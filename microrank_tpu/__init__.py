@@ -1,0 +1,36 @@
+"""microrank_tpu — a TPU-native trace-based root cause analysis framework.
+
+Brand-new implementation of the capabilities of MicroRank (WWW'21,
+CUHK-SE-Group/MicroRank): SLO-deviation anomaly detection over distributed
+traces, personalized PageRank over operation<->trace bipartite graphs, and
+weighted-spectrum ranking of suspect operations — rebuilt as an idiomatic
+JAX/XLA pipeline (host-side vectorized graph build -> padded COO arrays ->
+one jitted device program per window, vmap-able over window batches and
+shard_map-sharded over the graph's entry axis).
+
+See SURVEY.md for the structural analysis of the reference and the layer
+mapping; every module docstring cites the reference file:line it covers.
+"""
+
+from .config import (
+    CompatConfig,
+    DetectorConfig,
+    MicroRankConfig,
+    PageRankConfig,
+    RuntimeConfig,
+    SpectrumConfig,
+    WindowConfig,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MicroRankConfig",
+    "DetectorConfig",
+    "PageRankConfig",
+    "SpectrumConfig",
+    "WindowConfig",
+    "CompatConfig",
+    "RuntimeConfig",
+    "__version__",
+]

@@ -1,0 +1,154 @@
+"""Typed configuration for the whole framework.
+
+The reference hard-codes every constant at its call site (see SURVEY.md §2.2
+item 8; /root/reference/pagerank.py:116-117, online_rca.py:158-159,197-201).
+Here every knob lives in one frozen dataclass tree, with two presets:
+
+* ``MicroRankConfig()``             — paper semantics (the default).
+* ``MicroRankConfig.reference_compat()`` — bit-faithful reproduction of the
+  reference code's behavior, including its documented quirks (partition swap
+  at the orchestrator boundary, code-form anomalous preference vector).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class DetectorConfig:
+    """SLO-deviation anomaly detector (reference: anormaly_detector.py:44-84).
+
+    The reference has two detection paths with different thresholds:
+    the main path uses ``k_sigma=3`` and no slack
+    (anormaly_detector.py:64-65), the alternate/dead path uses ``k_sigma=1``
+    plus a 50 ms slack (anormaly_detector.py:107-110). The paper's Eq (1)
+    uses n=1.5. One configurable detector covers all three.
+    """
+
+    k_sigma: float = 3.0
+    slack_ms: float = 0.0
+    # A window is flagged anomalous iff >= min_abnormal_traces traces exceed
+    # their expected duration (reference: ``if anormaly_trace:`` i.e. >= 1).
+    min_abnormal_traces: int = 1
+
+    @classmethod
+    def single_trace_variant(cls) -> "DetectorConfig":
+        """The reference's alternate path (anormaly_detector.py:101-113)."""
+        return cls(k_sigma=1.0, slack_ms=50.0)
+
+
+@dataclass(frozen=True)
+class PageRankConfig:
+    """Personalized PageRank scorer (reference: pagerank.py:116-130)."""
+
+    iterations: int = 25
+    damping: float = 0.85          # d in the paper
+    call_weight: float = 0.01      # alpha / the paper's omega
+    # "reference": the code's anomalous preference vector (pagerank.py:75-85);
+    # "paper": Eq (7) — phi-weighted sum of normalized 1/n_t and 1/kind_t.
+    preference: str = "reference"
+    phi: float = 0.5               # only used by preference="paper"
+    # Max-normalize both ranking vectors every iteration
+    # (pagerank.py:126-127 — not in the paper, but load-bearing for parity).
+    max_normalize_each_iter: bool = True
+
+
+@dataclass(frozen=True)
+class SpectrumConfig:
+    """Weighted spectrum ranker (reference: online_rca.py:33-152)."""
+
+    method: str = "dstar2"
+    top_max: int = 5
+    # The reference emits ``top_max + 6`` rows (online_rca.py:148).
+    extra_rows: int = 6
+    # Missing-side spectrum value. Code uses 1e-7 (online_rca.py:57-58);
+    # the paper says 1e-4. Code wins by default.
+    eps: float = 1e-7
+
+    @property
+    def n_rows(self) -> int:
+        return self.top_max + self.extra_rows
+
+
+@dataclass(frozen=True)
+class WindowConfig:
+    """Sliding-window orchestration (reference: online_rca.py:155-216)."""
+
+    detect_minutes: float = 5.0    # online_rca.py:158
+    skip_minutes: float = 4.0      # extra advance after an anomaly (:215)
+
+
+@dataclass(frozen=True)
+class CompatConfig:
+    """Flags reproducing documented reference quirks (SURVEY.md §2.2)."""
+
+    # Quirk #1: the orchestrator unpacks (flag, abnormal, normal) as
+    # (flag, normal, abnormal) (online_rca.py:167), inverting the roles of
+    # the two partitions downstream. False = paper semantics.
+    partition_swap: bool = False
+    # Quirk #5: result.csv opened 'w' per anomaly — only the last survives.
+    # False = append per-window records (the sane behavior).
+    overwrite_results: bool = False
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Backend/device execution knobs (no reference equivalent — C18/C19)."""
+
+    backend: str = "jax"           # "jax" | "numpy_ref"
+    # Pad dynamic op/trace/nnz extents up to the next bucket to avoid jit
+    # recompilation storms (SURVEY.md §7 "Ragged → dense").
+    pad_policy: str = "pow2"       # "pow2" | "exact"
+    min_pad: int = 8
+    # Mesh axis sizes for the sharded path; None = single device.
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    mesh_axes: Tuple[str, ...] = ("shard",)
+    # Compute dtype for the iteration. float32 preserves ranking parity;
+    # bfloat16 trades precision for MXU throughput (rank-parity tested).
+    dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class MicroRankConfig:
+    detector: DetectorConfig = field(default_factory=DetectorConfig)
+    pagerank: PageRankConfig = field(default_factory=PageRankConfig)
+    spectrum: SpectrumConfig = field(default_factory=SpectrumConfig)
+    window: WindowConfig = field(default_factory=WindowConfig)
+    compat: CompatConfig = field(default_factory=CompatConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+
+    @classmethod
+    def reference_compat(cls) -> "MicroRankConfig":
+        """Preset that reproduces the reference code exactly, quirks and all."""
+        return cls(
+            compat=CompatConfig(partition_swap=True, overwrite_results=True),
+            pagerank=PageRankConfig(preference="reference"),
+        )
+
+    def replace(self, **kwargs: Any) -> "MicroRankConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MicroRankConfig":
+        def _mk(typ, sub):
+            flt = {k: v for k, v in sub.items() if k in {f.name for f in dataclasses.fields(typ)}}
+            if typ is RuntimeConfig and flt.get("mesh_shape") is not None:
+                flt["mesh_shape"] = tuple(flt["mesh_shape"])
+            if typ is RuntimeConfig and flt.get("mesh_axes") is not None:
+                flt["mesh_axes"] = tuple(flt["mesh_axes"])
+            return typ(**flt)
+
+        return cls(
+            detector=_mk(DetectorConfig, d.get("detector", {})),
+            pagerank=_mk(PageRankConfig, d.get("pagerank", {})),
+            spectrum=_mk(SpectrumConfig, d.get("spectrum", {})),
+            window=_mk(WindowConfig, d.get("window", {})),
+            compat=_mk(CompatConfig, d.get("compat", {})),
+            runtime=_mk(RuntimeConfig, d.get("runtime", {})),
+        )

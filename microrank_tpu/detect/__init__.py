@@ -1,0 +1,10 @@
+from .detector import DetectResult, detect_jax, detect_numpy
+from .slo import compute_slo, slo_as_dict
+
+__all__ = [
+    "DetectResult",
+    "detect_jax",
+    "detect_numpy",
+    "compute_slo",
+    "slo_as_dict",
+]

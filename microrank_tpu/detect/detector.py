@@ -1,0 +1,99 @@
+"""Vectorized SLO-deviation anomaly detector (reference components C4-C6).
+
+The reference loops in Python over traces and their operations
+(anormaly_detector.py:56-73). Here the whole window is three segment
+reductions over the span arrays:
+
+    expected[t] = sum over spans s in t of (mu + k*sigma)[op(s)]
+    real[t]     = max over spans s in t of duration(s) / 1000
+    abnormal[t] = real[t] > expected[t] + slack
+
+with the reference's edge semantics preserved: operations unseen in the SLO
+baseline contribute 0 (the bare ``except`` at anormaly_detector.py:66-67),
+and traces whose max span duration is <= 0 are dropped entirely
+(preprocess_data.py:116-117).
+
+Both a numpy implementation (host, oracle) and a jax implementation
+(jit/vmap-able, used by the device pipeline) are provided; they agree
+bit-for-bit on float32 inputs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+from ..config import DetectorConfig
+from ..graph.structures import DetectBatch, SloBaseline
+from ..io.schema import US_PER_MS
+
+
+class DetectResult(NamedTuple):
+    """Per-trace verdicts on the window-local trace axis."""
+
+    abnormal: np.ndarray  # bool[T] trace exceeded its expected duration
+    valid: np.ndarray     # bool[T] trace has positive duration (kept)
+    flag: np.ndarray      # bool scalar: window is anomalous
+    expected_ms: np.ndarray  # float32[T]
+    real_ms: np.ndarray      # float32[T]
+
+
+def _thresholds(baseline: SloBaseline, cfg: DetectorConfig) -> np.ndarray:
+    return baseline.mean_ms + np.float32(cfg.k_sigma) * baseline.std_ms
+
+
+def detect_numpy(
+    batch: DetectBatch, baseline: SloBaseline, cfg: DetectorConfig
+) -> DetectResult:
+    n_traces = int(batch.n_traces)
+    n_spans = int(batch.n_spans)
+    op = batch.op[:n_spans]
+    trace = batch.trace[:n_spans]
+    dur = batch.duration_us[:n_spans].astype(np.float32)
+
+    thresh = _thresholds(baseline, cfg)
+    contrib = np.where(op >= 0, thresh[np.clip(op, 0, None)], np.float32(0.0))
+    expected = np.bincount(trace, weights=contrib, minlength=n_traces).astype(
+        np.float32
+    )
+    real_us = np.full(n_traces, -np.inf, dtype=np.float32)
+    np.maximum.at(real_us, trace, dur)
+    real = (real_us / np.float32(US_PER_MS)).astype(np.float32)
+
+    valid = real > 0
+    abnormal = valid & (real > expected + np.float32(cfg.slack_ms))
+    flag = np.asarray(abnormal.sum() >= cfg.min_abnormal_traces)
+    return DetectResult(abnormal, valid, flag, expected, real)
+
+
+def detect_jax(
+    batch, thresh, n_traces_pad: int, cfg: DetectorConfig
+):
+    """JAX twin of ``detect_numpy``; fully shape-static, jittable.
+
+    ``thresh`` is the precomputed ``mu + k*sigma`` float32 array (padding
+    the SLO vocab with one trailing slot is the caller's concern);
+    ``n_traces_pad`` is the static padded trace count. Padding spans carry
+    op=-1 / duration=0 and are additionally masked by ``n_spans``.
+    """
+    import jax.numpy as jnp
+    from jax import ops as jops
+
+    span_live = jnp.arange(batch.op.shape[0]) < batch.n_spans
+    known = (batch.op >= 0) & span_live
+    contrib = jnp.where(
+        known, jnp.take(thresh, jnp.clip(batch.op, 0), mode="clip"), 0.0
+    )
+    expected = jops.segment_sum(
+        contrib, batch.trace, num_segments=n_traces_pad
+    ).astype(jnp.float32)
+    dur = jnp.where(span_live, batch.duration_us, -jnp.inf)
+    real_us = jops.segment_max(dur, batch.trace, num_segments=n_traces_pad)
+    real = (real_us / US_PER_MS).astype(jnp.float32)
+
+    trace_live = jnp.arange(n_traces_pad) < batch.n_traces
+    valid = trace_live & (real > 0)
+    abnormal = valid & (real > expected + jnp.float32(cfg.slack_ms))
+    flag = abnormal.sum() >= cfg.min_abnormal_traces
+    return DetectResult(abnormal, valid, flag, expected, real)

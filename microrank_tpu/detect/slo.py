@@ -1,0 +1,46 @@
+"""SLO baseline: per-operation duration mean/std (reference component C3).
+
+Reproduces ``get_operation_slo`` (/root/reference/preprocess_data.py:50-78):
+population std (numpy ddof=0), microsecond durations converted to ms and
+rounded to 4 decimals. The reference returns ``{op: [mean, std]}``; here the
+canonical form is a ``Vocab`` plus dense float32 arrays (the device-ready
+layout), with the dict view derivable for the oracle backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..graph.structures import SloBaseline
+from ..io.interning import Vocab
+from ..io.naming import operation_names
+from ..io.schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES, US_PER_MS
+
+
+def compute_slo(
+    span_df: pd.DataFrame,
+    strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
+) -> Tuple[Vocab, SloBaseline]:
+    """Compute the SLO baseline from a (long) normal-period span dump."""
+    names = operation_names(span_df, "service", strip_services)
+    dur = span_df["duration"].astype(float)
+    grouped = dur.groupby(names.to_numpy())
+    mean_ms = (grouped.mean() / US_PER_MS).round(4)
+    std_ms = (grouped.std(ddof=0) / US_PER_MS).round(4)
+    vocab = Vocab(mean_ms.index.tolist())
+    baseline = SloBaseline(
+        mean_ms=mean_ms.to_numpy(dtype=np.float32),
+        std_ms=std_ms.to_numpy(dtype=np.float32),
+    )
+    return vocab, baseline
+
+
+def slo_as_dict(vocab: Vocab, baseline: SloBaseline) -> Dict[str, List[float]]:
+    """The reference's ``{operation: [mean, std]}`` view."""
+    return {
+        vocab.name(i): [float(baseline.mean_ms[i]), float(baseline.std_ms[i])]
+        for i in range(len(vocab))
+    }

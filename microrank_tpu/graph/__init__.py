@@ -1,0 +1,27 @@
+from .build import (
+    build_detect_batch,
+    build_partition_graph,
+    build_window_graph,
+)
+from .dicts import pagerank_graph_dicts
+from .structures import (
+    DetectBatch,
+    PartitionGraph,
+    SloBaseline,
+    WindowGraph,
+    pad1d,
+    pad_to,
+)
+
+__all__ = [
+    "build_detect_batch",
+    "build_partition_graph",
+    "build_window_graph",
+    "pagerank_graph_dicts",
+    "DetectBatch",
+    "PartitionGraph",
+    "SloBaseline",
+    "WindowGraph",
+    "pad1d",
+    "pad_to",
+]

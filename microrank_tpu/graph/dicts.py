@@ -1,0 +1,69 @@
+"""Faithful dict-form PageRank-graph build (reference component C8).
+
+Reproduces ``get_pagerank_graph`` (/root/reference/preprocess_data.py:146-171)
+semantics exactly — including its quirks — so the numpy oracle backend can be
+driven by byte-identical inputs:
+
+* call graph ``operation_operation[parent] = [child, child, ...]`` keeps one
+  entry per call-edge *instance* (duplicates preserved); childless ops map to
+  ``[]`` (preprocess_data.py:160-163);
+* the parent-child merge joins on ``ParentSpanId == spanID`` globally (not
+  per-trace) over the partition's spans (preprocess_data.py:157-158);
+* ``operation_trace`` / ``pr_trace`` are content-identical groupbys
+  (SURVEY.md §2.2 quirk #7);
+* instance-level (podName) operation naming with the strip rule keyed on
+  serviceName (preprocess_data.py:151-155).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Tuple
+
+import pandas as pd
+
+from ..io.naming import operation_names
+from ..io.schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES
+
+GraphDicts = Tuple[
+    Dict[str, List[str]],  # operation_operation
+    Dict[str, List[str]],  # operation_trace: traceID -> [op, ...] (with dups)
+    Dict[str, List[str]],  # trace_operation: op -> [traceID, ...] (with dups)
+    Dict[str, List[str]],  # pr_trace (== operation_trace)
+]
+
+
+def pagerank_graph_dicts(
+    trace_ids: Iterable[str],
+    span_df: pd.DataFrame,
+    strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
+) -> GraphDicts:
+    filtered = span_df[span_df["traceID"].isin(set(trace_ids))]
+    filtered = filtered.assign(
+        operation_name=operation_names(filtered, "pod", strip_services)
+    )
+
+    parent_child = filtered[["traceID", "spanID", "ParentSpanId", "operation_name"]]
+    merged = parent_child.merge(
+        parent_child,
+        left_on="ParentSpanId",
+        right_on="spanID",
+        suffixes=("_child", "_parent"),
+    )
+    operation_operation = (
+        merged.groupby("operation_name_parent")["operation_name_child"]
+        .apply(list)
+        .to_dict()
+    )
+    for operation in filtered["operation_name"].unique():
+        if operation not in operation_operation:
+            operation_operation[operation] = []
+
+    operation_trace = (
+        filtered.groupby("traceID")["operation_name"].apply(list).to_dict()
+    )
+    trace_operation = (
+        filtered.groupby("operation_name")["traceID"].apply(list).to_dict()
+    )
+    pr_trace = {k: list(v) for k, v in operation_trace.items()}
+
+    return operation_operation, operation_trace, trace_operation, pr_trace

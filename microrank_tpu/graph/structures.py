@@ -1,0 +1,96 @@
+"""Array-form window/graph structures — the host<->device data contract.
+
+The reference passes Python dicts of strings between stages
+(preprocess_data.py:146-171 -> pagerank.py:15). Here each stage exchanges
+flat, padded, int32/float32 arrays: NamedTuples so they are automatically
+JAX pytrees, with dynamic extents carried as 0-d arrays (traced values) and
+padded extents carried in the shapes (static under jit).
+
+Sparsity layout: ``p_sr`` and ``p_rs`` (pagerank.py:42-52) share one unique
+(op, trace) incidence pattern — only their values differ — so a partition
+stores the pair list once with two value arrays.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class PartitionGraph(NamedTuple):
+    """One trace partition's PageRank graph, padded, in a shared window
+    op-vocab of (padded) size ``V``.
+
+    Extents: E = padded unique (op,trace) incidence entries, C = padded
+    unique (child_op, parent_op) call edges, T = padded trace count.
+    Padding rows carry value 0.0 / index 0 and are inert under segment-sum.
+    """
+
+    # Unique (op, trace) incidence entries (trace ids are partition-local).
+    inc_op: np.ndarray      # int32[E]
+    inc_trace: np.ndarray   # int32[E]
+    sr_val: np.ndarray      # float32[E]  = 1 / len_with_dups(trace)   (p_sr)
+    rs_val: np.ndarray      # float32[E]  = 1 / cov_with_dups(op)      (p_rs)
+    # Unique call-graph edges (child <- parent).
+    ss_child: np.ndarray    # int32[C]
+    ss_parent: np.ndarray   # int32[C]
+    ss_val: np.ndarray      # float32[C]  = 1 / outdeg_with_dups(parent)
+    # Per-trace statistics (partition-local trace axis, padded to T).
+    kind: np.ndarray        # int32[T]    size of the trace's dedup kind (C10)
+    tracelen: np.ndarray    # int32[T]    # spans in trace (with dups)
+    # Per-op statistics on the shared window vocab.
+    cov_unique: np.ndarray  # int32[V]    # unique traces covering op (C13)
+    op_present: np.ndarray  # bool[V]     op appears in this partition
+    # Dynamic extents (0-d int32): actual counts before padding.
+    n_ops: np.ndarray       # ops present in this partition (reference O)
+    n_traces: np.ndarray    # traces in this partition      (reference T)
+    n_inc: np.ndarray       # actual incidence entries
+    n_ss: np.ndarray        # actual call edges
+
+
+class WindowGraph(NamedTuple):
+    """Both partitions of one detection window over a shared op vocab."""
+
+    normal: PartitionGraph
+    abnormal: PartitionGraph
+
+
+class DetectBatch(NamedTuple):
+    """Arrays for the vectorized anomaly detector (components C4+C5).
+
+    Spans of one detection window, interned: ``op`` indexes the SLO
+    baseline vocab (service-level naming; -1 = unseen in baseline),
+    ``trace`` is window-local. Padding spans carry trace index 0 and
+    weight 0 via op=-1/duration=0 and are masked by ``n_spans``.
+    """
+
+    op: np.ndarray        # int32[S] id into the SLO vocab, -1 if unknown
+    trace: np.ndarray     # int32[S] window-local trace id
+    duration_us: np.ndarray  # float32[S] span duration, microseconds
+    n_spans: np.ndarray   # int32 0-d
+    n_traces: np.ndarray  # int32 0-d
+
+
+class SloBaseline(NamedTuple):
+    """Per-operation SLO stats (component C3), ms, aligned to a Vocab."""
+
+    mean_ms: np.ndarray   # float32[n_ops]
+    std_ms: np.ndarray    # float32[n_ops]
+
+
+def pad_to(n: int, policy: str = "pow2", min_pad: int = 8) -> int:
+    """Bucketed padding size to avoid jit recompilation storms."""
+    n = max(int(n), 1)
+    if policy == "exact":
+        return n
+    p = max(min_pad, 1)
+    while p < n:
+        p <<= 1
+    return p
+
+
+def pad1d(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    out = np.full((size,), fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out

@@ -1,0 +1,24 @@
+from .interning import Vocab, factorize_local
+from .loader import load_traces_csv, window_spans
+from .naming import operation_names, service_operation_list
+from .schema import (
+    CLICKHOUSE_RENAME,
+    DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
+    REQUIRED_COLUMNS,
+    US_PER_MS,
+    validate_columns,
+)
+
+__all__ = [
+    "Vocab",
+    "factorize_local",
+    "load_traces_csv",
+    "window_spans",
+    "operation_names",
+    "service_operation_list",
+    "CLICKHOUSE_RENAME",
+    "DEFAULT_STRIP_LAST_SEGMENT_SERVICES",
+    "REQUIRED_COLUMNS",
+    "US_PER_MS",
+    "validate_columns",
+]

@@ -1,0 +1,79 @@
+"""String interning: the device never sees strings (SURVEY.md C2 plan).
+
+Operations and trace ids are interned to dense int32 ids host-side; all
+device arrays carry ids only. A ``Vocab`` is append-only and stable, so ids
+are valid across windows of a run and can be checkpointed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+class Vocab:
+    """Append-only string <-> int32 interner."""
+
+    __slots__ = ("_index", "_names")
+
+    def __init__(self, names: Optional[Iterable[str]] = None):
+        self._index: Dict[str, int] = {}
+        self._names: List[str] = []
+        if names is not None:
+            for n in names:
+                self.add(n)
+
+    def add(self, name: str) -> int:
+        idx = self._index.get(name)
+        if idx is None:
+            idx = len(self._names)
+            self._index[name] = idx
+            self._names.append(name)
+        return idx
+
+    def update(self, names: Iterable[str]) -> None:
+        for n in names:
+            self.add(n)
+
+    def encode(self, names: Sequence[str], missing: int = -1) -> np.ndarray:
+        """int32 ids; unseen names map to ``missing`` (no mutation)."""
+        return np.asarray(
+            [self._index.get(n, missing) for n in names], dtype=np.int32
+        )
+
+    def encode_series(self, names: pd.Series, missing: int = -1) -> np.ndarray:
+        return names.map(self._index).fillna(missing).to_numpy(dtype=np.int32)
+
+    def grow_encode(self, names: pd.Series) -> np.ndarray:
+        """Intern every name (adding unseen ones) and return ids."""
+        uniques = pd.unique(names)
+        for n in uniques:
+            self.add(n)
+        return self.encode_series(names)
+
+    def decode(self, ids: Iterable[int]) -> List[str]:
+        return [self._names[int(i)] for i in ids]
+
+    def name(self, idx: int) -> str:
+        return self._names[int(idx)]
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+
+def factorize_local(names: pd.Series) -> tuple:
+    """Window-local interning: ids in first-seen order plus the vocab list.
+
+    Backed by ``pd.factorize`` — O(n), no Python loop.
+    """
+    codes, uniques = pd.factorize(names, use_na_sentinel=False)
+    return codes.astype(np.int32), list(uniques)

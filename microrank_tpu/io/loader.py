@@ -1,0 +1,36 @@
+"""CSV ingest honoring the reference's load contract
+(/root/reference/online_rca.py:219-248): read the ClickHouse export, rename
+columns to the canonical schema, and parse trace-level start/end datetimes.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Union
+
+import pandas as pd
+
+from .schema import CLICKHOUSE_RENAME, REQUIRED_COLUMNS, validate_columns
+
+
+def load_traces_csv(path: Union[str, Path]) -> pd.DataFrame:
+    """Load one ``traces.csv`` dump into the canonical span DataFrame."""
+    df = pd.read_csv(path)
+    # Renaming is a no-op for already-canonical columns, so both raw
+    # ClickHouse exports and canonical CSVs load through the same path.
+    df = df.rename(columns=CLICKHOUSE_RENAME)
+    validate_columns(df.columns)
+    df["startTime"] = pd.to_datetime(df["startTime"], format="mixed")
+    df["endTime"] = pd.to_datetime(df["endTime"], format="mixed")
+    return df
+
+
+def window_spans(df: pd.DataFrame, start=None, end=None) -> pd.DataFrame:
+    """Filter spans to a window (reference: get_span, preprocess_data.py:10-14).
+
+    Keeps rows with ``startTime >= start`` and ``endTime <= end``. Like the
+    reference, a missing bound disables filtering entirely.
+    """
+    if start is not None and end is not None:
+        return df[(df["startTime"] >= start) & (df["endTime"] <= end)]
+    return df

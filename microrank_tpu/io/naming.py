@@ -1,0 +1,66 @@
+"""Canonical operation naming (reference component C2).
+
+The reference repeats one ``np.where`` idiom four times
+(preprocess_data.py:27-31, :53-57, :100-104, :151-155): the canonical
+operation id is ``<prefix>_<operationName>``, where for services in the
+strip set (hard-coded 'ts-ui-dashboard' upstream) the last URL path segment
+of the operation name is dropped, collapsing parameterized endpoints.
+
+Two naming levels exist:
+* service-level (``serviceName`` prefix) — used by the SLO baseline and the
+  anomaly detector (preprocess_data.py:26-33, :100-104);
+* instance-level (``podName`` prefix)  — used by the PageRank graph
+  (preprocess_data.py:151-155). The strip rule still keys on serviceName.
+"""
+
+from __future__ import annotations
+
+from typing import FrozenSet, Iterable
+
+import numpy as np
+import pandas as pd
+
+from .schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES
+
+
+def _stripped_op_name(op_names: pd.Series) -> pd.Series:
+    # 'a/b/c' -> 'a/b' ; 'a' -> 'a' (pandas rsplit keeps the whole string
+    # when there is no separator — matches the reference's .str[0]).
+    return op_names.str.rsplit("/", n=1).str[0]
+
+
+def operation_names(
+    span_df: pd.DataFrame,
+    level: str = "service",
+    strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
+) -> pd.Series:
+    """Vectorized canonical operation name per span row.
+
+    ``level`` is "service" (detector/SLO vocab) or "pod" (PageRank vocab).
+    Unlike the reference, the input DataFrame is never mutated
+    (preprocess_data.py:100-104 renames a caller's column in place —
+    SURVEY.md §2.2 quirk #6).
+    """
+    if level == "service":
+        prefix = span_df["serviceName"].astype(str)
+    elif level == "pod":
+        prefix = span_df["podName"].astype(str)
+    else:
+        raise ValueError(f"unknown naming level {level!r}")
+    op = span_df["operationName"].astype(str)
+    in_strip = span_df["serviceName"].isin(strip_services)
+    if bool(in_strip.any()):
+        name = pd.Series(
+            np.where(in_strip.to_numpy(), (prefix + "_" + _stripped_op_name(op)).to_numpy(),
+                     (prefix + "_" + op).to_numpy()),
+            index=span_df.index,
+        )
+    else:
+        name = prefix + "_" + op
+    return name
+
+
+def service_operation_list(span_df: pd.DataFrame, strip_services=DEFAULT_STRIP_LAST_SEGMENT_SERVICES) -> list:
+    """All distinct service-level operations, first-seen order
+    (reference: get_service_operation_list, preprocess_data.py:26-33)."""
+    return operation_names(span_df, "service", strip_services).drop_duplicates().tolist()

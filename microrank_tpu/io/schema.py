@@ -1,0 +1,55 @@
+"""The input span-data contract (reference: SURVEY.md §2.1).
+
+The reference's ground-truth input is a CSV dump of OTel traces exported from
+ClickHouse with columns ``Timestamp, TraceId, SpanId, ParentSpanId, SpanName,
+ServiceName, PodName, Duration, SpanKind, TraceStart, TraceEnd``
+(/root/reference/collect_data.py:36-46), renamed at load time
+(/root/reference/online_rca.py:222-232). ``Duration`` is in microseconds and
+is compared in milliseconds downstream (preprocess_data.py:71,73).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+# ClickHouse export column -> canonical column (online_rca.py:222-232).
+CLICKHOUSE_RENAME: Dict[str, str] = {
+    "TraceId": "traceID",
+    "SpanId": "spanID",
+    "ServiceName": "serviceName",
+    "SpanName": "operationName",
+    "PodName": "podName",
+    "Duration": "duration",
+    "TraceStart": "startTime",
+    "TraceEnd": "endTime",
+}
+
+# Canonical columns the pipeline requires after rename.
+REQUIRED_COLUMNS: List[str] = [
+    "traceID",
+    "spanID",
+    "ParentSpanId",
+    "operationName",
+    "serviceName",
+    "podName",
+    "duration",   # microseconds
+    "startTime",  # trace-level start (datetime)
+    "endTime",    # trace-level end (datetime)
+]
+
+# Services whose operation names get their last URL path segment stripped,
+# collapsing parameterized endpoints (preprocess_data.py:27-31 hard-codes
+# 'ts-ui-dashboard'; here it is a configurable set).
+DEFAULT_STRIP_LAST_SEGMENT_SERVICES = frozenset({"ts-ui-dashboard"})
+
+US_PER_MS = 1000.0
+
+
+def validate_columns(columns) -> None:
+    missing = [c for c in REQUIRED_COLUMNS if c not in set(columns)]
+    if missing:
+        raise ValueError(
+            f"span DataFrame is missing required columns {missing}; "
+            f"expected the contract {REQUIRED_COLUMNS} "
+            "(rename ClickHouse exports via microrank_tpu.io.load_traces_csv)"
+        )

@@ -1,0 +1,3 @@
+from .segment import coo_matvec, masked_max, masked_sum, segment_count
+
+__all__ = ["coo_matvec", "masked_max", "masked_sum", "segment_count"]

@@ -1,0 +1,39 @@
+"""Segment-reduction primitives — the workhorse ops of the device pipeline.
+
+Everything the reference does with dense matvecs and Python loops reduces
+to gathers + segment sums over padded COO arrays: XLA lowers these to
+efficient scatter-adds on TPU, they are trivially vmap-able over window
+batches, and sharding the *entry* axis (with a psum of the dense partials)
+is the whole distribution story (SURVEY.md C18/C19 plan).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def coo_matvec(rows, cols, vals, x, n_rows: int):
+    """y = A @ x for COO entries A[rows[i], cols[i]] = vals[i].
+
+    Padding entries must carry ``vals == 0`` (rows/cols may be any valid
+    index); they then contribute nothing. ``n_rows`` is static.
+    """
+    return jax.ops.segment_sum(
+        vals * jnp.take(x, cols, mode="clip"), rows, num_segments=n_rows
+    )
+
+
+def segment_count(ids, n_segments: int, live=None):
+    ones = jnp.ones(ids.shape, dtype=jnp.int32)
+    if live is not None:
+        ones = jnp.where(live, ones, 0)
+    return jax.ops.segment_sum(ones, ids, num_segments=n_segments)
+
+
+def masked_max(x, mask, fill=-jnp.inf):
+    return jnp.max(jnp.where(mask, x, fill))
+
+
+def masked_sum(x, mask):
+    return jnp.sum(jnp.where(mask, x, 0))

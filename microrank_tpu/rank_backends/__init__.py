@@ -1,0 +1,52 @@
+"""Backend registry: ``numpy_ref`` (CPU parity oracle) and ``jax`` (TPU)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..config import MicroRankConfig
+from . import numpy_ref
+from .base import RankBackend
+
+
+class NumpyRefBackend:
+    """Oracle backend: faithful reference semantics over graph dicts."""
+
+    name = "numpy_ref"
+
+    def __init__(self, config: MicroRankConfig = MicroRankConfig()):
+        self.config = config
+
+    def rank_window(
+        self, span_df, normal_ids, abnormal_ids
+    ) -> Tuple[List[str], List[float]]:
+        from ..graph.dicts import pagerank_graph_dicts
+        from .base import validate_partitions
+
+        normal_ids = list(normal_ids)
+        abnormal_ids = list(abnormal_ids)
+        validate_partitions(normal_ids, abnormal_ids)
+        normal_graph = pagerank_graph_dicts(normal_ids, span_df)
+        abnormal_graph = pagerank_graph_dicts(abnormal_ids, span_df)
+        return numpy_ref.rank_window_dicts(
+            normal_graph,
+            abnormal_graph,
+            n_normal_traces=len(normal_ids),
+            n_abnormal_traces=len(abnormal_ids),
+            pagerank_cfg=self.config.pagerank,
+            spectrum_cfg=self.config.spectrum,
+        )
+
+
+def get_backend(config: MicroRankConfig) -> RankBackend:
+    name = config.runtime.backend
+    if name in ("jax", "jax_tpu", "tpu"):
+        from .jax_tpu import JaxBackend
+
+        return JaxBackend(config)
+    if name in ("numpy", "numpy_ref", "reference"):
+        return NumpyRefBackend(config)
+    raise ValueError(f"unknown rank backend {name!r}")
+
+
+__all__ = ["RankBackend", "NumpyRefBackend", "get_backend", "numpy_ref"]

@@ -1,0 +1,40 @@
+"""The backend seam (BASELINE.json north star: ``rank_backends/`` with
+numpy-reference and jax-tpu implementations selected at the orchestrator
+entry). A backend ranks one detection window given the span DataFrame and
+the two trace partitions."""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Tuple, runtime_checkable
+
+
+def validate_partitions(normal_ids, abnormal_ids) -> None:
+    """Both partitions must be non-empty to rank a window.
+
+    The reference guards this at the orchestrator (online_rca.py:176-178)
+    and crashes deep inside numpy if bypassed; backends here fail fast and
+    identically instead.
+    """
+    if not normal_ids or not abnormal_ids:
+        raise ValueError(
+            "rank_window requires non-empty normal AND abnormal trace "
+            f"partitions (got {len(list(normal_ids))} normal / "
+            f"{len(list(abnormal_ids))} abnormal); windows that fail to "
+            "partition should be skipped, as the reference does at "
+            "online_rca.py:176-178"
+        )
+
+
+@runtime_checkable
+class RankBackend(Protocol):
+    name: str
+
+    def rank_window(
+        self, span_df, normal_ids, abnormal_ids
+    ) -> Tuple[List[str], List[float]]:
+        """Rank one window's suspect operations.
+
+        Returns (op_names, scores), score-descending, at most
+        ``top_max + extra_rows`` entries (reference: online_rca.py:144-152).
+        """
+        ...

@@ -1,0 +1,211 @@
+"""TPU-native ranking backend (reference components C9-C14, redesigned).
+
+The reference's ranking core is dense numpy matvecs over Python-dict-built
+matrices (pagerank.py) plus a per-op Python loop for the spectrum
+(online_rca.py:33-152). Here the whole window ranking —
+
+    preference vector -> 25-step power iteration (both partitions)
+    -> rescale -> spectrum counters -> formula -> top-k
+
+— is ONE jit-compiled XLA program over padded COO arrays:
+
+* SpMV is gather + segment-sum over the unique (op, trace) incidence
+  entries (``p_sr``/``p_rs`` share the pattern, two value arrays) and the
+  call edges (``p_ss``);
+* the iteration is a ``lax.fori_loop`` (static trip count — the reference
+  runs exactly 25 iterations with no convergence check, pagerank.py:117);
+* both partitions iterate in the same program (XLA schedules them
+  side by side);
+* the 13 spectrum formulas are an elementwise [V] kernel fused by XLA;
+* ranking ends with ``lax.top_k`` on device.
+
+The function is vmap-able over a leading window-batch axis and is the unit
+the sharded path (microrank_tpu.parallel) wraps with shard_map + psum.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import MicroRankConfig, PageRankConfig, SpectrumConfig
+from ..graph.structures import PartitionGraph, WindowGraph
+from ..ops.segment import coo_matvec
+from ..spectrum.formulas import spectrum_scores
+
+
+def preference_vector(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
+    """Personalized preference vector on the padded trace axis
+    (reference: pagerank.py:68-85; paper Eq (7) behind preference="paper")."""
+    t_pad = g.kind.shape[0]
+    live = jnp.arange(t_pad) < g.n_traces
+    kind = g.kind.astype(jnp.float32)
+    tlen = g.tracelen.astype(jnp.float32)
+    inv_kind = jnp.where(live, 1.0 / kind, 0.0)
+    inv_len = jnp.where(live, 1.0 / tlen, 0.0)
+    kind_sum = inv_kind.sum()
+    num_sum = inv_len.sum()
+
+    if not anomaly:
+        pref = inv_kind / kind_sum
+    elif cfg.preference == "reference":
+        # The code's anomalous form (deviates from paper Eq (7) —
+        # SURVEY.md §2.2 quirk #4): phi / num_sum / (kind/kind_sum*phi + 1/n).
+        phi = jnp.float32(cfg.phi)
+        pref = phi / num_sum / (kind / kind_sum * phi + inv_len)
+    elif cfg.preference == "paper":
+        phi = jnp.float32(cfg.phi)
+        pref = phi * inv_len / num_sum + (1.0 - phi) * inv_kind / kind_sum
+    else:
+        raise ValueError(f"unknown preference form {cfg.preference!r}")
+    return jnp.where(live, pref, 0.0).astype(jnp.float32)
+
+
+def partition_pagerank(
+    g: PartitionGraph, anomaly: bool, cfg: PageRankConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Power-iterate one partition; returns (weight[V], score[V]).
+
+    ``weight`` is the reference's rescaled output
+    (score * sum(scores) / n_ops, pagerank.py:106-107); ``score`` the raw
+    max-normalized PageRank vector. Ops absent from the partition have no
+    incoming entries, stay at 0, and cannot perturb present ops — so
+    running on the shared window vocab is exact.
+    """
+    v = g.cov_unique.shape[0]
+    t_pad = g.kind.shape[0]
+    n_total = (g.n_ops + g.n_traces).astype(jnp.float32)
+    trace_live = jnp.arange(t_pad) < g.n_traces
+
+    pref = preference_vector(g, anomaly, cfg)
+    d = jnp.float32(cfg.damping)
+    alpha = jnp.float32(cfg.call_weight)
+
+    sv = jnp.where(g.op_present, 1.0 / n_total, 0.0).astype(jnp.float32)
+    rv = jnp.where(trace_live, 1.0 / n_total, 0.0).astype(jnp.float32)
+
+    def body(_, carry):
+        sv, rv = carry
+        # p_sr @ rv  +  alpha * p_ss @ sv   (pagerank.py:122-124)
+        sv_new = d * (
+            coo_matvec(g.inc_op, g.inc_trace, g.sr_val, rv, v)
+            + alpha * coo_matvec(g.ss_child, g.ss_parent, g.ss_val, sv, v)
+        )
+        # p_rs @ sv + (1-d) * pref          (pagerank.py:125)
+        rv_new = (
+            d * coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
+            + (1.0 - d) * pref
+        )
+        if cfg.max_normalize_each_iter:
+            sv_new = sv_new / jnp.max(sv_new)
+            rv_new = rv_new / jnp.max(rv_new)
+        return sv_new, rv_new
+
+    sv, rv = lax.fori_loop(0, cfg.iterations, body, (sv, rv))
+    score = sv / jnp.max(sv)
+
+    total = jnp.where(g.op_present, score, 0.0).sum()
+    weight = score * total / g.n_ops.astype(jnp.float32)
+    return weight, score
+
+
+def window_spectrum(
+    a_weight,
+    a_graph: PartitionGraph,
+    n_weight,
+    n_graph: PartitionGraph,
+    cfg: SpectrumConfig,
+):
+    """Spectrum counters + formula over the shared op vocab [V]
+    (reference: online_rca.py:43-142, including the asymmetric
+    only-in-normal branch at :65-66). Returns (scores[V], valid[V])."""
+    eps = jnp.float32(cfg.eps)
+    a_present = a_graph.op_present
+    n_present = n_graph.op_present
+    a_cov = a_graph.cov_unique.astype(jnp.float32)
+    n_cov = n_graph.cov_unique.astype(jnp.float32)
+    a_len = a_graph.n_traces.astype(jnp.float32)
+    n_len = n_graph.n_traces.astype(jnp.float32)
+
+    ef = jnp.where(a_present, a_weight * a_cov, eps)
+    nf = jnp.where(a_present, a_weight * (a_len - a_cov), eps)
+    ep = jnp.where(
+        a_present,
+        jnp.where(n_present, n_weight * n_cov, eps),
+        (1.0 + n_weight) * n_cov,
+    )
+    np_ = jnp.where(
+        a_present,
+        jnp.where(n_present, n_weight * (n_len - n_cov), eps),
+        n_len - n_cov,
+    )
+    scores = spectrum_scores(ef, nf, ep, np_, cfg.method)
+    valid = a_present | n_present
+    return jnp.where(valid, scores, -jnp.inf), valid
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def rank_window_device(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+):
+    """The full single-window ranking as one XLA program.
+
+    Returns (top_idx int32[k], top_scores float32[k], n_valid int32):
+    indices into the shared window op vocab, score-descending;
+    entries beyond ``n_valid`` are padding (score -inf).
+    """
+    n_weight, _ = partition_pagerank(graph.normal, False, pagerank_cfg)
+    a_weight, _ = partition_pagerank(graph.abnormal, True, pagerank_cfg)
+    scores, valid = window_spectrum(
+        a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
+    )
+    k = min(spectrum_cfg.n_rows, scores.shape[0])
+    top_scores, top_idx = lax.top_k(scores, k)
+    n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
+    return top_idx.astype(jnp.int32), top_scores, n_valid
+
+
+class JaxBackend:
+    """The ``rank_backends`` seam's device implementation.
+
+    Host side builds the padded COO window graph; everything after that is
+    the jitted device program above. See NumpyRefBackend for the oracle
+    twin behind the same interface.
+    """
+
+    name = "jax"
+
+    def __init__(self, config: MicroRankConfig = MicroRankConfig()):
+        self.config = config
+
+    def rank_window(
+        self, span_df, normal_ids, abnormal_ids
+    ) -> Tuple[List[str], List[float]]:
+        from ..graph.build import build_window_graph
+        from .base import validate_partitions
+
+        normal_ids = list(normal_ids)
+        abnormal_ids = list(abnormal_ids)
+        validate_partitions(normal_ids, abnormal_ids)
+        rt = self.config.runtime
+        graph, op_names, _, _ = build_window_graph(
+            span_df,
+            normal_ids,
+            abnormal_ids,
+            pad_policy=rt.pad_policy,
+            min_pad=rt.min_pad,
+        )
+        top_idx, top_scores, n_valid = rank_window_device(
+            jax.tree.map(jnp.asarray, graph),
+            self.config.pagerank,
+            self.config.spectrum,
+        )
+        n = int(n_valid)
+        idx = [int(i) for i in top_idx[:n]]
+        return [op_names[i] for i in idx], [float(s) for s in top_scores[:n]]

@@ -1,0 +1,3 @@
+from .synthetic import SyntheticCase, SyntheticConfig, Topology, generate_case
+
+__all__ = ["SyntheticCase", "SyntheticConfig", "Topology", "generate_case"]

@@ -1,0 +1,214 @@
+"""Synthetic microservice trace generator with fault injection.
+
+The reference's evaluation data comes from chaos experiments against live
+k8s testbeds harvested by collect_data.py; nothing ships with the repo, so
+the new framework gets a first-class generator (SURVEY.md §4 item 3, §5
+fault-injection row): a random service call tree, a small set of "trace
+kinds" (pruned subtrees — real systems exhibit few distinct trace shapes,
+which is exactly what the reference's kind-dedup exploits), lognormal
+per-operation service times, and *inclusive* span durations (a parent span
+covers its children), so the reference's trace-duration-=-max-span rule
+(preprocess_data.py:110) picks the root span.
+
+Fault injection adds latency to one (service, pod) operation during the
+abnormal window; the inclusive-duration computation propagates it to all
+ancestors, giving the detector a real signal. Output DataFrames follow the
+canonical span schema (microrank_tpu.io.schema) byte-for-byte.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+@dataclass(frozen=True)
+class SyntheticConfig:
+    n_operations: int = 40
+    n_pods: int = 1            # pods per service (instance-level RCA when >1)
+    n_kinds: int = 8           # distinct trace shapes
+    child_keep_prob: float = 0.8
+    n_traces: int = 200
+    mean_own_ms_range: Tuple[float, float] = (1.0, 20.0)
+    sigma_log: float = 0.3
+    # Expected duration is the sum of *inclusive* per-span SLOs (+k*sigma
+    # each), so the detector's margin is large by construction; the injected
+    # latency must clear it (see tests/test_detector.py).
+    fault_latency_ms: float = 2000.0
+    window_minutes: float = 5.0
+    seed: int = 0
+
+
+@dataclass
+class Topology:
+    parent: np.ndarray          # int [n_ops], parent[0] = -1
+    mean_own_ms: np.ndarray     # float [n_ops]
+    kinds: List[np.ndarray]     # each: topo-ordered op ids forming a subtree
+    kind_parent_pos: List[np.ndarray]  # position of op's parent within kind
+
+
+def _make_topology(cfg: SyntheticConfig, rng: np.random.Generator) -> Topology:
+    n = cfg.n_operations
+    parent = np.full(n, -1, dtype=np.int64)
+    for i in range(1, n):
+        parent[i] = rng.integers(0, i)
+    mean_own = rng.uniform(*cfg.mean_own_ms_range, size=n)
+
+    kinds = []
+    kind_parent_pos = []
+    for k in range(cfg.n_kinds):
+        keep = np.zeros(n, dtype=bool)
+        keep[0] = True
+        for i in range(1, n):
+            keep[i] = keep[parent[i]] and (
+                rng.random() < cfg.child_keep_prob
+            )
+        ops = np.flatnonzero(keep)  # ascending == topological (parent < child)
+        pos = {int(o): j for j, o in enumerate(ops)}
+        ppos = np.array(
+            [pos[int(parent[o])] if parent[o] >= 0 else -1 for o in ops],
+            dtype=np.int64,
+        )
+        kinds.append(ops)
+        kind_parent_pos.append(ppos)
+    return Topology(parent, mean_own, kinds, kind_parent_pos)
+
+
+def _render_spans(
+    topo: Topology,
+    cfg: SyntheticConfig,
+    rng: np.random.Generator,
+    n_traces: int,
+    t0: pd.Timestamp,
+    fault_op: Optional[int],
+    fault_pod: int,
+    trace_prefix: str,
+) -> pd.DataFrame:
+    kind_of_trace = rng.integers(0, len(topo.kinds), size=n_traces)
+    start_offsets_us = np.sort(
+        rng.uniform(0, cfg.window_minutes * 60e6, size=n_traces)
+    ).astype(np.int64)
+
+    blocks = []
+    for k, ops in enumerate(topo.kinds):
+        t_idx = np.flatnonzero(kind_of_trace == k)
+        if len(t_idx) == 0:
+            continue
+        m = len(ops)
+        mu = np.log(topo.mean_own_ms[ops])
+        own_ms = rng.lognormal(
+            mean=mu[None, :], sigma=cfg.sigma_log, size=(len(t_idx), m)
+        )
+        # Pod assignment per (trace, op).
+        pods = rng.integers(0, cfg.n_pods, size=(len(t_idx), m))
+        if fault_op is not None:
+            j = np.flatnonzero(ops == fault_op)
+            if len(j):
+                j = int(j[0])
+                hit = pods[:, j] == fault_pod
+                own_ms[:, j] += np.where(hit, cfg.fault_latency_ms, 0.0)
+        # Inclusive durations: add each op's total into its parent,
+        # deepest-first (ops are topo-ordered).
+        dur_ms = own_ms.copy()
+        ppos = topo.kind_parent_pos[k]
+        for j in range(m - 1, 0, -1):
+            dur_ms[:, ppos[j]] += dur_ms[:, j]
+
+        nt = len(t_idx)
+        trace_rows = np.repeat(t_idx, m)
+        op_rows = np.tile(ops, nt)
+        pod_rows = pods.reshape(-1)
+        dur_rows = (dur_ms.reshape(-1) * 1000.0).astype(np.int64)  # µs
+        root_dur_us = np.repeat((dur_ms[:, 0] * 1000.0).astype(np.int64), m)
+        parent_rows = np.tile(topo.parent[ops], nt)
+        blocks.append(
+            (trace_rows, op_rows, pod_rows, dur_rows, root_dur_us, parent_rows)
+        )
+
+    trace_rows = np.concatenate([b[0] for b in blocks])
+    op_rows = np.concatenate([b[1] for b in blocks])
+    pod_rows = np.concatenate([b[2] for b in blocks])
+    dur_rows = np.concatenate([b[3] for b in blocks])
+    root_dur_us = np.concatenate([b[4] for b in blocks])
+    parent_rows = np.concatenate([b[5] for b in blocks])
+
+    trace_str = np.char.add(trace_prefix, trace_rows.astype(np.str_))
+    op_str = op_rows.astype(np.str_)
+    span_id = np.char.add(np.char.add(trace_str, "-s"), op_str)
+    has_parent = parent_rows >= 0
+    parent_id = np.where(
+        has_parent,
+        np.char.add(
+            np.char.add(trace_str, "-s"),
+            np.where(has_parent, parent_rows, 0).astype(np.str_),
+        ),
+        "",
+    )
+    svc = np.char.add("svc", np.char.zfill(op_str, 3))
+    opname = np.char.add("op", np.char.zfill(op_str, 3))
+    pod = np.char.add(np.char.add(svc, "-"), pod_rows.astype(np.str_))
+
+    start_us = start_offsets_us[trace_rows]
+    start_ts = t0 + pd.to_timedelta(start_us, unit="us")
+    end_ts = t0 + pd.to_timedelta(start_us + root_dur_us, unit="us")
+
+    return pd.DataFrame(
+        {
+            "traceID": trace_str,
+            "spanID": span_id,
+            "ParentSpanId": parent_id,
+            "operationName": opname,
+            "serviceName": svc,
+            "podName": pod,
+            "duration": dur_rows,
+            "startTime": start_ts,
+            "endTime": end_ts,
+        }
+    )
+
+
+@dataclass
+class SyntheticCase:
+    normal: pd.DataFrame
+    abnormal: pd.DataFrame
+    fault_service_op: str     # service-level canonical name of the root cause
+    fault_pod_op: str         # instance-level (PageRank vocab) name
+    fault_op: int
+    fault_pod: int
+    topology: Topology
+
+
+def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
+    """One chaos case: a normal window and an abnormal window with one
+    injected latency fault (the collect_data.py normal/abnormal dump pair)."""
+    rng = np.random.default_rng(cfg.seed)
+    topo = _make_topology(cfg, rng)
+
+    # Pick a faulty op covered by at least one kind and not the root (the
+    # root is trivially always the top anomaly otherwise).
+    covered = np.unique(np.concatenate(topo.kinds))
+    candidates = covered[covered != 0]
+    fault_op = int(rng.choice(candidates if len(candidates) else covered))
+    fault_pod = int(rng.integers(0, cfg.n_pods))
+
+    t0 = pd.Timestamp("2025-02-14 12:00:00")
+    t1 = t0 + pd.Timedelta(minutes=cfg.window_minutes)
+    normal = _render_spans(
+        topo, cfg, rng, cfg.n_traces, t0, None, fault_pod, "n"
+    )
+    abnormal = _render_spans(
+        topo, cfg, rng, cfg.n_traces, t1, fault_op, fault_pod, "a"
+    )
+    svc = f"svc{fault_op:03d}"
+    return SyntheticCase(
+        normal=normal,
+        abnormal=abnormal,
+        fault_service_op=f"{svc}_op{fault_op:03d}",
+        fault_pod_op=f"{svc}-{fault_pod}_op{fault_op:03d}",
+        fault_op=fault_op,
+        fault_pod=fault_pod,
+        topology=topo,
+    )

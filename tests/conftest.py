@@ -1,0 +1,50 @@
+"""Test env: force CPU with an 8-device virtual mesh (SURVEY.md §4 item 4).
+
+Must run before jax is first imported anywhere in the test process — pytest
+imports conftest.py before collecting test modules, which guarantees that.
+The sharded-path tests use the same pjit/shard_map code paths as a real
+TPU slice, just on emulated host devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from microrank_tpu.config import DetectorConfig  # noqa: E402
+from microrank_tpu.detect import compute_slo, detect_numpy  # noqa: E402
+from microrank_tpu.graph import build_detect_batch  # noqa: E402
+from microrank_tpu.testing import SyntheticConfig, generate_case  # noqa: E402
+
+
+def partition_case(case, detector_cfg: DetectorConfig = DetectorConfig()):
+    """Shared detect+partition step: returns (normal_ids, abnormal_ids)."""
+    vocab, baseline = compute_slo(case.normal)
+    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
+    res = detect_numpy(batch, baseline, detector_cfg)
+    abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
+    nrm = [
+        t for t, a, v in zip(trace_ids, res.abnormal, res.valid) if v and not a
+    ]
+    return nrm, abn
+
+
+@pytest.fixture(scope="session")
+def small_case():
+    """A small synthetic chaos case shared across tests."""
+    return generate_case(SyntheticConfig(n_operations=24, n_traces=120, seed=7))
+
+
+@pytest.fixture(scope="session")
+def pod_case():
+    """Instance-level case: 2 pods per service, fault on one pod."""
+    return generate_case(
+        SyntheticConfig(n_operations=16, n_pods=2, n_traces=160, seed=11)
+    )

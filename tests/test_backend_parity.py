@@ -1,0 +1,104 @@
+"""THE critical suite (SURVEY.md §4 item 2): jax backend vs numpy oracle.
+
+Same window, same partitions -> identical Top-1, same op sets, close
+scores. Rank parity (not bitwise score equality) is the acceptance
+criterion: the oracle iterates in float64, the device path in float32.
+"""
+
+import numpy as np
+import pytest
+
+from microrank_tpu.config import (
+    MicroRankConfig,
+    PageRankConfig,
+    SpectrumConfig,
+)
+from conftest import partition_case
+from microrank_tpu.rank_backends import NumpyRefBackend, get_backend
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+def _compare(case, cfg, score_rtol=1e-3):
+    nrm, abn = partition_case(case)
+    top_o, sc_o = NumpyRefBackend(cfg).rank_window(case.abnormal, nrm, abn)
+    top_j, sc_j = get_backend(cfg).rank_window(case.abnormal, nrm, abn)
+    assert top_o, "oracle produced no ranking"
+    # Top-1 parity: the BASELINE.json acceptance metric.
+    assert top_o[0] == top_j[0]
+    # Same candidate sets.
+    assert set(top_o) == set(top_j)
+    # Scores close, position by position after name alignment.
+    scores_o = dict(zip(top_o, sc_o))
+    scores_j = dict(zip(top_j, sc_j))
+    for name in top_o:
+        denom = max(abs(scores_o[name]), 1e-12)
+        assert abs(scores_o[name] - scores_j[name]) / denom < score_rtol, name
+    return top_o, top_j
+
+
+def test_parity_default_config(small_case):
+    top_o, _ = _compare(small_case, MicroRankConfig())
+    assert top_o[0] == small_case.fault_pod_op
+
+
+def test_parity_pod_level(pod_case):
+    top_o, _ = _compare(pod_case, MicroRankConfig())
+    # Instance-level RCA: the faulty (pod, op) outranks its sibling pod.
+    sibling = pod_case.fault_pod_op.replace(
+        f"-{pod_case.fault_pod}_", f"-{1 - pod_case.fault_pod}_"
+    )
+    assert top_o.index(pod_case.fault_pod_op) < (
+        top_o.index(sibling) if sibling in top_o else len(top_o)
+    )
+
+
+@pytest.mark.parametrize("method", ["ochiai", "tarantula", "russellrao", "jaccard"])
+def test_parity_other_spectra(small_case, method):
+    cfg = MicroRankConfig(spectrum=SpectrumConfig(method=method))
+    _compare(small_case, cfg)
+
+
+def test_parity_paper_preference(small_case):
+    cfg = MicroRankConfig(pagerank=PageRankConfig(preference="paper"))
+    _compare(small_case, cfg)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_parity_across_seeds(seed):
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+    )
+    nrm, abn = partition_case(case)
+    if not (nrm and abn):
+        pytest.skip("window did not partition")
+    _compare(case, MicroRankConfig())
+
+
+def test_top1_is_injected_fault_across_seeds():
+    # Integration acceptance (SURVEY.md §4 item 3): the injected root cause
+    # ranks Top-1 in most cases, Top-3 always.
+    hits_top1 = 0
+    total = 0
+    for seed in range(5):
+        # Diverse trace shapes decorrelate op coverage; with few shapes the
+        # fault's always-co-occurring ancestors tie with it on the spectrum
+        # counters (inherent to the algorithm — the paper's own R@1 is 94%).
+        case = generate_case(
+            SyntheticConfig(
+                n_operations=20,
+                n_traces=120,
+                seed=100 + seed,
+                n_kinds=24,
+                child_keep_prob=0.6,
+            )
+        )
+        nrm, abn = partition_case(case)
+        if not (nrm and abn):
+            continue
+        cfg = MicroRankConfig()
+        top, _ = get_backend(cfg).rank_window(case.abnormal, nrm, abn)
+        total += 1
+        assert case.fault_pod_op in top[:3], (seed, top[:5])
+        hits_top1 += top[0] == case.fault_pod_op
+    assert total >= 3
+    assert hits_top1 >= total - 1

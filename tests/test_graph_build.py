@@ -1,0 +1,99 @@
+"""Structural parity: array COO build vs faithful dict build.
+
+Densifies the padded COO arrays and compares them entry-for-entry with the
+reference-semantics matrices built from the dicts — on synthetic data,
+for both partitions. This pins the whole C8/C9/C10 re-design (SURVEY.md)
+to the reference's exact values.
+"""
+
+import numpy as np
+
+from conftest import partition_case
+from microrank_tpu.graph import (
+    build_detect_batch,
+    build_window_graph,
+    pagerank_graph_dicts,
+)
+from microrank_tpu.detect import compute_slo
+from microrank_tpu.rank_backends import numpy_ref
+
+
+def _densify(part, op_names, trace_list_local):
+    """Rebuild dense p_ss/p_sr/p_rs (op axis = window vocab) from COO."""
+    v = len(op_names)
+    t = int(part.n_traces)
+    n_inc, n_ss = int(part.n_inc), int(part.n_ss)
+    p_sr = np.zeros((v, t), dtype=np.float32)
+    p_rs = np.zeros((t, v), dtype=np.float32)
+    p_ss = np.zeros((v, v), dtype=np.float32)
+    p_sr[part.inc_op[:n_inc], part.inc_trace[:n_inc]] = part.sr_val[:n_inc]
+    p_rs[part.inc_trace[:n_inc], part.inc_op[:n_inc]] = part.rs_val[:n_inc]
+    p_ss[part.ss_child[:n_ss], part.ss_parent[:n_ss]] = part.ss_val[:n_ss]
+    return p_ss, p_sr, p_rs
+
+
+def test_array_build_matches_dict_build(small_case):
+    case = small_case
+    nrm, abn = partition_case(case)
+    assert nrm and abn
+    graph, op_names, norm_traces, abn_traces = build_window_graph(
+        case.abnormal, nrm, abn
+    )
+    op_pos = {n: i for i, n in enumerate(op_names)}
+
+    for part, ids, local_traces in (
+        (graph.normal, nrm, norm_traces),
+        (graph.abnormal, abn, abn_traces),
+    ):
+        dicts = pagerank_graph_dicts(ids, case.abnormal)
+        ref_ss, ref_sr, ref_rs, nodes, traces = numpy_ref.build_matrices(
+            dicts[0], dicts[1], dicts[2]
+        )
+        assert int(part.n_ops) == len(nodes)
+        assert int(part.n_traces) == len(traces)
+        assert sorted(local_traces) == sorted(traces)
+
+        got_ss, got_sr, got_rs = _densify(part, op_names, local_traces)
+        # Remap reference matrices into (window-vocab, local-trace) indexing.
+        op_map = np.array([op_pos[n] for n in nodes])
+        tr_pos = {t: i for i, t in enumerate(local_traces)}
+        tr_map = np.array([tr_pos[t] for t in traces])
+
+        exp_sr = np.zeros_like(got_sr)
+        exp_sr[np.ix_(op_map, tr_map)] = ref_sr
+        np.testing.assert_array_equal(got_sr, exp_sr)
+
+        exp_rs = np.zeros_like(got_rs)
+        exp_rs[np.ix_(tr_map, op_map)] = ref_rs
+        np.testing.assert_array_equal(got_rs, exp_rs)
+
+        exp_ss = np.zeros_like(got_ss)
+        exp_ss[np.ix_(op_map, op_map)] = ref_ss
+        np.testing.assert_array_equal(got_ss, exp_ss)
+
+        # Kind sizes match the reference's column-equality dedup.
+        ref_kind = numpy_ref.compute_kind_list(ref_sr)
+        got_kind = part.kind[: len(traces)]
+        exp_kind = np.zeros(len(traces))
+        exp_kind[tr_map] = ref_kind
+        np.testing.assert_array_equal(got_kind, exp_kind.astype(np.int32))
+
+        # Coverage counts (trace_num_list).
+        cov = {
+            op: int(np.count_nonzero(ref_sr[i]))
+            for i, op in enumerate(nodes)
+        }
+        for op, c in cov.items():
+            assert int(part.cov_unique[op_pos[op]]) == c
+
+
+def test_detect_batch_roundtrip(small_case):
+    case = small_case
+    vocab, baseline = compute_slo(case.normal)
+    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
+    assert int(batch.n_traces) == case.abnormal["traceID"].nunique()
+    assert int(batch.n_spans) == len(case.abnormal)
+    # Padding is inert: op = -1, duration = 0.
+    n = int(batch.n_spans)
+    assert (batch.op[n:] == -1).all()
+    assert (batch.duration_us[n:] == 0).all()

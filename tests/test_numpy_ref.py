@@ -1,0 +1,144 @@
+"""Golden tests for the oracle backend on a hand-computed toy graph.
+
+Graph (instance-level op names A..D, traces t1..t3):
+  call edges per trace:  t1: A->B, B->C   t2: A->D   t3: A->B, B->C
+  coverage:              t1: {A,B,C}      t2: {A,D}  t3: {A,B,C}
+
+Hand-derived reference matrices (pagerank.py:35-52 semantics):
+  operation_operation: A: [B,B,D] (3 call-edge instances), B: [C,C], C/D: []
+  p_ss[B,A] = p_ss[D,A] = 1/3, p_ss[C,B] = 1/2
+  p_sr columns: t1 = t3 = (A,B,C @ 1/3), t2 = (A,D @ 1/2)
+  p_rs rows:    t1 = t3 = (A:1/3, B:1/2, C:1/2), t2 = (A:1/3, D:1)
+  kinds: t1,t3 -> 2; t2 -> 1
+  normal preference: inv_kind=(1/2,1,1/2), sum=2 -> pr=(0.25, 0.5, 0.25)
+"""
+
+import numpy as np
+import pytest
+
+from microrank_tpu.config import PageRankConfig, SpectrumConfig
+from microrank_tpu.rank_backends import numpy_ref
+
+OO = {"A": ["B", "B", "D"], "B": ["C", "C"], "C": [], "D": []}
+OT = {"t1": ["A", "B", "C"], "t2": ["A", "D"], "t3": ["A", "B", "C"]}
+TO = {"A": ["t1", "t2", "t3"], "B": ["t1", "t3"], "C": ["t1", "t3"], "D": ["t2"]}
+PR = {k: list(v) for k, v in OT.items()}
+
+
+def test_matrices_golden():
+    p_ss, p_sr, p_rs, nodes, traces = numpy_ref.build_matrices(OO, OT, TO)
+    ni = {n: i for i, n in enumerate(nodes)}
+    ti = {t: i for i, t in enumerate(traces)}
+    exp_ss = np.zeros((4, 4), dtype=np.float32)
+    exp_ss[ni["B"], ni["A"]] = 1 / 3
+    exp_ss[ni["D"], ni["A"]] = 1 / 3
+    exp_ss[ni["C"], ni["B"]] = 1 / 2
+    np.testing.assert_array_equal(p_ss, exp_ss)
+
+    exp_sr = np.zeros((4, 3), dtype=np.float32)
+    for t in ("t1", "t3"):
+        for op in ("A", "B", "C"):
+            exp_sr[ni[op], ti[t]] = 1 / 3
+    for op in ("A", "D"):
+        exp_sr[ni[op], ti["t2"]] = 1 / 2
+    np.testing.assert_array_equal(p_sr, exp_sr)
+
+    exp_rs = np.zeros((3, 4), dtype=np.float32)
+    for t in ("t1", "t3"):
+        exp_rs[ti[t], ni["A"]] = 1 / 3
+        exp_rs[ti[t], ni["B"]] = 1 / 2
+        exp_rs[ti[t], ni["C"]] = 1 / 2
+    exp_rs[ti["t2"], ni["A"]] = 1 / 3
+    exp_rs[ti["t2"], ni["D"]] = 1.0
+    np.testing.assert_array_equal(p_rs, exp_rs)
+
+
+def test_kind_list_golden():
+    _, p_sr, _, _, traces = numpy_ref.build_matrices(OO, OT, TO)
+    kind = numpy_ref.compute_kind_list(p_sr)
+    ti = {t: i for i, t in enumerate(traces)}
+    assert kind[ti["t1"]] == 2 and kind[ti["t3"]] == 2 and kind[ti["t2"]] == 1
+
+
+def test_normal_preference_golden():
+    _, p_sr, _, _, traces = numpy_ref.build_matrices(OO, OT, TO)
+    kind = numpy_ref.compute_kind_list(p_sr)
+    ti = {t: i for i, t in enumerate(traces)}
+    pr = numpy_ref._preference_vector(ti, PR, kind, False, PageRankConfig())
+    np.testing.assert_allclose(pr[ti["t1"], 0], 0.25, rtol=1e-6)
+    np.testing.assert_allclose(pr[ti["t2"], 0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(pr[ti["t3"], 0], 0.25, rtol=1e-6)
+
+
+def test_anomalous_preference_reference_form():
+    # pr[t] = phi / num_sum / (kind_t/kind_sum*phi + 1/n_t)
+    # kind_sum = 1/2 + 1 + 1/2 = 2 ; num_sum = 1/3 + 1/2 + 1/3 = 7/6
+    _, p_sr, _, _, traces = numpy_ref.build_matrices(OO, OT, TO)
+    kind = numpy_ref.compute_kind_list(p_sr)
+    ti = {t: i for i, t in enumerate(traces)}
+    pr = numpy_ref._preference_vector(ti, PR, kind, True, PageRankConfig())
+    num_sum = 7 / 6
+    exp_t1 = 0.5 / num_sum / (2 / 2 * 0.5 + 1 / 3)
+    exp_t2 = 0.5 / num_sum / (1 / 2 * 0.5 + 1 / 2)
+    np.testing.assert_allclose(pr[ti["t1"], 0], exp_t1, rtol=1e-6)
+    np.testing.assert_allclose(pr[ti["t3"], 0], exp_t1, rtol=1e-6)
+    np.testing.assert_allclose(pr[ti["t2"], 0], exp_t2, rtol=1e-6)
+
+
+def test_paper_preference_eq7():
+    # Eq (7): phi * (1/n_t)/num_sum + (1-phi) * (1/kind_t)/kind_sum
+    _, p_sr, _, _, traces = numpy_ref.build_matrices(OO, OT, TO)
+    kind = numpy_ref.compute_kind_list(p_sr)
+    ti = {t: i for i, t in enumerate(traces)}
+    cfg = PageRankConfig(preference="paper")
+    pr = numpy_ref._preference_vector(ti, PR, kind, True, cfg)
+    num_sum, kind_sum = 7 / 6, 2.0
+    exp_t2 = 0.5 * (1 / 2) / num_sum + 0.5 * 1.0 / kind_sum
+    np.testing.assert_allclose(pr[ti["t2"], 0], exp_t2, rtol=1e-6)
+    # Paper form is a proper distribution: sums to 1.
+    np.testing.assert_allclose(pr.sum(), 1.0, rtol=1e-6)
+
+
+def test_power_iteration_properties():
+    weight, trace_num = numpy_ref.trace_pagerank(OO, OT, TO, PR, False)
+    assert set(weight) == {"A", "B", "C", "D"}
+    assert trace_num == {"A": 3, "B": 2, "C": 2, "D": 1}
+    assert all(w > 0 for w in weight.values())
+    # A is covered by every trace and called most -> highest score.
+    assert max(weight, key=weight.get) == "A"
+
+
+def test_spectrum_golden_dstar2():
+    # Hand-built spectrum cells.
+    a_res = {"A": 1.0, "B": 0.5}
+    n_res = {"A": 0.8, "C": 0.2}
+    a_num = {"A": 4, "B": 2}
+    n_num = {"A": 5, "C": 3}
+    top, scores = numpy_ref.calculate_spectrum(
+        a_res, n_res, 4, 6, n_num, a_num, SpectrumConfig(method="dstar2")
+    )
+    # A: ef=4, nf=0, ep=4.0 -> 16/4 = 4
+    # B: ef=1, nf=1, ep=eps -> 1/(1+1e-7)
+    # C: only-normal: ep=(1+0.2)*3, ef=nf=eps -> ~eps^2/3.6
+    d = dict(zip(top, scores))
+    np.testing.assert_allclose(d["A"], 4.0, rtol=1e-6)
+    np.testing.assert_allclose(d["B"], 1 / (1 + 1e-7), rtol=1e-6)
+    assert d["C"] < 1e-10
+    assert top[0] == "A" and top[1] == "B"
+
+
+@pytest.mark.parametrize(
+    "method",
+    ["ochiai", "jaccard", "tarantula", "russellrao", "m1", "m2",
+     "goodman", "hamann", "dice", "sorensendice", "simplematcing", "rogers"],
+)
+def test_all_methods_finite(method):
+    a_res = {"A": 1.0, "B": 0.5}
+    n_res = {"A": 0.8, "C": 0.2}
+    a_num = {"A": 4, "B": 2}
+    n_num = {"A": 5, "C": 3}
+    top, scores = numpy_ref.calculate_spectrum(
+        a_res, n_res, 4, 6, n_num, a_num, SpectrumConfig(method=method)
+    )
+    assert len(top) == 3
+    assert all(np.isfinite(s) for s in scores)

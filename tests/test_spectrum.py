@@ -1,0 +1,43 @@
+"""Spectrum formulas: vectorized jnp vs the oracle's scalar forms."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from microrank_tpu.rank_backends.numpy_ref import spectrum_score
+from microrank_tpu.spectrum import METHODS, spectrum_scores
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_vectorized_matches_scalar(method):
+    rng = np.random.default_rng(0)
+    n = 64
+    ef = rng.uniform(1e-7, 10, n)
+    nf = rng.uniform(1e-7, 10, n)
+    ep = rng.uniform(1e-7, 10, n)
+    np_ = rng.uniform(1e-7, 10, n)
+    got = np.asarray(
+        spectrum_scores(
+            jnp.asarray(ef, jnp.float64) if False else jnp.asarray(ef, jnp.float32),
+            jnp.asarray(nf, jnp.float32),
+            jnp.asarray(ep, jnp.float32),
+            jnp.asarray(np_, jnp.float32),
+            method,
+        )
+    )
+    exp = np.array(
+        [
+            spectrum_score(
+                {"ef": ef[i], "nf": nf[i], "ep": ep[i], "np": np_[i]}, method
+            )
+            for i in range(n)
+        ]
+    )
+    np.testing.assert_allclose(got, exp, rtol=2e-5)
+
+
+def test_unknown_method_raises():
+    with pytest.raises(ValueError):
+        spectrum_scores(
+            jnp.ones(1), jnp.ones(1), jnp.ones(1), jnp.ones(1), "nope"
+        )

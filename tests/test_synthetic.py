@@ -1,0 +1,48 @@
+"""Generator invariants: schema conformance, inclusive durations, fault."""
+
+import numpy as np
+
+from microrank_tpu.io.schema import REQUIRED_COLUMNS, validate_columns
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+def test_schema(small_case):
+    for df in (small_case.normal, small_case.abnormal):
+        validate_columns(df.columns)
+        assert set(REQUIRED_COLUMNS) <= set(df.columns)
+        assert (df["duration"] > 0).all()
+
+
+def test_root_span_is_trace_max(small_case):
+    # Inclusive durations: the reference's trace duration = max span
+    # duration (preprocess_data.py:110) must pick the root span.
+    df = small_case.normal
+    root = df[df["ParentSpanId"] == ""]
+    assert len(root) == df["traceID"].nunique()
+    gmax = df.groupby("traceID")["duration"].max()
+    for _, row in root.head(20).iterrows():
+        assert row["duration"] == gmax[row["traceID"]]
+
+
+def test_parent_links_resolve(small_case):
+    df = small_case.abnormal
+    non_root = df[df["ParentSpanId"] != ""]
+    assert non_root["ParentSpanId"].isin(set(df["spanID"])).all()
+
+
+def test_fault_increases_duration():
+    cfg = SyntheticConfig(n_operations=12, n_traces=100, seed=3)
+    case = generate_case(cfg)
+    svc = f"svc{case.fault_op:03d}"
+    n_faulty = case.normal[case.normal["serviceName"] == svc]["duration"]
+    a_all = case.abnormal[case.abnormal["podName"] == f"{svc}-{case.fault_pod}"]
+    a_faulty = a_all["duration"]
+    assert a_faulty.mean() > n_faulty.mean() + cfg.fault_latency_ms * 1000 * 0.5
+
+
+def test_determinism():
+    cfg = SyntheticConfig(n_operations=10, n_traces=30, seed=5)
+    a, b = generate_case(cfg), generate_case(cfg)
+    assert a.normal.equals(b.normal)
+    assert a.abnormal.equals(b.abnormal)
+    assert a.fault_pod_op == b.fault_pod_op
