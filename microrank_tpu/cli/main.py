@@ -1,0 +1,195 @@
+"""Command-line entry point (reference component C16, rebuilt as a real CLI).
+
+The reference's CLI is ``python online_rca.py`` with hard-coded dataset
+paths and constants edited in source (online_rca.py:219-255; README.md
+instructs editing the file). Here:
+
+    python -m microrank_tpu.cli run    --normal N.csv --abnormal A.csv -o out/
+    python -m microrank_tpu.cli synth  -o data/ --spans 10000 --operations 100
+    python -m microrank_tpu.cli bench  ...        (thin wrapper over bench.py)
+    python -m microrank_tpu.cli collect ...       (optional ClickHouse export)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def _add_config_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", default="jax", choices=["jax", "numpy_ref"])
+    p.add_argument("--spectrum-method", default="dstar2")
+    p.add_argument("--top-max", type=int, default=5)
+    p.add_argument("--iterations", type=int, default=25)
+    p.add_argument("--damping", type=float, default=0.85)
+    p.add_argument("--call-weight", type=float, default=0.01)
+    p.add_argument(
+        "--preference", default="reference", choices=["reference", "paper"]
+    )
+    p.add_argument("--k-sigma", type=float, default=3.0)
+    p.add_argument("--slack-ms", type=float, default=0.0)
+    p.add_argument("--detect-minutes", type=float, default=5.0)
+    p.add_argument("--skip-minutes", type=float, default=4.0)
+    p.add_argument(
+        "--reference-compat",
+        action="store_true",
+        help="reproduce the reference code exactly, documented quirks "
+        "included (partition swap, overwritten result.csv)",
+    )
+    p.add_argument("--config-json", help="load a full MicroRankConfig dict")
+
+
+def _config_from_args(args) -> "MicroRankConfig":
+    from ..config import (
+        CompatConfig,
+        DetectorConfig,
+        MicroRankConfig,
+        PageRankConfig,
+        RuntimeConfig,
+        SpectrumConfig,
+        WindowConfig,
+    )
+
+    if args.config_json:
+        with open(args.config_json) as f:
+            return MicroRankConfig.from_dict(json.load(f))
+    cfg = MicroRankConfig(
+        detector=DetectorConfig(k_sigma=args.k_sigma, slack_ms=args.slack_ms),
+        pagerank=PageRankConfig(
+            iterations=args.iterations,
+            damping=args.damping,
+            call_weight=args.call_weight,
+            preference=args.preference,
+        ),
+        spectrum=SpectrumConfig(
+            method=args.spectrum_method, top_max=args.top_max
+        ),
+        window=WindowConfig(
+            detect_minutes=args.detect_minutes, skip_minutes=args.skip_minutes
+        ),
+        runtime=RuntimeConfig(backend=args.backend),
+    )
+    if args.reference_compat:
+        cfg = cfg.replace(
+            compat=CompatConfig(partition_swap=True, overwrite_results=True)
+        )
+    return cfg
+
+
+def cmd_run(args) -> int:
+    from ..io import load_traces_csv
+    from ..pipeline import OnlineRCA
+    from ..utils.logging import get_logger
+
+    log = get_logger("microrank_tpu.cli")
+    cfg = _config_from_args(args)
+    normal = load_traces_csv(args.normal)
+    abnormal = load_traces_csv(args.abnormal)
+    log.info(
+        "loaded %d normal spans, %d abnormal spans", len(normal), len(abnormal)
+    )
+    rca = OnlineRCA(cfg)
+    rca.fit_baseline(normal, cache_path=args.slo_cache)
+    results = rca.run(abnormal, out_dir=args.output, resume=args.resume)
+    n_anom = sum(r.anomaly for r in results)
+    log.info(
+        "processed %d windows, %d anomalous; results in %s",
+        len(results),
+        n_anom,
+        args.output,
+    )
+    for r in results:
+        if r.ranking:
+            print(f"window {r.start}:")
+            for rank, (name, score) in enumerate(r.ranking, 1):
+                print(f"  {rank:2d}. {name:<50s} {score:.8f}")
+    return 0
+
+
+def cmd_synth(args) -> int:
+    from ..testing import SyntheticConfig, generate_case
+
+    cfg = SyntheticConfig(
+        n_operations=args.operations,
+        n_pods=args.pods,
+        n_kinds=args.kinds,
+        n_traces=args.traces,
+        fault_latency_ms=args.fault_ms,
+        seed=args.seed,
+    )
+    case = generate_case(cfg)
+    out = Path(args.output)
+    (out / "normal").mkdir(parents=True, exist_ok=True)
+    (out / "abnormal").mkdir(parents=True, exist_ok=True)
+    case.normal.to_csv(out / "normal" / "traces.csv", index=False)
+    case.abnormal.to_csv(out / "abnormal" / "traces.csv", index=False)
+    truth = {
+        "fault_service_op": case.fault_service_op,
+        "fault_pod_op": case.fault_pod_op,
+        "fault_op": case.fault_op,
+        "fault_pod": case.fault_pod,
+        "config": {
+            "n_operations": cfg.n_operations,
+            "n_traces": cfg.n_traces,
+            "seed": cfg.seed,
+        },
+    }
+    (out / "ground_truth.json").write_text(json.dumps(truth, indent=2))
+    print(
+        f"wrote {len(case.normal)} normal + {len(case.abnormal)} abnormal "
+        f"spans to {out} (fault: {case.fault_pod_op})"
+    )
+    return 0
+
+
+def cmd_collect(args) -> int:
+    from ..collect.clickhouse import run_collect
+
+    return run_collect(args)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="microrank_tpu",
+        description="TPU-native trace-based root cause analysis",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_run = sub.add_parser("run", help="online RCA over trace dumps")
+    p_run.add_argument("--normal", required=True, help="normal-period traces.csv")
+    p_run.add_argument("--abnormal", required=True, help="traces.csv to analyze")
+    p_run.add_argument("-o", "--output", default="rca_out")
+    p_run.add_argument("--slo-cache", help="npz path to cache the SLO baseline")
+    p_run.add_argument(
+        "--resume", action="store_true", help="resume from the window cursor"
+    )
+    _add_config_flags(p_run)
+    p_run.set_defaults(fn=cmd_run)
+
+    p_synth = sub.add_parser("synth", help="generate a synthetic chaos case")
+    p_synth.add_argument("-o", "--output", required=True)
+    p_synth.add_argument("--operations", type=int, default=40)
+    p_synth.add_argument("--pods", type=int, default=1)
+    p_synth.add_argument("--kinds", type=int, default=24)
+    p_synth.add_argument("--traces", type=int, default=500)
+    p_synth.add_argument("--fault-ms", type=float, default=2000.0)
+    p_synth.add_argument("--seed", type=int, default=0)
+    p_synth.set_defaults(fn=cmd_synth)
+
+    p_col = sub.add_parser(
+        "collect", help="export chaos-case traces from ClickHouse (optional)"
+    )
+    p_col.add_argument("--host", default="localhost")
+    p_col.add_argument("--namespace", required=False)
+    p_col.add_argument("--config-toml", help="chaos events TOML manifest")
+    p_col.add_argument("-o", "--output", default=".")
+    p_col.set_defaults(fn=cmd_collect)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

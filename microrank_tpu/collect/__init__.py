@@ -1,0 +1,1 @@
+"""Optional ClickHouse chaos-case collector (gated dependency)."""

@@ -1,0 +1,161 @@
+"""Chaos-case trace collector (reference component C17, collect_data.py).
+
+Exports OTel trace windows around chaos-injection events from ClickHouse
+into the ``{case}/normal/traces.csv`` + ``{case}/abnormal/traces.csv``
+layout the pipeline consumes, with a TOML manifest of the collected cases.
+Optional: requires ``clickhouse_connect`` (not a core dependency); the
+import is gated so the rest of the framework never needs it. Credentials
+come from CLICKHOUSE_USER / CLICKHOUSE_PASSWORD env vars, as in the
+reference (collect_data.py:12-13).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+from pathlib import Path
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.collect")
+
+# Query shape mirrors the reference's projection (collect_data.py:18-55):
+# span rows joined with per-trace start/end bounds, filtered by namespace.
+TRACE_QUERY = """
+WITH
+    trace_times AS (
+        SELECT TraceId, MIN(Start) AS TraceStart, MAX(End) AS TraceEnd
+        FROM otel_traces_trace_id_ts
+        GROUP BY TraceId
+    )
+SELECT
+    ot.`Timestamp`, ot.TraceId, ot.SpanId, ot.ParentSpanId, ot.SpanName,
+    ot.ServiceName, ResourceAttributes['pod.name'] AS PodName,
+    ot.Duration, ot.SpanKind, trace_times.TraceStart, trace_times.TraceEnd
+FROM otel_traces ot
+LEFT JOIN trace_times ON ot.TraceId = trace_times.TraceId
+WHERE ot.`Timestamp` BETWEEN '{start}' AND '{end}'
+  AND ot.ResourceAttributes['service.namespace'] = '{namespace}'
+"""
+
+
+@dataclass
+class ChaosEvent:
+    timestamp: str           # "YYYY-MM-DD HH:MM:SS" injection time
+    namespace: str
+    chaos_type: str = ""
+    service: str = ""
+
+    @property
+    def case_name(self) -> str:
+        dt = datetime.strptime(self.timestamp, "%Y-%m-%d %H:%M:%S")
+        return f"{self.service}-{dt.month:02d}{dt.day:02d}-{dt.hour:02d}{dt.minute:02d}"
+
+
+def load_events_toml(path) -> List[ChaosEvent]:
+    import toml
+
+    events = []
+    for event in toml.load(path).get("chaos_events", []):
+        ts = event.get("timestamp", "")
+        try:
+            datetime.strptime(ts, "%Y-%m-%d %H:%M:%S")
+        except ValueError:
+            log.warning("invalid timestamp %r; skipping event", ts)
+            continue
+        events.append(
+            ChaosEvent(
+                timestamp=ts,
+                namespace=event.get("namespace", ""),
+                chaos_type=event.get("chaos_type", ""),
+                service=event.get("service", ""),
+            )
+        )
+    return events
+
+
+async def _fetch_csv(client, query: str, filepath: Path, semaphore, retries=3):
+    async with semaphore:
+        for attempt in range(retries):
+            try:
+                result = await client.raw_query(query=query, fmt="CSVWithNames")
+                filepath.write_bytes(result)
+                log.info("wrote %s", filepath)
+                return True
+            except Exception as exc:  # noqa: BLE001 — retried I/O
+                log.warning(
+                    "fetch failed (%d/%d): %s", attempt + 1, retries, exc
+                )
+        log.error("giving up on %s", filepath)
+        return False
+
+
+async def collect_cases(
+    events: List[ChaosEvent],
+    host: str,
+    out_dir,
+    window_minutes: int = 10,
+    concurrency: int = 2,
+):
+    try:
+        import clickhouse_connect
+    except ImportError as exc:
+        raise RuntimeError(
+            "the collect command needs the optional clickhouse_connect "
+            "dependency; install it or export traces.csv dumps another way"
+        ) from exc
+
+    client = await clickhouse_connect.create_async_client(
+        host=host,
+        username=os.getenv("CLICKHOUSE_USER", "default"),
+        password=os.getenv("CLICKHOUSE_PASSWORD", ""),
+    )
+    semaphore = asyncio.Semaphore(concurrency)
+    out = Path(out_dir)
+    tasks = []
+    for ev in events:
+        t = datetime.strptime(ev.timestamp, "%Y-%m-%d %H:%M:%S")
+        windows = {
+            "abnormal": (t, t + timedelta(minutes=window_minutes)),
+            "normal": (t - timedelta(minutes=window_minutes), t),
+        }
+        for kind, (w0, w1) in windows.items():
+            folder = out / ev.case_name / kind
+            folder.mkdir(parents=True, exist_ok=True)
+            query = TRACE_QUERY.format(
+                start=w0, end=w1, namespace=ev.namespace
+            )
+            tasks.append(
+                _fetch_csv(client, query, folder / "traces.csv", semaphore)
+            )
+    ok = await asyncio.gather(*tasks)
+
+    import toml
+
+    manifest = {
+        "chaos_injection": [
+            {
+                "case": ev.case_name,
+                "timestamp": ev.timestamp,
+                "namespace": ev.namespace,
+                "chaos_type": ev.chaos_type,
+                "service": ev.service,
+            }
+            for ev in events
+        ]
+    }
+    (out / "manifest.toml").write_text(toml.dumps(manifest))
+    return all(ok)
+
+
+def run_collect(args) -> int:
+    if args.config_toml:
+        events = load_events_toml(args.config_toml)
+    else:
+        log.error("--config-toml is required (interactive input not supported)")
+        return 2
+    ok = asyncio.run(collect_cases(events, args.host, args.output))
+    return 0 if ok else 1

@@ -1,0 +1,13 @@
+from .checkpoint import WindowCursor, load_slo, save_slo
+from .results import ResultSink, WindowResult
+from .runner import OnlineRCA, run_rca
+
+__all__ = [
+    "OnlineRCA",
+    "run_rca",
+    "ResultSink",
+    "WindowResult",
+    "WindowCursor",
+    "load_slo",
+    "save_slo",
+]

@@ -1,0 +1,81 @@
+"""Result records and sinks (reference: online_rca.py:202-214).
+
+The reference writes ``result.csv`` with mode 'w' per anomaly window, so
+only the last anomaly of a run survives (SURVEY.md §2.2 quirk #5). The
+default sink here appends one JSONL record per window (machine-readable,
+full context: window bounds, partition sizes, timings, ranking) plus a
+reference-shaped CSV; ``overwrite`` reproduces the quirk for compat runs.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class WindowResult:
+    """Everything the pipeline learned about one detection window."""
+
+    start: str
+    end: str
+    anomaly: bool
+    n_traces: int = 0
+    n_normal: int = 0
+    n_abnormal: int = 0
+    ranking: List[Tuple[str, float]] = field(default_factory=list)
+    timings: Dict[str, float] = field(default_factory=dict)
+    skipped_reason: Optional[str] = None
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["ranking"] = [[n, float(s)] for n, s in self.ranking]
+        return json.dumps(d)
+
+
+class ResultSink:
+    """Persists window results: JSONL (always append) + reference-shaped
+    CSV (``level,result,rank,confidence`` — online_rca.py:212-214)."""
+
+    def __init__(self, out_dir, overwrite_csv: bool = False):
+        self.out_dir = Path(out_dir)
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        self.jsonl_path = self.out_dir / "windows.jsonl"
+        self.csv_path = self.out_dir / "result.csv"
+        self.overwrite_csv = overwrite_csv
+        self._csv_initialized = False
+        self.results: List[WindowResult] = []
+
+    def emit(self, result: WindowResult) -> None:
+        self.results.append(result)
+        with open(self.jsonl_path, "a") as f:
+            f.write(result.to_json() + "\n")
+        if result.anomaly and result.ranking:
+            self._write_csv(result)
+
+    def _write_csv(self, result: WindowResult) -> None:
+        if self.overwrite_csv:
+            # Reference-exact shape: 4 columns, overwritten per anomaly
+            # (online_rca.py:210-214).
+            with open(self.csv_path, "w", newline="") as f:
+                writer = csv.writer(f)
+                writer.writerow(["level", "result", "rank", "confidence"])
+                for rank, (service, score) in enumerate(result.ranking, 1):
+                    writer.writerow(["span", service, rank, float(score)])
+            return
+        mode = "a" if self._csv_initialized or self.csv_path.exists() else "w"
+        with open(self.csv_path, mode, newline="") as f:
+            writer = csv.writer(f)
+            if mode == "w":
+                writer.writerow(
+                    ["level", "result", "rank", "confidence", "window_start"]
+                )
+            for rank, (service, score) in enumerate(result.ranking, 1):
+                writer.writerow(
+                    ["span", service, rank, float(score), result.start]
+                )
+        self._csv_initialized = True

@@ -1,0 +1,4 @@
+from .logging import get_logger
+from .profiling import StageTimings, trace_context
+
+__all__ = ["get_logger", "StageTimings", "trace_context"]

@@ -1,0 +1,27 @@
+"""Structured logging (SURVEY.md §5 observability row).
+
+The reference prints to stdout throughout (online_rca.py:151,172-174;
+anormaly_detector.py:49,74-76). Here everything goes through stdlib
+``logging`` under the ``microrank_tpu`` namespace; no print-as-API.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "microrank_tpu") -> logging.Logger:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root = logging.getLogger("microrank_tpu")
+        if not root.handlers:
+            root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        _configured = True
+    return logging.getLogger(name)

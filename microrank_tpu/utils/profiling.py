@@ -1,0 +1,55 @@
+"""Per-stage wall-clock profiling (SURVEY.md §5 tracing row).
+
+The reference has no self-timing at all (its paper reports module latencies
+measured externally, Table 7). Here every pipeline stage records into a
+``StageTimings`` struct so each window result carries
+ingest/detect/build/rank timings; ``jax.profiler`` trace export can be
+layered on via ``trace_context`` for deep dives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+
+class StageTimings:
+    """Accumulates named stage durations (seconds)."""
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc[name] += time.perf_counter() - t0
+            self._counts[name] += 1
+
+    def as_dict(self) -> Dict[str, float]:
+        return {k: round(v, 6) for k, v in self._acc.items()}
+
+    def total(self) -> float:
+        return sum(self._acc.values())
+
+    def merge(self, other: "StageTimings") -> None:
+        for k, v in other._acc.items():
+            self._acc[k] += v
+            self._counts[k] += other._counts[k]
+
+
+@contextlib.contextmanager
+def trace_context(log_dir: Optional[str]) -> Iterator[None]:
+    """Optionally wrap a region in a jax.profiler trace (Perfetto dump)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

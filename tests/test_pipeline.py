@@ -1,0 +1,163 @@
+"""Pipeline + CLI integration: window loop, sinks, checkpoint, compat."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from microrank_tpu.config import CompatConfig, MicroRankConfig
+from microrank_tpu.pipeline import (
+    OnlineRCA,
+    WindowCursor,
+    load_slo,
+    run_rca,
+    save_slo,
+)
+from microrank_tpu.detect import compute_slo
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+@pytest.fixture(scope="module")
+def case():
+    return generate_case(
+        SyntheticConfig(
+            n_operations=24, n_traces=200, seed=9, n_kinds=24,
+            child_keep_prob=0.6,
+        )
+    )
+
+
+def test_run_rca_end_to_end(case, tmp_path):
+    results = run_rca(
+        case.normal, case.abnormal, MicroRankConfig(), out_dir=tmp_path
+    )
+    anomalous = [r for r in results if r.anomaly and r.ranking]
+    assert anomalous, "no anomalous window found"
+    top1 = anomalous[0].ranking[0][0]
+    assert top1 == case.fault_pod_op
+    # Sink artifacts.
+    lines = (tmp_path / "windows.jsonl").read_text().strip().splitlines()
+    assert len(lines) == len(results)
+    rec = json.loads(lines[0])
+    assert rec["anomaly"] and rec["ranking"][0][0] == case.fault_pod_op
+    csv = pd.read_csv(tmp_path / "result.csv")
+    assert list(csv.columns) == [
+        "level", "result", "rank", "confidence", "window_start",
+    ]
+    assert csv.iloc[0]["result"] == case.fault_pod_op
+    # Timings recorded for the anomalous window.
+    assert "rank" in anomalous[0].timings
+    # Cursor cleared after a clean run.
+    assert not (tmp_path / "cursor.json").exists()
+
+
+def test_reference_compat_overwrite_csv(case, tmp_path):
+    cfg = MicroRankConfig.reference_compat()
+    results = run_rca(case.normal, case.abnormal, cfg, out_dir=tmp_path)
+    assert any(r.anomaly for r in results)
+    csv = pd.read_csv(tmp_path / "result.csv")
+    # Reference-exact 4-column shape (online_rca.py:212).
+    assert list(csv.columns) == ["level", "result", "rank", "confidence"]
+
+
+def test_partition_swap_changes_ranking(case, tmp_path):
+    plain = run_rca(case.normal, case.abnormal, MicroRankConfig())
+    cfg = MicroRankConfig(compat=CompatConfig(partition_swap=True))
+    swapped = run_rca(case.normal, case.abnormal, cfg)
+    r_plain = next(r for r in plain if r.ranking)
+    r_swap = next(r for r in swapped if r.ranking)
+    assert r_plain.ranking[0][0] != r_swap.ranking[0][0]
+
+
+def test_slo_cache_roundtrip(case, tmp_path):
+    vocab, baseline = compute_slo(case.normal)
+    path = tmp_path / "slo.npz"
+    save_slo(path, vocab, baseline)
+    vocab2, baseline2 = load_slo(path)
+    assert vocab2.names == vocab.names
+    np.testing.assert_array_equal(baseline2.mean_ms, baseline.mean_ms)
+    np.testing.assert_array_equal(baseline2.std_ms, baseline.std_ms)
+
+    rca = OnlineRCA(MicroRankConfig())
+    rca.fit_baseline(case.normal, cache_path=path)  # loads, not recomputes
+    assert rca.slo_vocab.names == vocab.names
+
+
+def test_window_cursor(tmp_path):
+    cur = WindowCursor(tmp_path / "cursor.json")
+    assert cur.load() is None
+    cur.save("2025-02-14 12:05:00")
+    assert cur.load() == "2025-02-14 12:05:00"
+    cur.clear()
+    assert cur.load() is None
+
+
+def test_resume_skips_processed_windows(case, tmp_path):
+    cfg = MicroRankConfig()
+    rca = OnlineRCA(cfg)
+    rca.fit_baseline(case.normal)
+    # Pretend a prior run stopped after the first window.
+    first = rca.run(case.abnormal, out_dir=tmp_path)
+    assert len(first) >= 1
+    cursor = WindowCursor(tmp_path / "cursor.json")
+    end_of_first = pd.Timestamp(first[0].end)
+    skip = pd.Timedelta(minutes=cfg.window.skip_minutes)
+    cursor.save(str(end_of_first + (skip if first[0].ranking else pd.Timedelta(0))))
+    resumed = rca.run(case.abnormal, out_dir=tmp_path, resume=True)
+    assert len(resumed) == len(first) - 1
+
+
+def test_empty_window_skipped(case):
+    # An empty dump -> zero windows, no crash (the reference's bare
+    # ``return False`` would crash the unpack at online_rca.py:167).
+    rca = OnlineRCA(MicroRankConfig())
+    rca.fit_baseline(case.normal)
+    assert rca.run(case.abnormal.iloc[0:0]) == []
+
+
+def test_cli_synth_and_run(tmp_path):
+    from microrank_tpu.cli import main
+
+    data = tmp_path / "data"
+    rc = main(
+        [
+            "synth", "-o", str(data), "--operations", "16", "--traces", "120",
+            "--seed", "3", "--kinds", "24",
+        ]
+    )
+    assert rc == 0
+    truth = json.loads((data / "ground_truth.json").read_text())
+    out = tmp_path / "out"
+    rc = main(
+        [
+            "run",
+            "--normal", str(data / "normal" / "traces.csv"),
+            "--abnormal", str(data / "abnormal" / "traces.csv"),
+            "-o", str(out),
+            "--backend", "jax",
+        ]
+    )
+    assert rc == 0
+    csv = pd.read_csv(out / "result.csv")
+    assert csv.iloc[0]["result"] == truth["fault_pod_op"]
+
+
+def test_cli_numpy_backend_agrees(tmp_path):
+    from microrank_tpu.cli import main
+
+    data = tmp_path / "data"
+    main(["synth", "-o", str(data), "--operations", "12", "--traces", "80",
+          "--seed", "4"])
+    outs = {}
+    for backend in ("jax", "numpy_ref"):
+        out = tmp_path / backend
+        main(
+            ["run", "--normal", str(data / "normal" / "traces.csv"),
+             "--abnormal", str(data / "abnormal" / "traces.csv"),
+             "-o", str(out), "--backend", backend]
+        )
+        if (out / "result.csv").exists():
+            outs[backend] = pd.read_csv(out / "result.csv")
+    if len(outs) == 2:
+        assert outs["jax"].iloc[0]["result"] == outs["numpy_ref"].iloc[0]["result"]
