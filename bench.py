@@ -1,0 +1,185 @@
+"""Benchmark harness: rank one large trace window on the device backend.
+
+Prints ONE JSON line:
+    {"metric": "spans_per_sec_ranked", "value": N, "unit": "spans/s",
+     "vs_baseline": R}
+
+* value — spans of the abnormal window ranked per second of wall-clock
+  through the device path (host COO graph build + jitted rank program,
+  post-compile; median of BENCH_REPEATS runs).
+* vs_baseline — speedup of that spans/s over the faithful numpy oracle
+  backend measured on a trace-subsample of the same window (the oracle is
+  the reference's dense-matrix semantics; its cost is superlinear, so the
+  subsample keeps the baseline measurable — the ratio therefore
+  *understates* the real speedup at full scale).
+
+Config via env: BENCH_SPANS (default 1_000_000), BENCH_OPS (5000),
+BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000). Details go to stderr;
+stdout carries only the JSON line.
+
+Reference baseline context: the reference's PageRank Scorer takes 5.5 s
+per window of ~1e2 ops / 1e2-1e3 traces on a CPU core (paper Table 7;
+BASELINE.md) — the target here is a window 3-4 orders of magnitude larger
+in under a second (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    spans_target = int(os.environ.get("BENCH_SPANS", 1_000_000))
+    n_ops = int(os.environ.get("BENCH_OPS", 5000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    oracle_spans = int(os.environ.get("BENCH_ORACLE_SPANS", 20_000))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.detect import compute_slo, detect_numpy
+    from microrank_tpu.graph import build_detect_batch, build_window_graph
+    from microrank_tpu.rank_backends import NumpyRefBackend
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+    from microrank_tpu.testing import SyntheticConfig, generate_case_with_spans
+
+    log(f"devices: {jax.devices()}")
+    cfg = MicroRankConfig()
+
+    t0 = time.perf_counter()
+    case = generate_case_with_spans(
+        SyntheticConfig(
+            n_operations=n_ops,
+            n_kinds=max(32, n_ops // 50),
+            child_keep_prob=0.55,
+            seed=0,
+        ),
+        target_spans=spans_target,
+    )
+    n_spans = len(case.abnormal)
+    log(
+        f"generated case in {time.perf_counter() - t0:.1f}s: "
+        f"{n_spans} abnormal spans, {case.abnormal['traceID'].nunique()} traces, "
+        f"{n_ops} operations"
+    )
+
+    # Detect + partition (host; not part of the timed rank path, matching
+    # the reference's Table 7 which times the PageRank Scorer stage).
+    t0 = time.perf_counter()
+    vocab, baseline = compute_slo(case.normal)
+    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
+    res = detect_numpy(batch, baseline, cfg.detector)
+    trace_arr = np.asarray(trace_ids)
+    abn = trace_arr[res.abnormal[: len(trace_arr)]].tolist()
+    nrm_mask = res.valid[: len(trace_arr)] & ~res.abnormal[: len(trace_arr)]
+    nrm = trace_arr[nrm_mask].tolist()
+    detect_s = time.perf_counter() - t0
+    log(
+        f"detect+partition: {detect_s:.2f}s "
+        f"({len(nrm)} normal / {len(abn)} abnormal traces)"
+    )
+    if not (nrm and abn):
+        log("FATAL: window did not partition; tune the generator")
+        return 1
+
+    # --- timed device path: graph build (host) + rank (device) ---------
+    def build():
+        return build_window_graph(case.abnormal, nrm, abn)
+
+    t0 = time.perf_counter()
+    graph, op_names, _, _ = build()
+    build_s = time.perf_counter() - t0
+    log(f"graph build (host, cold): {build_s:.2f}s")
+
+    device_graph = jax.tree.map(jnp.asarray, graph)
+    t0 = time.perf_counter()
+    out = rank_window_device(device_graph, cfg.pagerank, cfg.spectrum)
+    jax.block_until_ready(out)
+    log(f"first call (compile + run): {time.perf_counter() - t0:.2f}s")
+
+    rank_times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = rank_window_device(device_graph, cfg.pagerank, cfg.spectrum)
+        jax.block_until_ready(out)
+        rank_times.append(time.perf_counter() - t0)
+    rank_s = float(np.median(rank_times))
+
+    build_times = []
+    for _ in range(max(1, min(repeats, 3))):
+        t0 = time.perf_counter()
+        build()
+        build_times.append(time.perf_counter() - t0)
+    build_s = float(np.median(build_times))
+
+    total_s = build_s + rank_s
+    spans_per_sec = n_spans / total_s
+    top_idx, top_scores, n_valid = out
+    jax_top1 = op_names[int(np.asarray(top_idx)[0])]
+    log(
+        f"device path: build {build_s * 1e3:.0f}ms + rank {rank_s * 1e3:.0f}ms "
+        f"= {total_s * 1e3:.0f}ms -> {spans_per_sec:,.0f} spans/s; "
+        f"top-1 {jax_top1} (fault {case.fault_pod_op})"
+    )
+
+    # --- oracle baseline on a subsample --------------------------------
+    sub_traces = []
+    count = 0
+    per_trace = max(1, n_spans // max(len(trace_arr), 1))
+    for t in nrm + abn:
+        sub_traces.append(t)
+        count += per_trace
+        if count >= oracle_spans:
+            break
+    sub_set = set(sub_traces)
+    sub_df = case.abnormal[case.abnormal["traceID"].isin(sub_set)]
+    sub_nrm = [t for t in nrm if t in sub_set]
+    sub_abn = [t for t in abn if t in sub_set]
+    if not sub_abn:
+        sub_abn = abn[:2]
+        sub_df = case.abnormal[
+            case.abnormal["traceID"].isin(sub_set | set(sub_abn))
+        ]
+    n_sub = len(sub_df)
+    oracle = NumpyRefBackend(cfg)
+    t0 = time.perf_counter()
+    top_o, _ = oracle.rank_window(sub_df, sub_nrm, sub_abn)
+    oracle_s = time.perf_counter() - t0
+    oracle_sps = n_sub / oracle_s
+    log(
+        f"numpy oracle on {n_sub}-span subsample: {oracle_s:.2f}s "
+        f"-> {oracle_sps:,.0f} spans/s"
+    )
+
+    # Parity on the subsample through the device backend.
+    from microrank_tpu.rank_backends.jax_tpu import JaxBackend
+
+    top_j, _ = JaxBackend(cfg).rank_window(sub_df, sub_nrm, sub_abn)
+    parity = top_o[0] == top_j[0]
+    log(f"subsample Top-1 parity (oracle vs jax): {parity} ({top_o[0]})")
+
+    vs_baseline = spans_per_sec / oracle_sps
+    print(
+        json.dumps(
+            {
+                "metric": "spans_per_sec_ranked",
+                "value": round(spans_per_sec, 1),
+                "unit": "spans/s",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+from .mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh, single_axis_mesh
+from .sharded_rank import (
+    rank_windows_batched,
+    rank_windows_sharded,
+    stack_window_graphs,
+)
+
+__all__ = [
+    "SHARD_AXIS",
+    "WINDOW_AXIS",
+    "make_mesh",
+    "single_axis_mesh",
+    "rank_windows_batched",
+    "rank_windows_sharded",
+    "stack_window_graphs",
+]

@@ -1,0 +1,68 @@
+"""Device-mesh helpers (components C18/C19 — new; the reference has no
+parallelism or communication layer at all).
+
+The framework's scaling axes map onto a 2D logical mesh:
+
+* ``windows`` — data parallelism over detection windows (each window's
+  ranking is independent: vmap + batch sharding);
+* ``shard``  — graph parallelism within a window: the COO *entry* axes of
+  the incidence/call-edge lists are sharded, each device segment-sums its
+  shard into dense [V]/[T] partials, and one psum per SpMV combines them.
+  On a TPU slice the psum rides ICI; across slices, DCN — both compiled by
+  XLA from the same program (no NCCL/MPI analogue needed).
+
+Multi-host: call ``jax.distributed.initialize()`` before building the mesh
+and pass ``jax.devices()`` spanning all hosts; the code is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+WINDOW_AXIS = "windows"
+SHARD_AXIS = "shard"
+
+
+def make_mesh(
+    shape: Tuple[int, ...],
+    axes: Tuple[str, ...] = (WINDOW_AXIS, SHARD_AXIS),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh of the given logical shape.
+
+    Uses ``mesh_utils.create_device_mesh`` when the requested size matches
+    the full device count (gets ICI-topology-aware placement on real TPU
+    slices); otherwise reshapes an explicit device list.
+    """
+    if len(shape) != len(axes):
+        raise ValueError(f"mesh shape {shape} does not match axes {axes}")
+    n = int(np.prod(shape))
+    if devices is None:
+        devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(
+            f"mesh of {n} devices requested but only {len(devices)} available"
+        )
+    if n == len(devices):
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=list(devices)
+            )
+            return Mesh(dev_array, axes)
+        except Exception:  # pragma: no cover - topology helper unavailable
+            pass
+    dev_array = np.asarray(list(devices)[:n]).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def single_axis_mesh(n: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n is None:
+        n = len(devices)
+    return make_mesh((n,), (axis,), devices[:n])

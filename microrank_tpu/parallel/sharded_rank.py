@@ -1,0 +1,150 @@
+"""Sharded + batched window ranking (shard_map over the 2D mesh).
+
+Layout (see mesh.py): a batch of window graphs is stacked with a leading
+window axis; entry arrays are [B, E]. Under shard_map, B splits across the
+``windows`` mesh axis (pure data parallelism — zero communication) and E
+splits across the ``shard`` axis (each device holds a slice of the COO
+entries; one psum per SpMV inside the power iteration combines the dense
+partials). The per-op [V] / per-trace [T] arrays are replicated within a
+window's shard group — they are the small axes; the entry list is the big
+one (SURVEY.md §5 long-context row: the scaling axes of this workload are
+T and the nnz, not sequence length).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import PageRankConfig, SpectrumConfig
+from ..graph.structures import PartitionGraph, WindowGraph
+from ..rank_backends.jax_tpu import rank_window_core
+from .mesh import SHARD_AXIS, WINDOW_AXIS
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
+    if arr.shape[0] == size:
+        return arr
+    out = np.full((size,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def stack_window_graphs(
+    graphs: Sequence[WindowGraph], shard_multiple: int = 1
+) -> WindowGraph:
+    """Stack per-window graphs into one batched WindowGraph.
+
+    Each field is re-padded to the batch maximum (rounded up so the entry
+    axes divide ``shard_multiple`` — a shard_map requirement). Padding
+    entries carry value 0 and are inert; per-window true extents live in
+    the n_* scalars (stacked to [B]).
+    """
+
+    def stack_parts(parts: List[PartitionGraph]) -> PartitionGraph:
+        e = _round_up(max(p.inc_op.shape[0] for p in parts), shard_multiple)
+        c = _round_up(max(p.ss_child.shape[0] for p in parts), shard_multiple)
+        t = max(p.kind.shape[0] for p in parts)
+        v = max(p.cov_unique.shape[0] for p in parts)
+        return PartitionGraph(
+            inc_op=np.stack([_pad_axis0(p.inc_op, e) for p in parts]),
+            inc_trace=np.stack([_pad_axis0(p.inc_trace, e) for p in parts]),
+            sr_val=np.stack([_pad_axis0(p.sr_val, e) for p in parts]),
+            rs_val=np.stack([_pad_axis0(p.rs_val, e) for p in parts]),
+            ss_child=np.stack([_pad_axis0(p.ss_child, c) for p in parts]),
+            ss_parent=np.stack([_pad_axis0(p.ss_parent, c) for p in parts]),
+            ss_val=np.stack([_pad_axis0(p.ss_val, c) for p in parts]),
+            kind=np.stack([_pad_axis0(p.kind, t, fill=1) for p in parts]),
+            tracelen=np.stack(
+                [_pad_axis0(p.tracelen, t, fill=1) for p in parts]
+            ),
+            cov_unique=np.stack([_pad_axis0(p.cov_unique, v) for p in parts]),
+            op_present=np.stack(
+                [_pad_axis0(p.op_present, v, fill=False) for p in parts]
+            ),
+            n_ops=np.stack([p.n_ops for p in parts]),
+            n_traces=np.stack([p.n_traces for p in parts]),
+            n_inc=np.stack([p.n_inc for p in parts]),
+            n_ss=np.stack([p.n_ss for p in parts]),
+        )
+
+    return WindowGraph(
+        normal=stack_parts([g.normal for g in graphs]),
+        abnormal=stack_parts([g.abnormal for g in graphs]),
+    )
+
+
+def _partition_specs(window_axis, shard_axis) -> PartitionGraph:
+    entry = P(window_axis, shard_axis)   # big COO entry axes: sharded
+    per_window = P(window_axis)          # [V]/[T]/scalar arrays: replicated
+    return PartitionGraph(
+        inc_op=entry,
+        inc_trace=entry,
+        sr_val=entry,
+        rs_val=entry,
+        ss_child=entry,
+        ss_parent=entry,
+        ss_val=entry,
+        kind=per_window,
+        tracelen=per_window,
+        cov_unique=per_window,
+        op_present=per_window,
+        n_ops=per_window,
+        n_traces=per_window,
+        n_inc=per_window,
+        n_ss=per_window,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def rank_windows_sharded(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    mesh: Mesh,
+):
+    """Rank a batch of windows over the 2D (windows, shard) mesh.
+
+    Input arrays carry a leading batch axis B (divisible by the windows
+    axis size) with entry axes divisible by the shard axis size — use
+    ``stack_window_graphs(graphs, shard_multiple=mesh.shape['shard'])``.
+    Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
+    """
+    specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
+    in_specs = (WindowGraph(normal=specs, abnormal=specs),)
+    out_specs = (P(WINDOW_AXIS), P(WINDOW_AXIS), P(WINDOW_AXIS))
+
+    def kernel(graph: WindowGraph):
+        return jax.vmap(
+            lambda g: rank_window_core(
+                g, pagerank_cfg, spectrum_cfg, SHARD_AXIS
+            )
+        )(graph)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+    )(batched)
+
+
+def rank_windows_batched(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+):
+    """Single-device vmapped batch ranking (BASELINE.json config 4)."""
+    fn = jax.vmap(lambda g: rank_window_core(g, pagerank_cfg, spectrum_cfg))
+    return jax.jit(fn)(jax.tree.map(jnp.asarray, batched))

@@ -66,7 +66,10 @@ def preference_vector(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
 
 
 def partition_pagerank(
-    g: PartitionGraph, anomaly: bool, cfg: PageRankConfig
+    g: PartitionGraph,
+    anomaly: bool,
+    cfg: PageRankConfig,
+    psum_axis: str | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Power-iterate one partition; returns (weight[V], score[V]).
 
@@ -75,6 +78,13 @@ def partition_pagerank(
     max-normalized PageRank vector. Ops absent from the partition have no
     incoming entries, stay at 0, and cannot perturb present ops — so
     running on the shared window vocab is exact.
+
+    ``psum_axis``: when called under shard_map with the COO *entry* axes
+    (inc_*/ss_*) sharded across that mesh axis, each device segment-sums
+    its entry shard into full dense [V]/[T] partials and the psum combines
+    them — the ranking vectors stay replicated (V and T vectors are small;
+    the entries are the big axis). This is the whole multi-chip story for
+    the SpMV (SURVEY.md C18/C19 plan).
     """
     v = g.cov_unique.shape[0]
     t_pad = g.kind.shape[0]
@@ -85,19 +95,24 @@ def partition_pagerank(
     d = jnp.float32(cfg.damping)
     alpha = jnp.float32(cfg.call_weight)
 
+    def reduce_shards(x):
+        return lax.psum(x, psum_axis) if psum_axis is not None else x
+
     sv = jnp.where(g.op_present, 1.0 / n_total, 0.0).astype(jnp.float32)
     rv = jnp.where(trace_live, 1.0 / n_total, 0.0).astype(jnp.float32)
 
     def body(_, carry):
         sv, rv = carry
         # p_sr @ rv  +  alpha * p_ss @ sv   (pagerank.py:122-124)
-        sv_new = d * (
+        sv_new = d * reduce_shards(
             coo_matvec(g.inc_op, g.inc_trace, g.sr_val, rv, v)
             + alpha * coo_matvec(g.ss_child, g.ss_parent, g.ss_val, sv, v)
         )
         # p_rs @ sv + (1-d) * pref          (pagerank.py:125)
         rv_new = (
-            d * coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
+            d * reduce_shards(
+                coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
+            )
             + (1.0 - d) * pref
         )
         if cfg.max_normalize_each_iter:
@@ -148,20 +163,27 @@ def window_spectrum(
     return jnp.where(valid, scores, -jnp.inf), valid
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def rank_window_device(
+def rank_window_core(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
     spectrum_cfg: SpectrumConfig,
+    psum_axis: str | None = None,
 ):
-    """The full single-window ranking as one XLA program.
+    """The full single-window ranking: both partitions' power iterations,
+    spectrum, top-k. Pure traced function — jit it (single device), vmap
+    it (window batches), or call it under shard_map with the entry axes
+    sharded and ``psum_axis`` set (multi-chip).
 
     Returns (top_idx int32[k], top_scores float32[k], n_valid int32):
     indices into the shared window op vocab, score-descending;
     entries beyond ``n_valid`` are padding (score -inf).
     """
-    n_weight, _ = partition_pagerank(graph.normal, False, pagerank_cfg)
-    a_weight, _ = partition_pagerank(graph.abnormal, True, pagerank_cfg)
+    n_weight, _ = partition_pagerank(
+        graph.normal, False, pagerank_cfg, psum_axis
+    )
+    a_weight, _ = partition_pagerank(
+        graph.abnormal, True, pagerank_cfg, psum_axis
+    )
     scores, valid = window_spectrum(
         a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
     )
@@ -169,6 +191,9 @@ def rank_window_device(
     top_scores, top_idx = lax.top_k(scores, k)
     n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
     return top_idx.astype(jnp.int32), top_scores, n_valid
+
+
+rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3))
 
 
 class JaxBackend:

@@ -1,3 +1,15 @@
-from .synthetic import SyntheticCase, SyntheticConfig, Topology, generate_case
+from .synthetic import (
+    SyntheticCase,
+    SyntheticConfig,
+    Topology,
+    generate_case,
+    generate_case_with_spans,
+)
 
-__all__ = ["SyntheticCase", "SyntheticConfig", "Topology", "generate_case"]
+__all__ = [
+    "SyntheticCase",
+    "SyntheticConfig",
+    "Topology",
+    "generate_case",
+    "generate_case_with_spans",
+]

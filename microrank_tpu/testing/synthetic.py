@@ -181,6 +181,26 @@ class SyntheticCase:
     topology: Topology
 
 
+def generate_case_with_spans(
+    cfg: SyntheticConfig, target_spans: int
+) -> SyntheticCase:
+    """Generate a case whose windows hold ~``target_spans`` spans each.
+
+    Builds the topology first, measures the mean trace-kind size, and
+    derives the trace count — the knob bench configs are specified in
+    (BASELINE.json: "1M-span / 5k-operation window").
+    """
+    rng = np.random.default_rng(cfg.seed)
+    topo = _make_topology(cfg, rng)
+    mean_kind = float(np.mean([len(k) for k in topo.kinds]))
+    n_traces = max(1, int(round(target_spans / max(mean_kind, 1.0))))
+    return generate_case(
+        SyntheticConfig(
+            **{**cfg.__dict__, "n_traces": n_traces}
+        )
+    )
+
+
 def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     """One chaos case: a normal window and an abnormal window with one
     injected latency fault (the collect_data.py normal/abnormal dump pair)."""

@@ -8,12 +8,19 @@ TPU slice, just on emulated host devices.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The shell environment pins JAX_PLATFORMS=axon (the TPU tunnel) and the
+# plugin wins over a plain env override, so force CPU through the config
+# API before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

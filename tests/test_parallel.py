@@ -1,0 +1,114 @@
+"""Sharded/batched ranking on the 8-device virtual CPU mesh
+(SURVEY.md §4 item 4: same pjit/shard_map code paths as a real slice)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.graph import build_window_graph
+from microrank_tpu.parallel import (
+    make_mesh,
+    rank_windows_batched,
+    rank_windows_sharded,
+    single_axis_mesh,
+    stack_window_graphs,
+)
+from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+
+
+@pytest.fixture(scope="module")
+def window_batch():
+    graphs, namelists = [], []
+    for seed in (1, 2, 3, 4):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        graph, names, _, _ = build_window_graph(case.abnormal, nrm, abn)
+        graphs.append(graph)
+        namelists.append(names)
+    return graphs, namelists
+
+
+def test_sharded_matches_single_device(window_batch):
+    graphs, namelists = window_batch
+    cfg = MicroRankConfig()
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(graphs, shard_multiple=4)
+    sti, sts, stv = rank_windows_sharded(
+        jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum, mesh
+    )
+    for i, g in enumerate(graphs):
+        ti, ts, tv = rank_window_device(
+            jax.tree.map(jnp.asarray, g), cfg.pagerank, cfg.spectrum
+        )
+        # Same top-1 op by name; same candidate ordering.
+        assert namelists[i][int(ti[0])] == namelists[i][int(sti[i][0])]
+        np.testing.assert_array_equal(np.asarray(ti), np.asarray(sti[i]))
+
+
+def test_batched_vmap_matches_sharded(window_batch):
+    graphs, _ = window_batch
+    cfg = MicroRankConfig()
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(graphs, shard_multiple=4)
+    sti, sts, _ = rank_windows_sharded(
+        jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum, mesh
+    )
+    bti, bts, _ = rank_windows_batched(stacked, cfg.pagerank, cfg.spectrum)
+    np.testing.assert_array_equal(np.asarray(sti), np.asarray(bti))
+    fin = np.isfinite(np.asarray(bts))
+    rel = np.abs(np.asarray(sts)[fin] - np.asarray(bts)[fin]) / np.maximum(
+        np.abs(np.asarray(bts)[fin]), 1e-9
+    )
+    assert rel.max() < 1e-4
+
+
+def test_shard_only_mesh(window_batch):
+    # Pure graph-parallelism: 1 window across all 8 devices.
+    graphs, namelists = window_batch
+    cfg = MicroRankConfig()
+    mesh = make_mesh((1, 8))
+    stacked = stack_window_graphs(graphs[:1], shard_multiple=8)
+    sti, _, _ = rank_windows_sharded(
+        jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum, mesh
+    )
+    ti, _, _ = rank_window_device(
+        jax.tree.map(jnp.asarray, graphs[0]), cfg.pagerank, cfg.spectrum
+    )
+    assert namelists[0][int(ti[0])] == namelists[0][int(sti[0][0])]
+
+
+def test_mesh_helpers():
+    m = make_mesh((2, 4))
+    assert m.devices.shape == (2, 4)
+    assert m.axis_names == ("windows", "shard")
+    m1 = single_axis_mesh(8)
+    assert m1.devices.shape == (8,)
+    with pytest.raises(ValueError):
+        make_mesh((3, 4, 5), ("a", "b"))
+    with pytest.raises(ValueError):
+        make_mesh((1024,), ("shard",))
+
+
+def test_graft_entry_points():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out[0].shape == out[1].shape
+    mod.dryrun_multichip(8)
