@@ -40,6 +40,9 @@ def main() -> int:
     n_ops = int(os.environ.get("BENCH_OPS", 5000))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
     oracle_spans = int(os.environ.get("BENCH_ORACLE_SPANS", 20_000))
+    # Expected-duration margins grow with trace depth (sum of inclusive
+    # span SLOs), so the injected latency must scale with topology size.
+    fault_ms = float(os.environ.get("BENCH_FAULT_MS", 60_000.0))
 
     import jax
     import jax.numpy as jnp
@@ -49,7 +52,10 @@ def main() -> int:
     from microrank_tpu.detect import compute_slo, detect_numpy
     from microrank_tpu.graph import build_detect_batch, build_window_graph
     from microrank_tpu.rank_backends import NumpyRefBackend
-    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+    from microrank_tpu.rank_backends.jax_tpu import (
+        choose_kernel,
+        rank_window_device,
+    )
     from microrank_tpu.testing import SyntheticConfig, generate_case_with_spans
 
     log(f"devices: {jax.devices()}")
@@ -61,6 +67,7 @@ def main() -> int:
             n_operations=n_ops,
             n_kinds=max(32, n_ops // 50),
             child_keep_prob=0.55,
+            fault_latency_ms=fault_ms,
             seed=0,
         ),
         target_spans=spans_target,
@@ -100,16 +107,25 @@ def main() -> int:
     build_s = time.perf_counter() - t0
     log(f"graph build (host, cold): {build_s:.2f}s")
 
+    kernel = os.environ.get("BENCH_KERNEL", "auto")
+    if kernel == "auto":
+        kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
+    log(f"pagerank kernel: {kernel}")
+
     device_graph = jax.tree.map(jnp.asarray, graph)
     t0 = time.perf_counter()
-    out = rank_window_device(device_graph, cfg.pagerank, cfg.spectrum)
+    out = rank_window_device(
+        device_graph, cfg.pagerank, cfg.spectrum, None, kernel
+    )
     jax.block_until_ready(out)
     log(f"first call (compile + run): {time.perf_counter() - t0:.2f}s")
 
     rank_times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        out = rank_window_device(device_graph, cfg.pagerank, cfg.spectrum)
+        out = rank_window_device(
+            device_graph, cfg.pagerank, cfg.spectrum, None, kernel
+        )
         jax.block_until_ready(out)
         rank_times.append(time.perf_counter() - t0)
     rank_s = float(np.median(rank_times))

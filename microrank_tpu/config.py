@@ -109,6 +109,11 @@ class RuntimeConfig:
     # Compute dtype for the iteration. float32 preserves ranking parity;
     # bfloat16 trades precision for MXU throughput (rank-parity tested).
     dtype: str = "float32"
+    # Power-iteration kernel: "coo" (segment-sum SpMV — scales, shardable),
+    # "dense" (scatter once, 25 MXU matvecs — fastest when it fits),
+    # "auto" (dense iff scattered matrices fit dense_budget_bytes).
+    kernel: str = "auto"
+    dense_budget_bytes: int = 2 << 30
 
 
 @dataclass(frozen=True)

@@ -1,8 +1,4 @@
-from .build import (
-    build_detect_batch,
-    build_partition_graph,
-    build_window_graph,
-)
+from .build import build_detect_batch, build_window_graph
 from .dicts import pagerank_graph_dicts
 from .structures import (
     DetectBatch,
@@ -15,7 +11,6 @@ from .structures import (
 
 __all__ = [
     "build_detect_batch",
-    "build_partition_graph",
     "build_window_graph",
     "pagerank_graph_dicts",
     "DetectBatch",

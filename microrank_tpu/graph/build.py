@@ -3,9 +3,11 @@
 This replaces the reference's dict building plus its O(n^2) dense-matrix
 fill (``list.index()`` per edge, pagerank.py:35-52 — hot spot #3) and its
 O(T^2·O) all-pairs trace-kind dedup (pagerank.py:54-66 — hot spot #2) with
-O(n log n) numpy: ``pd.factorize`` interning, ``np.unique`` on packed
-(op, trace) keys, ``np.bincount`` degree statistics, and an exact
-byte-key dedup over each trace's sorted unique-op row.
+O(n log n) numpy. Every string column is interned exactly once per window
+(``pd.factorize``); both partitions are then built from int32 arrays only —
+``np.unique`` on packed (op, trace) keys, ``np.bincount`` degree
+statistics, and an exact vectorized dedup over each trace's sorted
+unique-op row.
 
 Semantics are kept value-identical to the reference matrices:
 * ``p_ss[child, parent] = 1/outdeg_with_dups(parent)`` — duplicate
@@ -14,12 +16,17 @@ Semantics are kept value-identical to the reference matrices:
 * ``p_sr[op, trace] = 1/len_with_dups(trace)`` (pagerank.py:42-45);
 * ``p_rs[trace, op] = 1/cov_with_dups(op)`` (pagerank.py:48-52);
 * trace kinds: two traces are one kind iff their p_sr columns are equal,
-  i.e. same unique-op set AND same span count (pagerank.py:54-66).
+  i.e. same unique-op set AND same span count (pagerank.py:54-66);
+* parent links resolve by ``ParentSpanId == spanID`` within the partition
+  (preprocess_data.py:157-158). One deliberate deviation: a span with a
+  duplicated spanID matches once (positional lookup), where the
+  reference's pandas merge would produce a cartesian blow-up — span ids
+  are unique in OTel data.
 """
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, Iterable, List, Sequence, Tuple
+from typing import FrozenSet, Iterable, List, Tuple
 
 import numpy as np
 import pandas as pd
@@ -52,8 +59,9 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 
 # Above this many matrix cells the exact padded-row dedup switches to
 # 128-bit set hashing (collision odds ~T^2 / 2^128 — negligible on
-# non-adversarial data, and the parity suite would catch one).
-_DENSE_KIND_BUDGET = 50_000_000
+# non-adversarial data, and the parity suite would catch one). The padded
+# row matrix is sorted row-wise by np.unique, so keep it small.
+_DENSE_KIND_BUDGET = 1_000_000
 
 
 def _trace_kinds(
@@ -113,27 +121,26 @@ def _trace_kinds(
     return kind
 
 
-def build_partition_graph(
-    op_codes: np.ndarray,
-    trace_names: pd.Series,
-    span_ids: pd.Series,
-    parent_span_ids: pd.Series,
+def _build_partition(
+    op_codes: np.ndarray,       # int64 window-vocab op id per partition span
+    g_trace: np.ndarray,        # int64 window-global trace id per span
+    child_op: np.ndarray,       # int64 call-edge child op (instances)
+    parent_op: np.ndarray,      # int64 call-edge parent op (instances)
     vocab_size: int,
     v_pad: int,
-    pad_policy: str = "pow2",
-    min_pad: int = 8,
-) -> Tuple[PartitionGraph, List]:
-    """Build one partition's padded graph.
+    pad_policy: str,
+    min_pad: int,
+) -> Tuple[PartitionGraph, np.ndarray]:
+    """Build one partition's padded graph from pure int arrays.
 
-    ``op_codes`` are window-vocab int32 ids (pod-level naming) for each span
-    in the partition; ``trace_names``/``span_ids``/``parent_span_ids`` are
-    the corresponding span columns. Returns the graph plus the local
-    trace-id list (local index -> original trace id).
+    Returns (graph, global_trace_ids) where ``global_trace_ids[i]`` is the
+    window-global trace id of partition-local trace i.
     """
-    op_codes = np.asarray(op_codes, dtype=np.int64)
-    t_codes, t_uniques = pd.factorize(trace_names, use_na_sentinel=False)
+    # Local trace interning: np.unique gives sorted-by-global-id order
+    # (order is irrelevant downstream — results key on names).
+    local_uniques, t_codes = np.unique(g_trace, return_inverse=True)
     t_codes = t_codes.astype(np.int64)
-    n_traces = len(t_uniques)
+    n_traces = len(local_uniques)
     tracelen = np.bincount(t_codes, minlength=max(n_traces, 1)).astype(np.int64)
 
     # Unique (trace, op) incidence with value arrays for p_sr / p_rs.
@@ -148,24 +155,7 @@ def build_partition_graph(
     op_present = cov_unique > 0
     n_ops = int(op_present.sum())
 
-    # Call edges: join child.ParentSpanId == parent.spanID within the
-    # partition, duplicates kept (one row per call-edge instance), exactly
-    # like the reference's self-merge (preprocess_data.py:157-158).
-    frame = pd.DataFrame(
-        {
-            "spanID": np.asarray(span_ids),
-            "parent": np.asarray(parent_span_ids),
-            "op": op_codes,
-        }
-    )
-    merged = frame.merge(
-        frame[["spanID", "op"]].rename(columns={"op": "op_parent"}),
-        left_on="parent",
-        right_on="spanID",
-        suffixes=("", "_p"),
-    )
-    child_op = merged["op"].to_numpy(dtype=np.int64)
-    parent_op = merged["op_parent"].to_numpy(dtype=np.int64)
+    # Call edges: duplicates kept for the outdegree, unique pairs stored.
     outdeg_dup = np.bincount(parent_op, minlength=vocab_size).astype(np.int64)
     if len(child_op):
         ekey = np.unique(child_op * vocab_size + parent_op)
@@ -200,7 +190,7 @@ def build_partition_graph(
         n_inc=np.int32(len(u_op)),
         n_ss=np.int32(len(e_child)),
     )
-    return graph, list(t_uniques)
+    return graph, local_uniques
 
 
 def build_window_graph(
@@ -221,28 +211,57 @@ def build_window_graph(
     Returns (graph, op_names, normal_trace_ids, abnormal_trace_ids).
     """
     names = operation_names(span_df, "pod", strip_services)
-    codes, op_uniques = pd.factorize(names, use_na_sentinel=False)
+    op_codes, op_uniques = pd.factorize(names, use_na_sentinel=False)
+    op_codes = op_codes.astype(np.int64)
     vocab_size = len(op_uniques)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
-    codes = codes.astype(np.int64)
 
-    trace_col = span_df["traceID"]
+    tr_codes, tr_uniques = pd.factorize(
+        span_df["traceID"], use_na_sentinel=False
+    )
+    tr_codes = tr_codes.astype(np.int64)
+    tr_index = {t: i for i, t in enumerate(tr_uniques)}
+
+    # Span linkage, once for the window: factorize spanID and ParentSpanId
+    # through one shared vocabulary, then positional parent lookup.
+    n = len(span_df)
+    combined = np.concatenate(
+        [
+            span_df["spanID"].to_numpy(dtype=object),
+            span_df["ParentSpanId"].to_numpy(dtype=object),
+        ]
+    )
+    link_codes, link_uniques = pd.factorize(combined, use_na_sentinel=False)
+    sid = link_codes[:n].astype(np.int64)
+    pid = link_codes[n:].astype(np.int64)
+    pos = np.full(len(link_uniques), -1, dtype=np.int64)
+    pos[sid] = np.arange(n)
+    parent_row = pos[pid]  # -1 when the parent span is absent
+
     parts = []
     id_lists = []
     for ids in (normal_ids, abnormal_ids):
-        mask = trace_col.isin(set(ids)).to_numpy()
-        part, tlist = build_partition_graph(
-            codes[mask],
-            trace_col[mask],
-            span_df["spanID"][mask],
-            span_df["ParentSpanId"][mask],
+        codes = [tr_index[t] for t in ids if t in tr_index]
+        flags = np.zeros(len(tr_uniques) + 1, dtype=bool)
+        if codes:
+            flags[np.asarray(codes, dtype=np.int64)] = True
+        mask = flags[tr_codes]
+
+        edge_rows = np.flatnonzero(
+            mask & (parent_row >= 0) & flags[tr_codes[np.clip(parent_row, 0, None)]]
+        )
+        part, local_codes = _build_partition(
+            op_codes[mask],
+            tr_codes[mask],
+            op_codes[edge_rows],
+            op_codes[np.clip(parent_row[edge_rows], 0, None)],
             vocab_size,
             v_pad,
             pad_policy,
             min_pad,
         )
         parts.append(part)
-        id_lists.append(tlist)
+        id_lists.append([tr_uniques[c] for c in local_codes])
 
     graph = WindowGraph(normal=parts[0], abnormal=parts[1])
     return graph, list(op_uniques), id_lists[0], id_lists[1]

@@ -65,11 +65,35 @@ def preference_vector(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
     return jnp.where(live, pref, 0.0).astype(jnp.float32)
 
 
+def densify(g: PartitionGraph):
+    """Scatter the COO entries into the dense reference-shaped matrices
+    (pagerank.py:19-24) on device: [V, T] p_sr, [T, V] p_rs, [V, V] p_ss.
+
+    Entries are unique pairs so scatter-add equals overwrite; padding rows
+    carry value 0 and land harmlessly at index 0. Dense matvecs put the 25
+    iterations on the MXU — the fastest path whenever (2*V*T + V^2) floats
+    fit comfortably in HBM; the COO segment-sum path covers the rest.
+    """
+    v = g.cov_unique.shape[0]
+    t = g.kind.shape[0]
+    p_sr = jnp.zeros((v, t), jnp.float32).at[g.inc_op, g.inc_trace].add(
+        g.sr_val
+    )
+    p_rs = jnp.zeros((t, v), jnp.float32).at[g.inc_trace, g.inc_op].add(
+        g.rs_val
+    )
+    p_ss = jnp.zeros((v, v), jnp.float32).at[g.ss_child, g.ss_parent].add(
+        g.ss_val
+    )
+    return p_ss, p_sr, p_rs
+
+
 def partition_pagerank(
     g: PartitionGraph,
     anomaly: bool,
     cfg: PageRankConfig,
     psum_axis: str | None = None,
+    kernel: str = "coo",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Power-iterate one partition; returns (weight[V], score[V]).
 
@@ -101,20 +125,44 @@ def partition_pagerank(
     sv = jnp.where(g.op_present, 1.0 / n_total, 0.0).astype(jnp.float32)
     rv = jnp.where(trace_live, 1.0 / n_total, 0.0).astype(jnp.float32)
 
+    if kernel == "dense":
+        if psum_axis is not None:
+            raise ValueError(
+                "the dense kernel does not support entry-axis sharding; "
+                "use kernel='coo' under shard_map"
+            )
+        p_ss, p_sr, p_rs = densify(g)
+
+        def matvecs(sv, rv):
+            return (
+                jnp.dot(p_sr, rv) + alpha * jnp.dot(p_ss, sv),
+                jnp.dot(p_rs, sv),
+            )
+
+    elif kernel == "coo":
+
+        def matvecs(sv, rv):
+            return (
+                reduce_shards(
+                    coo_matvec(g.inc_op, g.inc_trace, g.sr_val, rv, v)
+                    + alpha
+                    * coo_matvec(g.ss_child, g.ss_parent, g.ss_val, sv, v)
+                ),
+                reduce_shards(
+                    coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
+                ),
+            )
+
+    else:
+        raise ValueError(f"unknown pagerank kernel {kernel!r}")
+
     def body(_, carry):
         sv, rv = carry
-        # p_sr @ rv  +  alpha * p_ss @ sv   (pagerank.py:122-124)
-        sv_new = d * reduce_shards(
-            coo_matvec(g.inc_op, g.inc_trace, g.sr_val, rv, v)
-            + alpha * coo_matvec(g.ss_child, g.ss_parent, g.ss_val, sv, v)
-        )
-        # p_rs @ sv + (1-d) * pref          (pagerank.py:125)
-        rv_new = (
-            d * reduce_shards(
-                coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
-            )
-            + (1.0 - d) * pref
-        )
+        # sv' = d*(p_sr @ rv + alpha * p_ss @ sv)    (pagerank.py:122-124)
+        # rv' = d*(p_rs @ sv) + (1-d) * pref         (pagerank.py:125)
+        mv_s, mv_r = matvecs(sv, rv)
+        sv_new = d * mv_s
+        rv_new = d * mv_r + (1.0 - d) * pref
         if cfg.max_normalize_each_iter:
             sv_new = sv_new / jnp.max(sv_new)
             rv_new = rv_new / jnp.max(rv_new)
@@ -168,6 +216,7 @@ def rank_window_core(
     pagerank_cfg: PageRankConfig,
     spectrum_cfg: SpectrumConfig,
     psum_axis: str | None = None,
+    kernel: str = "coo",
 ):
     """The full single-window ranking: both partitions' power iterations,
     spectrum, top-k. Pure traced function — jit it (single device), vmap
@@ -179,10 +228,10 @@ def rank_window_core(
     entries beyond ``n_valid`` are padding (score -inf).
     """
     n_weight, _ = partition_pagerank(
-        graph.normal, False, pagerank_cfg, psum_axis
+        graph.normal, False, pagerank_cfg, psum_axis, kernel
     )
     a_weight, _ = partition_pagerank(
-        graph.abnormal, True, pagerank_cfg, psum_axis
+        graph.abnormal, True, pagerank_cfg, psum_axis, kernel
     )
     scores, valid = window_spectrum(
         a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
@@ -193,7 +242,18 @@ def rank_window_core(
     return top_idx.astype(jnp.int32), top_scores, n_valid
 
 
-rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3))
+rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
+
+
+def choose_kernel(graph: WindowGraph, budget_bytes: int) -> str:
+    """auto kernel policy: dense (MXU matmuls) when both partitions'
+    scattered matrices fit the budget, COO segment-sums otherwise."""
+    total = 0
+    for g in (graph.normal, graph.abnormal):
+        v = int(g.cov_unique.shape[0])
+        t = int(g.kind.shape[0])
+        total += (2 * v * t + v * v) * 4
+    return "dense" if total <= budget_bytes else "coo"
 
 
 class JaxBackend:
@@ -226,10 +286,15 @@ class JaxBackend:
             pad_policy=rt.pad_policy,
             min_pad=rt.min_pad,
         )
+        kernel = rt.kernel
+        if kernel == "auto":
+            kernel = choose_kernel(graph, rt.dense_budget_bytes)
         top_idx, top_scores, n_valid = rank_window_device(
             jax.tree.map(jnp.asarray, graph),
             self.config.pagerank,
             self.config.spectrum,
+            None,
+            kernel,
         )
         n = int(n_valid)
         idx = [int(i) for i in top_idx[:n]]

@@ -42,6 +42,10 @@ class SyntheticConfig:
     seed: int = 0
 
 
+def _op_id_width(n_operations: int) -> int:
+    return max(3, len(str(max(n_operations - 1, 0))))
+
+
 @dataclass
 class Topology:
     parent: np.ndarray          # int [n_ops], parent[0] = -1
@@ -147,8 +151,11 @@ def _render_spans(
         ),
         "",
     )
-    svc = np.char.add("svc", np.char.zfill(op_str, 3))
-    opname = np.char.add("op", np.char.zfill(op_str, 3))
+    # np.char.zfill allocates exactly `width` chars and TRUNCATES longer
+    # ids, so the width must cover the largest op id.
+    width = _op_id_width(cfg.n_operations)
+    svc = np.char.add("svc", np.char.zfill(op_str, width))
+    opname = np.char.add("op", np.char.zfill(op_str, width))
     pod = np.char.add(np.char.add(svc, "-"), pod_rows.astype(np.str_))
 
     start_us = start_offsets_us[trace_rows]
@@ -222,12 +229,13 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     abnormal = _render_spans(
         topo, cfg, rng, cfg.n_traces, t1, fault_op, fault_pod, "a"
     )
-    svc = f"svc{fault_op:03d}"
+    w = _op_id_width(cfg.n_operations)
+    svc = f"svc{fault_op:0{w}d}"
     return SyntheticCase(
         normal=normal,
         abnormal=abnormal,
-        fault_service_op=f"{svc}_op{fault_op:03d}",
-        fault_pod_op=f"{svc}-{fault_pod}_op{fault_op:03d}",
+        fault_service_op=f"{svc}_op{fault_op:0{w}d}",
+        fault_pod_op=f"{svc}-{fault_pod}_op{fault_op:0{w}d}",
         fault_op=fault_op,
         fault_pod=fault_pod,
         topology=topo,

@@ -102,3 +102,24 @@ def test_top1_is_injected_fault_across_seeds():
         hits_top1 += top[0] == case.fault_pod_op
     assert total >= 3
     assert hits_top1 >= total - 1
+
+
+def test_dense_kernel_matches_coo(small_case):
+    # The MXU dense path and the COO segment-sum path are the same math.
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(small_case.abnormal, nrm, abn)
+    dg = jax.tree.map(jnp.asarray, graph)
+    ti_c, ts_c, _ = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "coo")
+    ti_d, ts_d, _ = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "dense")
+    np.testing.assert_array_equal(np.asarray(ti_c), np.asarray(ti_d))
+    fin = np.isfinite(np.asarray(ts_c))
+    np.testing.assert_allclose(
+        np.asarray(ts_c)[fin], np.asarray(ts_d)[fin], rtol=1e-4
+    )

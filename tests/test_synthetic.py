@@ -46,3 +46,15 @@ def test_determinism():
     assert a.normal.equals(b.normal)
     assert a.abnormal.equals(b.abnormal)
     assert a.fault_pod_op == b.fault_pod_op
+
+
+def test_large_op_ids_do_not_collide():
+    # Regression: np.char.zfill truncates ids wider than its width arg,
+    # collapsing ops >= 1000 into shared names at 5k-op scale.
+    cfg = SyntheticConfig(n_operations=1500, n_kinds=40, n_traces=60, seed=0)
+    case = generate_case(cfg)
+    svc_ids = {int(s[3:]) for s in case.abnormal["serviceName"].unique()}
+    assert max(svc_ids) >= 1000
+    assert case.fault_op in svc_ids
+    svc = f"svc{case.fault_op:04d}"
+    assert (case.abnormal["serviceName"] == svc).any()
