@@ -13,9 +13,14 @@ Prints ONE JSON line:
   subsample keeps the baseline measurable — the ratio therefore
   *understates* the real speedup at full scale).
 
+Pipeline benched is the native lane: C++ mmap ingest (interned arrays) ->
+int-only window build -> jitted rank. Synthetic chaos-case CSVs are
+generated once and cached under bench_data/.
+
 Config via env: BENCH_SPANS (default 1_000_000), BENCH_OPS (5000),
-BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000). Details go to stderr;
-stdout carries only the JSON line.
+BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000), BENCH_KERNEL
+(auto|coo|dense), BENCH_FAULT_MS (60000). Details go to stderr; stdout
+carries only the JSON line.
 
 Reference baseline context: the reference's PageRank Scorer takes 5.5 s
 per window of ~1e2 ops / 1e2-1e3 traces on a CPU core (paper Table 7;
@@ -29,37 +34,25 @@ import json
 import os
 import sys
 import time
+from pathlib import Path
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    spans_target = int(os.environ.get("BENCH_SPANS", 1_000_000))
-    n_ops = int(os.environ.get("BENCH_OPS", 5000))
-    repeats = int(os.environ.get("BENCH_REPEATS", 5))
-    oracle_spans = int(os.environ.get("BENCH_ORACLE_SPANS", 20_000))
-    # Expected-duration margins grow with trace depth (sum of inclusive
-    # span SLOs), so the injected latency must scale with topology size.
-    fault_ms = float(os.environ.get("BENCH_FAULT_MS", 60_000.0))
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from microrank_tpu.config import MicroRankConfig
-    from microrank_tpu.detect import compute_slo, detect_numpy
-    from microrank_tpu.graph import build_detect_batch, build_window_graph
-    from microrank_tpu.rank_backends import NumpyRefBackend
-    from microrank_tpu.rank_backends.jax_tpu import (
-        choose_kernel,
-        rank_window_device,
+def _ensure_data(spans_target, n_ops, fault_ms):
+    """Generate (or reuse) the cached chaos-case CSV pair."""
+    root = Path(__file__).parent / "bench_data"
+    case_dir = root / f"s{spans_target}_o{n_ops}_f{int(fault_ms)}"
+    truth_path = case_dir / "ground_truth.json"
+    if truth_path.exists():
+        truth = json.loads(truth_path.read_text())
+        return case_dir, truth
+    from microrank_tpu.testing import (
+        SyntheticConfig,
+        generate_case_with_spans,
     )
-    from microrank_tpu.testing import SyntheticConfig, generate_case_with_spans
-
-    log(f"devices: {jax.devices()}")
-    cfg = MicroRankConfig()
 
     t0 = time.perf_counter()
     case = generate_case_with_spans(
@@ -72,41 +65,90 @@ def main() -> int:
         ),
         target_spans=spans_target,
     )
-    n_spans = len(case.abnormal)
+    case_dir.mkdir(parents=True, exist_ok=True)
+    case.normal.to_csv(case_dir / "normal.csv", index=False)
+    case.abnormal.to_csv(case_dir / "abnormal.csv", index=False)
+    truth = {
+        "fault_pod_op": case.fault_pod_op,
+        "n_abnormal_spans": len(case.abnormal),
+    }
+    truth_path.write_text(json.dumps(truth))
     log(
-        f"generated case in {time.perf_counter() - t0:.1f}s: "
-        f"{n_spans} abnormal spans, {case.abnormal['traceID'].nunique()} traces, "
-        f"{n_ops} operations"
+        f"generated + cached case in {time.perf_counter() - t0:.1f}s "
+        f"({len(case.abnormal)} abnormal spans) -> {case_dir}"
+    )
+    return case_dir, truth
+
+
+def main() -> int:
+    spans_target = int(os.environ.get("BENCH_SPANS", 1_000_000))
+    n_ops = int(os.environ.get("BENCH_OPS", 5000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    oracle_spans = int(os.environ.get("BENCH_ORACLE_SPANS", 20_000))
+    fault_ms = float(os.environ.get("BENCH_FAULT_MS", 60_000.0))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.detect import detect_numpy
+    from microrank_tpu.graph.table_ops import (
+        build_window_graph_from_table,
+        compute_slo_from_table,
+        detect_batch_from_table,
+    )
+    from microrank_tpu.native import load_span_table, native_available
+    from microrank_tpu.rank_backends import NumpyRefBackend
+    from microrank_tpu.rank_backends.jax_tpu import (
+        JaxBackend,
+        choose_kernel,
+        rank_window_device,
     )
 
-    # Detect + partition (host; not part of the timed rank path, matching
-    # the reference's Table 7 which times the PageRank Scorer stage).
+    log(f"devices: {jax.devices()}")
+    if not native_available():
+        log("FATAL: native span loader unavailable (g++ missing?)")
+        return 1
+    cfg = MicroRankConfig()
+    case_dir, truth = _ensure_data(spans_target, n_ops, fault_ms)
+
+    # --- ingest (native lane) ------------------------------------------
     t0 = time.perf_counter()
-    vocab, baseline = compute_slo(case.normal)
-    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
-    res = detect_numpy(batch, baseline, cfg.detector)
-    trace_arr = np.asarray(trace_ids)
-    abn = trace_arr[res.abnormal[: len(trace_arr)]].tolist()
-    nrm_mask = res.valid[: len(trace_arr)] & ~res.abnormal[: len(trace_arr)]
-    nrm = trace_arr[nrm_mask].tolist()
+    normal_table = load_span_table(case_dir / "normal.csv")
+    abnormal_table = load_span_table(case_dir / "abnormal.csv")
+    ingest_s = time.perf_counter() - t0
+    n_spans = abnormal_table.n_spans
+    log(
+        f"native ingest: {ingest_s:.2f}s for "
+        f"{normal_table.n_spans + n_spans} spans"
+    )
+
+    # --- detect + partition (host) -------------------------------------
+    t0 = time.perf_counter()
+    slo_vocab, baseline = compute_slo_from_table(normal_table)
+    mask = np.ones(n_spans, dtype=bool)
+    batch, trace_codes = detect_batch_from_table(
+        abnormal_table, mask, slo_vocab
+    )
+    det = detect_numpy(batch, baseline, cfg.detector)
+    t = len(trace_codes)
+    abn = trace_codes[det.abnormal[:t]]
+    nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
     detect_s = time.perf_counter() - t0
     log(
         f"detect+partition: {detect_s:.2f}s "
         f"({len(nrm)} normal / {len(abn)} abnormal traces)"
     )
-    if not (nrm and abn):
+    if not (len(nrm) and len(abn)):
         log("FATAL: window did not partition; tune the generator")
         return 1
 
     # --- timed device path: graph build (host) + rank (device) ---------
     def build():
-        return build_window_graph(case.abnormal, nrm, abn)
+        return build_window_graph_from_table(abnormal_table, mask, nrm, abn)
 
-    t0 = time.perf_counter()
     graph, op_names, _, _ = build()
-    build_s = time.perf_counter() - t0
-    log(f"graph build (host, cold): {build_s:.2f}s")
-
     kernel = os.environ.get("BENCH_KERNEL", "auto")
     if kernel == "auto":
         kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
@@ -141,30 +183,32 @@ def main() -> int:
     spans_per_sec = n_spans / total_s
     top_idx, top_scores, n_valid = out
     jax_top1 = op_names[int(np.asarray(top_idx)[0])]
+    fault_hit = jax_top1 == truth["fault_pod_op"]
     log(
         f"device path: build {build_s * 1e3:.0f}ms + rank {rank_s * 1e3:.0f}ms "
         f"= {total_s * 1e3:.0f}ms -> {spans_per_sec:,.0f} spans/s; "
-        f"top-1 {jax_top1} (fault {case.fault_pod_op})"
+        f"top-1 {jax_top1} (fault {truth['fault_pod_op']}, hit={fault_hit})"
     )
 
-    # --- oracle baseline on a subsample --------------------------------
-    sub_traces = []
-    count = 0
-    per_trace = max(1, n_spans // max(len(trace_arr), 1))
-    for t in nrm + abn:
-        sub_traces.append(t)
-        count += per_trace
-        if count >= oracle_spans:
-            break
-    sub_set = set(sub_traces)
-    sub_df = case.abnormal[case.abnormal["traceID"].isin(sub_set)]
-    sub_nrm = [t for t in nrm if t in sub_set]
-    sub_abn = [t for t in abn if t in sub_set]
-    if not sub_abn:
-        sub_abn = abn[:2]
-        sub_df = case.abnormal[
-            case.abnormal["traceID"].isin(sub_set | set(sub_abn))
-        ]
+    # --- oracle baseline on a subsample (pandas lane, untimed load) ----
+    import pandas as pd
+
+    sub_df = pd.read_csv(case_dir / "abnormal.csv")
+    per_trace = max(1, n_spans // max(t, 1))
+    n_take = max(2, oracle_spans // per_trace)
+    keep = set(
+        [abnormal_table.trace_names[c] for c in nrm[: max(2, n_take // 2)]]
+        + [abnormal_table.trace_names[c] for c in abn[: max(2, n_take // 2)]]
+    )
+    sub_df = sub_df[sub_df["traceID"].isin(keep)]
+    sub_nrm = [
+        abnormal_table.trace_names[c]
+        for c in nrm[: max(2, n_take // 2)]
+    ]
+    sub_abn = [
+        abnormal_table.trace_names[c]
+        for c in abn[: max(2, n_take // 2)]
+    ]
     n_sub = len(sub_df)
     oracle = NumpyRefBackend(cfg)
     t0 = time.perf_counter()
@@ -175,9 +219,6 @@ def main() -> int:
         f"numpy oracle on {n_sub}-span subsample: {oracle_s:.2f}s "
         f"-> {oracle_sps:,.0f} spans/s"
     )
-
-    # Parity on the subsample through the device backend.
-    from microrank_tpu.rank_backends.jax_tpu import JaxBackend
 
     top_j, _ = JaxBackend(cfg).rank_window(sub_df, sub_nrm, sub_abn)
     parity = top_o[0] == top_j[0]

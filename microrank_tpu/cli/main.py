@@ -79,20 +79,39 @@ def _config_from_args(args) -> "MicroRankConfig":
 
 
 def cmd_run(args) -> int:
-    from ..io import load_traces_csv
-    from ..pipeline import OnlineRCA
     from ..utils.logging import get_logger
 
     log = get_logger("microrank_tpu.cli")
     cfg = _config_from_args(args)
-    normal = load_traces_csv(args.normal)
-    abnormal = load_traces_csv(args.abnormal)
-    log.info(
-        "loaded %d normal spans, %d abnormal spans", len(normal), len(abnormal)
-    )
-    rca = OnlineRCA(cfg)
-    rca.fit_baseline(normal, cache_path=args.slo_cache)
-    results = rca.run(abnormal, out_dir=args.output, resume=args.resume)
+
+    engine = args.engine
+    if engine == "auto":
+        from ..native import native_available
+
+        engine = "native" if native_available() else "pandas"
+    log.info("ingest engine: %s", engine)
+
+    if engine == "native":
+        from ..native import load_span_table
+        from ..pipeline import TableRCA
+
+        rca = TableRCA(cfg)
+        rca.fit_baseline(load_span_table(args.normal))
+        results = rca.run(load_span_table(args.abnormal), out_dir=args.output)
+    else:
+        from ..io import load_traces_csv
+        from ..pipeline import OnlineRCA
+
+        normal = load_traces_csv(args.normal)
+        abnormal = load_traces_csv(args.abnormal)
+        log.info(
+            "loaded %d normal spans, %d abnormal spans",
+            len(normal),
+            len(abnormal),
+        )
+        rca = OnlineRCA(cfg)
+        rca.fit_baseline(normal, cache_path=args.slo_cache)
+        results = rca.run(abnormal, out_dir=args.output, resume=args.resume)
     n_anom = sum(r.anomaly for r in results)
     log.info(
         "processed %d windows, %d anomalous; results in %s",
@@ -164,6 +183,12 @@ def main(argv=None) -> int:
     p_run.add_argument("--slo-cache", help="npz path to cache the SLO baseline")
     p_run.add_argument(
         "--resume", action="store_true", help="resume from the window cursor"
+    )
+    p_run.add_argument(
+        "--engine",
+        default="auto",
+        choices=["auto", "native", "pandas"],
+        help="ingest engine: the C++ span loader or the pandas path",
     )
     _add_config_flags(p_run)
     p_run.set_defaults(fn=cmd_run)

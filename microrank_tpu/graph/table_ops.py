@@ -1,0 +1,140 @@
+"""Array-native window building from a SpanTable (the native-ingest lane).
+
+The pandas lane interns strings per window (build.py); here the native
+loader (microrank_tpu.native) already interned everything at load time, so
+window slicing, detection batching, and graph building are pure integer
+array ops — no strings anywhere past ingest. The PageRank op vocab is the
+table's pod_op vocabulary, shared across every window of the table (which
+also makes batched multi-window stacking vocab-stable).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+import numpy as np
+
+from ..io.interning import Vocab
+from .build import _build_partition
+from .structures import DetectBatch, SloBaseline, WindowGraph, pad1d, pad_to
+
+
+def compute_slo_from_table(table) -> Tuple[Vocab, SloBaseline]:
+    """SLO baseline from a (normal-period) SpanTable — one bincount pass.
+
+    Same semantics as detect.compute_slo (population std, ms, 4 decimals;
+    reference preprocess_data.py:50-78).
+    """
+    n_ops = len(table.svc_op_names)
+    dur = table.duration_us.astype(np.float64)
+    counts = np.bincount(table.svc_op, minlength=n_ops).astype(np.float64)
+    counts = np.maximum(counts, 1.0)
+    s1 = np.bincount(table.svc_op, weights=dur, minlength=n_ops)
+    mean = s1 / counts
+    # Two-pass variance for numerical agreement with np.std.
+    centered = dur - mean[table.svc_op]
+    s2 = np.bincount(table.svc_op, weights=centered * centered, minlength=n_ops)
+    std = np.sqrt(s2 / counts)
+    baseline = SloBaseline(
+        mean_ms=np.round(mean / 1000.0, 4).astype(np.float32),
+        std_ms=np.round(std / 1000.0, 4).astype(np.float32),
+    )
+    return Vocab(table.svc_op_names), baseline
+
+
+def window_rows(table, start_us: int, end_us: int) -> np.ndarray:
+    """Row mask for one detection window (get_span semantics:
+    startTime >= start AND endTime <= end, preprocess_data.py:10-14)."""
+    return (table.start_us >= start_us) & (table.end_us <= end_us)
+
+
+def detect_batch_from_table(
+    table,
+    mask: np.ndarray,
+    slo_vocab: Vocab,
+    pad_policy: str = "pow2",
+    min_pad: int = 8,
+) -> Tuple[DetectBatch, np.ndarray]:
+    """DetectBatch for the masked window rows.
+
+    Returns (batch, trace_codes) where trace_codes[i] is the table-global
+    trace id of window-local trace i. The table's svc-op ids are remapped
+    into the SLO vocab (unseen -> -1, the reference's bare-except rule).
+    """
+    rows = np.flatnonzero(mask)
+    remap = slo_vocab.encode(table.svc_op_names)
+    op = remap[table.svc_op[rows]]
+    g_trace = table.trace_id[rows]
+    uniques, t_codes = np.unique(g_trace, return_inverse=True)
+    n_spans = len(rows)
+    s_pad = pad_to(n_spans, pad_policy, min_pad)
+    batch = DetectBatch(
+        op=pad1d(op.astype(np.int32), s_pad, fill=-1),
+        trace=pad1d(t_codes.astype(np.int32), s_pad),
+        duration_us=pad1d(
+            table.duration_us[rows].astype(np.float32), s_pad
+        ),
+        n_spans=np.int32(n_spans),
+        n_traces=np.int32(len(uniques)),
+    )
+    return batch, uniques
+
+
+def build_window_graph_from_table(
+    table,
+    mask: np.ndarray,
+    normal_trace_codes: Iterable[int],
+    abnormal_trace_codes: Iterable[int],
+    pad_policy: str = "pow2",
+    min_pad: int = 8,
+) -> Tuple[WindowGraph, List[str], np.ndarray, np.ndarray]:
+    """Both partitions' graphs from table rows — ints end to end.
+
+    The op vocab is the table's pod_op vocabulary (stable across windows).
+    Returns (graph, op_names, normal_codes, abnormal_codes).
+    """
+    vocab_size = len(table.pod_op_names)
+    v_pad = pad_to(vocab_size, pad_policy, min_pad)
+    rows = np.flatnonzero(mask)
+    op_codes = table.pod_op[rows].astype(np.int64)
+    g_trace = table.trace_id[rows].astype(np.int64)
+
+    # Parent linkage restricted to the window: map table-row -> window-pos.
+    pos_in_window = np.full(table.n_spans, -1, dtype=np.int64)
+    pos_in_window[rows] = np.arange(len(rows))
+    parent = table.parent_row[rows]
+    parent_pos = np.where(
+        parent >= 0, pos_in_window[np.clip(parent, 0, None)], -1
+    )
+
+    n_total_traces = len(table.trace_names)
+    parts = []
+    code_arrays = []
+    for codes in (normal_trace_codes, abnormal_trace_codes):
+        codes = np.asarray(list(codes), dtype=np.int64)
+        flags = np.zeros(n_total_traces, dtype=bool)
+        if len(codes):
+            flags[codes] = True
+        pmask = flags[g_trace]
+        # Call edges: child in partition AND parent span in the window AND
+        # parent's trace in the partition (preprocess_data.py:157-158).
+        edge_child = np.flatnonzero(
+            pmask
+            & (parent_pos >= 0)
+            & flags[g_trace[np.clip(parent_pos, 0, None)]]
+        )
+        part, local = _build_partition(
+            op_codes[pmask],
+            g_trace[pmask],
+            op_codes[edge_child],
+            op_codes[np.clip(parent_pos[edge_child], 0, None)],
+            vocab_size,
+            v_pad,
+            pad_policy,
+            min_pad,
+        )
+        parts.append(part)
+        code_arrays.append(local)
+
+    graph = WindowGraph(normal=parts[0], abnormal=parts[1])
+    return graph, list(table.pod_op_names), code_arrays[0], code_arrays[1]

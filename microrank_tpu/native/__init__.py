@@ -1,0 +1,175 @@
+"""Native (C++) ingest runtime with a ctypes binding.
+
+Builds ``libmrspan.so`` from span_loader.cpp on first use (g++ -O3; cached
+next to the source) and exposes ``load_span_table(path)`` returning a
+``SpanTable`` of interned numpy arrays. Falls back cleanly: callers should
+catch ``NativeUnavailable`` and use the pandas path
+(microrank_tpu.io.load_traces_csv).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "span_loader.cpp"
+_LIB = Path(__file__).parent / "libmrspan.so"
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+class SpanTable(NamedTuple):
+    """One CSV dump, fully interned: the native ingest output.
+
+    Times are epoch microseconds (trace-level start/end, as in the CSV
+    contract); ``parent_row`` is the row index of each span's parent
+    (-1 when absent) — the span linkage of preprocess_data.py:157-158
+    resolved at load time.
+    """
+
+    trace_id: np.ndarray     # int32[S]
+    svc_op: np.ndarray       # int32[S] service-level op (detector vocab)
+    pod_op: np.ndarray       # int32[S] instance-level op (PageRank vocab)
+    duration_us: np.ndarray  # int64[S]
+    start_us: np.ndarray     # int64[S]
+    end_us: np.ndarray       # int64[S]
+    parent_row: np.ndarray   # int64[S]
+    trace_names: List[str]
+    svc_op_names: List[str]
+    pod_op_names: List[str]
+
+    @property
+    def n_spans(self) -> int:
+        return int(self.trace_id.shape[0])
+
+
+class _MrSpanTable(ctypes.Structure):
+    _fields_ = [
+        ("n_spans", ctypes.c_int64),
+        ("trace_id", ctypes.POINTER(ctypes.c_int32)),
+        ("svc_op", ctypes.POINTER(ctypes.c_int32)),
+        ("pod_op", ctypes.POINTER(ctypes.c_int32)),
+        ("duration_us", ctypes.POINTER(ctypes.c_int64)),
+        ("start_us", ctypes.POINTER(ctypes.c_int64)),
+        ("end_us", ctypes.POINTER(ctypes.c_int64)),
+        ("parent_row", ctypes.POINTER(ctypes.c_int64)),
+        ("trace_blob", ctypes.c_char_p),
+        ("trace_offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("n_traces", ctypes.c_int64),
+        ("svc_blob", ctypes.c_char_p),
+        ("svc_offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("n_svc_ops", ctypes.c_int64),
+        ("pod_blob", ctypes.c_char_p),
+        ("pod_offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("n_pod_ops", ctypes.c_int64),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+def _build_library() -> None:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=300
+        )
+    except FileNotFoundError as exc:
+        raise NativeUnavailable("g++ not available") from exc
+    except subprocess.CalledProcessError as exc:
+        raise NativeUnavailable(
+            f"native build failed:\n{exc.stderr}"
+        ) from exc
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        _build_library()
+    lib = ctypes.CDLL(str(_LIB))
+    lib.mr_load_csv.restype = ctypes.POINTER(_MrSpanTable)
+    lib.mr_load_csv.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.mr_free_table.restype = None
+    lib.mr_free_table.argtypes = [ctypes.POINTER(_MrSpanTable)]
+    _lib = lib
+    return lib
+
+
+def _decode_vocab(blob: bytes, offsets, n: int) -> List[str]:
+    offs = np.ctypeslib.as_array(offsets, shape=(n + 1,))
+    return [
+        blob[offs[i]: offs[i + 1]].decode("utf-8", "replace")
+        for i in range(n)
+    ]
+
+
+def native_available() -> bool:
+    try:
+        _load_library()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def load_span_table(
+    path, strip_services=("ts-ui-dashboard",)
+) -> SpanTable:
+    """Load one traces.csv (raw ClickHouse export or canonical schema)."""
+    lib = _load_library()
+    res = lib.mr_load_csv(
+        str(path).encode(), ",".join(strip_services).encode()
+    )
+    try:
+        t = res.contents
+        if t.error:
+            raise ValueError(
+                f"native loader failed for {path}: {t.error.decode()}"
+            )
+        n = int(t.n_spans)
+
+        def arr(ptr, dtype):
+            if n == 0:
+                return np.zeros(0, dtype=dtype)
+            return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+        # blob pointers: ctypes c_char_p auto-converts to bytes
+        table = SpanTable(
+            trace_id=arr(t.trace_id, np.int32),
+            svc_op=arr(t.svc_op, np.int32),
+            pod_op=arr(t.pod_op, np.int32),
+            duration_us=arr(t.duration_us, np.int64),
+            start_us=arr(t.start_us, np.int64),
+            end_us=arr(t.end_us, np.int64),
+            parent_row=arr(t.parent_row, np.int64),
+            trace_names=_decode_vocab(
+                t.trace_blob, t.trace_offsets, int(t.n_traces)
+            ),
+            svc_op_names=_decode_vocab(
+                t.svc_blob, t.svc_offsets, int(t.n_svc_ops)
+            ),
+            pod_op_names=_decode_vocab(
+                t.pod_blob, t.pod_offsets, int(t.n_pod_ops)
+            ),
+        )
+        return table
+    finally:
+        lib.mr_free_table(res)
+
+
+__all__ = [
+    "SpanTable",
+    "NativeUnavailable",
+    "load_span_table",
+    "native_available",
+]

@@ -1,10 +1,13 @@
 from .checkpoint import WindowCursor, load_slo, save_slo
 from .results import ResultSink, WindowResult
 from .runner import OnlineRCA, run_rca
+from .table_runner import TableRCA, run_rca_native
 
 __all__ = [
     "OnlineRCA",
     "run_rca",
+    "TableRCA",
+    "run_rca_native",
     "ResultSink",
     "WindowResult",
     "WindowCursor",

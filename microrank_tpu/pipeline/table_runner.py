@@ -1,0 +1,154 @@
+"""The native-ingest fast lane: OnlineRCA over a SpanTable.
+
+Same orchestration semantics as runner.py (reference online_rca.py:155-216
+window arithmetic, guards, compat flags) but strings never appear past
+ingest: windowing is int64-µs comparisons, detection and graph build are
+integer array ops (graph/table_ops.py), ranking is the jitted device
+program. This is the path the benchmark and high-volume replays use.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MicroRankConfig
+from ..detect import detect_numpy
+from ..graph.table_ops import (
+    build_window_graph_from_table,
+    compute_slo_from_table,
+    detect_batch_from_table,
+    window_rows,
+)
+from ..rank_backends.jax_tpu import choose_kernel, rank_window_device
+from ..utils.logging import get_logger
+from ..utils.profiling import StageTimings
+from .results import ResultSink, WindowResult
+
+_US_PER_MIN = 60_000_000
+
+
+def _iso(us: int) -> str:
+    return str(np.datetime64(int(us), "us"))
+
+
+class TableRCA:
+    def __init__(self, config: MicroRankConfig = MicroRankConfig()):
+        self.config = config
+        self.log = get_logger("microrank_tpu.pipeline.table")
+        self.slo_vocab = None
+        self.baseline = None
+
+    def fit_baseline(self, normal_table) -> None:
+        self.slo_vocab, self.baseline = compute_slo_from_table(normal_table)
+        self.log.info(
+            "fitted SLO baseline (native lane): %d operations",
+            len(self.slo_vocab),
+        )
+
+    def rank_window(self, table, mask, nrm_codes, abn_codes):
+        """Rank one window given its row mask and trace-code partitions."""
+        cfg = self.config
+        graph, op_names, _, _ = build_window_graph_from_table(
+            table,
+            mask,
+            nrm_codes,
+            abn_codes,
+            pad_policy=cfg.runtime.pad_policy,
+            min_pad=cfg.runtime.min_pad,
+        )
+        kernel = cfg.runtime.kernel
+        if kernel == "auto":
+            kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
+        top_idx, top_scores, n_valid = rank_window_device(
+            jax.tree.map(jnp.asarray, graph),
+            cfg.pagerank,
+            cfg.spectrum,
+            None,
+            kernel,
+        )
+        n = int(n_valid)
+        names = [op_names[int(i)] for i in np.asarray(top_idx)[:n]]
+        scores = [float(s) for s in np.asarray(top_scores)[:n]]
+        return names, scores
+
+    def run(
+        self,
+        table,
+        out_dir=None,
+        sink: Optional[ResultSink] = None,
+    ) -> List[WindowResult]:
+        cfg = self.config
+        if self.baseline is None:
+            raise RuntimeError("call fit_baseline() before run()")
+        if sink is None and out_dir is not None:
+            sink = ResultSink(
+                out_dir, overwrite_csv=cfg.compat.overwrite_results
+            )
+        if table.n_spans == 0:
+            return []
+
+        detect_us = int(cfg.window.detect_minutes * _US_PER_MIN)
+        skip_us = int(cfg.window.skip_minutes * _US_PER_MIN)
+        current = int(table.start_us.min())
+        end = int(table.end_us.max())
+
+        results: List[WindowResult] = []
+        while current < end:
+            w0, w1 = current, current + detect_us
+            timings = StageTimings()
+            result = WindowResult(start=_iso(w0), end=_iso(w1), anomaly=False)
+
+            mask = window_rows(table, w0, w1)
+            if not mask.any():
+                result.skipped_reason = "empty_window"
+            else:
+                with timings.stage("detect"):
+                    batch, trace_codes = detect_batch_from_table(
+                        table, mask, self.slo_vocab,
+                        cfg.runtime.pad_policy, cfg.runtime.min_pad,
+                    )
+                    det = detect_numpy(batch, self.baseline, cfg.detector)
+                t = len(trace_codes)
+                abn = trace_codes[det.abnormal[:t]]
+                nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
+                result.anomaly = bool(det.flag)
+                result.n_normal, result.n_abnormal = len(nrm), len(abn)
+                result.n_traces = len(nrm) + len(abn)
+                if result.anomaly and (len(nrm) == 0 or len(abn) == 0):
+                    result.skipped_reason = "degenerate_partition"
+                elif result.anomaly:
+                    if cfg.compat.partition_swap:
+                        nrm, abn = abn, nrm
+                    with timings.stage("rank"):
+                        names, scores = self.rank_window(
+                            table, mask, nrm, abn
+                        )
+                    result.ranking = list(zip(names, scores))
+
+            result.timings = timings.as_dict()
+            results.append(result)
+            if sink is not None:
+                sink.emit(result)
+            if result.anomaly and result.ranking:
+                current += skip_us
+            current += detect_us
+        return results
+
+
+def run_rca_native(
+    normal_path,
+    abnormal_path,
+    config: MicroRankConfig = MicroRankConfig(),
+    out_dir=None,
+) -> List[WindowResult]:
+    """Native-lane equivalent of pipeline.run_rca: CSV paths in,
+    window results out, no pandas anywhere."""
+    from ..native import load_span_table
+
+    rca = TableRCA(config)
+    rca.fit_baseline(load_span_table(normal_path))
+    return rca.run(load_span_table(abnormal_path), out_dir=out_dir)

@@ -1,0 +1,136 @@
+"""Native C++ span loader + table lane: equivalence with the pandas lane."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.io import load_traces_csv
+from microrank_tpu.io.naming import operation_names
+from microrank_tpu.pipeline import run_rca, run_rca_native
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+native = pytest.importorskip("microrank_tpu.native")
+if not native.native_available():
+    pytest.skip("g++ / native build unavailable", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def csv_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("native_csv")
+    case = generate_case(
+        SyntheticConfig(
+            n_operations=24, n_traces=200, seed=9, n_pods=2,
+            n_kinds=24, child_keep_prob=0.6,
+        )
+    )
+    case.normal.to_csv(d / "normal.csv", index=False)
+    case.abnormal.to_csv(d / "abnormal.csv", index=False)
+    return d, case
+
+
+def test_loader_matches_pandas(csv_pair):
+    d, case = csv_pair
+    tab = native.load_span_table(d / "abnormal.csv")
+    df = load_traces_csv(d / "abnormal.csv")
+    assert tab.n_spans == len(df)
+    assert [tab.trace_names[i] for i in tab.trace_id] == df["traceID"].tolist()
+    assert [tab.svc_op_names[i] for i in tab.svc_op] == operation_names(
+        df, "service"
+    ).tolist()
+    assert [tab.pod_op_names[i] for i in tab.pod_op] == operation_names(
+        df, "pod"
+    ).tolist()
+    np.testing.assert_array_equal(
+        tab.duration_us, df["duration"].to_numpy()
+    )
+    np.testing.assert_array_equal(
+        tab.start_us,
+        df["startTime"].astype("datetime64[us]").astype("int64").to_numpy(),
+    )
+    np.testing.assert_array_equal(
+        tab.end_us,
+        df["endTime"].astype("datetime64[us]").astype("int64").to_numpy(),
+    )
+    pos = {s: i for i, s in enumerate(df["spanID"])}
+    exp_parent = np.array(
+        [
+            pos.get(p, -1) if isinstance(p, str) and p else -1
+            for p in df["ParentSpanId"].fillna("")
+        ],
+        dtype=np.int64,
+    )
+    np.testing.assert_array_equal(tab.parent_row, exp_parent)
+
+
+def test_loader_clickhouse_header(csv_pair, tmp_path):
+    d, case = csv_pair
+    raw = case.abnormal.rename(
+        columns={
+            "traceID": "TraceId", "spanID": "SpanId",
+            "serviceName": "ServiceName", "operationName": "SpanName",
+            "podName": "PodName", "duration": "Duration",
+            "startTime": "TraceStart", "endTime": "TraceEnd",
+        }
+    )
+    raw.insert(0, "Timestamp", raw["TraceStart"])
+    raw["SpanKind"] = "Server"
+    raw.to_csv(tmp_path / "raw.csv", index=False)
+    tab = native.load_span_table(tmp_path / "raw.csv")
+    ref = native.load_span_table(d / "abnormal.csv")
+    assert tab.n_spans == ref.n_spans
+    np.testing.assert_array_equal(tab.trace_id, ref.trace_id)
+    np.testing.assert_array_equal(tab.pod_op, ref.pod_op)
+
+
+def test_loader_strip_rule(tmp_path, csv_pair):
+    _, case = csv_pair
+    df = case.abnormal.copy()
+    df.loc[df.index[:5], "serviceName"] = "ts-ui-dashboard"
+    df.loc[df.index[:5], "operationName"] = "GET /api/v1/item/123"
+    df.to_csv(tmp_path / "strip.csv", index=False)
+    tab = native.load_span_table(tmp_path / "strip.csv")
+    got = {tab.svc_op_names[i] for i in tab.svc_op[:5]}
+    assert got == {"ts-ui-dashboard_GET /api/v1/item"}
+
+
+def test_loader_quoted_fields(tmp_path):
+    (tmp_path / "q.csv").write_text(
+        "traceID,spanID,ParentSpanId,operationName,serviceName,podName,"
+        "duration,startTime,endTime\n"
+        '"t1","s1","","GET /a,b","svc ""x""","pod-1",1000,'
+        '"2025-02-14 12:00:00","2025-02-14 12:00:01"\n'
+    )
+    tab = native.load_span_table(tmp_path / "q.csv")
+    assert tab.n_spans == 1
+    assert tab.svc_op_names[tab.svc_op[0]] == 'svc "x"_GET /a,b'
+    assert tab.start_us[0] == np.datetime64("2025-02-14 12:00:00", "us").astype(
+        "int64"
+    )
+
+
+def test_loader_missing_file():
+    with pytest.raises(ValueError, match="cannot open"):
+        native.load_span_table("/nonexistent/traces.csv")
+
+
+def test_loader_bad_header(tmp_path):
+    (tmp_path / "bad.csv").write_text("a,b,c\n1,2,3\n")
+    with pytest.raises(ValueError, match="missing required columns"):
+        native.load_span_table(tmp_path / "bad.csv")
+
+
+def test_table_lane_matches_pandas_lane(csv_pair):
+    d, case = csv_pair
+    cfg = MicroRankConfig()
+    r_pandas = run_rca(
+        load_traces_csv(d / "normal.csv"),
+        load_traces_csv(d / "abnormal.csv"),
+        cfg,
+    )
+    r_native = run_rca_native(d / "normal.csv", d / "abnormal.csv", cfg)
+    a = next(r for r in r_pandas if r.ranking)
+    b = next(r for r in r_native if r.ranking)
+    assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking]
+    assert (a.n_normal, a.n_abnormal) == (b.n_normal, b.n_abnormal)
+    assert a.ranking[0][0] == case.fault_pod_op
