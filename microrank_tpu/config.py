@@ -114,6 +114,9 @@ class RuntimeConfig:
     # "auto" (dense iff scattered matrices fit dense_budget_bytes).
     kernel: str = "auto"
     dense_budget_bytes: int = 2 << 30
+    # Validate fetched ranking scores for NaN/inf (nearly free: results are
+    # already on host when checked).
+    validate_numerics: bool = True
 
 
 @dataclass(frozen=True)

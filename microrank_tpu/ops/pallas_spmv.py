@@ -1,0 +1,113 @@
+"""Pallas TPU kernel: COO segment-sum as one-hot MXU matmuls.
+
+XLA lowers ``jax.ops.segment_sum`` to scatter-add, which serializes on the
+TPU's vector unit. The TPU-native alternative implemented here keeps the
+systolic array busy instead: for each block of COO entries, build a
+one-hot matrix ``[BLOCK, V_TILE]`` in VMEM (an iota comparison — pure VPU)
+and accumulate ``prod[None, :] @ onehot`` into a VMEM accumulator with the
+MXU. The grid is (row-tiles, entry-blocks); TPU grids execute sequentially
+over the last dimension, so the accumulator scratch carries across entry
+blocks and each row-tile writes once at the end.
+
+Cost: O(E * V) MACs instead of O(E) scatters — a good trade on TPU
+whenever the scatter would serialize (and exact: one-hot entries are 0/1,
+accumulation is f32).
+
+Usage: ``coo_matvec_pallas(rows, cols, vals, x, n_rows)`` — same contract
+as ops.segment.coo_matvec (padding entries must carry vals == 0).
+Requires n_rows to be a multiple of 128 (the caller pads; structures
+pad_to guarantees pow2 >= 128 for real workloads) and entries to be a
+multiple of the block size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+ENTRY_BLOCK = 512
+ROW_TILE = 2048
+
+
+def _spmv_kernel(rows_ref, prod_ref, y_ref, acc_ref, *, row_tile: int):
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    i = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    rows = rows_ref[:]          # [BLOCK] int32 (global row ids)
+    prod = prod_ref[:]          # [BLOCK] f32
+    base = i * row_tile
+    local = rows - base
+    onehot = (
+        local[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (rows.shape[0], row_tile), 1)
+    ).astype(jnp.float32)
+    acc_ref[:] += jnp.dot(
+        prod[None, :], onehot, preferred_element_type=jnp.float32
+    )[0]
+
+    @pl.when(j == n_j - 1)
+    def _emit():
+        y_ref[:] = acc_ref[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_rows", "interpret", "entry_block", "row_tile")
+)
+def coo_segment_sum_pallas(
+    rows,
+    prod,
+    n_rows: int,
+    interpret: bool = False,
+    entry_block: int = ENTRY_BLOCK,
+    row_tile: int = ROW_TILE,
+):
+    """y[r] = sum of prod[e] where rows[e] == r, via one-hot MXU matmuls.
+
+    ``rows`` int32[E], ``prod`` float32[E]; E padded to entry_block and
+    n_rows padded to row_tile multiples by this wrapper.
+    """
+    e = rows.shape[0]
+    e_pad = ((e + entry_block - 1) // entry_block) * entry_block
+    if e_pad != e:
+        rows = jnp.pad(rows, (0, e_pad - e))
+        prod = jnp.pad(prod, (0, e_pad - e))
+    row_tile = min(row_tile, max(128, n_rows))
+    v_pad = ((n_rows + row_tile - 1) // row_tile) * row_tile
+
+    grid = (v_pad // row_tile, e_pad // entry_block)
+    kernel = functools.partial(_spmv_kernel, row_tile=row_tile)
+    y = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((v_pad,), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((entry_block,), lambda i, j: (j,)),
+            pl.BlockSpec((entry_block,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((row_tile,), lambda i, j: (i,)),
+        scratch_shapes=[pltpu.VMEM((row_tile,), jnp.float32)],
+        interpret=interpret,
+    )(rows.astype(jnp.int32), prod.astype(jnp.float32))
+    return y[:n_rows]
+
+
+def coo_matvec_pallas(
+    rows, cols, vals, x, n_rows: int, interpret: bool = False
+):
+    """Drop-in for ops.segment.coo_matvec using the one-hot MXU kernel.
+
+    The x-gather stays in XLA (one vectorized gather); only the scatter
+    side moves into Pallas.
+    """
+    prod = vals * jnp.take(x, cols, mode="clip")
+    return coo_segment_sum_pallas(rows, prod, n_rows, interpret=interpret)

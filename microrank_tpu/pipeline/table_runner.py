@@ -73,6 +73,10 @@ class TableRCA:
         n = int(n_valid)
         names = [op_names[int(i)] for i in np.asarray(top_idx)[:n]]
         scores = [float(s) for s in np.asarray(top_scores)[:n]]
+        if cfg.runtime.validate_numerics:
+            from ..utils.guards import assert_finite_scores
+
+            assert_finite_scores(scores, "TableRCA.rank_window")
         return names, scores
 
     def run(

@@ -125,19 +125,49 @@ def partition_pagerank(
     sv = jnp.where(g.op_present, 1.0 / n_total, 0.0).astype(jnp.float32)
     rv = jnp.where(trace_live, 1.0 / n_total, 0.0).astype(jnp.float32)
 
-    if kernel == "dense":
+    if kernel in ("dense", "dense_bf16"):
         if psum_axis is not None:
             raise ValueError(
                 "the dense kernel does not support entry-axis sharding; "
                 "use kernel='coo' under shard_map"
             )
         p_ss, p_sr, p_rs = densify(g)
+        if kernel == "dense_bf16":
+            # bf16 operands, f32 accumulation: halves the HBM traffic of
+            # the matrix reads (the iteration is bandwidth-bound) while
+            # max-normalization keeps values in bf16's comfortable range;
+            # rank parity is tested, score tolerance widens.
+            p_ss = p_ss.astype(jnp.bfloat16)
+            p_sr = p_sr.astype(jnp.bfloat16)
+            p_rs = p_rs.astype(jnp.bfloat16)
 
-        def matvecs(sv, rv):
-            return (
-                jnp.dot(p_sr, rv) + alpha * jnp.dot(p_ss, sv),
-                jnp.dot(p_rs, sv),
-            )
+            def matvecs(sv, rv):
+                return (
+                    jnp.dot(
+                        p_sr,
+                        rv.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32,
+                    )
+                    + alpha
+                    * jnp.dot(
+                        p_ss,
+                        sv.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    jnp.dot(
+                        p_rs,
+                        sv.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32,
+                    ),
+                )
+
+        else:
+
+            def matvecs(sv, rv):
+                return (
+                    jnp.dot(p_sr, rv) + alpha * jnp.dot(p_ss, sv),
+                    jnp.dot(p_rs, sv),
+                )
 
     elif kernel == "coo":
 
@@ -150,6 +180,33 @@ def partition_pagerank(
                 ),
                 reduce_shards(
                     coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
+                ),
+            )
+
+    elif kernel == "pallas":
+        # One-hot MXU segment sums (ops/pallas_spmv.py): the scatter side
+        # of each SpMV runs on the systolic array instead of serializing
+        # on scatter-add. Interpret mode off-TPU keeps tests honest.
+        from ..ops.pallas_spmv import coo_matvec_pallas
+
+        # The axon TPU plugin reports backend "axon"; interpret only on CPU.
+        interpret = jax.default_backend() == "cpu"
+
+        def matvecs(sv, rv):
+            return (
+                reduce_shards(
+                    coo_matvec_pallas(
+                        g.inc_op, g.inc_trace, g.sr_val, rv, v, interpret
+                    )
+                    + alpha
+                    * coo_matvec_pallas(
+                        g.ss_child, g.ss_parent, g.ss_val, sv, v, interpret
+                    )
+                ),
+                reduce_shards(
+                    coo_matvec_pallas(
+                        g.inc_trace, g.inc_op, g.rs_val, sv, t_pad, interpret
+                    )
                 ),
             )
 
@@ -298,4 +355,9 @@ class JaxBackend:
         )
         n = int(n_valid)
         idx = [int(i) for i in top_idx[:n]]
-        return [op_names[i] for i in idx], [float(s) for s in top_scores[:n]]
+        scores = [float(s) for s in top_scores[:n]]
+        if rt.validate_numerics:
+            from ..utils.guards import assert_finite_scores
+
+            assert_finite_scores(scores, "JaxBackend.rank_window")
+        return [op_names[i] for i in idx], scores

@@ -1,0 +1,29 @@
+"""Numeric hygiene guards (SURVEY.md §5 race-detection/sanitizers row).
+
+XLA programs are data-race-free by construction; the failure mode that
+remains is numeric — NaN/inf escaping a division in the preference vector
+or a spectrum formula. Backends validate fetched scores by default
+(``RuntimeConfig.validate_numerics``); for deep debugging, enable
+``jax.config.update("jax_debug_nans", True)`` to trap the originating op.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NumericsError(RuntimeError):
+    pass
+
+
+def assert_finite_scores(scores, context: str) -> None:
+    """Raise if any ranked score is NaN or infinite."""
+    arr = np.asarray(scores, dtype=np.float64)
+    bad = ~np.isfinite(arr)
+    if bad.any():
+        idx = np.flatnonzero(bad)[:5].tolist()
+        raise NumericsError(
+            f"non-finite ranking scores in {context}: positions {idx} of "
+            f"{arr.size} (values {[float(arr[i]) for i in idx]}); enable "
+            "jax_debug_nans to locate the producing op"
+        )

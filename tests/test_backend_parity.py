@@ -123,3 +123,24 @@ def test_dense_kernel_matches_coo(small_case):
     np.testing.assert_allclose(
         np.asarray(ts_c)[fin], np.asarray(ts_d)[fin], rtol=1e-4
     )
+
+
+def test_pallas_kernel_matches_coo(small_case):
+    # One-hot MXU SpMV (interpret mode on CPU) == segment-sum path.
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(small_case.abnormal, nrm, abn)
+    dg = jax.tree.map(jnp.asarray, graph)
+    ti_c, ts_c, _ = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "coo")
+    ti_p, ts_p, _ = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "pallas")
+    np.testing.assert_array_equal(np.asarray(ti_c), np.asarray(ti_p))
+    fin = np.isfinite(np.asarray(ts_c))
+    np.testing.assert_allclose(
+        np.asarray(ts_c)[fin], np.asarray(ts_p)[fin], rtol=1e-4
+    )

@@ -1,0 +1,110 @@
+"""Property tests (SURVEY.md §4 item 5): permutation/scale invariance,
+determinism, numeric hygiene."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.graph import build_window_graph
+from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+@pytest.fixture(scope="module")
+def ranked_case():
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=120, seed=2,
+                        n_kinds=24, child_keep_prob=0.6)
+    )
+    nrm, abn = partition_case(case)
+    assert nrm and abn
+    return case, nrm, abn
+
+
+def _rank(case, nrm, abn, kernel="coo", df=None):
+    cfg = MicroRankConfig()
+    graph, names, _, _ = build_window_graph(
+        case.abnormal if df is None else df, nrm, abn
+    )
+    ti, ts, nv = rank_window_device(
+        jax.tree.map(jnp.asarray, graph), cfg.pagerank, cfg.spectrum, None,
+        kernel,
+    )
+    n = int(nv)
+    return (
+        [names[int(i)] for i in np.asarray(ti)[:n]],
+        np.asarray(ts)[:n],
+    )
+
+
+def test_row_permutation_invariance(ranked_case):
+    # Shuffling span rows must not change the ranking.
+    case, nrm, abn = ranked_case
+    top_a, sc_a = _rank(case, nrm, abn)
+    rng = np.random.default_rng(0)
+    shuffled = case.abnormal.sample(frac=1.0, random_state=7).reset_index(
+        drop=True
+    )
+    top_b, sc_b = _rank(case, nrm, abn, df=shuffled)
+    assert top_a == top_b
+    np.testing.assert_allclose(sc_a, sc_b, rtol=1e-5)
+
+
+def test_partition_order_invariance(ranked_case):
+    # The order of trace ids inside each partition list is irrelevant.
+    case, nrm, abn = ranked_case
+    top_a, _ = _rank(case, nrm, abn)
+    top_b, _ = _rank(case, list(reversed(nrm)), list(reversed(abn)))
+    assert top_a == top_b
+
+
+def test_determinism_across_runs(ranked_case):
+    case, nrm, abn = ranked_case
+    top_a, sc_a = _rank(case, nrm, abn)
+    top_b, sc_b = _rank(case, nrm, abn)
+    assert top_a == top_b
+    np.testing.assert_array_equal(sc_a, sc_b)
+
+
+def test_bf16_rank_parity(ranked_case):
+    # bf16 matmuls must preserve the ranking ORDER (scores may drift).
+    case, nrm, abn = ranked_case
+    top_f32, _ = _rank(case, nrm, abn, kernel="dense")
+    top_bf16, _ = _rank(case, nrm, abn, kernel="dense_bf16")
+    assert top_f32[0] == top_bf16[0]
+    # Allow adjacent swaps deep in the tail but not set changes.
+    assert set(top_f32) == set(top_bf16)
+    assert top_f32[:3] == top_bf16[:3]
+
+
+def test_scores_finite_and_positive(ranked_case):
+    case, nrm, abn = ranked_case
+    _, sc = _rank(case, nrm, abn)
+    assert np.isfinite(sc).all()
+    assert (sc >= 0).all()
+
+
+def test_duration_scale_changes_detection_not_build(ranked_case):
+    # Scaling all durations by a constant leaves the PageRank graphs
+    # untouched (they depend only on structure) — the rescale invariance
+    # of pagerank.py:107 generalized.
+    case, nrm, abn = ranked_case
+    df = case.abnormal.copy()
+    df["duration"] = df["duration"] * 2
+    top_a, sc_a = _rank(case, nrm, abn)
+    top_b, sc_b = _rank(case, nrm, abn, df=df)
+    assert top_a == top_b
+    np.testing.assert_allclose(sc_a, sc_b, rtol=1e-6)
+
+
+def test_numerics_guard():
+    from microrank_tpu.utils.guards import NumericsError, assert_finite_scores
+
+    assert_finite_scores([1.0, 2.0], "t")  # fine
+    with pytest.raises(NumericsError, match="non-finite"):
+        assert_finite_scores([1.0, float("nan")], "t")
+    with pytest.raises(NumericsError):
+        assert_finite_scores([float("inf")], "t")
