@@ -84,7 +84,17 @@ class TableRCA:
         table,
         out_dir=None,
         sink: Optional[ResultSink] = None,
+        batch_windows: bool = False,
     ) -> List[WindowResult]:
+        """Slide over the table; RCA every anomalous window.
+
+        ``batch_windows=True`` runs two-phase: detection decides the
+        window advance rule (it alone does — ranking never feeds back into
+        the loop), all anomalous windows' graphs are then stacked over one
+        leading axis and ranked in a single vmapped device call
+        (BASELINE.json config 4: batched multi-window spectrum). The
+        table-global pod vocabulary makes the stacked graphs name-stable.
+        """
         cfg = self.config
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before run()")
@@ -101,10 +111,12 @@ class TableRCA:
         end = int(table.end_us.max())
 
         results: List[WindowResult] = []
+        pending = []  # (result, mask, nrm, abn) for deferred batched rank
         while current < end:
             w0, w1 = current, current + detect_us
             timings = StageTimings()
             result = WindowResult(start=_iso(w0), end=_iso(w1), anomaly=False)
+            ranked = False
 
             mask = window_rows(table, w0, w1)
             if not mask.any():
@@ -127,20 +139,69 @@ class TableRCA:
                 elif result.anomaly:
                     if cfg.compat.partition_swap:
                         nrm, abn = abn, nrm
-                    with timings.stage("rank"):
-                        names, scores = self.rank_window(
-                            table, mask, nrm, abn
-                        )
-                    result.ranking = list(zip(names, scores))
+                    ranked = True
+                    if batch_windows:
+                        pending.append((result, mask, nrm, abn))
+                    else:
+                        with timings.stage("rank"):
+                            names, scores = self.rank_window(
+                                table, mask, nrm, abn
+                            )
+                        result.ranking = list(zip(names, scores))
 
             result.timings = timings.as_dict()
             results.append(result)
-            if sink is not None:
+            if not batch_windows and sink is not None:
                 sink.emit(result)
-            if result.anomaly and result.ranking:
+            if ranked:
                 current += skip_us
             current += detect_us
+
+        if batch_windows and pending:
+            self._rank_pending(table, pending)
+        if batch_windows and sink is not None:
+            for r in results:
+                sink.emit(r)
         return results
+
+    def _rank_pending(self, table, pending) -> None:
+        """Phase 2 of batch_windows: one vmapped rank over all windows."""
+        from ..parallel.sharded_rank import (
+            rank_windows_batched,
+            stack_window_graphs,
+        )
+
+        cfg = self.config
+        graphs = []
+        op_names = list(table.pod_op_names)
+        timings = StageTimings()
+        with timings.stage("build"):
+            for _, mask, nrm, abn in pending:
+                graph, _, _, _ = build_window_graph_from_table(
+                    table, mask, nrm, abn,
+                    pad_policy=cfg.runtime.pad_policy,
+                    min_pad=cfg.runtime.min_pad,
+                )
+                graphs.append(graph)
+            stacked = stack_window_graphs(graphs)
+        with timings.stage("rank_batched"):
+            top_idx, top_scores, n_valid = rank_windows_batched(
+                stacked, cfg.pagerank, cfg.spectrum
+            )
+            top_idx = np.asarray(top_idx)
+            top_scores = np.asarray(top_scores)
+            n_valid = np.asarray(n_valid)
+        shared = timings.as_dict()
+        for b, (result, _, _, _) in enumerate(pending):
+            n = int(n_valid[b])
+            names = [op_names[int(i)] for i in top_idx[b, :n]]
+            scores = [float(s) for s in top_scores[b, :n]]
+            if cfg.runtime.validate_numerics:
+                from ..utils.guards import assert_finite_scores
+
+                assert_finite_scores(scores, f"TableRCA batched window {b}")
+            result.ranking = list(zip(names, scores))
+            result.timings = {**result.timings, **shared}
 
 
 def run_rca_native(

@@ -161,3 +161,46 @@ def test_cli_numpy_backend_agrees(tmp_path):
             outs[backend] = pd.read_csv(out / "result.csv")
     if len(outs) == 2:
         assert outs["jax"].iloc[0]["result"] == outs["numpy_ref"].iloc[0]["result"]
+
+
+def test_batched_windows_match_sequential(case, tmp_path):
+    # Three anomalous windows (the same case tiled at +10/+20 min);
+    # batch_windows=True must produce identical rankings to sequential.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.pipeline import TableRCA
+
+    tiles = []
+    for k in range(3):
+        df = case.abnormal.copy()
+        off = pd.Timedelta(minutes=10 * k)
+        df["startTime"] = df["startTime"] + off
+        df["endTime"] = df["endTime"] + off
+        df["traceID"] = df["traceID"] + f"-w{k}"
+        df["spanID"] = df["spanID"] + f"-w{k}"
+        df["ParentSpanId"] = df["ParentSpanId"].where(
+            df["ParentSpanId"] == "", df["ParentSpanId"] + f"-w{k}"
+        )
+        tiles.append(df)
+    multi = pd.concat(tiles, ignore_index=True)
+    case.normal.to_csv(tmp_path / "n.csv", index=False)
+    multi.to_csv(tmp_path / "a.csv", index=False)
+
+    cfg = MicroRankConfig()
+    rca = TableRCA(cfg)
+    rca.fit_baseline(native.load_span_table(tmp_path / "n.csv"))
+    table = native.load_span_table(tmp_path / "a.csv")
+    seq = rca.run(table)
+    bat = rca.run(table, batch_windows=True)
+    assert len(seq) == len(bat)
+    n_ranked = sum(1 for r in seq if r.ranking)
+    assert n_ranked >= 2
+    for a, b in zip(seq, bat):
+        assert (a.start, a.anomaly, a.skipped_reason) == (
+            b.start, b.anomaly, b.skipped_reason,
+        )
+        assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking]
+        np.testing.assert_allclose(
+            [s for _, s in a.ranking], [s for _, s in b.ranking], rtol=1e-4
+        )
