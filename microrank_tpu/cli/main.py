@@ -169,6 +169,36 @@ def cmd_collect(args) -> int:
     return run_collect(args)
 
 
+def cmd_eval(args) -> int:
+    from ..evaluation import EvalConfig, evaluate
+
+    cfg = _config_from_args(args)
+    eval_cfg = EvalConfig(
+        n_cases=args.cases,
+        n_operations=args.operations,
+        n_traces=args.traces,
+        n_pods=args.pods,
+        n_kinds=args.kinds,
+        n_faults=args.faults,
+        fault_latency_ms=args.fault_ms,
+        seed0=args.seed,
+    )
+    report = evaluate(cfg, eval_cfg)
+    print(report.summary())
+    if args.json:
+        out = {
+            "recall_at": report.recall_at,
+            "exam_score": report.exam_score,
+            "detection_rate": report.detection_rate,
+            "cases": [
+                {"seed": c.seed, "faults": c.faults, "ranks": c.ranks}
+                for c in report.cases
+            ],
+        }
+        Path(args.json).write_text(json.dumps(out, indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="microrank_tpu",
@@ -202,6 +232,23 @@ def main(argv=None) -> int:
     p_synth.add_argument("--fault-ms", type=float, default=2000.0)
     p_synth.add_argument("--seed", type=int, default=0)
     p_synth.set_defaults(fn=cmd_synth)
+
+    p_eval = sub.add_parser(
+        "eval",
+        help="R@k / Exam-Score accuracy experiment over synthetic chaos "
+        "cases (the paper's Tables 4-6 methodology, reproducible)",
+    )
+    p_eval.add_argument("--cases", type=int, default=20)
+    p_eval.add_argument("--operations", type=int, default=30)
+    p_eval.add_argument("--traces", type=int, default=200)
+    p_eval.add_argument("--pods", type=int, default=1)
+    p_eval.add_argument("--kinds", type=int, default=24)
+    p_eval.add_argument("--faults", type=int, default=1)
+    p_eval.add_argument("--fault-ms", type=float, default=2000.0)
+    p_eval.add_argument("--seed", type=int, default=1000)
+    p_eval.add_argument("--json", help="write the detailed report here")
+    _add_config_flags(p_eval)
+    p_eval.set_defaults(fn=cmd_eval)
 
     p_col = sub.add_parser(
         "collect", help="export chaos-case traces from ClickHouse (optional)"

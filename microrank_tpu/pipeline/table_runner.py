@@ -41,6 +41,15 @@ class TableRCA:
         self.log = get_logger("microrank_tpu.pipeline.table")
         self.slo_vocab = None
         self.baseline = None
+        self._mesh = None
+        if config.runtime.mesh_shape is not None:
+            from ..parallel.mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh
+
+            shape = tuple(config.runtime.mesh_shape)
+            if len(shape) == 1:  # pure graph parallelism
+                shape = (1, shape[0])
+            self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
+            self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
 
     def fit_baseline(self, normal_table) -> None:
         self.slo_vocab, self.baseline = compute_slo_from_table(normal_table)
@@ -60,16 +69,32 @@ class TableRCA:
             pad_policy=cfg.runtime.pad_policy,
             min_pad=cfg.runtime.min_pad,
         )
-        kernel = cfg.runtime.kernel
-        if kernel == "auto":
-            kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
-        top_idx, top_scores, n_valid = rank_window_device(
-            jax.tree.map(jnp.asarray, graph),
-            cfg.pagerank,
-            cfg.spectrum,
-            None,
-            kernel,
-        )
+        if self._mesh is not None:
+            from ..parallel.sharded_rank import (
+                rank_windows_sharded,
+                stack_window_graphs,
+            )
+
+            shard_n = int(self._mesh.devices.shape[1])
+            stacked = stack_window_graphs([graph], shard_multiple=shard_n)
+            ti, ts, nv = rank_windows_sharded(
+                jax.tree.map(jnp.asarray, stacked),
+                cfg.pagerank,
+                cfg.spectrum,
+                self._mesh,
+            )
+            top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
+        else:
+            kernel = cfg.runtime.kernel
+            if kernel == "auto":
+                kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
+            top_idx, top_scores, n_valid = rank_window_device(
+                jax.tree.map(jnp.asarray, graph),
+                cfg.pagerank,
+                cfg.spectrum,
+                None,
+                kernel,
+            )
         n = int(n_valid)
         names = [op_names[int(i)] for i in np.asarray(top_idx)[:n]]
         scores = [float(s) for s in np.asarray(top_scores)[:n]]

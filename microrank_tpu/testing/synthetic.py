@@ -38,6 +38,8 @@ class SyntheticConfig:
     # each), so the detector's margin is large by construction; the injected
     # latency must clear it (see tests/test_detector.py).
     fault_latency_ms: float = 2000.0
+    # Simultaneous faults in the abnormal window (paper dataset B uses 2).
+    n_faults: int = 1
     window_minutes: float = 5.0
     seed: int = 0
 
@@ -87,8 +89,7 @@ def _render_spans(
     rng: np.random.Generator,
     n_traces: int,
     t0: pd.Timestamp,
-    fault_op: Optional[int],
-    fault_pod: int,
+    faults: Optional[List[Tuple[int, int]]],  # (op, pod) pairs
     trace_prefix: str,
 ) -> pd.DataFrame:
     kind_of_trace = rng.integers(0, len(topo.kinds), size=n_traces)
@@ -108,12 +109,13 @@ def _render_spans(
         )
         # Pod assignment per (trace, op).
         pods = rng.integers(0, cfg.n_pods, size=(len(t_idx), m))
-        if fault_op is not None:
-            j = np.flatnonzero(ops == fault_op)
-            if len(j):
-                j = int(j[0])
-                hit = pods[:, j] == fault_pod
-                own_ms[:, j] += np.where(hit, cfg.fault_latency_ms, 0.0)
+        if faults:
+            for fault_op, fault_pod in faults:
+                j = np.flatnonzero(ops == fault_op)
+                if len(j):
+                    j = int(j[0])
+                    hit = pods[:, j] == fault_pod
+                    own_ms[:, j] += np.where(hit, cfg.fault_latency_ms, 0.0)
         # Inclusive durations: add each op's total into its parent,
         # deepest-first (ops are topo-ordered).
         dur_ms = own_ms.copy()
@@ -181,11 +183,22 @@ def _render_spans(
 class SyntheticCase:
     normal: pd.DataFrame
     abnormal: pd.DataFrame
-    fault_service_op: str     # service-level canonical name of the root cause
+    fault_service_op: str     # service-level name of the (first) root cause
     fault_pod_op: str         # instance-level (PageRank vocab) name
     fault_op: int
     fault_pod: int
     topology: Topology
+    faults: List[Tuple[int, int]] = field(default_factory=list)
+
+    @property
+    def fault_pod_ops(self) -> List[str]:
+        """Instance-level names of every injected root cause."""
+        w = _op_id_width(
+            int(self.topology.parent.shape[0])
+        )
+        return [
+            f"svc{op:0{w}d}-{pod}_op{op:0{w}d}" for op, pod in self.faults
+        ]
 
 
 def generate_case_with_spans(
@@ -214,21 +227,23 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     rng = np.random.default_rng(cfg.seed)
     topo = _make_topology(cfg, rng)
 
-    # Pick a faulty op covered by at least one kind and not the root (the
+    # Pick faulty ops covered by at least one kind and not the root (the
     # root is trivially always the top anomaly otherwise).
     covered = np.unique(np.concatenate(topo.kinds))
     candidates = covered[covered != 0]
-    fault_op = int(rng.choice(candidates if len(candidates) else covered))
-    fault_pod = int(rng.integers(0, cfg.n_pods))
+    if len(candidates) == 0:
+        candidates = covered
+    n_faults = min(cfg.n_faults, len(candidates))
+    fault_ops = rng.choice(candidates, size=n_faults, replace=False)
+    faults = [
+        (int(op), int(rng.integers(0, cfg.n_pods))) for op in fault_ops
+    ]
 
     t0 = pd.Timestamp("2025-02-14 12:00:00")
     t1 = t0 + pd.Timedelta(minutes=cfg.window_minutes)
-    normal = _render_spans(
-        topo, cfg, rng, cfg.n_traces, t0, None, fault_pod, "n"
-    )
-    abnormal = _render_spans(
-        topo, cfg, rng, cfg.n_traces, t1, fault_op, fault_pod, "a"
-    )
+    normal = _render_spans(topo, cfg, rng, cfg.n_traces, t0, None, "n")
+    abnormal = _render_spans(topo, cfg, rng, cfg.n_traces, t1, faults, "a")
+    fault_op, fault_pod = faults[0]
     w = _op_id_width(cfg.n_operations)
     svc = f"svc{fault_op:0{w}d}"
     return SyntheticCase(
@@ -239,4 +254,5 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
         fault_op=fault_op,
         fault_pod=fault_pod,
         topology=topo,
+        faults=faults,
     )

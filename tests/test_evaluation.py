@@ -1,0 +1,62 @@
+"""Accuracy harness: R@k / Exam Score semantics and CLI."""
+
+import json
+
+import pytest
+
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.evaluation import EvalConfig, evaluate
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+def test_single_fault_accuracy():
+    rep = evaluate(
+        MicroRankConfig(),
+        EvalConfig(n_cases=5, n_operations=20, n_traces=120, seed0=100),
+    )
+    assert len(rep.cases) == 5
+    assert rep.detection_rate == 1.0
+    # Paper-level accuracy on single faults (Table 4: R@1=94%, R@3=96%).
+    assert rep.recall_at[1] >= 0.6
+    assert rep.recall_at[3] == 1.0
+    assert rep.exam_score < 0.2
+    # Monotone in k.
+    assert rep.recall_at[1] <= rep.recall_at[3] <= rep.recall_at[5]
+
+
+def test_two_fault_cases_scored_per_fault():
+    rep = evaluate(
+        MicroRankConfig(),
+        EvalConfig(
+            n_cases=3, n_operations=20, n_traces=150, n_faults=2, seed0=300
+        ),
+    )
+    assert all(len(c.faults) == 2 and len(c.ranks) == 2 for c in rep.cases)
+
+
+def test_multi_fault_generator():
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=100, n_faults=2, seed=1)
+    )
+    assert len(case.faults) == 2
+    assert len(set(op for op, _ in case.faults)) == 2
+    assert len(case.fault_pod_ops) == 2
+    assert case.fault_pod_ops[0] == case.fault_pod_op
+    # Both faulty services really exist in the abnormal dump.
+    svcs = set(case.abnormal["serviceName"].unique())
+    for op, _ in case.faults:
+        assert f"svc{op:03d}" in svcs
+
+
+def test_cli_eval(tmp_path):
+    from microrank_tpu.cli import main
+
+    out = tmp_path / "report.json"
+    rc = main(
+        ["eval", "--cases", "3", "--operations", "16", "--traces", "100",
+         "--json", str(out)]
+    )
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert set(report) >= {"recall_at", "exam_score", "cases"}
+    assert len(report["cases"]) == 3

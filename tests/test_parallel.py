@@ -112,3 +112,34 @@ def test_graft_entry_points():
     jax.block_until_ready(out)
     assert out[0].shape == out[1].shape
     mod.dryrun_multichip(8)
+
+
+def test_table_rca_sharded_matches_default(tmp_path):
+    # RuntimeConfig.mesh_shape routes TableRCA ranking through shard_map.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.config import RuntimeConfig
+    from microrank_tpu.pipeline import TableRCA
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=120, seed=5,
+                        n_kinds=24, child_keep_prob=0.6)
+    )
+    case.normal.to_csv(tmp_path / "n.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "a.csv", index=False)
+    normal = native.load_span_table(tmp_path / "n.csv")
+    abnormal = native.load_span_table(tmp_path / "a.csv")
+
+    plain = TableRCA(MicroRankConfig())
+    plain.fit_baseline(normal)
+    r_plain = plain.run(abnormal)
+
+    cfg = MicroRankConfig(runtime=RuntimeConfig(mesh_shape=(8,)))
+    sharded = TableRCA(cfg)
+    sharded.fit_baseline(normal)
+    r_sharded = sharded.run(abnormal)
+
+    a = next(r for r in r_plain if r.ranking)
+    b = next(r for r in r_sharded if r.ranking)
+    assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking]
