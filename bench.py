@@ -80,9 +80,21 @@ def _ensure_data(spans_target, n_ops, fault_ms):
     return case_dir, truth
 
 
+# BASELINE.json's five workload configs, selectable via BENCH_CONFIG=1..5
+# (BENCH_SPANS / BENCH_OPS still override individually).
+CONFIG_PRESETS = {
+    "1": dict(spans=1_000, ops=40),        # Bookinfo-scale replay
+    "2": dict(spans=10_000, ops=500),      # synthetic Erdős–Rényi
+    "3": dict(spans=50_000, ops=1_000),    # Online-Boutique scale
+    "4": dict(spans=250_000, ops=2_000),   # TrainTicket scale
+    "5": dict(spans=1_000_000, ops=5_000), # sharded-mesh target
+}
+
+
 def main() -> int:
-    spans_target = int(os.environ.get("BENCH_SPANS", 1_000_000))
-    n_ops = int(os.environ.get("BENCH_OPS", 5000))
+    preset = CONFIG_PRESETS.get(os.environ.get("BENCH_CONFIG", "5"))
+    spans_target = int(os.environ.get("BENCH_SPANS", preset["spans"]))
+    n_ops = int(os.environ.get("BENCH_OPS", preset["ops"]))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
     oracle_spans = int(os.environ.get("BENCH_ORACLE_SPANS", 20_000))
     fault_ms = float(os.environ.get("BENCH_FAULT_MS", 60_000.0))
