@@ -17,10 +17,11 @@ Pipeline benched is the native lane: C++ mmap ingest (interned arrays) ->
 int-only window build -> jitted rank. Synthetic chaos-case CSVs are
 generated once and cached under bench_data/.
 
-Config via env: BENCH_SPANS (default 1_000_000), BENCH_OPS (5000),
-BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000), BENCH_KERNEL
-(auto|coo|dense), BENCH_FAULT_MS (60000). Details go to stderr; stdout
-carries only the JSON line.
+Config via env: BENCH_CONFIG=1..5 selects a BASELINE.json workload preset
+(default 5 = 1M spans / 5k ops); BENCH_SPANS / BENCH_OPS override the
+preset's sizes; BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000),
+BENCH_KERNEL (auto|coo|dense|dense_bf16|pallas), BENCH_FAULT_MS (60000).
+Details go to stderr; stdout carries only the JSON line.
 
 Reference baseline context: the reference's PageRank Scorer takes 5.5 s
 per window of ~1e2 ops / 1e2-1e3 traces on a CPU core (paper Table 7;
@@ -92,7 +93,14 @@ CONFIG_PRESETS = {
 
 
 def main() -> int:
-    preset = CONFIG_PRESETS.get(os.environ.get("BENCH_CONFIG", "5"))
+    config_key = os.environ.get("BENCH_CONFIG", "5")
+    preset = CONFIG_PRESETS.get(config_key)
+    if preset is None:
+        log(
+            f"unknown BENCH_CONFIG={config_key!r} "
+            f"(valid: {sorted(CONFIG_PRESETS)}); using config 5"
+        )
+        preset = CONFIG_PRESETS["5"]
     spans_target = int(os.environ.get("BENCH_SPANS", preset["spans"]))
     n_ops = int(os.environ.get("BENCH_OPS", preset["ops"]))
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
