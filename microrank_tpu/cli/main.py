@@ -30,6 +30,7 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--k-sigma", type=float, default=3.0)
     p.add_argument("--slack-ms", type=float, default=0.0)
+    p.add_argument("--slo-stat", default="mean", choices=["mean", "p90"])
     p.add_argument("--detect-minutes", type=float, default=5.0)
     p.add_argument("--skip-minutes", type=float, default=4.0)
     p.add_argument(
@@ -56,7 +57,11 @@ def _config_from_args(args) -> "MicroRankConfig":
         with open(args.config_json) as f:
             return MicroRankConfig.from_dict(json.load(f))
     cfg = MicroRankConfig(
-        detector=DetectorConfig(k_sigma=args.k_sigma, slack_ms=args.slack_ms),
+        detector=DetectorConfig(
+            k_sigma=args.k_sigma,
+            slack_ms=args.slack_ms,
+            slo_stat=args.slo_stat,
+        ),
         pagerank=PageRankConfig(
             iterations=args.iterations,
             damping=args.damping,
@@ -260,7 +265,28 @@ def main(argv=None) -> int:
     p_col.set_defaults(fn=cmd_collect)
 
     args = parser.parse_args(argv)
+    _enable_jit_cache()
     return args.fn(args)
+
+
+def _enable_jit_cache() -> None:
+    """Persist compiled XLA programs across CLI invocations (first TPU
+    compile is tens of seconds; cached reloads are near-instant)."""
+    import os
+
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "MICRORANK_JIT_CACHE",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "microrank_tpu", "jit"
+            ),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
 
 
 if __name__ == "__main__":

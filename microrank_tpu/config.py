@@ -33,6 +33,10 @@ class DetectorConfig:
     # A window is flagged anomalous iff >= min_abnormal_traces traces exceed
     # their expected duration (reference: ``if anormaly_trace:`` i.e. >= 1).
     min_abnormal_traces: int = 1
+    # Central statistic of the SLO baseline: "mean" (reference behavior) or
+    # "p90" (the alternative the reference left commented out at
+    # preprocess_data.py:72).
+    slo_stat: str = "mean"
 
     @classmethod
     def single_trace_variant(cls) -> "DetectorConfig":

@@ -23,16 +23,27 @@ from ..io.schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES, US_PER_MS
 def compute_slo(
     span_df: pd.DataFrame,
     strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
+    stat: str = "mean",
 ) -> Tuple[Vocab, SloBaseline]:
-    """Compute the SLO baseline from a (long) normal-period span dump."""
+    """Compute the SLO baseline from a (long) normal-period span dump.
+
+    ``stat="mean"`` is the reference behavior; ``stat="p90"`` substitutes
+    the 90th-percentile duration for the mean — the alternative the
+    reference left commented out (preprocess_data.py:72).
+    """
     names = operation_names(span_df, "service", strip_services)
     dur = span_df["duration"].astype(float)
     grouped = dur.groupby(names.to_numpy())
-    mean_ms = (grouped.mean() / US_PER_MS).round(4)
+    if stat == "mean":
+        center_ms = (grouped.mean() / US_PER_MS).round(4)
+    elif stat == "p90":
+        center_ms = (grouped.quantile(0.9) / US_PER_MS).round(4)
+    else:
+        raise ValueError(f"unknown SLO statistic {stat!r}")
     std_ms = (grouped.std(ddof=0) / US_PER_MS).round(4)
-    vocab = Vocab(mean_ms.index.tolist())
+    vocab = Vocab(center_ms.index.tolist())
     baseline = SloBaseline(
-        mean_ms=mean_ms.to_numpy(dtype=np.float32),
+        mean_ms=center_ms.to_numpy(dtype=np.float32),
         std_ms=std_ms.to_numpy(dtype=np.float32),
     )
     return vocab, baseline

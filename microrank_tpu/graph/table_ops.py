@@ -19,11 +19,12 @@ from .build import _build_partition
 from .structures import DetectBatch, SloBaseline, WindowGraph, pad1d, pad_to
 
 
-def compute_slo_from_table(table) -> Tuple[Vocab, SloBaseline]:
+def compute_slo_from_table(table, stat: str = "mean") -> Tuple[Vocab, SloBaseline]:
     """SLO baseline from a (normal-period) SpanTable — one bincount pass.
 
     Same semantics as detect.compute_slo (population std, ms, 4 decimals;
-    reference preprocess_data.py:50-78).
+    reference preprocess_data.py:50-78), incl. the ``stat="p90"``
+    variant (linear-interpolated percentile, matching np.percentile).
     """
     n_ops = len(table.svc_op_names)
     dur = table.duration_us.astype(np.float64)
@@ -35,8 +36,25 @@ def compute_slo_from_table(table) -> Tuple[Vocab, SloBaseline]:
     centered = dur - mean[table.svc_op]
     s2 = np.bincount(table.svc_op, weights=centered * centered, minlength=n_ops)
     std = np.sqrt(s2 / counts)
+    if stat == "mean":
+        center = mean
+    elif stat == "p90":
+        order = np.lexsort((dur, table.svc_op))
+        s_op = table.svc_op[order]
+        s_dur = dur[order]
+        ids = np.arange(n_ops)
+        starts = np.searchsorted(s_op, ids)
+        n = np.searchsorted(s_op, ids, side="right") - starts
+        n = np.maximum(n, 1)
+        pos = 0.9 * (n - 1)
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.minimum(lo + 1, n - 1)
+        frac = pos - lo
+        center = s_dur[starts + lo] * (1 - frac) + s_dur[starts + hi] * frac
+    else:
+        raise ValueError(f"unknown SLO statistic {stat!r}")
     baseline = SloBaseline(
-        mean_ms=np.round(mean / 1000.0, 4).astype(np.float32),
+        mean_ms=np.round(center / 1000.0, 4).astype(np.float32),
         std_ms=np.round(std / 1000.0, 4).astype(np.float32),
     )
     return Vocab(table.svc_op_names), baseline

@@ -53,7 +53,9 @@ class OnlineRCA:
                 len(self.slo_vocab),
             )
             return
-        self.slo_vocab, self.baseline = compute_slo(normal_df)
+        self.slo_vocab, self.baseline = compute_slo(
+            normal_df, stat=self.config.detector.slo_stat
+        )
         self.log.info("fitted SLO baseline: %d operations", len(self.slo_vocab))
         if cache_path is not None:
             save_slo(cache_path, self.slo_vocab, self.baseline)

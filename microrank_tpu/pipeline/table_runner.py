@@ -52,7 +52,9 @@ class TableRCA:
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
 
     def fit_baseline(self, normal_table) -> None:
-        self.slo_vocab, self.baseline = compute_slo_from_table(normal_table)
+        self.slo_vocab, self.baseline = compute_slo_from_table(
+            normal_table, stat=self.config.detector.slo_stat
+        )
         self.log.info(
             "fitted SLO baseline (native lane): %d operations",
             len(self.slo_vocab),

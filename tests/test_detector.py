@@ -1,6 +1,7 @@
 """Detector tests: numpy vs jax agreement, fault sensitivity, edge rules."""
 
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from microrank_tpu.config import DetectorConfig
@@ -74,3 +75,31 @@ def test_slack_variant(small_case):
     cfg = DetectorConfig.single_trace_variant()
     res_np, res_jx, _ = _run_both(small_case, cfg)
     assert bool(res_np.flag) == bool(res_jx.flag)
+
+
+def test_p90_slo_lanes_agree(small_case, tmp_path):
+    # p90 variant: pandas groupby.quantile vs the table lane's
+    # sorted-searchsorted percentile must agree.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.graph.table_ops import compute_slo_from_table
+
+    case = small_case
+    case.normal.to_csv(tmp_path / "n.csv", index=False)
+    table = native.load_span_table(tmp_path / "n.csv")
+    v1, b1 = compute_slo(case.normal, stat="p90")
+    v2, b2 = compute_slo_from_table(table, stat="p90")
+    m1 = dict(zip(v1.names, b1.mean_ms))
+    m2 = dict(zip(v2.names, b2.mean_ms))
+    assert set(m1) == set(m2)
+    for op in m1:
+        assert m1[op] == pytest.approx(m2[op], abs=2e-4), op
+    # p90 center sits above the mean for right-skewed lognormal durations.
+    _, b_mean = compute_slo(case.normal, stat="mean")
+    assert (b1.mean_ms >= b_mean.mean_ms - 1e-3).mean() > 0.9
+
+
+def test_unknown_slo_stat_raises(small_case):
+    with pytest.raises(ValueError, match="unknown SLO statistic"):
+        compute_slo(small_case.normal, stat="median")

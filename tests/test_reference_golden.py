@@ -161,3 +161,30 @@ def test_full_rca_matches_reference(case):
     ref_map = dict(zip(ref_top, ref_scores))
     for name, score in zip(jax_top, jax_scores):
         assert score == pytest.approx(ref_map[name], rel=2e-3), name
+
+
+def test_trace_list_partition_matches_reference(case):
+    """C6: the alternate 1-sigma + 50ms path (trace_anormaly_detect /
+    trace_list_partition, anormaly_detector.py:101-139) vs our unified
+    detector with DetectorConfig.single_trace_variant()."""
+    from microrank_tpu.config import DetectorConfig
+
+    ref_norm = case.normal.copy()
+    ops = ref_pre.get_service_operation_list(ref_norm)
+    slo = ref_pre.get_operation_slo(ops, ref_norm)
+    operation_count = ref_pre.get_operation_duration_data(
+        ops, case.abnormal.copy()
+    )
+    ref_abn, ref_nrm = ref_detector.trace_list_partition(operation_count, slo)
+
+    vocab, baseline = compute_slo(case.normal)
+    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
+    det = detect_numpy(batch, baseline, DetectorConfig.single_trace_variant())
+    abn = {t for t, a in zip(trace_ids, det.abnormal) if a}
+    # The reference path has no duration>0 validity filter in the
+    # partition loop itself (it inherits it from
+    # get_operation_duration_data's dropna/positive filter) — compare on
+    # the traces it actually scored.
+    scored = set(operation_count)
+    ours_abn = abn & scored
+    assert ours_abn == set(ref_abn)
