@@ -265,7 +265,8 @@ def main(argv=None) -> int:
     p_col.set_defaults(fn=cmd_collect)
 
     args = parser.parse_args(argv)
-    _enable_jit_cache()
+    if args.fn in (cmd_run, cmd_eval):  # jax-touching commands only
+        _enable_jit_cache()
     return args.fn(args)
 
 

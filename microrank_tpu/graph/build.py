@@ -141,7 +141,7 @@ def _build_partition(
     local_uniques, t_codes = np.unique(g_trace, return_inverse=True)
     t_codes = t_codes.astype(np.int64)
     n_traces = len(local_uniques)
-    tracelen = np.bincount(t_codes, minlength=max(n_traces, 1)).astype(np.int64)
+    tracelen = np.bincount(t_codes, minlength=n_traces).astype(np.int64)
 
     # Unique (trace, op) incidence with value arrays for p_sr / p_rs.
     key = t_codes * vocab_size + op_codes

@@ -16,7 +16,14 @@ import numpy as np
 
 from ..io.interning import Vocab
 from .build import _build_partition
-from .structures import DetectBatch, SloBaseline, WindowGraph, pad1d, pad_to
+from .structures import (
+    DetectBatch,
+    PartitionGraph,
+    SloBaseline,
+    WindowGraph,
+    pad1d,
+    pad_to,
+)
 
 
 def compute_slo_from_table(table, stat: str = "mean") -> Tuple[Vocab, SloBaseline]:
@@ -98,6 +105,33 @@ def detect_batch_from_table(
     return batch, uniques
 
 
+def _graph_from_raw(raw, vocab_size, v_pad, pad_policy, min_pad):
+    """Pad one native RawPartition into a PartitionGraph."""
+    n_inc = len(raw.inc_op)
+    n_ss = len(raw.ss_child)
+    n_traces = len(raw.kind)
+    e_pad = pad_to(n_inc, pad_policy, min_pad)
+    c_pad = pad_to(n_ss, pad_policy, min_pad)
+    t_pad = pad_to(n_traces, pad_policy, min_pad)
+    return PartitionGraph(
+        inc_op=pad1d(raw.inc_op, e_pad),
+        inc_trace=pad1d(raw.inc_trace, e_pad),
+        sr_val=pad1d(raw.sr_val, e_pad),
+        rs_val=pad1d(raw.rs_val, e_pad),
+        ss_child=pad1d(raw.ss_child, c_pad),
+        ss_parent=pad1d(raw.ss_parent, c_pad),
+        ss_val=pad1d(raw.ss_val, c_pad),
+        kind=pad1d(raw.kind, t_pad, fill=1),
+        tracelen=pad1d(raw.tracelen, t_pad, fill=1),
+        cov_unique=pad1d(raw.cov_unique, v_pad),
+        op_present=pad1d(raw.op_present, v_pad, fill=False),
+        n_ops=np.int32(raw.n_ops),
+        n_traces=np.int32(n_traces),
+        n_inc=np.int32(n_inc),
+        n_ss=np.int32(n_ss),
+    )
+
+
 def build_window_graph_from_table(
     table,
     mask: np.ndarray,
@@ -105,14 +139,67 @@ def build_window_graph_from_table(
     abnormal_trace_codes: Iterable[int],
     pad_policy: str = "pow2",
     min_pad: int = 8,
+    use_native: bool = True,
 ) -> Tuple[WindowGraph, List[str], np.ndarray, np.ndarray]:
     """Both partitions' graphs from table rows — ints end to end.
 
     The op vocab is the table's pod_op vocabulary (stable across windows).
+    ``mask`` is a bool row filter (None = all rows). When the native
+    library is available (and ``use_native``), both partitions build in
+    C++ via fused single-scan counting sorts (graph_builder.cpp); the
+    numpy fallback below is array-identical.
     Returns (graph, op_names, normal_codes, abnormal_codes).
     """
     vocab_size = len(table.pod_op_names)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
+    if mask is None:
+        mask = np.ones(table.n_spans, dtype=bool)
+
+    if use_native:
+        from ..native import (
+            NativeUnavailable,
+            build_window_native,
+            native_available,
+        )
+
+        if native_available():
+            n_total = len(table.trace_names)
+            nf = np.zeros(n_total, dtype=np.uint8)
+            af = np.zeros(n_total, dtype=np.uint8)
+            ncodes = np.asarray(list(normal_trace_codes), dtype=np.int64)
+            acodes = np.asarray(list(abnormal_trace_codes), dtype=np.int64)
+            if len(ncodes):
+                nf[ncodes] = 1
+            if len(acodes):
+                af[acodes] = 1
+            full = bool(np.all(mask))
+            try:
+                raw_n, raw_a = build_window_native(
+                    table.pod_op,
+                    table.trace_id,
+                    table.parent_row,
+                    None if full else mask,
+                    nf,
+                    af,
+                    vocab_size,
+                )
+            except NativeUnavailable:
+                raw_n = raw_a = None  # fall through to the numpy lane
+            if raw_n is not None:
+                graph = WindowGraph(
+                    normal=_graph_from_raw(
+                        raw_n, vocab_size, v_pad, pad_policy, min_pad
+                    ),
+                    abnormal=_graph_from_raw(
+                        raw_a, vocab_size, v_pad, pad_policy, min_pad
+                    ),
+                )
+                return (
+                    graph,
+                    list(table.pod_op_names),
+                    raw_n.local_uniques.astype(np.int64),
+                    raw_a.local_uniques.astype(np.int64),
+                )
     rows = np.flatnonzero(mask)
     op_codes = table.pod_op[rows].astype(np.int64)
     g_trace = table.trace_id[rows].astype(np.int64)

@@ -1,10 +1,16 @@
-"""Native (C++) ingest runtime with a ctypes binding.
+"""Native (C++) runtime with a ctypes binding.
 
-Builds ``libmrspan.so`` from span_loader.cpp on first use (g++ -O3; cached
-next to the source) and exposes ``load_span_table(path)`` returning a
-``SpanTable`` of interned numpy arrays. Falls back cleanly: callers should
-catch ``NativeUnavailable`` and use the pandas path
-(microrank_tpu.io.load_traces_csv).
+Builds ``libmrspan.so`` from span_loader.cpp + graph_builder.cpp on first
+use (g++ -O3; cached next to the sources) and exposes:
+
+* ``load_span_table(path)`` — mmap CSV ingest to a ``SpanTable`` of
+  interned numpy arrays;
+* ``build_window_native(...)`` — fused counting-sort window-graph build
+  (both partitions in single scans), array-compatible with the numpy lane
+  (graph.build._build_partition).
+
+Falls back cleanly: callers should catch ``NativeUnavailable`` and use the
+pandas/numpy paths.
 """
 
 from __future__ import annotations
@@ -13,11 +19,14 @@ import ctypes
 import os
 import subprocess
 from pathlib import Path
-from typing import List, NamedTuple, Optional
+from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-_SRC = Path(__file__).parent / "span_loader.cpp"
+_SRCS = [
+    Path(__file__).parent / "span_loader.cpp",
+    Path(__file__).parent / "graph_builder.cpp",
+]
 _LIB = Path(__file__).parent / "libmrspan.so"
 _lib: Optional[ctypes.CDLL] = None
 
@@ -74,10 +83,38 @@ class _MrSpanTable(ctypes.Structure):
     ]
 
 
+class _MrPartition(ctypes.Structure):
+    _fields_ = [
+        ("n_inc", ctypes.c_int64),
+        ("inc_op", ctypes.POINTER(ctypes.c_int32)),
+        ("inc_trace", ctypes.POINTER(ctypes.c_int32)),
+        ("sr_val", ctypes.POINTER(ctypes.c_float)),
+        ("rs_val", ctypes.POINTER(ctypes.c_float)),
+        ("n_ss", ctypes.c_int64),
+        ("ss_child", ctypes.POINTER(ctypes.c_int32)),
+        ("ss_parent", ctypes.POINTER(ctypes.c_int32)),
+        ("ss_val", ctypes.POINTER(ctypes.c_float)),
+        ("n_traces", ctypes.c_int64),
+        ("kind", ctypes.POINTER(ctypes.c_int32)),
+        ("tracelen", ctypes.POINTER(ctypes.c_int32)),
+        ("local_uniques", ctypes.POINTER(ctypes.c_int32)),
+        ("cov_unique", ctypes.POINTER(ctypes.c_int32)),
+        ("op_present", ctypes.POINTER(ctypes.c_uint8)),
+        ("n_ops", ctypes.c_int64),
+    ]
+
+
+class _MrWindowGraph(ctypes.Structure):
+    _fields_ = [
+        ("parts", _MrPartition * 2),
+        ("error", ctypes.c_char_p),
+    ]
+
+
 def _build_library() -> None:
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        str(_SRC), "-o", str(_LIB),
+        *[str(s) for s in _SRCS], "-o", str(_LIB),
     ]
     try:
         subprocess.run(
@@ -95,13 +132,30 @@ def _load_library() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+    if not _LIB.exists() or _LIB.stat().st_mtime < max(
+        s.stat().st_mtime for s in _SRCS
+    ):
         _build_library()
     lib = ctypes.CDLL(str(_LIB))
     lib.mr_load_csv.restype = ctypes.POINTER(_MrSpanTable)
     lib.mr_load_csv.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.mr_free_table.restype = None
     lib.mr_free_table.argtypes = [ctypes.POINTER(_MrSpanTable)]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mr_build_window.restype = ctypes.POINTER(_MrWindowGraph)
+    lib.mr_build_window.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),  # pod_op
+        ctypes.POINTER(ctypes.c_int32),  # trace_id
+        ctypes.POINTER(ctypes.c_int64),  # parent_row
+        ctypes.c_int64,                  # n_rows
+        u8p,                             # row_mask (nullable)
+        u8p,                             # normal_flag
+        u8p,                             # abnormal_flag
+        ctypes.c_int64,                  # n_total_traces
+        ctypes.c_int64,                  # vocab_size
+    ]
+    lib.mr_free_window.restype = None
+    lib.mr_free_window.argtypes = [ctypes.POINTER(_MrWindowGraph)]
     _lib = lib
     return lib
 
@@ -167,9 +221,112 @@ def load_span_table(
         lib.mr_free_table(res)
 
 
+class RawPartition(NamedTuple):
+    """Unpadded arrays of one partition graph, as built by C++.
+
+    Field semantics match graph.build._build_partition's outputs; callers
+    (graph.table_ops) pad and assemble the PartitionGraph.
+    """
+
+    inc_op: np.ndarray       # int32[n_inc]
+    inc_trace: np.ndarray    # int32[n_inc]
+    sr_val: np.ndarray       # float32[n_inc]
+    rs_val: np.ndarray       # float32[n_inc]
+    ss_child: np.ndarray     # int32[n_ss]
+    ss_parent: np.ndarray    # int32[n_ss]
+    ss_val: np.ndarray       # float32[n_ss]
+    kind: np.ndarray         # int32[n_traces]
+    tracelen: np.ndarray     # int32[n_traces]
+    local_uniques: np.ndarray  # int32[n_traces] global trace codes
+    cov_unique: np.ndarray   # int32[vocab]
+    op_present: np.ndarray   # bool[vocab]
+    n_ops: int
+
+
+def _take(ptr, n: int, dtype) -> np.ndarray:
+    if n == 0:
+        return np.zeros(0, dtype=dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+def build_window_native(
+    pod_op: np.ndarray,
+    trace_id: np.ndarray,
+    parent_row: np.ndarray,
+    row_mask: Optional[np.ndarray],
+    normal_flag: np.ndarray,
+    abnormal_flag: np.ndarray,
+    vocab_size: int,
+) -> Tuple[RawPartition, RawPartition]:
+    """Build both partitions' raw COO graphs in C++ (fused single scans).
+
+    ``normal_flag``/``abnormal_flag`` are bool arrays over the table's
+    global trace codes; ``row_mask`` (bool over rows, or None for all)
+    is the detection window (get_span semantics applied upstream).
+    """
+    lib = _load_library()
+    pod_op = np.ascontiguousarray(pod_op, dtype=np.int32)
+    trace_id = np.ascontiguousarray(trace_id, dtype=np.int32)
+    parent_row = np.ascontiguousarray(parent_row, dtype=np.int64)
+    nf = np.ascontiguousarray(normal_flag, dtype=np.uint8)
+    af = np.ascontiguousarray(abnormal_flag, dtype=np.uint8)
+    n_total = len(nf)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    if row_mask is None:
+        mask_ptr = ctypes.cast(None, u8p)
+    else:
+        row_mask = np.ascontiguousarray(row_mask, dtype=np.uint8)
+        mask_ptr = row_mask.ctypes.data_as(u8p)
+    res = lib.mr_build_window(
+        pod_op.ctypes.data_as(i32p),
+        trace_id.ctypes.data_as(i32p),
+        parent_row.ctypes.data_as(i64p),
+        ctypes.c_int64(len(pod_op)),
+        mask_ptr,
+        nf.ctypes.data_as(u8p),
+        af.ctypes.data_as(u8p),
+        ctypes.c_int64(n_total),
+        ctypes.c_int64(vocab_size),
+    )
+    if not res:
+        raise NativeUnavailable("mr_build_window allocation failed")
+    try:
+        if res.contents.error:
+            raise NativeUnavailable(res.contents.error.decode())
+        out = []
+        for p in res.contents.parts:
+            n_inc, n_ss, n_tr = int(p.n_inc), int(p.n_ss), int(p.n_traces)
+            out.append(
+                RawPartition(
+                    inc_op=_take(p.inc_op, n_inc, np.int32),
+                    inc_trace=_take(p.inc_trace, n_inc, np.int32),
+                    sr_val=_take(p.sr_val, n_inc, np.float32),
+                    rs_val=_take(p.rs_val, n_inc, np.float32),
+                    ss_child=_take(p.ss_child, n_ss, np.int32),
+                    ss_parent=_take(p.ss_parent, n_ss, np.int32),
+                    ss_val=_take(p.ss_val, n_ss, np.float32),
+                    kind=_take(p.kind, n_tr, np.int32),
+                    tracelen=_take(p.tracelen, n_tr, np.int32),
+                    local_uniques=_take(p.local_uniques, n_tr, np.int32),
+                    cov_unique=_take(p.cov_unique, vocab_size, np.int32),
+                    op_present=_take(p.op_present, vocab_size, np.uint8).astype(
+                        bool
+                    ),
+                    n_ops=int(p.n_ops),
+                )
+            )
+        return out[0], out[1]
+    finally:
+        lib.mr_free_window(res)
+
+
 __all__ = [
     "SpanTable",
+    "RawPartition",
     "NativeUnavailable",
     "load_span_table",
+    "build_window_native",
     "native_available",
 ]

@@ -1,0 +1,336 @@
+// Native window-graph builder: interned span rows -> COO partition graphs.
+//
+// The graph-build stage of the framework (reference: get_pagerank_graph
+// preprocess_data.py:146-171 plus the matrix fills of pagerank.py:35-52 and
+// the kind dedup of pagerank.py:54-66). The numpy lane
+// (graph/build.py:_build_partition) is O(n log n) via comparison sorts;
+// every id here is a bounded small int (op vocab, window-local trace ids),
+// so this builds both partitions in fused single scans: one stats pass
+// over the rows for BOTH partitions, a bucket-scatter by trace, small
+// in-cache per-trace sorts for the unique-op rows, and one counting sort
+// for the call edges — O(n + V + T) total.
+//
+// Output order is kept identical to the numpy lane (incidence sorted by
+// (local trace asc, op asc), call edges by (child asc, parent asc), local
+// trace ids assigned in ascending global-id order) so the two lanes are
+// array-for-array interchangeable.
+//
+// Plain C ABI (ctypes-friendly); all output arrays are heap-allocated and
+// released with mr_free_window.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// Splitmix64 finalizer — matches graph/build.py:_splitmix64 so both lanes
+// group trace kinds through the same hash prefilter (equality is still
+// decided by exact sequence compare below).
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+template <typename T>
+T* copy_out(const std::vector<T>& v) {
+  T* p = static_cast<T*>(std::malloc(v.size() * sizeof(T) + 1));
+  if (p && !v.empty()) std::memcpy(p, v.data(), v.size() * sizeof(T));
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct MrPartition {
+  // Unique (trace, op) incidence, sorted by (trace asc, op asc).
+  int64_t n_inc;
+  int32_t* inc_op;
+  int32_t* inc_trace;
+  float* sr_val;  // 1 / tracelen_with_dups(trace)   (pagerank.py:42-45)
+  float* rs_val;  // 1 / coverage_with_dups(op)      (pagerank.py:48-52)
+  // Unique call edges, sorted by (child asc, parent asc).
+  int64_t n_ss;
+  int32_t* ss_child;
+  int32_t* ss_parent;
+  float* ss_val;  // 1 / outdeg_with_dups(parent)    (pagerank.py:35-39)
+  // Per-local-trace stats.
+  int64_t n_traces;
+  int32_t* kind;           // kind-class size          (pagerank.py:54-66)
+  int32_t* tracelen;       // span count with dups
+  int32_t* local_uniques;  // global trace code of local trace i
+  // Per-op stats over the full vocab.
+  int32_t* cov_unique;  // #traces covering op (unique)
+  uint8_t* op_present;
+  int64_t n_ops;
+};
+
+struct MrWindowGraph {
+  MrPartition parts[2];  // [0]=normal, [1]=abnormal
+  const char* error;
+};
+
+}  // extern "C"
+
+namespace {
+
+// Scratch accumulated for one partition during the fused scans.
+struct PartScratch {
+  const uint8_t* flags;
+  std::vector<int32_t> counts_global;  // [n_total_traces] span counts
+  std::vector<int32_t> cov_dup;        // [vocab]
+  std::vector<int32_t> outdeg_dup;     // [vocab]
+  std::vector<int32_t> edge_child;     // call-edge instances
+  std::vector<int32_t> edge_parent;
+  int64_t n_p = 0;
+};
+
+bool finish_partition(PartScratch& sc, const int32_t* pod_op,
+                      const int32_t* trace_id, const uint8_t* row_mask,
+                      int64_t n_rows, int64_t n_total_traces, int64_t vocab,
+                      MrPartition* out) {
+  // Local trace interning in ascending global-id order (np.unique order).
+  std::vector<int32_t> local_id(n_total_traces, -1);
+  std::vector<int32_t> local_uniques;
+  std::vector<int32_t> tracelen;
+  for (int64_t t = 0; t < n_total_traces; ++t) {
+    if (sc.counts_global[t] > 0) {
+      local_id[t] = static_cast<int32_t>(local_uniques.size());
+      local_uniques.push_back(static_cast<int32_t>(t));
+      tracelen.push_back(sc.counts_global[t]);
+    }
+  }
+  const int64_t n_traces = static_cast<int64_t>(local_uniques.size());
+
+  // Bucket-scatter ops by local trace, then sort each trace's bucket —
+  // buckets are small (avg spans/trace), so the sorts stay in cache.
+  std::vector<int64_t> tr_off(n_traces + 1, 0);
+  for (int64_t t = 0; t < n_traces; ++t) tr_off[t + 1] = tr_off[t] + tracelen[t];
+  std::vector<int64_t> cursor(tr_off.begin(), tr_off.end());
+  std::vector<int32_t> by_trace_op(sc.n_p);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    if (row_mask && !row_mask[r]) continue;
+    int32_t lt = local_id[trace_id[r]];
+    if (lt < 0 || !sc.flags[trace_id[r]]) continue;
+    by_trace_op[cursor[lt]++] = pod_op[r];
+  }
+
+  // Sort + dedup each trace group -> unique incidence; kind hash inline.
+  std::vector<int32_t> inc_op, inc_trace;
+  std::vector<float> sr_val;
+  std::vector<int32_t> cov_unique(vocab, 0);
+  std::vector<int64_t> u_start(n_traces + 1, 0);
+  std::vector<uint64_t> trace_hash(n_traces, 0);
+  inc_op.reserve(sc.n_p);
+  inc_trace.reserve(sc.n_p);
+  sr_val.reserve(sc.n_p);
+  for (int64_t t = 0; t < n_traces; ++t) {
+    int32_t* b = by_trace_op.data() + tr_off[t];
+    int32_t* e = by_trace_op.data() + tr_off[t + 1];
+    std::sort(b, e);
+    const float inv_len = 1.0f / static_cast<float>(tracelen[t]);
+    int32_t prev = -1;
+    uint64_t h = 0;
+    for (int32_t* p = b; p < e; ++p) {
+      if (*p == prev) continue;
+      prev = *p;
+      inc_op.push_back(*p);
+      inc_trace.push_back(static_cast<int32_t>(t));
+      sr_val.push_back(inv_len);
+      ++cov_unique[*p];
+      h += splitmix64(static_cast<uint64_t>(*p));
+    }
+    const int64_t n_uniq = static_cast<int64_t>(inc_op.size()) - u_start[t];
+    u_start[t + 1] = static_cast<int64_t>(inc_op.size());
+    trace_hash[t] = h ^ splitmix64(static_cast<uint64_t>(tracelen[t])) ^
+                    splitmix64(static_cast<uint64_t>(n_uniq) + 0x51ED270B9ULL);
+  }
+  const int64_t n_inc = static_cast<int64_t>(inc_op.size());
+  std::vector<float> rs_val(n_inc);
+  for (int64_t i = 0; i < n_inc; ++i)
+    rs_val[i] = 1.0f / static_cast<float>(sc.cov_dup[inc_op[i]]);
+  int64_t n_ops = 0;
+  std::vector<uint8_t> op_present(vocab, 0);
+  for (int64_t o = 0; o < vocab; ++o)
+    if (cov_unique[o] > 0) {
+      op_present[o] = 1;
+      ++n_ops;
+    }
+
+  // Unique call edges via two-pass stable counting sort of the collected
+  // (child, parent) instances: by parent, then by child.
+  const int64_t m_p = static_cast<int64_t>(sc.edge_child.size());
+  std::vector<int64_t> par_off(vocab + 1, 0);
+  for (int64_t p = 0; p < m_p; ++p) ++par_off[sc.edge_parent[p] + 1];
+  for (int64_t o = 0; o < vocab; ++o) par_off[o + 1] += par_off[o];
+  std::vector<int64_t> pcur(par_off.begin(), par_off.end());
+  std::vector<int32_t> by_parent_child(m_p);
+  for (int64_t p = 0; p < m_p; ++p)
+    by_parent_child[pcur[sc.edge_parent[p]]++] = sc.edge_child[p];
+  std::vector<int64_t> ch_off(vocab + 1, 0);
+  for (int64_t p = 0; p < m_p; ++p) ++ch_off[by_parent_child[p] + 1];
+  for (int64_t o = 0; o < vocab; ++o) ch_off[o + 1] += ch_off[o];
+  std::vector<int64_t> ccur(ch_off.begin(), ch_off.end());
+  std::vector<int32_t> by_child_parent(m_p);
+  {
+    int64_t par = 0;
+    for (int64_t p = 0; p < m_p; ++p) {
+      while (p >= par_off[par + 1]) ++par;
+      by_child_parent[ccur[by_parent_child[p]]++] = static_cast<int32_t>(par);
+    }
+  }
+  std::vector<int32_t> ss_child, ss_parent;
+  std::vector<float> ss_val;
+  {
+    int64_t child = 0;
+    int32_t prev_parent = -1;
+    for (int64_t p = 0; p < m_p; ++p) {
+      while (p >= ch_off[child + 1]) {
+        ++child;
+        prev_parent = -1;
+      }
+      int32_t par = by_child_parent[p];
+      if (par == prev_parent) continue;
+      prev_parent = par;
+      ss_child.push_back(static_cast<int32_t>(child));
+      ss_parent.push_back(par);
+      ss_val.push_back(1.0f / static_cast<float>(sc.outdeg_dup[par]));
+    }
+  }
+
+  // Trace kinds: two traces are one kind iff identical unique-op sequence
+  // AND identical span count (== p_sr-column equality, pagerank.py:54-66).
+  // Hash prefilter + exact compare on collision — always exact.
+  std::vector<int32_t> kind(n_traces, 0);
+  {
+    std::unordered_map<uint64_t, std::vector<int32_t>> groups;  // hash -> reps
+    std::vector<int32_t> group_of(n_traces, -1);
+    std::vector<int32_t> group_count;
+    groups.reserve(static_cast<size_t>(n_traces) * 2);
+    for (int64_t t = 0; t < n_traces; ++t) {
+      const int64_t s = u_start[t], e = u_start[t + 1];
+      auto& reps = groups[trace_hash[t]];
+      int32_t g = -1;
+      for (int32_t rep : reps) {
+        const int64_t rs = u_start[rep], re = u_start[rep + 1];
+        if (re - rs != e - s || tracelen[rep] != tracelen[t]) continue;
+        if (std::memcmp(&inc_op[rs], &inc_op[s],
+                        static_cast<size_t>(e - s) * sizeof(int32_t)) == 0) {
+          g = group_of[rep];
+          break;
+        }
+      }
+      if (g < 0) {
+        g = static_cast<int32_t>(group_count.size());
+        group_count.push_back(0);
+        reps.push_back(static_cast<int32_t>(t));
+      }
+      group_of[t] = g;
+      ++group_count[g];
+    }
+    for (int64_t t = 0; t < n_traces; ++t) kind[t] = group_count[group_of[t]];
+  }
+
+  out->n_inc = n_inc;
+  out->inc_op = copy_out(inc_op);
+  out->inc_trace = copy_out(inc_trace);
+  out->sr_val = copy_out(sr_val);
+  out->rs_val = copy_out(rs_val);
+  out->n_ss = static_cast<int64_t>(ss_child.size());
+  out->ss_child = copy_out(ss_child);
+  out->ss_parent = copy_out(ss_parent);
+  out->ss_val = copy_out(ss_val);
+  out->n_traces = n_traces;
+  out->kind = copy_out(kind);
+  out->tracelen = copy_out(tracelen);
+  out->local_uniques = copy_out(local_uniques);
+  out->cov_unique = copy_out(cov_unique);
+  out->op_present = copy_out(op_present);
+  out->n_ops = n_ops;
+  return !(out->inc_op == nullptr || out->inc_trace == nullptr ||
+           out->sr_val == nullptr || out->rs_val == nullptr ||
+           out->ss_child == nullptr || out->ss_parent == nullptr ||
+           out->ss_val == nullptr || out->kind == nullptr ||
+           out->tracelen == nullptr || out->local_uniques == nullptr ||
+           out->cov_unique == nullptr || out->op_present == nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+MrWindowGraph* mr_build_window(const int32_t* pod_op, const int32_t* trace_id,
+                               const int64_t* parent_row, int64_t n_rows,
+                               const uint8_t* row_mask,
+                               const uint8_t* normal_flag,
+                               const uint8_t* abnormal_flag,
+                               int64_t n_total_traces, int64_t vocab_size) {
+  auto* g = static_cast<MrWindowGraph*>(std::calloc(1, sizeof(MrWindowGraph)));
+  if (!g) return nullptr;
+
+  PartScratch sc[2];
+  sc[0].flags = normal_flag;
+  sc[1].flags = abnormal_flag;
+  for (PartScratch& s : sc) {
+    s.counts_global.assign(n_total_traces, 0);
+    s.cov_dup.assign(vocab_size, 0);
+    s.outdeg_dup.assign(vocab_size, 0);
+  }
+
+  // Fused stats pass: one scan accumulates BOTH partitions' per-trace
+  // counts, per-op duplicate coverage, and call-edge instances
+  // (preprocess_data.py:157-158 linkage: child row in the partition,
+  // parent span inside the window, parent's trace in the partition).
+  for (int64_t r = 0; r < n_rows; ++r) {
+    if (row_mask && !row_mask[r]) continue;
+    const int32_t t = trace_id[r];
+    const int32_t op = pod_op[r];
+    const int64_t pr = parent_row[r];
+    const bool parent_in_window = pr >= 0 && (!row_mask || row_mask[pr]);
+    for (PartScratch& s : sc) {
+      if (!s.flags[t]) continue;
+      ++s.counts_global[t];
+      ++s.cov_dup[op];
+      ++s.n_p;
+      if (parent_in_window && s.flags[trace_id[pr]]) {
+        ++s.outdeg_dup[pod_op[pr]];
+        s.edge_child.push_back(op);
+        s.edge_parent.push_back(pod_op[pr]);
+      }
+    }
+  }
+
+  g->error = nullptr;
+  for (int i = 0; i < 2; ++i)
+    if (!finish_partition(sc[i], pod_op, trace_id, row_mask, n_rows,
+                          n_total_traces, vocab_size, &g->parts[i]))
+      g->error = "allocation failure in mr_build_window";
+  return g;
+}
+
+void mr_free_window(MrWindowGraph* g) {
+  if (!g) return;
+  for (MrPartition& p : g->parts) {
+    std::free(p.inc_op);
+    std::free(p.inc_trace);
+    std::free(p.sr_val);
+    std::free(p.rs_val);
+    std::free(p.ss_child);
+    std::free(p.ss_parent);
+    std::free(p.ss_val);
+    std::free(p.kind);
+    std::free(p.tracelen);
+    std::free(p.local_uniques);
+    std::free(p.cov_unique);
+    std::free(p.op_present);
+  }
+  std::free(g);
+}
+
+}  // extern "C"

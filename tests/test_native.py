@@ -134,3 +134,56 @@ def test_table_lane_matches_pandas_lane(csv_pair):
     assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking]
     assert (a.n_normal, a.n_abnormal) == (b.n_normal, b.n_abnormal)
     assert a.ranking[0][0] == case.fault_pod_op
+
+
+def _assert_graphs_equal(g1, g2):
+    for side in ("normal", "abnormal"):
+        a, b = getattr(g1, side), getattr(g2, side)
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)),
+                np.asarray(getattr(b, f)),
+                err_msg=f"{side}.{f}",
+            )
+
+
+def test_native_graph_build_matches_numpy(csv_pair):
+    """C++ counting-sort builder is array-identical to the numpy lane."""
+    from microrank_tpu.graph.table_ops import build_window_graph_from_table
+
+    d, _ = csv_pair
+    tab = native.load_span_table(d / "abnormal.csv")
+    full = np.ones(tab.n_spans, dtype=bool)
+    partial = np.arange(tab.n_spans) % 3 != 0
+    for mask in (full, partial):
+        codes = np.unique(tab.trace_id[mask])
+        nrm, abn = codes[::2], codes[1::2]
+        g1, n1, a1, b1 = build_window_graph_from_table(
+            tab, mask, nrm, abn, use_native=True
+        )
+        g2, n2, a2, b2 = build_window_graph_from_table(
+            tab, mask, nrm, abn, use_native=False
+        )
+        assert n1 == n2
+        np.testing.assert_array_equal(a1, a2)
+        np.testing.assert_array_equal(b1, b2)
+        _assert_graphs_equal(g1, g2)
+
+
+def test_native_graph_build_empty_partition(csv_pair):
+    """One empty partition must not crash and must match numpy."""
+    from microrank_tpu.graph.table_ops import build_window_graph_from_table
+
+    d, _ = csv_pair
+    tab = native.load_span_table(d / "abnormal.csv")
+    mask = np.ones(tab.n_spans, dtype=bool)
+    codes = np.unique(tab.trace_id)
+    g1, _, a1, b1 = build_window_graph_from_table(
+        tab, mask, [], codes, use_native=True
+    )
+    g2, _, a2, b2 = build_window_graph_from_table(
+        tab, mask, [], codes, use_native=False
+    )
+    assert len(a1) == len(a2) == 0
+    np.testing.assert_array_equal(b1, b2)
+    _assert_graphs_equal(g1, g2)
