@@ -121,6 +121,11 @@ class RuntimeConfig:
     # Validate fetched ranking scores for NaN/inf (nearly free: results are
     # already on host when checked).
     validate_numerics: bool = True
+    # Window-loop pipelining (table lane): number of device rank programs
+    # allowed in flight before the host blocks. 2 overlaps window N's
+    # device execution with window N+1's host graph build (jax async
+    # dispatch); 1 restores fully synchronous per-window execution.
+    pipeline_depth: int = 2
 
 
 @dataclass(frozen=True)

@@ -105,30 +105,24 @@ def detect_batch_from_table(
     return batch, uniques
 
 
-def _graph_from_raw(raw, vocab_size, v_pad, pad_policy, min_pad):
-    """Pad one native RawPartition into a PartitionGraph."""
-    n_inc = len(raw.inc_op)
-    n_ss = len(raw.ss_child)
-    n_traces = len(raw.kind)
-    e_pad = pad_to(n_inc, pad_policy, min_pad)
-    c_pad = pad_to(n_ss, pad_policy, min_pad)
-    t_pad = pad_to(n_traces, pad_policy, min_pad)
+def _graph_from_padded(p):
+    """Wrap one native PaddedPartition (already padded) as PartitionGraph."""
     return PartitionGraph(
-        inc_op=pad1d(raw.inc_op, e_pad),
-        inc_trace=pad1d(raw.inc_trace, e_pad),
-        sr_val=pad1d(raw.sr_val, e_pad),
-        rs_val=pad1d(raw.rs_val, e_pad),
-        ss_child=pad1d(raw.ss_child, c_pad),
-        ss_parent=pad1d(raw.ss_parent, c_pad),
-        ss_val=pad1d(raw.ss_val, c_pad),
-        kind=pad1d(raw.kind, t_pad, fill=1),
-        tracelen=pad1d(raw.tracelen, t_pad, fill=1),
-        cov_unique=pad1d(raw.cov_unique, v_pad),
-        op_present=pad1d(raw.op_present, v_pad, fill=False),
-        n_ops=np.int32(raw.n_ops),
-        n_traces=np.int32(n_traces),
-        n_inc=np.int32(n_inc),
-        n_ss=np.int32(n_ss),
+        inc_op=p.inc_op,
+        inc_trace=p.inc_trace,
+        sr_val=p.sr_val,
+        rs_val=p.rs_val,
+        ss_child=p.ss_child,
+        ss_parent=p.ss_parent,
+        ss_val=p.ss_val,
+        kind=p.kind,
+        tracelen=p.tracelen,
+        cov_unique=p.cov_unique,
+        op_present=p.op_present,
+        n_ops=np.int32(p.n_ops),
+        n_traces=np.int32(p.n_traces),
+        n_inc=np.int32(p.n_inc),
+        n_ss=np.int32(p.n_ss),
     )
 
 
@@ -158,7 +152,7 @@ def build_window_graph_from_table(
     if use_native:
         from ..native import (
             NativeUnavailable,
-            build_window_native,
+            build_window_padded,
             native_available,
         )
 
@@ -174,7 +168,7 @@ def build_window_graph_from_table(
                 af[acodes] = 1
             full = bool(np.all(mask))
             try:
-                raw_n, raw_a = build_window_native(
+                raw_n, raw_a = build_window_padded(
                     table.pod_op,
                     table.trace_id,
                     table.parent_row,
@@ -182,17 +176,15 @@ def build_window_graph_from_table(
                     nf,
                     af,
                     vocab_size,
+                    v_pad,
+                    lambda n: pad_to(n, pad_policy, min_pad),
                 )
             except NativeUnavailable:
                 raw_n = raw_a = None  # fall through to the numpy lane
             if raw_n is not None:
                 graph = WindowGraph(
-                    normal=_graph_from_raw(
-                        raw_n, vocab_size, v_pad, pad_policy, min_pad
-                    ),
-                    abnormal=_graph_from_raw(
-                        raw_a, vocab_size, v_pad, pad_policy, min_pad
-                    ),
+                    normal=_graph_from_padded(raw_n),
+                    abnormal=_graph_from_padded(raw_a),
                 )
                 return (
                     graph,

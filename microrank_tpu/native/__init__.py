@@ -5,8 +5,9 @@ use (g++ -O3; cached next to the sources) and exposes:
 
 * ``load_span_table(path)`` — mmap CSV ingest to a ``SpanTable`` of
   interned numpy arrays;
-* ``build_window_native(...)`` — fused counting-sort window-graph build
-  (both partitions in single scans), array-compatible with the numpy lane
+* ``build_window_padded(...)`` — fused counting-sort window-graph build
+  (both partitions in single scans), exported straight into padded numpy
+  buffers; array-compatible with the numpy lane
   (graph.build._build_partition).
 
 Falls back cleanly: callers should catch ``NativeUnavailable`` and use the
@@ -83,34 +84,6 @@ class _MrSpanTable(ctypes.Structure):
     ]
 
 
-class _MrPartition(ctypes.Structure):
-    _fields_ = [
-        ("n_inc", ctypes.c_int64),
-        ("inc_op", ctypes.POINTER(ctypes.c_int32)),
-        ("inc_trace", ctypes.POINTER(ctypes.c_int32)),
-        ("sr_val", ctypes.POINTER(ctypes.c_float)),
-        ("rs_val", ctypes.POINTER(ctypes.c_float)),
-        ("n_ss", ctypes.c_int64),
-        ("ss_child", ctypes.POINTER(ctypes.c_int32)),
-        ("ss_parent", ctypes.POINTER(ctypes.c_int32)),
-        ("ss_val", ctypes.POINTER(ctypes.c_float)),
-        ("n_traces", ctypes.c_int64),
-        ("kind", ctypes.POINTER(ctypes.c_int32)),
-        ("tracelen", ctypes.POINTER(ctypes.c_int32)),
-        ("local_uniques", ctypes.POINTER(ctypes.c_int32)),
-        ("cov_unique", ctypes.POINTER(ctypes.c_int32)),
-        ("op_present", ctypes.POINTER(ctypes.c_uint8)),
-        ("n_ops", ctypes.c_int64),
-    ]
-
-
-class _MrWindowGraph(ctypes.Structure):
-    _fields_ = [
-        ("parts", _MrPartition * 2),
-        ("error", ctypes.c_char_p),
-    ]
-
-
 def _build_library() -> None:
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
@@ -142,20 +115,33 @@ def _load_library() -> ctypes.CDLL:
     lib.mr_free_table.restype = None
     lib.mr_free_table.argtypes = [ctypes.POINTER(_MrSpanTable)]
     u8p = ctypes.POINTER(ctypes.c_uint8)
-    lib.mr_build_window.restype = ctypes.POINTER(_MrWindowGraph)
-    lib.mr_build_window.argtypes = [
-        ctypes.POINTER(ctypes.c_int32),  # pod_op
-        ctypes.POINTER(ctypes.c_int32),  # trace_id
-        ctypes.POINTER(ctypes.c_int64),  # parent_row
-        ctypes.c_int64,                  # n_rows
-        u8p,                             # row_mask (nullable)
-        u8p,                             # normal_flag
-        u8p,                             # abnormal_flag
-        ctypes.c_int64,                  # n_total_traces
-        ctypes.c_int64,                  # vocab_size
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.mr_build_window2.restype = ctypes.c_void_p
+    lib.mr_build_window2.argtypes = [
+        i32p,            # pod_op
+        i32p,            # trace_id
+        i64p,            # parent_row
+        ctypes.c_int64,  # n_rows
+        u8p,             # row_mask (nullable)
+        u8p,             # normal_flag
+        u8p,             # abnormal_flag
+        ctypes.c_int64,  # n_total_traces
+        ctypes.c_int64,  # vocab_size
     ]
-    lib.mr_free_window.restype = None
-    lib.mr_free_window.argtypes = [ctypes.POINTER(_MrWindowGraph)]
+    lib.mr_window_sizes.restype = None
+    lib.mr_window_sizes.argtypes = [ctypes.c_void_p, i64p]
+    lib.mr_export_partition.restype = None
+    lib.mr_export_partition.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        i32p, i32p, f32p, f32p,          # inc_op, inc_trace, sr, rs
+        i32p, i32p, f32p,                # ss_child, ss_parent, ss_val
+        i32p, i32p, i32p,                # kind, tracelen, local_uniques
+        i32p, u8p,                       # cov_unique, op_present
+    ]
+    lib.mr_free_built.restype = None
+    lib.mr_free_built.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -176,10 +162,88 @@ def native_available() -> bool:
         return False
 
 
+_SIDECAR_VERSION = 1
+
+
+def _sidecar_path(path: Path, strip_services) -> Path:
+    import hashlib
+
+    tag = hashlib.sha1(
+        ",".join(sorted(strip_services)).encode()
+    ).hexdigest()[:8]
+    return path.with_suffix(path.suffix + f".mrt-{tag}.npz")
+
+
+def _load_sidecar(path: Path, side: Path) -> Optional[SpanTable]:
+    import zipfile
+
+    try:
+        st = path.stat()
+        with np.load(side, allow_pickle=False) as z:
+            if int(z["version"][0]) != _SIDECAR_VERSION:
+                return None
+            # Freshness: the sidecar records the source CSV's (mtime, size)
+            # at save time — a replaced dump with a preserved/older mtime
+            # still invalidates via the size (and any mtime change does).
+            src = z["source_stat"]
+            if int(src[0]) != st.st_mtime_ns or int(src[1]) != st.st_size:
+                return None
+            return SpanTable(
+                trace_id=z["trace_id"],
+                svc_op=z["svc_op"],
+                pod_op=z["pod_op"],
+                duration_us=z["duration_us"],
+                start_us=z["start_us"],
+                end_us=z["end_us"],
+                parent_row=z["parent_row"],
+                trace_names=list(z["trace_names"]),
+                svc_op_names=list(z["svc_op_names"]),
+                pod_op_names=list(z["pod_op_names"]),
+            )
+    except (OSError, KeyError, ValueError, zipfile.BadZipFile):
+        return None
+
+
+def _save_sidecar(side: Path, source: Path, table: SpanTable) -> None:
+    try:
+        st = source.stat()
+        tmp = side.with_suffix(".tmp.npz")
+        np.savez(
+            tmp,
+            version=np.array([_SIDECAR_VERSION]),
+            source_stat=np.array([st.st_mtime_ns, st.st_size], dtype=np.int64),
+            trace_id=table.trace_id,
+            svc_op=table.svc_op,
+            pod_op=table.pod_op,
+            duration_us=table.duration_us,
+            start_us=table.start_us,
+            end_us=table.end_us,
+            parent_row=table.parent_row,
+            trace_names=np.array(table.trace_names, dtype=np.str_),
+            svc_op_names=np.array(table.svc_op_names, dtype=np.str_),
+            pod_op_names=np.array(table.pod_op_names, dtype=np.str_),
+        )
+        os.replace(tmp, side)
+    except OSError:  # cache is best-effort (read-only dirs, full disk)
+        pass
+
+
 def load_span_table(
-    path, strip_services=("ts-ui-dashboard",)
+    path, strip_services=("ts-ui-dashboard",), cache: bool = True
 ) -> SpanTable:
-    """Load one traces.csv (raw ClickHouse export or canonical schema)."""
+    """Load one traces.csv (raw ClickHouse export or canonical schema).
+
+    With ``cache`` (default), the interned arrays are persisted to an
+    ``.mrt-*.npz`` sidecar next to the CSV and reused on later loads when
+    fresher than the CSV — repeat replays of the same dump skip the parse
+    entirely.
+    """
+    path = Path(path)
+    side = _sidecar_path(path, strip_services)
+    if cache:
+        cached = _load_sidecar(path, side)
+        if cached is not None:
+            return cached
     lib = _load_library()
     res = lib.mr_load_csv(
         str(path).encode(), ",".join(strip_services).encode()
@@ -216,40 +280,42 @@ def load_span_table(
                 t.pod_blob, t.pod_offsets, int(t.n_pod_ops)
             ),
         )
+        if cache:
+            _save_sidecar(side, path, table)
         return table
     finally:
         lib.mr_free_table(res)
 
 
-class RawPartition(NamedTuple):
-    """Unpadded arrays of one partition graph, as built by C++.
+class PaddedPartition(NamedTuple):
+    """One partition graph with arrays pre-padded by the caller's policy.
 
-    Field semantics match graph.build._build_partition's outputs; callers
-    (graph.table_ops) pad and assemble the PartitionGraph.
+    Array semantics match graph.build._build_partition's outputs after
+    pad1d; ``local_uniques`` (global trace code per local trace id) is
+    exact-length. C++ fills the leading true-length prefix of each array;
+    the padding keeps the allocation-time fill (zeros, or ones for
+    kind/tracelen — the same fills pad1d uses).
     """
 
-    inc_op: np.ndarray       # int32[n_inc]
-    inc_trace: np.ndarray    # int32[n_inc]
-    sr_val: np.ndarray       # float32[n_inc]
-    rs_val: np.ndarray       # float32[n_inc]
-    ss_child: np.ndarray     # int32[n_ss]
-    ss_parent: np.ndarray    # int32[n_ss]
-    ss_val: np.ndarray       # float32[n_ss]
-    kind: np.ndarray         # int32[n_traces]
-    tracelen: np.ndarray     # int32[n_traces]
-    local_uniques: np.ndarray  # int32[n_traces] global trace codes
-    cov_unique: np.ndarray   # int32[vocab]
-    op_present: np.ndarray   # bool[vocab]
+    inc_op: np.ndarray       # int32[e_pad]
+    inc_trace: np.ndarray    # int32[e_pad]
+    sr_val: np.ndarray       # float32[e_pad]
+    rs_val: np.ndarray       # float32[e_pad]
+    ss_child: np.ndarray     # int32[c_pad]
+    ss_parent: np.ndarray    # int32[c_pad]
+    ss_val: np.ndarray       # float32[c_pad]
+    kind: np.ndarray         # int32[t_pad], padded with 1
+    tracelen: np.ndarray     # int32[t_pad], padded with 1
+    local_uniques: np.ndarray  # int32[n_traces]
+    cov_unique: np.ndarray   # int32[v_pad]
+    op_present: np.ndarray   # bool[v_pad]
     n_ops: int
+    n_traces: int
+    n_inc: int
+    n_ss: int
 
 
-def _take(ptr, n: int, dtype) -> np.ndarray:
-    if n == 0:
-        return np.zeros(0, dtype=dtype)
-    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
-
-
-def build_window_native(
+def build_window_padded(
     pod_op: np.ndarray,
     trace_id: np.ndarray,
     parent_row: np.ndarray,
@@ -257,12 +323,16 @@ def build_window_native(
     normal_flag: np.ndarray,
     abnormal_flag: np.ndarray,
     vocab_size: int,
-) -> Tuple[RawPartition, RawPartition]:
-    """Build both partitions' raw COO graphs in C++ (fused single scans).
+    v_pad: int,
+    pad,
+) -> Tuple[PaddedPartition, PaddedPartition]:
+    """Build both partitions' COO graphs in C++ (fused single scans),
+    exported directly into padded numpy buffers (single copy).
 
     ``normal_flag``/``abnormal_flag`` are bool arrays over the table's
     global trace codes; ``row_mask`` (bool over rows, or None for all)
-    is the detection window (get_span semantics applied upstream).
+    is the detection window (get_span semantics applied upstream);
+    ``pad`` maps a true length to its padded length (>= the true length).
     """
     lib = _load_library()
     pod_op = np.ascontiguousarray(pod_op, dtype=np.int32)
@@ -270,16 +340,16 @@ def build_window_native(
     parent_row = np.ascontiguousarray(parent_row, dtype=np.int64)
     nf = np.ascontiguousarray(normal_flag, dtype=np.uint8)
     af = np.ascontiguousarray(abnormal_flag, dtype=np.uint8)
-    n_total = len(nf)
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
     u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
     if row_mask is None:
         mask_ptr = ctypes.cast(None, u8p)
     else:
         row_mask = np.ascontiguousarray(row_mask, dtype=np.uint8)
         mask_ptr = row_mask.ctypes.data_as(u8p)
-    res = lib.mr_build_window(
+    handle = lib.mr_build_window2(
         pod_op.ctypes.data_as(i32p),
         trace_id.ctypes.data_as(i32p),
         parent_row.ctypes.data_as(i64p),
@@ -287,46 +357,62 @@ def build_window_native(
         mask_ptr,
         nf.ctypes.data_as(u8p),
         af.ctypes.data_as(u8p),
-        ctypes.c_int64(n_total),
+        ctypes.c_int64(len(nf)),
         ctypes.c_int64(vocab_size),
     )
-    if not res:
-        raise NativeUnavailable("mr_build_window allocation failed")
+    if not handle:
+        raise NativeUnavailable("mr_build_window2 allocation failed")
     try:
-        if res.contents.error:
-            raise NativeUnavailable(res.contents.error.decode())
+        sizes = np.zeros(8, dtype=np.int64)
+        lib.mr_window_sizes(handle, sizes.ctypes.data_as(i64p))
         out = []
-        for p in res.contents.parts:
-            n_inc, n_ss, n_tr = int(p.n_inc), int(p.n_ss), int(p.n_traces)
-            out.append(
-                RawPartition(
-                    inc_op=_take(p.inc_op, n_inc, np.int32),
-                    inc_trace=_take(p.inc_trace, n_inc, np.int32),
-                    sr_val=_take(p.sr_val, n_inc, np.float32),
-                    rs_val=_take(p.rs_val, n_inc, np.float32),
-                    ss_child=_take(p.ss_child, n_ss, np.int32),
-                    ss_parent=_take(p.ss_parent, n_ss, np.int32),
-                    ss_val=_take(p.ss_val, n_ss, np.float32),
-                    kind=_take(p.kind, n_tr, np.int32),
-                    tracelen=_take(p.tracelen, n_tr, np.int32),
-                    local_uniques=_take(p.local_uniques, n_tr, np.int32),
-                    cov_unique=_take(p.cov_unique, vocab_size, np.int32),
-                    op_present=_take(p.op_present, vocab_size, np.uint8).astype(
-                        bool
-                    ),
-                    n_ops=int(p.n_ops),
-                )
+        for idx in range(2):
+            n_inc, n_ss, n_tr, n_ops = (int(x) for x in sizes[4 * idx: 4 * idx + 4])
+            e_pad, c_pad, t_pad = pad(n_inc), pad(n_ss), pad(n_tr)
+            p = PaddedPartition(
+                inc_op=np.zeros(e_pad, np.int32),
+                inc_trace=np.zeros(e_pad, np.int32),
+                sr_val=np.zeros(e_pad, np.float32),
+                rs_val=np.zeros(e_pad, np.float32),
+                ss_child=np.zeros(c_pad, np.int32),
+                ss_parent=np.zeros(c_pad, np.int32),
+                ss_val=np.zeros(c_pad, np.float32),
+                kind=np.ones(t_pad, np.int32),
+                tracelen=np.ones(t_pad, np.int32),
+                local_uniques=np.zeros(n_tr, np.int32),
+                cov_unique=np.zeros(v_pad, np.int32),
+                op_present=np.zeros(v_pad, np.bool_),
+                n_ops=n_ops,
+                n_traces=n_tr,
+                n_inc=n_inc,
+                n_ss=n_ss,
             )
+            lib.mr_export_partition(
+                handle, ctypes.c_int32(idx),
+                p.inc_op.ctypes.data_as(i32p),
+                p.inc_trace.ctypes.data_as(i32p),
+                p.sr_val.ctypes.data_as(f32p),
+                p.rs_val.ctypes.data_as(f32p),
+                p.ss_child.ctypes.data_as(i32p),
+                p.ss_parent.ctypes.data_as(i32p),
+                p.ss_val.ctypes.data_as(f32p),
+                p.kind.ctypes.data_as(i32p),
+                p.tracelen.ctypes.data_as(i32p),
+                p.local_uniques.ctypes.data_as(i32p),
+                p.cov_unique.ctypes.data_as(i32p),
+                p.op_present.ctypes.data_as(u8p),
+            )
+            out.append(p)
         return out[0], out[1]
     finally:
-        lib.mr_free_window(res)
+        lib.mr_free_built(handle)
 
 
 __all__ = [
     "SpanTable",
-    "RawPartition",
+    "PaddedPartition",
     "NativeUnavailable",
     "load_span_table",
-    "build_window_native",
+    "build_window_padded",
     "native_available",
 ]

@@ -10,26 +10,28 @@
 // in-cache per-trace sorts for the unique-op rows, and one counting sort
 // for the call edges — O(n + V + T) total.
 //
+// Two-phase API: mr_build_window2 computes everything and returns an
+// opaque handle; mr_window_sizes reports array lengths; mr_export_partition
+// copies each partition once, directly into caller-allocated (padded)
+// numpy buffers — no intermediate heap copies on either side.
+//
 // Output order is kept identical to the numpy lane (incidence sorted by
 // (local trace asc, op asc), call edges by (child asc, parent asc), local
 // trace ids assigned in ascending global-id order) so the two lanes are
 // array-for-array interchangeable.
-//
-// Plain C ABI (ctypes-friendly); all output arrays are heap-allocated and
-// released with mr_free_window.
 
 #include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <new>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
-// Splitmix64 finalizer — matches graph/build.py:_splitmix64 so both lanes
-// group trace kinds through the same hash prefilter (equality is still
-// decided by exact sequence compare below).
+// Splitmix64 finalizer (same mixer family as graph/build.py:_splitmix64).
+// Only a prefilter here — kind equality is decided by exact compare.
 inline uint64_t splitmix64(uint64_t x) {
   x += 0x9E3779B97F4A7C15ULL;
   x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
@@ -37,43 +39,25 @@ inline uint64_t splitmix64(uint64_t x) {
   return x ^ (x >> 31);
 }
 
-template <typename T>
-T* copy_out(const std::vector<T>& v) {
-  T* p = static_cast<T*>(std::malloc(v.size() * sizeof(T) + 1));
-  if (p && !v.empty()) std::memcpy(p, v.data(), v.size() * sizeof(T));
-  return p;
-}
+struct BuiltPartition {
+  std::vector<int32_t> inc_op, inc_trace;
+  std::vector<float> sr_val, rs_val;
+  std::vector<int32_t> ss_child, ss_parent;
+  std::vector<float> ss_val;
+  std::vector<int32_t> kind, tracelen, local_uniques;
+  std::vector<int32_t> cov_unique;
+  std::vector<uint8_t> op_present;
+  int64_t n_ops = 0;
+};
 
 }  // namespace
 
 extern "C" {
 
-struct MrPartition {
-  // Unique (trace, op) incidence, sorted by (trace asc, op asc).
-  int64_t n_inc;
-  int32_t* inc_op;
-  int32_t* inc_trace;
-  float* sr_val;  // 1 / tracelen_with_dups(trace)   (pagerank.py:42-45)
-  float* rs_val;  // 1 / coverage_with_dups(op)      (pagerank.py:48-52)
-  // Unique call edges, sorted by (child asc, parent asc).
-  int64_t n_ss;
-  int32_t* ss_child;
-  int32_t* ss_parent;
-  float* ss_val;  // 1 / outdeg_with_dups(parent)    (pagerank.py:35-39)
-  // Per-local-trace stats.
-  int64_t n_traces;
-  int32_t* kind;           // kind-class size          (pagerank.py:54-66)
-  int32_t* tracelen;       // span count with dups
-  int32_t* local_uniques;  // global trace code of local trace i
-  // Per-op stats over the full vocab.
-  int32_t* cov_unique;  // #traces covering op (unique)
-  uint8_t* op_present;
-  int64_t n_ops;
-};
-
-struct MrWindowGraph {
-  MrPartition parts[2];  // [0]=normal, [1]=abnormal
-  const char* error;
+// Opaque to callers; errors are signaled by a null handle from
+// mr_build_window2 (allocation failure).
+struct MrBuiltWindow {
+  BuiltPartition parts[2];  // [0]=normal, [1]=abnormal
 };
 
 }  // extern "C"
@@ -82,49 +66,29 @@ namespace {
 
 // Scratch accumulated for one partition during the fused scans.
 struct PartScratch {
-  const uint8_t* flags;
   std::vector<int32_t> counts_global;  // [n_total_traces] span counts
   std::vector<int32_t> cov_dup;        // [vocab]
   std::vector<int32_t> outdeg_dup;     // [vocab]
   std::vector<int32_t> edge_child;     // call-edge instances
   std::vector<int32_t> edge_parent;
+  std::vector<int32_t> local_id;       // [n_total_traces] global -> local
+  std::vector<int64_t> tr_off;         // [n_traces+1] bucket offsets
+  std::vector<int32_t> by_trace_op;    // [n_p] ops bucketed by local trace
   int64_t n_p = 0;
 };
 
-bool finish_partition(PartScratch& sc, const int32_t* pod_op,
-                      const int32_t* trace_id, const uint8_t* row_mask,
-                      int64_t n_rows, int64_t n_total_traces, int64_t vocab,
-                      MrPartition* out) {
-  // Local trace interning in ascending global-id order (np.unique order).
-  std::vector<int32_t> local_id(n_total_traces, -1);
-  std::vector<int32_t> local_uniques;
-  std::vector<int32_t> tracelen;
-  for (int64_t t = 0; t < n_total_traces; ++t) {
-    if (sc.counts_global[t] > 0) {
-      local_id[t] = static_cast<int32_t>(local_uniques.size());
-      local_uniques.push_back(static_cast<int32_t>(t));
-      tracelen.push_back(sc.counts_global[t]);
-    }
-  }
-  const int64_t n_traces = static_cast<int64_t>(local_uniques.size());
-
-  // Bucket-scatter ops by local trace, then sort each trace's bucket —
-  // buckets are small (avg spans/trace), so the sorts stay in cache.
-  std::vector<int64_t> tr_off(n_traces + 1, 0);
-  for (int64_t t = 0; t < n_traces; ++t) tr_off[t + 1] = tr_off[t] + tracelen[t];
-  std::vector<int64_t> cursor(tr_off.begin(), tr_off.end());
-  std::vector<int32_t> by_trace_op(sc.n_p);
-  for (int64_t r = 0; r < n_rows; ++r) {
-    if (row_mask && !row_mask[r]) continue;
-    int32_t lt = local_id[trace_id[r]];
-    if (lt < 0 || !sc.flags[trace_id[r]]) continue;
-    by_trace_op[cursor[lt]++] = pod_op[r];
-  }
+void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
+  const int64_t n_traces = static_cast<int64_t>(out->local_uniques.size());
+  auto& tracelen = out->tracelen;
+  const std::vector<int64_t>& tr_off = sc.tr_off;
+  std::vector<int32_t>& by_trace_op = sc.by_trace_op;
 
   // Sort + dedup each trace group -> unique incidence; kind hash inline.
-  std::vector<int32_t> inc_op, inc_trace;
-  std::vector<float> sr_val;
-  std::vector<int32_t> cov_unique(vocab, 0);
+  auto& inc_op = out->inc_op;
+  auto& inc_trace = out->inc_trace;
+  auto& sr_val = out->sr_val;
+  out->cov_unique.assign(vocab, 0);
+  auto& cov_unique = out->cov_unique;
   std::vector<int64_t> u_start(n_traces + 1, 0);
   std::vector<uint64_t> trace_hash(n_traces, 0);
   inc_op.reserve(sc.n_p);
@@ -152,19 +116,20 @@ bool finish_partition(PartScratch& sc, const int32_t* pod_op,
                     splitmix64(static_cast<uint64_t>(n_uniq) + 0x51ED270B9ULL);
   }
   const int64_t n_inc = static_cast<int64_t>(inc_op.size());
-  std::vector<float> rs_val(n_inc);
+  out->rs_val.resize(n_inc);
   for (int64_t i = 0; i < n_inc; ++i)
-    rs_val[i] = 1.0f / static_cast<float>(sc.cov_dup[inc_op[i]]);
-  int64_t n_ops = 0;
-  std::vector<uint8_t> op_present(vocab, 0);
+    out->rs_val[i] = 1.0f / static_cast<float>(sc.cov_dup[inc_op[i]]);
+  out->op_present.assign(vocab, 0);
   for (int64_t o = 0; o < vocab; ++o)
     if (cov_unique[o] > 0) {
-      op_present[o] = 1;
-      ++n_ops;
+      out->op_present[o] = 1;
+      ++out->n_ops;
     }
 
   // Unique call edges via two-pass stable counting sort of the collected
-  // (child, parent) instances: by parent, then by child.
+  // (child, parent) instances: by parent, then by child — the resulting
+  // (child asc, parent asc) order matches the numpy lane's packed-key
+  // np.unique.
   const int64_t m_p = static_cast<int64_t>(sc.edge_child.size());
   std::vector<int64_t> par_off(vocab + 1, 0);
   for (int64_t p = 0; p < m_p; ++p) ++par_off[sc.edge_parent[p] + 1];
@@ -185,8 +150,6 @@ bool finish_partition(PartScratch& sc, const int32_t* pod_op,
       by_child_parent[ccur[by_parent_child[p]]++] = static_cast<int32_t>(par);
     }
   }
-  std::vector<int32_t> ss_child, ss_parent;
-  std::vector<float> ss_val;
   {
     int64_t child = 0;
     int32_t prev_parent = -1;
@@ -195,19 +158,19 @@ bool finish_partition(PartScratch& sc, const int32_t* pod_op,
         ++child;
         prev_parent = -1;
       }
-      int32_t par = by_child_parent[p];
+      const int32_t par = by_child_parent[p];
       if (par == prev_parent) continue;
       prev_parent = par;
-      ss_child.push_back(static_cast<int32_t>(child));
-      ss_parent.push_back(par);
-      ss_val.push_back(1.0f / static_cast<float>(sc.outdeg_dup[par]));
+      out->ss_child.push_back(static_cast<int32_t>(child));
+      out->ss_parent.push_back(par);
+      out->ss_val.push_back(1.0f / static_cast<float>(sc.outdeg_dup[par]));
     }
   }
 
   // Trace kinds: two traces are one kind iff identical unique-op sequence
   // AND identical span count (== p_sr-column equality, pagerank.py:54-66).
   // Hash prefilter + exact compare on collision — always exact.
-  std::vector<int32_t> kind(n_traces, 0);
+  out->kind.assign(n_traces, 0);
   {
     std::unordered_map<uint64_t, std::vector<int32_t>> groups;  // hash -> reps
     std::vector<int32_t> group_of(n_traces, -1);
@@ -234,103 +197,157 @@ bool finish_partition(PartScratch& sc, const int32_t* pod_op,
       group_of[t] = g;
       ++group_count[g];
     }
-    for (int64_t t = 0; t < n_traces; ++t) kind[t] = group_count[group_of[t]];
+    for (int64_t t = 0; t < n_traces; ++t)
+      out->kind[t] = group_count[group_of[t]];
   }
-
-  out->n_inc = n_inc;
-  out->inc_op = copy_out(inc_op);
-  out->inc_trace = copy_out(inc_trace);
-  out->sr_val = copy_out(sr_val);
-  out->rs_val = copy_out(rs_val);
-  out->n_ss = static_cast<int64_t>(ss_child.size());
-  out->ss_child = copy_out(ss_child);
-  out->ss_parent = copy_out(ss_parent);
-  out->ss_val = copy_out(ss_val);
-  out->n_traces = n_traces;
-  out->kind = copy_out(kind);
-  out->tracelen = copy_out(tracelen);
-  out->local_uniques = copy_out(local_uniques);
-  out->cov_unique = copy_out(cov_unique);
-  out->op_present = copy_out(op_present);
-  out->n_ops = n_ops;
-  return !(out->inc_op == nullptr || out->inc_trace == nullptr ||
-           out->sr_val == nullptr || out->rs_val == nullptr ||
-           out->ss_child == nullptr || out->ss_parent == nullptr ||
-           out->ss_val == nullptr || out->kind == nullptr ||
-           out->tracelen == nullptr || out->local_uniques == nullptr ||
-           out->cov_unique == nullptr || out->op_present == nullptr);
 }
 
 }  // namespace
 
 extern "C" {
 
-MrWindowGraph* mr_build_window(const int32_t* pod_op, const int32_t* trace_id,
-                               const int64_t* parent_row, int64_t n_rows,
-                               const uint8_t* row_mask,
-                               const uint8_t* normal_flag,
-                               const uint8_t* abnormal_flag,
-                               int64_t n_total_traces, int64_t vocab_size) {
-  auto* g = static_cast<MrWindowGraph*>(std::calloc(1, sizeof(MrWindowGraph)));
-  if (!g) return nullptr;
+MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
+                                const int64_t* parent_row, int64_t n_rows,
+                                const uint8_t* row_mask,
+                                const uint8_t* normal_flag,
+                                const uint8_t* abnormal_flag,
+                                int64_t n_total_traces, int64_t vocab_size) {
+  MrBuiltWindow* g = nullptr;
+  try {
+    g = new MrBuiltWindow();
 
-  PartScratch sc[2];
-  sc[0].flags = normal_flag;
-  sc[1].flags = abnormal_flag;
-  for (PartScratch& s : sc) {
-    s.counts_global.assign(n_total_traces, 0);
-    s.cov_dup.assign(vocab_size, 0);
-    s.outdeg_dup.assign(vocab_size, 0);
-  }
+    // Combined membership code per global trace: bit0=normal, bit1=abnormal
+    // (one cache line probe per row instead of two).
+    std::vector<uint8_t> part_bit(n_total_traces);
+    for (int64_t t = 0; t < n_total_traces; ++t)
+      part_bit[t] =
+          static_cast<uint8_t>((normal_flag[t] != 0) | ((abnormal_flag[t] != 0) << 1));
 
-  // Fused stats pass: one scan accumulates BOTH partitions' per-trace
-  // counts, per-op duplicate coverage, and call-edge instances
-  // (preprocess_data.py:157-158 linkage: child row in the partition,
-  // parent span inside the window, parent's trace in the partition).
-  for (int64_t r = 0; r < n_rows; ++r) {
-    if (row_mask && !row_mask[r]) continue;
-    const int32_t t = trace_id[r];
-    const int32_t op = pod_op[r];
-    const int64_t pr = parent_row[r];
-    const bool parent_in_window = pr >= 0 && (!row_mask || row_mask[pr]);
+    PartScratch sc[2];
     for (PartScratch& s : sc) {
-      if (!s.flags[t]) continue;
-      ++s.counts_global[t];
-      ++s.cov_dup[op];
-      ++s.n_p;
-      if (parent_in_window && s.flags[trace_id[pr]]) {
-        ++s.outdeg_dup[pod_op[pr]];
-        s.edge_child.push_back(op);
-        s.edge_parent.push_back(pod_op[pr]);
+      s.counts_global.assign(n_total_traces, 0);
+      s.cov_dup.assign(vocab_size, 0);
+      s.outdeg_dup.assign(vocab_size, 0);
+      if (!row_mask) {  // full-table builds: edges ~ rows; windows grow
+        s.edge_child.reserve(n_rows / 2);
+        s.edge_parent.reserve(n_rows / 2);
       }
     }
-  }
 
-  g->error = nullptr;
-  for (int i = 0; i < 2; ++i)
-    if (!finish_partition(sc[i], pod_op, trace_id, row_mask, n_rows,
-                          n_total_traces, vocab_size, &g->parts[i]))
-      g->error = "allocation failure in mr_build_window";
+    // Fused stats pass: one scan accumulates BOTH partitions' per-trace
+    // counts, per-op duplicate coverage, and call-edge instances
+    // (preprocess_data.py:157-158 linkage: child row in the partition,
+    // parent span inside the window, parent's trace in the partition).
+    for (int64_t r = 0; r < n_rows; ++r) {
+      if (row_mask && !row_mask[r]) continue;
+      const uint8_t code = part_bit[trace_id[r]];
+      if (!code) continue;
+      const int32_t t = trace_id[r];
+      const int32_t op = pod_op[r];
+      const int64_t pr = parent_row[r];
+      uint8_t ecode = 0;
+      int32_t pop = 0;
+      if (pr >= 0 && (!row_mask || row_mask[pr])) {
+        ecode = static_cast<uint8_t>(code & part_bit[trace_id[pr]]);
+        pop = pod_op[pr];
+      }
+      for (int i = 0; i < 2; ++i) {
+        if (!(code & (1 << i))) continue;
+        PartScratch& s = sc[i];
+        ++s.counts_global[t];
+        ++s.cov_dup[op];
+        ++s.n_p;
+        if (ecode & (1 << i)) {
+          ++s.outdeg_dup[pop];
+          s.edge_child.push_back(op);
+          s.edge_parent.push_back(pop);
+        }
+      }
+    }
+
+    // Local trace interning in ascending global-id order (np.unique
+    // order), then ONE more scan bucket-scatters both partitions' ops by
+    // local trace — buckets are small (avg spans/trace), so the per-trace
+    // sorts in finish_partition stay in cache.
+    for (int i = 0; i < 2; ++i) {
+      PartScratch& s = sc[i];
+      s.local_id.assign(n_total_traces, -1);
+      auto& lu = g->parts[i].local_uniques;
+      auto& tl = g->parts[i].tracelen;
+      for (int64_t t = 0; t < n_total_traces; ++t) {
+        if (s.counts_global[t] > 0) {
+          s.local_id[t] = static_cast<int32_t>(lu.size());
+          lu.push_back(static_cast<int32_t>(t));
+          tl.push_back(s.counts_global[t]);
+        }
+      }
+      s.tr_off.assign(lu.size() + 1, 0);
+      for (size_t t = 0; t < lu.size(); ++t)
+        s.tr_off[t + 1] = s.tr_off[t] + tl[t];
+      s.by_trace_op.resize(s.n_p);
+    }
+    {
+      std::vector<int64_t> cur0(sc[0].tr_off.begin(), sc[0].tr_off.end());
+      std::vector<int64_t> cur1(sc[1].tr_off.begin(), sc[1].tr_off.end());
+      for (int64_t r = 0; r < n_rows; ++r) {
+        if (row_mask && !row_mask[r]) continue;
+        const int32_t t = trace_id[r];
+        const uint8_t code = part_bit[t];
+        if (!code) continue;
+        const int32_t op = pod_op[r];
+        if (code & 1) sc[0].by_trace_op[cur0[sc[0].local_id[t]]++] = op;
+        if (code & 2) sc[1].by_trace_op[cur1[sc[1].local_id[t]]++] = op;
+      }
+    }
+
+    for (int i = 0; i < 2; ++i)
+      finish_partition(sc[i], vocab_size, &g->parts[i]);
+  } catch (const std::bad_alloc&) {
+    delete g;
+    return nullptr;
+  }
   return g;
 }
 
-void mr_free_window(MrWindowGraph* g) {
-  if (!g) return;
-  for (MrPartition& p : g->parts) {
-    std::free(p.inc_op);
-    std::free(p.inc_trace);
-    std::free(p.sr_val);
-    std::free(p.rs_val);
-    std::free(p.ss_child);
-    std::free(p.ss_parent);
-    std::free(p.ss_val);
-    std::free(p.kind);
-    std::free(p.tracelen);
-    std::free(p.local_uniques);
-    std::free(p.cov_unique);
-    std::free(p.op_present);
+// sizes[8]: per partition (normal, abnormal): n_inc, n_ss, n_traces, n_ops.
+void mr_window_sizes(const MrBuiltWindow* g, int64_t* sizes) {
+  for (int i = 0; i < 2; ++i) {
+    const BuiltPartition& p = g->parts[i];
+    sizes[4 * i + 0] = static_cast<int64_t>(p.inc_op.size());
+    sizes[4 * i + 1] = static_cast<int64_t>(p.ss_child.size());
+    sizes[4 * i + 2] = static_cast<int64_t>(p.kind.size());
+    sizes[4 * i + 3] = p.n_ops;
   }
-  std::free(g);
 }
+
+// Copy partition idx into caller-allocated buffers (each at least the
+// corresponding mr_window_sizes length; vocab-length for cov/present).
+// Buffers beyond the filled length keep whatever the caller padded with.
+void mr_export_partition(const MrBuiltWindow* g, int32_t idx, int32_t* inc_op,
+                         int32_t* inc_trace, float* sr_val, float* rs_val,
+                         int32_t* ss_child, int32_t* ss_parent, float* ss_val,
+                         int32_t* kind, int32_t* tracelen,
+                         int32_t* local_uniques, int32_t* cov_unique,
+                         uint8_t* op_present) {
+  const BuiltPartition& p = g->parts[idx];
+  auto cp = [](auto* dst, const auto& src) {
+    if (!src.empty())
+      std::memcpy(dst, src.data(), src.size() * sizeof(src[0]));
+  };
+  cp(inc_op, p.inc_op);
+  cp(inc_trace, p.inc_trace);
+  cp(sr_val, p.sr_val);
+  cp(rs_val, p.rs_val);
+  cp(ss_child, p.ss_child);
+  cp(ss_parent, p.ss_parent);
+  cp(ss_val, p.ss_val);
+  cp(kind, p.kind);
+  cp(tracelen, p.tracelen);
+  cp(local_uniques, p.local_uniques);
+  cp(cov_unique, p.cov_unique);
+  cp(op_present, p.op_present);
+}
+
+void mr_free_built(MrBuiltWindow* g) { delete g; }
 
 }  // extern "C"

@@ -29,18 +29,57 @@
 
 namespace {
 
+// String interner with an open-addressing probe table keyed by views into
+// the growing blob — no per-lookup std::string allocation (the hot path
+// runs 3x per span row).
 struct Vocab {
-  std::unordered_map<std::string, int32_t> index;
   std::string blob;
   std::vector<int64_t> offsets{0};
+  std::vector<int32_t> slots;  // id+1; 0 = empty
+  size_t mask = 0;
+
+  Vocab() : slots(1024, 0), mask(1023) {}
+
+  static uint64_t hash(std::string_view s) {
+    uint64_t h = 1469598103934665603ULL;  // FNV-1a
+    for (char c : s) {
+      h ^= static_cast<unsigned char>(c);
+      h *= 1099511628211ULL;
+    }
+    return h;
+  }
+
+  std::string_view at(int32_t id) const {
+    return std::string_view(blob)
+        .substr(static_cast<size_t>(offsets[id]),
+                static_cast<size_t>(offsets[id + 1] - offsets[id]));
+  }
+
+  void grow() {
+    std::vector<int32_t> fresh(slots.size() * 2, 0);
+    const size_t m = fresh.size() - 1;
+    for (int32_t v : slots) {
+      if (!v) continue;
+      size_t i = hash(at(v - 1)) & m;
+      while (fresh[i]) i = (i + 1) & m;
+      fresh[i] = v;
+    }
+    slots.swap(fresh);
+    mask = m;
+  }
 
   int32_t intern(std::string_view s) {
-    auto it = index.find(std::string(s));
-    if (it != index.end()) return it->second;
-    int32_t id = static_cast<int32_t>(offsets.size()) - 1;
-    index.emplace(std::string(s), id);
+    size_t i = hash(s) & mask;
+    while (slots[i]) {
+      const int32_t id = slots[i] - 1;
+      if (at(id) == s) return id;
+      i = (i + 1) & mask;
+    }
+    const int32_t id = static_cast<int32_t>(offsets.size()) - 1;
     blob.append(s.data(), s.size());
     offsets.push_back(static_cast<int64_t>(blob.size()));
+    slots[i] = id + 1;
+    if ((offsets.size() - 1) * 2 > slots.size()) grow();
     return id;
   }
   size_t size() const { return offsets.size() - 1; }

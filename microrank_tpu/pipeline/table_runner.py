@@ -60,8 +60,13 @@ class TableRCA:
             len(self.slo_vocab),
         )
 
-    def rank_window(self, table, mask, nrm_codes, abn_codes):
-        """Rank one window given its row mask and trace-code partitions."""
+    def dispatch_rank(self, table, mask, nrm_codes, abn_codes):
+        """Build one window's graph and dispatch its device rank program.
+
+        Returns opaque handles (device arrays still in flight — jax
+        dispatch is async) to pass to ``finalize_rank``. The host is free
+        to build the next window while the device executes this one.
+        """
         cfg = self.config
         graph, op_names, _, _ = build_window_graph_from_table(
             table,
@@ -97,14 +102,25 @@ class TableRCA:
                 None,
                 kernel,
             )
+        return top_idx, top_scores, n_valid, op_names
+
+    def finalize_rank(self, handles):
+        """Force a dispatched rank's results to host (blocks if needed)."""
+        top_idx, top_scores, n_valid, op_names = handles
         n = int(n_valid)
         names = [op_names[int(i)] for i in np.asarray(top_idx)[:n]]
         scores = [float(s) for s in np.asarray(top_scores)[:n]]
-        if cfg.runtime.validate_numerics:
+        if self.config.runtime.validate_numerics:
             from ..utils.guards import assert_finite_scores
 
             assert_finite_scores(scores, "TableRCA.rank_window")
         return names, scores
+
+    def rank_window(self, table, mask, nrm_codes, abn_codes):
+        """Rank one window given its row mask and trace-code partitions."""
+        return self.finalize_rank(
+            self.dispatch_rank(table, mask, nrm_codes, abn_codes)
+        )
 
     def run(
         self,
@@ -121,6 +137,13 @@ class TableRCA:
         leading axis and ranked in a single vmapped device call
         (BASELINE.json config 4: batched multi-window spectrum). The
         table-global pod vocabulary makes the stacked graphs name-stable.
+
+        Otherwise the loop is pipelined up to
+        ``runtime.pipeline_depth`` device programs deep: a window's rank
+        is dispatched asynchronously and only forced once the next
+        window's host work is done, so graph build overlaps device
+        execution. Results are emitted to the sink strictly in window
+        order either way.
         """
         cfg = self.config
         if self.baseline is None:
@@ -134,11 +157,37 @@ class TableRCA:
 
         detect_us = int(cfg.window.detect_minutes * _US_PER_MIN)
         skip_us = int(cfg.window.skip_minutes * _US_PER_MIN)
+        depth = max(1, int(cfg.runtime.pipeline_depth))
         current = int(table.start_us.min())
         end = int(table.end_us.max())
 
         results: List[WindowResult] = []
         pending = []  # (result, mask, nrm, abn) for deferred batched rank
+        inflight = []  # (result, handles, timings) dispatched, not forced
+        emitted = 0  # results[:emitted] already sent to the sink
+
+        def _emit_ready():
+            """Emit results in window order, stopping at the oldest
+            still-inflight window (its ranking isn't final yet)."""
+            nonlocal emitted
+            if sink is None or batch_windows:
+                return
+            stop = id(inflight[0][0]) if inflight else None
+            while emitted < len(results):
+                r = results[emitted]
+                if id(r) == stop:
+                    break
+                sink.emit(r)
+                emitted += 1
+
+        def _finalize_one():
+            result, handles, timings = inflight.pop(0)
+            with timings.stage("rank_wait"):
+                names, scores = self.finalize_rank(handles)
+            result.ranking = list(zip(names, scores))
+            result.timings = timings.as_dict()
+            _emit_ready()
+
         while current < end:
             w0, w1 = current, current + detect_us
             timings = StageTimings()
@@ -170,19 +219,25 @@ class TableRCA:
                     if batch_windows:
                         pending.append((result, mask, nrm, abn))
                     else:
-                        with timings.stage("rank"):
-                            names, scores = self.rank_window(
+                        with timings.stage("rank_dispatch"):
+                            handles = self.dispatch_rank(
                                 table, mask, nrm, abn
                             )
-                        result.ranking = list(zip(names, scores))
+                        inflight.append((result, handles, timings))
+                        if len(inflight) >= depth:
+                            _finalize_one()
 
-            result.timings = timings.as_dict()
             results.append(result)
-            if not batch_windows and sink is not None:
-                sink.emit(result)
+            if not (result.anomaly and not result.skipped_reason) or batch_windows:
+                result.timings = timings.as_dict()
+            _emit_ready()
             if ranked:
                 current += skip_us
             current += detect_us
+
+        while inflight:
+            _finalize_one()
+        _emit_ready()
 
         if batch_windows and pending:
             self._rank_pending(table, pending)

@@ -187,3 +187,24 @@ def test_native_graph_build_empty_partition(csv_pair):
     assert len(a1) == len(a2) == 0
     np.testing.assert_array_equal(b1, b2)
     _assert_graphs_equal(g1, g2)
+
+
+def test_loader_sidecar_cache(tmp_path, csv_pair):
+    """Sidecar .npz reused when fresh, invalidated when the CSV changes."""
+    import os
+    import time as _time
+
+    _, case = csv_pair
+    p = tmp_path / "t.csv"
+    case.abnormal.to_csv(p, index=False)
+    a = native.load_span_table(p)
+    sidecars = list(tmp_path.glob("*.npz"))
+    assert len(sidecars) == 1
+    b = native.load_span_table(p)  # cache hit
+    np.testing.assert_array_equal(a.pod_op, b.pod_op)
+    assert a.trace_names == b.trace_names
+    # Stale cache: rewrite the CSV with one span fewer, bump mtime.
+    case.abnormal.iloc[:-1].to_csv(p, index=False)
+    os.utime(p, (_time.time() + 2, _time.time() + 2))
+    c = native.load_span_table(p)
+    assert c.n_spans == a.n_spans - 1

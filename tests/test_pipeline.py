@@ -204,3 +204,40 @@ def test_batched_windows_match_sequential(case, tmp_path):
         np.testing.assert_allclose(
             [s for _, s in a.ranking], [s for _, s in b.ranking], rtol=1e-4
         )
+
+
+def test_table_lane_pipelined_matches_sync(case, tmp_path):
+    """pipeline_depth=2 (async overlap) == depth=1, incl. sink order."""
+    from dataclasses import replace
+
+    from microrank_tpu.native import native_available
+    from microrank_tpu.pipeline import run_rca_native
+
+    if not native_available():
+        pytest.skip("native lane unavailable")
+    case.normal.to_csv(tmp_path / "normal.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "abnormal.csv", index=False)
+    cfg = MicroRankConfig()
+    outs = {}
+    for depth in (1, 2, 4):
+        c = replace(cfg, runtime=replace(cfg.runtime, pipeline_depth=depth))
+        out = tmp_path / f"out{depth}"
+        outs[depth] = (
+            run_rca_native(
+                tmp_path / "normal.csv", tmp_path / "abnormal.csv", c, out
+            ),
+            (out / "windows.jsonl").read_text().splitlines(),
+        )
+    r1, lines1 = outs[1]
+    for depth in (2, 4):
+        rd, lines_d = outs[depth]
+        assert len(rd) == len(r1)
+        for a, b in zip(r1, rd):
+            assert a.ranking == b.ranking
+            assert (a.start, a.anomaly, a.skipped_reason) == (
+                b.start, b.anomaly, b.skipped_reason
+            )
+        # sink emission preserved window order and count
+        starts1 = [json.loads(l)["start"] for l in lines1]
+        starts_d = [json.loads(l)["start"] for l in lines_d]
+        assert starts1 == starts_d
