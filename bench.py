@@ -5,8 +5,11 @@ Prints ONE JSON line:
      "vs_baseline": R}
 
 * value — spans of the abnormal window ranked per second of wall-clock
-  through the device path (host COO graph build + jitted rank program,
-  post-compile; median of BENCH_REPEATS runs).
+  through the device path (host COO graph build + jitted rank program +
+  device->host fetch of the top-k result, post-compile; median of
+  BENCH_REPEATS runs). The fetch is deliberate: on the tunneled TPU
+  platform jax.block_until_ready does not wait for execution, so only a
+  value transfer is a sound timing fence.
 * vs_baseline — speedup of that spans/s over the faithful numpy oracle
   backend measured on a trace-subsample of the same window (the oracle is
   the reference's dense-matrix semantics; its cost is superlinear, so the
@@ -165,30 +168,45 @@ def main() -> int:
         return 1
 
     # --- timed device path: graph build (host) + rank (device) ---------
+    from microrank_tpu.graph.build import aux_for_kernel
+
+    kernel = os.environ.get("BENCH_KERNEL", "auto")
+
     def build():
-        return build_window_graph_from_table(abnormal_table, mask, nrm, abn)
+        return build_window_graph_from_table(
+            abnormal_table, mask, nrm, abn, aux=aux_for_kernel(kernel)
+        )
 
     graph, op_names, _, _ = build()
-    kernel = os.environ.get("BENCH_KERNEL", "auto")
     if kernel == "auto":
-        kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
+        kernel = choose_kernel(graph)
     log(f"pagerank kernel: {kernel}")
+
+    # Timing note: on the tunneled TPU platform ("axon"),
+    # jax.block_until_ready returns without waiting for device execution —
+    # measured 0.1 ms for a program whose value-fetch takes 80 ms. The only
+    # trustworthy fence is a device->host transfer of the outputs, so every
+    # timed call below materializes the (tiny: top-k indices/scores) result
+    # on the host — in ONE batched jax.device_get (per-buffer fetches each
+    # pay a full RPC round trip, ~78 ms apiece measured). The transfer is
+    # part of an honest end-to-end rank anyway — the ranking is consumed
+    # host-side.
+    def run_fetched():
+        return jax.device_get(
+            rank_window_device(
+                device_graph, cfg.pagerank, cfg.spectrum, None, kernel
+            )
+        )
 
     device_graph = jax.tree.map(jnp.asarray, graph)
     t0 = time.perf_counter()
-    out = rank_window_device(
-        device_graph, cfg.pagerank, cfg.spectrum, None, kernel
-    )
-    jax.block_until_ready(out)
-    log(f"first call (compile + run): {time.perf_counter() - t0:.2f}s")
+    out = run_fetched()
+    log(f"first call (compile + run + fetch): {time.perf_counter() - t0:.2f}s")
 
     rank_times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        out = rank_window_device(
-            device_graph, cfg.pagerank, cfg.spectrum, None, kernel
-        )
-        jax.block_until_ready(out)
+        out = run_fetched()
         rank_times.append(time.perf_counter() - t0)
     rank_s = float(np.median(rank_times))
 

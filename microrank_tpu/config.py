@@ -113,10 +113,21 @@ class RuntimeConfig:
     # Compute dtype for the iteration. float32 preserves ranking parity;
     # bfloat16 trades precision for MXU throughput (rank-parity tested).
     dtype: str = "float32"
-    # Power-iteration kernel: "coo" (segment-sum SpMV — scales, shardable),
-    # "dense" (scatter once, 25 MXU matvecs — fastest when it fits),
-    # "auto" (dense iff scattered matrices fit dense_budget_bytes).
+    # Power-iteration kernel:
+    #   "packed" / "packed_bf16" — bitmap-expanded dense MXU matvecs, no
+    #       scatter (fastest on TPU when the matrices fit);
+    #   "csr" — cumsum-difference SpMV, scatter-free and entry-linear in
+    #       memory (the at-scale fallback);
+    #   "dense" / "dense_bf16" — scatter densify + MXU matvecs;
+    #   "coo" — segment-sum SpMV (the shardable kernel under shard_map);
+    #   "pallas" — one-hot MXU segment sums (blocked on tunneled-TPU
+    #       deployments whose remote compile helper can't build Mosaic);
+    #   "auto" — packed when both partitions' unpacked matrices fit
+    #       dense_budget_bytes (decided once at graph build, which then
+    #       constructs exactly the needed auxiliary view), else csr.
     kernel: str = "auto"
+    # Budget for the packed kernel's unpacked f32 matrices, summed over
+    # both partitions (graph.build.resolve_aux applies it at build time).
     dense_budget_bytes: int = 2 << 30
     # Validate fetched ranking scores for NaN/inf (nearly free: results are
     # already on host when checked).

@@ -121,6 +121,195 @@ def _trace_kinds(
     return kind
 
 
+def csr_auxiliary(
+    inc_op: np.ndarray,
+    inc_trace: np.ndarray,
+    sr_val: np.ndarray,
+    ss_child: np.ndarray,
+    n_inc: int,
+    n_ss: int,
+    v_pad: int,
+    t_pad: int,
+):
+    """CSR orderings + row offsets for the scatter-free device kernel.
+
+    Requires the storage invariants both build lanes guarantee: incidence
+    sorted by (trace, op), call edges sorted by (child, parent). The
+    op-major permutation is a stable sort on the op column (numpy radix for
+    int keys — O(E)), which keeps traces ascending within each op row.
+
+    Returns (inc_trace_opmajor[E], sr_val_opmajor[E], inc_indptr_op[V+1],
+    inc_indptr_trace[T+1], ss_indptr[V+1]); padding entries carry 0 and sit
+    outside every indptr range.
+    """
+    e_pad = inc_op.shape[0]
+    perm = np.argsort(inc_op[:n_inc], kind="stable")
+    tr_om = np.zeros(e_pad, dtype=np.int32)
+    tr_om[:n_inc] = inc_trace[:n_inc][perm]
+    sr_om = np.zeros(e_pad, dtype=np.float32)
+    sr_om[:n_inc] = sr_val[:n_inc][perm]
+
+    def indptr(ids, n, size):
+        out = np.zeros(size + 1, dtype=np.int64)
+        np.cumsum(np.bincount(ids[:n], minlength=size), out=out[1:])
+        return out.astype(np.int32)
+
+    return (
+        tr_om,
+        sr_om,
+        indptr(inc_op, n_inc, v_pad),
+        indptr(inc_trace, n_inc, t_pad),
+        indptr(ss_child, n_ss, v_pad),
+    )
+
+
+# Device budget for the packed kernel's unpacked f32 matrices, summed over
+# both partitions: (V*T + V*V)*4 per partition. One constant, one policy —
+# resolve_aux decides at build time which auxiliary view to construct, and
+# choose_kernel then selects purely by presence, so build and kernel choice
+# can never disagree. Matches RuntimeConfig.dense_budget_bytes's default.
+DEFAULT_DENSE_BUDGET_BYTES = 2 << 30
+
+# Above this many cells, build bitmaps by direct bit-scatter instead of a
+# dense bool temporary + packbits (the bool temp is 8x the bitmap bytes).
+_BOOL_TEMP_CELL_BUDGET = 128 << 20
+
+
+def resolve_aux(
+    aux: str,
+    v_pad: int,
+    t_pads,
+    dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
+) -> str:
+    """Window-level auxiliary-view policy (one decision for BOTH
+    partitions, so a window can never mix bitmap and CSR partitions).
+
+    "auto" -> "packed" when both partitions' unpacked matrices fit the
+    budget, else "csr". Explicit modes ("packed" | "csr" | "all" | "none")
+    pass through for forced-kernel runs.
+    """
+    if aux != "auto":
+        return aux
+    total = sum((v_pad * t + v_pad * v_pad) * 4 for t in t_pads)
+    return "packed" if total <= dense_budget_bytes else "csr"
+
+
+def aux_for_kernel(kernel: str) -> str:
+    """The build aux mode a forced RuntimeConfig.kernel needs."""
+    return {
+        "auto": "auto",
+        "csr": "csr",
+        "packed": "packed",
+        "packed_bf16": "packed",
+    }.get(kernel, "none")
+
+
+def _scatter_bits(rows, cols, v_pad: int, n_cols: int) -> np.ndarray:
+    """Pack a 0/1 pattern [v_pad, n_cols] to uint8 bits (big-endian bit
+    order, matching np.packbits). Uses a dense bool temporary + packbits
+    when small (fast), direct in-place bit-scatter when the temporary
+    would dwarf the bitmap."""
+    if v_pad * n_cols <= _BOOL_TEMP_CELL_BUDGET:
+        dense = np.zeros((v_pad, n_cols), dtype=bool)
+        dense[rows, cols] = True
+        return np.packbits(dense, axis=1)
+    bits = np.zeros((v_pad, (n_cols + 7) // 8), dtype=np.uint8)
+    np.bitwise_or.at(
+        bits,
+        (rows, cols >> 3),
+        (np.uint8(128) >> (cols & 7).astype(np.uint8)),
+    )
+    return bits
+
+
+def packed_aux(
+    inc_op: np.ndarray,
+    inc_trace: np.ndarray,
+    sr_val: np.ndarray,
+    rs_val: np.ndarray,
+    ss_child: np.ndarray,
+    ss_parent: np.ndarray,
+    ss_val: np.ndarray,
+    n_inc: int,
+    n_ss: int,
+    v_pad: int,
+    t_pad: int,
+    with_bitmaps: bool = True,
+):
+    """Bitmap patterns + inverse vectors for the packed dense kernel.
+
+    The inverse vectors are scattered from the per-entry value arrays (one
+    f32 copy per axis position), so they carry bit-identical values to the
+    COO path. Returns (cov_bits, ss_bits, inv_tracelen, inv_cov_dup,
+    inv_outdeg); the bitmaps are [x, 0] placeholders when not requested.
+    """
+    inv_len = np.zeros(t_pad, dtype=np.float32)
+    inv_len[inc_trace[:n_inc]] = sr_val[:n_inc]
+    inv_cov = np.zeros(v_pad, dtype=np.float32)
+    inv_cov[inc_op[:n_inc]] = rs_val[:n_inc]
+    inv_out = np.zeros(v_pad, dtype=np.float32)
+    inv_out[ss_parent[:n_ss]] = ss_val[:n_ss]
+
+    if not with_bitmaps:
+        empty = np.zeros((v_pad, 0), dtype=np.uint8)
+        return empty, empty, inv_len, inv_cov, inv_out
+
+    return (
+        _scatter_bits(inc_op[:n_inc], inc_trace[:n_inc], v_pad, t_pad),
+        _scatter_bits(ss_child[:n_ss], ss_parent[:n_ss], v_pad, v_pad),
+        inv_len,
+        inv_cov,
+        inv_out,
+    )
+
+
+def build_aux_views(
+    inc_op: np.ndarray,
+    inc_trace: np.ndarray,
+    sr_val: np.ndarray,
+    rs_val: np.ndarray,
+    ss_child: np.ndarray,
+    ss_parent: np.ndarray,
+    ss_val: np.ndarray,
+    n_inc: int,
+    n_ss: int,
+    v_pad: int,
+    t_pad: int,
+    mode: str,
+):
+    """The shared (numpy-lane + native-lane) auxiliary-view constructor.
+
+    ``mode`` is a RESOLVED aux mode ("packed" | "csr" | "all" | "none" —
+    run resolve_aux first; "auto" is rejected here so the two build lanes
+    can't silently apply different policies). Unbuilt views are [0]-shaped
+    ([x, 0] for bitmaps) placeholders; the kernels raise loudly on them.
+
+    Returns the 10 PartitionGraph aux fields: (inc_trace_opmajor,
+    sr_val_opmajor, inc_indptr_op, inc_indptr_trace, ss_indptr, cov_bits,
+    ss_bits, inv_tracelen, inv_cov_dup, inv_outdeg).
+    """
+    if mode not in ("packed", "csr", "all", "none"):
+        raise ValueError(f"unresolved aux mode {mode!r}")
+    if mode in ("csr", "all"):
+        csr = csr_auxiliary(
+            inc_op, inc_trace, sr_val, ss_child, n_inc, n_ss, v_pad, t_pad
+        )
+    else:
+        csr = (
+            np.zeros(0, np.int32),
+            np.zeros(0, np.float32),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+        )
+    packed = packed_aux(
+        inc_op, inc_trace, sr_val, rs_val, ss_child, ss_parent, ss_val,
+        n_inc, n_ss, v_pad, t_pad,
+        with_bitmaps=mode in ("packed", "all"),
+    )
+    return csr + packed
+
+
 def _build_partition(
     op_codes: np.ndarray,       # int64 window-vocab op id per partition span
     g_trace: np.ndarray,        # int64 window-global trace id per span
@@ -130,6 +319,7 @@ def _build_partition(
     v_pad: int,
     pad_policy: str,
     min_pad: int,
+    aux: str = "auto",
 ) -> Tuple[PartitionGraph, np.ndarray]:
     """Build one partition's padded graph from pure int arrays.
 
@@ -173,14 +363,43 @@ def _build_partition(
     c_pad = pad_to(len(e_child), pad_policy, min_pad)
     t_pad = pad_to(n_traces, pad_policy, min_pad)
 
+    p_inc_op = pad1d(u_op, e_pad)
+    p_inc_trace = pad1d(u_trace, e_pad)
+    p_sr_val = pad1d(sr_val, e_pad)
+    p_rs_val = pad1d(rs_val, e_pad)
+    p_ss_child = pad1d(e_child, c_pad)
+    p_ss_parent = pad1d(e_parent, c_pad)
+    p_ss_val = pad1d(ss_val, c_pad)
+    # ``aux`` must be window-level-resolved by the caller (resolve_aux);
+    # "auto" here falls back to a partition-local resolution for direct
+    # callers/tests that build a single partition.
+    mode = resolve_aux(aux, v_pad, (t_pad,))
+    (
+        tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
+        cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+    ) = build_aux_views(
+        p_inc_op, p_inc_trace, p_sr_val, p_rs_val,
+        p_ss_child, p_ss_parent, p_ss_val,
+        len(u_op), len(e_child), v_pad, t_pad, mode,
+    )
     graph = PartitionGraph(
-        inc_op=pad1d(u_op, e_pad),
-        inc_trace=pad1d(u_trace, e_pad),
-        sr_val=pad1d(sr_val, e_pad),
-        rs_val=pad1d(rs_val, e_pad),
-        ss_child=pad1d(e_child, c_pad),
-        ss_parent=pad1d(e_parent, c_pad),
-        ss_val=pad1d(ss_val, c_pad),
+        inc_op=p_inc_op,
+        inc_trace=p_inc_trace,
+        sr_val=p_sr_val,
+        rs_val=p_rs_val,
+        ss_child=p_ss_child,
+        ss_parent=p_ss_parent,
+        ss_val=p_ss_val,
+        inc_trace_opmajor=tr_om,
+        sr_val_opmajor=sr_om,
+        inc_indptr_op=indptr_op,
+        inc_indptr_trace=indptr_trace,
+        ss_indptr=ss_indptr,
+        cov_bits=cov_bits,
+        ss_bits=ss_bits,
+        inv_tracelen=inv_len,
+        inv_cov_dup=inv_cov,
+        inv_outdeg=inv_out,
         kind=pad1d(kind, t_pad, fill=1),
         tracelen=pad1d(tracelen.astype(np.int32), t_pad, fill=1),
         cov_unique=pad1d(cov_unique, v_pad),
@@ -200,6 +419,8 @@ def build_window_graph(
     strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
     pad_policy: str = "pow2",
     min_pad: int = 8,
+    aux: str = "auto",
+    dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
 ) -> Tuple[WindowGraph, List[str], List, List]:
     """Build both partitions of a window over one shared op vocab.
 
@@ -238,10 +459,21 @@ def build_window_graph(
     pos[sid] = np.arange(n)
     parent_row = pos[pid]  # -1 when the parent span is absent
 
+    # Window-level aux resolution: one decision for both partitions, from
+    # their padded trace counts (every id kept below maps to >=1 span, so
+    # the local trace count equals the kept-id count).
+    code_lists = [
+        [tr_index[t] for t in ids if t in tr_index]
+        for ids in (normal_ids, abnormal_ids)
+    ]
+    t_pads = [
+        pad_to(max(len(set(c)), 1), pad_policy, min_pad) for c in code_lists
+    ]
+    mode = resolve_aux(aux, v_pad, t_pads, dense_budget_bytes)
+
     parts = []
     id_lists = []
-    for ids in (normal_ids, abnormal_ids):
-        codes = [tr_index[t] for t in ids if t in tr_index]
+    for codes in code_lists:
         flags = np.zeros(len(tr_uniques) + 1, dtype=bool)
         if codes:
             flags[np.asarray(codes, dtype=np.int64)] = True
@@ -259,6 +491,7 @@ def build_window_graph(
             v_pad,
             pad_policy,
             min_pad,
+            mode,
         )
         parts.append(part)
         id_lists.append([tr_uniques[c] for c in local_codes])

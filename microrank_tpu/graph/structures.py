@@ -27,15 +27,43 @@ class PartitionGraph(NamedTuple):
     Padding rows carry value 0.0 / index 0 and are inert under segment-sum.
     """
 
-    # Unique (op, trace) incidence entries (trace ids are partition-local).
+    # Unique (op, trace) incidence entries (trace ids are partition-local),
+    # sorted by (trace, op) — "trace-major". The CSR views below index into
+    # this order (and its op-major twin) for the scatter-free kernel.
     inc_op: np.ndarray      # int32[E]
     inc_trace: np.ndarray   # int32[E]
     sr_val: np.ndarray      # float32[E]  = 1 / len_with_dups(trace)   (p_sr)
     rs_val: np.ndarray      # float32[E]  = 1 / cov_with_dups(op)      (p_rs)
-    # Unique call-graph edges (child <- parent).
+    # Unique call-graph edges (child <- parent), sorted by (child, parent).
     ss_child: np.ndarray    # int32[C]
     ss_parent: np.ndarray   # int32[C]
     ss_val: np.ndarray      # float32[C]  = 1 / outdeg_with_dups(parent)
+    # CSR views for the cumsum-difference SpMV kernel (kernel="csr"):
+    # TPU scatters are expensive, so each SpMV becomes gather -> cumsum ->
+    # gather-at-row-boundaries, which only needs each operand grouped by
+    # its OUTPUT axis. Trace-major grouping is the storage order above;
+    # op-major is this reordered copy. indptr[r]..indptr[r+1] brackets row
+    # r's entries; padded rows have empty ranges.
+    inc_trace_opmajor: np.ndarray  # int32[E]   trace ids, op-major order
+    sr_val_opmajor: np.ndarray     # float32[E] sr_val, op-major order
+    inc_indptr_op: np.ndarray      # int32[V+1] op-major row offsets
+    inc_indptr_trace: np.ndarray   # int32[T+1] trace-major row offsets
+    ss_indptr: np.ndarray          # int32[V+1] call-edge child row offsets
+    # Packed-bitmap views for the dense MXU kernel (kernel="packed"):
+    # every transition matrix is a 0/1 pattern scaled by a per-source-axis
+    # value (p_sr[v,t] = cov[v,t]/len(t), p_rs[t,v] = cov[v,t]/cov_dup(v),
+    # p_ss[c,p] = call[c,p]/outdeg(p)), so the device needs only the
+    # pattern as a host-packed bitmap (np.packbits, bitorder="big") plus
+    # the three inverse vectors — unpacked on device with shift/mask ops
+    # (no scatter: TPU scatters cost ~75 ms each at this scale, the whole
+    # point of this layout). Empty [x, 0] bitmaps mean "not built" (the
+    # window exceeded the build's bitmap budget); choose_kernel then
+    # avoids "packed".
+    cov_bits: np.ndarray           # uint8[V, T/8] incidence pattern
+    ss_bits: np.ndarray            # uint8[V, V/8] call-edge pattern
+    inv_tracelen: np.ndarray       # float32[T] = 1/len_with_dups (= sr_val)
+    inv_cov_dup: np.ndarray        # float32[V] = 1/cov_with_dups (= rs_val)
+    inv_outdeg: np.ndarray         # float32[V] = 1/outdeg_with_dups (= ss_val)
     # Per-trace statistics (partition-local trace axis, padded to T).
     kind: np.ndarray        # int32[T]    size of the trace's dedup kind (C10)
     tracelen: np.ndarray    # int32[T]    # spans in trace (with dups)

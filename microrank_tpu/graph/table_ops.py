@@ -15,7 +15,12 @@ from typing import Iterable, List, Tuple
 import numpy as np
 
 from ..io.interning import Vocab
-from .build import _build_partition
+from .build import (
+    DEFAULT_DENSE_BUDGET_BYTES,
+    _build_partition,
+    build_aux_views,
+    resolve_aux,
+)
 from .structures import (
     DetectBatch,
     PartitionGraph,
@@ -105,8 +110,23 @@ def detect_batch_from_table(
     return batch, uniques
 
 
-def _graph_from_padded(p):
-    """Wrap one native PaddedPartition (already padded) as PartitionGraph."""
+def _graph_from_padded(p, mode: str):
+    """Wrap one native PaddedPartition (already padded) as PartitionGraph.
+
+    The CSR/bitmap views are a numpy post-pass through the SAME
+    build_aux_views helper as the numpy lane (graph_builder.cpp emits the
+    same trace-major / child-sorted orders, so the invariants hold).
+    ``mode`` must already be window-level resolved (resolve_aux)."""
+    v_pad = p.cov_unique.shape[0]
+    t_pad = p.kind.shape[0]
+    (
+        tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
+        cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+    ) = build_aux_views(
+        p.inc_op, p.inc_trace, p.sr_val, p.rs_val,
+        p.ss_child, p.ss_parent, p.ss_val,
+        int(p.n_inc), int(p.n_ss), v_pad, t_pad, mode,
+    )
     return PartitionGraph(
         inc_op=p.inc_op,
         inc_trace=p.inc_trace,
@@ -115,6 +135,16 @@ def _graph_from_padded(p):
         ss_child=p.ss_child,
         ss_parent=p.ss_parent,
         ss_val=p.ss_val,
+        inc_trace_opmajor=tr_om,
+        sr_val_opmajor=sr_om,
+        inc_indptr_op=indptr_op,
+        inc_indptr_trace=indptr_trace,
+        ss_indptr=ss_indptr,
+        cov_bits=cov_bits,
+        ss_bits=ss_bits,
+        inv_tracelen=inv_len,
+        inv_cov_dup=inv_cov,
+        inv_outdeg=inv_out,
         kind=p.kind,
         tracelen=p.tracelen,
         cov_unique=p.cov_unique,
@@ -134,6 +164,8 @@ def build_window_graph_from_table(
     pad_policy: str = "pow2",
     min_pad: int = 8,
     use_native: bool = True,
+    aux: str = "auto",
+    dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
 ) -> Tuple[WindowGraph, List[str], np.ndarray, np.ndarray]:
     """Both partitions' graphs from table rows — ints end to end.
 
@@ -148,6 +180,17 @@ def build_window_graph_from_table(
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
     if mask is None:
         mask = np.ones(table.n_spans, dtype=bool)
+
+    normal_trace_codes = list(normal_trace_codes)
+    abnormal_trace_codes = list(abnormal_trace_codes)
+    # Window-level aux resolution (one decision for both partitions; every
+    # partition code comes from detection over these same rows, so the
+    # local trace count equals the code count).
+    t_pads = [
+        pad_to(max(len(set(c)), 1), pad_policy, min_pad)
+        for c in (normal_trace_codes, abnormal_trace_codes)
+    ]
+    mode = resolve_aux(aux, v_pad, t_pads, dense_budget_bytes)
 
     if use_native:
         from ..native import (
@@ -183,8 +226,8 @@ def build_window_graph_from_table(
                 raw_n = raw_a = None  # fall through to the numpy lane
             if raw_n is not None:
                 graph = WindowGraph(
-                    normal=_graph_from_padded(raw_n),
-                    abnormal=_graph_from_padded(raw_a),
+                    normal=_graph_from_padded(raw_n, mode),
+                    abnormal=_graph_from_padded(raw_a, mode),
                 )
                 return (
                     graph,
@@ -229,6 +272,7 @@ def build_window_graph_from_table(
             v_pad,
             pad_policy,
             min_pad,
+            mode,
         )
         parts.append(part)
         code_arrays.append(local)

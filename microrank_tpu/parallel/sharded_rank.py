@@ -44,6 +44,14 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def _pad2d(arr: np.ndarray, rows: int, cols: int) -> np.ndarray:
+    if arr.shape == (rows, cols):
+        return arr
+    out = np.zeros((rows, cols), dtype=arr.dtype)
+    out[: arr.shape[0], : arr.shape[1]] = arr
+    return out
+
+
 def stack_window_graphs(
     graphs: Sequence[WindowGraph], shard_multiple: int = 1
 ) -> WindowGraph:
@@ -60,6 +68,25 @@ def stack_window_graphs(
         c = _round_up(max(p.ss_child.shape[0] for p in parts), shard_multiple)
         t = max(p.kind.shape[0] for p in parts)
         v = max(p.cov_unique.shape[0] for p in parts)
+        # A batch mixing built and placeholder aux views degrades to
+        # placeholders (all-or-none per view family; the batched kernel
+        # chooser treats 0-sized views as "not available").
+        have_csr = all(p.inc_indptr_op.shape[0] for p in parts)
+        have_bits = all(
+            p.cov_bits.shape[1] and p.ss_bits.shape[1] for p in parts
+        )
+        # indptr re-padding: a row-offset array padded with its last real
+        # value keeps every added row an empty range (the arrays end at the
+        # true entry count, so repeating indptr[-1] is exact).
+        def pad_indptr(arr: np.ndarray, size: int) -> np.ndarray:
+            if arr.shape[0] == 0:  # aux="auto" placeholder (no CSR views)
+                return np.zeros(0, np.int32)
+            if arr.shape[0] == size + 1:
+                return arr
+            return np.concatenate(
+                [arr, np.full(size + 1 - arr.shape[0], arr[-1], arr.dtype)]
+            )
+
         return PartitionGraph(
             inc_op=np.stack([_pad_axis0(p.inc_op, e) for p in parts]),
             inc_trace=np.stack([_pad_axis0(p.inc_trace, e) for p in parts]),
@@ -68,6 +95,55 @@ def stack_window_graphs(
             ss_child=np.stack([_pad_axis0(p.ss_child, c) for p in parts]),
             ss_parent=np.stack([_pad_axis0(p.ss_parent, c) for p in parts]),
             ss_val=np.stack([_pad_axis0(p.ss_val, c) for p in parts]),
+            inc_trace_opmajor=(
+                np.stack([_pad_axis0(p.inc_trace_opmajor, e) for p in parts])
+                if have_csr
+                else np.zeros((len(parts), 0), np.int32)
+            ),
+            sr_val_opmajor=(
+                np.stack([_pad_axis0(p.sr_val_opmajor, e) for p in parts])
+                if have_csr
+                else np.zeros((len(parts), 0), np.float32)
+            ),
+            inc_indptr_op=(
+                np.stack([pad_indptr(p.inc_indptr_op, v) for p in parts])
+                if have_csr
+                else np.zeros((len(parts), 0), np.int32)
+            ),
+            inc_indptr_trace=(
+                np.stack([pad_indptr(p.inc_indptr_trace, t) for p in parts])
+                if have_csr
+                else np.zeros((len(parts), 0), np.int32)
+            ),
+            ss_indptr=(
+                np.stack([pad_indptr(p.ss_indptr, v) for p in parts])
+                if have_csr
+                else np.zeros((len(parts), 0), np.int32)
+            ),
+            # Bitmaps: 2D zero-pad is exact (absent rows/traces are 0 bits).
+            cov_bits=(
+                np.stack(
+                    [_pad2d(p.cov_bits, v, (t + 7) // 8) for p in parts]
+                )
+                if have_bits
+                else np.zeros((len(parts), v, 0), np.uint8)
+            ),
+            ss_bits=(
+                np.stack(
+                    [_pad2d(p.ss_bits, v, (v + 7) // 8) for p in parts]
+                )
+                if have_bits
+                else np.zeros((len(parts), v, 0), np.uint8)
+            ),
+            inv_tracelen=np.stack(
+                [_pad_axis0(p.inv_tracelen, t) for p in parts]
+            ),
+            inv_cov_dup=np.stack(
+                [_pad_axis0(p.inv_cov_dup, v) for p in parts]
+            ),
+            inv_outdeg=np.stack(
+                [_pad_axis0(p.inv_outdeg, v) for p in parts]
+            ),
             kind=np.stack([_pad_axis0(p.kind, t, fill=1) for p in parts]),
             tracelen=np.stack(
                 [_pad_axis0(p.tracelen, t, fill=1) for p in parts]
@@ -99,6 +175,18 @@ def _partition_specs(window_axis, shard_axis) -> PartitionGraph:
         ss_child=entry,
         ss_parent=entry,
         ss_val=entry,
+        # CSR views are unused by the sharded (coo+psum) kernel; shard the
+        # entry-sized copies like their siblings, replicate the offsets.
+        inc_trace_opmajor=entry,
+        sr_val_opmajor=entry,
+        inc_indptr_op=per_window,
+        inc_indptr_trace=per_window,
+        ss_indptr=per_window,
+        cov_bits=per_window,
+        ss_bits=per_window,
+        inv_tracelen=per_window,
+        inv_cov_dup=per_window,
+        inv_outdeg=per_window,
         kind=per_window,
         tracelen=per_window,
         cov_unique=per_window,
@@ -144,7 +232,16 @@ def rank_windows_batched(
     batched: WindowGraph,
     pagerank_cfg: PageRankConfig,
     spectrum_cfg: SpectrumConfig,
+    kernel: str = "auto",
 ):
     """Single-device vmapped batch ranking (BASELINE.json config 4)."""
-    fn = jax.vmap(lambda g: rank_window_core(g, pagerank_cfg, spectrum_cfg))
+    if kernel == "auto":
+        from ..rank_backends.jax_tpu import choose_kernel
+
+        kernel = choose_kernel(batched)
+    fn = jax.vmap(
+        lambda g: rank_window_core(
+            g, pagerank_cfg, spectrum_cfg, None, kernel
+        )
+    )
     return jax.jit(fn)(jax.tree.map(jnp.asarray, batched))

@@ -67,6 +67,8 @@ class TableRCA:
         dispatch is async) to pass to ``finalize_rank``. The host is free
         to build the next window while the device executes this one.
         """
+        from ..graph.build import aux_for_kernel
+
         cfg = self.config
         graph, op_names, _, _ = build_window_graph_from_table(
             table,
@@ -75,6 +77,13 @@ class TableRCA:
             abn_codes,
             pad_policy=cfg.runtime.pad_policy,
             min_pad=cfg.runtime.min_pad,
+            # Sharded ranking uses the coo kernel; no aux views needed.
+            aux=(
+                "none"
+                if self._mesh is not None
+                else aux_for_kernel(cfg.runtime.kernel)
+            ),
+            dense_budget_bytes=cfg.runtime.dense_budget_bytes,
         )
         if self._mesh is not None:
             from ..parallel.sharded_rank import (
@@ -94,7 +103,7 @@ class TableRCA:
         else:
             kernel = cfg.runtime.kernel
             if kernel == "auto":
-                kernel = choose_kernel(graph, cfg.runtime.dense_budget_bytes)
+                kernel = choose_kernel(graph)
             top_idx, top_scores, n_valid = rank_window_device(
                 jax.tree.map(jnp.asarray, graph),
                 cfg.pagerank,
@@ -105,11 +114,18 @@ class TableRCA:
         return top_idx, top_scores, n_valid, op_names
 
     def finalize_rank(self, handles):
-        """Force a dispatched rank's results to host (blocks if needed)."""
+        """Force a dispatched rank's results to host (blocks if needed).
+
+        One batched ``jax.device_get`` — per-buffer fetches each pay a full
+        RPC round trip on tunneled-TPU runtimes (~78 ms apiece measured),
+        so never convert device scalars/arrays piecemeal on this path."""
         top_idx, top_scores, n_valid, op_names = handles
+        top_idx, top_scores, n_valid = jax.device_get(
+            (top_idx, top_scores, n_valid)
+        )
         n = int(n_valid)
-        names = [op_names[int(i)] for i in np.asarray(top_idx)[:n]]
-        scores = [float(s) for s in np.asarray(top_scores)[:n]]
+        names = [op_names[int(i)] for i in top_idx[:n]]
+        scores = [float(s) for s in top_scores[:n]]
         if self.config.runtime.validate_numerics:
             from ..utils.guards import assert_finite_scores
 
@@ -253,6 +269,8 @@ class TableRCA:
             stack_window_graphs,
         )
 
+        from ..graph.build import aux_for_kernel
+
         cfg = self.config
         graphs = []
         op_names = list(table.pod_op_names)
@@ -263,16 +281,23 @@ class TableRCA:
                     table, mask, nrm, abn,
                     pad_policy=cfg.runtime.pad_policy,
                     min_pad=cfg.runtime.min_pad,
+                    aux=aux_for_kernel(cfg.runtime.kernel),
+                    # All B windows' matrices are live at once under vmap.
+                    dense_budget_bytes=max(
+                        1, cfg.runtime.dense_budget_bytes // len(pending)
+                    ),
                 )
                 graphs.append(graph)
             stacked = stack_window_graphs(graphs)
         with timings.stage("rank_batched"):
             top_idx, top_scores, n_valid = rank_windows_batched(
-                stacked, cfg.pagerank, cfg.spectrum
+                stacked, cfg.pagerank, cfg.spectrum, cfg.runtime.kernel
             )
-            top_idx = np.asarray(top_idx)
-            top_scores = np.asarray(top_scores)
-            n_valid = np.asarray(n_valid)
+            # One batched fetch: per-buffer transfers each pay an RPC
+            # round trip on tunneled-TPU runtimes.
+            top_idx, top_scores, n_valid = jax.device_get(
+                (top_idx, top_scores, n_valid)
+            )
         shared = timings.as_dict()
         for b, (result, _, _, _) in enumerate(pending):
             n = int(n_valid[b])

@@ -65,6 +65,20 @@ def preference_vector(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
     return jnp.where(live, pref, 0.0).astype(jnp.float32)
 
 
+def unpack_bits(bits, n_cols: int, dtype=jnp.float32):
+    """Device-side bitmap expansion: uint8[V, C] -> dtype[V, n_cols].
+
+    Inverse of host ``np.packbits(..., axis=1)`` (big-endian bit order) —
+    pure shift/mask/reshape, no scatter or gather. ~0.2 ms for the 134 MB
+    f32 result at the 1M-span scale, vs ~75 ms for the scatter it replaces.
+    """
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    b = (bits[:, :, None] >> shifts) & jnp.uint8(1)
+    return b.reshape(bits.shape[0], bits.shape[1] * 8)[:, :n_cols].astype(
+        dtype
+    )
+
+
 def densify(g: PartitionGraph):
     """Scatter the COO entries into the dense reference-shaped matrices
     (pagerank.py:19-24) on device: [V, T] p_sr, [T, V] p_rs, [V, V] p_ss.
@@ -182,6 +196,97 @@ def partition_pagerank(
                     coo_matvec(g.inc_trace, g.inc_op, g.rs_val, sv, t_pad)
                 ),
             )
+
+    elif kernel in ("packed", "packed_bf16"):
+        # The MXU path without the scatter: every transition matrix is a
+        # 0/1 pattern scaled along its source axis, so the program unpacks
+        # the host-packed pattern bitmaps with shift/mask ops and applies
+        # the scaling as elementwise vector products around plain dense
+        # matvecs. One [V, T] matrix serves BOTH directions (p_sr uses it
+        # as-is, p_rs is its transpose with a different scaling), halving
+        # resident matrix bytes vs the dense kernel — and TPU matvecs beat
+        # per-entry gathers/scatters by ~an order of magnitude here.
+        if psum_axis is not None:
+            raise ValueError(
+                "the packed kernel does not support entry-axis sharding; "
+                "use kernel='coo' under shard_map"
+            )
+        if g.cov_bits.shape[-1] == 0:
+            raise ValueError(
+                "kernel='packed' needs bitmaps, but this window was built "
+                "without them (aux policy chose csr — past the dense "
+                "budget — or aux='none') — build with aux='packed'/'all' "
+                "or use kernel='csr'"
+            )
+        mat_dtype = (
+            jnp.bfloat16 if kernel == "packed_bf16" else jnp.float32
+        )
+        b_cov = unpack_bits(g.cov_bits, t_pad, mat_dtype)
+        b_ss = unpack_bits(g.ss_bits, v, mat_dtype)
+        w_len = g.inv_tracelen
+        w_cov = g.inv_cov_dup
+        w_out = g.inv_outdeg
+
+        def matvecs(sv, rv):
+            return (
+                jnp.dot(
+                    b_cov,
+                    (rv * w_len).astype(mat_dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                + alpha
+                * jnp.dot(
+                    b_ss,
+                    (sv * w_out).astype(mat_dtype),
+                    preferred_element_type=jnp.float32,
+                ),
+                jnp.dot(
+                    (sv * w_cov).astype(mat_dtype),
+                    b_cov,
+                    preferred_element_type=jnp.float32,
+                ),
+            )
+
+    elif kernel == "csr":
+        # Scatter-free SpMV: gather -> cumsum -> difference at row
+        # boundaries. XLA lowers TPU scatters to serialized updates (the
+        # measured densify cost dwarfs the 25 matvecs), while cumsum is a
+        # log-depth pass and gathers vectorize — so each SpMV touches the
+        # entry list a constant number of times with no scatter anywhere.
+        # Exactness: operand values are identical to the COO path (same
+        # f32 vals, same products); only the summation tree differs
+        # (prefix-sum differences vs segment scatter-adds), which is the
+        # usual f32 reassociation tolerance the parity suite tests under.
+        if psum_axis is not None:
+            raise ValueError(
+                "the csr kernel needs the whole entry list on one device; "
+                "use kernel='coo' under shard_map"
+            )
+        if g.inc_indptr_op.shape[-1] == 0:
+            raise ValueError(
+                "kernel='csr' needs the CSR views, but this window was "
+                "built with aux='auto' inside the bitmap budget — build "
+                "with aux='all' (or use kernel='packed')"
+            )
+
+        def csr_rowsum(prod, indptr):
+            cs = jnp.concatenate(
+                [jnp.zeros((1,), jnp.float32), jnp.cumsum(prod)]
+            )
+            return jnp.take(cs, indptr[1:]) - jnp.take(cs, indptr[:-1])
+
+        def matvecs(sv, rv):
+            y_sr = csr_rowsum(
+                g.sr_val_opmajor * jnp.take(rv, g.inc_trace_opmajor),
+                g.inc_indptr_op,
+            )
+            y_ss = csr_rowsum(
+                g.ss_val * jnp.take(sv, g.ss_parent), g.ss_indptr
+            )
+            y_rs = csr_rowsum(
+                g.rs_val * jnp.take(sv, g.inc_op), g.inc_indptr_trace
+            )
+            return y_sr + alpha * y_ss, y_rs
 
     elif kernel == "pallas":
         # One-hot MXU segment sums (ops/pallas_spmv.py): the scatter side
@@ -302,15 +407,23 @@ def rank_window_core(
 rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
 
 
-def choose_kernel(graph: WindowGraph, budget_bytes: int) -> str:
-    """auto kernel policy: dense (MXU matmuls) when both partitions'
-    scattered matrices fit the budget, COO segment-sums otherwise."""
-    total = 0
-    for g in (graph.normal, graph.abnormal):
-        v = int(g.cov_unique.shape[0])
-        t = int(g.kind.shape[0])
-        total += (2 * v * t + v * v) * 4
-    return "dense" if total <= budget_bytes else "coo"
+def choose_kernel(graph: WindowGraph, budget_bytes: int = 0) -> str:
+    """auto kernel policy, by PRESENCE of the auxiliary views the build
+    constructed (graph.build.resolve_aux holds the actual budget policy, so
+    build and kernel choice cannot disagree). Rationale, from measured v5e
+    costs at the 1M-span scale (scatter ~75 ms each, 1M-entry gather ~8 ms
+    *per iteration*, dense matvec sub-ms): "packed" bitmap-expanded MXU
+    matvecs when available, "csr" cumsum-difference SpMV (scatter-free,
+    entry-linear memory) past the budget, "coo" as the last resort (e.g. a
+    stacked batch that mixed aux modes). ``budget_bytes`` is unused and
+    kept for call-site compatibility."""
+    parts = (graph.normal, graph.abnormal)
+    # [-1] indexing so batched ([B, ...]-leading) graphs work too.
+    if all(int(g.cov_bits.shape[-1]) > 0 for g in parts):
+        return "packed"
+    if all(int(g.inc_indptr_op.shape[-1]) > 0 for g in parts):
+        return "csr"
+    return "coo"
 
 
 class JaxBackend:
@@ -329,7 +442,7 @@ class JaxBackend:
     def rank_window(
         self, span_df, normal_ids, abnormal_ids
     ) -> Tuple[List[str], List[float]]:
-        from ..graph.build import build_window_graph
+        from ..graph.build import aux_for_kernel, build_window_graph
         from .base import validate_partitions
 
         normal_ids = list(normal_ids)
@@ -342,16 +455,23 @@ class JaxBackend:
             abnormal_ids,
             pad_policy=rt.pad_policy,
             min_pad=rt.min_pad,
+            aux=aux_for_kernel(rt.kernel),
+            dense_budget_bytes=rt.dense_budget_bytes,
         )
         kernel = rt.kernel
         if kernel == "auto":
-            kernel = choose_kernel(graph, rt.dense_budget_bytes)
+            kernel = choose_kernel(graph)
         top_idx, top_scores, n_valid = rank_window_device(
             jax.tree.map(jnp.asarray, graph),
             self.config.pagerank,
             self.config.spectrum,
             None,
             kernel,
+        )
+        # One batched fetch — piecemeal int()/float() conversions on device
+        # arrays each pay a full RPC round trip on tunneled-TPU runtimes.
+        top_idx, top_scores, n_valid = jax.device_get(
+            (top_idx, top_scores, n_valid)
         )
         n = int(n_valid)
         idx = [int(i) for i in top_idx[:n]]

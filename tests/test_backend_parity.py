@@ -125,6 +125,103 @@ def test_dense_kernel_matches_coo(small_case):
     )
 
 
+@pytest.mark.parametrize("kernel", ["csr", "packed", "packed_bf16"])
+def test_scatterfree_kernels_match_coo(small_case, kernel):
+    # The cumsum-difference CSR path and the bitmap-expanded packed path
+    # are the same math as the COO segment-sum path (f32 reassociation
+    # tolerance; bf16 matrices still carry exact 0/1 entries but round the
+    # scaled vectors, so only rank order is asserted there).
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(
+        small_case.abnormal, nrm, abn, aux="all"
+    )
+    dg = jax.tree.map(jnp.asarray, graph)
+    ti_c, ts_c, _ = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "coo")
+    ti_k, ts_k, _ = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, kernel)
+    ti_c, ts_c = np.asarray(ti_c), np.asarray(ts_c)
+    ti_k, ts_k = np.asarray(ti_k), np.asarray(ts_k)
+    # Top-1 parity plus same candidate set; exact positional equality is
+    # not guaranteed — different summation trees perturb tied scores.
+    assert ti_c[0] == ti_k[0]
+    assert set(ti_c.tolist()) == set(ti_k.tolist())
+    if kernel != "packed_bf16":
+        sc_c = dict(zip(ti_c.tolist(), ts_c.tolist()))
+        sc_k = dict(zip(ti_k.tolist(), ts_k.tolist()))
+        for op, v in sc_c.items():
+            if np.isfinite(v):
+                assert abs(v - sc_k[op]) <= 1e-4 * max(abs(v), 1e-12), op
+
+
+def test_forced_csr_kernel_via_config(small_case):
+    # RuntimeConfig.kernel="csr" must work end to end: the backend plumbs
+    # the matching aux mode into the graph build (regression: it used to
+    # build aux="auto", skip the CSR views, and crash).
+    from microrank_tpu.config import RuntimeConfig
+
+    cfg = MicroRankConfig(runtime=RuntimeConfig(kernel="csr"))
+    nrm, abn = partition_case(small_case)
+    top, _ = get_backend(cfg).rank_window(small_case.abnormal, nrm, abn)
+    assert top[0] == small_case.fault_pod_op
+
+
+def test_auto_policy_past_budget_is_coherent(small_case):
+    # A dense budget too small for the bitmaps must yield a csr-view build
+    # AND a csr kernel choice — build policy and kernel choice cannot
+    # disagree (regression: choose_kernel could pick csr for a bitmap-only
+    # build and crash).
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import (
+        choose_kernel,
+        rank_window_device,
+    )
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(
+        small_case.abnormal, nrm, abn, dense_budget_bytes=1
+    )
+    assert graph.normal.cov_bits.shape[1] == 0
+    assert graph.normal.inc_indptr_op.shape[0] > 0
+    kernel = choose_kernel(graph)
+    assert kernel == "csr"
+    ti, _, _ = rank_window_device(
+        jax.tree.map(jnp.asarray, graph),
+        cfg.pagerank,
+        cfg.spectrum,
+        None,
+        kernel,
+    )
+    assert names[int(np.asarray(ti)[0])] == small_case.fault_pod_op
+
+
+def test_csr_kernel_raises_without_aux(small_case):
+    # aux="auto" inside the bitmap budget skips the CSR views; forcing
+    # kernel="csr" must fail loudly, not return garbage.
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, _, _, _ = build_window_graph(small_case.abnormal, nrm, abn)
+    assert graph.normal.inc_indptr_op.shape[0] == 0
+    dg = jax.tree.map(jnp.asarray, graph)
+    with pytest.raises(ValueError, match="csr"):
+        rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "csr")
+
+
 def test_pallas_kernel_matches_coo(small_case):
     # One-hot MXU SpMV (interpret mode on CPU) == segment-sum path.
     import jax
