@@ -18,7 +18,6 @@ from ..io.interning import Vocab
 from .build import (
     DEFAULT_DENSE_BUDGET_BYTES,
     _build_partition,
-    build_aux_views,
     resolve_aux,
 )
 from .structures import (
@@ -110,23 +109,12 @@ def detect_batch_from_table(
     return batch, uniques
 
 
-def _graph_from_padded(p, mode: str):
+def _graph_from_padded(p):
     """Wrap one native PaddedPartition (already padded) as PartitionGraph.
 
-    The CSR/bitmap views are a numpy post-pass through the SAME
-    build_aux_views helper as the numpy lane (graph_builder.cpp emits the
-    same trace-major / child-sorted orders, so the invariants hold).
-    ``mode`` must already be window-level resolved (resolve_aux)."""
-    v_pad = p.cov_unique.shape[0]
-    t_pad = p.kind.shape[0]
-    (
-        tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
-        cov_bits, ss_bits, inv_len, inv_cov, inv_out,
-    ) = build_aux_views(
-        p.inc_op, p.inc_trace, p.sr_val, p.rs_val,
-        p.ss_child, p.ss_parent, p.ss_val,
-        int(p.n_inc), int(p.n_ss), v_pad, t_pad, mode,
-    )
+    All auxiliary kernel views were exported by the C++ side
+    (mr_export_bitmaps / mr_export_csr) per the resolved aux mode — this
+    is a pure field copy."""
     return PartitionGraph(
         inc_op=p.inc_op,
         inc_trace=p.inc_trace,
@@ -135,16 +123,16 @@ def _graph_from_padded(p, mode: str):
         ss_child=p.ss_child,
         ss_parent=p.ss_parent,
         ss_val=p.ss_val,
-        inc_trace_opmajor=tr_om,
-        sr_val_opmajor=sr_om,
-        inc_indptr_op=indptr_op,
-        inc_indptr_trace=indptr_trace,
-        ss_indptr=ss_indptr,
-        cov_bits=cov_bits,
-        ss_bits=ss_bits,
-        inv_tracelen=inv_len,
-        inv_cov_dup=inv_cov,
-        inv_outdeg=inv_out,
+        inc_trace_opmajor=p.inc_trace_opmajor,
+        sr_val_opmajor=p.sr_val_opmajor,
+        inc_indptr_op=p.inc_indptr_op,
+        inc_indptr_trace=p.inc_indptr_trace,
+        ss_indptr=p.ss_indptr,
+        cov_bits=p.cov_bits,
+        ss_bits=p.ss_bits,
+        inv_tracelen=p.inv_tracelen,
+        inv_cov_dup=p.inv_cov_dup,
+        inv_outdeg=p.inv_outdeg,
         kind=p.kind,
         tracelen=p.tracelen,
         cov_unique=p.cov_unique,
@@ -221,13 +209,14 @@ def build_window_graph_from_table(
                     vocab_size,
                     v_pad,
                     lambda n: pad_to(n, pad_policy, min_pad),
+                    mode,
                 )
             except NativeUnavailable:
                 raw_n = raw_a = None  # fall through to the numpy lane
             if raw_n is not None:
                 graph = WindowGraph(
-                    normal=_graph_from_padded(raw_n, mode),
-                    abnormal=_graph_from_padded(raw_a, mode),
+                    normal=_graph_from_padded(raw_n),
+                    abnormal=_graph_from_padded(raw_a),
                 )
                 return (
                     graph,

@@ -140,6 +140,20 @@ def _load_library() -> ctypes.CDLL:
         i32p, i32p, i32p,                # kind, tracelen, local_uniques
         i32p, u8p,                       # cov_unique, op_present
     ]
+    lib.mr_export_bitmaps.restype = None
+    lib.mr_export_bitmaps.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        u8p, ctypes.c_int64,             # cov_bits, t8
+        u8p, ctypes.c_int64,             # ss_bits, v8
+        f32p, f32p, f32p,                # inv_len, inv_cov, inv_out
+    ]
+    lib.mr_export_csr.restype = None
+    lib.mr_export_csr.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # vocab, v_pad, t_pad
+        i32p, f32p,                      # tr_om, sr_om
+        i32p, i32p, i32p,                # indptr_op, indptr_trace, ss_indptr
+    ]
     lib.mr_free_built.restype = None
     lib.mr_free_built.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -309,6 +323,19 @@ class PaddedPartition(NamedTuple):
     local_uniques: np.ndarray  # int32[n_traces]
     cov_unique: np.ndarray   # int32[v_pad]
     op_present: np.ndarray   # bool[v_pad]
+    # Auxiliary kernel views (see graph.structures.PartitionGraph), filled
+    # per the resolved aux mode; unbuilt views are [0]-shaped ([x, 0] for
+    # bitmaps) placeholders.
+    inc_trace_opmajor: np.ndarray  # int32[e_pad]
+    sr_val_opmajor: np.ndarray     # float32[e_pad]
+    inc_indptr_op: np.ndarray      # int32[v_pad+1]
+    inc_indptr_trace: np.ndarray   # int32[t_pad+1]
+    ss_indptr: np.ndarray          # int32[v_pad+1]
+    cov_bits: np.ndarray           # uint8[v_pad, t_pad/8]
+    ss_bits: np.ndarray            # uint8[v_pad, v_pad/8]
+    inv_tracelen: np.ndarray       # float32[t_pad]
+    inv_cov_dup: np.ndarray        # float32[v_pad]
+    inv_outdeg: np.ndarray         # float32[v_pad]
     n_ops: int
     n_traces: int
     n_inc: int
@@ -325,6 +352,7 @@ def build_window_padded(
     vocab_size: int,
     v_pad: int,
     pad,
+    mode: str = "none",
 ) -> Tuple[PaddedPartition, PaddedPartition]:
     """Build both partitions' COO graphs in C++ (fused single scans),
     exported directly into padded numpy buffers (single copy).
@@ -333,7 +361,12 @@ def build_window_padded(
     global trace codes; ``row_mask`` (bool over rows, or None for all)
     is the detection window (get_span semantics applied upstream);
     ``pad`` maps a true length to its padded length (>= the true length).
+    ``mode`` is a RESOLVED aux mode (graph.build.resolve_aux): which
+    kernel views ("packed" bitmaps / "csr" orderings / "all" / "none") the
+    C++ side additionally exports.
     """
+    if mode not in ("packed", "csr", "all", "none"):
+        raise ValueError(f"unresolved aux mode {mode!r}")
     lib = _load_library()
     pod_op = np.ascontiguousarray(pod_op, dtype=np.int32)
     trace_id = np.ascontiguousarray(trace_id, dtype=np.int32)
@@ -366,9 +399,13 @@ def build_window_padded(
         sizes = np.zeros(8, dtype=np.int64)
         lib.mr_window_sizes(handle, sizes.ctypes.data_as(i64p))
         out = []
+        want_bits = mode in ("packed", "all")
+        want_csr = mode in ("csr", "all")
         for idx in range(2):
             n_inc, n_ss, n_tr, n_ops = (int(x) for x in sizes[4 * idx: 4 * idx + 4])
             e_pad, c_pad, t_pad = pad(n_inc), pad(n_ss), pad(n_tr)
+            t8 = (t_pad + 7) // 8
+            v8 = (v_pad + 7) // 8
             p = PaddedPartition(
                 inc_op=np.zeros(e_pad, np.int32),
                 inc_trace=np.zeros(e_pad, np.int32),
@@ -382,6 +419,18 @@ def build_window_padded(
                 local_uniques=np.zeros(n_tr, np.int32),
                 cov_unique=np.zeros(v_pad, np.int32),
                 op_present=np.zeros(v_pad, np.bool_),
+                inc_trace_opmajor=np.zeros(e_pad if want_csr else 0, np.int32),
+                sr_val_opmajor=np.zeros(e_pad if want_csr else 0, np.float32),
+                inc_indptr_op=np.zeros(v_pad + 1 if want_csr else 0, np.int32),
+                inc_indptr_trace=np.zeros(
+                    t_pad + 1 if want_csr else 0, np.int32
+                ),
+                ss_indptr=np.zeros(v_pad + 1 if want_csr else 0, np.int32),
+                cov_bits=np.zeros((v_pad, t8 if want_bits else 0), np.uint8),
+                ss_bits=np.zeros((v_pad, v8 if want_bits else 0), np.uint8),
+                inv_tracelen=np.zeros(t_pad, np.float32),
+                inv_cov_dup=np.zeros(v_pad, np.float32),
+                inv_outdeg=np.zeros(v_pad, np.float32),
                 n_ops=n_ops,
                 n_traces=n_tr,
                 n_inc=n_inc,
@@ -402,6 +451,32 @@ def build_window_padded(
                 p.cov_unique.ctypes.data_as(i32p),
                 p.op_present.ctypes.data_as(u8p),
             )
+            if want_bits:
+                lib.mr_export_bitmaps(
+                    handle, ctypes.c_int32(idx),
+                    p.cov_bits.ctypes.data_as(u8p), ctypes.c_int64(t8),
+                    p.ss_bits.ctypes.data_as(u8p), ctypes.c_int64(v8),
+                    p.inv_tracelen.ctypes.data_as(f32p),
+                    p.inv_cov_dup.ctypes.data_as(f32p),
+                    p.inv_outdeg.ctypes.data_as(f32p),
+                )
+            else:
+                # The inverse vectors are cheap and also wanted by "csr"
+                # callers for completeness — fill from the value arrays.
+                p.inv_tracelen[p.inc_trace[:n_inc]] = p.sr_val[:n_inc]
+                p.inv_cov_dup[p.inc_op[:n_inc]] = p.rs_val[:n_inc]
+                p.inv_outdeg[p.ss_parent[:n_ss]] = p.ss_val[:n_ss]
+            if want_csr:
+                lib.mr_export_csr(
+                    handle, ctypes.c_int32(idx),
+                    ctypes.c_int64(vocab_size),
+                    ctypes.c_int64(v_pad), ctypes.c_int64(t_pad),
+                    p.inc_trace_opmajor.ctypes.data_as(i32p),
+                    p.sr_val_opmajor.ctypes.data_as(f32p),
+                    p.inc_indptr_op.ctypes.data_as(i32p),
+                    p.inc_indptr_trace.ctypes.data_as(i32p),
+                    p.ss_indptr.ctypes.data_as(i32p),
+                )
             out.append(p)
         return out[0], out[1]
     finally:

@@ -348,6 +348,62 @@ void mr_export_partition(const MrBuiltWindow* g, int32_t idx, int32_t* inc_op,
   cp(op_present, p.op_present);
 }
 
+// Packed-kernel views: 0/1 pattern bitmaps (big-endian bit order, matching
+// np.packbits) plus the three inverse vectors, written into caller-ZEROED
+// padded buffers. ``t8``/``v8`` are the bitmap row strides in bytes
+// (= t_pad/8, v_pad/8 rounded up). inv values copy the same f32 the COO
+// value arrays carry, so the packed kernel is value-identical to it.
+void mr_export_bitmaps(const MrBuiltWindow* g, int32_t idx, uint8_t* cov_bits,
+                       int64_t t8, uint8_t* ss_bits, int64_t v8,
+                       float* inv_len, float* inv_cov, float* inv_out) {
+  const BuiltPartition& p = g->parts[idx];
+  const int64_t n_inc = static_cast<int64_t>(p.inc_op.size());
+  for (int64_t i = 0; i < n_inc; ++i) {
+    const int32_t v = p.inc_op[i], t = p.inc_trace[i];
+    cov_bits[static_cast<int64_t>(v) * t8 + (t >> 3)] |=
+        static_cast<uint8_t>(128u >> (t & 7));
+    inv_cov[v] = p.rs_val[i];
+  }
+  const int64_t n_tr = static_cast<int64_t>(p.tracelen.size());
+  for (int64_t t = 0; t < n_tr; ++t)
+    inv_len[t] = 1.0f / static_cast<float>(p.tracelen[t]);
+  const int64_t n_ss = static_cast<int64_t>(p.ss_child.size());
+  for (int64_t i = 0; i < n_ss; ++i) {
+    const int32_t c = p.ss_child[i], par = p.ss_parent[i];
+    ss_bits[static_cast<int64_t>(c) * v8 + (par >> 3)] |=
+        static_cast<uint8_t>(128u >> (par & 7));
+    inv_out[par] = p.ss_val[i];
+  }
+}
+
+// CSR-kernel views: op-major reorder of the incidence (stable counting
+// scatter — entries are stored trace-major with ops ascending per trace,
+// so op rows keep traces ascending) plus the three row-offset arrays.
+// Caller-zeroed buffers: tr_om/sr_om e_pad-length, indptr_op/ss_indptr
+// (v_pad+1)-length, indptr_trace (t_pad+1)-length.
+void mr_export_csr(const MrBuiltWindow* g, int32_t idx, int64_t vocab,
+                   int64_t v_pad, int64_t t_pad, int32_t* tr_om, float* sr_om,
+                   int32_t* indptr_op, int32_t* indptr_trace,
+                   int32_t* ss_indptr) {
+  const BuiltPartition& p = g->parts[idx];
+  const int64_t n_inc = static_cast<int64_t>(p.inc_op.size());
+  indptr_op[0] = 0;
+  for (int64_t o = 0; o < v_pad; ++o)
+    indptr_op[o + 1] =
+        indptr_op[o] + (o < vocab ? p.cov_unique[o] : 0);
+  std::vector<int32_t> cur(indptr_op, indptr_op + vocab);
+  for (int64_t i = 0; i < n_inc; ++i) {
+    const int32_t pos = cur[p.inc_op[i]]++;
+    tr_om[pos] = p.inc_trace[i];
+    sr_om[pos] = p.sr_val[i];
+  }
+  for (int64_t i = 0; i < n_inc; ++i) ++indptr_trace[p.inc_trace[i] + 1];
+  for (int64_t t = 0; t < t_pad; ++t) indptr_trace[t + 1] += indptr_trace[t];
+  const int64_t n_ss = static_cast<int64_t>(p.ss_child.size());
+  for (int64_t i = 0; i < n_ss; ++i) ++ss_indptr[p.ss_child[i] + 1];
+  for (int64_t o = 0; o < v_pad; ++o) ss_indptr[o + 1] += ss_indptr[o];
+}
+
 void mr_free_built(MrBuiltWindow* g) { delete g; }
 
 }  // extern "C"

@@ -158,16 +158,19 @@ def test_native_graph_build_matches_numpy(csv_pair):
     for mask in (full, partial):
         codes = np.unique(tab.trace_id[mask])
         nrm, abn = codes[::2], codes[1::2]
-        g1, n1, a1, b1 = build_window_graph_from_table(
-            tab, mask, nrm, abn, use_native=True
-        )
-        g2, n2, a2, b2 = build_window_graph_from_table(
-            tab, mask, nrm, abn, use_native=False
-        )
-        assert n1 == n2
-        np.testing.assert_array_equal(a1, a2)
-        np.testing.assert_array_equal(b1, b2)
-        _assert_graphs_equal(g1, g2)
+        # aux="all" also compares the C++-exported bitmap and CSR kernel
+        # views against the numpy-lane constructions, field for field.
+        for aux in ("auto", "all"):
+            g1, n1, a1, b1 = build_window_graph_from_table(
+                tab, mask, nrm, abn, use_native=True, aux=aux
+            )
+            g2, n2, a2, b2 = build_window_graph_from_table(
+                tab, mask, nrm, abn, use_native=False, aux=aux
+            )
+            assert n1 == n2
+            np.testing.assert_array_equal(a1, a2)
+            np.testing.assert_array_equal(b1, b2)
+            _assert_graphs_equal(g1, g2)
 
 
 def test_native_graph_build_empty_partition(csv_pair):
