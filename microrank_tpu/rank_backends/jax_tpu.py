@@ -102,27 +102,19 @@ def densify(g: PartitionGraph):
     return p_ss, p_sr, p_rs
 
 
-def partition_pagerank(
+def _partition_setup(
     g: PartitionGraph,
     anomaly: bool,
     cfg: PageRankConfig,
     psum_axis: str | None = None,
     kernel: str = "coo",
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Power-iterate one partition; returns (weight[V], score[V]).
+):
+    """One partition's iteration ingredients: (matvecs, pref, sv0, rv0).
 
-    ``weight`` is the reference's rescaled output
-    (score * sum(scores) / n_ops, pagerank.py:106-107); ``score`` the raw
-    max-normalized PageRank vector. Ops absent from the partition have no
-    incoming entries, stay at 0, and cannot perturb present ops — so
-    running on the shared window vocab is exact.
-
-    ``psum_axis``: when called under shard_map with the COO *entry* axes
-    (inc_*/ss_*) sharded across that mesh axis, each device segment-sums
-    its entry shard into full dense [V]/[T] partials and the psum combines
-    them — the ranking vectors stay replicated (V and T vectors are small;
-    the entries are the big axis). This is the whole multi-chip story for
-    the SpMV (SURVEY.md C18/C19 plan).
+    Factored out of partition_pagerank so rank_window_core can step BOTH
+    partitions inside one fori_loop (their updates are independent; fusing
+    them halves the loop-body op count, which matters on latency-sensitive
+    runtimes).
     """
     v = g.cov_unique.shape[0]
     t_pad = g.kind.shape[0]
@@ -318,24 +310,61 @@ def partition_pagerank(
     else:
         raise ValueError(f"unknown pagerank kernel {kernel!r}")
 
-    def body(_, carry):
-        sv, rv = carry
-        # sv' = d*(p_sr @ rv + alpha * p_ss @ sv)    (pagerank.py:122-124)
-        # rv' = d*(p_rs @ sv) + (1-d) * pref         (pagerank.py:125)
-        mv_s, mv_r = matvecs(sv, rv)
-        sv_new = d * mv_s
-        rv_new = d * mv_r + (1.0 - d) * pref
-        if cfg.max_normalize_each_iter:
-            sv_new = sv_new / jnp.max(sv_new)
-            rv_new = rv_new / jnp.max(rv_new)
-        return sv_new, rv_new
+    return matvecs, pref, sv, rv
 
-    sv, rv = lax.fori_loop(0, cfg.iterations, body, (sv, rv))
+
+def _partition_step(matvecs, pref, sv, rv, cfg: PageRankConfig):
+    """One power-iteration step (pagerank.py:122-127):
+    sv' = d*(p_sr @ rv + alpha * p_ss @ sv);
+    rv' = d*(p_rs @ sv) + (1-d) * pref; both max-normalized."""
+    d = jnp.float32(cfg.damping)
+    mv_s, mv_r = matvecs(sv, rv)
+    sv_new = d * mv_s
+    rv_new = d * mv_r + (1.0 - d) * pref
+    if cfg.max_normalize_each_iter:
+        sv_new = sv_new / jnp.max(sv_new)
+        rv_new = rv_new / jnp.max(rv_new)
+    return sv_new, rv_new
+
+
+def _partition_finish(g: PartitionGraph, sv):
+    """Final normalize + the reference's rescale (pagerank.py:93-112):
+    returns (weight[V], score[V])."""
     score = sv / jnp.max(sv)
-
     total = jnp.where(g.op_present, score, 0.0).sum()
     weight = score * total / g.n_ops.astype(jnp.float32)
     return weight, score
+
+
+def partition_pagerank(
+    g: PartitionGraph,
+    anomaly: bool,
+    cfg: PageRankConfig,
+    psum_axis: str | None = None,
+    kernel: str = "coo",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Power-iterate one partition; returns (weight[V], score[V]).
+
+    ``weight`` is the reference's rescaled output
+    (score * sum(scores) / n_ops, pagerank.py:106-107); ``score`` the raw
+    max-normalized PageRank vector. Ops absent from the partition have no
+    incoming entries, stay at 0, and cannot perturb present ops — so
+    running on the shared window vocab is exact.
+
+    ``psum_axis``: when called under shard_map with the COO *entry* axes
+    (inc_*/ss_*) sharded across that mesh axis, each device segment-sums
+    its entry shard into full dense [V]/[T] partials and the psum combines
+    them — the ranking vectors stay replicated (V and T vectors are small;
+    the entries are the big axis). This is the whole multi-chip story for
+    the SpMV (SURVEY.md C18/C19 plan).
+    """
+    matvecs, pref, sv, rv = _partition_setup(g, anomaly, cfg, psum_axis, kernel)
+
+    def body(_, carry):
+        return _partition_step(matvecs, pref, *carry, cfg)
+
+    sv, rv = lax.fori_loop(0, cfg.iterations, body, (sv, rv))
+    return _partition_finish(g, sv)
 
 
 def window_spectrum(
@@ -389,12 +418,29 @@ def rank_window_core(
     indices into the shared window op vocab, score-descending;
     entries beyond ``n_valid`` are padding (score -inf).
     """
-    n_weight, _ = partition_pagerank(
+    # Both partitions step inside ONE fori_loop (their iterations are
+    # independent; fusing halves the loop-body op count and lets XLA
+    # schedule the small partition's matvecs into the big one's gaps).
+    # Per-partition math is identical to partition_pagerank.
+    mv_n, pref_n, sv_n, rv_n = _partition_setup(
         graph.normal, False, pagerank_cfg, psum_axis, kernel
     )
-    a_weight, _ = partition_pagerank(
+    mv_a, pref_a, sv_a, rv_a = _partition_setup(
         graph.abnormal, True, pagerank_cfg, psum_axis, kernel
     )
+
+    def body(_, carry):
+        (sv_n, rv_n), (sv_a, rv_a) = carry
+        return (
+            _partition_step(mv_n, pref_n, sv_n, rv_n, pagerank_cfg),
+            _partition_step(mv_a, pref_a, sv_a, rv_a, pagerank_cfg),
+        )
+
+    (sv_n, rv_n), (sv_a, rv_a) = lax.fori_loop(
+        0, pagerank_cfg.iterations, body, ((sv_n, rv_n), (sv_a, rv_a))
+    )
+    n_weight, _ = _partition_finish(graph.normal, sv_n)
+    a_weight, _ = _partition_finish(graph.abnormal, sv_a)
     scores, valid = window_spectrum(
         a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
     )
