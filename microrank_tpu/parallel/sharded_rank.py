@@ -198,33 +198,37 @@ def _partition_specs(window_axis, shard_axis) -> PartitionGraph:
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def rank_windows_sharded(
     batched: WindowGraph,
     pagerank_cfg: PageRankConfig,
     spectrum_cfg: SpectrumConfig,
     mesh: Mesh,
+    kernel: str = "coo",
 ):
     """Rank a batch of windows over the 2D (windows, shard) mesh.
 
     Input arrays carry a leading batch axis B (divisible by the windows
     axis size) with entry axes divisible by the shard axis size — use
     ``stack_window_graphs(graphs, shard_multiple=mesh.shape['shard'])``.
-    Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
+    ``kernel`` must be shard-capable: "coo" (segment-sum partials) or
+    "csr" (local-block prefix sums with clamped row ranges; needs graphs
+    built with the CSR views, aux="csr"/"all"). Both psum the per-shard
+    partials. Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
     """
     specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
     in_specs = (WindowGraph(normal=specs, abnormal=specs),)
     out_specs = (P(WINDOW_AXIS), P(WINDOW_AXIS), P(WINDOW_AXIS))
 
-    def kernel(graph: WindowGraph):
+    def kernel_fn(graph: WindowGraph):
         return jax.vmap(
             lambda g: rank_window_core(
-                g, pagerank_cfg, spectrum_cfg, SHARD_AXIS
+                g, pagerank_cfg, spectrum_cfg, SHARD_AXIS, kernel
             )
         )(graph)
 
     return shard_map(
-        kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        kernel_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
     )(batched)
 
 

@@ -249,11 +249,13 @@ def _partition_setup(
         # f32 vals, same products); only the summation tree differs
         # (prefix-sum differences vs segment scatter-adds), which is the
         # usual f32 reassociation tolerance the parity suite tests under.
-        if psum_axis is not None:
-            raise ValueError(
-                "the csr kernel needs the whole entry list on one device; "
-                "use kernel='coo' under shard_map"
-            )
+        #
+        # Sharded (psum_axis set): each device holds one CONTIGUOUS block
+        # of the entry axis (shard_map block-splits the padded arrays) and
+        # the indptrs are replicated, so a row's local sum is the prefix
+        # difference over the row range CLAMPED to the local block; the
+        # psum adds the per-shard partials. Rows crossing a shard boundary
+        # are simply split across the adjacent shards.
         if g.inc_indptr_op.shape[-1] == 0:
             raise ValueError(
                 "kernel='csr' needs the CSR views, but this window was "
@@ -265,7 +267,15 @@ def _partition_setup(
             cs = jnp.concatenate(
                 [jnp.zeros((1,), jnp.float32), jnp.cumsum(prod)]
             )
-            return jnp.take(cs, indptr[1:]) - jnp.take(cs, indptr[:-1])
+            n_local = prod.shape[0]
+            lo = (
+                0
+                if psum_axis is None
+                else lax.axis_index(psum_axis) * n_local
+            )
+            a = jnp.clip(indptr[:-1], lo, lo + n_local) - lo
+            b = jnp.clip(indptr[1:], lo, lo + n_local) - lo
+            return reduce_shards(jnp.take(cs, b) - jnp.take(cs, a))
 
         def matvecs(sv, rv):
             y_sr = csr_rowsum(

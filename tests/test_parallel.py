@@ -72,6 +72,38 @@ def test_batched_vmap_matches_sharded(window_batch):
     assert rel.max() < 1e-4
 
 
+def test_sharded_csr_matches_coo(window_batch):
+    # The csr kernel under shard_map: each device prefix-sums its entry
+    # block with clamped row ranges; psum'd partials must equal the coo
+    # path's segment sums (f32 reassociation tolerance on scores).
+    graphs, namelists = window_batch
+    cfg = MicroRankConfig()
+    csr_graphs = []
+    for seed in (1, 2, 3, 4):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        graph, _, _, _ = build_window_graph(
+            case.abnormal, nrm, abn, aux="all"
+        )
+        csr_graphs.append(graph)
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(csr_graphs, shard_multiple=4)
+    jstacked = jax.tree.map(jnp.asarray, stacked)
+    ci, cs, _ = rank_windows_sharded(
+        jstacked, cfg.pagerank, cfg.spectrum, mesh, "csr"
+    )
+    oi, os_, _ = rank_windows_sharded(
+        jstacked, cfg.pagerank, cfg.spectrum, mesh, "coo"
+    )
+    for b in range(len(csr_graphs)):
+        assert int(ci[b][0]) == int(oi[b][0])
+        assert set(np.asarray(ci[b]).tolist()) == set(
+            np.asarray(oi[b]).tolist()
+        )
+
+
 def test_shard_only_mesh(window_batch):
     # Pure graph-parallelism: 1 window across all 8 devices.
     graphs, namelists = window_batch
