@@ -232,6 +232,23 @@ def rank_windows_sharded(
     )(batched)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _rank_windows_batched_jit(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str,
+):
+    # Module-level jit: cache keys on the config/kernel VALUES, so repeat
+    # batches reuse the compilation (a per-call jax.jit(lambda ...) would
+    # recompile every invocation — new closure, new cache entry).
+    return jax.vmap(
+        lambda g: rank_window_core(
+            g, pagerank_cfg, spectrum_cfg, None, kernel
+        )
+    )(batched)
+
+
 def rank_windows_batched(
     batched: WindowGraph,
     pagerank_cfg: PageRankConfig,
@@ -243,9 +260,6 @@ def rank_windows_batched(
         from ..rank_backends.jax_tpu import choose_kernel
 
         kernel = choose_kernel(batched)
-    fn = jax.vmap(
-        lambda g: rank_window_core(
-            g, pagerank_cfg, spectrum_cfg, None, kernel
-        )
+    return _rank_windows_batched_jit(
+        jax.tree.map(jnp.asarray, batched), pagerank_cfg, spectrum_cfg, kernel
     )
-    return jax.jit(fn)(jax.tree.map(jnp.asarray, batched))
