@@ -256,10 +256,13 @@ def rank_windows_batched(
     kernel: str = "auto",
 ):
     """Single-device vmapped batch ranking (BASELINE.json config 4)."""
-    if kernel == "auto":
-        from ..rank_backends.jax_tpu import choose_kernel
+    from ..rank_backends.jax_tpu import choose_kernel, device_subset
 
+    if kernel == "auto":
         kernel = choose_kernel(batched)
     return _rank_windows_batched_jit(
-        jax.tree.map(jnp.asarray, batched), pagerank_cfg, spectrum_cfg, kernel
+        jax.device_put(device_subset(batched, kernel)),
+        pagerank_cfg,
+        spectrum_cfg,
+        kernel,
     )

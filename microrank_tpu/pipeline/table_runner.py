@@ -94,18 +94,20 @@ class TableRCA:
             shard_n = int(self._mesh.devices.shape[1])
             stacked = stack_window_graphs([graph], shard_multiple=shard_n)
             ti, ts, nv = rank_windows_sharded(
-                jax.tree.map(jnp.asarray, stacked),
+                jax.device_put(stacked),
                 cfg.pagerank,
                 cfg.spectrum,
                 self._mesh,
             )
             top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
         else:
+            from ..rank_backends.jax_tpu import device_subset
+
             kernel = cfg.runtime.kernel
             if kernel == "auto":
                 kernel = choose_kernel(graph)
             top_idx, top_scores, n_valid = rank_window_device(
-                jax.tree.map(jnp.asarray, graph),
+                jax.device_put(device_subset(graph, kernel)),
                 cfg.pagerank,
                 cfg.spectrum,
                 None,

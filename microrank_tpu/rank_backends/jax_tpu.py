@@ -30,6 +30,7 @@ from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..config import MicroRankConfig, PageRankConfig, SpectrumConfig
@@ -463,6 +464,49 @@ def rank_window_core(
 rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
 
 
+_KERNEL_UNUSED_FIELDS = {
+    # The packed kernel reads only the bitmaps, inverse vectors, and the
+    # per-axis stats; the COO entry arrays (the big ones — ~19 of 28 MB at
+    # the 1M-span scale) never reach the traced branch.
+    "packed": (
+        "inc_op", "inc_trace", "sr_val", "rs_val",
+        "ss_child", "ss_parent", "ss_val",
+        "inc_trace_opmajor", "sr_val_opmajor",
+    ),
+    "packed_bf16": (
+        "inc_op", "inc_trace", "sr_val", "rs_val",
+        "ss_child", "ss_parent", "ss_val",
+        "inc_trace_opmajor", "sr_val_opmajor",
+    ),
+    # The csr kernel reads the trace-major COO arrays + CSR views, not the
+    # bitmaps (already empty under the aux policy).
+    "csr": ("cov_bits", "ss_bits"),
+}
+
+
+def device_subset(graph: WindowGraph, kernel: str) -> WindowGraph:
+    """Drop the fields ``kernel`` never reads (replaced by empty arrays)
+    before staging the graph on device — halves host->device bytes for the
+    packed kernel. Safe under jit: the kernel string is static, so the
+    dropped fields' branches are never traced."""
+    fields = _KERNEL_UNUSED_FIELDS.get(kernel, ())
+    if not fields:
+        return graph
+
+    def strip(p: PartitionGraph) -> PartitionGraph:
+        repl = {}
+        for f in fields:
+            arr = np.asarray(getattr(p, f))
+            # Zero only the LAST axis: leading batch/row dims survive so
+            # vmap/stacked graphs keep consistent mapped-axis sizes.
+            repl[f] = np.zeros(arr.shape[:-1] + (0,), arr.dtype)
+        return p._replace(**repl)
+
+    return WindowGraph(
+        normal=strip(graph.normal), abnormal=strip(graph.abnormal)
+    )
+
+
 def choose_kernel(graph: WindowGraph, budget_bytes: int = 0) -> str:
     """auto kernel policy, by PRESENCE of the auxiliary views the build
     constructed (graph.build.resolve_aux holds the actual budget policy, so
@@ -518,7 +562,7 @@ class JaxBackend:
         if kernel == "auto":
             kernel = choose_kernel(graph)
         top_idx, top_scores, n_valid = rank_window_device(
-            jax.tree.map(jnp.asarray, graph),
+            jax.device_put(device_subset(graph, kernel)),
             self.config.pagerank,
             self.config.spectrum,
             None,
