@@ -70,6 +70,12 @@ class TableRCA:
         from ..graph.build import aux_for_kernel
 
         cfg = self.config
+        # Sharded ranking supports the coo (default) and csr kernels;
+        # other configured kernels fall back to coo with their aux views
+        # skipped.
+        shard_kernel = (
+            cfg.runtime.kernel if cfg.runtime.kernel == "csr" else "coo"
+        )
         graph, op_names, _, _ = build_window_graph_from_table(
             table,
             mask,
@@ -77,9 +83,8 @@ class TableRCA:
             abn_codes,
             pad_policy=cfg.runtime.pad_policy,
             min_pad=cfg.runtime.min_pad,
-            # Sharded ranking uses the coo kernel; no aux views needed.
             aux=(
-                "none"
+                aux_for_kernel(shard_kernel)
                 if self._mesh is not None
                 else aux_for_kernel(cfg.runtime.kernel)
             ),
@@ -98,6 +103,7 @@ class TableRCA:
                 cfg.pagerank,
                 cfg.spectrum,
                 self._mesh,
+                shard_kernel,
             )
             top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
         else:

@@ -265,6 +265,8 @@ def _partition_setup(
             )
 
         def csr_rowsum(prod, indptr):
+            """LOCAL row sums (per-shard partial when sharded — the
+            caller psums, combining vectors first to save collectives)."""
             cs = jnp.concatenate(
                 [jnp.zeros((1,), jnp.float32), jnp.cumsum(prod)]
             )
@@ -276,7 +278,7 @@ def _partition_setup(
             )
             a = jnp.clip(indptr[:-1], lo, lo + n_local) - lo
             b = jnp.clip(indptr[1:], lo, lo + n_local) - lo
-            return reduce_shards(jnp.take(cs, b) - jnp.take(cs, a))
+            return jnp.take(cs, b) - jnp.take(cs, a)
 
         def matvecs(sv, rv):
             y_sr = csr_rowsum(
@@ -289,7 +291,8 @@ def _partition_setup(
             y_rs = csr_rowsum(
                 g.rs_val * jnp.take(sv, g.inc_op), g.inc_indptr_trace
             )
-            return y_sr + alpha * y_ss, y_rs
+            # Two collectives per iteration (like the coo path), not three.
+            return reduce_shards(y_sr + alpha * y_ss), reduce_shards(y_rs)
 
     elif kernel == "pallas":
         # One-hot MXU segment sums (ops/pallas_spmv.py): the scatter side
@@ -478,9 +481,11 @@ _KERNEL_UNUSED_FIELDS = {
         "ss_child", "ss_parent", "ss_val",
         "inc_trace_opmajor", "sr_val_opmajor",
     ),
-    # The csr kernel reads the trace-major COO arrays + CSR views, not the
-    # bitmaps (already empty under the aux policy).
-    "csr": ("cov_bits", "ss_bits"),
+    # The csr kernel reads rs_val+inc_op (trace-major), ss_val+ss_parent,
+    # and the CSR views — not inc_trace/ss_child/sr_val (their information
+    # lives in the indptrs and the op-major copies) or the bitmaps
+    # (already empty under the aux policy).
+    "csr": ("inc_trace", "ss_child", "sr_val", "cov_bits", "ss_bits"),
 }
 
 
@@ -496,10 +501,11 @@ def device_subset(graph: WindowGraph, kernel: str) -> WindowGraph:
     def strip(p: PartitionGraph) -> PartitionGraph:
         repl = {}
         for f in fields:
-            arr = np.asarray(getattr(p, f))
+            arr = getattr(p, f)  # shape/dtype only — no np.asarray, which
+            # would round-trip device-resident arrays through the host
             # Zero only the LAST axis: leading batch/row dims survive so
             # vmap/stacked graphs keep consistent mapped-axis sizes.
-            repl[f] = np.zeros(arr.shape[:-1] + (0,), arr.dtype)
+            repl[f] = np.zeros(tuple(arr.shape[:-1]) + (0,), arr.dtype)
         return p._replace(**repl)
 
     return WindowGraph(

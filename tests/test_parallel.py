@@ -72,11 +72,10 @@ def test_batched_vmap_matches_sharded(window_batch):
     assert rel.max() < 1e-4
 
 
-def test_sharded_csr_matches_coo(window_batch):
+def test_sharded_csr_matches_coo():
     # The csr kernel under shard_map: each device prefix-sums its entry
     # block with clamped row ranges; psum'd partials must equal the coo
     # path's segment sums (f32 reassociation tolerance on scores).
-    graphs, namelists = window_batch
     cfg = MicroRankConfig()
     csr_graphs = []
     for seed in (1, 2, 3, 4):
@@ -167,11 +166,14 @@ def test_table_rca_sharded_matches_default(tmp_path):
     plain.fit_baseline(normal)
     r_plain = plain.run(abnormal)
 
-    cfg = MicroRankConfig(runtime=RuntimeConfig(mesh_shape=(8,)))
-    sharded = TableRCA(cfg)
-    sharded.fit_baseline(normal)
-    r_sharded = sharded.run(abnormal)
-
     a = next(r for r in r_plain if r.ranking)
-    b = next(r for r in r_sharded if r.ranking)
-    assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking]
+    # Both shard-capable kernels route through the pipeline's mesh branch.
+    for kernel in ("auto", "csr"):
+        cfg = MicroRankConfig(
+            runtime=RuntimeConfig(mesh_shape=(8,), kernel=kernel)
+        )
+        sharded = TableRCA(cfg)
+        sharded.fit_baseline(normal)
+        r_sharded = sharded.run(abnormal)
+        b = next(r for r in r_sharded if r.ranking)
+        assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking], kernel
