@@ -30,7 +30,11 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--k-sigma", type=float, default=3.0)
     p.add_argument("--slack-ms", type=float, default=0.0)
-    p.add_argument("--slo-stat", default="mean", choices=["mean", "p90"])
+    p.add_argument(
+        "--slo-stat",
+        default="mean",
+        help='SLO central statistic: "mean" or a percentile like "p90"',
+    )
     p.add_argument("--detect-minutes", type=float, default=5.0)
     p.add_argument("--skip-minutes", type=float, default=4.0)
     p.add_argument(

@@ -34,8 +34,8 @@ class DetectorConfig:
     # their expected duration (reference: ``if anormaly_trace:`` i.e. >= 1).
     min_abnormal_traces: int = 1
     # Central statistic of the SLO baseline: "mean" (reference behavior) or
-    # "p90" (the alternative the reference left commented out at
-    # preprocess_data.py:72).
+    # any percentile "pNN" — e.g. "p90" (the alternative the reference left
+    # commented out at preprocess_data.py:72), "p99", "p99.9".
     slo_stat: str = "mean"
 
     @classmethod

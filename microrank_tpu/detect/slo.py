@@ -20,6 +20,22 @@ from ..io.naming import operation_names
 from ..io.schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES, US_PER_MS
 
 
+def slo_quantile(stat: str) -> float:
+    """Parse a percentile SLO statistic: "p90" -> 0.9, "p99.9" -> 0.999.
+
+    Raises ValueError for anything that is not p<number in (0, 100].
+    """
+    if not stat.startswith("p"):
+        raise ValueError(f"unknown SLO statistic {stat!r}")
+    try:
+        pct = float(stat[1:])
+    except ValueError:
+        raise ValueError(f"unknown SLO statistic {stat!r}") from None
+    if not 0.0 < pct <= 100.0:
+        raise ValueError(f"SLO percentile out of range: {stat!r}")
+    return pct / 100.0
+
+
 def compute_slo(
     span_df: pd.DataFrame,
     strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
@@ -27,19 +43,18 @@ def compute_slo(
 ) -> Tuple[Vocab, SloBaseline]:
     """Compute the SLO baseline from a (long) normal-period span dump.
 
-    ``stat="mean"`` is the reference behavior; ``stat="p90"`` substitutes
-    the 90th-percentile duration for the mean — the alternative the
-    reference left commented out (preprocess_data.py:72).
+    ``stat="mean"`` is the reference behavior; ``stat="pNN"`` (e.g. "p90",
+    "p99", "p99.9") substitutes that percentile of the duration for the
+    mean — the alternative the reference left commented out
+    (preprocess_data.py:72).
     """
     names = operation_names(span_df, "service", strip_services)
     dur = span_df["duration"].astype(float)
     grouped = dur.groupby(names.to_numpy())
     if stat == "mean":
         center_ms = (grouped.mean() / US_PER_MS).round(4)
-    elif stat == "p90":
-        center_ms = (grouped.quantile(0.9) / US_PER_MS).round(4)
     else:
-        raise ValueError(f"unknown SLO statistic {stat!r}")
+        center_ms = (grouped.quantile(slo_quantile(stat)) / US_PER_MS).round(4)
     std_ms = (grouped.std(ddof=0) / US_PER_MS).round(4)
     vocab = Vocab(center_ms.index.tolist())
     baseline = SloBaseline(

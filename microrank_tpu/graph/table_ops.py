@@ -34,9 +34,11 @@ def compute_slo_from_table(table, stat: str = "mean") -> Tuple[Vocab, SloBaselin
     """SLO baseline from a (normal-period) SpanTable — one bincount pass.
 
     Same semantics as detect.compute_slo (population std, ms, 4 decimals;
-    reference preprocess_data.py:50-78), incl. the ``stat="p90"``
-    variant (linear-interpolated percentile, matching np.percentile).
+    reference preprocess_data.py:50-78), incl. the ``stat="pNN"``
+    percentile variants (linear-interpolated, matching np.percentile).
     """
+    from ..detect.slo import slo_quantile
+
     n_ops = len(table.svc_op_names)
     dur = table.duration_us.astype(np.float64)
     counts = np.bincount(table.svc_op, minlength=n_ops).astype(np.float64)
@@ -49,7 +51,8 @@ def compute_slo_from_table(table, stat: str = "mean") -> Tuple[Vocab, SloBaselin
     std = np.sqrt(s2 / counts)
     if stat == "mean":
         center = mean
-    elif stat == "p90":
+    else:
+        q = slo_quantile(stat)
         order = np.lexsort((dur, table.svc_op))
         s_op = table.svc_op[order]
         s_dur = dur[order]
@@ -57,13 +60,11 @@ def compute_slo_from_table(table, stat: str = "mean") -> Tuple[Vocab, SloBaselin
         starts = np.searchsorted(s_op, ids)
         n = np.searchsorted(s_op, ids, side="right") - starts
         n = np.maximum(n, 1)
-        pos = 0.9 * (n - 1)
+        pos = q * (n - 1)
         lo = np.floor(pos).astype(np.int64)
         hi = np.minimum(lo + 1, n - 1)
         frac = pos - lo
         center = s_dur[starts + lo] * (1 - frac) + s_dur[starts + hi] * frac
-    else:
-        raise ValueError(f"unknown SLO statistic {stat!r}")
     baseline = SloBaseline(
         mean_ms=np.round(center / 1000.0, 4).astype(np.float32),
         std_ms=np.round(std / 1000.0, 4).astype(np.float32),

@@ -103,3 +103,28 @@ def test_p90_slo_lanes_agree(small_case, tmp_path):
 def test_unknown_slo_stat_raises(small_case):
     with pytest.raises(ValueError, match="unknown SLO statistic"):
         compute_slo(small_case.normal, stat="median")
+    with pytest.raises(ValueError, match="percentile out of range"):
+        compute_slo(small_case.normal, stat="p0")
+    with pytest.raises(ValueError, match="unknown SLO statistic"):
+        compute_slo(small_case.normal, stat="pxx")
+
+
+def test_arbitrary_percentile_slo(small_case, tmp_path):
+    # Any "pNN" percentile works in both lanes and orders sensibly.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.graph.table_ops import compute_slo_from_table
+
+    case = small_case
+    case.normal.to_csv(tmp_path / "n99.csv", index=False)
+    table = native.load_span_table(tmp_path / "n99.csv")
+    v1, b1 = compute_slo(case.normal, stat="p99")
+    v2, b2 = compute_slo_from_table(table, stat="p99")
+    m1 = dict(zip(v1.names, b1.mean_ms))
+    m2 = dict(zip(v2.names, b2.mean_ms))
+    assert set(m1) == set(m2)
+    for op in m1:
+        assert m1[op] == pytest.approx(m2[op], abs=2e-4), op
+    _, b90 = compute_slo(case.normal, stat="p90")
+    assert (b1.mean_ms >= b90.mean_ms - 1e-3).all()
