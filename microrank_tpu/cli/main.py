@@ -179,7 +179,7 @@ def cmd_collect(args) -> int:
 
 
 def cmd_eval(args) -> int:
-    from ..evaluation import EvalConfig, evaluate
+    from ..evaluation import EvalConfig, evaluate, evaluate_all_methods
 
     cfg = _config_from_args(args)
     eval_cfg = EvalConfig(
@@ -192,6 +192,22 @@ def cmd_eval(args) -> int:
         fault_latency_ms=args.fault_ms,
         seed0=args.seed,
     )
+    if args.all_methods:
+        reports = evaluate_all_methods(cfg, eval_cfg)
+        width = max(len(m) for m in reports)
+        for m, rep in reports.items():
+            print(f"{m:<{width}}  {rep.summary()}")
+        if args.json:
+            out = {
+                m: {
+                    "recall_at": rep.recall_at,
+                    "exam_score": rep.exam_score,
+                    "detection_rate": rep.detection_rate,
+                }
+                for m, rep in reports.items()
+            }
+            Path(args.json).write_text(json.dumps(out, indent=2))
+        return 0
     report = evaluate(cfg, eval_cfg)
     print(report.summary())
     if args.json:
@@ -255,6 +271,11 @@ def main(argv=None) -> int:
     p_eval.add_argument("--faults", type=int, default=1)
     p_eval.add_argument("--fault-ms", type=float, default=2000.0)
     p_eval.add_argument("--seed", type=int, default=1000)
+    p_eval.add_argument(
+        "--all-methods",
+        action="store_true",
+        help="score every spectrum formula (one device dispatch per case)",
+    )
     p_eval.add_argument("--json", help="write the detailed report here")
     _add_config_flags(p_eval)
     p_eval.set_defaults(fn=cmd_eval)

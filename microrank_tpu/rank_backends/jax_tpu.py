@@ -381,16 +381,17 @@ def partition_pagerank(
     return _partition_finish(g, sv)
 
 
-def window_spectrum(
+def spectrum_counters(
     a_weight,
     a_graph: PartitionGraph,
     n_weight,
     n_graph: PartitionGraph,
     cfg: SpectrumConfig,
 ):
-    """Spectrum counters + formula over the shared op vocab [V]
-    (reference: online_rca.py:43-142, including the asymmetric
-    only-in-normal branch at :65-66). Returns (scores[V], valid[V])."""
+    """The method-independent spectrum counters {ef, nf, ep, np} over the
+    shared op vocab [V] (reference: online_rca.py:43-69, including the
+    asymmetric only-in-normal branch at :65-66). Returns
+    (ef, nf, ep, np_, valid)."""
     eps = jnp.float32(cfg.eps)
     a_present = a_graph.op_present
     n_present = n_graph.op_present
@@ -411,8 +412,23 @@ def window_spectrum(
         jnp.where(n_present, n_weight * (n_len - n_cov), eps),
         n_len - n_cov,
     )
-    scores = spectrum_scores(ef, nf, ep, np_, cfg.method)
     valid = a_present | n_present
+    return ef, nf, ep, np_, valid
+
+
+def window_spectrum(
+    a_weight,
+    a_graph: PartitionGraph,
+    n_weight,
+    n_graph: PartitionGraph,
+    cfg: SpectrumConfig,
+):
+    """Spectrum counters + formula over the shared op vocab [V]
+    (reference: online_rca.py:43-142). Returns (scores[V], valid[V])."""
+    ef, nf, ep, np_, valid = spectrum_counters(
+        a_weight, a_graph, n_weight, n_graph, cfg
+    )
+    scores = spectrum_scores(ef, nf, ep, np_, cfg.method)
     return jnp.where(valid, scores, -jnp.inf), valid
 
 
@@ -432,10 +448,30 @@ def rank_window_core(
     indices into the shared window op vocab, score-descending;
     entries beyond ``n_valid`` are padding (score -inf).
     """
-    # Both partitions step inside ONE fori_loop (their iterations are
-    # independent; fusing halves the loop-body op count and lets XLA
-    # schedule the small partition's matvecs into the big one's gaps).
-    # Per-partition math is identical to partition_pagerank.
+    n_weight, a_weight = window_weights(graph, pagerank_cfg, psum_axis, kernel)
+    scores, valid = window_spectrum(
+        a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
+    )
+    k = min(spectrum_cfg.n_rows, scores.shape[0])
+    top_scores, top_idx = lax.top_k(scores, k)
+    n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
+    return top_idx.astype(jnp.int32), top_scores, n_valid
+
+
+def window_weights(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    psum_axis: str | None = None,
+    kernel: str = "coo",
+):
+    """Both partitions' PageRank weights, iterated together.
+
+    Both partitions step inside ONE fori_loop (their iterations are
+    independent; fusing halves the loop-body op count and lets XLA
+    schedule the small partition's matvecs into the big one's gaps).
+    Per-partition math is identical to partition_pagerank.
+    Returns (n_weight[V], a_weight[V]).
+    """
     mv_n, pref_n, sv_n, rv_n = _partition_setup(
         graph.normal, False, pagerank_cfg, psum_axis, kernel
     )
@@ -455,16 +491,51 @@ def rank_window_core(
     )
     n_weight, _ = _partition_finish(graph.normal, sv_n)
     a_weight, _ = _partition_finish(graph.abnormal, sv_a)
-    scores, valid = window_spectrum(
+    return n_weight, a_weight
+
+
+def rank_window_all_methods_core(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    psum_axis: str | None = None,
+    kernel: str = "coo",
+):
+    """Rank one window under EVERY spectrum formula in one program.
+
+    The power iterations and the spectrum counters are method-independent
+    — only the final elementwise formula + top-k differ — so comparing all
+    13 methods (the paper's Tables 4-6 axis) costs one dispatch instead of
+    13. Returns (top_idx int32[M, k], top_scores float32[M, k],
+    n_valid int32) with M = len(spectrum.formulas.METHODS), rows in
+    METHODS order; ``spectrum_cfg.method`` is ignored.
+    """
+    from ..spectrum.formulas import METHODS
+
+    n_weight, a_weight = window_weights(graph, pagerank_cfg, psum_axis, kernel)
+    ef, nf, ep, np_, valid = spectrum_counters(
         a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
     )
-    k = min(spectrum_cfg.n_rows, scores.shape[0])
-    top_scores, top_idx = lax.top_k(scores, k)
+    k = min(spectrum_cfg.n_rows, valid.shape[0])
+    tops = []
+    for method in METHODS:  # static unroll — method is a trace constant
+        scores = jnp.where(
+            valid, spectrum_scores(ef, nf, ep, np_, method), -jnp.inf
+        )
+        top_scores, top_idx = lax.top_k(scores, k)
+        tops.append((top_idx.astype(jnp.int32), top_scores))
     n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
-    return top_idx.astype(jnp.int32), top_scores, n_valid
+    return (
+        jnp.stack([t[0] for t in tops]),
+        jnp.stack([t[1] for t in tops]),
+        n_valid,
+    )
 
 
 rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
+rank_window_all_methods_device = jax.jit(
+    rank_window_all_methods_core, static_argnums=(1, 2, 3, 4)
+)
 
 
 _KERNEL_UNUSED_FIELDS = {
@@ -587,3 +658,47 @@ class JaxBackend:
 
             assert_finite_scores(scores, "JaxBackend.rank_window")
         return [op_names[i] for i in idx], scores
+
+    def rank_window_all_methods(self, span_df, normal_ids, abnormal_ids):
+        """Rank under every spectrum formula in one device dispatch.
+
+        Returns {method: ([op names], [scores])} in METHODS order — the
+        cheap way to produce a paper-style per-formula comparison.
+        """
+        from ..graph.build import aux_for_kernel, build_window_graph
+        from ..spectrum.formulas import METHODS
+        from .base import validate_partitions
+
+        normal_ids = list(normal_ids)
+        abnormal_ids = list(abnormal_ids)
+        validate_partitions(normal_ids, abnormal_ids)
+        rt = self.config.runtime
+        graph, op_names, _, _ = build_window_graph(
+            span_df,
+            normal_ids,
+            abnormal_ids,
+            pad_policy=rt.pad_policy,
+            min_pad=rt.min_pad,
+            aux=aux_for_kernel(rt.kernel),
+            dense_budget_bytes=rt.dense_budget_bytes,
+        )
+        kernel = rt.kernel
+        if kernel == "auto":
+            kernel = choose_kernel(graph)
+        top_idx, top_scores, n_valid = jax.device_get(
+            rank_window_all_methods_device(
+                jax.device_put(device_subset(graph, kernel)),
+                self.config.pagerank,
+                self.config.spectrum,
+                None,
+                kernel,
+            )
+        )
+        n = int(n_valid)
+        return {
+            m: (
+                [op_names[int(i)] for i in top_idx[mi, :n]],
+                [float(s) for s in top_scores[mi, :n]],
+            )
+            for mi, m in enumerate(METHODS)
+        }

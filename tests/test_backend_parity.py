@@ -159,6 +159,28 @@ def test_scatterfree_kernels_match_coo(small_case, kernel):
                 assert abs(v - sc_k[op]) <= 1e-4 * max(abs(v), 1e-12), op
 
 
+def test_all_methods_matches_per_method(small_case):
+    # One all-formulas dispatch == 13 per-method runs.
+    from microrank_tpu.spectrum.formulas import METHODS
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    backend = get_backend(cfg)
+    all_out = backend.rank_window_all_methods(small_case.abnormal, nrm, abn)
+    assert set(all_out) == set(METHODS)
+    for method in ("dstar2", "ochiai", "tarantula", "russellrao"):
+        mcfg = MicroRankConfig(spectrum=SpectrumConfig(method=method))
+        names, scores = get_backend(mcfg).rank_window(
+            small_case.abnormal, nrm, abn
+        )
+        a_names, a_scores = all_out[method]
+        assert a_names[0] == names[0], method
+        assert set(a_names) == set(names), method
+        for n_, s_ in zip(names, scores):
+            i = a_names.index(n_)
+            assert a_scores[i] == pytest.approx(s_, rel=1e-5), (method, n_)
+
+
 def test_forced_csr_kernel_via_config(small_case):
     # RuntimeConfig.kernel="csr" must work end to end: the backend plumbs
     # the matching aux mode into the graph build (regression: it used to
