@@ -86,7 +86,7 @@ class _MrSpanTable(ctypes.Structure):
 
 def _build_library() -> None:
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         *[str(s) for s in _SRCS], "-o", str(_LIB),
     ]
     try:

@@ -25,6 +25,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <new>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -300,8 +301,21 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
       }
     }
 
-    for (int i = 0; i < 2; ++i)
-      finish_partition(sc[i], vocab_size, &g->parts[i]);
+    // The two partitions' finishing work (per-trace sorts, edge dedup,
+    // kind grouping) is independent — overlap it on two threads.
+    {
+      bool failed = false;
+      std::thread other([&] {
+        try {
+          finish_partition(sc[1], vocab_size, &g->parts[1]);
+        } catch (const std::bad_alloc&) {
+          failed = true;
+        }
+      });
+      finish_partition(sc[0], vocab_size, &g->parts[0]);
+      other.join();
+      if (failed) throw std::bad_alloc();
+    }
   } catch (const std::bad_alloc&) {
     delete g;
     return nullptr;
