@@ -58,6 +58,14 @@ class PageRankConfig:
     # Max-normalize both ranking vectors every iteration
     # (pagerank.py:126-127 — not in the paper, but load-bearing for parity).
     max_normalize_each_iter: bool = True
+    # Optional convergence tolerance: stop early once the L-inf change of
+    # every ranking vector falls below tol (checked jointly for both
+    # partitions), still capped at ``iterations``. None (default)
+    # reproduces the reference exactly — a fixed 25 iterations with no
+    # check, which its own README flags as potentially insufficient for
+    # large systems (reference README.md:34-38); set tol AND a higher
+    # iterations cap to rank such systems to convergence.
+    tol: Optional[float] = None
 
 
 @dataclass(frozen=True)

@@ -350,6 +350,34 @@ def _partition_finish(g: PartitionGraph, sv):
     return weight, score
 
 
+def _iterate(step, carry, cfg: PageRankConfig):
+    """Run ``step`` for cfg.iterations, or — when cfg.tol is set — until
+    the L-inf change of every carried vector falls below tol (whichever
+    comes first). The reference has no convergence check (its README flags
+    that as a limitation for large systems); tol=None reproduces it."""
+    if cfg.tol is None:
+        return lax.fori_loop(0, cfg.iterations, lambda i, c: step(c), carry)
+    tol = jnp.float32(cfg.tol)
+
+    def cond(state):
+        i, _, delta = state
+        return (i < cfg.iterations) & (delta > tol)
+
+    def body(state):
+        i, c, _ = state
+        new = step(c)
+        delta = jax.tree.reduce(
+            jnp.maximum,
+            jax.tree.map(lambda a, b: jnp.max(jnp.abs(a - b)), new, c),
+        )
+        return i + 1, new, delta
+
+    _, carry, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), carry, jnp.float32(jnp.inf))
+    )
+    return carry
+
+
 def partition_pagerank(
     g: PartitionGraph,
     anomaly: bool,
@@ -373,11 +401,9 @@ def partition_pagerank(
     the SpMV (SURVEY.md C18/C19 plan).
     """
     matvecs, pref, sv, rv = _partition_setup(g, anomaly, cfg, psum_axis, kernel)
-
-    def body(_, carry):
-        return _partition_step(matvecs, pref, *carry, cfg)
-
-    sv, rv = lax.fori_loop(0, cfg.iterations, body, (sv, rv))
+    sv, rv = _iterate(
+        lambda c: _partition_step(matvecs, pref, *c, cfg), (sv, rv), cfg
+    )
     return _partition_finish(g, sv)
 
 
@@ -479,15 +505,15 @@ def window_weights(
         graph.abnormal, True, pagerank_cfg, psum_axis, kernel
     )
 
-    def body(_, carry):
+    def step(carry):
         (sv_n, rv_n), (sv_a, rv_a) = carry
         return (
             _partition_step(mv_n, pref_n, sv_n, rv_n, pagerank_cfg),
             _partition_step(mv_a, pref_a, sv_a, rv_a, pagerank_cfg),
         )
 
-    (sv_n, rv_n), (sv_a, rv_a) = lax.fori_loop(
-        0, pagerank_cfg.iterations, body, ((sv_n, rv_n), (sv_a, rv_a))
+    (sv_n, rv_n), (sv_a, rv_a) = _iterate(
+        step, ((sv_n, rv_n), (sv_a, rv_a)), pagerank_cfg
     )
     n_weight, _ = _partition_finish(graph.normal, sv_n)
     a_weight, _ = _partition_finish(graph.abnormal, sv_a)

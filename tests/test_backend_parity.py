@@ -159,6 +159,30 @@ def test_scatterfree_kernels_match_coo(small_case, kernel):
                 assert abs(v - sc_k[op]) <= 1e-4 * max(abs(v), 1e-12), op
 
 
+def test_convergence_tolerance(small_case):
+    # tol-based early exit: a tight tolerance with a high iteration cap
+    # must agree with the reference's fixed 25 iterations on Top-1 (the
+    # iteration is convergent here), and tol=inf stops after one step yet
+    # still returns finite scores.
+    from microrank_tpu.config import PageRankConfig
+
+    nrm, abn = partition_case(small_case)
+    base = MicroRankConfig()
+    top_ref, _ = get_backend(base).rank_window(small_case.abnormal, nrm, abn)
+    tight = MicroRankConfig(
+        pagerank=PageRankConfig(iterations=200, tol=1e-7)
+    )
+    top_tight, _ = get_backend(tight).rank_window(
+        small_case.abnormal, nrm, abn
+    )
+    assert top_tight[0] == top_ref[0]
+    loose = MicroRankConfig(pagerank=PageRankConfig(tol=float("inf")))
+    top_loose, sc_loose = get_backend(loose).rank_window(
+        small_case.abnormal, nrm, abn
+    )
+    assert top_loose and all(np.isfinite(s) for s in sc_loose)
+
+
 def test_all_methods_matches_per_method(small_case):
     # One all-formulas dispatch == 13 per-method runs.
     from microrank_tpu.spectrum.formulas import METHODS
