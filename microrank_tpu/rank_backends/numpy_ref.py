@@ -38,9 +38,11 @@ def page_rank_iterate(
 ) -> np.ndarray:
     """Power iteration (reference ``pageRank``, pagerank.py:116-130).
 
-    Fixed iteration count, no convergence check; both vectors are
-    max-normalized every iteration (pagerank.py:126-127 — not in the paper
-    but load-bearing for score parity).
+    Fixed iteration count, no convergence check (tol=None, the reference
+    behavior); both vectors are max-normalized every iteration
+    (pagerank.py:126-127 — not in the paper but load-bearing for score
+    parity). ``cfg.tol`` adds the same early-exit rule as the device
+    backend: stop once the L-inf change of both vectors is below tol.
     """
     d = cfg.damping
     alpha = cfg.call_weight
@@ -50,8 +52,16 @@ def page_rank_iterate(
         new_s = d * (np.dot(p_sr, v_r) + alpha * np.dot(p_ss, v_s))
         new_r = d * np.dot(p_rs, v_s) + (1.0 - d) * pref
         if cfg.max_normalize_each_iter:
-            v_s = new_s / np.amax(new_s)
-            v_r = new_r / np.amax(new_r)
+            new_s = new_s / np.amax(new_s)
+            new_r = new_r / np.amax(new_r)
+        if cfg.tol is not None:
+            delta = max(
+                float(np.max(np.abs(new_s - v_s))),
+                float(np.max(np.abs(new_r - v_r))),
+            )
+            v_s, v_r = new_s, new_r
+            if delta <= cfg.tol:
+                break
         else:
             v_s, v_r = new_s, new_r
     return v_s / np.amax(v_s)

@@ -176,6 +176,11 @@ def test_convergence_tolerance(small_case):
         small_case.abnormal, nrm, abn
     )
     assert top_tight[0] == top_ref[0]
+    # The numpy oracle honors the same tol semantics.
+    top_oracle, _ = NumpyRefBackend(tight).rank_window(
+        small_case.abnormal, nrm, abn
+    )
+    assert top_oracle[0] == top_tight[0]
     loose = MicroRankConfig(pagerank=PageRankConfig(tol=float("inf")))
     top_loose, sc_loose = get_backend(loose).rank_window(
         small_case.abnormal, nrm, abn
