@@ -179,7 +179,12 @@ def cmd_collect(args) -> int:
 
 
 def cmd_eval(args) -> int:
-    from ..evaluation import EvalConfig, evaluate, evaluate_all_methods
+    from ..evaluation import (
+        EvalConfig,
+        evaluate,
+        evaluate_all_methods,
+        evaluate_detection,
+    )
 
     cfg = _config_from_args(args)
     eval_cfg = EvalConfig(
@@ -192,6 +197,23 @@ def cmd_eval(args) -> int:
         fault_latency_ms=args.fault_ms,
         seed0=args.seed,
     )
+    if args.detection:
+        report = evaluate_detection(cfg, eval_cfg, n_windows=args.windows)
+        print(report.summary())
+        if args.json:
+            Path(args.json).write_text(
+                json.dumps(
+                    {
+                        "precision": report.precision,
+                        "recall": report.recall,
+                        "f1": report.f1,
+                        "tp": report.tp, "fp": report.fp,
+                        "fn": report.fn, "tn": report.tn,
+                    },
+                    indent=2,
+                )
+            )
+        return 0
     if args.all_methods:
         reports = evaluate_all_methods(cfg, eval_cfg)
         width = max(len(m) for m in reports)
@@ -275,6 +297,16 @@ def main(argv=None) -> int:
         "--all-methods",
         action="store_true",
         help="score every spectrum formula (one device dispatch per case)",
+    )
+    p_eval.add_argument(
+        "--detection",
+        action="store_true",
+        help="window-level detection precision/recall/F1 over timelines "
+        "(the paper's Fig. 9 experiment)",
+    )
+    p_eval.add_argument(
+        "--windows", type=int, default=10,
+        help="timeline length for --detection (half the windows faulted)",
     )
     p_eval.add_argument("--json", help="write the detailed report here")
     _add_config_flags(p_eval)

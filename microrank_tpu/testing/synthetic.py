@@ -221,6 +221,68 @@ def generate_case_with_spans(
     )
 
 
+@dataclass
+class SyntheticTimeline:
+    """A multi-window replay: one normal baseline window plus
+    ``n_windows`` consecutive windows, a subset of which carry the fault —
+    the shape of the paper's anomaly-detection experiment (Fig. 9:
+    per-window precision/recall/F1)."""
+
+    normal: pd.DataFrame
+    timeline: pd.DataFrame
+    window_faulted: List[bool]
+    window_minutes: float
+    start: pd.Timestamp          # first timeline window's start
+    fault_pod_op: str
+
+
+def generate_timeline(
+    cfg: SyntheticConfig,
+    n_windows: int,
+    faulted: List[int],
+) -> SyntheticTimeline:
+    """Generate a continuous ``n_windows``-window trace stream where the
+    windows listed in ``faulted`` carry the injected latency fault and the
+    rest are clean. ``cfg.n_traces`` applies per window."""
+    rng = np.random.default_rng(cfg.seed)
+    topo = _make_topology(cfg, rng)
+    covered = np.unique(np.concatenate(topo.kinds))
+    candidates = covered[covered != 0]
+    if len(candidates) == 0:
+        candidates = covered
+    fault_op = int(rng.choice(candidates))
+    fault_pod = int(rng.integers(0, cfg.n_pods))
+    faults = [(fault_op, fault_pod)]
+
+    t0 = pd.Timestamp("2025-02-14 12:00:00")
+    t1 = t0 + pd.Timedelta(minutes=cfg.window_minutes)
+    normal = _render_spans(topo, cfg, rng, cfg.n_traces, t0, None, "n")
+    fault_set = set(faulted)
+    frames = []
+    flags = []
+    for i in range(n_windows):
+        ti = t1 + pd.Timedelta(minutes=i * cfg.window_minutes)
+        is_faulted = i in fault_set
+        frames.append(
+            _render_spans(
+                topo, cfg, rng, cfg.n_traces, ti,
+                faults if is_faulted else None, f"w{i}x",
+            )
+        )
+        flags.append(is_faulted)
+    w = _op_id_width(cfg.n_operations)
+    return SyntheticTimeline(
+        normal=normal,
+        timeline=pd.concat(frames, ignore_index=True),
+        window_faulted=flags,
+        window_minutes=cfg.window_minutes,
+        start=t1,
+        fault_pod_op=(
+            f"svc{fault_op:0{w}d}-{fault_pod}_op{fault_op:0{w}d}"
+        ),
+    )
+
+
 def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     """One chaos case: a normal window and an abnormal window with one
     injected latency fault (the collect_data.py normal/abnormal dump pair)."""

@@ -60,3 +60,42 @@ def test_cli_eval(tmp_path):
     report = json.loads(out.read_text())
     assert set(report) >= {"recall_at", "exam_score", "cases"}
     assert len(report["cases"]) == 3
+
+
+def test_detection_evaluation():
+    # Big faults must be perfectly detected across a timeline (100% P/R);
+    # a tiny fault must NOT produce false positives on clean windows.
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.evaluation import EvalConfig, evaluate_detection
+
+    cfg = EvalConfig(n_cases=2, n_operations=16, n_traces=80)
+    rep = evaluate_detection(MicroRankConfig(), cfg, n_windows=6)
+    assert rep.tp + rep.fn == 2 * 3  # half the windows faulted
+    assert rep.precision == 1.0 and rep.recall == 1.0
+    tiny = EvalConfig(
+        n_cases=2, n_operations=16, n_traces=80, fault_latency_ms=0.1
+    )
+    rep2 = evaluate_detection(MicroRankConfig(), tiny, n_windows=6)
+    assert rep2.fp == 0  # clean windows never flag
+
+
+def test_timeline_generator_layout():
+    from microrank_tpu.testing import SyntheticConfig
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=12, n_traces=50, seed=3),
+        4,
+        [1, 3],
+    )
+    assert tl.window_faulted == [False, True, False, True]
+    # Each window's traces start inside its bounds.
+    import pandas as pd
+
+    for w in range(4):
+        w0 = tl.start + pd.Timedelta(minutes=w * tl.window_minutes)
+        w1 = w0 + pd.Timedelta(minutes=tl.window_minutes)
+        spans = tl.timeline[tl.timeline["traceID"].str.startswith(f"w{w}x")]
+        assert len(spans)
+        assert (spans["startTime"] >= w0).all()
+        assert (spans["startTime"] < w1).all()
