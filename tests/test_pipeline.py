@@ -241,3 +241,44 @@ def test_table_lane_pipelined_matches_sync(case, tmp_path):
         starts1 = [json.loads(l)["start"] for l in lines1]
         starts_d = [json.loads(l)["start"] for l in lines_d]
         assert starts1 == starts_d
+
+
+def test_timeline_window_loop_and_skip(tmp_path):
+    # A continuous multi-window stream drives the real sliding-window
+    # orchestration: faulted windows are detected and ranked to the
+    # injected fault; an anomalous window advances the cursor by
+    # detect+skip (reference online_rca.py:215-216), so the clean window
+    # immediately after a faulted one is jumped over.
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(
+            n_operations=20, n_traces=120, seed=21, n_kinds=24,
+            child_keep_prob=0.6,
+        ),
+        6,
+        [1, 4],
+    )
+    cfg = MicroRankConfig()
+    results = run_rca(tl.normal, tl.timeline, cfg, out_dir=tmp_path)
+    ranked = [r for r in results if r.anomaly and r.ranking]
+    assert ranked, "no anomalous window ranked"
+    for r in ranked:
+        assert r.ranking[0][0] == tl.fault_pod_op
+    # Window starts visited: faulted windows trigger the +skip jump, so
+    # fewer windows are visited than exist in the stream.
+    assert len(results) < 6
+    # Ranked window starts align to the faulted windows' bounds.
+    # The loop's windows stride from the first trace, not the generator's
+    # grid — ranked windows must OVERLAP a faulted window's interval.
+    faulted_spans = [
+        (
+            tl.start + pd.Timedelta(minutes=5 * w),
+            tl.start + pd.Timedelta(minutes=5 * (w + 1)),
+        )
+        for w in (1, 4)
+    ]
+    for r in ranked:
+        w0 = pd.Timestamp(r.start)
+        w1 = w0 + pd.Timedelta(minutes=5)
+        assert any(w0 < f1 and f0 < w1 for f0, f1 in faulted_spans), r.start
