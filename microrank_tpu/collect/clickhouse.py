@@ -56,10 +56,12 @@ class ChaosEvent:
 
 
 def load_events_toml(path) -> List[ChaosEvent]:
-    import toml
+    import tomllib  # stdlib (3.11+) — no third-party toml needed to read
 
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
     events = []
-    for event in toml.load(path).get("chaos_events", []):
+    for event in data.get("chaos_events", []):
         ts = event.get("timestamp", "")
         try:
             datetime.strptime(ts, "%Y-%m-%d %H:%M:%S")
@@ -132,23 +134,31 @@ async def collect_cases(
                 _fetch_csv(client, query, folder / "traces.csv", semaphore)
             )
     ok = await asyncio.gather(*tasks)
-
-    import toml
-
-    manifest = {
-        "chaos_injection": [
-            {
-                "case": ev.case_name,
-                "timestamp": ev.timestamp,
-                "namespace": ev.namespace,
-                "chaos_type": ev.chaos_type,
-                "service": ev.service,
-            }
-            for ev in events
-        ]
-    }
-    (out / "manifest.toml").write_text(toml.dumps(manifest))
+    (out / "manifest.toml").write_text(manifest_toml(events))
     return all(ok)
+
+
+def manifest_toml(events: List[ChaosEvent]) -> str:
+    """Serialize the collected-cases manifest (all-string fields — the
+    stdlib has no TOML writer, and pulling in the third-party ``toml``
+    package for this shape is not worth the dependency)."""
+
+    def esc(s: str) -> str:
+        return s.replace("\\", "\\\\").replace('"', '\\"')
+
+    lines = []
+    for ev in events:
+        lines.append("[[chaos_injection]]")
+        for k, v in (
+            ("case", ev.case_name),
+            ("timestamp", ev.timestamp),
+            ("namespace", ev.namespace),
+            ("chaos_type", ev.chaos_type),
+            ("service", ev.service),
+        ):
+            lines.append(f'{k} = "{esc(v)}"')
+        lines.append("")
+    return "\n".join(lines)
 
 
 def run_collect(args) -> int:

@@ -1,0 +1,70 @@
+"""Collector unit coverage (component C17): the pure parts — TOML event
+manifests, case naming, optional-dependency gating — without a ClickHouse."""
+
+import asyncio
+
+import pytest
+
+from microrank_tpu.collect.clickhouse import (
+    ChaosEvent,
+    collect_cases,
+    load_events_toml,
+)
+
+
+def test_load_events_toml(tmp_path):
+    p = tmp_path / "events.toml"
+    p.write_text(
+        """
+[[chaos_events]]
+timestamp = "2025-02-14 12:30:00"
+namespace = "ts"
+chaos_type = "latency"
+service = "ts-order-service"
+
+[[chaos_events]]
+timestamp = "not-a-timestamp"
+namespace = "ts"
+
+[[chaos_events]]
+timestamp = "2025-02-14 13:00:00"
+namespace = "hipster"
+service = "cartservice"
+"""
+    )
+    events = load_events_toml(p)
+    # The malformed-timestamp event is skipped with a warning.
+    assert len(events) == 2
+    assert events[0].namespace == "ts"
+    assert events[0].case_name == "ts-order-service-0214-1230"
+    assert events[1].case_name == "cartservice-0214-1300"
+
+
+def test_collect_requires_clickhouse(tmp_path):
+    pytest.importorskip  # noqa: B018 — only run when the dep is absent
+    try:
+        import clickhouse_connect  # noqa: F401
+
+        pytest.skip("clickhouse_connect installed; gating not exercised")
+    except ImportError:
+        pass
+    ev = [ChaosEvent(timestamp="2025-02-14 12:30:00", namespace="ts")]
+    with pytest.raises(RuntimeError, match="clickhouse_connect"):
+        asyncio.run(collect_cases(ev, "localhost", tmp_path))
+
+
+def test_manifest_toml_roundtrip(tmp_path):
+    import tomllib
+
+    from microrank_tpu.collect.clickhouse import manifest_toml
+
+    events = [
+        ChaosEvent(
+            timestamp="2025-02-14 12:30:00", namespace="ts",
+            chaos_type="latency", service='svc"quoted"',
+        )
+    ]
+    text = manifest_toml(events)
+    data = tomllib.loads(text)
+    assert data["chaos_injection"][0]["service"] == 'svc"quoted"'
+    assert data["chaos_injection"][0]["case"].endswith("-0214-1230")
