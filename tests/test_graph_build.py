@@ -97,3 +97,25 @@ def test_detect_batch_roundtrip(small_case):
     n = int(batch.n_spans)
     assert (batch.op[n:] == -1).all()
     assert (batch.duration_us[n:] == 0).all()
+
+
+def test_kind_hash_path_matches_exact(monkeypatch):
+    # Large windows switch _trace_kinds from exact padded-row np.unique to
+    # O(E) 128-bit set hashing; both must yield identical kind sizes.
+    import microrank_tpu.graph.build as build_mod
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+    from conftest import partition_case
+
+    case = generate_case(
+        SyntheticConfig(n_operations=30, n_traces=250, n_kinds=12, seed=17)
+    )
+    nrm, abn = partition_case(case)
+    if not (nrm and abn):
+        pytest.skip("window did not partition")
+    g_exact, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+    monkeypatch.setattr(build_mod, "_DENSE_KIND_BUDGET", 1)
+    g_hash, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+    for side in ("normal", "abnormal"):
+        a, b = getattr(g_exact, side), getattr(g_hash, side)
+        np.testing.assert_array_equal(a.kind, b.kind, err_msg=side)
