@@ -144,7 +144,23 @@ def manifest_toml(events: List[ChaosEvent]) -> str:
     package for this shape is not worth the dependency)."""
 
     def esc(s: str) -> str:
-        return s.replace("\\", "\\\\").replace('"', '\\"')
+        out = []
+        for ch in s:
+            if ch == "\\":
+                out.append("\\\\")
+            elif ch == '"':
+                out.append('\\"')
+            elif ch == "\n":
+                out.append("\\n")
+            elif ch == "\r":
+                out.append("\\r")
+            elif ch == "\t":
+                out.append("\\t")
+            elif ord(ch) < 0x20 or ch == "\x7f":
+                out.append(f"\\u{ord(ch):04X}")
+            else:
+                out.append(ch)
+        return "".join(out)
 
     lines = []
     for ev in events:

@@ -70,7 +70,38 @@ class EvalReport:
         )
 
 
-def _run_case(case, config: MicroRankConfig) -> CaseResult:
+def _widen_spectrum(
+    config: MicroRankConfig, eval_cfg: EvalConfig
+) -> MicroRankConfig:
+    """Full-depth rankings (top_max covers every op) so Exam Score is
+    exact."""
+    return config.replace(
+        spectrum=SpectrumConfig(
+            method=config.spectrum.method,
+            top_max=eval_cfg.n_operations * max(1, eval_cfg.n_pods),
+            extra_rows=config.spectrum.extra_rows,
+            eps=config.spectrum.eps,
+        )
+    )
+
+
+def _case_config(eval_cfg: EvalConfig, seed: int) -> SyntheticConfig:
+    return SyntheticConfig(
+        n_operations=eval_cfg.n_operations,
+        n_pods=eval_cfg.n_pods,
+        n_kinds=eval_cfg.n_kinds,
+        child_keep_prob=eval_cfg.child_keep_prob,
+        n_traces=eval_cfg.n_traces,
+        fault_latency_ms=eval_cfg.fault_latency_ms,
+        n_faults=eval_cfg.n_faults,
+        seed=seed,
+    )
+
+
+def _detect_partition(case, config: MicroRankConfig):
+    """Shared detection + partitioning front half of every eval case.
+
+    Returns (ok, nrm, abn) with the compat partition swap applied."""
     vocab, baseline = compute_slo(case.normal)
     batch, trace_ids = build_detect_batch(case.abnormal, vocab)
     det = detect_numpy(batch, baseline, config.detector)
@@ -80,14 +111,43 @@ def _run_case(case, config: MicroRankConfig) -> CaseResult:
         for t, a, v in zip(trace_ids, det.abnormal, det.valid)
         if v and not a
     ]
+    ok = bool(det.flag) and bool(nrm) and bool(abn)
+    if ok and config.compat.partition_swap:
+        nrm, abn = abn, nrm
+    return ok, nrm, abn
+
+
+def _finalize_report(
+    report: EvalReport,
+    all_ranks: List[Tuple[Optional[int], int]],
+    detected: int,
+    eval_cfg: EvalConfig,
+) -> EvalReport:
+    """Shared scoring: R@k over faults, Exam Score as normalized
+    inspection depth (unranked faults count as a full candidate scan)."""
+    n_faults = len(all_ranks)
+    for k in eval_cfg.ks:
+        report.recall_at[k] = (
+            sum(1 for r, _ in all_ranks if r is not None and r <= k)
+            / max(n_faults, 1)
+        )
+    depths = [
+        ((r - 1) / max(n, 1)) if r is not None else 1.0
+        for r, n in all_ranks
+    ]
+    report.exam_score = float(np.mean(depths)) if depths else float("nan")
+    report.detection_rate = detected / max(eval_cfg.n_cases, 1)
+    return report
+
+
+def _run_case(case, config: MicroRankConfig) -> CaseResult:
     faults = case.fault_pod_ops
-    if not (bool(det.flag) and nrm and abn):
+    ok, nrm, abn = _detect_partition(case, config)
+    if not ok:
         return CaseResult(
             seed=-1, faults=faults, ranks=[None] * len(faults),
             n_ranked_ops=0, detected=False,
         )
-    if config.compat.partition_swap:
-        nrm, abn = abn, nrm
     top, _ = get_backend(config).rank_window(case.abnormal, nrm, abn)
     pos = {name: i + 1 for i, name in enumerate(top)}
     ranks = [pos.get(f) for f in faults]
@@ -103,31 +163,13 @@ def evaluate(
 ) -> EvalReport:
     """Run the accuracy experiment; rankings are requested full-depth so
     Exam Score is exact (top_max is widened to cover every op)."""
-    config = config.replace(
-        spectrum=SpectrumConfig(
-            method=config.spectrum.method,
-            top_max=eval_cfg.n_operations * max(1, eval_cfg.n_pods),
-            extra_rows=config.spectrum.extra_rows,
-            eps=config.spectrum.eps,
-        )
-    )
+    config = _widen_spectrum(config, eval_cfg)
     report = EvalReport()
     all_ranks: List[Tuple[Optional[int], int]] = []
     detected = 0
     for i in range(eval_cfg.n_cases):
         seed = eval_cfg.seed0 + i
-        case = generate_case(
-            SyntheticConfig(
-                n_operations=eval_cfg.n_operations,
-                n_pods=eval_cfg.n_pods,
-                n_kinds=eval_cfg.n_kinds,
-                child_keep_prob=eval_cfg.child_keep_prob,
-                n_traces=eval_cfg.n_traces,
-                fault_latency_ms=eval_cfg.fault_latency_ms,
-                n_faults=eval_cfg.n_faults,
-                seed=seed,
-            )
-        )
+        case = generate_case(_case_config(eval_cfg, seed))
         result = _run_case(case, config)
         result.seed = seed
         report.cases.append(result)
@@ -138,22 +180,7 @@ def evaluate(
             "case %d: detected=%s faults=%s ranks=%s",
             seed, result.detected, result.faults, result.ranks,
         )
-
-    n_faults = len(all_ranks)
-    for k in eval_cfg.ks:
-        report.recall_at[k] = (
-            sum(1 for r, _ in all_ranks if r is not None and r <= k)
-            / max(n_faults, 1)
-        )
-    # Exam Score: normalized inspection depth; unranked faults count as a
-    # full scan of the candidate list.
-    depths = [
-        ((r - 1) / max(n, 1)) if r is not None else 1.0
-        for r, n in all_ranks
-    ]
-    report.exam_score = float(np.mean(depths)) if depths else float("nan")
-    report.detection_rate = detected / max(eval_cfg.n_cases, 1)
-    return report
+    return _finalize_report(report, all_ranks, detected, eval_cfg)
 
 
 @dataclass
@@ -203,6 +230,7 @@ def evaluate_detection(
     """
     import pandas as pd
 
+    from .io.loader import window_spans
     from .testing.synthetic import generate_timeline
 
     report = DetectionReport()
@@ -213,15 +241,7 @@ def evaluate_detection(
             rng.choice(n_windows, size=max(1, n_windows // 2), replace=False)
         )
         tl = generate_timeline(
-            SyntheticConfig(
-                n_operations=eval_cfg.n_operations,
-                n_pods=eval_cfg.n_pods,
-                n_kinds=eval_cfg.n_kinds,
-                child_keep_prob=eval_cfg.child_keep_prob,
-                n_traces=eval_cfg.n_traces,
-                fault_latency_ms=eval_cfg.fault_latency_ms,
-                seed=seed,
-            ),
+            _case_config(eval_cfg, seed),
             n_windows,
             [int(f) for f in faulted],
         )
@@ -229,10 +249,8 @@ def evaluate_detection(
         for w in range(n_windows):
             w0 = tl.start + pd.Timedelta(minutes=w * tl.window_minutes)
             w1 = w0 + pd.Timedelta(minutes=tl.window_minutes)
-            spans = tl.timeline[
-                (tl.timeline["startTime"] >= w0)
-                & (tl.timeline["endTime"] <= w1)
-            ]
+            # The same get_span predicate the pipeline windows with.
+            spans = window_spans(tl.timeline, w0, w1)
             flag = False
             if len(spans):
                 batch, _ = build_detect_batch(spans, vocab)
@@ -268,14 +286,7 @@ def evaluate_all_methods(
     """
     from .spectrum.formulas import METHODS
 
-    config = config.replace(
-        spectrum=SpectrumConfig(
-            method=config.spectrum.method,
-            top_max=eval_cfg.n_operations * max(1, eval_cfg.n_pods),
-            extra_rows=config.spectrum.extra_rows,
-            eps=config.spectrum.eps,
-        )
-    )
+    config = _widen_spectrum(config, eval_cfg)
     backend = get_backend(config)
     reports = {m: EvalReport() for m in METHODS}
     all_ranks: Dict[str, List[Tuple[Optional[int], int]]] = {
@@ -284,32 +295,10 @@ def evaluate_all_methods(
     detected = 0
     for i in range(eval_cfg.n_cases):
         seed = eval_cfg.seed0 + i
-        case = generate_case(
-            SyntheticConfig(
-                n_operations=eval_cfg.n_operations,
-                n_pods=eval_cfg.n_pods,
-                n_kinds=eval_cfg.n_kinds,
-                child_keep_prob=eval_cfg.child_keep_prob,
-                n_traces=eval_cfg.n_traces,
-                fault_latency_ms=eval_cfg.fault_latency_ms,
-                n_faults=eval_cfg.n_faults,
-                seed=seed,
-            )
-        )
-        vocab, baseline = compute_slo(case.normal)
-        batch, trace_ids = build_detect_batch(case.abnormal, vocab)
-        det = detect_numpy(batch, baseline, config.detector)
-        abn = [t for t, a in zip(trace_ids, det.abnormal) if a]
-        nrm = [
-            t
-            for t, a, v in zip(trace_ids, det.abnormal, det.valid)
-            if v and not a
-        ]
+        case = generate_case(_case_config(eval_cfg, seed))
         faults = case.fault_pod_ops
-        ok = bool(det.flag) and bool(nrm) and bool(abn)
+        ok, nrm, abn = _detect_partition(case, config)
         detected += ok
-        if ok and config.compat.partition_swap:
-            nrm, abn = abn, nrm
         if not ok:
             per_method = {m: ([], []) for m in METHODS}
         elif hasattr(backend, "rank_window_all_methods"):
@@ -342,17 +331,5 @@ def evaluate_all_methods(
         log.info("case %d: detected=%s faults=%s", seed, ok, faults)
 
     for m in METHODS:
-        rep = reports[m]
-        n_faults = len(all_ranks[m])
-        for k in eval_cfg.ks:
-            rep.recall_at[k] = (
-                sum(1 for r, _ in all_ranks[m] if r is not None and r <= k)
-                / max(n_faults, 1)
-            )
-        depths = [
-            ((r - 1) / max(n, 1)) if r is not None else 1.0
-            for r, n in all_ranks[m]
-        ]
-        rep.exam_score = float(np.mean(depths)) if depths else float("nan")
-        rep.detection_rate = detected / max(eval_cfg.n_cases, 1)
+        _finalize_report(reports[m], all_ranks[m], detected, eval_cfg)
     return reports

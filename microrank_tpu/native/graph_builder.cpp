@@ -25,6 +25,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <new>
+#include <system_error>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -302,21 +303,32 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
     }
 
     // The two partitions' finishing work (per-trace sorts, edge dedup,
-    // kind grouping) is independent — overlap it on two threads.
+    // kind grouping) is independent — overlap it on two threads. The
+    // worker catches everything and the main-thread call is guarded so
+    // the thread is ALWAYS joined before any rethrow (a joinable
+    // std::thread destroyed during unwinding calls std::terminate).
     {
-      bool failed = false;
+      bool worker_failed = false;
+      bool main_failed = false;
       std::thread other([&] {
         try {
           finish_partition(sc[1], vocab_size, &g->parts[1]);
-        } catch (const std::bad_alloc&) {
-          failed = true;
+        } catch (...) {
+          worker_failed = true;
         }
       });
-      finish_partition(sc[0], vocab_size, &g->parts[0]);
+      try {
+        finish_partition(sc[0], vocab_size, &g->parts[0]);
+      } catch (...) {
+        main_failed = true;
+      }
       other.join();
-      if (failed) throw std::bad_alloc();
+      if (worker_failed || main_failed) throw std::bad_alloc();
     }
   } catch (const std::bad_alloc&) {
+    delete g;
+    return nullptr;
+  } catch (const std::system_error&) {  // thread creation failure
     delete g;
     return nullptr;
   }

@@ -48,6 +48,26 @@ def _op_id_width(n_operations: int) -> int:
     return max(3, len(str(max(n_operations - 1, 0))))
 
 
+def _pod_op_name(op: int, pod: int, n_operations: int) -> str:
+    """The instance-level (PageRank vocab) name of a (service op, pod)."""
+    w = _op_id_width(n_operations)
+    return f"svc{op:0{w}d}-{pod}_op{op:0{w}d}"
+
+
+def _pick_faults(
+    topo: "Topology", rng: np.random.Generator, n_pods: int, n_faults: int
+):
+    """Fault candidates: ops covered by >=1 kind, excluding the root (the
+    root is trivially always the top anomaly otherwise)."""
+    covered = np.unique(np.concatenate(topo.kinds))
+    candidates = covered[covered != 0]
+    if len(candidates) == 0:
+        candidates = covered
+    n_faults = min(n_faults, len(candidates))
+    fault_ops = rng.choice(candidates, size=n_faults, replace=False)
+    return [(int(op), int(rng.integers(0, n_pods))) for op in fault_ops]
+
+
 @dataclass
 class Topology:
     parent: np.ndarray          # int [n_ops], parent[0] = -1
@@ -193,12 +213,8 @@ class SyntheticCase:
     @property
     def fault_pod_ops(self) -> List[str]:
         """Instance-level names of every injected root cause."""
-        w = _op_id_width(
-            int(self.topology.parent.shape[0])
-        )
-        return [
-            f"svc{op:0{w}d}-{pod}_op{op:0{w}d}" for op, pod in self.faults
-        ]
+        n_ops = int(self.topology.parent.shape[0])
+        return [_pod_op_name(op, pod, n_ops) for op, pod in self.faults]
 
 
 def generate_case_with_spans(
@@ -246,13 +262,8 @@ def generate_timeline(
     rest are clean. ``cfg.n_traces`` applies per window."""
     rng = np.random.default_rng(cfg.seed)
     topo = _make_topology(cfg, rng)
-    covered = np.unique(np.concatenate(topo.kinds))
-    candidates = covered[covered != 0]
-    if len(candidates) == 0:
-        candidates = covered
-    fault_op = int(rng.choice(candidates))
-    fault_pod = int(rng.integers(0, cfg.n_pods))
-    faults = [(fault_op, fault_pod)]
+    faults = _pick_faults(topo, rng, cfg.n_pods, 1)
+    fault_op, fault_pod = faults[0]
 
     t0 = pd.Timestamp("2025-02-14 12:00:00")
     t1 = t0 + pd.Timedelta(minutes=cfg.window_minutes)
@@ -270,16 +281,13 @@ def generate_timeline(
             )
         )
         flags.append(is_faulted)
-    w = _op_id_width(cfg.n_operations)
     return SyntheticTimeline(
         normal=normal,
         timeline=pd.concat(frames, ignore_index=True),
         window_faulted=flags,
         window_minutes=cfg.window_minutes,
         start=t1,
-        fault_pod_op=(
-            f"svc{fault_op:0{w}d}-{fault_pod}_op{fault_op:0{w}d}"
-        ),
+        fault_pod_op=_pod_op_name(fault_op, fault_pod, cfg.n_operations),
     )
 
 
@@ -288,18 +296,7 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     injected latency fault (the collect_data.py normal/abnormal dump pair)."""
     rng = np.random.default_rng(cfg.seed)
     topo = _make_topology(cfg, rng)
-
-    # Pick faulty ops covered by at least one kind and not the root (the
-    # root is trivially always the top anomaly otherwise).
-    covered = np.unique(np.concatenate(topo.kinds))
-    candidates = covered[covered != 0]
-    if len(candidates) == 0:
-        candidates = covered
-    n_faults = min(cfg.n_faults, len(candidates))
-    fault_ops = rng.choice(candidates, size=n_faults, replace=False)
-    faults = [
-        (int(op), int(rng.integers(0, cfg.n_pods))) for op in fault_ops
-    ]
+    faults = _pick_faults(topo, rng, cfg.n_pods, cfg.n_faults)
 
     t0 = pd.Timestamp("2025-02-14 12:00:00")
     t1 = t0 + pd.Timedelta(minutes=cfg.window_minutes)
@@ -307,12 +304,11 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     abnormal = _render_spans(topo, cfg, rng, cfg.n_traces, t1, faults, "a")
     fault_op, fault_pod = faults[0]
     w = _op_id_width(cfg.n_operations)
-    svc = f"svc{fault_op:0{w}d}"
     return SyntheticCase(
         normal=normal,
         abnormal=abnormal,
-        fault_service_op=f"{svc}_op{fault_op:0{w}d}",
-        fault_pod_op=f"{svc}-{fault_pod}_op{fault_op:0{w}d}",
+        fault_service_op=f"svc{fault_op:0{w}d}_op{fault_op:0{w}d}",
+        fault_pod_op=_pod_op_name(fault_op, fault_pod, cfg.n_operations),
         fault_op=fault_op,
         fault_pod=fault_pod,
         topology=topo,
