@@ -211,3 +211,56 @@ def test_loader_sidecar_cache(tmp_path, csv_pair):
     os.utime(p, (_time.time() + 2, _time.time() + 2))
     c = native.load_span_table(p)
     assert c.n_spans == a.n_spans - 1
+
+
+def test_pathological_input_both_lanes(tmp_path):
+    """Unicode names, an orphan parent id, and a zero-duration trace flow
+    through both ingest lanes; lane outputs agree and the zero-duration
+    trace is dropped by the detector's valid mask (reference
+    preprocess_data.py:116-117)."""
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.detect import detect_numpy
+    from microrank_tpu.graph.table_ops import (
+        compute_slo_from_table,
+        detect_batch_from_table,
+    )
+    from microrank_tpu.rank_backends import get_backend
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+    from conftest import partition_case
+
+    case = generate_case(
+        SyntheticConfig(
+            n_operations=20, n_traces=120, seed=3, n_kinds=24,
+            child_keep_prob=0.6,
+        )
+    )
+    ab = case.abnormal.copy()
+    ab.loc[ab.index[:3], "serviceName"] = "svc-ünïcode-服务"
+    ab.loc[ab.index[5], "ParentSpanId"] = "missing-span-xyz"
+    dead_trace = ab["traceID"].iloc[0]
+    ab.loc[ab["traceID"] == dead_trace, "duration"] = 0
+
+    # Pandas lane: ranking still works with the pathological rows.
+    nrm, abn = partition_case(case)
+    nrm = [t for t in nrm if t != dead_trace]
+    abn = [t for t in abn if t != dead_trace]
+    top, _ = get_backend(MicroRankConfig()).rank_window(ab, nrm, abn)
+    assert top
+
+    # Native lane: rows, vocab, and the unicode names survive the mmap
+    # scan; the zero-duration trace is invalid to the detector.
+    ab.to_csv(tmp_path / "patho.csv", index=False)
+    table = native.load_span_table(tmp_path / "patho.csv")
+    assert table.n_spans == len(ab)
+    assert any("ünïcode" in n for n in table.svc_op_names)
+    nrm_t = case.normal.copy()
+    nrm_t.to_csv(tmp_path / "norm.csv", index=False)
+    ntab = native.load_span_table(tmp_path / "norm.csv")
+    vocab, baseline = compute_slo_from_table(ntab)
+    batch, codes = detect_batch_from_table(
+        table, np.ones(table.n_spans, bool), vocab
+    )
+    det = detect_numpy(batch, baseline, MicroRankConfig().detector)
+    dead_code = list(table.trace_names).index(dead_trace)
+    local = list(codes).index(dead_code)
+    assert not det.valid[local]
