@@ -85,14 +85,186 @@ def _ensure_data(spans_target, n_ops, fault_ms):
 
 
 # BASELINE.json's five workload configs, selectable via BENCH_CONFIG=1..5
-# (BENCH_SPANS / BENCH_OPS still override individually).
+# (BENCH_SPANS / BENCH_OPS still override individually). Config 4 is the
+# "batched multi-window spectrum (8 windows vmapped)" preset: the window
+# is time-sliced into `batch` sub-windows, each detected/partitioned
+# separately, and ONE vmapped device program ranks them all
+# (BENCH_BATCH overrides; BENCH_BATCH=1 on any config disables).
 CONFIG_PRESETS = {
     "1": dict(spans=1_000, ops=40),        # Bookinfo-scale replay
     "2": dict(spans=10_000, ops=500),      # synthetic Erdős–Rényi
     "3": dict(spans=50_000, ops=1_000),    # Online-Boutique scale
-    "4": dict(spans=250_000, ops=2_000),   # TrainTicket scale
+    "4": dict(spans=250_000, ops=2_000, batch=8),  # TrainTicket, vmapped
     "5": dict(spans=1_000_000, ops=5_000), # sharded-mesh target
 }
+
+
+def _ensure_batch_data(spans_target, n_ops, fault_ms, n_batch):
+    """Generate (or reuse) a cached n_batch-window faulted timeline."""
+    root = Path(__file__).parent / "bench_data"
+    case_dir = root / f"tl_s{spans_target}_o{n_ops}_f{int(fault_ms)}_w{n_batch}"
+    truth_path = case_dir / "ground_truth.json"
+    if truth_path.exists():
+        return case_dir, json.loads(truth_path.read_text())
+    from microrank_tpu.testing import SyntheticConfig
+    from microrank_tpu.testing.synthetic import generate_timeline_with_spans
+
+    t0 = time.perf_counter()
+    tl = generate_timeline_with_spans(
+        SyntheticConfig(
+            n_operations=n_ops,
+            n_kinds=max(32, n_ops // 50),
+            child_keep_prob=0.55,
+            fault_latency_ms=fault_ms,
+            seed=0,
+        ),
+        spans_target // n_batch,
+        n_batch,
+        list(range(n_batch)),  # every window carries the fault
+    )
+    case_dir.mkdir(parents=True, exist_ok=True)
+    tl.normal.to_csv(case_dir / "normal.csv", index=False)
+    tl.timeline.to_csv(case_dir / "abnormal.csv", index=False)
+    truth = {
+        "fault_pod_op": tl.fault_pod_op,
+        "n_abnormal_spans": len(tl.timeline),
+        "start_us": int(tl.start.value // 1000),
+        "window_minutes": tl.window_minutes,
+    }
+    truth_path.write_text(json.dumps(truth))
+    log(
+        f"generated + cached {n_batch}-window timeline in "
+        f"{time.perf_counter() - t0:.1f}s ({len(tl.timeline)} spans) "
+        f"-> {case_dir}"
+    )
+    return case_dir, truth
+
+
+def _run_batched(
+    cfg, table, slo_vocab, baseline, n_batch, repeats, truth,
+    case_dir, oracle_spans,
+) -> int:
+    """BASELINE.json config 4 shape: an n_batch-window faulted timeline,
+    each window detected/partitioned on the host and ALL of them ranked
+    in ONE vmapped device program (`rank_windows_batched`)."""
+    import jax
+    import numpy as np
+
+    from microrank_tpu.detect import detect_numpy
+    from microrank_tpu.graph.table_ops import (
+        build_window_graph_from_table,
+        detect_batch_from_table,
+    )
+    from microrank_tpu.parallel import (
+        rank_windows_batched,
+        stack_window_graphs,
+    )
+
+    w_us = int(truth["window_minutes"] * 60e6)
+    start = int(truth["start_us"])
+    edges = [start + b * w_us for b in range(n_batch + 1)]
+
+    def build_all():
+        graphs, names, total = [], list(table.pod_op_names), 0
+        for b in range(n_batch):
+            m = (table.start_us >= edges[b]) & (table.end_us <= edges[b + 1])
+            batch, codes = detect_batch_from_table(table, m, slo_vocab)
+            det = detect_numpy(batch, baseline, cfg.detector)
+            t = len(codes)
+            abn = codes[det.abnormal[:t]]
+            nrm = codes[det.valid[:t] & ~det.abnormal[:t]]
+            if not (len(nrm) and len(abn)):
+                continue
+            g, _, _, _ = build_window_graph_from_table(table, m, nrm, abn)
+            graphs.append(g)
+            total += int(m.sum())
+        return stack_window_graphs(graphs), names, total, len(graphs)
+
+    stacked, op_names, spans_used, n_windows = build_all()
+    log(f"batched mode: {n_windows}/{n_batch} sub-windows partitioned, "
+        f"{spans_used} spans")
+
+    def run_fetched():
+        return jax.device_get(
+            rank_windows_batched(stacked, cfg.pagerank, cfg.spectrum)
+        )
+
+    t0 = time.perf_counter()
+    out = run_fetched()
+    log(f"first call (compile + run + fetch): {time.perf_counter() - t0:.2f}s")
+    rank_times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = run_fetched()
+        rank_times.append(time.perf_counter() - t0)
+    import numpy as _np
+
+    rank_s = float(_np.median(rank_times))
+    build_times = []
+    for _ in range(max(1, min(repeats, 3))):
+        t0 = time.perf_counter()
+        build_all()
+        build_times.append(time.perf_counter() - t0)
+    build_s = float(_np.median(build_times))
+    total_s = build_s + rank_s
+    sps = spans_used / total_s
+    ti, ts, nv = out
+    hits = sum(
+        op_names[int(ti[b][0])] == truth["fault_pod_op"]
+        for b in range(n_windows)
+    )
+    log(
+        f"batched device path: build {build_s * 1e3:.0f}ms + one vmapped "
+        f"rank {rank_s * 1e3:.0f}ms = {total_s * 1e3:.0f}ms -> "
+        f"{sps:,.0f} spans/s; fault top-1 in {hits}/{n_windows} sub-windows"
+    )
+
+    # Oracle baseline on a trace subsample of sub-window 0 (same
+    # methodology as single-window mode).
+    import pandas as pd
+
+    from microrank_tpu.rank_backends import NumpyRefBackend
+
+    sub_df = pd.read_csv(case_dir / "abnormal.csv")
+    sub_df["startTime"] = pd.to_datetime(sub_df["startTime"])
+    sub_df["endTime"] = pd.to_datetime(sub_df["endTime"])
+    w0 = pd.Timestamp(np.datetime64(int(edges[0]), "us"))
+    w1 = pd.Timestamp(np.datetime64(int(edges[1]), "us"))
+    sub_df = sub_df[(sub_df["startTime"] >= w0) & (sub_df["endTime"] <= w1)]
+    m0 = (table.start_us >= edges[0]) & (table.end_us <= edges[1])
+    batch0, codes0 = detect_batch_from_table(table, m0, slo_vocab)
+    det0 = detect_numpy(batch0, baseline, cfg.detector)
+    t0_ = len(codes0)
+    abn0 = codes0[det0.abnormal[:t0_]]
+    nrm0 = codes0[det0.valid[:t0_] & ~det0.abnormal[:t0_]]
+    per_trace = max(1, int(m0.sum()) // max(t0_, 1))
+    n_take = max(2, oracle_spans // per_trace)
+    keep_codes = list(nrm0[: max(2, n_take // 2)]) + list(
+        abn0[: max(2, n_take // 2)]
+    )
+    keep = {table.trace_names[c] for c in keep_codes}
+    sub_df = sub_df[sub_df["traceID"].isin(keep)]
+    t0 = time.perf_counter()
+    NumpyRefBackend(cfg).rank_window(
+        sub_df,
+        [table.trace_names[c] for c in nrm0[: max(2, n_take // 2)]],
+        [table.trace_names[c] for c in abn0[: max(2, n_take // 2)]],
+    )
+    oracle_sps = len(sub_df) / (time.perf_counter() - t0)
+    log(f"numpy oracle on {len(sub_df)}-span subsample: "
+        f"{oracle_sps:,.0f} spans/s")
+
+    print(
+        json.dumps(
+            {
+                "metric": "spans_per_sec_ranked",
+                "value": round(sps, 1),
+                "unit": "spans/s",
+                "vs_baseline": round(sps / oracle_sps, 2),
+            }
+        )
+    )
+    return 0
 
 
 def main() -> int:
@@ -109,6 +281,7 @@ def main() -> int:
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
     oracle_spans = int(os.environ.get("BENCH_ORACLE_SPANS", 20_000))
     fault_ms = float(os.environ.get("BENCH_FAULT_MS", 60_000.0))
+    n_batch = int(os.environ.get("BENCH_BATCH", preset.get("batch", 1)))
 
     import jax
     import jax.numpy as jnp
@@ -134,7 +307,12 @@ def main() -> int:
         log("FATAL: native span loader unavailable (g++ missing?)")
         return 1
     cfg = MicroRankConfig()
-    case_dir, truth = _ensure_data(spans_target, n_ops, fault_ms)
+    if n_batch > 1:
+        case_dir, truth = _ensure_batch_data(
+            spans_target, n_ops, fault_ms, n_batch
+        )
+    else:
+        case_dir, truth = _ensure_data(spans_target, n_ops, fault_ms)
 
     # --- ingest (native lane) ------------------------------------------
     t0 = time.perf_counter()
@@ -150,6 +328,11 @@ def main() -> int:
     # --- detect + partition (host) -------------------------------------
     t0 = time.perf_counter()
     slo_vocab, baseline = compute_slo_from_table(normal_table)
+    if n_batch > 1:  # per-window detection happens inside _run_batched
+        return _run_batched(
+            cfg, abnormal_table, slo_vocab, baseline, n_batch, repeats,
+            truth, case_dir, oracle_spans,
+        )
     mask = np.ones(n_spans, dtype=bool)
     batch, trace_codes = detect_batch_from_table(
         abnormal_table, mask, slo_vocab

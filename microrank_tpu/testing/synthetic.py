@@ -291,6 +291,25 @@ def generate_timeline(
     )
 
 
+def generate_timeline_with_spans(
+    cfg: SyntheticConfig,
+    target_spans_per_window: int,
+    n_windows: int,
+    faulted: List[int],
+) -> SyntheticTimeline:
+    """generate_timeline with the per-window trace count derived from a
+    spans target (same estimation as generate_case_with_spans)."""
+    rng = np.random.default_rng(cfg.seed)
+    topo = _make_topology(cfg, rng)
+    mean_kind = float(np.mean([len(k) for k in topo.kinds]))
+    n_traces = max(1, int(round(target_spans_per_window / max(mean_kind, 1.0))))
+    return generate_timeline(
+        SyntheticConfig(**{**cfg.__dict__, "n_traces": n_traces}),
+        n_windows,
+        faulted,
+    )
+
+
 def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     """One chaos case: a normal window and an abnormal window with one
     injected latency fault (the collect_data.py normal/abnormal dump pair)."""
