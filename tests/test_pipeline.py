@@ -282,3 +282,59 @@ def test_timeline_window_loop_and_skip(tmp_path):
         w0 = pd.Timestamp(r.start)
         w1 = w0 + pd.Timedelta(minutes=5)
         assert any(w0 < f1 and f0 < w1 for f0, f1 in faulted_spans), r.start
+
+
+def test_cli_config_json_roundtrip(tmp_path):
+    # A full MicroRankConfig serialized to JSON drives the CLI: to_dict ->
+    # file -> from_dict inside _config_from_args, overriding every flag.
+    from microrank_tpu.cli.main import main as cli_main
+    from microrank_tpu.config import PageRankConfig, SpectrumConfig
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+
+    cfg = MicroRankConfig(
+        pagerank=PageRankConfig(iterations=30, damping=0.9),
+        spectrum=SpectrumConfig(method="ochiai", top_max=7),
+    )
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg.to_dict()))
+
+    case = generate_case(
+        SyntheticConfig(n_operations=16, n_traces=100, seed=4,
+                        n_kinds=24, child_keep_prob=0.6)
+    )
+    (tmp_path / "d").mkdir()
+    case.normal.to_csv(tmp_path / "d" / "normal.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "d" / "abnormal.csv", index=False)
+    rc = cli_main(
+        ["run", "--normal", str(tmp_path / "d" / "normal.csv"),
+         "--abnormal", str(tmp_path / "d" / "abnormal.csv"),
+         "-o", str(tmp_path / "out"),
+         "--config-json", str(cfg_path)]
+    )
+    assert rc == 0
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "out" / "windows.jsonl").read_text().splitlines()
+    ]
+    ranked = [r for r in records if r["ranking"]]
+    assert ranked
+    # top_max=7 -> top_max + 6 = 13 rows requested; vocab is smaller here,
+    # so every valid op is ranked (more than the default 11 only if vocab
+    # allows) — just assert the config actually took effect via ochiai's
+    # bounded scores (dstar2 produces values >> 1).
+    assert all(s <= 1.5 for _, s in ranked[0]["ranking"])
+
+
+def test_trace_context(tmp_path):
+    # jax.profiler trace wrapper: produces a dump dir when given one and
+    # is a no-op without.
+    import jax.numpy as jnp
+
+    from microrank_tpu.utils.profiling import trace_context
+
+    with trace_context(None):
+        pass
+    d = tmp_path / "trace"
+    with trace_context(str(d)):
+        jnp.arange(8).sum().block_until_ready()
+    assert d.exists() and any(d.rglob("*"))
