@@ -23,7 +23,8 @@ generated once and cached under bench_data/.
 Config via env: BENCH_CONFIG=1..5 selects a BASELINE.json workload preset
 (default 5 = 1M spans / 5k ops); BENCH_SPANS / BENCH_OPS override the
 preset's sizes; BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000),
-BENCH_KERNEL (auto|coo|dense|dense_bf16|pallas), BENCH_FAULT_MS (60000).
+BENCH_KERNEL (auto|packed|packed_bf16|csr|coo|dense|dense_bf16|pallas),
+BENCH_FAULT_MS (60000), BENCH_BATCH (preset-dependent; 1 disables).
 Details go to stderr; stdout carries only the JSON line.
 
 Reference baseline context: the reference's PageRank Scorer takes 5.5 s
@@ -140,9 +141,37 @@ def _ensure_batch_data(spans_target, n_ops, fault_ms, n_batch):
     return case_dir, truth
 
 
+def _oracle_subsample(
+    cfg, sub_df, trace_names, nrm_codes, abn_codes, window_spans, oracle_spans
+):
+    """Time the numpy oracle on a trace subsample of one window — the
+    shared vs_baseline methodology of both bench modes. ``sub_df`` holds
+    the window's spans (pandas); returns (oracle_sps, sub_df_subsample,
+    sub_nrm_names, sub_abn_names, oracle_top).
+    """
+    from microrank_tpu.rank_backends import NumpyRefBackend
+
+    n_traces = len(nrm_codes) + len(abn_codes)
+    per_trace = max(1, window_spans // max(n_traces, 1))
+    n_take = max(2, oracle_spans // per_trace)
+    sub_nrm = [trace_names[c] for c in nrm_codes[: max(2, n_take // 2)]]
+    sub_abn = [trace_names[c] for c in abn_codes[: max(2, n_take // 2)]]
+    keep = set(sub_nrm) | set(sub_abn)
+    sub_df = sub_df[sub_df["traceID"].isin(keep)]
+    t0 = time.perf_counter()
+    top_o, _ = NumpyRefBackend(cfg).rank_window(sub_df, sub_nrm, sub_abn)
+    oracle_s = time.perf_counter() - t0
+    sps = len(sub_df) / oracle_s
+    log(
+        f"numpy oracle on {len(sub_df)}-span subsample: {oracle_s:.2f}s "
+        f"-> {sps:,.0f} spans/s"
+    )
+    return sps, sub_df, sub_nrm, sub_abn, top_o
+
+
 def _run_batched(
     cfg, table, slo_vocab, baseline, n_batch, repeats, truth,
-    case_dir, oracle_spans,
+    case_dir, oracle_spans, kernel,
 ) -> int:
     """BASELINE.json config 4 shape: an n_batch-window faulted timeline,
     each window detected/partitioned on the host and ALL of them ranked
@@ -151,6 +180,7 @@ def _run_batched(
     import numpy as np
 
     from microrank_tpu.detect import detect_numpy
+    from microrank_tpu.graph.build import aux_for_kernel
     from microrank_tpu.graph.table_ops import (
         build_window_graph_from_table,
         detect_batch_from_table,
@@ -164,29 +194,38 @@ def _run_batched(
     start = int(truth["start_us"])
     edges = [start + b * w_us for b in range(n_batch + 1)]
 
+    def detect_window(b):
+        m = (table.start_us >= edges[b]) & (table.end_us <= edges[b + 1])
+        batch, codes = detect_batch_from_table(table, m, slo_vocab)
+        det = detect_numpy(batch, baseline, cfg.detector)
+        t = len(codes)
+        abn = codes[det.abnormal[:t]]
+        nrm = codes[det.valid[:t] & ~det.abnormal[:t]]
+        return m, nrm, abn
+
     def build_all():
         graphs, names, total = [], list(table.pod_op_names), 0
         for b in range(n_batch):
-            m = (table.start_us >= edges[b]) & (table.end_us <= edges[b + 1])
-            batch, codes = detect_batch_from_table(table, m, slo_vocab)
-            det = detect_numpy(batch, baseline, cfg.detector)
-            t = len(codes)
-            abn = codes[det.abnormal[:t]]
-            nrm = codes[det.valid[:t] & ~det.abnormal[:t]]
+            m, nrm, abn = detect_window(b)
             if not (len(nrm) and len(abn)):
                 continue
-            g, _, _, _ = build_window_graph_from_table(table, m, nrm, abn)
+            g, _, _, _ = build_window_graph_from_table(
+                table, m, nrm, abn, aux=aux_for_kernel(kernel)
+            )
             graphs.append(g)
             total += int(m.sum())
+        if not graphs:
+            log("FATAL: no sub-window partitioned; tune the generator")
+            raise SystemExit(1)
         return stack_window_graphs(graphs), names, total, len(graphs)
 
     stacked, op_names, spans_used, n_windows = build_all()
     log(f"batched mode: {n_windows}/{n_batch} sub-windows partitioned, "
-        f"{spans_used} spans")
+        f"{spans_used} spans; kernel={kernel}")
 
     def run_fetched():
         return jax.device_get(
-            rank_windows_batched(stacked, cfg.pagerank, cfg.spectrum)
+            rank_windows_batched(stacked, cfg.pagerank, cfg.spectrum, kernel)
         )
 
     t0 = time.perf_counter()
@@ -197,15 +236,13 @@ def _run_batched(
         t0 = time.perf_counter()
         out = run_fetched()
         rank_times.append(time.perf_counter() - t0)
-    import numpy as _np
-
-    rank_s = float(_np.median(rank_times))
+    rank_s = float(np.median(rank_times))
     build_times = []
     for _ in range(max(1, min(repeats, 3))):
         t0 = time.perf_counter()
         build_all()
         build_times.append(time.perf_counter() - t0)
-    build_s = float(_np.median(build_times))
+    build_s = float(np.median(build_times))
     total_s = build_s + rank_s
     sps = spans_used / total_s
     ti, ts, nv = out
@@ -219,11 +256,8 @@ def _run_batched(
         f"{sps:,.0f} spans/s; fault top-1 in {hits}/{n_windows} sub-windows"
     )
 
-    # Oracle baseline on a trace subsample of sub-window 0 (same
-    # methodology as single-window mode).
+    # Oracle baseline on a trace subsample of sub-window 0.
     import pandas as pd
-
-    from microrank_tpu.rank_backends import NumpyRefBackend
 
     sub_df = pd.read_csv(case_dir / "abnormal.csv")
     sub_df["startTime"] = pd.to_datetime(sub_df["startTime"])
@@ -231,28 +265,11 @@ def _run_batched(
     w0 = pd.Timestamp(np.datetime64(int(edges[0]), "us"))
     w1 = pd.Timestamp(np.datetime64(int(edges[1]), "us"))
     sub_df = sub_df[(sub_df["startTime"] >= w0) & (sub_df["endTime"] <= w1)]
-    m0 = (table.start_us >= edges[0]) & (table.end_us <= edges[1])
-    batch0, codes0 = detect_batch_from_table(table, m0, slo_vocab)
-    det0 = detect_numpy(batch0, baseline, cfg.detector)
-    t0_ = len(codes0)
-    abn0 = codes0[det0.abnormal[:t0_]]
-    nrm0 = codes0[det0.valid[:t0_] & ~det0.abnormal[:t0_]]
-    per_trace = max(1, int(m0.sum()) // max(t0_, 1))
-    n_take = max(2, oracle_spans // per_trace)
-    keep_codes = list(nrm0[: max(2, n_take // 2)]) + list(
-        abn0[: max(2, n_take // 2)]
+    m0, nrm0, abn0 = detect_window(0)
+    oracle_sps, _, _, _, _ = _oracle_subsample(
+        cfg, sub_df, table.trace_names, nrm0, abn0, int(m0.sum()),
+        oracle_spans,
     )
-    keep = {table.trace_names[c] for c in keep_codes}
-    sub_df = sub_df[sub_df["traceID"].isin(keep)]
-    t0 = time.perf_counter()
-    NumpyRefBackend(cfg).rank_window(
-        sub_df,
-        [table.trace_names[c] for c in nrm0[: max(2, n_take // 2)]],
-        [table.trace_names[c] for c in abn0[: max(2, n_take // 2)]],
-    )
-    oracle_sps = len(sub_df) / (time.perf_counter() - t0)
-    log(f"numpy oracle on {len(sub_df)}-span subsample: "
-        f"{oracle_sps:,.0f} spans/s")
 
     print(
         json.dumps(
@@ -295,7 +312,6 @@ def main() -> int:
         detect_batch_from_table,
     )
     from microrank_tpu.native import load_span_table, native_available
-    from microrank_tpu.rank_backends import NumpyRefBackend
     from microrank_tpu.rank_backends.jax_tpu import (
         JaxBackend,
         choose_kernel,
@@ -332,6 +348,7 @@ def main() -> int:
         return _run_batched(
             cfg, abnormal_table, slo_vocab, baseline, n_batch, repeats,
             truth, case_dir, oracle_spans,
+            os.environ.get("BENCH_KERNEL", "auto"),
         )
     mask = np.ones(n_spans, dtype=bool)
     batch, trace_codes = detect_batch_from_table(
@@ -430,31 +447,14 @@ def main() -> int:
     # --- oracle baseline on a subsample (pandas lane, untimed load) ----
     import pandas as pd
 
-    sub_df = pd.read_csv(case_dir / "abnormal.csv")
-    per_trace = max(1, n_spans // max(t, 1))
-    n_take = max(2, oracle_spans // per_trace)
-    keep = set(
-        [abnormal_table.trace_names[c] for c in nrm[: max(2, n_take // 2)]]
-        + [abnormal_table.trace_names[c] for c in abn[: max(2, n_take // 2)]]
-    )
-    sub_df = sub_df[sub_df["traceID"].isin(keep)]
-    sub_nrm = [
-        abnormal_table.trace_names[c]
-        for c in nrm[: max(2, n_take // 2)]
-    ]
-    sub_abn = [
-        abnormal_table.trace_names[c]
-        for c in abn[: max(2, n_take // 2)]
-    ]
-    n_sub = len(sub_df)
-    oracle = NumpyRefBackend(cfg)
-    t0 = time.perf_counter()
-    top_o, _ = oracle.rank_window(sub_df, sub_nrm, sub_abn)
-    oracle_s = time.perf_counter() - t0
-    oracle_sps = n_sub / oracle_s
-    log(
-        f"numpy oracle on {n_sub}-span subsample: {oracle_s:.2f}s "
-        f"-> {oracle_sps:,.0f} spans/s"
+    oracle_sps, sub_df, sub_nrm, sub_abn, top_o = _oracle_subsample(
+        cfg,
+        pd.read_csv(case_dir / "abnormal.csv"),
+        abnormal_table.trace_names,
+        nrm,
+        abn,
+        n_spans,
+        oracle_spans,
     )
 
     top_j, _ = JaxBackend(cfg).rank_window(sub_df, sub_nrm, sub_abn)

@@ -217,23 +217,26 @@ class SyntheticCase:
         return [_pod_op_name(op, pod, n_ops) for op, pod in self.faults]
 
 
-def generate_case_with_spans(
-    cfg: SyntheticConfig, target_spans: int
-) -> SyntheticCase:
-    """Generate a case whose windows hold ~``target_spans`` spans each.
-
-    Builds the topology first, measures the mean trace-kind size, and
-    derives the trace count — the knob bench configs are specified in
-    (BASELINE.json: "1M-span / 5k-operation window").
-    """
+def _traces_for_spans(cfg: SyntheticConfig, target_spans: int) -> int:
+    """Trace count whose expected span total is ~``target_spans``: build
+    the (deterministic, seed-keyed) topology once to measure the mean
+    trace-kind size. The caller's generator rebuilds the same topology
+    from the same seed, so the estimate matches what it will render."""
     rng = np.random.default_rng(cfg.seed)
     topo = _make_topology(cfg, rng)
     mean_kind = float(np.mean([len(k) for k in topo.kinds]))
-    n_traces = max(1, int(round(target_spans / max(mean_kind, 1.0))))
+    return max(1, int(round(target_spans / max(mean_kind, 1.0))))
+
+
+def generate_case_with_spans(
+    cfg: SyntheticConfig, target_spans: int
+) -> SyntheticCase:
+    """Generate a case whose windows hold ~``target_spans`` spans each —
+    the knob bench configs are specified in (BASELINE.json: "1M-span /
+    5k-operation window")."""
+    n_traces = _traces_for_spans(cfg, target_spans)
     return generate_case(
-        SyntheticConfig(
-            **{**cfg.__dict__, "n_traces": n_traces}
-        )
+        SyntheticConfig(**{**cfg.__dict__, "n_traces": n_traces})
     )
 
 
@@ -299,10 +302,7 @@ def generate_timeline_with_spans(
 ) -> SyntheticTimeline:
     """generate_timeline with the per-window trace count derived from a
     spans target (same estimation as generate_case_with_spans)."""
-    rng = np.random.default_rng(cfg.seed)
-    topo = _make_topology(cfg, rng)
-    mean_kind = float(np.mean([len(k) for k in topo.kinds]))
-    n_traces = max(1, int(round(target_spans_per_window / max(mean_kind, 1.0))))
+    n_traces = _traces_for_spans(cfg, target_spans_per_window)
     return generate_timeline(
         SyntheticConfig(**{**cfg.__dict__, "n_traces": n_traces}),
         n_windows,
