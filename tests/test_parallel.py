@@ -177,3 +177,21 @@ def test_table_rca_sharded_matches_default(tmp_path):
         r_sharded = sharded.run(abnormal)
         b = next(r for r in r_sharded if r.ranking)
         assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking], kernel
+
+
+def test_batched_with_convergence_tol(window_batch):
+    # lax.while_loop under vmap runs lockstep until every window's
+    # vectors converge; results must match per-window tol ranking.
+    from microrank_tpu.config import PageRankConfig
+
+    graphs, namelists = window_batch
+    cfg = MicroRankConfig(
+        pagerank=PageRankConfig(iterations=100, tol=1e-6)
+    )
+    stacked = stack_window_graphs(graphs)
+    bti, _, _ = rank_windows_batched(stacked, cfg.pagerank, cfg.spectrum)
+    for i, g in enumerate(graphs):
+        ti, _, _ = rank_window_device(
+            jax.tree.map(jnp.asarray, g), cfg.pagerank, cfg.spectrum
+        )
+        assert int(np.asarray(ti)[0]) == int(np.asarray(bti[i])[0])
