@@ -79,6 +79,12 @@ class SpectrumConfig:
     # Missing-side spectrum value. Code uses 1e-7 (online_rca.py:57-58);
     # the paper says 1e-4. Code wins by default.
     eps: float = 1e-7
+    # Order of EXACTLY tied scores: "name" (ascending op name — the
+    # deterministic default; the device path realizes it as ascending
+    # vocab index over the name-sorted window vocab) or "insertion"
+    # (the reference's accidental dict-insertion order under a stable
+    # sort, online_rca.py:144-152 — oracle backend only).
+    tiebreak: str = "name"
 
     @property
     def n_rows(self) -> int:
@@ -162,6 +168,7 @@ class MicroRankConfig:
         return cls(
             compat=CompatConfig(partition_swap=True, overwrite_results=True),
             pagerank=PageRankConfig(preference="reference"),
+            spectrum=SpectrumConfig(tiebreak="insertion"),
         )
 
     def replace(self, **kwargs: Any) -> "MicroRankConfig":

@@ -432,7 +432,10 @@ def build_window_graph(
     Returns (graph, op_names, normal_trace_ids, abnormal_trace_ids).
     """
     names = operation_names(span_df, "pod", strip_services)
-    op_codes, op_uniques = pd.factorize(names, use_na_sentinel=False)
+    # sort=True interns the vocab in name order: vocab index then doubles
+    # as the deterministic tie key of the device ranking (ascending op
+    # name — the same key the numpy oracle uses under tiebreak="name").
+    op_codes, op_uniques = pd.factorize(names, sort=True, use_na_sentinel=False)
     op_codes = op_codes.astype(np.int64)
     vocab_size = len(op_uniques)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)

@@ -176,7 +176,27 @@ def native_available() -> bool:
         return False
 
 
-_SIDECAR_VERSION = 1
+# v2: op vocabularies canonicalized to name-sorted order (the vocab index
+# is the device ranking's tie key — it must equal ascending op name).
+_SIDECAR_VERSION = 2
+
+
+def _sort_vocab(codes: np.ndarray, names: List[str]):
+    """Remap one interned column onto the name-sorted canonical vocab.
+
+    The C++ interner assigns ids in first-appearance order; downstream the
+    pod-op vocab index doubles as the ranking's deterministic tie key, so
+    it must order by name (Python ``sorted`` semantics — the same
+    comparison the numpy oracle's tiebreak="name" sort uses).
+    """
+    if len(names) <= 1:
+        return codes, list(names)
+    perm = sorted(range(len(names)), key=names.__getitem__)
+    inv = np.empty(len(names), dtype=codes.dtype)
+    inv[np.asarray(perm, dtype=np.int64)] = np.arange(
+        len(names), dtype=codes.dtype
+    )
+    return inv[codes], [names[i] for i in perm]
 
 
 def _sidecar_path(path: Path, strip_services) -> Path:
@@ -276,10 +296,18 @@ def load_span_table(
             return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
 
         # blob pointers: ctypes c_char_p auto-converts to bytes
+        svc_op, svc_names = _sort_vocab(
+            arr(t.svc_op, np.int32),
+            _decode_vocab(t.svc_blob, t.svc_offsets, int(t.n_svc_ops)),
+        )
+        pod_op, pod_names = _sort_vocab(
+            arr(t.pod_op, np.int32),
+            _decode_vocab(t.pod_blob, t.pod_offsets, int(t.n_pod_ops)),
+        )
         table = SpanTable(
             trace_id=arr(t.trace_id, np.int32),
-            svc_op=arr(t.svc_op, np.int32),
-            pod_op=arr(t.pod_op, np.int32),
+            svc_op=svc_op,
+            pod_op=pod_op,
             duration_us=arr(t.duration_us, np.int64),
             start_us=arr(t.start_us, np.int64),
             end_us=arr(t.end_us, np.int64),
@@ -287,12 +315,8 @@ def load_span_table(
             trace_names=_decode_vocab(
                 t.trace_blob, t.trace_offsets, int(t.n_traces)
             ),
-            svc_op_names=_decode_vocab(
-                t.svc_blob, t.svc_offsets, int(t.n_svc_ops)
-            ),
-            pod_op_names=_decode_vocab(
-                t.pod_blob, t.pod_offsets, int(t.n_pod_ops)
-            ),
+            svc_op_names=svc_names,
+            pod_op_names=pod_names,
         )
         if cache:
             _save_sidecar(side, path, table)

@@ -175,8 +175,11 @@ def _partition_specs(window_axis, shard_axis) -> PartitionGraph:
         ss_child=entry,
         ss_parent=entry,
         ss_val=entry,
-        # CSR views are unused by the sharded (coo+psum) kernel; shard the
-        # entry-sized copies like their siblings, replicate the offsets.
+        # The sharded csr kernel reads these: the entry-sized op-major
+        # copies block-split across the shard axis like their COO
+        # siblings, while the indptrs stay replicated — each device
+        # prefix-sums its contiguous entry block and clamps the row
+        # ranges to it (jax_tpu.csr_rowsum). The coo kernel ignores them.
         inc_trace_opmajor=entry,
         sr_val_opmajor=entry,
         inc_indptr_op=per_window,

@@ -37,8 +37,11 @@ def _iso(us: int) -> str:
 
 class TableRCA:
     def __init__(self, config: MicroRankConfig = MicroRankConfig()):
+        from ..rank_backends.jax_tpu import validate_tiebreak
+
         self.config = config
         self.log = get_logger("microrank_tpu.pipeline.table")
+        validate_tiebreak(config.spectrum)
         self.slo_vocab = None
         self.baseline = None
         self._mesh = None
@@ -50,6 +53,13 @@ class TableRCA:
                 shape = (1, shape[0])
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
+            if config.runtime.kernel not in ("auto", "coo", "csr"):
+                self.log.warning(
+                    "kernel=%r is not shard-capable; the sharded path "
+                    "ranks with kernel='csr' instead (different "
+                    "summation tree, same math)",
+                    config.runtime.kernel,
+                )
 
     def fit_baseline(self, normal_table) -> None:
         self.slo_vocab, self.baseline = compute_slo_from_table(

@@ -17,7 +17,8 @@ matrices (pagerank.py) plus a per-op Python loop for the spectrum
 * both partitions iterate in the same program (XLA schedules them
   side by side);
 * the 13 spectrum formulas are an elementwise [V] kernel fused by XLA;
-* ranking ends with ``lax.top_k`` on device.
+* ranking ends with a two-key ``lax.sort`` on device (score descending,
+  op index ascending — exactly tied scores break deterministically).
 
 The function is vmap-able over a leading window-batch axis and is the unit
 the sharded path (microrank_tpu.parallel) wraps with shard_map + psum.
@@ -458,6 +459,46 @@ def window_spectrum(
     return jnp.where(valid, scores, -jnp.inf), valid
 
 
+def validate_tiebreak(cfg: SpectrumConfig) -> None:
+    """Device-path check of SpectrumConfig.tiebreak: unknown values raise;
+    "insertion" (the oracle-only reference-compat order) warns once that
+    the device program always uses the name/index tie key — lax.sort has
+    no notion of dict insertion order to reproduce."""
+    if cfg.tiebreak == "name":
+        return
+    if cfg.tiebreak == "insertion":
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "tiebreak='insertion' is oracle-only; the device ranking "
+            "breaks exact score ties by ascending op name instead"
+        )
+        return
+    raise ValueError(f"unknown tiebreak {cfg.tiebreak!r}")
+
+
+def top_k_tiebroken(scores, k: int):
+    """Top-k by score descending, op index ascending on EXACT score ties.
+
+    The reference's tie order is dict insertion order under a stable sort
+    (online_rca.py:144-152) — an accident of hash ordering. Here ties
+    break by vocab index; the graph build interns the window vocab in
+    name-sorted order, so that is ascending op name in every backend and
+    kernel, and rankings stay reproducible even under tarantula-style
+    score saturation (many ops at exactly 1.0). Implemented as one
+    two-key ``lax.sort`` over [V] — the score vector is op-vocab-sized,
+    so the full sort costs noise next to the power iteration.
+
+    Returns (top_scores[k], top_idx[k]) like ``lax.top_k``.
+    """
+    # +0.0 canonicalizes -0.0 so the float total order XLA sorts by
+    # cannot split scores Python compares equal.
+    neg = -(scores + 0.0)
+    idx = jnp.arange(scores.shape[0], dtype=jnp.int32)
+    neg_sorted, idx_sorted = lax.sort((neg, idx), num_keys=2)
+    return -neg_sorted[:k], idx_sorted[:k]
+
+
 def rank_window_core(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
@@ -479,7 +520,7 @@ def rank_window_core(
         a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
     )
     k = min(spectrum_cfg.n_rows, scores.shape[0])
-    top_scores, top_idx = lax.top_k(scores, k)
+    top_scores, top_idx = top_k_tiebroken(scores, k)
     n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
     return top_idx.astype(jnp.int32), top_scores, n_valid
 
@@ -548,7 +589,7 @@ def rank_window_all_methods_core(
         scores = jnp.where(
             valid, spectrum_scores(ef, nf, ep, np_, method), -jnp.inf
         )
-        top_scores, top_idx = lax.top_k(scores, k)
+        top_scores, top_idx = top_k_tiebroken(scores, k)
         tops.append((top_idx.astype(jnp.int32), top_scores))
     n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
     return (
@@ -651,6 +692,7 @@ class JaxBackend:
         normal_ids = list(normal_ids)
         abnormal_ids = list(abnormal_ids)
         validate_partitions(normal_ids, abnormal_ids)
+        validate_tiebreak(self.config.spectrum)
         rt = self.config.runtime
         graph, op_names, _, _ = build_window_graph(
             span_df,
@@ -698,6 +740,7 @@ class JaxBackend:
         normal_ids = list(normal_ids)
         abnormal_ids = list(abnormal_ids)
         validate_partitions(normal_ids, abnormal_ids)
+        validate_tiebreak(self.config.spectrum)
         rt = self.config.runtime
         graph, op_names, _, _ = build_window_graph(
             span_df,

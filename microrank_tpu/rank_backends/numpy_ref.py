@@ -294,8 +294,12 @@ def calculate_spectrum(
 ) -> Tuple[List[str], List[float]]:
     """Reference ``calculate_spectrum_without_delay_list``
     (online_rca.py:33-152): score every op, return the top
-    ``top_max + extra_rows`` (descending; Python stable sort, so ties keep
-    dict insertion order like the reference)."""
+    ``top_max + extra_rows`` (score descending).
+
+    Exactly tied scores order by ``cfg.tiebreak``: "name" (ascending op
+    name — matches the device path, whose vocab-index tie key runs over
+    the name-sorted window vocab) or "insertion" (the reference's
+    accidental dict-insertion order under Python's stable sort)."""
     spectrum = spectrum_components(
         anomaly_result,
         normal_result,
@@ -308,11 +312,15 @@ def calculate_spectrum(
     result = {
         node: spectrum_score(cell, cfg.method) for node, cell in spectrum.items()
     }
+    if cfg.tiebreak == "name":
+        ranked = sorted(result.items(), key=lambda x: (-x[1], x[0]))
+    elif cfg.tiebreak == "insertion":
+        ranked = sorted(result.items(), key=lambda x: x[1], reverse=True)
+    else:
+        raise ValueError(f"unknown tiebreak {cfg.tiebreak!r}")
     top_list: List[str] = []
     score_list: List[float] = []
-    for index, (node, score) in enumerate(
-        sorted(result.items(), key=lambda x: x[1], reverse=True)
-    ):
+    for index, (node, score) in enumerate(ranked):
         if index < cfg.n_rows:
             top_list.append(node)
             score_list.append(float(score))

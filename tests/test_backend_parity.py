@@ -294,6 +294,61 @@ def test_pallas_kernel_matches_coo(small_case):
     )
 
 
+def test_tie_order_pinned_across_backends():
+    # Tarantula-style saturation: ops that appear ONLY in abnormal traces
+    # and cover every abnormal trace all score exactly 1/(1+0.5) = 2/3
+    # (ef/(ef+nf)=1 exactly; eps/(eps+eps)=0.5 exactly), independent of
+    # their PageRank weight. The pinned tie key — ascending op name, via
+    # the name-sorted vocab index on device — must produce the SAME
+    # positional ranking in the float64 oracle and every device kernel.
+    import pandas as pd
+
+    from microrank_tpu.config import RuntimeConfig
+
+    rows = []
+    sid = 0
+
+    def trace(tid, ops):
+        nonlocal sid
+        root_sid = f"s{sid}"
+        for i, op in enumerate(ops):
+            rows.append(
+                dict(
+                    traceID=tid,
+                    spanID=f"s{sid}",
+                    ParentSpanId="missing" if i == 0 else root_sid,
+                    operationName=op,
+                    serviceName="svc",
+                    podName="svc-0",
+                    duration=1000,
+                    startTime=0,
+                    endTime=10,
+                )
+            )
+            sid += 1
+
+    nrm = [f"n{i}" for i in range(4)]
+    abn = [f"a{i}" for i in range(4)]
+    for tid in nrm:
+        trace(tid, ["root"])
+    for tid in abn:
+        # Deliberately non-sorted insertion order to catch any
+        # insertion-order accident surviving in either backend.
+        trace(tid, ["root", "tie-b", "tie-a", "tie-c"])
+    df = pd.DataFrame(rows)
+
+    cfg = MicroRankConfig(spectrum=SpectrumConfig(method="tarantula"))
+    expected_ties = ["svc-0_tie-a", "svc-0_tie-b", "svc-0_tie-c"]
+
+    top_o, sc_o = NumpyRefBackend(cfg).rank_window(df, nrm, abn)
+    assert top_o[:3] == expected_ties
+    assert sc_o[0] == sc_o[1] == sc_o[2]
+    for kernel in ("auto", "coo", "csr", "packed", "dense"):
+        kcfg = cfg.replace(runtime=RuntimeConfig(kernel=kernel))
+        top_j, _ = get_backend(kcfg).rank_window(df, nrm, abn)
+        assert top_j == top_o, kernel
+
+
 def test_fuzz_parity_tie_aware():
     # Randomized windows across sizes/pads/kernels: the device Top-1 must
     # be an op the float64 oracle scores within 1e-6 relative of ITS top

@@ -38,6 +38,22 @@ def window_batch():
     return graphs, namelists
 
 
+def _assert_rank_equal_tieaware(ti, ts, si, ss, rtol=1e-5):
+    """Positional rank equality, except where the two paths' summation
+    trees differ (coo segment sums vs sharded csr prefix sums): a
+    positional mismatch is allowed only between near-equal scores —
+    EXACT ties are pinned by the op-index tie key, but ~1-ulp near-ties
+    legitimately flip across kernels."""
+    ti, ts = np.asarray(ti), np.asarray(ts)
+    si, ss = np.asarray(si), np.asarray(ss)
+    assert set(ti.tolist()) == set(si.tolist())
+    for p in range(len(ti)):
+        if ti[p] != si[p]:
+            a, b = float(ts[p]), float(ss[p])
+            assert np.isfinite(a) and np.isfinite(b), (p, a, b)
+            assert abs(a - b) <= rtol * max(abs(a), abs(b), 1e-12), (p, a, b)
+
+
 def test_sharded_matches_single_device(window_batch):
     graphs, namelists = window_batch
     cfg = MicroRankConfig()
@@ -50,9 +66,9 @@ def test_sharded_matches_single_device(window_batch):
         ti, ts, tv = rank_window_device(
             jax.tree.map(jnp.asarray, g), cfg.pagerank, cfg.spectrum
         )
-        # Same top-1 op by name; same candidate ordering.
+        # Same top-1 op by name; same candidate ordering up to near-ties.
         assert namelists[i][int(ti[0])] == namelists[i][int(sti[i][0])]
-        np.testing.assert_array_equal(np.asarray(ti), np.asarray(sti[i]))
+        _assert_rank_equal_tieaware(ti, ts, sti[i], sts[i])
 
 
 def test_batched_vmap_matches_sharded(window_batch):
@@ -64,7 +80,8 @@ def test_batched_vmap_matches_sharded(window_batch):
         jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum, mesh
     )
     bti, bts, _ = rank_windows_batched(stacked, cfg.pagerank, cfg.spectrum)
-    np.testing.assert_array_equal(np.asarray(sti), np.asarray(bti))
+    for b in range(np.asarray(bti).shape[0]):
+        _assert_rank_equal_tieaware(bti[b], bts[b], sti[b], sts[b])
     fin = np.isfinite(np.asarray(bts))
     rel = np.abs(np.asarray(sts)[fin] - np.asarray(bts)[fin]) / np.maximum(
         np.abs(np.asarray(bts)[fin]), 1e-9
