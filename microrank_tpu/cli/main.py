@@ -193,10 +193,29 @@ def cmd_eval(args) -> int:
         n_traces=args.traces,
         n_pods=args.pods,
         n_kinds=args.kinds,
+        child_keep_prob=args.keep_prob,
         n_faults=args.faults,
         fault_latency_ms=args.fault_ms,
+        fault_path_overlap=args.fault_overlap,
         seed0=args.seed,
     )
+    if args.overlap_ablation:
+        from ..evaluation import evaluate_overlap_ablation
+
+        reports = evaluate_overlap_ablation(cfg, eval_cfg)
+        for ov, rep in reports.items():
+            print(f"overlap={ov:.2f}  {rep.summary()}")
+        if args.json:
+            out = {
+                str(ov): {
+                    "recall_at": rep.recall_at,
+                    "exam_score": rep.exam_score,
+                    "detection_rate": rep.detection_rate,
+                }
+                for ov, rep in reports.items()
+            }
+            Path(args.json).write_text(json.dumps(out, indent=2))
+        return 0
     if args.detection:
         report = evaluate_detection(cfg, eval_cfg, n_windows=args.windows)
         print(report.summary())
@@ -292,6 +311,21 @@ def main(argv=None) -> int:
     p_eval.add_argument("--kinds", type=int, default=24)
     p_eval.add_argument("--faults", type=int, default=1)
     p_eval.add_argument("--fault-ms", type=float, default=2000.0)
+    p_eval.add_argument(
+        "--keep-prob", type=float, default=0.6,
+        help="per-kind subtree keep probability: trace-kind breadth "
+        "(lower = narrower, more request-like traces)",
+    )
+    p_eval.add_argument(
+        "--fault-overlap", type=float, default=None,
+        help="target root-path overlap between injected faults "
+        "(multi-fault hardness control, 0=disjoint paths, 1=nested)",
+    )
+    p_eval.add_argument(
+        "--overlap-ablation", action="store_true",
+        help="sweep --fault-overlap over 0, 0.25, 0.5, 0.75, 1 "
+        "(two-fault hardness ablation)",
+    )
     p_eval.add_argument("--seed", type=int, default=1000)
     p_eval.add_argument(
         "--all-methods",

@@ -40,6 +40,10 @@ class EvalConfig:
     child_keep_prob: float = 0.6
     n_faults: int = 1
     fault_latency_ms: float = 2000.0
+    # Target root-path overlap between injected faults (multi-fault
+    # hardness control — testing.synthetic.path_overlap). None = the
+    # unconstrained historical choice.
+    fault_path_overlap: Optional[float] = None
     seed0: int = 1000
     ks: Tuple[int, ...] = (1, 3, 5)
 
@@ -94,6 +98,7 @@ def _case_config(eval_cfg: EvalConfig, seed: int) -> SyntheticConfig:
         n_traces=eval_cfg.n_traces,
         fault_latency_ms=eval_cfg.fault_latency_ms,
         n_faults=eval_cfg.n_faults,
+        fault_path_overlap=eval_cfg.fault_path_overlap,
         seed=seed,
     )
 
@@ -270,6 +275,36 @@ def evaluate_detection(
             seed, list(faulted), report.tp, report.fp, report.fn, report.tn,
         )
     return report
+
+
+def evaluate_overlap_ablation(
+    config: MicroRankConfig = MicroRankConfig(),
+    eval_cfg: EvalConfig = EvalConfig(n_faults=2),
+    overlaps: Tuple[float, ...] = (0.0, 0.25, 0.5, 0.75, 1.0),
+) -> Dict[float, EvalReport]:
+    """Two-fault accuracy vs fault-path separation (the hardness
+    ablation behind EVALUATION.md's two-fault table).
+
+    Runs ``evaluate`` once per target overlap with the fault placement
+    constrained via ``SyntheticConfig.fault_path_overlap``: overlap 0
+    puts the two faults on disjoint call paths (the separable regime the
+    paper's dataset-B testbed approximates), overlap 1 makes one fault
+    an ancestor of the other (its spectrum counters are masked by the
+    propagated latency — irreducibly hard for any coverage-spectrum
+    ranker). Returns {target_overlap: EvalReport}.
+    """
+    import dataclasses
+
+    out: Dict[float, EvalReport] = {}
+    for ov in overlaps:
+        ecfg = dataclasses.replace(
+            eval_cfg,
+            n_faults=max(2, eval_cfg.n_faults),
+            fault_path_overlap=float(ov),
+        )
+        out[float(ov)] = evaluate(config, ecfg)
+        log.info("overlap %.2f: %s", ov, out[float(ov)].summary())
+    return out
 
 
 def evaluate_all_methods(

@@ -459,20 +459,26 @@ def window_spectrum(
     return jnp.where(valid, scores, -jnp.inf), valid
 
 
+_tiebreak_warned = False
+
+
 def validate_tiebreak(cfg: SpectrumConfig) -> None:
     """Device-path check of SpectrumConfig.tiebreak: unknown values raise;
-    "insertion" (the oracle-only reference-compat order) warns once that
-    the device program always uses the name/index tie key — lax.sort has
-    no notion of dict insertion order to reproduce."""
+    "insertion" (the oracle-only reference-compat order) warns once per
+    process that the device program always uses the name/index tie key —
+    lax.sort has no notion of dict insertion order to reproduce."""
     if cfg.tiebreak == "name":
         return
     if cfg.tiebreak == "insertion":
-        import logging
+        global _tiebreak_warned
+        if not _tiebreak_warned:
+            _tiebreak_warned = True
+            from ..utils.logging import get_logger
 
-        logging.getLogger(__name__).warning(
-            "tiebreak='insertion' is oracle-only; the device ranking "
-            "breaks exact score ties by ascending op name instead"
-        )
+            get_logger("microrank_tpu.rank_backends").warning(
+                "tiebreak='insertion' is oracle-only; the device ranking "
+                "breaks exact score ties by ascending op name instead"
+            )
         return
     raise ValueError(f"unknown tiebreak {cfg.tiebreak!r}")
 
@@ -651,7 +657,7 @@ def device_subset(graph: WindowGraph, kernel: str) -> WindowGraph:
     )
 
 
-def choose_kernel(graph: WindowGraph, budget_bytes: int = 0) -> str:
+def choose_kernel(graph: WindowGraph) -> str:
     """auto kernel policy, by PRESENCE of the auxiliary views the build
     constructed (graph.build.resolve_aux holds the actual budget policy, so
     build and kernel choice cannot disagree). Rationale, from measured v5e
@@ -659,8 +665,7 @@ def choose_kernel(graph: WindowGraph, budget_bytes: int = 0) -> str:
     *per iteration*, dense matvec sub-ms): "packed" bitmap-expanded MXU
     matvecs when available, "csr" cumsum-difference SpMV (scatter-free,
     entry-linear memory) past the budget, "coo" as the last resort (e.g. a
-    stacked batch that mixed aux modes). ``budget_bytes`` is unused and
-    kept for call-site compatibility."""
+    stacked batch that mixed aux modes)."""
     parts = (graph.normal, graph.abnormal)
     # [-1] indexing so batched ([B, ...]-leading) graphs work too.
     if all(int(g.cov_bits.shape[-1]) > 0 for g in parts):

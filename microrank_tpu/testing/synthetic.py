@@ -40,6 +40,15 @@ class SyntheticConfig:
     fault_latency_ms: float = 2000.0
     # Simultaneous faults in the abnormal window (paper dataset B uses 2).
     n_faults: int = 1
+    # Fault-separation control (multi-fault hardness ablation): target
+    # root-path overlap between the injected faults, as the overlap
+    # coefficient |P_a ∩ P_b| / min(|P_a|, |P_b|) over root-to-op paths
+    # with the root excluded. 0.0 places the faults on disjoint call
+    # paths (cleanly separable spectra), 1.0 makes one fault an ancestor
+    # of the other (its counters are fully masked by latency
+    # propagation). None (default) keeps the historical unconstrained
+    # random choice.
+    fault_path_overlap: Optional[float] = None
     window_minutes: float = 5.0
     seed: int = 0
 
@@ -54,18 +63,95 @@ def _pod_op_name(op: int, pod: int, n_operations: int) -> str:
     return f"svc{op:0{w}d}-{pod}_op{op:0{w}d}"
 
 
+def _root_path(parent: np.ndarray, op: int) -> frozenset:
+    """Ops on the root→op call path, the root itself excluded (every
+    path shares the root, so including it would floor the overlap)."""
+    out = []
+    o = int(op)
+    while o > 0:
+        out.append(o)
+        o = int(parent[o])
+    return frozenset(out)
+
+
+def path_overlap(parent: np.ndarray, a: int, b: int) -> float:
+    """Overlap coefficient of two ops' root paths: |Pa ∩ Pb| / min(|Pa|,
+    |Pb|). 0 = disjoint paths (share only the root); 1 = one op lies on
+    the other's path (ancestor/descendant)."""
+    pa, pb = _root_path(parent, a), _root_path(parent, b)
+    return len(pa & pb) / max(min(len(pa), len(pb)), 1)
+
+
 def _pick_faults(
-    topo: "Topology", rng: np.random.Generator, n_pods: int, n_faults: int
+    topo: "Topology",
+    rng: np.random.Generator,
+    n_pods: int,
+    n_faults: int,
+    target_overlap: Optional[float] = None,
 ):
     """Fault candidates: ops covered by >=1 kind, excluding the root (the
-    root is trivially always the top anomaly otherwise)."""
+    root is trivially always the top anomaly otherwise).
+
+    With ``target_overlap`` set and >=2 faults, ops are chosen so their
+    mean pairwise ``path_overlap`` tracks the target: the best pair over
+    all candidate pairs seeds the set, then greedy additions minimize the
+    deviation. ``None`` keeps the historical unconstrained choice (so
+    fixed-seed cases generated before this control exist unchanged).
+    """
     covered = np.unique(np.concatenate(topo.kinds))
     candidates = covered[covered != 0]
     if len(candidates) == 0:
         candidates = covered
     n_faults = min(n_faults, len(candidates))
-    fault_ops = rng.choice(candidates, size=n_faults, replace=False)
-    return [(int(op), int(rng.integers(0, n_pods))) for op in fault_ops]
+    if target_overlap is None or n_faults < 2:
+        fault_ops = rng.choice(candidates, size=n_faults, replace=False)
+        return [(int(op), int(rng.integers(0, n_pods))) for op in fault_ops]
+
+    cand = [int(c) for c in candidates]
+    pairs = [
+        (a, b) for i, a in enumerate(cand) for b in cand[i + 1:]
+    ]
+    dev = np.array(
+        [abs(path_overlap(topo.parent, a, b) - target_overlap) for a, b in pairs]
+    )
+    best = np.flatnonzero(dev == dev.min())
+    chosen = list(pairs[int(rng.choice(best))])
+    remaining = [c for c in cand if c not in chosen]
+    while len(chosen) < n_faults and remaining:
+        devs = np.array(
+            [
+                abs(
+                    float(
+                        np.mean(
+                            [path_overlap(topo.parent, c, x) for x in chosen]
+                        )
+                    )
+                    - target_overlap
+                )
+                for c in remaining
+            ]
+        )
+        best = np.flatnonzero(devs == devs.min())
+        pick = remaining[int(rng.choice(best))]
+        chosen.append(pick)
+        remaining.remove(pick)
+    return [(int(op), int(rng.integers(0, n_pods))) for op in chosen]
+
+
+def achieved_overlap(
+    parent: np.ndarray, faults: List[Tuple[int, int]]
+) -> Optional[float]:
+    """Mean pairwise root-path overlap of the injected fault ops
+    (None for single-fault cases)."""
+    ops = [op for op, _ in faults]
+    if len(ops) < 2:
+        return None
+    vals = [
+        path_overlap(parent, a, b)
+        for i, a in enumerate(ops)
+        for b in ops[i + 1:]
+    ]
+    return float(np.mean(vals))
 
 
 @dataclass
@@ -209,6 +295,10 @@ class SyntheticCase:
     fault_pod: int
     topology: Topology
     faults: List[Tuple[int, int]] = field(default_factory=list)
+    # Mean pairwise root-path overlap of the injected faults (None when
+    # single-fault) — the hardness statistic the two-fault ablation
+    # conditions on.
+    fault_overlap: Optional[float] = None
 
     @property
     def fault_pod_ops(self) -> List[str]:
@@ -315,7 +405,9 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
     injected latency fault (the collect_data.py normal/abnormal dump pair)."""
     rng = np.random.default_rng(cfg.seed)
     topo = _make_topology(cfg, rng)
-    faults = _pick_faults(topo, rng, cfg.n_pods, cfg.n_faults)
+    faults = _pick_faults(
+        topo, rng, cfg.n_pods, cfg.n_faults, cfg.fault_path_overlap
+    )
 
     t0 = pd.Timestamp("2025-02-14 12:00:00")
     t1 = t0 + pd.Timedelta(minutes=cfg.window_minutes)
@@ -332,4 +424,5 @@ def generate_case(cfg: SyntheticConfig) -> SyntheticCase:
         fault_pod=fault_pod,
         topology=topo,
         faults=faults,
+        fault_overlap=achieved_overlap(topo.parent, faults),
     )

@@ -99,3 +99,18 @@ def test_timeline_generator_layout():
         assert len(spans)
         assert (spans["startTime"] >= w0).all()
         assert (spans["startTime"] < w1).all()
+
+
+def test_overlap_ablation_smoke():
+    # The two-fault ablation runner: one report per target overlap, each
+    # generated under the constrained fault placement.
+    from microrank_tpu.evaluation import evaluate_overlap_ablation
+
+    cfg = EvalConfig(n_cases=2, n_operations=20, n_traces=80, n_faults=2)
+    reports = evaluate_overlap_ablation(
+        MicroRankConfig(), cfg, overlaps=(0.0, 1.0)
+    )
+    assert set(reports) == {0.0, 1.0}
+    for rep in reports.values():
+        assert len(rep.cases) == 2
+        assert all(len(c.faults) == 2 for c in rep.cases)

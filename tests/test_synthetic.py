@@ -58,3 +58,37 @@ def test_large_op_ids_do_not_collide():
     assert case.fault_op in svc_ids
     svc = f"svc{case.fault_op:04d}"
     assert (case.abnormal["serviceName"] == svc).any()
+
+
+def test_fault_path_overlap_control():
+    # The two-fault hardness control: chosen fault ops' root-path overlap
+    # must hit the target (0 = disjoint paths, 1 = nested), and the
+    # achieved statistic is recorded on the case.
+    from microrank_tpu.testing.synthetic import path_overlap
+
+    for target in (0.0, 1.0):
+        for seed in range(4):
+            case = generate_case(
+                SyntheticConfig(
+                    n_operations=30, n_traces=20, n_kinds=24,
+                    child_keep_prob=0.6, n_faults=2,
+                    fault_path_overlap=target, seed=seed,
+                )
+            )
+            assert case.fault_overlap == target, (target, seed)
+            (a, _), (b, _) = case.faults
+            assert path_overlap(case.topology.parent, a, b) == target
+
+
+def test_fault_overlap_none_preserves_historical_choice():
+    # fault_path_overlap=None must reproduce the pre-control fault pick
+    # bit-for-bit (fixed-seed cases across the suite depend on it).
+    base = SyntheticConfig(n_operations=24, n_traces=30, seed=7)
+    a = generate_case(base)
+    b = generate_case(
+        SyntheticConfig(
+            n_operations=24, n_traces=30, seed=7, fault_path_overlap=None
+        )
+    )
+    assert a.faults == b.faults
+    assert a.fault_overlap is None  # single fault: no pairwise statistic
