@@ -91,6 +91,35 @@ def cmd_run(args) -> int:
     from ..utils.logging import get_logger
 
     log = get_logger("microrank_tpu.cli")
+
+    primary = True
+    if args.distributed or args.coordinator:
+        # Must precede every other jax touch (config building is safe).
+        from ..parallel.distributed import (
+            initialize_distributed,
+            is_primary,
+        )
+
+        active = initialize_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        if not active and args.distributed:
+            log.warning(
+                "--distributed set but no coordinator configured "
+                "(flag or MICRORANK_COORDINATOR); running single-process"
+            )
+        primary = is_primary()
+        if active:
+            import jax
+
+            log.info(
+                "distributed runtime: process %d/%d, %d global devices",
+                jax.process_index(), jax.process_count(),
+                len(jax.devices()),
+            )
+
     cfg = _config_from_args(args)
 
     engine = args.engine
@@ -100,13 +129,16 @@ def cmd_run(args) -> int:
         engine = "native" if native_available() else "pandas"
     log.info("ingest engine: %s", engine)
 
+    # In a multi-process run every process executes the same pipeline
+    # (the sharded programs are collective); only rank 0 writes results.
+    out_dir = args.output if primary else None
     if engine == "native":
         from ..native import load_span_table
         from ..pipeline import TableRCA
 
         rca = TableRCA(cfg)
         rca.fit_baseline(load_span_table(args.normal))
-        results = rca.run(load_span_table(args.abnormal), out_dir=args.output)
+        results = rca.run(load_span_table(args.abnormal), out_dir=out_dir)
     else:
         from ..io import load_traces_csv
         from ..pipeline import OnlineRCA
@@ -119,8 +151,11 @@ def cmd_run(args) -> int:
             len(abnormal),
         )
         rca = OnlineRCA(cfg)
-        rca.fit_baseline(normal, cache_path=args.slo_cache)
-        results = rca.run(abnormal, out_dir=args.output, resume=args.resume)
+        # Non-primary ranks must not race rank 0 on the shared cache file.
+        rca.fit_baseline(
+            normal, cache_path=args.slo_cache if primary else None
+        )
+        results = rca.run(abnormal, out_dir=out_dir, resume=args.resume)
     n_anom = sum(r.anomaly for r in results)
     log.info(
         "processed %d windows, %d anomalous; results in %s",
@@ -286,6 +321,17 @@ def main(argv=None) -> int:
         choices=["auto", "native", "pandas"],
         help="ingest engine: the C++ span loader or the pandas path",
     )
+    p_run.add_argument(
+        "--distributed", action="store_true",
+        help="join a multi-host jax.distributed runtime before any "
+        "device work (coordinator from --coordinator or "
+        "MICRORANK_COORDINATOR; only process 0 writes results)",
+    )
+    p_run.add_argument(
+        "--coordinator", help='process 0 address, "host:port"'
+    )
+    p_run.add_argument("--num-processes", type=int)
+    p_run.add_argument("--process-id", type=int)
     _add_config_flags(p_run)
     p_run.set_defaults(fn=cmd_run)
 

@@ -1,3 +1,9 @@
+from .distributed import (
+    fetch_replicated,
+    global_put,
+    initialize_distributed,
+    is_primary,
+)
 from .mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh, single_axis_mesh
 from .sharded_rank import (
     rank_windows_batched,
@@ -13,4 +19,8 @@ __all__ = [
     "rank_windows_batched",
     "rank_windows_sharded",
     "stack_window_graphs",
+    "initialize_distributed",
+    "is_primary",
+    "global_put",
+    "fetch_replicated",
 ]

@@ -1,0 +1,128 @@
+"""Multi-host runtime entry (component C19 — the reference has no
+distributed layer at all; SURVEY.md §5 plans `jax.distributed` + XLA
+collectives over ICI/DCN).
+
+One call makes a multi-process deployment real:
+
+    initialize_distributed()        # before ANY other jax use
+    mesh = make_mesh((w, s))        # jax.devices() now spans all hosts
+
+Every process runs the same program; the shard_map/psum ranking code is
+unchanged — XLA compiles the collectives onto ICI within a slice and DCN
+across hosts. Host data becomes global arrays with ``global_put`` (each
+process contributes the shards it addresses), and only process 0 should
+write results (``is_primary``).
+
+Tested two-process on CPU: tests/test_distributed.py spawns two real
+processes that form one 8-device mesh and must rank identically to the
+single-process path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotent `jax.distributed.initialize` wrapper.
+
+    Must run before any other jax API touches a backend. Arguments
+    default from the environment:
+
+    * ``MICRORANK_COORDINATOR``   — "host:port" of process 0
+    * ``MICRORANK_NUM_PROCESSES`` — world size
+    * ``MICRORANK_PROCESS_ID``    — this process's rank
+
+    With none of the three supplied (args or env), this is a no-op
+    returning False — single-process runs never pay for it. With only
+    ``MICRORANK_COORDINATOR`` set, jax's own cluster auto-detection
+    fills the rest (TPU pods, SLURM, etc.). Returns True when a
+    multi-process runtime is active after the call.
+    """
+    global _initialized
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MICRORANK_COORDINATOR")
+    if num_processes is None and "MICRORANK_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MICRORANK_NUM_PROCESSES"])
+    if process_id is None and "MICRORANK_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MICRORANK_PROCESS_ID"])
+
+    if _initialized:
+        return jax.process_count() > 1
+    if coordinator_address is None and num_processes is None:
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def is_primary() -> bool:
+    """True on the process that should write results (rank 0)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_put(tree, mesh, specs):
+    """Replicated host data -> global jax.Arrays over a (possibly
+    multi-process) mesh.
+
+    Every process is expected to hold the SAME full host arrays (the
+    deterministic graph build makes this natural: each host ingests the
+    same window and builds the same arrays); each contributes exactly
+    the shards its local devices address via
+    ``jax.make_array_from_callback``. Single-process meshes work too —
+    this is then equivalent to a sharded ``jax.device_put``.
+
+    ``tree``/``specs`` are matching pytrees (e.g. a stacked WindowGraph
+    and the PartitionSpec tree from ``sharded_rank._partition_specs``).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    def put(x, spec):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    return jax.tree.map(put, tree, specs)
+
+
+def fetch_replicated(tree):
+    """Device results -> host numpy on every process.
+
+    Arrays sharded across processes (e.g. ranking outputs split over the
+    ``windows`` axis) are allgathered so every process sees the full
+    value; fully-addressable arrays (replicated outputs, or any
+    single-process array) are plain device_gets — process_allgather
+    would wrongly STACK a replicated array once per process.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def fetch(x):
+        if getattr(x, "is_fully_addressable", True):
+            return jax.device_get(x)
+        return multihost_utils.process_allgather(x, tiled=True)
+
+    return jax.tree.map(fetch, tree)

@@ -11,8 +11,12 @@ The framework's scaling axes map onto a 2D logical mesh:
   On a TPU slice the psum rides ICI; across slices, DCN — both compiled by
   XLA from the same program (no NCCL/MPI analogue needed).
 
-Multi-host: call ``jax.distributed.initialize()`` before building the mesh
-and pass ``jax.devices()`` spanning all hosts; the code is identical.
+Multi-host: ``parallel.distributed.initialize_distributed()`` (env- or
+flag-driven ``jax.distributed.initialize`` — `cli run --distributed`)
+before building the mesh; ``jax.devices()`` then spans all hosts and the
+ranking code is identical. Proven by a real two-process CPU-mesh test
+(tests/test_distributed.py) that must rank bit-identically to the
+single-process path.
 """
 
 from __future__ import annotations

@@ -51,6 +51,13 @@ class TableRCA:
             shape = tuple(config.runtime.mesh_shape)
             if len(shape) == 1:  # pure graph parallelism
                 shape = (1, shape[0])
+            if shape[0] != 1:
+                raise ValueError(
+                    "TableRCA ranks one window per dispatch; use a 1D "
+                    f"(N,) / (1, N) mesh_shape, not {shape} — the "
+                    "windows axis belongs to rank_windows_batched/"
+                    "rank_windows_sharded batch calls"
+                )
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
             if config.runtime.kernel not in ("auto", "coo", "csr"):
@@ -107,8 +114,28 @@ class TableRCA:
 
             shard_n = int(self._mesh.devices.shape[1])
             stacked = stack_window_graphs([graph], shard_multiple=shard_n)
+            if jax.process_count() > 1:
+                # Multi-host mesh: every process built the same host
+                # arrays (deterministic build over the same window);
+                # each contributes the shards its devices address.
+                from ..graph.structures import WindowGraph
+                from ..parallel.distributed import global_put
+                from ..parallel.sharded_rank import (
+                    SHARD_AXIS,
+                    WINDOW_AXIS,
+                    _partition_specs,
+                )
+
+                pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
+                batched = global_put(
+                    stacked,
+                    self._mesh,
+                    WindowGraph(normal=pspecs, abnormal=pspecs),
+                )
+            else:
+                batched = jax.device_put(stacked)
             ti, ts, nv = rank_windows_sharded(
-                jax.device_put(stacked),
+                batched,
                 cfg.pagerank,
                 cfg.spectrum,
                 self._mesh,
@@ -135,9 +162,13 @@ class TableRCA:
 
         One batched ``jax.device_get`` — per-buffer fetches each pay a full
         RPC round trip on tunneled-TPU runtimes (~78 ms apiece measured),
-        so never convert device scalars/arrays piecemeal on this path."""
+        so never convert device scalars/arrays piecemeal on this path.
+        Multi-host runs route through fetch_replicated (allgather of any
+        process-spanning shards)."""
+        from ..parallel.distributed import fetch_replicated
+
         top_idx, top_scores, n_valid, op_names = handles
-        top_idx, top_scores, n_valid = jax.device_get(
+        top_idx, top_scores, n_valid = fetch_replicated(
             (top_idx, top_scores, n_valid)
         )
         n = int(n_valid)

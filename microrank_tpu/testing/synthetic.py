@@ -108,11 +108,19 @@ def _pick_faults(
         return [(int(op), int(rng.integers(0, n_pods))) for op in fault_ops]
 
     cand = [int(c) for c in candidates]
+    # Root paths once per candidate — the pair loop below is O(n^2) pair
+    # set-intersections, not O(n^2 * depth) parent-pointer walks.
+    paths = {c: _root_path(topo.parent, c) for c in cand}
+
+    def overlap(a: int, b: int) -> float:
+        pa, pb = paths[a], paths[b]
+        return len(pa & pb) / max(min(len(pa), len(pb)), 1)
+
     pairs = [
         (a, b) for i, a in enumerate(cand) for b in cand[i + 1:]
     ]
     dev = np.array(
-        [abs(path_overlap(topo.parent, a, b) - target_overlap) for a, b in pairs]
+        [abs(overlap(a, b) - target_overlap) for a, b in pairs]
     )
     best = np.flatnonzero(dev == dev.min())
     chosen = list(pairs[int(rng.choice(best))])
@@ -121,11 +129,7 @@ def _pick_faults(
         devs = np.array(
             [
                 abs(
-                    float(
-                        np.mean(
-                            [path_overlap(topo.parent, c, x) for x in chosen]
-                        )
-                    )
+                    float(np.mean([overlap(c, x) for x in chosen]))
                     - target_overlap
                 )
                 for c in remaining

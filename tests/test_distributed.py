@@ -1,0 +1,160 @@
+"""Multi-host distribution (component C19): a REAL two-process CPU mesh.
+
+The worker (two_process_rank_worker.py) joins a jax.distributed runtime
+(Gloo collectives on CPU — the same initialize + mesh + shard_map path a
+TPU pod uses over ICI/DCN), forms one global (2, 4) mesh across both
+processes' 4 local devices each, and ranks the same four windows the
+single-process sharded tests use. Both processes must produce the full
+batch result, equal to the single-process ranking.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.graph import build_window_graph
+from microrank_tpu.parallel import (
+    make_mesh,
+    rank_windows_sharded,
+    stack_window_graphs,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+_WORKER = Path(__file__).parent / "two_process_rank_worker.py"
+
+
+def test_initialize_is_noop_without_config():
+    # No coordinator/env configured -> no side effects, False.
+    from microrank_tpu.parallel.distributed import initialize_distributed
+
+    assert initialize_distributed() is False
+    assert jax.process_count() == 1
+
+
+def test_global_put_single_process_equals_device_put():
+    # global_put on a single-process mesh is a sharded device_put.
+    from microrank_tpu.graph.structures import WindowGraph
+    from microrank_tpu.parallel.distributed import global_put
+    from microrank_tpu.parallel.sharded_rank import (
+        SHARD_AXIS,
+        WINDOW_AXIS,
+        _partition_specs,
+    )
+
+    case = generate_case(SyntheticConfig(n_operations=20, n_traces=100, seed=1))
+    nrm, abn = partition_case(case)
+    graph, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+    stacked = stack_window_graphs([graph, graph], shard_multiple=4)
+    mesh = make_mesh((2, 4))
+    pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
+    specs = WindowGraph(normal=pspecs, abnormal=pspecs)
+    put = global_put(stacked, mesh, specs)
+    for a, b in zip(jax.tree.leaves(put), jax.tree.leaves(stacked)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.skipif(
+    os.environ.get("MICRORANK_SKIP_MULTIPROCESS") == "1",
+    reason="multi-process test disabled",
+)
+def test_two_process_mesh_ranks_like_single_process(tmp_path):
+    # Expected result: the in-process (2, 4) sharded ranking.
+    cfg = MicroRankConfig()
+    graphs = []
+    for seed in (1, 2, 3, 4):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        graph, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+        graphs.append(graph)
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(graphs, shard_multiple=4)
+    sti, _, snv = rank_windows_sharded(
+        jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum, mesh
+    )
+    expected_idx = np.asarray(sti)
+    expected_nv = np.asarray(snv)
+
+    # Shared tables for the full-pipeline (TableRCA) leg of the worker.
+    pytest.importorskip("microrank_tpu.native")
+    from microrank_tpu.native import load_span_table, native_available
+    from microrank_tpu.pipeline import TableRCA
+    from microrank_tpu.config import RuntimeConfig
+
+    table_dir = None
+    expected_table = None
+    if native_available():
+        tcase = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=120, seed=5,
+                            n_kinds=24, child_keep_prob=0.6)
+        )
+        table_dir = tmp_path / "tables"
+        table_dir.mkdir()
+        tcase.normal.to_csv(table_dir / "n.csv", index=False)
+        tcase.abnormal.to_csv(table_dir / "a.csv", index=False)
+        single = TableRCA(
+            MicroRankConfig(runtime=RuntimeConfig(mesh_shape=(8,)))
+        )
+        single.fit_baseline(load_span_table(table_dir / "n.csv"))
+        expected_table = [
+            [n for n, _ in r.ranking] if r.ranking else None
+            for r in single.run(load_span_table(table_dir / "a.csv"))
+        ]
+
+    # Two real processes, 4 virtual CPU devices each, one Gloo runtime.
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    procs = []
+    outs = []
+    for pid in (0, 1):
+        out = tmp_path / f"worker_{pid}.json"
+        outs.append(out)
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(Path(__file__).parent.parent),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "MICRORANK_COORDINATOR": f"localhost:{port}",
+            "MICRORANK_NUM_PROCESSES": "2",
+            "MICRORANK_PROCESS_ID": str(pid),
+        }
+        cmd = [sys.executable, str(_WORKER), str(out)]
+        if table_dir is not None:
+            cmd.append(str(table_dir))
+        procs.append(
+            subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    logs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, log_text in zip(procs, logs):
+        assert p.returncode == 0, log_text[-2000:]
+
+    for pid, out in enumerate(outs):
+        res = json.loads(out.read_text())
+        assert res["process_index"] == pid
+        assert res["is_primary"] == (pid == 0)
+        # Every process sees the FULL batch (allgathered), identical to
+        # the single-process sharded ranking.
+        np.testing.assert_array_equal(np.asarray(res["top_idx"]), expected_idx)
+        np.testing.assert_array_equal(np.asarray(res["n_valid"]), expected_nv)
+        # The full TableRCA pipeline over the process-spanning mesh must
+        # rank exactly like the single-process (1, 8) mesh.
+        if expected_table is not None:
+            assert res["table_rankings"] == expected_table

@@ -1,0 +1,112 @@
+"""Worker for tests/test_distributed.py: one process of a two-process
+CPU mesh (not collected by pytest — no test_ prefix).
+
+Each process: join the distributed runtime (env-driven), build the SAME
+four window graphs deterministically, form one global (2, 4) mesh over
+both processes' devices, rank via the unchanged shard_map/psum program,
+allgather, and dump the full result to JSON. The driver asserts both
+processes' dumps equal the single-process ranking.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    out_path = sys.argv[1]
+
+    from microrank_tpu.parallel.distributed import (
+        fetch_replicated,
+        initialize_distributed,
+        is_primary,
+    )
+
+    active = initialize_distributed()
+    assert active, "distributed runtime did not come up"
+
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.detect import compute_slo, detect_numpy
+    from microrank_tpu.graph import build_detect_batch, build_window_graph
+    from microrank_tpu.parallel import make_mesh, stack_window_graphs
+    from microrank_tpu.parallel.distributed import global_put
+    from microrank_tpu.parallel.sharded_rank import (
+        SHARD_AXIS,
+        WINDOW_AXIS,
+        _partition_specs,
+        rank_windows_sharded,
+    )
+    from microrank_tpu.graph.structures import WindowGraph
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+
+    cfg = MicroRankConfig()
+    graphs = []
+    for seed in (1, 2, 3, 4):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        vocab, baseline = compute_slo(case.normal)
+        batch, tids = build_detect_batch(case.abnormal, vocab)
+        det = detect_numpy(batch, baseline, cfg.detector)
+        abn = [t for t, a in zip(tids, det.abnormal) if a]
+        nrm = [
+            t
+            for t, a, v in zip(tids, det.abnormal, det.valid)
+            if v and not a
+        ]
+        graph, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+        graphs.append(graph)
+
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(graphs, shard_multiple=4)
+    pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
+    specs = WindowGraph(normal=pspecs, abnormal=pspecs)
+    batched = global_put(stacked, mesh, specs)
+
+    top_idx, top_scores, n_valid = rank_windows_sharded(
+        batched, cfg.pagerank, cfg.spectrum, mesh
+    )
+    top_idx, n_valid = fetch_replicated((top_idx, n_valid))
+    result = {
+        "process_index": int(jax.process_index()),
+        "is_primary": bool(is_primary()),
+        "top_idx": np.asarray(top_idx).tolist(),
+        "n_valid": np.asarray(n_valid).tolist(),
+    }
+
+    # Full pipeline over the same distributed mesh: TableRCA with a
+    # process-spanning (1, 8) mesh (global_put staging + allgather
+    # fetch) over a shared CSV pair written by the test driver.
+    table_dir = sys.argv[2] if len(sys.argv) > 2 else None
+    if table_dir:
+        from microrank_tpu.config import RuntimeConfig
+        from microrank_tpu.native import load_span_table
+        from microrank_tpu.pipeline import TableRCA
+
+        tcfg = MicroRankConfig(runtime=RuntimeConfig(mesh_shape=(8,)))
+        rca = TableRCA(tcfg)
+        # cache=False: two processes must not race on the sidecar file.
+        rca.fit_baseline(
+            load_span_table(os.path.join(table_dir, "n.csv"), cache=False)
+        )
+        records = rca.run(
+            load_span_table(os.path.join(table_dir, "a.csv"), cache=False)
+        )
+        result["table_rankings"] = [
+            [n for n, _ in r.ranking] if r.ranking else None
+            for r in records
+        ]
+
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
