@@ -129,17 +129,35 @@ def cmd_run(args) -> int:
         engine = "native" if native_available() else "pandas"
     log.info("ingest engine: %s", engine)
 
-    # In a multi-process run every process executes the same pipeline
-    # (the sharded programs are collective); only rank 0 writes results.
+    import jax
+
+    multiprocess = jax.process_count() > 1
+    # In a multi-process run every process executes the same pipeline —
+    # the sharded TableRCA programs are collective; only rank 0 writes
+    # results (and caches: concurrent ranks must not race shared files).
     out_dir = args.output if primary else None
     if engine == "native":
         from ..native import load_span_table
         from ..pipeline import TableRCA
 
         rca = TableRCA(cfg)
-        rca.fit_baseline(load_span_table(args.normal))
-        results = rca.run(load_span_table(args.abnormal), out_dir=out_dir)
+        rca.fit_baseline(load_span_table(args.normal, cache=primary))
+        results = rca.run(
+            load_span_table(args.abnormal, cache=primary), out_dir=out_dir
+        )
+    elif multiprocess and not primary:
+        # The pandas pipeline has no collectives — duplicating it on
+        # every rank buys nothing and non-primary ranks would drop
+        # --resume (no cursor without an out_dir). Idle here.
+        log.info("pandas engine is single-process; rank idle")
+        return 0
     else:
+        if multiprocess:
+            log.warning(
+                "pandas engine does not shard; running on the primary "
+                "rank only (use --engine native with a mesh to "
+                "distribute)"
+            )
         from ..io import load_traces_csv
         from ..pipeline import OnlineRCA
 

@@ -21,10 +21,13 @@
 // array-for-array interchangeable.
 
 #include <algorithm>
+#include <chrono>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <new>
+#include <optional>
 #include <system_error>
 #include <thread>
 #include <unordered_map>
@@ -40,6 +43,32 @@ inline uint64_t splitmix64(uint64_t x) {
   x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
   return x ^ (x >> 31);
 }
+
+// MR_BUILD_PROFILE=1 prints per-phase wall times to stderr — the
+// profiling hook behind DESIGN.md's build-cost numbers.
+bool profile_enabled() {
+  static const bool on = [] {
+    const char* env = std::getenv("MR_BUILD_PROFILE");
+    return env && env[0] == '1';
+  }();
+  return on;
+}
+
+struct PhaseTimer {
+  const char* name;
+  std::chrono::steady_clock::time_point start;
+  explicit PhaseTimer(const char* n)
+      : name(n), start(std::chrono::steady_clock::now()) {}
+  ~PhaseTimer() {
+    if (profile_enabled()) {
+      const double ms =
+          std::chrono::duration<double, std::milli>(
+              std::chrono::steady_clock::now() - start)
+              .count();
+      std::fprintf(stderr, "[mr_build] %-12s %8.2f ms\n", name, ms);
+    }
+  }
+};
 
 struct BuiltPartition {
   std::vector<int32_t> inc_op, inc_trace;
@@ -66,12 +95,29 @@ struct MrBuiltWindow {
 
 namespace {
 
+// Above this vocab size the per-partition edge bitmap (vocab^2 bits)
+// would exceed 32 MB; fall back to the instance-list counting sorts.
+// MR_EDGE_BITMAP_MAX_VOCAB overrides (tests force the fallback with 0).
+int64_t edge_bitmap_max_vocab() {
+  // Re-read per build (a handful of getenv calls) so tests can toggle
+  // the fallback without a fresh process.
+  if (const char* env = std::getenv("MR_EDGE_BITMAP_MAX_VOCAB"))
+    return static_cast<int64_t>(std::atoll(env));
+  return 16384;
+}
+
 // Scratch accumulated for one partition during the fused scans.
 struct PartScratch {
   std::vector<int32_t> counts_global;  // [n_total_traces] span counts
   std::vector<int32_t> cov_dup;        // [vocab]
   std::vector<int32_t> outdeg_dup;     // [vocab]
-  std::vector<int32_t> edge_child;     // call-edge instances
+  // Unique call edges, deduplicated AT SCAN TIME: bit key
+  // child*vocab+parent in a child-major bitmap, so the ordered word
+  // scan in finish_partition emits (child asc, parent asc) directly —
+  // no instance lists, no counting sorts. Empty when vocab is past the
+  // bitmap budget; the legacy instance lists below are used instead.
+  std::vector<uint64_t> edge_bits;
+  std::vector<int32_t> edge_child;     // call-edge instances (fallback)
   std::vector<int32_t> edge_parent;
   std::vector<int32_t> local_id;       // [n_total_traces] global -> local
   std::vector<int64_t> tr_off;         // [n_traces+1] bucket offsets
@@ -79,48 +125,141 @@ struct PartScratch {
   int64_t n_p = 0;
 };
 
+// Worker count for the intra-partition trace chunks: the hardware
+// concurrency (this scales the 4M-span build on real multi-core TPU
+// hosts; a 1-core container just runs the serial path), overridable via
+// MR_BUILD_THREADS for testing the chunked code on any box.
+int build_threads() {
+  // Re-read per call so tests can exercise the chunked path without a
+  // fresh process; the cost is a few getenv calls per window build.
+  if (const char* env = std::getenv("MR_BUILD_THREADS")) {
+    const long v = std::atol(env);
+    if (v >= 1 && v <= 64) return static_cast<int>(v);
+  }
+  const unsigned hw = std::thread::hardware_concurrency();
+  return static_cast<int>(std::max(1u, std::min(hw, 16u)));
+}
+
+// Run fn(lo, hi) over [0, n) split into k contiguous chunks with
+// boundaries chosen so each chunk covers ~equal WEIGHT (weights given by
+// the monotone prefix array `prefix` of length n+1). k==1 short-circuits
+// to a plain call; worker exceptions surface as bad_alloc.
+template <typename Fn>
+void parallel_chunks(int64_t n, const int64_t* prefix, int k, Fn fn) {
+  if (n <= 0) return;
+  if (k <= 1 || n < 2 * k) {
+    fn(static_cast<int64_t>(0), n);
+    return;
+  }
+  const int64_t total = prefix[n];
+  std::vector<int64_t> bounds(k + 1, 0);
+  bounds[k] = n;
+  for (int i = 1; i < k; ++i) {
+    const int64_t target = total * i / k;
+    // first index whose prefix exceeds target
+    const int64_t* it = std::upper_bound(prefix, prefix + n + 1, target);
+    bounds[i] = std::min<int64_t>(it - prefix - 1, n);
+  }
+  for (int i = 1; i <= k; ++i) bounds[i] = std::max(bounds[i], bounds[i - 1]);
+  std::vector<std::thread> pool;
+  std::vector<uint8_t> failed(k, 0);
+  pool.reserve(k - 1);
+  for (int i = 1; i < k; ++i) {
+    pool.emplace_back([&, i] {
+      try {
+        fn(bounds[i], bounds[i + 1]);
+      } catch (...) {
+        failed[i] = 1;
+      }
+    });
+  }
+  try {
+    fn(bounds[0], bounds[1]);
+  } catch (...) {
+    failed[0] = 1;
+  }
+  for (auto& th : pool) th.join();
+  for (int i = 0; i < k; ++i)
+    if (failed[i]) throw std::bad_alloc();
+}
+
 void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
   const int64_t n_traces = static_cast<int64_t>(out->local_uniques.size());
   auto& tracelen = out->tracelen;
   const std::vector<int64_t>& tr_off = sc.tr_off;
   std::vector<int32_t>& by_trace_op = sc.by_trace_op;
 
-  // Sort + dedup each trace group -> unique incidence; kind hash inline.
+  // Pass 1 — per-trace sort + IN-PLACE dedup + kind hash. Buckets are
+  // disjoint, so trace chunks run on the thread pool (chunk boundaries
+  // balanced by span counts via tr_off; the per-trace sorts are the
+  // single-core hot spot at the 4M-span scale).
+  std::vector<int32_t> n_uniq(n_traces, 0);
+  std::vector<uint64_t> trace_hash(n_traces, 0);
+  // RAII phase scopes: .emplace() prints the previous phase (destructor)
+  // and starts the next; unwinding destroys the active one.
+  std::optional<PhaseTimer> tm;
+  if (profile_enabled()) tm.emplace("sort+dedup");
+  parallel_chunks(
+      n_traces, tr_off.data(), build_threads(),
+      [&](int64_t t0, int64_t t1) {
+        for (int64_t t = t0; t < t1; ++t) {
+          int32_t* b = by_trace_op.data() + tr_off[t];
+          int32_t* e = by_trace_op.data() + tr_off[t + 1];
+          std::sort(b, e);
+          int32_t* w = b;
+          int32_t prev = -1;
+          uint64_t h = 0;
+          for (int32_t* p = b; p < e; ++p) {
+            if (*p == prev) continue;
+            prev = *p;
+            *w++ = *p;
+            h += splitmix64(static_cast<uint64_t>(*p));
+          }
+          const int64_t uq = w - b;
+          n_uniq[t] = static_cast<int32_t>(uq);
+          trace_hash[t] =
+              h ^ splitmix64(static_cast<uint64_t>(tracelen[t])) ^
+              splitmix64(static_cast<uint64_t>(uq) + 0x51ED270B9ULL);
+        }
+      });
+
+  if (profile_enabled()) tm.emplace("emit");
+
+  // Pass 2 — one serial emit of the unique incidence; rs_val is fused in
+  // (cov_dup is final after the stats scan, so 1/cov needs no extra pass).
   auto& inc_op = out->inc_op;
   auto& inc_trace = out->inc_trace;
   auto& sr_val = out->sr_val;
+  auto& rs_val = out->rs_val;
   out->cov_unique.assign(vocab, 0);
   auto& cov_unique = out->cov_unique;
   std::vector<int64_t> u_start(n_traces + 1, 0);
-  std::vector<uint64_t> trace_hash(n_traces, 0);
-  inc_op.reserve(sc.n_p);
-  inc_trace.reserve(sc.n_p);
-  sr_val.reserve(sc.n_p);
-  for (int64_t t = 0; t < n_traces; ++t) {
-    int32_t* b = by_trace_op.data() + tr_off[t];
-    int32_t* e = by_trace_op.data() + tr_off[t + 1];
-    std::sort(b, e);
-    const float inv_len = 1.0f / static_cast<float>(tracelen[t]);
-    int32_t prev = -1;
-    uint64_t h = 0;
-    for (int32_t* p = b; p < e; ++p) {
-      if (*p == prev) continue;
-      prev = *p;
-      inc_op.push_back(*p);
-      inc_trace.push_back(static_cast<int32_t>(t));
-      sr_val.push_back(inv_len);
-      ++cov_unique[*p];
-      h += splitmix64(static_cast<uint64_t>(*p));
-    }
-    const int64_t n_uniq = static_cast<int64_t>(inc_op.size()) - u_start[t];
-    u_start[t + 1] = static_cast<int64_t>(inc_op.size());
-    trace_hash[t] = h ^ splitmix64(static_cast<uint64_t>(tracelen[t])) ^
-                    splitmix64(static_cast<uint64_t>(n_uniq) + 0x51ED270B9ULL);
-  }
-  const int64_t n_inc = static_cast<int64_t>(inc_op.size());
-  out->rs_val.resize(n_inc);
-  for (int64_t i = 0; i < n_inc; ++i)
-    out->rs_val[i] = 1.0f / static_cast<float>(sc.cov_dup[inc_op[i]]);
+  for (int64_t t = 0; t < n_traces; ++t)
+    u_start[t + 1] = u_start[t] + n_uniq[t];
+  const int64_t n_inc = u_start[n_traces];
+  inc_op.resize(n_inc);
+  inc_trace.resize(n_inc);
+  sr_val.resize(n_inc);
+  rs_val.resize(n_inc);
+  parallel_chunks(
+      n_traces, u_start.data(), build_threads(),
+      [&](int64_t t0, int64_t t1) {
+        for (int64_t t = t0; t < t1; ++t) {
+          const int32_t* b = by_trace_op.data() + tr_off[t];
+          const float inv_len = 1.0f / static_cast<float>(tracelen[t]);
+          int64_t w = u_start[t];
+          for (int32_t j = 0; j < n_uniq[t]; ++j, ++w) {
+            const int32_t op = b[j];
+            inc_op[w] = op;
+            inc_trace[w] = static_cast<int32_t>(t);
+            sr_val[w] = inv_len;
+            rs_val[w] = 1.0f / static_cast<float>(sc.cov_dup[op]);
+          }
+        }
+      });
+  // cov_unique is a vocab-sized histogram of the unique incidence — one
+  // serial pass (racy if chunked without per-thread copies).
+  for (int64_t i = 0; i < n_inc; ++i) ++cov_unique[inc_op[i]];
   out->op_present.assign(vocab, 0);
   for (int64_t o = 0; o < vocab; ++o)
     if (cov_unique[o] > 0) {
@@ -128,46 +267,71 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
       ++out->n_ops;
     }
 
-  // Unique call edges via two-pass stable counting sort of the collected
-  // (child, parent) instances: by parent, then by child — the resulting
-  // (child asc, parent asc) order matches the numpy lane's packed-key
-  // np.unique.
-  const int64_t m_p = static_cast<int64_t>(sc.edge_child.size());
-  std::vector<int64_t> par_off(vocab + 1, 0);
-  for (int64_t p = 0; p < m_p; ++p) ++par_off[sc.edge_parent[p] + 1];
-  for (int64_t o = 0; o < vocab; ++o) par_off[o + 1] += par_off[o];
-  std::vector<int64_t> pcur(par_off.begin(), par_off.end());
-  std::vector<int32_t> by_parent_child(m_p);
-  for (int64_t p = 0; p < m_p; ++p)
-    by_parent_child[pcur[sc.edge_parent[p]]++] = sc.edge_child[p];
-  std::vector<int64_t> ch_off(vocab + 1, 0);
-  for (int64_t p = 0; p < m_p; ++p) ++ch_off[by_parent_child[p] + 1];
-  for (int64_t o = 0; o < vocab; ++o) ch_off[o + 1] += ch_off[o];
-  std::vector<int64_t> ccur(ch_off.begin(), ch_off.end());
-  std::vector<int32_t> by_child_parent(m_p);
-  {
-    int64_t par = 0;
-    for (int64_t p = 0; p < m_p; ++p) {
-      while (p >= par_off[par + 1]) ++par;
-      by_child_parent[ccur[by_parent_child[p]]++] = static_cast<int32_t>(par);
-    }
-  }
-  {
-    int64_t child = 0;
-    int32_t prev_parent = -1;
-    for (int64_t p = 0; p < m_p; ++p) {
-      while (p >= ch_off[child + 1]) {
-        ++child;
-        prev_parent = -1;
+  if (profile_enabled()) tm.emplace("edges");
+
+  if (!sc.edge_bits.empty()) {
+    // Edges were deduplicated at scan time into the child-major bitmap;
+    // an ascending word/bit scan IS (child asc, parent asc) order —
+    // matching the numpy lane's packed-key np.unique.
+    const int64_t n_words = static_cast<int64_t>(sc.edge_bits.size());
+    for (int64_t w = 0; w < n_words; ++w) {
+      uint64_t bits = sc.edge_bits[w];
+      while (bits) {
+        const int b = __builtin_ctzll(bits);
+        bits &= bits - 1;
+        const int64_t key = (w << 6) | b;
+        const int32_t child = static_cast<int32_t>(key / vocab);
+        const int32_t par = static_cast<int32_t>(key % vocab);
+        out->ss_child.push_back(child);
+        out->ss_parent.push_back(par);
+        out->ss_val.push_back(1.0f /
+                              static_cast<float>(sc.outdeg_dup[par]));
       }
-      const int32_t par = by_child_parent[p];
-      if (par == prev_parent) continue;
-      prev_parent = par;
-      out->ss_child.push_back(static_cast<int32_t>(child));
-      out->ss_parent.push_back(par);
-      out->ss_val.push_back(1.0f / static_cast<float>(sc.outdeg_dup[par]));
+    }
+  } else {
+    // Fallback (vocab past the bitmap budget): two-pass stable counting
+    // sort of the (child, parent) instances — by parent, then by child.
+    const int64_t m_p = static_cast<int64_t>(sc.edge_child.size());
+    std::vector<int64_t> par_off(vocab + 1, 0);
+    for (int64_t p = 0; p < m_p; ++p) ++par_off[sc.edge_parent[p] + 1];
+    for (int64_t o = 0; o < vocab; ++o) par_off[o + 1] += par_off[o];
+    std::vector<int64_t> pcur(par_off.begin(), par_off.end());
+    std::vector<int32_t> by_parent_child(m_p);
+    for (int64_t p = 0; p < m_p; ++p)
+      by_parent_child[pcur[sc.edge_parent[p]]++] = sc.edge_child[p];
+    std::vector<int64_t> ch_off(vocab + 1, 0);
+    for (int64_t p = 0; p < m_p; ++p) ++ch_off[by_parent_child[p] + 1];
+    for (int64_t o = 0; o < vocab; ++o) ch_off[o + 1] += ch_off[o];
+    std::vector<int64_t> ccur(ch_off.begin(), ch_off.end());
+    std::vector<int32_t> by_child_parent(m_p);
+    {
+      int64_t par = 0;
+      for (int64_t p = 0; p < m_p; ++p) {
+        while (p >= par_off[par + 1]) ++par;
+        by_child_parent[ccur[by_parent_child[p]]++] =
+            static_cast<int32_t>(par);
+      }
+    }
+    {
+      int64_t child = 0;
+      int32_t prev_parent = -1;
+      for (int64_t p = 0; p < m_p; ++p) {
+        while (p >= ch_off[child + 1]) {
+          ++child;
+          prev_parent = -1;
+        }
+        const int32_t par = by_child_parent[p];
+        if (par == prev_parent) continue;
+        prev_parent = par;
+        out->ss_child.push_back(static_cast<int32_t>(child));
+        out->ss_parent.push_back(par);
+        out->ss_val.push_back(1.0f /
+                              static_cast<float>(sc.outdeg_dup[par]));
+      }
     }
   }
+
+  if (profile_enabled()) tm.emplace("kinds");
 
   // Trace kinds: two traces are one kind iff identical unique-op sequence
   // AND identical span count (== p_sr-column equality, pagerank.py:54-66).
@@ -225,12 +389,27 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
       part_bit[t] =
           static_cast<uint8_t>((normal_flag[t] != 0) | ((abnormal_flag[t] != 0) << 1));
 
+    // Bitmap-vs-instance-list choice: the bitmap wins when its memset
+    // (vocab^2/64 words per partition) is small next to the rows it
+    // dedups — a small masked window over a big table vocab must NOT
+    // pay a fixed multi-MB clear per build, so require the word count
+    // to stay within 8x the effective row count.
+    int64_t n_eff = n_rows;
+    if (row_mask) {
+      n_eff = 0;
+      for (int64_t r = 0; r < n_rows; ++r) n_eff += row_mask[r] != 0;
+    }
+    const int64_t bitmap_words = (vocab_size * vocab_size + 63) / 64;
+    const bool edge_bitmap = vocab_size <= edge_bitmap_max_vocab() &&
+                             bitmap_words <= n_eff * 8;
     PartScratch sc[2];
     for (PartScratch& s : sc) {
       s.counts_global.assign(n_total_traces, 0);
       s.cov_dup.assign(vocab_size, 0);
       s.outdeg_dup.assign(vocab_size, 0);
-      if (!row_mask) {  // full-table builds: edges ~ rows; windows grow
+      if (edge_bitmap) {
+        s.edge_bits.assign(static_cast<size_t>(bitmap_words), 0);
+      } else if (!row_mask) {  // full-table: edges ~ rows; windows grow
         s.edge_child.reserve(n_rows / 2);
         s.edge_parent.reserve(n_rows / 2);
       }
@@ -240,13 +419,41 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
     // counts, per-op duplicate coverage, and call-edge instances
     // (preprocess_data.py:157-158 linkage: child row in the partition,
     // parent span inside the window, parent's trace in the partition).
+    std::optional<PhaseTimer> tm_scan;
+    if (profile_enabled()) tm_scan.emplace("stats-scan");
     for (int64_t r = 0; r < n_rows; ++r) {
       if (row_mask && !row_mask[r]) continue;
-      const uint8_t code = part_bit[trace_id[r]];
-      if (!code) continue;
       const int32_t t = trace_id[r];
+      const uint8_t code = part_bit[t];
+      if (!code) continue;
       const int32_t op = pod_op[r];
       const int64_t pr = parent_row[r];
+      const auto record_edge = [&](PartScratch& s, int32_t child,
+                                   int32_t parent) {
+        ++s.outdeg_dup[parent];
+        if (edge_bitmap) {
+          const uint64_t key =
+              static_cast<uint64_t>(child) * vocab_size + parent;
+          s.edge_bits[key >> 6] |= 1ull << (key & 63);
+        } else {
+          s.edge_child.push_back(child);
+          s.edge_parent.push_back(parent);
+        }
+      };
+      if (code != 3) {
+        // The common case: detection partitions are disjoint, so a row
+        // belongs to exactly one partition — no inner loop.
+        PartScratch& s = sc[code >> 1];
+        ++s.counts_global[t];
+        ++s.cov_dup[op];
+        ++s.n_p;
+        if (pr >= 0 && (!row_mask || row_mask[pr]) &&
+            (part_bit[trace_id[pr]] & code)) {
+          record_edge(s, op, pod_op[pr]);
+        }
+        continue;
+      }
+      // Rare: a caller listed the trace in BOTH partitions.
       uint8_t ecode = 0;
       int32_t pop = 0;
       if (pr >= 0 && (!row_mask || row_mask[pr])) {
@@ -254,18 +461,15 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
         pop = pod_op[pr];
       }
       for (int i = 0; i < 2; ++i) {
-        if (!(code & (1 << i))) continue;
         PartScratch& s = sc[i];
         ++s.counts_global[t];
         ++s.cov_dup[op];
         ++s.n_p;
-        if (ecode & (1 << i)) {
-          ++s.outdeg_dup[pop];
-          s.edge_child.push_back(op);
-          s.edge_parent.push_back(pop);
-        }
+        if (ecode & (1 << i)) record_edge(s, op, pop);
       }
     }
+
+    if (profile_enabled()) tm_scan.emplace("scatter");
 
     // Local trace interning in ascending global-id order (np.unique
     // order), then ONE more scan bucket-scatters both partitions' ops by
@@ -302,29 +506,15 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
       }
     }
 
-    // The two partitions' finishing work (per-trace sorts, edge dedup,
-    // kind grouping) is independent — overlap it on two threads. The
-    // worker catches everything and the main-thread call is guarded so
-    // the thread is ALWAYS joined before any rethrow (a joinable
-    // std::thread destroyed during unwinding calls std::terminate).
-    {
-      bool worker_failed = false;
-      bool main_failed = false;
-      std::thread other([&] {
-        try {
-          finish_partition(sc[1], vocab_size, &g->parts[1]);
-        } catch (...) {
-          worker_failed = true;
-        }
-      });
-      try {
-        finish_partition(sc[0], vocab_size, &g->parts[0]);
-      } catch (...) {
-        main_failed = true;
-      }
-      other.join();
-      if (worker_failed || main_failed) throw std::bad_alloc();
-    }
+    tm_scan.reset();
+
+    // Finish the partitions sequentially: each call parallelizes ACROSS
+    // its trace chunks (parallel_chunks), which balances arbitrarily
+    // skewed partitions — the old one-thread-per-partition overlap
+    // bought nothing when one partition held 40x the entries (the usual
+    // detection outcome).
+    finish_partition(sc[0], vocab_size, &g->parts[0]);
+    finish_partition(sc[1], vocab_size, &g->parts[1]);
   } catch (const std::bad_alloc&) {
     delete g;
     return nullptr;

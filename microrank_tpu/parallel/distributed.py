@@ -58,7 +58,17 @@ def initialize_distributed(
 
     if _initialized:
         return jax.process_count() > 1
-    if coordinator_address is None and num_processes is None:
+    if coordinator_address is None:
+        if num_processes is not None or process_id is not None:
+            # Partial config (e.g. a leftover MICRORANK_NUM_PROCESSES):
+            # keep the documented graceful fallback instead of letting
+            # jax.distributed.initialize raise on a missing coordinator.
+            from ..utils.logging import get_logger
+
+            get_logger("microrank_tpu.parallel").warning(
+                "distributed config incomplete (num_processes/process_id "
+                "set but no coordinator address); running single-process"
+            )
         return False
 
     jax.distributed.initialize(

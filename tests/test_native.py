@@ -264,3 +264,37 @@ def test_pathological_input_both_lanes(tmp_path):
     dead_code = list(table.trace_names).index(dead_trace)
     local = list(codes).index(dead_code)
     assert not det.valid[local]
+
+
+def test_edge_bitmap_and_fallback_agree(csv_pair, monkeypatch):
+    """The scan-time edge-bitmap dedup and the counting-sort fallback
+    (vocab past the bitmap budget) must build identical graphs; the
+    chunked thread pool must match the serial path."""
+    from microrank_tpu.graph.table_ops import build_window_graph_from_table
+
+    d, _ = csv_pair
+    tab = native.load_span_table(d / "abnormal.csv")
+    mask = np.ones(tab.n_spans, dtype=bool)
+    codes = np.unique(tab.trace_id)
+    nrm, abn = codes[::2], codes[1::2]
+
+    def build():
+        g, _, a, b = build_window_graph_from_table(
+            tab, mask, nrm, abn, use_native=True, aux="all"
+        )
+        return g, a, b
+
+    base_g, base_a, base_b = build()
+    for env in (
+        {"MR_EDGE_BITMAP_MAX_VOCAB": "0"},   # force counting-sort path
+        {"MR_BUILD_THREADS": "4"},            # force chunked finishing
+        {"MR_EDGE_BITMAP_MAX_VOCAB": "0", "MR_BUILD_THREADS": "4"},
+    ):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        g, a, b = build()
+        for k in env:
+            monkeypatch.delenv(k)
+        np.testing.assert_array_equal(a, base_a)
+        np.testing.assert_array_equal(b, base_b)
+        _assert_graphs_equal(g, base_g)
