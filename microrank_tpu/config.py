@@ -134,8 +134,9 @@ class RuntimeConfig:
     #       memory (the at-scale fallback);
     #   "dense" / "dense_bf16" — scatter densify + MXU matvecs;
     #   "coo" — segment-sum SpMV (the shardable kernel under shard_map);
-    #   "pallas" — one-hot MXU segment sums (blocked on tunneled-TPU
-    #       deployments whose remote compile helper can't build Mosaic);
+    #   "pallas" — one-hot MXU segment sums (measured on v5e: beats the
+    #       coo scatter at 1M entries, ~7x slower than packed — see
+    #       DESIGN.md's kernel table; never chosen by "auto");
     #   "auto" — packed when both partitions' unpacked matrices fit
     #       dense_budget_bytes (decided once at graph build, which then
     #       constructs exactly the needed auxiliary view), else csr.

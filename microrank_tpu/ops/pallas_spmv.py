@@ -30,7 +30,13 @@ from jax.experimental import pallas as pl
 
 from jax.experimental.pallas import tpu as pltpu
 
-ENTRY_BLOCK = 512
+# Block shapes must align to the XLA 1-D layout tile (1024 elements for
+# s32/f32 on v5e) once the padded array exceeds one tile — Mosaic rejects
+# a 512 block on an 8192-element operand with "XLA layout {0:T(1024)}
+# does not match Mosaic layout {0:T(512)}". A block that covers the WHOLE
+# (sub-1024) array is fine, which is why the row_tile clamp below may
+# yield 512 for a 512-row output and still compile.
+ENTRY_BLOCK = 1024
 ROW_TILE = 2048
 
 
