@@ -247,10 +247,7 @@ def _run_batched(
         return stack_window_graphs(graphs), names, total, len(graphs)
 
     stacked, op_names, spans_used, n_windows = build_all()
-    from microrank_tpu.rank_backends.jax_tpu import (
-        choose_kernel as _choose,
-        device_subset,
-    )
+    from microrank_tpu.rank_backends.jax_tpu import choose_kernel as _choose
 
     resolved = kernel if kernel != "auto" else _choose(stacked)
     log(f"batched mode: {n_windows}/{n_batch} sub-windows partitioned, "

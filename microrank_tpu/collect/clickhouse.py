@@ -79,6 +79,44 @@ def load_events_toml(path) -> List[ChaosEvent]:
     return events
 
 
+def interactive_events(
+    input_fn=input, print_fn=print
+) -> List[ChaosEvent]:
+    """Prompt an operator for chaos events (reference
+    collect_data.py:145-172 behavior): loop until an empty timestamp;
+    invalid timestamps re-prompt; each event then asks for namespace,
+    chaos type, and service. ``input_fn``/``print_fn`` are injectable
+    for tests."""
+    events: List[ChaosEvent] = []
+    try:
+        while True:
+            ts = input_fn(
+                "Enter the timestamp for anomaly injection "
+                "(YYYY-MM-DD HH:MM:SS, or press Enter to stop): "
+            ).strip()
+            if not ts:
+                print_fn("No valid timestamp provided. Stopping input.")
+                break
+            try:
+                datetime.strptime(ts, "%Y-%m-%d %H:%M:%S")
+            except ValueError:
+                print_fn("Invalid timestamp format. Please try again.")
+                continue
+            events.append(
+                ChaosEvent(
+                    timestamp=ts,
+                    namespace=input_fn("Enter namespace: ").strip(),
+                    chaos_type=input_fn("Enter the chaos type: ").strip(),
+                    service=input_fn("Enter the service name: ").strip(),
+                )
+            )
+    except EOFError:
+        # Closed stdin mid-prompt (piped/headless use): keep whatever
+        # complete events were entered instead of crashing.
+        print_fn("Input closed. Stopping input.")
+    return events
+
+
 async def _fetch_csv(client, query: str, filepath: Path, semaphore, retries=3):
     async with semaphore:
         for attempt in range(retries):
@@ -181,7 +219,22 @@ def run_collect(args) -> int:
     if args.config_toml:
         events = load_events_toml(args.config_toml)
     else:
-        log.error("--config-toml is required (interactive input not supported)")
+        # The reference's fallback when no TOML exists
+        # (collect_data.py:185-187): prompt the operator for events —
+        # but only on a real terminal; headless invocations keep the
+        # old clean error instead of hanging on (or crashing over) a
+        # non-interactive stdin.
+        import sys
+
+        if not sys.stdin.isatty():
+            log.error(
+                "--config-toml is required when stdin is not a terminal"
+            )
+            return 2
+        log.info("no --config-toml given; switching to interactive input")
+        events = interactive_events()
+    if not events:
+        log.error("no chaos events to collect")
         return 2
     ok = asyncio.run(collect_cases(events, args.host, args.output))
     return 0 if ok else 1

@@ -68,3 +68,67 @@ def test_manifest_toml_roundtrip(tmp_path):
     data = tomllib.loads(text)
     assert data["chaos_injection"][0]["service"] == 'svc"quoted"'
     assert data["chaos_injection"][0]["case"].endswith("-0214-1230")
+
+
+def test_interactive_events_scripted():
+    from microrank_tpu.collect.clickhouse import interactive_events
+
+    # One invalid timestamp (re-prompts), one full event, empty to stop —
+    # the reference's interactive loop behavior (collect_data.py:145-172).
+    answers = iter(
+        [
+            "not-a-timestamp",
+            "2025-02-14 12:30:00",
+            "ts",
+            "latency",
+            "cartsvc",
+            "",
+        ]
+    )
+    printed = []
+    events = interactive_events(
+        input_fn=lambda prompt: next(answers), print_fn=printed.append
+    )
+    assert len(events) == 1
+    ev = events[0]
+    assert (ev.timestamp, ev.namespace, ev.chaos_type, ev.service) == (
+        "2025-02-14 12:30:00", "ts", "latency", "cartsvc",
+    )
+    assert any("Invalid timestamp" in p for p in printed)
+    assert any("Stopping input" in p for p in printed)
+
+
+def test_fetch_csv_retry_exhaustion_and_recovery(tmp_path):
+    from microrank_tpu.collect.clickhouse import _fetch_csv
+
+    class FlakyClient:
+        """Fails the first ``fail_n`` raw_query calls, then succeeds."""
+
+        def __init__(self, fail_n):
+            self.fail_n = fail_n
+            self.calls = 0
+
+        async def raw_query(self, query, fmt):
+            self.calls += 1
+            if self.calls <= self.fail_n:
+                raise ConnectionError(f"boom {self.calls}")
+            return b"Timestamp,TraceId\n1,abc\n"
+
+    sem = asyncio.Semaphore(2)
+
+    # Recovery: 2 failures then success within retries=3.
+    client = FlakyClient(fail_n=2)
+    path = tmp_path / "ok.csv"
+    ok = asyncio.run(_fetch_csv(client, "SELECT 1", path, sem))
+    assert ok is True
+    assert client.calls == 3
+    assert path.read_bytes().startswith(b"Timestamp")
+
+    # Exhaustion: every attempt fails -> False, no file, exactly
+    # ``retries`` attempts.
+    client = FlakyClient(fail_n=99)
+    path = tmp_path / "never.csv"
+    ok = asyncio.run(_fetch_csv(client, "SELECT 1", path, sem))
+    assert ok is False
+    assert client.calls == 3
+    assert not path.exists()
