@@ -140,10 +140,21 @@ def cmd_run(args) -> int:
         from ..native import load_span_table
         from ..pipeline import TableRCA
 
+        resume = args.resume
+        if resume and multiprocess:
+            # Only rank 0 has a cursor (out_dir); resuming it alone
+            # would desynchronize the ranks' collective window loops.
+            log.warning(
+                "--resume is disabled in multi-process runs (all ranks "
+                "must execute the same window sequence); starting over"
+            )
+            resume = False
         rca = TableRCA(cfg)
         rca.fit_baseline(load_span_table(args.normal, cache=primary))
         results = rca.run(
-            load_span_table(args.abnormal, cache=primary), out_dir=out_dir
+            load_span_table(args.abnormal, cache=primary),
+            out_dir=out_dir,
+            resume=resume,
         )
     elif multiprocess and not primary:
         # The pandas pipeline has no collectives — duplicating it on

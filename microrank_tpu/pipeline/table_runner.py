@@ -192,6 +192,7 @@ class TableRCA:
         out_dir=None,
         sink: Optional[ResultSink] = None,
         batch_windows: bool = False,
+        resume: bool = False,
     ) -> List[WindowResult]:
         """Slide over the table; RCA every anomalous window.
 
@@ -208,7 +209,17 @@ class TableRCA:
         window's host work is done, so graph build overlaps device
         execution. Results are emitted to the sink strictly in window
         order either way.
+
+        ``resume`` (needs ``out_dir``): restart from the persisted
+        window cursor. The cursor records the NEXT window start and only
+        advances when a window's result has actually been emitted — a
+        crash mid-pipeline re-runs the inflight windows instead of
+        dropping them.
         """
+        from pathlib import Path
+
+        from .checkpoint import WindowCursor
+
         cfg = self.config
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before run()")
@@ -216,6 +227,11 @@ class TableRCA:
             sink = ResultSink(
                 out_dir, overwrite_csv=cfg.compat.overwrite_results
             )
+        cursor = (
+            WindowCursor(Path(out_dir) / "cursor.json")
+            if out_dir is not None
+            else None
+        )
         if table.n_spans == 0:
             return []
 
@@ -224,11 +240,31 @@ class TableRCA:
         depth = max(1, int(cfg.runtime.pipeline_depth))
         current = int(table.start_us.min())
         end = int(table.end_us.max())
+        if resume and cursor is not None:
+            saved = cursor.load()
+            if saved is not None:
+                current = int(
+                    np.datetime64(saved, "us").astype(np.int64)
+                )
+                self.log.info("resuming window loop at %s", saved)
 
         results: List[WindowResult] = []
         pending = []  # (result, mask, nrm, abn) for deferred batched rank
         inflight = []  # (result, handles, timings) dispatched, not forced
         emitted = 0  # results[:emitted] already sent to the sink
+        next_cursor = {}  # id(result) -> post-advance window position (µs)
+
+        def _emit(r):
+            sink.emit(r)
+            # Not in batch mode: there all ranking completes BEFORE any
+            # emit, so per-window saves would be N redundant writes
+            # right before cursor.clear().
+            if (
+                cursor is not None
+                and not batch_windows
+                and id(r) in next_cursor
+            ):
+                cursor.save(_iso(next_cursor[id(r)]))
 
         def _emit_ready():
             """Emit results in window order, stopping at the oldest
@@ -241,7 +277,7 @@ class TableRCA:
                 r = results[emitted]
                 if id(r) == stop:
                     break
-                sink.emit(r)
+                _emit(r)
                 emitted += 1
 
         def _finalize_one():
@@ -294,10 +330,11 @@ class TableRCA:
             results.append(result)
             if not (result.anomaly and not result.skipped_reason) or batch_windows:
                 result.timings = timings.as_dict()
-            _emit_ready()
             if ranked:
                 current += skip_us
             current += detect_us
+            next_cursor[id(result)] = current
+            _emit_ready()
 
         while inflight:
             _finalize_one()
@@ -307,7 +344,9 @@ class TableRCA:
             self._rank_pending(table, pending)
         if batch_windows and sink is not None:
             for r in results:
-                sink.emit(r)
+                _emit(r)
+        if cursor is not None:
+            cursor.clear()
         return results
 
     def _rank_pending(self, table, pending) -> None:

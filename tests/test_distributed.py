@@ -158,3 +158,15 @@ def test_two_process_mesh_ranks_like_single_process(tmp_path):
         # rank exactly like the single-process (1, 8) mesh.
         if expected_table is not None:
             assert res["table_rankings"] == expected_table
+
+
+def test_initialize_partial_config_falls_back(monkeypatch):
+    # A leftover MICRORANK_NUM_PROCESSES without a coordinator must warn
+    # and keep the single-process fallback, not raise inside jax.
+    from microrank_tpu.parallel.distributed import initialize_distributed
+
+    monkeypatch.setenv("MICRORANK_NUM_PROCESSES", "2")
+    monkeypatch.delenv("MICRORANK_COORDINATOR", raising=False)
+    monkeypatch.delenv("MICRORANK_PROCESS_ID", raising=False)
+    assert initialize_distributed() is False
+    assert jax.process_count() == 1

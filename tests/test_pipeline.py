@@ -338,3 +338,45 @@ def test_trace_context(tmp_path):
     with trace_context(str(d)):
         jnp.arange(8).sum().block_until_ready()
     assert d.exists() and any(d.rglob("*"))
+
+
+def test_table_rca_resume(tmp_path):
+    # The native fast lane mirrors OnlineRCA's window-cursor resume: a
+    # saved cursor makes the next run skip already-emitted windows, and
+    # a clean run clears it.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.pipeline import TableRCA
+    from microrank_tpu.pipeline.checkpoint import WindowCursor
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=16, n_traces=80, seed=9), 3, [0, 2]
+    )
+    tl.normal.to_csv(tmp_path / "n.csv", index=False)
+    tl.timeline.to_csv(tmp_path / "a.csv", index=False)
+    normal = native.load_span_table(tmp_path / "n.csv")
+    timeline = native.load_span_table(tmp_path / "a.csv")
+
+    out1 = tmp_path / "run1"
+    rca = TableRCA(MicroRankConfig())
+    rca.fit_baseline(normal)
+    first = rca.run(timeline, out_dir=out1)
+    assert len(first) >= 2
+    # Clean completion clears the cursor.
+    assert WindowCursor(out1 / "cursor.json").load() is None
+
+    # Pretend a prior run stopped after the first window: save the
+    # cursor a full run would have written at that point.
+    cfg = MicroRankConfig()
+    skip_min = cfg.window.skip_minutes if first[0].ranking else 0.0
+    resume_at = (
+        pd.Timestamp(first[0].end) + pd.Timedelta(minutes=skip_min)
+    )
+    out2 = tmp_path / "run2"
+    out2.mkdir()
+    WindowCursor(out2 / "cursor.json").save(str(resume_at))
+    resumed = rca.run(timeline, out_dir=out2, resume=True)
+    assert len(resumed) == len(first) - 1
+    assert [r.start for r in resumed] == [r.start for r in first[1:]]
