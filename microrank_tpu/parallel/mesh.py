@@ -5,11 +5,12 @@ The framework's scaling axes map onto a 2D logical mesh:
 
 * ``windows`` — data parallelism over detection windows (each window's
   ranking is independent: vmap + batch sharding);
-* ``shard``  — graph parallelism within a window: the COO *entry* axes of
-  the incidence/call-edge lists are sharded, each device segment-sums its
-  shard into dense [V]/[T] partials, and one psum per SpMV combines them.
-  On a TPU slice the psum rides ICI; across slices, DCN — both compiled by
-  XLA from the same program (no NCCL/MPI analogue needed).
+* ``shard``  — graph parallelism within a window. The packed kernel
+  shards the TRACE axis (bitmap column blocks, distributed rv, one psum
+  per iteration); coo/csr shard the COO *entry* axes (dense [V]/[T]
+  partials, two psums). On a TPU slice the collectives ride ICI; across
+  slices, DCN — both compiled by XLA from the same program (no NCCL/MPI
+  analogue needed).
 
 Multi-host: ``parallel.distributed.initialize_distributed()`` (env- or
 flag-driven ``jax.distributed.initialize`` — `cli run --distributed`)

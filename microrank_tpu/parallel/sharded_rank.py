@@ -53,7 +53,9 @@ def _pad2d(arr: np.ndarray, rows: int, cols: int) -> np.ndarray:
 
 
 def stack_window_graphs(
-    graphs: Sequence[WindowGraph], shard_multiple: int = 1
+    graphs: Sequence[WindowGraph],
+    shard_multiple: int = 1,
+    trace_multiple: int = 1,
 ) -> WindowGraph:
     """Stack per-window graphs into one batched WindowGraph.
 
@@ -61,12 +63,16 @@ def stack_window_graphs(
     axes divide ``shard_multiple`` — a shard_map requirement). Padding
     entries carry value 0 and are inert; per-window true extents live in
     the n_* scalars (stacked to [B]).
+
+    ``trace_multiple``: round the trace axis up to this multiple — the
+    trace-sharded packed kernel needs t_pad divisible by 8*S (whole
+    bitmap BYTES per shard), so pass ``8 * mesh shard size`` there.
     """
 
     def stack_parts(parts: List[PartitionGraph]) -> PartitionGraph:
         e = _round_up(max(p.inc_op.shape[0] for p in parts), shard_multiple)
         c = _round_up(max(p.ss_child.shape[0] for p in parts), shard_multiple)
-        t = max(p.kind.shape[0] for p in parts)
+        t = _round_up(max(p.kind.shape[0] for p in parts), trace_multiple)
         v = max(p.cov_unique.shape[0] for p in parts)
         # A batch mixing built and placeholder aux views degrades to
         # placeholders (all-or-none per view family; the batched kernel
@@ -164,9 +170,47 @@ def stack_window_graphs(
     )
 
 
-def _partition_specs(window_axis, shard_axis) -> PartitionGraph:
+def _partition_specs(
+    window_axis, shard_axis, kernel: str = "coo"
+) -> PartitionGraph:
     entry = P(window_axis, shard_axis)   # big COO entry axes: sharded
     per_window = P(window_axis)          # [V]/[T]/scalar arrays: replicated
+    if kernel in ("packed", "packed_bf16"):
+        # Trace-sharded layout: each device holds a COLUMN block of the
+        # coverage bitmap ([V, T8/S] bytes) plus the matching [T/S]
+        # blocks of the trace-axis vectors (rv lives sharded through the
+        # whole iteration); sv-sized arrays and the call-graph bitmap
+        # replicate. The COO entry arrays are typically stripped to
+        # [B, 0] by device_subset before staging — the entry spec on a
+        # zero-length axis is inert.
+        trace = P(window_axis, shard_axis)
+        return PartitionGraph(
+            inc_op=entry,
+            inc_trace=entry,
+            sr_val=entry,
+            rs_val=entry,
+            ss_child=entry,
+            ss_parent=entry,
+            ss_val=entry,
+            inc_trace_opmajor=entry,
+            sr_val_opmajor=entry,
+            inc_indptr_op=per_window,
+            inc_indptr_trace=per_window,
+            ss_indptr=per_window,
+            cov_bits=P(window_axis, None, shard_axis),
+            ss_bits=per_window,
+            inv_tracelen=trace,
+            inv_cov_dup=per_window,
+            inv_outdeg=per_window,
+            kind=trace,
+            tracelen=trace,
+            cov_unique=per_window,
+            op_present=per_window,
+            n_ops=per_window,
+            n_traces=per_window,
+            n_inc=per_window,
+            n_ss=per_window,
+        )
     return PartitionGraph(
         inc_op=entry,
         inc_trace=entry,
@@ -214,12 +258,40 @@ def rank_windows_sharded(
     Input arrays carry a leading batch axis B (divisible by the windows
     axis size) with entry axes divisible by the shard axis size — use
     ``stack_window_graphs(graphs, shard_multiple=mesh.shape['shard'])``.
-    ``kernel`` must be shard-capable: "coo" (segment-sum partials) or
-    "csr" (local-block prefix sums with clamped row ranges; needs graphs
-    built with the CSR views, aux="csr"/"all"). Both psum the per-shard
-    partials. Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
+    ``kernel`` must be shard-capable:
+
+    * "coo" — segment-sum partials over sharded entry axes, two psums
+      per iteration;
+    * "csr" — local-block prefix sums with clamped row ranges (needs
+      graphs built with the CSR views, aux="csr"/"all"), two psums;
+    * "packed" / "packed_bf16" — the MXU bitmap kernel with the TRACE
+      axis sharded (bitmap column blocks; rv stays distributed), ONE
+      psum per iteration. Needs aux="packed"/"all" graphs stacked with
+      ``trace_multiple = 8 * mesh.shape['shard']``.
+
+    Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
     """
-    specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
+    if kernel not in ("coo", "csr", "packed", "packed_bf16"):
+        raise ValueError(
+            f"kernel {kernel!r} is not shard-capable; use coo, csr, or "
+            "packed/packed_bf16"
+        )
+    if kernel in ("packed", "packed_bf16"):
+        shard_n = int(dict(zip(mesh.axis_names, mesh.devices.shape))[SHARD_AXIS])
+        t_pad = int(batched.normal.kind.shape[-1])
+        t8 = int(batched.normal.cov_bits.shape[-1])
+        if t8 == 0:
+            raise ValueError(
+                "sharded packed kernel needs bitmap graphs — build with "
+                "aux='packed'/'all'"
+            )
+        if t_pad % (8 * shard_n) or t8 % shard_n:
+            raise ValueError(
+                f"sharded packed kernel needs the trace axis divisible "
+                f"by 8*shard ({8 * shard_n}); stack with "
+                f"trace_multiple={8 * shard_n}"
+            )
+    specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
     in_specs = (WindowGraph(normal=specs, abnormal=specs),)
     out_specs = (P(WINDOW_AXIS), P(WINDOW_AXIS), P(WINDOW_AXIS))
 

@@ -60,10 +60,12 @@ class TableRCA:
                 )
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
-            if config.runtime.kernel not in ("auto", "coo", "csr"):
+            if config.runtime.kernel not in (
+                "auto", "coo", "csr", "packed", "packed_bf16"
+            ):
                 self.log.warning(
                     "kernel=%r is not shard-capable; the sharded path "
-                    "ranks with kernel='csr' instead (different "
+                    "auto-selects packed or csr instead (different "
                     "summation tree, same math)",
                     config.runtime.kernel,
                 )
@@ -87,11 +89,21 @@ class TableRCA:
         from ..graph.build import aux_for_kernel
 
         cfg = self.config
-        # Sharded ranking supports the csr and coo kernels. auto prefers
-        # csr (scatter-free — coo's per-iteration segment-sum scatters
-        # measured ~4x slower on v5e); an explicit coo request is honored,
-        # any other configured kernel falls back to csr.
-        shard_kernel = "coo" if cfg.runtime.kernel == "coo" else "csr"
+        # Shard-capable kernels: packed/packed_bf16 (trace-sharded MXU
+        # bitmap matvecs, ONE psum per iteration — the fastest), csr and
+        # coo (entry-sharded, two psums). Explicit requests are honored;
+        # "auto" (and non-shardable kernels, which __init__ warned about)
+        # resolve like the single-device policy: packed within the dense
+        # budget, csr past it.
+        if self._mesh is not None:
+            k = cfg.runtime.kernel
+            shard_kernel = (
+                k if k in ("coo", "csr", "packed", "packed_bf16") else "auto"
+            )
+            build_aux = aux_for_kernel(shard_kernel)
+        else:
+            shard_kernel = None
+            build_aux = aux_for_kernel(cfg.runtime.kernel)
         graph, op_names, _, _ = build_window_graph_from_table(
             table,
             mask,
@@ -99,11 +111,7 @@ class TableRCA:
             abn_codes,
             pad_policy=cfg.runtime.pad_policy,
             min_pad=cfg.runtime.min_pad,
-            aux=(
-                aux_for_kernel(shard_kernel)
-                if self._mesh is not None
-                else aux_for_kernel(cfg.runtime.kernel)
-            ),
+            aux=build_aux,
             dense_budget_bytes=cfg.runtime.dense_budget_bytes,
         )
         if self._mesh is not None:
@@ -112,8 +120,23 @@ class TableRCA:
                 stack_window_graphs,
             )
 
+            from ..rank_backends.jax_tpu import device_subset
+
+            if shard_kernel == "auto":
+                shard_kernel = choose_kernel(graph)
             shard_n = int(self._mesh.devices.shape[1])
-            stacked = stack_window_graphs([graph], shard_multiple=shard_n)
+            # Strip the arrays this kernel never reads BEFORE staging —
+            # the packed kernel otherwise ships the full COO entry
+            # arrays (~2/3 of the graph bytes) to no purpose.
+            stacked = stack_window_graphs(
+                [device_subset(graph, shard_kernel)],
+                shard_multiple=shard_n,
+                trace_multiple=(
+                    8 * shard_n
+                    if shard_kernel in ("packed", "packed_bf16")
+                    else 1
+                ),
+            )
             if jax.process_count() > 1:
                 # Multi-host mesh: every process built the same host
                 # arrays (deterministic build over the same window);
@@ -126,7 +149,9 @@ class TableRCA:
                     _partition_specs,
                 )
 
-                pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS)
+                pspecs = _partition_specs(
+                    WINDOW_AXIS, SHARD_AXIS, shard_kernel
+                )
                 batched = global_put(
                     stacked,
                     self._mesh,

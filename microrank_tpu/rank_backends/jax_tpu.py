@@ -40,17 +40,32 @@ from ..ops.segment import coo_matvec
 from ..spectrum.formulas import spectrum_scores
 
 
-def preference_vector(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
+def preference_vector(
+    g: PartitionGraph,
+    anomaly: bool,
+    cfg: PageRankConfig,
+    trace_axis: str | None = None,
+):
     """Personalized preference vector on the padded trace axis
-    (reference: pagerank.py:68-85; paper Eq (7) behind preference="paper")."""
+    (reference: pagerank.py:68-85; paper Eq (7) behind preference="paper").
+
+    ``trace_axis``: when the trace axis is SHARDED over that mesh axis
+    (the packed sharded kernel), the per-trace arrays here are local
+    blocks — the live mask offsets by the shard position and the two
+    normalization sums are psum'd to their global values.
+    """
     t_pad = g.kind.shape[0]
-    live = jnp.arange(t_pad) < g.n_traces
+    base = 0 if trace_axis is None else lax.axis_index(trace_axis) * t_pad
+    live = (base + jnp.arange(t_pad)) < g.n_traces
     kind = g.kind.astype(jnp.float32)
     tlen = g.tracelen.astype(jnp.float32)
     inv_kind = jnp.where(live, 1.0 / kind, 0.0)
     inv_len = jnp.where(live, 1.0 / tlen, 0.0)
     kind_sum = inv_kind.sum()
     num_sum = inv_len.sum()
+    if trace_axis is not None:
+        kind_sum = lax.psum(kind_sum, trace_axis)
+        num_sum = lax.psum(num_sum, trace_axis)
 
     if not anomaly:
         pref = inv_kind / kind_sum
@@ -111,19 +126,31 @@ def _partition_setup(
     psum_axis: str | None = None,
     kernel: str = "coo",
 ):
-    """One partition's iteration ingredients: (matvecs, pref, sv0, rv0).
+    """One partition's iteration ingredients:
+    (matvecs, pref, sv0, rv0, rv_axis).
 
     Factored out of partition_pagerank so rank_window_core can step BOTH
     partitions inside one fori_loop (their updates are independent; fusing
     them halves the loop-body op count, which matters on latency-sensitive
     runtimes).
+
+    ``rv_axis`` is the mesh axis the trace vector ``rv`` is SHARDED over
+    (the packed sharded kernel keeps rv distributed — its bitmap columns
+    split over the shard axis), or None when rv is replicated (coo/csr
+    shard the ENTRY axes instead and psum dense partials).
     """
     v = g.cov_unique.shape[0]
     t_pad = g.kind.shape[0]
     n_total = (g.n_ops + g.n_traces).astype(jnp.float32)
-    trace_live = jnp.arange(t_pad) < g.n_traces
+    rv_axis = (
+        psum_axis
+        if psum_axis is not None and kernel in ("packed", "packed_bf16")
+        else None
+    )
+    t_base = 0 if rv_axis is None else lax.axis_index(rv_axis) * t_pad
+    trace_live = (t_base + jnp.arange(t_pad)) < g.n_traces
 
-    pref = preference_vector(g, anomaly, cfg)
+    pref = preference_vector(g, anomaly, cfg, rv_axis)
     d = jnp.float32(cfg.damping)
     alpha = jnp.float32(cfg.call_weight)
 
@@ -200,11 +227,15 @@ def _partition_setup(
         # as-is, p_rs is its transpose with a different scaling), halving
         # resident matrix bytes vs the dense kernel — and TPU matvecs beat
         # per-entry gathers/scatters by ~an order of magnitude here.
-        if psum_axis is not None:
-            raise ValueError(
-                "the packed kernel does not support entry-axis sharding; "
-                "use kernel='coo' under shard_map"
-            )
+        #
+        # Sharded (psum_axis set): the TRACE axis distributes — each
+        # device holds a [V, T/S] bitmap column block, rv/inv_tracelen/
+        # kind/tracelen live as local [T/S] blocks, the call-graph bitmap
+        # and sv stay replicated. Per iteration: ONE psum combines the
+        # b_cov @ rv partials (the b_ss term is replicated and must NOT
+        # be summed), and y_r needs no collective at all (each device
+        # computes its own rv block) — half the collectives of the
+        # entry-sharded csr/coo path, on the fastest kernel.
         if g.cov_bits.shape[-1] == 0:
             raise ValueError(
                 "kernel='packed' needs bitmaps, but this window was built "
@@ -221,12 +252,16 @@ def _partition_setup(
         w_cov = g.inv_cov_dup
         w_out = g.inv_outdeg
 
+        # reduce_shards psums over psum_axis == rv_axis here: ONLY the
+        # b_cov partials sum; the replicated b_ss term stays outside.
         def matvecs(sv, rv):
             return (
-                jnp.dot(
-                    b_cov,
-                    (rv * w_len).astype(mat_dtype),
-                    preferred_element_type=jnp.float32,
+                reduce_shards(
+                    jnp.dot(
+                        b_cov,
+                        (rv * w_len).astype(mat_dtype),
+                        preferred_element_type=jnp.float32,
+                    )
                 )
                 + alpha
                 * jnp.dot(
@@ -325,20 +360,29 @@ def _partition_setup(
     else:
         raise ValueError(f"unknown pagerank kernel {kernel!r}")
 
-    return matvecs, pref, sv, rv
+    return matvecs, pref, sv, rv, rv_axis
 
 
-def _partition_step(matvecs, pref, sv, rv, cfg: PageRankConfig):
+def _partition_step(
+    matvecs, pref, sv, rv, cfg: PageRankConfig, rv_axis: str | None = None
+):
     """One power-iteration step (pagerank.py:122-127):
     sv' = d*(p_sr @ rv + alpha * p_ss @ sv);
-    rv' = d*(p_rs @ sv) + (1-d) * pref; both max-normalized."""
+    rv' = d*(p_rs @ sv) + (1-d) * pref; both max-normalized.
+
+    With ``rv_axis`` set (trace-sharded rv, packed sharded kernel) the
+    rv normalization max is a pmax over the shards — a local max would
+    normalize each block differently."""
     d = jnp.float32(cfg.damping)
     mv_s, mv_r = matvecs(sv, rv)
     sv_new = d * mv_s
     rv_new = d * mv_r + (1.0 - d) * pref
     if cfg.max_normalize_each_iter:
         sv_new = sv_new / jnp.max(sv_new)
-        rv_new = rv_new / jnp.max(rv_new)
+        r_max = jnp.max(rv_new)
+        if rv_axis is not None:
+            r_max = lax.pmax(r_max, rv_axis)
+        rv_new = rv_new / r_max
     return sv_new, rv_new
 
 
@@ -351,11 +395,15 @@ def _partition_finish(g: PartitionGraph, sv):
     return weight, score
 
 
-def _iterate(step, carry, cfg: PageRankConfig):
+def _iterate(step, carry, cfg: PageRankConfig, delta_axis: str | None = None):
     """Run ``step`` for cfg.iterations, or — when cfg.tol is set — until
     the L-inf change of every carried vector falls below tol (whichever
     comes first). The reference has no convergence check (its README flags
-    that as a limitation for large systems); tol=None reproduces it."""
+    that as a limitation for large systems); tol=None reproduces it.
+
+    ``delta_axis``: mesh axis to pmax the convergence delta over when
+    part of the carry is sharded (packed sharded kernel) — the
+    while_loop predicate must be uniform across the shards."""
     if cfg.tol is None:
         return lax.fori_loop(0, cfg.iterations, lambda i, c: step(c), carry)
     tol = jnp.float32(cfg.tol)
@@ -371,10 +419,24 @@ def _iterate(step, carry, cfg: PageRankConfig):
             jnp.maximum,
             jax.tree.map(lambda a, b: jnp.max(jnp.abs(a - b)), new, c),
         )
+        if delta_axis is not None:
+            delta = lax.pmax(delta, delta_axis)
         return i + 1, new, delta
 
+    # Initial delta: +inf carrying the SAME varying-axes (vma) type as
+    # the body's delta — under shard_map the carry derives from sharded
+    # inputs, and a plain scalar literal would mismatch the loop-carry
+    # type. Deriving it from the carry (then overwriting the value)
+    # reproduces the body's vma exactly.
+    delta0 = jax.tree.reduce(
+        jnp.maximum, jax.tree.map(lambda a: jnp.max(jnp.abs(a)), carry)
+    )
+    if delta_axis is not None:
+        delta0 = lax.pmax(delta0, delta_axis)
+    delta0 = delta0 * 0 + jnp.float32(jnp.inf)
+
     _, carry, _ = lax.while_loop(
-        cond, body, (jnp.int32(0), carry, jnp.float32(jnp.inf))
+        cond, body, (jnp.int32(0), carry, delta0)
     )
     return carry
 
@@ -401,9 +463,14 @@ def partition_pagerank(
     the entries are the big axis). This is the whole multi-chip story for
     the SpMV (SURVEY.md C18/C19 plan).
     """
-    matvecs, pref, sv, rv = _partition_setup(g, anomaly, cfg, psum_axis, kernel)
+    matvecs, pref, sv, rv, rv_axis = _partition_setup(
+        g, anomaly, cfg, psum_axis, kernel
+    )
     sv, rv = _iterate(
-        lambda c: _partition_step(matvecs, pref, *c, cfg), (sv, rv), cfg
+        lambda c: _partition_step(matvecs, pref, *c, cfg, rv_axis),
+        (sv, rv),
+        cfg,
+        delta_axis=rv_axis,
     )
     return _partition_finish(g, sv)
 
@@ -545,22 +612,22 @@ def window_weights(
     Per-partition math is identical to partition_pagerank.
     Returns (n_weight[V], a_weight[V]).
     """
-    mv_n, pref_n, sv_n, rv_n = _partition_setup(
+    mv_n, pref_n, sv_n, rv_n, ax_n = _partition_setup(
         graph.normal, False, pagerank_cfg, psum_axis, kernel
     )
-    mv_a, pref_a, sv_a, rv_a = _partition_setup(
+    mv_a, pref_a, sv_a, rv_a, ax_a = _partition_setup(
         graph.abnormal, True, pagerank_cfg, psum_axis, kernel
     )
 
     def step(carry):
         (sv_n, rv_n), (sv_a, rv_a) = carry
         return (
-            _partition_step(mv_n, pref_n, sv_n, rv_n, pagerank_cfg),
-            _partition_step(mv_a, pref_a, sv_a, rv_a, pagerank_cfg),
+            _partition_step(mv_n, pref_n, sv_n, rv_n, pagerank_cfg, ax_n),
+            _partition_step(mv_a, pref_a, sv_a, rv_a, pagerank_cfg, ax_a),
         )
 
     (sv_n, rv_n), (sv_a, rv_a) = _iterate(
-        step, ((sv_n, rv_n), (sv_a, rv_a)), pagerank_cfg
+        step, ((sv_n, rv_n), (sv_a, rv_a)), pagerank_cfg, delta_axis=ax_n
     )
     n_weight, _ = _partition_finish(graph.normal, sv_n)
     a_weight, _ = _partition_finish(graph.abnormal, sv_a)

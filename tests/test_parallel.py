@@ -212,3 +212,69 @@ def test_batched_with_convergence_tol(window_batch):
             jax.tree.map(jnp.asarray, g), cfg.pagerank, cfg.spectrum
         )
         assert int(np.asarray(ti)[0]) == int(np.asarray(bti[i])[0])
+
+
+def test_sharded_packed_matches_single_device():
+    # The trace-sharded MXU bitmap kernel: bitmap column blocks + a
+    # distributed rv with one psum per iteration must match the
+    # single-device packed kernel (tie-aware: the wider stacked trace
+    # padding changes reduction shapes).
+    from microrank_tpu.config import PageRankConfig
+
+    cfg = MicroRankConfig()
+    graphs, namelists = [], []
+    for seed in (1, 2, 3, 4):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        graph, names, _, _ = build_window_graph(
+            case.abnormal, nrm, abn, aux="all"
+        )
+        graphs.append(graph)
+        namelists.append(names)
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(graphs, shard_multiple=4, trace_multiple=32)
+    sti, sts, _ = rank_windows_sharded(
+        jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum,
+        mesh, "packed",
+    )
+    for i, g in enumerate(graphs):
+        ti, ts, _ = rank_window_device(
+            jax.tree.map(jnp.asarray, g), cfg.pagerank, cfg.spectrum,
+            None, "packed",
+        )
+        assert namelists[i][int(ti[0])] == namelists[i][int(sti[i][0])]
+        _assert_rank_equal_tieaware(ti, ts, sti[i], sts[i])
+
+    # Convergence-tol path: the while_loop predicate pmaxes the sharded
+    # rv delta so all shards agree on when to stop.
+    tol_cfg = PageRankConfig(iterations=100, tol=1e-6)
+    tti, _, _ = rank_windows_sharded(
+        jax.tree.map(jnp.asarray, stacked), tol_cfg, cfg.spectrum,
+        mesh, "packed",
+    )
+    for i in range(len(graphs)):
+        assert int(np.asarray(tti[i])[0]) == int(np.asarray(sti[i])[0])
+
+
+def test_sharded_packed_rejects_misaligned_traces():
+    # Without trace_multiple=8*S the packed sharded kernel must fail
+    # loudly with stacking instructions, not shard garbage.
+    cfg = MicroRankConfig()
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=70, seed=1)
+    )
+    nrm, abn = partition_case(case)
+    # Exact padding gives an odd trace extent that cannot divide 8*S.
+    graph, _, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux="all", pad_policy="exact"
+    )
+    mesh = make_mesh((1, 8))
+    stacked = stack_window_graphs([graph], shard_multiple=8)
+    assert stacked.normal.kind.shape[-1] % 64 != 0
+    with pytest.raises(ValueError, match="trace_multiple"):
+        rank_windows_sharded(
+            jax.tree.map(jnp.asarray, stacked), cfg.pagerank,
+            cfg.spectrum, mesh, "packed",
+        )
