@@ -70,8 +70,19 @@ def stack_window_graphs(
     """
 
     def stack_parts(parts: List[PartitionGraph]) -> PartitionGraph:
-        e = _round_up(max(p.inc_op.shape[0] for p in parts), shard_multiple)
-        c = _round_up(max(p.ss_child.shape[0] for p in parts), shard_multiple)
+        def stack_entry(getter, dtype):
+            """Stack one entry-sized field padded to ITS OWN rounded
+            batch max — kernel-stripped ([0]-length) fields stay
+            zero-length instead of being re-inflated to the sibling
+            fields' extent (device_subset's whole point)."""
+            arrs = [getter(p) for p in parts]
+            size = _round_up(
+                max(a.shape[0] for a in arrs), shard_multiple
+            )
+            return np.stack(
+                [_pad_axis0(np.asarray(a, dtype), size) for a in arrs]
+            )
+
         t = _round_up(max(p.kind.shape[0] for p in parts), trace_multiple)
         v = max(p.cov_unique.shape[0] for p in parts)
         # A batch mixing built and placeholder aux views degrades to
@@ -94,20 +105,20 @@ def stack_window_graphs(
             )
 
         return PartitionGraph(
-            inc_op=np.stack([_pad_axis0(p.inc_op, e) for p in parts]),
-            inc_trace=np.stack([_pad_axis0(p.inc_trace, e) for p in parts]),
-            sr_val=np.stack([_pad_axis0(p.sr_val, e) for p in parts]),
-            rs_val=np.stack([_pad_axis0(p.rs_val, e) for p in parts]),
-            ss_child=np.stack([_pad_axis0(p.ss_child, c) for p in parts]),
-            ss_parent=np.stack([_pad_axis0(p.ss_parent, c) for p in parts]),
-            ss_val=np.stack([_pad_axis0(p.ss_val, c) for p in parts]),
+            inc_op=stack_entry(lambda p: p.inc_op, np.int32),
+            inc_trace=stack_entry(lambda p: p.inc_trace, np.int32),
+            sr_val=stack_entry(lambda p: p.sr_val, np.float32),
+            rs_val=stack_entry(lambda p: p.rs_val, np.float32),
+            ss_child=stack_entry(lambda p: p.ss_child, np.int32),
+            ss_parent=stack_entry(lambda p: p.ss_parent, np.int32),
+            ss_val=stack_entry(lambda p: p.ss_val, np.float32),
             inc_trace_opmajor=(
-                np.stack([_pad_axis0(p.inc_trace_opmajor, e) for p in parts])
+                stack_entry(lambda p: p.inc_trace_opmajor, np.int32)
                 if have_csr
                 else np.zeros((len(parts), 0), np.int32)
             ),
             sr_val_opmajor=(
-                np.stack([_pad_axis0(p.sr_val_opmajor, e) for p in parts])
+                stack_entry(lambda p: p.sr_val_opmajor, np.float32)
                 if have_csr
                 else np.zeros((len(parts), 0), np.float32)
             ),
