@@ -51,13 +51,9 @@ class TableRCA:
             shape = tuple(config.runtime.mesh_shape)
             if len(shape) == 1:  # pure graph parallelism
                 shape = (1, shape[0])
-            if shape[0] != 1:
-                raise ValueError(
-                    "TableRCA ranks one window per dispatch; use a 1D "
-                    f"(N,) / (1, N) mesh_shape, not {shape} — the "
-                    "windows axis belongs to rank_windows_batched/"
-                    "rank_windows_sharded batch calls"
-                )
+            # A windows axis > 1 is only usable by run(batch_windows=
+            # True), which ranks all anomalous windows in one sharded
+            # dispatch; per-window dispatch checks this at rank time.
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
             if config.runtime.kernel not in (
@@ -69,6 +65,52 @@ class TableRCA:
                     "summation tree, same math)",
                     config.runtime.kernel,
                 )
+
+    _SHARD_KERNELS = ("coo", "csr", "packed", "packed_bf16")
+
+    def _resolve_shard_kernel(self, graphs) -> str:
+        """Kernel for a sharded dispatch: an explicit shard-capable
+        config wins; otherwise resolve by the views EVERY graph in the
+        batch carries (stacking degrades mixed-aux batches to the
+        common denominator, so the choice must agree with that: all
+        packed -> packed, all csr -> csr, mixed -> coo)."""
+        k = self.config.runtime.kernel
+        if k in self._SHARD_KERNELS:
+            return k
+        kernels = {choose_kernel(g) for g in graphs}
+        return kernels.pop() if len(kernels) == 1 else "coo"
+
+    def _stage_sharded(self, graphs, kernel: str):
+        """The one staging recipe for every sharded path: strip the
+        arrays ``kernel`` never reads, stack with the mesh's shard (and,
+        for packed, 8*S trace) alignment, and form global arrays with
+        kernel-correct partition specs — global_put handles both
+        single-process meshes (a sharded device_put) and multi-host ones
+        (each process contributes its addressable shards)."""
+        from ..graph.structures import WindowGraph
+        from ..parallel.distributed import global_put
+        from ..parallel.sharded_rank import (
+            SHARD_AXIS,
+            WINDOW_AXIS,
+            _partition_specs,
+            stack_window_graphs,
+        )
+        from ..rank_backends.jax_tpu import device_subset
+
+        shard_n = int(self._mesh.devices.shape[1])
+        stacked = stack_window_graphs(
+            [device_subset(g, kernel) for g in graphs],
+            shard_multiple=shard_n,
+            trace_multiple=(
+                8 * shard_n if kernel in ("packed", "packed_bf16") else 1
+            ),
+        )
+        pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
+        return global_put(
+            stacked,
+            self._mesh,
+            WindowGraph(normal=pspecs, abnormal=pspecs),
+        )
 
     def fit_baseline(self, normal_table) -> None:
         self.slo_vocab, self.baseline = compute_slo_from_table(
@@ -115,50 +157,17 @@ class TableRCA:
             dense_budget_bytes=cfg.runtime.dense_budget_bytes,
         )
         if self._mesh is not None:
-            from ..parallel.sharded_rank import (
-                rank_windows_sharded,
-                stack_window_graphs,
-            )
+            from ..parallel.sharded_rank import rank_windows_sharded
 
-            from ..rank_backends.jax_tpu import device_subset
-
+            if int(self._mesh.devices.shape[0]) != 1:
+                raise ValueError(
+                    "per-window dispatch needs a (1, N) / (N,) mesh; a "
+                    "windows axis > 1 only applies to "
+                    "run(batch_windows=True)"
+                )
             if shard_kernel == "auto":
-                shard_kernel = choose_kernel(graph)
-            shard_n = int(self._mesh.devices.shape[1])
-            # Strip the arrays this kernel never reads BEFORE staging —
-            # the packed kernel otherwise ships the full COO entry
-            # arrays (~2/3 of the graph bytes) to no purpose.
-            stacked = stack_window_graphs(
-                [device_subset(graph, shard_kernel)],
-                shard_multiple=shard_n,
-                trace_multiple=(
-                    8 * shard_n
-                    if shard_kernel in ("packed", "packed_bf16")
-                    else 1
-                ),
-            )
-            if jax.process_count() > 1:
-                # Multi-host mesh: every process built the same host
-                # arrays (deterministic build over the same window);
-                # each contributes the shards its devices address.
-                from ..graph.structures import WindowGraph
-                from ..parallel.distributed import global_put
-                from ..parallel.sharded_rank import (
-                    SHARD_AXIS,
-                    WINDOW_AXIS,
-                    _partition_specs,
-                )
-
-                pspecs = _partition_specs(
-                    WINDOW_AXIS, SHARD_AXIS, shard_kernel
-                )
-                batched = global_put(
-                    stacked,
-                    self._mesh,
-                    WindowGraph(normal=pspecs, abnormal=pspecs),
-                )
-            else:
-                batched = jax.device_put(stacked)
+                shard_kernel = self._resolve_shard_kernel([graph])
+            batched = self._stage_sharded([graph], shard_kernel)
             ti, ts, nv = rank_windows_sharded(
                 batched,
                 cfg.pagerank,
@@ -375,39 +384,67 @@ class TableRCA:
         return results
 
     def _rank_pending(self, table, pending) -> None:
-        """Phase 2 of batch_windows: one vmapped rank over all windows."""
+        """Phase 2 of batch_windows: one vmapped rank over all windows —
+        sharded over the full (windows, shard) mesh when one is
+        configured (the windows axis splits the batch, the shard axis
+        splits each window's graph), vmapped single-device otherwise."""
         from ..parallel.sharded_rank import (
             rank_windows_batched,
+            rank_windows_sharded,
             stack_window_graphs,
         )
 
         from ..graph.build import aux_for_kernel
 
         cfg = self.config
+        if self._mesh is not None:
+            k = cfg.runtime.kernel
+            kernel = k if k in self._SHARD_KERNELS else "auto"
+            w_n = int(self._mesh.devices.shape[0])
+        else:
+            kernel = cfg.runtime.kernel
+            w_n = 1
         graphs = []
         op_names = list(table.pod_op_names)
         timings = StageTimings()
+        # Concurrently-resident windows per device: the whole batch under
+        # single-device vmap, ceil(B/windows-axis) on a mesh.
+        per_device = -(-len(pending) // w_n)
         with timings.stage("build"):
             for _, mask, nrm, abn in pending:
                 graph, _, _, _ = build_window_graph_from_table(
                     table, mask, nrm, abn,
                     pad_policy=cfg.runtime.pad_policy,
                     min_pad=cfg.runtime.min_pad,
-                    aux=aux_for_kernel(cfg.runtime.kernel),
-                    # All B windows' matrices are live at once under vmap.
+                    aux=aux_for_kernel(kernel),
                     dense_budget_bytes=max(
-                        1, cfg.runtime.dense_budget_bytes // len(pending)
+                        1, cfg.runtime.dense_budget_bytes // per_device
                     ),
                 )
                 graphs.append(graph)
-            stacked = stack_window_graphs(graphs)
         with timings.stage("rank_batched"):
-            top_idx, top_scores, n_valid = rank_windows_batched(
-                stacked, cfg.pagerank, cfg.spectrum, cfg.runtime.kernel
-            )
+            if self._mesh is not None:
+                if kernel == "auto":
+                    kernel = self._resolve_shard_kernel(graphs)
+                # The batch must divide the windows axis: pad by
+                # repeating the last window and drop the tail rows.
+                n_pad = (-len(graphs)) % w_n
+                batched = self._stage_sharded(
+                    graphs + [graphs[-1]] * n_pad, kernel
+                )
+                top_idx, top_scores, n_valid = rank_windows_sharded(
+                    batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
+                )
+            else:
+                stacked = stack_window_graphs(graphs)
+                top_idx, top_scores, n_valid = rank_windows_batched(
+                    stacked, cfg.pagerank, cfg.spectrum, kernel
+                )
             # One batched fetch: per-buffer transfers each pay an RPC
             # round trip on tunneled-TPU runtimes.
-            top_idx, top_scores, n_valid = jax.device_get(
+            from ..parallel.distributed import fetch_replicated
+
+            top_idx, top_scores, n_valid = fetch_replicated(
                 (top_idx, top_scores, n_valid)
             )
         shared = timings.as_dict()
