@@ -46,6 +46,26 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config-json", help="load a full MicroRankConfig dict")
 
 
+def _positive_int(value: str) -> int:
+    n = int(value)
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
+
+
+def _parse_mesh(spec):
+    """'8' -> (8,); '2x4' -> (2, 4); None/'' -> None (single device)."""
+    if not spec:
+        return None
+    try:
+        shape = tuple(int(p) for p in str(spec).lower().split("x"))
+    except ValueError:
+        raise SystemExit(f'invalid --mesh {spec!r}; use "8" or "2x4"')
+    if not shape or any(n < 1 for n in shape) or len(shape) > 2:
+        raise SystemExit(f'invalid --mesh {spec!r}; use "8" or "2x4"')
+    return shape
+
+
 def _config_from_args(args) -> "MicroRankConfig":
     from ..config import (
         CompatConfig,
@@ -78,7 +98,11 @@ def _config_from_args(args) -> "MicroRankConfig":
         window=WindowConfig(
             detect_minutes=args.detect_minutes, skip_minutes=args.skip_minutes
         ),
-        runtime=RuntimeConfig(backend=args.backend),
+        runtime=RuntimeConfig(
+            backend=args.backend,
+            mesh_shape=_parse_mesh(getattr(args, "mesh", None)),
+            kernel=getattr(args, "kernel", "auto"),
+        ),
     )
     if args.reference_compat:
         cfg = cfg.replace(
@@ -140,6 +164,20 @@ def cmd_run(args) -> int:
         from ..native import load_span_table
         from ..pipeline import TableRCA
 
+        # A windows axis > 1 only makes sense with batch-mode ranking
+        # (all anomalous windows in one sharded dispatch) — enable it
+        # automatically so "--mesh 2x4" works as advertised.
+        mesh_shape = cfg.runtime.mesh_shape
+        batch_windows = bool(
+            mesh_shape is not None
+            and len(mesh_shape) == 2
+            and mesh_shape[0] > 1
+        )
+        if batch_windows:
+            log.info(
+                "mesh windows axis > 1: ranking in batch mode (one "
+                "sharded dispatch over all anomalous windows)"
+            )
         resume = args.resume
         if resume and multiprocess:
             # Only rank 0 has a cursor (out_dir); resuming it alone
@@ -154,8 +192,15 @@ def cmd_run(args) -> int:
         results = rca.run(
             load_span_table(args.abnormal, cache=primary),
             out_dir=out_dir,
+            batch_windows=batch_windows,
             resume=resume,
         )
+    elif cfg.runtime.mesh_shape is not None and not multiprocess:
+        log.error(
+            "--mesh needs the native engine (the pandas pipeline has no "
+            "sharded path); rerun with --engine native"
+        )
+        return 2
     elif multiprocess and not primary:
         # The pandas pipeline has no collectives — duplicating it on
         # every rank buys nothing and non-primary ranks would drop
@@ -351,6 +396,21 @@ def main(argv=None) -> int:
         help="ingest engine: the C++ span loader or the pandas path",
     )
     p_run.add_argument(
+        "--mesh",
+        help='device mesh for sharded ranking: "8" (graph-parallel '
+        'over 8 devices) or "2x4" (2-way window batch x 4-way graph '
+        "shard; the windows axis >1 needs batch mode)",
+    )
+    p_run.add_argument(
+        "--kernel",
+        default="auto",
+        choices=[
+            "auto", "packed", "packed_bf16", "csr", "coo",
+            "dense", "dense_bf16", "pallas",
+        ],
+        help="power-iteration kernel",
+    )
+    p_run.add_argument(
         "--distributed", action="store_true",
         help="join a multi-host jax.distributed runtime before any "
         "device work (coordinator from --coordinator or "
@@ -428,6 +488,15 @@ def main(argv=None) -> int:
     p_col.add_argument("--namespace", required=False)
     p_col.add_argument("--config-toml", help="chaos events TOML manifest")
     p_col.add_argument("-o", "--output", default=".")
+    p_col.add_argument(
+        "--window-minutes", type=_positive_int, default=10,
+        help="normal/abnormal export window around each event "
+        "(reference: 10 minutes)",
+    )
+    p_col.add_argument(
+        "--concurrency", type=_positive_int, default=2,
+        help="concurrent ClickHouse queries (reference: Semaphore(2))",
+    )
     p_col.set_defaults(fn=cmd_collect)
 
     args = parser.parse_args(argv)

@@ -236,5 +236,13 @@ def run_collect(args) -> int:
     if not events:
         log.error("no chaos events to collect")
         return 2
-    ok = asyncio.run(collect_cases(events, args.host, args.output))
+    ok = asyncio.run(
+        collect_cases(
+            events,
+            args.host,
+            args.output,
+            window_minutes=args.window_minutes,
+            concurrency=args.concurrency,
+        )
+    )
     return 0 if ok else 1

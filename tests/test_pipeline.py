@@ -380,3 +380,62 @@ def test_table_rca_resume(tmp_path):
     resumed = rca.run(timeline, out_dir=out2, resume=True)
     assert len(resumed) == len(first) - 1
     assert [r.start for r in resumed] == [r.start for r in first[1:]]
+
+
+def test_cli_mesh_flag(tmp_path):
+    # --mesh routes the run through the sharded TableRCA path (and
+    # --kernel through the kernel config) without a config-json file;
+    # a 2x4 mesh auto-enables batch-mode ranking.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.cli import main
+    from microrank_tpu.cli.main import _parse_mesh
+
+    assert _parse_mesh(None) is None
+    assert _parse_mesh("8") == (8,)
+    assert _parse_mesh("2x4") == (2, 4)
+    with pytest.raises(SystemExit):
+        _parse_mesh("0x4")
+    with pytest.raises(SystemExit):
+        _parse_mesh("abc")
+
+    data = tmp_path / "data"
+    rc = main(
+        [
+            "synth", "-o", str(data), "--operations", "16", "--traces",
+            "120", "--seed", "3", "--kinds", "24",
+        ]
+    )
+    assert rc == 0
+    truth = json.loads((data / "ground_truth.json").read_text())
+    for mesh in ("8", "2x4"):
+        out = tmp_path / f"out_{mesh}"
+        rc = main(
+            [
+                "run",
+                "--engine", "native",
+                "--normal", str(data / "normal" / "traces.csv"),
+                "--abnormal", str(data / "abnormal" / "traces.csv"),
+                "-o", str(out),
+                "--mesh", mesh,
+                "--kernel", "csr",
+            ]
+        )
+        assert rc == 0, mesh
+        csv = pd.read_csv(out / "result.csv")
+        assert csv.iloc[0]["result"] == truth["fault_pod_op"], mesh
+
+    # The pandas pipeline has no sharded path: --mesh there is a clear
+    # error, not a silently unsharded run.
+    rc = main(
+        [
+            "run",
+            "--engine", "pandas",
+            "--normal", str(data / "normal" / "traces.csv"),
+            "--abnormal", str(data / "abnormal" / "traces.csv"),
+            "-o", str(tmp_path / "out_pandas"),
+            "--mesh", "8",
+        ]
+    )
+    assert rc == 2
