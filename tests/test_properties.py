@@ -108,3 +108,24 @@ def test_numerics_guard():
         assert_finite_scores([1.0, float("nan")], "t")
     with pytest.raises(NumericsError):
         assert_finite_scores([float("inf")], "t")
+
+
+def test_row_order_invariance(small_case):
+    # With the name-sorted vocab and pinned tie order, the FULL ranking
+    # (names and positions, not just scores) is invariant to the order
+    # spans arrive in — previously the vocab followed first appearance
+    # and exact ties followed it.
+    from microrank_tpu.rank_backends import get_backend
+
+    nrm, abn = partition_case(small_case)
+    cfg = MicroRankConfig()
+    base_top, base_sc = get_backend(cfg).rank_window(
+        small_case.abnormal, nrm, abn
+    )
+    for seed in (0, 1):
+        shuffled = small_case.abnormal.sample(
+            frac=1.0, random_state=seed
+        ).reset_index(drop=True)
+        top, sc = get_backend(cfg).rank_window(shuffled, nrm, abn)
+        assert top == base_top, seed
+        assert np.allclose(sc, base_sc, rtol=1e-6)
