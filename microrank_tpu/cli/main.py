@@ -156,10 +156,13 @@ def cmd_run(args) -> int:
     import jax
 
     multiprocess = jax.process_count() > 1
+    from ..utils.profiling import trace_context
+
     # In a multi-process run every process executes the same pipeline —
     # the sharded TableRCA programs are collective; only rank 0 writes
     # results (and caches: concurrent ranks must not race shared files).
     out_dir = args.output if primary else None
+    profile_dir = args.profile_dir if primary else None
     if engine == "native":
         from ..native import load_span_table
         from ..pipeline import TableRCA
@@ -189,12 +192,13 @@ def cmd_run(args) -> int:
             resume = False
         rca = TableRCA(cfg)
         rca.fit_baseline(load_span_table(args.normal, cache=primary))
-        results = rca.run(
-            load_span_table(args.abnormal, cache=primary),
-            out_dir=out_dir,
-            batch_windows=batch_windows,
-            resume=resume,
-        )
+        with trace_context(profile_dir):
+            results = rca.run(
+                load_span_table(args.abnormal, cache=primary),
+                out_dir=out_dir,
+                batch_windows=batch_windows,
+                resume=resume,
+            )
     elif cfg.runtime.mesh_shape is not None and not multiprocess:
         log.error(
             "--mesh needs the native engine (the pandas pipeline has no "
@@ -229,7 +233,8 @@ def cmd_run(args) -> int:
         rca.fit_baseline(
             normal, cache_path=args.slo_cache if primary else None
         )
-        results = rca.run(abnormal, out_dir=out_dir, resume=args.resume)
+        with trace_context(profile_dir):
+            results = rca.run(abnormal, out_dir=out_dir, resume=args.resume)
     n_anom = sum(r.anomaly for r in results)
     log.info(
         "processed %d windows, %d anomalous; results in %s",
@@ -409,6 +414,11 @@ def main(argv=None) -> int:
             "dense", "dense_bf16", "pallas",
         ],
         help="power-iteration kernel",
+    )
+    p_run.add_argument(
+        "--profile-dir",
+        help="wrap the window loop in a jax.profiler trace and write the "
+        "Perfetto dump here (rank 0 only in distributed runs)",
     )
     p_run.add_argument(
         "--distributed", action="store_true",

@@ -439,3 +439,29 @@ def test_cli_mesh_flag(tmp_path):
         ]
     )
     assert rc == 2
+
+
+def test_cli_profile_dir(tmp_path):
+    # --profile-dir wraps the window loop in a jax.profiler trace and
+    # leaves a Perfetto dump behind.
+    from microrank_tpu.cli import main
+
+    data = tmp_path / "data"
+    assert main(
+        [
+            "synth", "-o", str(data), "--operations", "12", "--traces",
+            "80", "--seed", "2",
+        ]
+    ) == 0
+    prof = tmp_path / "prof"
+    rc = main(
+        [
+            "run",
+            "--normal", str(data / "normal" / "traces.csv"),
+            "--abnormal", str(data / "abnormal" / "traces.csv"),
+            "-o", str(tmp_path / "out"),
+            "--profile-dir", str(prof),
+        ]
+    )
+    assert rc == 0
+    assert any(prof.rglob("*"))  # the trace dump exists
