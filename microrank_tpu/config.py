@@ -133,7 +133,8 @@ class RuntimeConfig:
     #   "csr" — cumsum-difference SpMV, scatter-free and entry-linear in
     #       memory (the at-scale fallback);
     #   "dense" / "dense_bf16" — scatter densify + MXU matvecs;
-    #   "coo" — segment-sum SpMV (the shardable kernel under shard_map);
+    #   "coo" — segment-sum SpMV (entry-shardable under shard_map, like
+    #       csr; packed shards the trace axis instead — see parallel/);
     #   "pallas" — one-hot MXU segment sums (measured on v5e: beats the
     #       coo scatter at 1M entries, ~7x slower than packed — see
     #       DESIGN.md's kernel table; never chosen by "auto");
