@@ -153,9 +153,14 @@ def cmd_run(args) -> int:
         engine = "native" if native_available() else "pandas"
     log.info("ingest engine: %s", engine)
 
-    import jax
+    # Only a distributed request needs the process count — asking jax
+    # otherwise would initialize a device backend (a tunnel round trip)
+    # even for pure-numpy/pandas runs that never touch one.
+    multiprocess = False
+    if args.distributed or args.coordinator:
+        import jax
 
-    multiprocess = jax.process_count() > 1
+        multiprocess = jax.process_count() > 1
     from ..utils.profiling import trace_context
 
     # In a multi-process run every process executes the same pipeline —
@@ -292,6 +297,15 @@ def cmd_collect(args) -> int:
     return run_collect(args)
 
 
+def _report_dict(rep) -> dict:
+    """The JSON shape shared by every eval report writer."""
+    return {
+        "recall_at": rep.recall_at,
+        "exam_score": rep.exam_score,
+        "detection_rate": rep.detection_rate,
+    }
+
+
 def cmd_eval(args) -> int:
     from ..evaluation import (
         EvalConfig,
@@ -320,14 +334,7 @@ def cmd_eval(args) -> int:
         for ov, rep in reports.items():
             print(f"overlap={ov:.2f}  {rep.summary()}")
         if args.json:
-            out = {
-                str(ov): {
-                    "recall_at": rep.recall_at,
-                    "exam_score": rep.exam_score,
-                    "detection_rate": rep.detection_rate,
-                }
-                for ov, rep in reports.items()
-            }
+            out = {str(ov): _report_dict(rep) for ov, rep in reports.items()}
             Path(args.json).write_text(json.dumps(out, indent=2))
         return 0
     if args.detection:
@@ -353,23 +360,14 @@ def cmd_eval(args) -> int:
         for m, rep in reports.items():
             print(f"{m:<{width}}  {rep.summary()}")
         if args.json:
-            out = {
-                m: {
-                    "recall_at": rep.recall_at,
-                    "exam_score": rep.exam_score,
-                    "detection_rate": rep.detection_rate,
-                }
-                for m, rep in reports.items()
-            }
+            out = {m: _report_dict(rep) for m, rep in reports.items()}
             Path(args.json).write_text(json.dumps(out, indent=2))
         return 0
     report = evaluate(cfg, eval_cfg)
     print(report.summary())
     if args.json:
         out = {
-            "recall_at": report.recall_at,
-            "exam_score": report.exam_score,
-            "detection_rate": report.detection_rate,
+            **_report_dict(report),
             "cases": [
                 {"seed": c.seed, "faults": c.faults, "ranks": c.ranks}
                 for c in report.cases

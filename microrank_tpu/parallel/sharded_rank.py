@@ -31,6 +31,10 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+# The kernels rank_windows_sharded accepts (one source of truth — the
+# pipeline's kernel selection imports this).
+SHARD_KERNELS = ("coo", "csr", "packed", "packed_bf16")
+
 
 def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
     if arr.shape[0] == size:
@@ -282,10 +286,10 @@ def rank_windows_sharded(
 
     Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
     """
-    if kernel not in ("coo", "csr", "packed", "packed_bf16"):
+    if kernel not in SHARD_KERNELS:
         raise ValueError(
-            f"kernel {kernel!r} is not shard-capable; use coo, csr, or "
-            "packed/packed_bf16"
+            f"kernel {kernel!r} is not shard-capable; use one of "
+            f"{SHARD_KERNELS}"
         )
     if kernel in ("packed", "packed_bf16"):
         shard_n = int(dict(zip(mesh.axis_names, mesh.devices.shape))[SHARD_AXIS])

@@ -23,6 +23,7 @@ from ..graph.table_ops import (
     detect_batch_from_table,
     window_rows,
 )
+from ..parallel.sharded_rank import SHARD_KERNELS
 from ..rank_backends.jax_tpu import choose_kernel, rank_window_device
 from ..utils.logging import get_logger
 from ..utils.profiling import StageTimings
@@ -56,17 +57,13 @@ class TableRCA:
             # dispatch; per-window dispatch checks this at rank time.
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
-            if config.runtime.kernel not in (
-                "auto", "coo", "csr", "packed", "packed_bf16"
-            ):
+            if config.runtime.kernel not in ("auto",) + SHARD_KERNELS:
                 self.log.warning(
                     "kernel=%r is not shard-capable; the sharded path "
                     "auto-selects packed or csr instead (different "
                     "summation tree, same math)",
                     config.runtime.kernel,
                 )
-
-    _SHARD_KERNELS = ("coo", "csr", "packed", "packed_bf16")
 
     def _resolve_shard_kernel(self, graphs) -> str:
         """Kernel for a sharded dispatch: an explicit shard-capable
@@ -75,7 +72,7 @@ class TableRCA:
         common denominator, so the choice must agree with that: all
         packed -> packed, all csr -> csr, mixed -> coo)."""
         k = self.config.runtime.kernel
-        if k in self._SHARD_KERNELS:
+        if k in SHARD_KERNELS:
             return k
         kernels = {choose_kernel(g) for g in graphs}
         return kernels.pop() if len(kernels) == 1 else "coo"
@@ -139,9 +136,7 @@ class TableRCA:
         # budget, csr past it.
         if self._mesh is not None:
             k = cfg.runtime.kernel
-            shard_kernel = (
-                k if k in ("coo", "csr", "packed", "packed_bf16") else "auto"
-            )
+            shard_kernel = k if k in SHARD_KERNELS else "auto"
             build_aux = aux_for_kernel(shard_kernel)
         else:
             shard_kernel = None
@@ -395,11 +390,12 @@ class TableRCA:
         )
 
         from ..graph.build import aux_for_kernel
+        from ..parallel.distributed import fetch_replicated
 
         cfg = self.config
         if self._mesh is not None:
             k = cfg.runtime.kernel
-            kernel = k if k in self._SHARD_KERNELS else "auto"
+            kernel = k if k in SHARD_KERNELS else "auto"
             w_n = int(self._mesh.devices.shape[0])
         else:
             kernel = cfg.runtime.kernel
@@ -442,8 +438,6 @@ class TableRCA:
                 )
             # One batched fetch: per-buffer transfers each pay an RPC
             # round trip on tunneled-TPU runtimes.
-            from ..parallel.distributed import fetch_replicated
-
             top_idx, top_scores, n_valid = fetch_replicated(
                 (top_idx, top_scores, n_valid)
             )
