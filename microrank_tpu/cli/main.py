@@ -153,11 +153,20 @@ def cmd_run(args) -> int:
         engine = "native" if native_available() else "pandas"
     log.info("ingest engine: %s", engine)
 
-    # Only a distributed request needs the process count — asking jax
-    # otherwise would initialize a device backend (a tunnel round trip)
-    # even for pure-numpy/pandas runs that never touch one.
+    # The process count matters for any path that runs collectives — a
+    # TPU-pod runtime can be multi-process WITHOUT an explicit
+    # --distributed (native multi-host discovery). Ask jax whenever the
+    # chosen path will initialize a backend anyway; only the pure-host
+    # combination (pandas engine + numpy_ref backend) skips the query,
+    # because asking would initialize a device backend (a tunnel round
+    # trip) for a run that never touches one.
     multiprocess = False
-    if args.distributed or args.coordinator:
+    if (
+        args.distributed
+        or args.coordinator
+        or engine == "native"
+        or args.backend == "jax"
+    ):
         import jax
 
         multiprocess = jax.process_count() > 1

@@ -1,0 +1,60 @@
+"""bench.py is the driver's measurement artifact — guard it against
+bitrot: both modes must run end to end on CPU and emit the JSON
+contract ({metric, value, unit, vs_baseline} + the timing keys)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_REPO = Path(__file__).parent.parent
+
+
+def _run_bench(extra_env):
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(_REPO),
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_REPEATS": "1",
+        "BENCH_ORACLE_SPANS": "2000",
+        **extra_env,
+    }
+    proc = subprocess.run(
+        [sys.executable, str(_REPO / "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout  # ONE JSON line on stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "spans_per_sec_ranked"
+    assert out["unit"] == "spans/s"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+    for key in ("build_ms", "rank_ms", "staging_ms"):
+        assert out[key] >= 0, key
+    return out
+
+
+def test_bench_single_window_mode():
+    # Config 1 is 1k spans — bench.py generates and caches the case on a
+    # fresh checkout in well under a second, so no cache precondition.
+    _run_bench({"BENCH_CONFIG": "1"})
+
+
+@pytest.mark.skipif(
+    not (_REPO / "bench_data" / "tl_s250000_o2000_f60000_w8").exists(),
+    reason="config-4 timeline case not cached",
+)
+def test_bench_batched_mode():
+    # The batched (vmapped multi-window) mode, reusing the cached
+    # config-4 timeline with one repeat — ~250k spans ranks in seconds
+    # on CPU.
+    _run_bench({"BENCH_CONFIG": "4"})
