@@ -95,7 +95,21 @@ def detect_batch_from_table(
     remap = slo_vocab.encode(table.svc_op_names)
     op = remap[table.svc_op[rows]]
     g_trace = table.trace_id[rows]
-    uniques, t_codes = np.unique(g_trace, return_inverse=True)
+    # Window-local trace interning: trace ids are already table-interned
+    # small ints, so a flag + prefix-rank scatter replaces the sort-based
+    # np.unique (same ascending-id order, ~5x faster at the 1M-span
+    # scale). The scatter costs O(total traces) though — for a SMALL
+    # window over a huge table (the many-window runner loop), the
+    # windowed np.unique stays cheaper, so pick per window.
+    n_total = len(table.trace_names)
+    if len(rows) * 4 < n_total:
+        uniques, t_codes = np.unique(g_trace, return_inverse=True)
+    else:
+        flags = np.zeros(n_total, dtype=bool)
+        flags[g_trace] = True
+        uniques = np.flatnonzero(flags)
+        rank = np.cumsum(flags) - 1
+        t_codes = rank[g_trace]
     n_spans = len(rows)
     s_pad = pad_to(n_spans, pad_policy, min_pad)
     batch = DetectBatch(
