@@ -108,7 +108,9 @@ def detect_batch_from_table(
         flags = np.zeros(n_total, dtype=bool)
         flags[g_trace] = True
         uniques = np.flatnonzero(flags)
-        rank = np.cumsum(flags) - 1
+        # int32 rank: trace counts fit (trace_id is int32) and the
+        # downstream DetectBatch stores int32 — half the bandwidth.
+        rank = np.cumsum(flags, dtype=np.int32) - np.int32(1)
         t_codes = rank[g_trace]
     n_spans = len(rows)
     s_pad = pad_to(n_spans, pad_policy, min_pad)
