@@ -120,6 +120,7 @@ def cmd_run(args) -> int:
     if args.distributed or args.coordinator:
         # Must precede every other jax touch (config building is safe).
         from ..parallel.distributed import (
+            coordinator_configured,
             initialize_distributed,
             is_primary,
         )
@@ -130,10 +131,17 @@ def cmd_run(args) -> int:
             process_id=args.process_id,
         )
         if not active and args.distributed:
-            log.warning(
-                "--distributed set but no coordinator configured "
-                "(flag or MICRORANK_COORDINATOR); running single-process"
-            )
+            if coordinator_configured(args.coordinator):
+                log.warning(
+                    "--distributed: runtime initialized but the world has "
+                    "a single process; running single-process"
+                )
+            else:
+                log.warning(
+                    "--distributed set but no coordinator configured "
+                    "(flag or MICRORANK_COORDINATOR); running "
+                    "single-process"
+                )
         primary = is_primary()
         if active:
             import jax
@@ -171,6 +179,16 @@ def cmd_run(args) -> int:
 
         multiprocess = jax.process_count() > 1
     from ..utils.profiling import trace_context
+
+    # A mesh only exists on the native engine's sharded path; reject the
+    # combination up front so a multi-process pandas run cannot fall
+    # through and silently drop a configured --mesh.
+    if cfg.runtime.mesh_shape is not None and engine != "native":
+        log.error(
+            "--mesh needs the native engine (the pandas pipeline has no "
+            "sharded path); rerun with --engine native"
+        )
+        return 2
 
     # In a multi-process run every process executes the same pipeline —
     # the sharded TableRCA programs are collective; only rank 0 writes
@@ -213,12 +231,6 @@ def cmd_run(args) -> int:
                 batch_windows=batch_windows,
                 resume=resume,
             )
-    elif cfg.runtime.mesh_shape is not None and not multiprocess:
-        log.error(
-            "--mesh needs the native engine (the pandas pipeline has no "
-            "sharded path); rerun with --engine native"
-        )
-        return 2
     elif multiprocess and not primary:
         # The pandas pipeline has no collectives — duplicating it on
         # every rank buys nothing and non-primary ranks would drop

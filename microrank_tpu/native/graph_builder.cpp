@@ -26,6 +26,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <exception>
+#include <mutex>
 #include <new>
 #include <optional>
 #include <system_error>
@@ -143,7 +145,7 @@ int build_threads() {
 // Run fn(lo, hi) over [0, n) split into k contiguous chunks with
 // boundaries chosen so each chunk covers ~equal WEIGHT (weights given by
 // the monotone prefix array `prefix` of length n+1). k==1 short-circuits
-// to a plain call; worker exceptions surface as bad_alloc.
+// to a plain call; the first worker exception is rethrown on the caller.
 template <typename Fn>
 void parallel_chunks(int64_t n, const int64_t* prefix, int k, Fn fn) {
   if (n <= 0) return;
@@ -162,25 +164,37 @@ void parallel_chunks(int64_t n, const int64_t* prefix, int k, Fn fn) {
   }
   for (int i = 1; i <= k; ++i) bounds[i] = std::max(bounds[i], bounds[i - 1]);
   std::vector<std::thread> pool;
-  std::vector<uint8_t> failed(k, 0);
+  std::mutex err_mu;
+  std::exception_ptr first_err;
+  auto record = [&](std::exception_ptr e) {
+    std::lock_guard<std::mutex> lk(err_mu);
+    if (!first_err) first_err = e;
+  };
   pool.reserve(k - 1);
-  for (int i = 1; i < k; ++i) {
-    pool.emplace_back([&, i] {
-      try {
-        fn(bounds[i], bounds[i + 1]);
-      } catch (...) {
-        failed[i] = 1;
-      }
-    });
+  try {
+    for (int i = 1; i < k; ++i) {
+      pool.emplace_back([&, i] {
+        try {
+          fn(bounds[i], bounds[i + 1]);
+        } catch (...) {
+          record(std::current_exception());
+        }
+      });
+    }
+  } catch (...) {
+    // Thread creation failed mid-loop (EAGAIN under resource
+    // exhaustion). Joinable threads in `pool` would std::terminate in
+    // the vector destructor during unwind — join them first, then let
+    // the caller's system_error fallback engage.
+    record(std::current_exception());
   }
   try {
     fn(bounds[0], bounds[1]);
   } catch (...) {
-    failed[0] = 1;
+    record(std::current_exception());
   }
   for (auto& th : pool) th.join();
-  for (int i = 0; i < k; ++i)
-    if (failed[i]) throw std::bad_alloc();
+  if (first_err) std::rethrow_exception(first_err);
 }
 
 void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
@@ -519,6 +533,15 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
     delete g;
     return nullptr;
   } catch (const std::system_error&) {  // thread creation failure
+    delete g;
+    return nullptr;
+  } catch (const std::exception& e) {
+    // Never cross the C ABI with an exception. Allocation/thread
+    // failures above stay silent (the Python side falls back to the
+    // numpy lane); anything else is a real bug — say what it was
+    // before reporting the generic build failure.
+    std::fprintf(stderr, "mr_build_window2: unexpected error: %s\n",
+                 e.what());
     delete g;
     return nullptr;
   }

@@ -80,6 +80,20 @@ def initialize_distributed(
     return jax.process_count() > 1
 
 
+def coordinator_configured(
+    coordinator_address: Optional[str] = None,
+) -> bool:
+    """True when a coordinator address is resolvable (explicit argument
+    or ``MICRORANK_COORDINATOR``) — the same resolution rule
+    ``initialize_distributed`` applies, exposed so callers can tell
+    "initialized but single-process world" apart from "never
+    configured" without re-implementing the env lookup."""
+    return (
+        coordinator_address is not None
+        or os.environ.get("MICRORANK_COORDINATOR") is not None
+    )
+
+
 def is_primary() -> bool:
     """True on the process that should write results (rank 0)."""
     import jax

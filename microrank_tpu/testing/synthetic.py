@@ -108,6 +108,17 @@ def _pick_faults(
         return [(int(op), int(rng.integers(0, n_pods))) for op in fault_ops]
 
     cand = [int(c) for c in candidates]
+    # The pair seed below enumerates all O(n^2) candidate pairs; at eval
+    # scale (5k ops) that is ~12M tuples and dominates case generation.
+    # The greedy selection only needs a good pair, not the global argmin,
+    # so bound the pool — 512 candidates is ~131k pairs. Small cases
+    # (every fixed-seed case generated before this cap) are unaffected:
+    # the rng is only consumed when the cap engages.
+    pool_cap = max(512, n_faults)
+    if len(cand) > pool_cap:
+        cand = sorted(
+            int(c) for c in rng.choice(cand, size=pool_cap, replace=False)
+        )
     # Root paths once per candidate — the pair loop below is O(n^2) pair
     # set-intersections, not O(n^2 * depth) parent-pointer walks.
     paths = {c: _root_path(topo.parent, c) for c in cand}
