@@ -149,6 +149,23 @@ def _time_staging() -> bool:
     return os.environ.get("BENCH_TIME_STAGING") == "1"
 
 
+def _enable_compile_cache() -> None:
+    """Persist compiled XLA programs across bench invocations (same
+    cache the CLI wires up — the driver re-runs this script cold every
+    round, and the big fused rank program costs tens of seconds to
+    compile but milliseconds to reload). BENCH_COLD_COMPILE=1 skips the
+    cache to measure a true cold compile."""
+    if os.environ.get("BENCH_COLD_COMPILE") == "1":
+        log("compile cache: disabled (BENCH_COLD_COMPILE=1)")
+        return
+    from microrank_tpu.cli.main import _enable_jit_cache
+
+    _enable_jit_cache()
+    import jax
+
+    log(f"compile cache: {jax.config.jax_compilation_cache_dir}")
+
+
 def _stage_once(graph, kernel):
     """Stage a (possibly stacked) window graph on device ONCE — the
     shared pipeline boundary both bench modes time at. Returns
@@ -270,7 +287,8 @@ def _run_batched(
 
     t0 = time.perf_counter()
     out = run_fetched()
-    log(f"first call (compile + run + fetch): {time.perf_counter() - t0:.2f}s")
+    first_s = time.perf_counter() - t0
+    log(f"first call (compile + run + fetch): {first_s:.2f}s")
     rank_times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -324,6 +342,7 @@ def _run_batched(
                 "build_ms": round(build_s * 1e3, 1),
                 "rank_ms": round(rank_s * 1e3, 1),
                 "staging_ms": round(stage_s * 1e3, 1),
+                "compile_ms": round(max(first_s - rank_s, 0.0) * 1e3, 1),
             }
         )
     )
@@ -364,6 +383,7 @@ def main() -> int:
         rank_window_device,
     )
 
+    _enable_compile_cache()
     log(f"devices: {jax.devices()}")
     if not native_available():
         log("FATAL: native span loader unavailable (g++ missing?)")
@@ -456,7 +476,8 @@ def main() -> int:
 
     t0 = time.perf_counter()
     out = run_fetched()
-    log(f"first call (compile + run + fetch): {time.perf_counter() - t0:.2f}s")
+    first_s = time.perf_counter() - t0
+    log(f"first call (compile + run + fetch): {first_s:.2f}s")
 
     rank_times = []
     for _ in range(repeats):
@@ -515,6 +536,7 @@ def main() -> int:
                 "build_ms": round(build_s * 1e3, 1),
                 "rank_ms": round(rank_s * 1e3, 1),
                 "staging_ms": round(stage_s * 1e3, 1),
+                "compile_ms": round(max(first_s - rank_s, 0.0) * 1e3, 1),
             }
         )
     )

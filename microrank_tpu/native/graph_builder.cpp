@@ -544,6 +544,9 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
                  e.what());
     delete g;
     return nullptr;
+  } catch (...) {
+    delete g;
+    return nullptr;
   }
   return g;
 }

@@ -49,8 +49,7 @@ def initialize_distributed(
     global _initialized
     import jax
 
-    if coordinator_address is None:
-        coordinator_address = os.environ.get("MICRORANK_COORDINATOR")
+    coordinator_address = _resolve_coordinator(coordinator_address)
     if num_processes is None and "MICRORANK_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["MICRORANK_NUM_PROCESSES"])
     if process_id is None and "MICRORANK_PROCESS_ID" in os.environ:
@@ -80,18 +79,23 @@ def initialize_distributed(
     return jax.process_count() > 1
 
 
+def _resolve_coordinator(
+    coordinator_address: Optional[str],
+) -> Optional[str]:
+    """The one place the coordinator address is resolved: explicit
+    argument, else ``MICRORANK_COORDINATOR``."""
+    if coordinator_address is not None:
+        return coordinator_address
+    return os.environ.get("MICRORANK_COORDINATOR")
+
+
 def coordinator_configured(
     coordinator_address: Optional[str] = None,
 ) -> bool:
-    """True when a coordinator address is resolvable (explicit argument
-    or ``MICRORANK_COORDINATOR``) — the same resolution rule
-    ``initialize_distributed`` applies, exposed so callers can tell
-    "initialized but single-process world" apart from "never
-    configured" without re-implementing the env lookup."""
-    return (
-        coordinator_address is not None
-        or os.environ.get("MICRORANK_COORDINATOR") is not None
-    )
+    """True when ``initialize_distributed`` would see a coordinator
+    address, so callers can tell "initialized but single-process world"
+    apart from "never configured"."""
+    return _resolve_coordinator(coordinator_address) is not None
 
 
 def is_primary() -> bool:
