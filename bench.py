@@ -217,8 +217,12 @@ def _analytic_iter_cost(graph, kernel):
         tp = int(p.kind.shape[-1] if p.kind.ndim > 1 else p.kind.shape[0])
         if kernel in ("packed", "packed_bf16"):
             cov_bytes = float(vp * (tp // 8))
-            ss_bytes = float(vp * int(p.ss_bits.shape[-1]))
-            vp_ss = int(p.ss_bits.shape[-1]) * 8
+            # ss_stage="edges" staging strips the host ss bitmap; the
+            # device-built packed array the loop streams has the same
+            # ceil(V/8) byte columns.
+            ss8 = int(p.ss_bits.shape[-1]) or (vp + 7) // 8
+            ss_bytes = float(vp * ss8)
+            vp_ss = ss8 * 8
             flops += 4.0 * vp * tp + 2.0 * vp * vp_ss
             bytes_ += 2.0 * cov_bytes + ss_bytes
         elif kernel == "csr":

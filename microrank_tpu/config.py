@@ -153,6 +153,16 @@ class RuntimeConfig:
     # device execution with window N+1's host graph build (jax async
     # dispatch); 1 restores fully synchronous per-window execution.
     pipeline_depth: int = 2
+    # Run device staging (device_put + program dispatch) and result
+    # fetches on worker threads so their RPC latency — ~90 ms apiece on
+    # tunneled-TPU runtimes — overlaps the main thread's detect/build
+    # work instead of serializing with it. The main thread still does all
+    # host compute; the workers only hold latency-bound PJRT calls.
+    # Single-process only (a multi-process mesh needs every rank to issue
+    # collectives in program order, which per-process worker threads
+    # cannot guarantee against the fetch allgathers); ignored with a
+    # warning there.
+    async_dispatch: bool = False
 
 
 @dataclass(frozen=True)

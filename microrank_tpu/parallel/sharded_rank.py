@@ -93,9 +93,11 @@ def stack_window_graphs(
         # placeholders (all-or-none per view family; the batched kernel
         # chooser treats 0-sized views as "not available").
         have_csr = all(p.inc_indptr_op.shape[0] for p in parts)
-        have_bits = all(
-            p.cov_bits.shape[1] and p.ss_bits.shape[1] for p in parts
-        )
+        # The two bitmap families degrade independently: the default
+        # staging profile strips ss_bits (device rebuilds it from the
+        # edge list) while cov_bits stays host-packed.
+        have_cov = all(p.cov_bits.shape[1] for p in parts)
+        have_ss = all(p.ss_bits.shape[1] for p in parts)
         # indptr re-padding: a row-offset array padded with its last real
         # value keeps every added row an empty range (the arrays end at the
         # true entry count, so repeating indptr[-1] is exact).
@@ -146,14 +148,14 @@ def stack_window_graphs(
                 np.stack(
                     [_pad2d(p.cov_bits, v, (t + 7) // 8) for p in parts]
                 )
-                if have_bits
+                if have_cov
                 else np.zeros((len(parts), v, 0), np.uint8)
             ),
             ss_bits=(
                 np.stack(
                     [_pad2d(p.ss_bits, v, (v + 7) // 8) for p in parts]
                 )
-                if have_bits
+                if have_ss
                 else np.zeros((len(parts), v, 0), np.uint8)
             ),
             inv_tracelen=np.stack(
@@ -195,18 +197,20 @@ def _partition_specs(
         # coverage bitmap ([V, T8/S] bytes) plus the matching [T/S]
         # blocks of the trace-axis vectors (rv lives sharded through the
         # whole iteration); sv-sized arrays and the call-graph bitmap
-        # replicate. The COO entry arrays are typically stripped to
-        # [B, 0] by device_subset before staging — the entry spec on a
-        # zero-length axis is inert.
+        # replicate — including the ss edge list, which the default
+        # ss_stage="edges" staging keeps so each device can rebuild the
+        # replicated b_ss (pack_edge_bits). The COO incidence arrays are
+        # stripped to [B, 0] by device_subset before staging — the entry
+        # spec on a zero-length axis is inert.
         trace = P(window_axis, shard_axis)
         return PartitionGraph(
             inc_op=entry,
             inc_trace=entry,
             sr_val=entry,
             rs_val=entry,
-            ss_child=entry,
-            ss_parent=entry,
-            ss_val=entry,
+            ss_child=per_window,
+            ss_parent=per_window,
+            ss_val=per_window,
             inc_trace_opmajor=entry,
             sr_val_opmajor=entry,
             inc_indptr_op=per_window,

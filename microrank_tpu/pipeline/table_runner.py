@@ -118,13 +118,10 @@ class TableRCA:
             len(self.slo_vocab),
         )
 
-    def dispatch_rank(self, table, mask, nrm_codes, abn_codes):
-        """Build one window's graph and dispatch its device rank program.
-
-        Returns opaque handles (device arrays still in flight — jax
-        dispatch is async) to pass to ``finalize_rank``. The host is free
-        to build the next window while the device executes this one.
-        """
+    def prepare_rank(self, table, mask, nrm_codes, abn_codes):
+        """Host half of a window rank: build the graph (pure host compute,
+        no PJRT calls). Returns (graph, op_names, kernel) for
+        ``launch_rank`` — the seam the async pipeline splits at."""
         from ..graph.build import aux_for_kernel
 
         cfg = self.config
@@ -152,8 +149,6 @@ class TableRCA:
             dense_budget_bytes=cfg.runtime.dense_budget_bytes,
         )
         if self._mesh is not None:
-            from ..parallel.sharded_rank import rank_windows_sharded
-
             if int(self._mesh.devices.shape[0]) != 1:
                 raise ValueError(
                     "per-window dispatch needs a (1, N) / (N,) mesh; a "
@@ -162,21 +157,30 @@ class TableRCA:
                 )
             if shard_kernel == "auto":
                 shard_kernel = self._resolve_shard_kernel([graph])
-            batched = self._stage_sharded([graph], shard_kernel)
+        else:
+            shard_kernel = cfg.runtime.kernel
+            if shard_kernel == "auto":
+                shard_kernel = choose_kernel(graph)
+        return graph, op_names, shard_kernel
+
+    def launch_rank(self, graph, op_names, kernel):
+        """Device half of a window rank: stage the graph (device_put /
+        global_put) and dispatch the jitted program. Latency-bound PJRT
+        calls only — safe to run on a staging worker thread. Returns
+        opaque handles (device arrays still in flight — jax dispatch is
+        async) to pass to ``finalize_rank``."""
+        cfg = self.config
+        if self._mesh is not None:
+            from ..parallel.sharded_rank import rank_windows_sharded
+
+            batched = self._stage_sharded([graph], kernel)
             ti, ts, nv = rank_windows_sharded(
-                batched,
-                cfg.pagerank,
-                cfg.spectrum,
-                self._mesh,
-                shard_kernel,
+                batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
             )
             top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
         else:
             from ..rank_backends.jax_tpu import device_subset
 
-            kernel = cfg.runtime.kernel
-            if kernel == "auto":
-                kernel = choose_kernel(graph)
             top_idx, top_scores, n_valid = rank_window_device(
                 jax.device_put(device_subset(graph, kernel)),
                 cfg.pagerank,
@@ -185,6 +189,17 @@ class TableRCA:
                 kernel,
             )
         return top_idx, top_scores, n_valid, op_names
+
+    def dispatch_rank(self, table, mask, nrm_codes, abn_codes):
+        """Build one window's graph and dispatch its device rank program.
+
+        Returns opaque handles (device arrays still in flight — jax
+        dispatch is async) to pass to ``finalize_rank``. The host is free
+        to build the next window while the device executes this one.
+        """
+        return self.launch_rank(
+            *self.prepare_rank(table, mask, nrm_codes, abn_codes)
+        )
 
     def finalize_rank(self, handles):
         """Force a dispatched rank's results to host (blocks if needed).
@@ -277,9 +292,29 @@ class TableRCA:
                 )
                 self.log.info("resuming window loop at %s", saved)
 
+        # Async dispatch: staging (device_put + dispatch) and fetches run
+        # on one worker thread each, so their RPC latency overlaps the
+        # main thread's detect/build. Multi-process meshes must issue
+        # collectives in program order on every rank, which worker
+        # threads cannot guarantee — force synchronous there.
+        async_mode = bool(cfg.runtime.async_dispatch) and not batch_windows
+        if async_mode and jax.process_count() > 1:
+            self.log.warning(
+                "async_dispatch is single-process only (collective "
+                "ordering); running synchronously"
+            )
+            async_mode = False
+        stage_pool = fetch_pool = None
+        if async_mode:
+            from concurrent.futures import ThreadPoolExecutor
+
+            stage_pool = ThreadPoolExecutor(1, "mr-stage")
+            fetch_pool = ThreadPoolExecutor(1, "mr-fetch")
+
         results: List[WindowResult] = []
         pending = []  # (result, mask, nrm, abn) for deferred batched rank
-        inflight = []  # (result, handles, timings) dispatched, not forced
+        inflight = []  # (result, handles-or-future, timings) dispatched
+        finishing = []  # (result, finalize future, timings) async fetches
         emitted = 0  # results[:emitted] already sent to the sink
         next_cursor = {}  # id(result) -> post-advance window position (µs)
 
@@ -301,7 +336,13 @@ class TableRCA:
             nonlocal emitted
             if sink is None or batch_windows:
                 return
-            stop = id(inflight[0][0]) if inflight else None
+            # finishing entries are older than inflight entries.
+            if finishing:
+                stop = id(finishing[0][0])
+            elif inflight:
+                stop = id(inflight[0][0])
+            else:
+                stop = None
             while emitted < len(results):
                 r = results[emitted]
                 if id(r) == stop:
@@ -309,14 +350,65 @@ class TableRCA:
                 _emit(r)
                 emitted += 1
 
-        def _finalize_one():
-            result, handles, timings = inflight.pop(0)
-            with timings.stage("rank_wait"):
-                names, scores = self.finalize_rank(handles)
+        def _set_ranking(result, timings, names, scores):
             result.ranking = list(zip(names, scores))
             result.timings = timings.as_dict()
             _emit_ready()
 
+        def _complete_one():
+            """Join the oldest async fetch and emit its window."""
+            result, fut, timings = finishing.pop(0)
+            with timings.stage("rank_wait"):
+                names, scores = fut.result()
+            _set_ranking(result, timings, names, scores)
+
+        def _finalize_one():
+            result, handles, timings = inflight.pop(0)
+            if fetch_pool is not None:
+                # handles is the staging future: chain its join with the
+                # fetch on the fetch worker so the device_get RPC of
+                # window N overlaps the device_put of window N+1.
+                fut = fetch_pool.submit(
+                    lambda h=handles: self.finalize_rank(h.result())
+                )
+                finishing.append((result, fut, timings))
+                if len(finishing) > depth:
+                    _complete_one()
+                return
+            with timings.stage("rank_wait"):
+                names, scores = self.finalize_rank(handles)
+            _set_ranking(result, timings, names, scores)
+
+        try:
+            self._window_loop(
+                table, current, end, detect_us, skip_us, depth,
+                batch_windows, results, pending, inflight, finishing,
+                next_cursor, stage_pool, _finalize_one, _complete_one,
+                _emit_ready,
+            )
+        finally:
+            if stage_pool is not None:
+                stage_pool.shutdown(wait=False, cancel_futures=True)
+                fetch_pool.shutdown(wait=False, cancel_futures=True)
+
+        if batch_windows and pending:
+            self._rank_pending(table, pending)
+        if batch_windows and sink is not None:
+            for r in results:
+                _emit(r)
+        if cursor is not None:
+            cursor.clear()
+        return results
+
+    def _window_loop(
+        self, table, current, end, detect_us, skip_us, depth,
+        batch_windows, results, pending, inflight, finishing,
+        next_cursor, stage_pool, _finalize_one, _complete_one,
+        _emit_ready,
+    ):
+        """The sliding-window detect/dispatch loop of run() (factored out
+        so the worker pools shut down on any exit path)."""
+        cfg = self.config
         while current < end:
             w0, w1 = current, current + detect_us
             timings = StageTimings()
@@ -349,9 +441,17 @@ class TableRCA:
                         pending.append((result, mask, nrm, abn))
                     else:
                         with timings.stage("rank_dispatch"):
-                            handles = self.dispatch_rank(
-                                table, mask, nrm, abn
-                            )
+                            if stage_pool is not None:
+                                prep = self.prepare_rank(
+                                    table, mask, nrm, abn
+                                )
+                                handles = stage_pool.submit(
+                                    self.launch_rank, *prep
+                                )
+                            else:
+                                handles = self.dispatch_rank(
+                                    table, mask, nrm, abn
+                                )
                         inflight.append((result, handles, timings))
                         if len(inflight) >= depth:
                             _finalize_one()

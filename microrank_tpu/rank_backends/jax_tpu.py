@@ -96,6 +96,31 @@ def unpack_bits(bits, n_cols: int, dtype=jnp.float32):
     )
 
 
+def pack_edge_bits(child, parent, n_live, n_rows: int):
+    """Device-side twin of the host bit-scatter (graph.build._scatter_bits):
+    build the uint8[n_rows, ceil(n_rows/8)] call-edge bitmap from the
+    (child, parent) edge list with ONE scatter-add of per-edge byte values
+    (big-endian bit order, matching np.packbits).
+
+    Edges are unique (child, parent) pairs, so adding each edge's power of
+    two composes bytes exactly; entries past ``n_live`` are padding and
+    contribute 0. This is the staging-side inverse trade of unpack_bits:
+    the edge list is ~V*V/(8*C) times smaller than the bitmap (50-100x at
+    the 1M-span scale), so shipping edges and packing on device cuts
+    host->device bytes by ~10x while the per-iteration HBM traffic — the
+    packed array the fori_loop streams — stays identical.
+    """
+    c_pad = child.shape[0]
+    live = jnp.arange(c_pad, dtype=jnp.int32) < n_live
+    bitval = jnp.where(live, jnp.int32(1) << (7 - (parent % 8)), 0)
+    packed = (
+        jnp.zeros((n_rows, (n_rows + 7) // 8), jnp.int32)
+        .at[child, parent // 8]
+        .add(bitval, mode="promise_in_bounds")
+    )
+    return packed.astype(jnp.uint8)
+
+
 def densify(g: PartitionGraph):
     """Scatter the COO entries into the dense reference-shaped matrices
     (pagerank.py:19-24) on device: [V, T] p_sr, [T, V] p_rs, [V, V] p_ss.
@@ -247,7 +272,22 @@ def _partition_setup(
             jnp.bfloat16 if kernel == "packed_bf16" else jnp.float32
         )
         b_cov = unpack_bits(g.cov_bits, t_pad, mat_dtype)
-        b_ss = unpack_bits(g.ss_bits, v, mat_dtype)
+        # The call-edge bitmap arrives either host-packed (ss_stage="bits")
+        # or — the default staging profile — as the raw edge list, packed
+        # on device by one scatter-add (pack_edge_bits): same uint8 array,
+        # ~10x fewer host->device bytes. Loop-invariant, so XLA builds it
+        # once per program, not per iteration.
+        if g.ss_bits.shape[-1] > 0:
+            ss_packed = g.ss_bits
+        elif g.ss_child.shape[-1] > 0:
+            ss_packed = pack_edge_bits(g.ss_child, g.ss_parent, g.n_ss, v)
+        else:
+            raise ValueError(
+                "kernel='packed' needs the call-edge bitmap or edge list, "
+                "but both were stripped — stage with device_subset(graph, "
+                "'packed') or build with aux='packed'/'all'"
+            )
+        b_ss = unpack_bits(ss_packed, v, mat_dtype)
         w_len = g.inv_tracelen
         w_cov = g.inv_cov_dup
         w_out = g.inv_outdeg
@@ -678,34 +718,47 @@ rank_window_all_methods_device = jax.jit(
 )
 
 
+_PACKED_UNUSED = (
+    # The packed kernel reads only the bitmaps/edge list, inverse vectors,
+    # and the per-axis stats; the COO incidence arrays (the big ones —
+    # ~19 of 28 MB at the 1M-span scale) never reach the traced branch.
+    "inc_op", "inc_trace", "sr_val", "rs_val", "ss_val",
+    "inc_trace_opmajor", "sr_val_opmajor",
+)
 _KERNEL_UNUSED_FIELDS = {
-    # The packed kernel reads only the bitmaps, inverse vectors, and the
-    # per-axis stats; the COO entry arrays (the big ones — ~19 of 28 MB at
-    # the 1M-span scale) never reach the traced branch.
-    "packed": (
-        "inc_op", "inc_trace", "sr_val", "rs_val",
-        "ss_child", "ss_parent", "ss_val",
-        "inc_trace_opmajor", "sr_val_opmajor",
-    ),
-    "packed_bf16": (
-        "inc_op", "inc_trace", "sr_val", "rs_val",
-        "ss_child", "ss_parent", "ss_val",
-        "inc_trace_opmajor", "sr_val_opmajor",
-    ),
+    # Default ss_stage="edges": the V*V/8-byte call-edge bitmap stays on
+    # the host too — the kernel rebuilds it on device from the (much
+    # smaller) ss edge list (pack_edge_bits). ~10x fewer staged bytes at
+    # the 1M-span scale; ss_stage="bits" restores the host-packed profile.
+    ("packed", "edges"): _PACKED_UNUSED + ("ss_bits",),
+    ("packed_bf16", "edges"): _PACKED_UNUSED + ("ss_bits",),
+    ("packed", "bits"): _PACKED_UNUSED + ("ss_child", "ss_parent"),
+    ("packed_bf16", "bits"): _PACKED_UNUSED + ("ss_child", "ss_parent"),
     # The csr kernel reads rs_val+inc_op (trace-major), ss_val+ss_parent,
     # and the CSR views — not inc_trace/ss_child/sr_val (their information
     # lives in the indptrs and the op-major copies) or the bitmaps
     # (already empty under the aux policy).
-    "csr": ("inc_trace", "ss_child", "sr_val", "cov_bits", "ss_bits"),
+    ("csr", "edges"): ("inc_trace", "ss_child", "sr_val", "cov_bits", "ss_bits"),
+    ("csr", "bits"): ("inc_trace", "ss_child", "sr_val", "cov_bits", "ss_bits"),
 }
 
 
-def device_subset(graph: WindowGraph, kernel: str) -> WindowGraph:
+def device_subset(
+    graph: WindowGraph, kernel: str, ss_stage: str = "edges"
+) -> WindowGraph:
     """Drop the fields ``kernel`` never reads (replaced by empty arrays)
-    before staging the graph on device — halves host->device bytes for the
-    packed kernel. Safe under jit: the kernel string is static, so the
-    dropped fields' branches are never traced."""
-    fields = _KERNEL_UNUSED_FIELDS.get(kernel, ())
+    before staging the graph on device — ~10x fewer host->device bytes for
+    the packed kernel. Safe under jit: the kernel string is static, so the
+    dropped fields' branches are never traced.
+
+    ``ss_stage`` (packed kernels): "edges" (default) keeps the call-edge
+    list and drops the host-packed ss bitmap — the device program rebuilds
+    it (pack_edge_bits) from ~50-100x fewer bytes; "bits" stages the
+    host-packed bitmap and drops the edge list (no device scatter).
+    """
+    if ss_stage not in ("edges", "bits"):
+        raise ValueError(f"unknown ss_stage {ss_stage!r}")
+    fields = _KERNEL_UNUSED_FIELDS.get((kernel, ss_stage), ())
     if not fields:
         return graph
 

@@ -159,6 +159,72 @@ def test_scatterfree_kernels_match_coo(small_case, kernel):
                 assert abs(v - sc_k[op]) <= 1e-4 * max(abs(v), 1e-12), op
 
 
+@pytest.mark.parametrize("v", [8, 13, 64, 130])
+def test_pack_edge_bits_matches_host_packing(v):
+    # The device-side scatter-packed call-edge bitmap must be byte-
+    # identical to the host packbits path for any vocab size (including
+    # non-multiples of 8) and any padding tail.
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph.build import _scatter_bits
+    from microrank_tpu.rank_backends.jax_tpu import pack_edge_bits
+
+    rng = np.random.default_rng(v)
+    n_edges = min(v * 3, v * v // 2)
+    pairs = rng.choice(v * v, size=n_edges, replace=False)
+    child = (pairs // v).astype(np.int32)
+    parent = (pairs % v).astype(np.int32)
+    host = _scatter_bits(child, parent, v, v)
+    c_pad = n_edges + 5  # padded tail entries at index (0, 0), value 0
+    device = pack_edge_bits(
+        jnp.asarray(np.pad(child, (0, 5))),
+        jnp.asarray(np.pad(parent, (0, 5))),
+        jnp.int32(n_edges),
+        v,
+    )
+    np.testing.assert_array_equal(host, np.asarray(device))
+
+
+@pytest.mark.parametrize("ss_stage", ["edges", "bits"])
+def test_packed_ss_staging_profiles_identical(small_case, ss_stage):
+    # ss_stage="edges" ships the edge list and packs the bitmap on device;
+    # "bits" ships the host-packed bitmap. Same uint8 array either way, so
+    # rankings AND scores must be bit-identical between the profiles.
+    import jax
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import (
+        device_subset,
+        rank_window_device,
+    )
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(
+        small_case.abnormal, nrm, abn, aux="packed"
+    )
+    outs = {}
+    for stage in ("edges", "bits"):
+        sub = device_subset(graph, "packed", ss_stage=stage)
+        if stage == "edges":
+            assert sub.normal.ss_bits.shape[-1] == 0
+            assert sub.normal.ss_child.shape[-1] > 0
+        else:
+            assert sub.normal.ss_bits.shape[-1] > 0
+            assert sub.normal.ss_child.shape[-1] == 0
+        outs[stage] = jax.device_get(
+            rank_window_device(
+                jax.device_put(sub), cfg.pagerank, cfg.spectrum, None,
+                "packed",
+            )
+        )
+    ti_e, ts_e, nv_e = outs["edges"]
+    ti_b, ts_b, nv_b = outs[ss_stage]
+    np.testing.assert_array_equal(ti_e, ti_b)
+    np.testing.assert_array_equal(ts_e, ts_b)
+    assert int(nv_e) == int(nv_b)
+
+
 def test_convergence_tolerance(small_case):
     # tol-based early exit: a tight tolerance with a high iteration cap
     # must agree with the reference's fixed 25 iterations on Top-1 (the
