@@ -467,16 +467,9 @@ class TableRCA:
 
         while inflight:
             _finalize_one()
+        while finishing:
+            _complete_one()
         _emit_ready()
-
-        if batch_windows and pending:
-            self._rank_pending(table, pending)
-        if batch_windows and sink is not None:
-            for r in results:
-                _emit(r)
-        if cursor is not None:
-            cursor.clear()
-        return results
 
     def _rank_pending(self, table, pending) -> None:
         """Phase 2 of batch_windows: one vmapped rank over all windows —
