@@ -12,7 +12,6 @@ from __future__ import annotations
 from typing import List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..config import MicroRankConfig
@@ -24,7 +23,7 @@ from ..graph.table_ops import (
     window_rows,
 )
 from ..parallel.sharded_rank import SHARD_KERNELS
-from ..rank_backends.jax_tpu import choose_kernel, rank_window_device
+from ..rank_backends.jax_tpu import choose_kernel
 from ..utils.logging import get_logger
 from ..utils.profiling import StageTimings
 from .results import ResultSink, WindowResult
@@ -179,14 +178,15 @@ class TableRCA:
             )
             top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
         else:
+            from ..rank_backends.blob import stage_rank_window
             from ..rank_backends.jax_tpu import device_subset
 
-            top_idx, top_scores, n_valid = rank_window_device(
-                jax.device_put(device_subset(graph, kernel)),
+            top_idx, top_scores, n_valid = stage_rank_window(
+                device_subset(graph, kernel),
                 cfg.pagerank,
                 cfg.spectrum,
-                None,
                 kernel,
+                cfg.runtime.blob_staging,
             )
         return top_idx, top_scores, n_valid, op_names
 
@@ -477,7 +477,6 @@ class TableRCA:
         configured (the windows axis splits the batch, the shard axis
         splits each window's graph), vmapped single-device otherwise."""
         from ..parallel.sharded_rank import (
-            rank_windows_batched,
             rank_windows_sharded,
             stack_window_graphs,
         )
@@ -525,9 +524,18 @@ class TableRCA:
                     batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
                 )
             else:
+                from ..rank_backends.blob import stage_rank_windows_batched
+                from ..rank_backends.jax_tpu import device_subset
+
                 stacked = stack_window_graphs(graphs)
-                top_idx, top_scores, n_valid = rank_windows_batched(
-                    stacked, cfg.pagerank, cfg.spectrum, kernel
+                if kernel == "auto":
+                    kernel = choose_kernel(stacked)
+                top_idx, top_scores, n_valid = stage_rank_windows_batched(
+                    device_subset(stacked, kernel),
+                    cfg.pagerank,
+                    cfg.spectrum,
+                    kernel,
+                    cfg.runtime.blob_staging,
                 )
             # One batched fetch: per-buffer transfers each pay an RPC
             # round trip on tunneled-TPU runtimes.

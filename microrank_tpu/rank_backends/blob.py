@@ -1,0 +1,164 @@
+"""Single-buffer host->device staging for window graphs.
+
+A ``WindowGraph`` is ~50 leaf arrays; staging it with ``jax.device_put``
+issues one transfer per leaf, and on tunneled-TPU runtimes every transfer
+pays a full RPC round trip (~60-90 ms measured) regardless of size — round
+3 measured 5 MB staged in 1,675 ms, pure per-transfer latency. Here the
+whole graph is packed into ONE uint32 buffer on the host (a memcpy),
+shipped in ONE transfer, and re-sliced into the graph's leaves *inside*
+the jitted rank program: the layout (field offsets/shapes/dtypes) is a
+static jit argument, so the unpack lowers to free slices + same-width
+bitcasts that XLA fuses into the consumers.
+
+No reference counterpart (the reference never crosses a device boundary —
+SURVEY.md C18/C19); this is the TPU-native answer to its in-process numpy
+arrays.
+
+Word format: little-endian byte order within each uint32 word (the host
+packs via a uint8 view of the word buffer; sub-word dtypes are decoded on
+device with shift/mask arithmetic against that order, never bitcasts, so
+device endianness is irrelevant). 4-byte dtypes round-trip as same-width
+bitcasts, which are bit-pattern-exact.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..graph.structures import PartitionGraph, WindowGraph
+
+# (field, dtype str, shape, word offset, word count) per leaf, one tuple
+# per partition, normal first. Hashable -> usable as a static jit arg;
+# offsets are a pure function of the (already static) padded shapes, so
+# blob programs recompile exactly when the non-blob ones would.
+BlobLayout = Tuple[Tuple[Tuple[str, str, Tuple[int, ...], int, int], ...], ...]
+
+_WORD = 4
+
+
+def _leaf_entries(part: PartitionGraph, off: int):
+    entries = []
+    for f in PartitionGraph._fields:
+        arr = np.asarray(getattr(part, f))
+        n_words = (arr.nbytes + _WORD - 1) // _WORD
+        entries.append((f, str(arr.dtype), tuple(arr.shape), off, n_words))
+        off += n_words
+    return tuple(entries), off
+
+
+def pack_graph_blob(graph: WindowGraph) -> Tuple[np.ndarray, BlobLayout]:
+    """Host side: one uint32 buffer + the static layout describing it."""
+    n_entries, off = _leaf_entries(graph.normal, 0)
+    a_entries, off = _leaf_entries(graph.abnormal, off)
+    layout: BlobLayout = (n_entries, a_entries)
+    blob = np.zeros(max(off, 1), np.uint32)
+    u8 = blob.view(np.uint8)
+    for part, entries in ((graph.normal, n_entries), (graph.abnormal, a_entries)):
+        for f, _, _, o, _ in entries:
+            b = np.ascontiguousarray(getattr(part, f)).view(np.uint8).reshape(-1)
+            u8[o * _WORD : o * _WORD + b.size] = b
+    return blob, layout
+
+
+def _decode_leaf(blob, dtype_str: str, shape: Tuple[int, ...], off: int, n_words: int):
+    w = lax.slice(blob, (off,), (off + n_words,))
+    if dtype_str == "float32":
+        return lax.bitcast_convert_type(w, jnp.float32).reshape(shape)
+    if dtype_str == "int32":
+        return lax.bitcast_convert_type(w, jnp.int32).reshape(shape)
+    if dtype_str in ("uint8", "bool"):
+        n = math.prod(shape)
+        shifts = jnp.arange(4, dtype=jnp.uint32) * 8
+        by = ((w[:, None] >> shifts) & jnp.uint32(0xFF)).astype(jnp.uint8)
+        a = by.reshape(-1)[:n].reshape(shape)
+        return a != 0 if dtype_str == "bool" else a
+    raise TypeError(f"blob staging: unsupported leaf dtype {dtype_str!r}")
+
+
+def unpack_graph_blob(blob, layout: BlobLayout) -> WindowGraph:
+    """Device side (traced): rebuild the WindowGraph from the blob."""
+    parts = [
+        PartitionGraph(*(_decode_leaf(blob, *e[1:]) for e in entries))
+        for entries in layout
+    ]
+    return WindowGraph(normal=parts[0], abnormal=parts[1])
+
+
+def rank_window_blob_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, psum_axis=None, kernel="coo"
+):
+    from .jax_tpu import rank_window_core
+
+    graph = unpack_graph_blob(blob, layout)
+    return rank_window_core(graph, pagerank_cfg, spectrum_cfg, psum_axis, kernel)
+
+
+rank_window_blob_device = jax.jit(
+    rank_window_blob_core, static_argnums=(1, 2, 3, 4, 5)
+)
+
+
+def rank_windows_batched_blob_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
+):
+    from .jax_tpu import rank_window_core
+
+    graph = unpack_graph_blob(blob, layout)
+    return jax.vmap(
+        lambda g: rank_window_core(g, pagerank_cfg, spectrum_cfg, None, kernel)
+    )(graph)
+
+
+rank_windows_batched_blob_device = jax.jit(
+    rank_windows_batched_blob_core, static_argnums=(1, 2, 3, 4)
+)
+
+
+def stage_rank_blob(graph: WindowGraph, pagerank_cfg, spectrum_cfg, kernel):
+    """Pack + single-transfer stage + dispatch one window's rank program.
+
+    Single-device twin of jax_tpu.rank_window_device over device_put; the
+    sharded path keeps global_put (shards need per-device placement the
+    single blob cannot express).
+    """
+    blob, layout = pack_graph_blob(graph)
+    return rank_window_blob_device(
+        jax.device_put(blob), layout, pagerank_cfg, spectrum_cfg, None, kernel
+    )
+
+
+def stage_rank_window(
+    graph: WindowGraph, pagerank_cfg, spectrum_cfg, kernel, blob: bool
+):
+    """The one single-device stage+dispatch seam both the backend
+    (JaxBackend.rank_window) and the pipeline (TableRCA.launch_rank)
+    call: blob staging when enabled, per-leaf device_put otherwise. The
+    graph should already be device_subset-stripped for ``kernel``."""
+    if blob:
+        return stage_rank_blob(graph, pagerank_cfg, spectrum_cfg, kernel)
+    from .jax_tpu import rank_window_device
+
+    return rank_window_device(
+        jax.device_put(graph), pagerank_cfg, spectrum_cfg, None, kernel
+    )
+
+
+def stage_rank_windows_batched(
+    batched: WindowGraph, pagerank_cfg, spectrum_cfg, kernel, blob: bool
+):
+    """Batched twin of stage_rank_window (one vmapped program over a
+    stacked graph). The stacked graph should already be subset-stripped."""
+    if blob:
+        blob_arr, layout = pack_graph_blob(batched)
+        return rank_windows_batched_blob_device(
+            jax.device_put(blob_arr), layout, pagerank_cfg, spectrum_cfg, kernel
+        )
+    from ..parallel.sharded_rank import rank_windows_batched
+
+    return rank_windows_batched(batched, pagerank_cfg, spectrum_cfg, kernel)

@@ -831,12 +831,14 @@ class JaxBackend:
         kernel = rt.kernel
         if kernel == "auto":
             kernel = choose_kernel(graph)
-        top_idx, top_scores, n_valid = rank_window_device(
-            jax.device_put(device_subset(graph, kernel)),
+        from .blob import stage_rank_window
+
+        top_idx, top_scores, n_valid = stage_rank_window(
+            device_subset(graph, kernel),
             self.config.pagerank,
             self.config.spectrum,
-            None,
             kernel,
+            rt.blob_staging,
         )
         # One batched fetch — piecemeal int()/float() conversions on device
         # arrays each pay a full RPC round trip on tunneled-TPU runtimes.

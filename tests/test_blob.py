@@ -1,0 +1,90 @@
+"""Blob staging (rank_backends.blob): the single-transfer device path.
+
+The pack/unpack pair must be a bit-exact identity over every leaf dtype
+(float32/int32/uint8/bool and the 0-d extents), and the blob rank program
+must return exactly what the per-leaf-staged program returns — same jitted
+math, different transport.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.graph.build import aux_for_kernel, build_window_graph
+from microrank_tpu.rank_backends.blob import (
+    pack_graph_blob,
+    rank_window_blob_device,
+    unpack_graph_blob,
+)
+from microrank_tpu.rank_backends.jax_tpu import (
+    choose_kernel,
+    device_subset,
+    rank_window_device,
+)
+
+
+def _graph_for(case, kernel="auto", **build_kw):
+    nrm, abn = partition_case(case)
+    graph, op_names, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux=aux_for_kernel(kernel), **build_kw
+    )
+    return graph, op_names
+
+
+def test_blob_roundtrip_bit_exact(small_case):
+    graph, _ = _graph_for(small_case)
+    blob, layout = pack_graph_blob(graph)
+    assert blob.dtype == np.uint32
+    out = jax.jit(unpack_graph_blob, static_argnums=1)(blob, layout)
+    for part_name in ("normal", "abnormal"):
+        src, dst = getattr(graph, part_name), getattr(out, part_name)
+        for f, a, b in zip(src._fields, src, dst):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape, f"{part_name}.{f} shape"
+            assert a.dtype == b.dtype, f"{part_name}.{f} dtype"
+            # Bitwise equality, including float32 (same-width bitcasts).
+            np.testing.assert_array_equal(
+                np.atleast_1d(a).view(np.uint8),
+                np.atleast_1d(b).view(np.uint8),
+                err_msg=f"{part_name}.{f}",
+            )
+
+
+def test_blob_roundtrip_stripped_fields(small_case):
+    # device_subset replaces unused leaves with 0-width arrays; the blob
+    # must carry them (0 words) and restore the 0-width shapes.
+    graph, _ = _graph_for(small_case, kernel="packed")
+    sub = device_subset(graph, "packed")
+    blob, layout = pack_graph_blob(sub)
+    out = jax.jit(unpack_graph_blob, static_argnums=1)(blob, layout)
+    assert out.normal.inc_op.shape == sub.normal.inc_op.shape
+    assert int(np.asarray(out.abnormal.n_traces)) == int(
+        np.asarray(sub.abnormal.n_traces)
+    )
+
+
+@pytest.mark.parametrize("kernel", ["packed", "csr", "coo"])
+def test_blob_rank_matches_per_leaf_staging(small_case, kernel):
+    cfg = MicroRankConfig()
+    graph, _ = _graph_for(small_case, kernel=kernel)
+    if kernel == "packed" and choose_kernel(graph) != "packed":
+        pytest.skip("packed aux not built at this size")
+    sub = device_subset(graph, kernel)
+    ref = rank_window_device(
+        jax.device_put(sub), cfg.pagerank, cfg.spectrum, None, kernel
+    )
+    blob, layout = pack_graph_blob(sub)
+    got = rank_window_blob_device(
+        jax.device_put(blob), layout, cfg.pagerank, cfg.spectrum, None, kernel
+    )
+    # Same ranking and count exactly; scores only to float32 closeness —
+    # the blob program is a different XLA program, so fusion may reorder
+    # float reductions by a ulp (the unpack itself is bit-exact, see
+    # test_blob_roundtrip_bit_exact).
+    np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+    np.testing.assert_allclose(
+        np.asarray(ref[1]), np.asarray(got[1]), rtol=1e-5
+    )
+    assert int(ref[2]) == int(got[2])
