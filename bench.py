@@ -23,7 +23,8 @@ generated once and cached under bench_data/.
 Config via env: BENCH_CONFIG=1..5 selects a BASELINE.json workload preset
 (default 5 = 1M spans / 5k ops); BENCH_SPANS / BENCH_OPS override the
 preset's sizes; BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000),
-BENCH_KERNEL (auto|packed|packed_bf16|csr|coo|dense|dense_bf16|pallas),
+BENCH_KERNEL
+(auto|packed|packed_bf16|packed_blocked|csr|coo|dense|dense_bf16|pallas),
 BENCH_FAULT_MS (60000), BENCH_BATCH (preset-dependent; 1 disables),
 Host->device staging is part of the headline value BY DEFAULT (round 4
 on; BENCH_TIME_STAGING=0 excludes it to reproduce the r1-r3
@@ -277,7 +278,10 @@ def _analytic_iter_cost(graph, kernel):
         vp = int(p.cov_unique.shape[-1] if p.cov_unique.ndim > 1
                  else p.cov_unique.shape[0])
         tp = int(p.kind.shape[-1] if p.kind.ndim > 1 else p.kind.shape[0])
-        if kernel in ("packed", "packed_bf16"):
+        if kernel in ("packed", "packed_bf16", "packed_blocked"):
+            # packed_blocked streams the same packed bytes per iteration
+            # (one unpack per column block, both directions share it);
+            # the model is identical — measured deltas are scan overhead.
             cov_bytes = float(vp * (tp // 8))
             # ss_stage="edges" staging strips the host ss bitmap; the
             # device-built packed array the loop streams has the same
@@ -692,19 +696,20 @@ def main() -> int:
             )
 
         try:
-            if kernel in ("packed", "packed_bf16", "csr"):
+            if kernel in ("packed", "packed_bf16", "packed_blocked", "csr"):
                 device_profile[kernel] = _profile_device_time(
                     run_iters, cfg.pagerank.iterations, rank_s, graph,
                     kernel, repeats,
                 )
-            for other in ("csr", "packed_bf16"):
+            for other in ("csr", "packed_bf16", "packed_blocked"):
                 if other == kernel or other in device_profile:
                     continue
-                # Forced aux builds ignore the dense-bitmap budget the
-                # auto policy applies — skip kernels whose views would
-                # blow it rather than OOM a diagnostic.
+                # Forced aux builds ignore the budgets the auto policy
+                # applies — skip kernels whose views/intermediates would
+                # blow them rather than OOM a diagnostic.
                 from microrank_tpu.graph.build import (
                     DEFAULT_DENSE_BUDGET_BYTES,
+                    packed_unpacked_bytes,
                     resolve_aux,
                 )
 
@@ -713,10 +718,17 @@ def main() -> int:
                     graph.normal.kind.shape[-1],
                     graph.abnormal.kind.shape[-1],
                 )
-                if other.startswith("packed") and resolve_aux(
+                unpacked = packed_unpacked_bytes(v_pad, t_pads)
+                if (
+                    other in ("packed", "packed_bf16")
+                    and unpacked > DEFAULT_DENSE_BUDGET_BYTES
+                ):
+                    log(f"[{other}] skipped: past the dense budget")
+                    continue
+                if other == "packed_blocked" and resolve_aux(
                     "auto", v_pad, t_pads, DEFAULT_DENSE_BUDGET_BYTES
                 ) != "packed":
-                    log(f"[{other}] skipped: past the dense budget")
+                    log(f"[{other}] skipped: bitmaps past the budget")
                     continue
                 g2, _, _, _ = build_window_graph_from_table(
                     abnormal_table, mask, nrm, abn,

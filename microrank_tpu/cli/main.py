@@ -429,7 +429,7 @@ def main(argv=None) -> int:
         "--kernel",
         default="auto",
         choices=[
-            "auto", "packed", "packed_bf16", "csr", "coo",
+            "auto", "packed", "packed_bf16", "packed_blocked", "csr", "coo",
             "dense", "dense_bf16", "pallas",
         ],
         help="power-iteration kernel",

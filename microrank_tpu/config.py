@@ -66,6 +66,11 @@ class PageRankConfig:
     # large systems (reference README.md:34-38); set tol AND a higher
     # iterations cap to rank such systems to convergence.
     tol: Optional[float] = None
+    # kernel="packed_blocked": ceiling on the unpacked f32 block each
+    # scan step materializes (the trace/op column axis splits into the
+    # fewest power-of-two blocks that fit). Static under jit (part of
+    # the config cache key), so changing it recompiles correctly.
+    packed_block_bytes: int = 128 << 20
 
 
 @dataclass(frozen=True)
@@ -130,6 +135,10 @@ class RuntimeConfig:
     # Power-iteration kernel:
     #   "packed" / "packed_bf16" — bitmap-expanded dense MXU matvecs, no
     #       scatter (fastest on TPU when the matrices fit);
+    #   "packed_blocked" — the same matvecs with the bitmap's column axis
+    #       streamed in blocks through a lax.scan (pagerank.
+    #       packed_block_bytes caps the unpacked f32 intermediate) — the
+    #       at-scale path past the dense budget;
     #   "csr" — cumsum-difference SpMV, scatter-free and entry-linear in
     #       memory (the at-scale fallback);
     #   "dense" / "dense_bf16" — scatter densify + MXU matvecs;
@@ -139,8 +148,9 @@ class RuntimeConfig:
     #       coo scatter at 1M entries, ~7x slower than packed — see
     #       DESIGN.md's kernel table; never chosen by "auto");
     #   "auto" — packed when both partitions' unpacked matrices fit
-    #       dense_budget_bytes (decided once at graph build, which then
-    #       constructs exactly the needed auxiliary view), else csr.
+    #       dense_budget_bytes, packed_blocked when only the bitmaps fit
+    #       a quarter of it (graph build constructs the matching
+    #       auxiliary view), else csr.
     kernel: str = "auto"
     # Budget for the packed kernel's unpacked f32 matrices, summed over
     # both partitions (graph.build.resolve_aux applies it at build time).

@@ -175,6 +175,21 @@ DEFAULT_DENSE_BUDGET_BYTES = 2 << 30
 _BOOL_TEMP_CELL_BUDGET = 128 << 20
 
 
+def packed_unpacked_bytes(v_pad: int, t_pads) -> int:
+    """Resident f32 bytes of the UNBLOCKED packed kernel's unpacked
+    matrices ([V, T] coverage + [V, V] call graph per partition) — the
+    one footprint formula choose_kernel, bench, and the tests share."""
+    return sum((v_pad * t + v_pad * v_pad) * 4 for t in t_pads)
+
+
+def packed_bits_bytes(v_pad: int, t_pads) -> int:
+    """Resident bytes of the PACKED bitmaps themselves (what must fit
+    for any packed-family kernel, including packed_blocked)."""
+    return sum(
+        v_pad * ((t + 7) // 8) + v_pad * ((v_pad + 7) // 8) for t in t_pads
+    )
+
+
 def resolve_aux(
     aux: str,
     v_pad: int,
@@ -184,14 +199,18 @@ def resolve_aux(
     """Window-level auxiliary-view policy (one decision for BOTH
     partitions, so a window can never mix bitmap and CSR partitions).
 
-    "auto" -> "packed" when both partitions' unpacked matrices fit the
-    budget, else "csr". Explicit modes ("packed" | "csr" | "all" | "none")
-    pass through for forced-kernel runs.
+    "auto" -> "packed" when both partitions' PACKED bitmaps fit a
+    quarter of the budget (the unpacked-f32 budget itself is applied at
+    kernel-choice time: within it the kernel is "packed", past it
+    "packed_blocked" streams column blocks so only the bitmap must be
+    resident) -> "csr" when even the bitmaps blow that. Explicit modes
+    ("packed" | "csr" | "all" | "none") pass through for forced-kernel
+    runs.
     """
     if aux != "auto":
         return aux
-    total = sum((v_pad * t + v_pad * v_pad) * 4 for t in t_pads)
-    return "packed" if total <= dense_budget_bytes else "csr"
+    bits_total = packed_bits_bytes(v_pad, t_pads)
+    return "packed" if bits_total <= dense_budget_bytes // 4 else "csr"
 
 
 def aux_for_kernel(kernel: str) -> str:
@@ -201,6 +220,7 @@ def aux_for_kernel(kernel: str) -> str:
         "csr": "csr",
         "packed": "packed",
         "packed_bf16": "packed",
+        "packed_blocked": "packed",
     }.get(kernel, "none")
 
 

@@ -336,6 +336,11 @@ def _rank_windows_batched_jit(
     # Module-level jit: cache keys on the config/kernel VALUES, so repeat
     # batches reuse the compilation (a per-call jax.jit(lambda ...) would
     # recompile every invocation — new closure, new cache entry).
+    from ..rank_backends.jax_tpu import divide_block_budget
+
+    pagerank_cfg = divide_block_budget(
+        pagerank_cfg, kernel, batched.normal.kind.shape[0]
+    )
     return jax.vmap(
         lambda g: rank_window_core(
             g, pagerank_cfg, spectrum_cfg, None, kernel

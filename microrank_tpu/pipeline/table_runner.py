@@ -73,7 +73,38 @@ class TableRCA:
         k = self.config.runtime.kernel
         if k in SHARD_KERNELS:
             return k
-        kernels = {choose_kernel(g) for g in graphs}
+        if all(
+            int(p.cov_bits.shape[-1]) > 0
+            for g in graphs
+            for p in (g.normal, g.abnormal)
+        ):
+            # Trace-sharded packed unpacks [V, T/S] coverage blocks plus
+            # the replicated [V, V] call bitmap per device — budget-check
+            # THAT footprint, not the single-device one (otherwise a
+            # batch mixing in-budget and past-budget windows degrades to
+            # the ~7x-slower coo path even though every graph carries
+            # bitmaps). packed_blocked itself is single-device-only.
+            from ..graph.build import packed_unpacked_bytes
+
+            s = int(self._mesh.devices.shape[1])
+            budget = self.config.runtime.dense_budget_bytes
+            fits = all(
+                packed_unpacked_bytes(
+                    int(g.normal.cov_unique.shape[-1]),
+                    tuple(
+                        -(-int(p.kind.shape[-1]) // s)
+                        for p in (g.normal, g.abnormal)
+                    ),
+                )
+                <= budget
+                for g in graphs
+            )
+            return "packed" if fits else "csr"
+        kernels = {
+            choose_kernel(g, self.config.runtime.dense_budget_bytes)
+            for g in graphs
+        }
+        # Without bitmaps choose_kernel only returns csr/coo here.
         return kernels.pop() if len(kernels) == 1 else "coo"
 
     def _stage_sharded(self, graphs, kernel: str):
@@ -159,7 +190,9 @@ class TableRCA:
         else:
             shard_kernel = cfg.runtime.kernel
             if shard_kernel == "auto":
-                shard_kernel = choose_kernel(graph)
+                shard_kernel = choose_kernel(
+                    graph, cfg.runtime.dense_budget_bytes
+                )
         return graph, op_names, shard_kernel
 
     def launch_rank(self, graph, op_names, kernel):
@@ -529,7 +562,9 @@ class TableRCA:
 
                 stacked = stack_window_graphs(graphs)
                 if kernel == "auto":
-                    kernel = choose_kernel(stacked)
+                    kernel = choose_kernel(
+                        stacked, cfg.runtime.dense_budget_bytes // per_device
+                    )
                 top_idx, top_scores, n_valid = stage_rank_windows_batched(
                     device_subset(stacked, kernel),
                     cfg.pagerank,

@@ -107,9 +107,11 @@ rank_window_blob_device = jax.jit(
 def rank_windows_batched_blob_core(
     blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
 ):
-    from .jax_tpu import rank_window_core
+    from .jax_tpu import divide_block_budget, rank_window_core
 
     graph = unpack_graph_blob(blob, layout)
+    b = graph.normal.kind.shape[0]
+    pagerank_cfg = divide_block_budget(pagerank_cfg, kernel, b)
     return jax.vmap(
         lambda g: rank_window_core(g, pagerank_cfg, spectrum_cfg, None, kernel)
     )(graph)

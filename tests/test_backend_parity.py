@@ -493,3 +493,71 @@ def test_fuzz_parity_tie_aware():
                         seed, pad, kernel, i, names[int(ti[i])], top_o[i],
                     )
     assert runs >= 32
+
+
+def test_packed_blocked_matches_packed(small_case):
+    # The at-scale blocked kernel is the packed kernel with the bitmap's
+    # column axis streamed through a lax.scan — same math, different
+    # accumulation grouping. Force several blocks with a tiny
+    # packed_block_bytes and compare against the unblocked kernel.
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(
+        small_case.abnormal, nrm, abn, aux="packed"
+    )
+    t_pad = graph.abnormal.kind.shape[0]
+    v_pad = graph.abnormal.cov_unique.shape[0]
+    # Cap so one block holds at most a quarter of the columns.
+    pr_blocked = dataclasses.replace(
+        cfg.pagerank, packed_block_bytes=v_pad * (t_pad // 4) * 4
+    )
+    dg = jax.tree.map(jnp.asarray, graph)
+    ti_p, ts_p, nv_p = rank_window_device(
+        dg, cfg.pagerank, cfg.spectrum, None, "packed"
+    )
+    ti_b, ts_b, nv_b = rank_window_device(
+        dg, pr_blocked, cfg.spectrum, None, "packed_blocked"
+    )
+    ti_p, ts_p = np.asarray(ti_p), np.asarray(ts_p)
+    ti_b, ts_b = np.asarray(ti_b), np.asarray(ts_b)
+    assert int(nv_p) == int(nv_b)
+    assert ti_p[0] == ti_b[0]
+    assert set(ti_p.tolist()) == set(ti_b.tolist())
+    sc_p = dict(zip(ti_p.tolist(), ts_p.tolist()))
+    sc_b = dict(zip(ti_b.tolist(), ts_b.tolist()))
+    for op, v in sc_p.items():
+        if np.isfinite(v):
+            assert abs(v - sc_b[op]) <= 1e-4 * max(abs(v), 1e-12), op
+
+
+def test_auto_policy_blocked_past_budget(small_case):
+    # Past the dense budget the auto policy must still build bitmaps and
+    # pick packed_blocked (not the ~90x slower csr), as long as the
+    # bitmaps themselves fit a quarter of the budget.
+    from microrank_tpu.graph import build_window_graph
+    from microrank_tpu.graph.build import resolve_aux
+    from microrank_tpu.rank_backends.jax_tpu import choose_kernel
+
+    nrm, abn = partition_case(small_case)
+    graph, _, _, _ = build_window_graph(small_case.abnormal, nrm, abn)
+    v_pad = graph.normal.cov_unique.shape[0]
+    t_pads = (graph.normal.kind.shape[0], graph.abnormal.kind.shape[0])
+    unpacked = sum((v_pad * t + v_pad * v_pad) * 4 for t in t_pads)
+    bits = sum(
+        v_pad * ((t + 7) // 8) + v_pad * ((v_pad + 7) // 8) for t in t_pads
+    )
+    # A budget between the bitmap footprint and the unpacked footprint:
+    # aux still packs, kernel choice degrades to blocked.
+    budget = unpacked - 1
+    assert bits * 4 <= budget
+    assert resolve_aux("auto", v_pad, t_pads, budget) == "packed"
+    assert choose_kernel(graph, budget) == "packed_blocked"
+    assert choose_kernel(graph, unpacked) == "packed"
