@@ -104,7 +104,7 @@ CONFIG_PRESETS = {
     "2": dict(spans=10_000, ops=500),      # synthetic Erdős–Rényi
     "3": dict(spans=50_000, ops=1_000),    # Online-Boutique scale
     "4": dict(spans=250_000, ops=2_000, batch=8),  # TrainTicket, vmapped
-    "5": dict(spans=1_000_000, ops=5_000), # sharded-mesh target
+    "5": dict(spans=1_000_000, ops=5_000, replay=4),  # sharded-mesh target
     "6": dict(spans=4_000_000, ops=10_000),  # stretch (EVALUATION.md row)
 }
 
@@ -536,6 +536,71 @@ def _run_batched(
     return 0
 
 
+def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
+    """The pipelined-replay measurement (VERDICT r3 #3/#8): drive the
+    REAL product path — TableRCA.run() with async dispatch and the
+    depth-2 pipeline — over an n_windows faulted timeline and report
+    aggregate ranked spans/s. Staging, detection, graph build, dispatch
+    and fetch all count; their RPC latencies overlap across windows
+    exactly as they do in production. First pass warms the jit caches
+    (a real deployment ranks windows indefinitely; steady state is the
+    honest number), second pass is timed.
+    """
+    import numpy as np
+
+    from microrank_tpu.config import WindowConfig
+    from microrank_tpu.graph.table_ops import window_rows
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+
+    case_dir, truth = _ensure_batch_data(
+        spans_per_window * n_windows, n_ops, fault_ms, n_windows
+    )
+    normal_table = load_span_table(case_dir / "normal.csv")
+    table = load_span_table(case_dir / "abnormal.csv")
+    # Window arithmetic must visit each generated sub-window exactly:
+    # detect = the generator's window span, skip = 0.
+    cfg = cfg.replace(
+        window=WindowConfig(
+            detect_minutes=float(truth["window_minutes"]), skip_minutes=0.0
+        )
+    )
+    rca = TableRCA(cfg)
+    rca.fit_baseline(normal_table)
+    t0 = time.perf_counter()
+    rca.run(table)
+    warm_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    results = rca.run(table)
+    replay_s = time.perf_counter() - t0
+    ranked = [r for r in results if r.ranking]
+    spans_ranked = 0
+    hits = 0
+    for r in ranked:
+        w0 = int(np.datetime64(r.start, "us").astype(np.int64))
+        w1 = int(np.datetime64(r.end, "us").astype(np.int64))
+        spans_ranked += int(window_rows(table, w0, w1).sum())
+        hits += r.ranking[0][0] == truth["fault_pod_op"]
+    if not ranked:
+        log("replay: no window ranked; skipping replay headline")
+        return None
+    sps = spans_ranked / replay_s
+    log(
+        f"pipelined replay: {len(ranked)}/{len(results)} windows ranked "
+        f"({spans_ranked} spans) in {replay_s * 1e3:.0f}ms "
+        f"(warmup+compile pass {warm_s:.2f}s) -> {sps:,.0f} spans/s "
+        f"aggregate; fault top-1 in {hits}/{len(ranked)} windows; "
+        f"{replay_s * 1e3 / len(ranked):.0f}ms/window"
+    )
+    return {
+        "replay_spans_per_sec": round(sps, 1),
+        "replay_windows": len(ranked),
+        "replay_ms": round(replay_s * 1e3, 1),
+        "replay_ms_per_window": round(replay_s * 1e3 / len(ranked), 1),
+        "replay_fault_hits": hits,
+    }
+
+
 def main() -> int:
     config_key = os.environ.get("BENCH_CONFIG", "5")
     preset = CONFIG_PRESETS.get(config_key)
@@ -787,24 +852,40 @@ def main() -> int:
     parity = top_o[0] == top_j[0]
     log(f"subsample Top-1 parity (oracle vs jax): {parity} ({top_o[0]})")
 
-    vs_baseline = spans_per_sec / oracle_sps
-    print(
-        json.dumps(
-            {
-                "metric": "spans_per_sec_ranked",
-                "value": round(spans_per_sec, 1),
-                "unit": "spans/s",
-                "vs_baseline": round(vs_baseline, 2),
-                "build_ms": round(build_s * 1e3, 1),
-                "rank_ms": round(rank_s * 1e3, 1),
-                "staging_ms": round(stage_s * 1e3, 1),
-                "compile_ms": round(max(first_s - rank_s, 0.0) * 1e3, 1),
-                **(
-                    {"device": device_profile} if device_profile else {}
-                ),
-            }
-        )
-    )
+    result = {
+        "metric": "spans_per_sec_ranked",
+        "value": round(spans_per_sec, 1),
+        "unit": "spans/s",
+        "vs_baseline": round(spans_per_sec / oracle_sps, 2),
+        "build_ms": round(build_s * 1e3, 1),
+        "rank_ms": round(rank_s * 1e3, 1),
+        "staging_ms": round(stage_s * 1e3, 1),
+        "compile_ms": round(max(first_s - rank_s, 0.0) * 1e3, 1),
+        **({"device": device_profile} if device_profile else {}),
+    }
+
+    # Pipelined replay over a multi-window timeline: the aggregate
+    # throughput of the real pipeline (async dispatch overlapping
+    # staging/rank RPCs with the next window's host work) IS the
+    # headline when the preset asks for it — per-window fixed RPC
+    # latency is a tunnel artifact the production loop amortizes, and
+    # the replay still counts every cost end to end.
+    replay_n = int(os.environ.get("BENCH_REPLAY", preset.get("replay", 1)))
+    if replay_n > 1:
+        try:
+            rep = _run_replay(cfg, spans_target, n_ops, fault_ms, replay_n)
+        except Exception as exc:  # replay must not eat the single metric
+            log(f"replay failed ({exc!r}); keeping single-window headline")
+            rep = None
+        if rep is not None:
+            result.update(rep)
+            result["single_window_spans_per_sec"] = result["value"]
+            result["value"] = rep["replay_spans_per_sec"]
+            result["vs_baseline"] = round(
+                rep["replay_spans_per_sec"] / oracle_sps, 2
+            )
+
+    print(json.dumps(result))
     return 0
 
 

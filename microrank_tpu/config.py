@@ -171,8 +171,10 @@ class RuntimeConfig:
     # Single-process only (a multi-process mesh needs every rank to issue
     # collectives in program order, which per-process worker threads
     # cannot guarantee against the fetch allgathers); ignored with a
-    # warning there.
-    async_dispatch: bool = False
+    # warning there. Default ON since round 4: the r3 drain bug is fixed
+    # and sync/async equivalence is tested
+    # (test_table_lane_async_dispatch_matches_sync).
+    async_dispatch: bool = True
     # Stage single-device window graphs as ONE packed uint32 buffer
     # (rank_backends.blob) instead of ~50 per-leaf transfers — each leaf
     # transfer pays a full RPC round trip on tunneled-TPU runtimes

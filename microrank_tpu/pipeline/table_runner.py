@@ -99,7 +99,24 @@ class TableRCA:
                 <= budget
                 for g in graphs
             )
-            return "packed" if fits else "csr"
+            has_csr = all(
+                int(p.inc_indptr_op.shape[-1]) > 0
+                for g in graphs
+                for p in (g.normal, g.abnormal)
+            )
+            if fits or not has_csr:
+                # Bitmap-only builds (aux="packed") carry no CSR views,
+                # so past-budget batches must still take the packed path
+                # (the pre-r4 behavior) rather than crash at rank time.
+                if not fits:
+                    self.log.warning(
+                        "sharded packed footprint exceeds "
+                        "dense_budget_bytes and no CSR views were built; "
+                        "proceeding with 'packed' — build with aux='all' "
+                        "to enable the csr fallback"
+                    )
+                return "packed"
+            return "csr"
         kernels = {
             choose_kernel(g, self.config.runtime.dense_budget_bytes)
             for g in graphs

@@ -543,17 +543,19 @@ def test_auto_policy_blocked_past_budget(small_case):
     # pick packed_blocked (not the ~90x slower csr), as long as the
     # bitmaps themselves fit a quarter of the budget.
     from microrank_tpu.graph import build_window_graph
-    from microrank_tpu.graph.build import resolve_aux
+    from microrank_tpu.graph.build import (
+        packed_bits_bytes,
+        packed_unpacked_bytes,
+        resolve_aux,
+    )
     from microrank_tpu.rank_backends.jax_tpu import choose_kernel
 
     nrm, abn = partition_case(small_case)
     graph, _, _, _ = build_window_graph(small_case.abnormal, nrm, abn)
     v_pad = graph.normal.cov_unique.shape[0]
     t_pads = (graph.normal.kind.shape[0], graph.abnormal.kind.shape[0])
-    unpacked = sum((v_pad * t + v_pad * v_pad) * 4 for t in t_pads)
-    bits = sum(
-        v_pad * ((t + 7) // 8) + v_pad * ((v_pad + 7) // 8) for t in t_pads
-    )
+    unpacked = packed_unpacked_bytes(v_pad, t_pads)
+    bits = packed_bits_bytes(v_pad, t_pads)
     # A budget between the bitmap footprint and the unpacked footprint:
     # aux still packs, kernel choice degrades to blocked.
     budget = unpacked - 1

@@ -465,3 +465,42 @@ def test_cli_profile_dir(tmp_path):
     )
     assert rc == 0
     assert any(prof.rglob("*"))  # the trace dump exists
+
+
+def test_table_lane_async_dispatch_matches_sync(case, tmp_path):
+    """async_dispatch=True (stage/fetch worker threads) must produce the
+    same rankings, order, and sink lines as the synchronous loop."""
+    from dataclasses import replace
+
+    from microrank_tpu.native import native_available
+    from microrank_tpu.pipeline import run_rca_native
+
+    if not native_available():
+        pytest.skip("native lane unavailable")
+    case.normal.to_csv(tmp_path / "normal.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "abnormal.csv", index=False)
+    cfg = MicroRankConfig()
+    outs = {}
+    for mode in (False, True):
+        c = replace(
+            cfg,
+            runtime=replace(
+                cfg.runtime, async_dispatch=mode, pipeline_depth=2
+            ),
+        )
+        out = tmp_path / f"out_async{mode}"
+        outs[mode] = (
+            run_rca_native(
+                tmp_path / "normal.csv", tmp_path / "abnormal.csv", c, out
+            ),
+            (out / "windows.jsonl").read_text().splitlines(),
+        )
+    r_sync, lines_sync = outs[False]
+    r_async, lines_async = outs[True]
+    assert len(r_async) == len(r_sync) > 0
+    for a, b in zip(r_sync, r_async):
+        assert a.ranking == b.ranking
+        assert (a.start, a.anomaly, a.skipped_reason) == (
+            b.start, b.anomaly, b.skipped_reason
+        )
+    assert len(lines_async) == len(lines_sync)
