@@ -1,0 +1,200 @@
+"""Sparse full-scale numpy oracle (VERDICT r3 #5).
+
+The dense oracle (numpy_ref.py) is value-faithful to the reference but
+allocates the dense [V, T] transition matrices (pagerank.py:19-24), which
+is infeasible at the 1M-span bench scale. This module re-derives the SAME
+semantics — preference vector (pagerank.py:68-85), power iteration
+(pagerank.py:116-130), rescale + coverage counts (pagerank.py:93-112) and
+the weighted spectrum (online_rca.py:33-152) — over the padded COO window
+graph, using float64 vectors and ``np.bincount`` segment sums instead of
+dense matvecs. Memory is O(E + V + T); the 1M-span window ranks in
+seconds.
+
+Independence from the device path: everything downstream of the COO
+entries is recomputed here in a different summation structure (bincount
+vs the device's bitmap matvecs / CSR prefix sums), in float64, including
+an independent trace-kind dedup (byte-signature grouping vs the device
+build's splitmix hash) and an independent unique-coverage count. The COO
+entries themselves are shared with the device path — their construction
+is covered by the small-scale dense-oracle parity suite
+(tests/test_backend_parity.py), which starts from raw spans.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..config import PageRankConfig, SpectrumConfig
+from ..graph.structures import PartitionGraph, WindowGraph
+from .numpy_ref import spectrum_score
+
+
+def _partition_arrays(g: PartitionGraph):
+    """Slice the live (unpadded) COO arrays of one partition."""
+    e = int(g.n_inc)
+    c = int(g.n_ss)
+    t = int(g.n_traces)
+    return {
+        "inc_op": np.asarray(g.inc_op[:e]),
+        "inc_trace": np.asarray(g.inc_trace[:e]),
+        "sr_val": np.asarray(g.sr_val[:e], dtype=np.float64),
+        "rs_val": np.asarray(g.rs_val[:e], dtype=np.float64),
+        "ss_child": np.asarray(g.ss_child[:c]),
+        "ss_parent": np.asarray(g.ss_parent[:c]),
+        "ss_val": np.asarray(g.ss_val[:c], dtype=np.float64),
+        # NB: g.kind is deliberately NOT read — the oracle recomputes
+        # kinds independently (recompute_kinds).
+        "tracelen": np.asarray(g.tracelen[:t], dtype=np.float64),
+        "op_present": np.asarray(g.op_present),
+        "n_ops": int(g.n_ops),
+        "n_traces": t,
+    }
+
+
+def recompute_kinds(
+    inc_trace, inc_op, tracelen, n_traces: int
+) -> np.ndarray:
+    """Independent trace-kind dedup (reference pagerank.py:54-66): two
+    traces are one kind iff their p_sr columns match — same unique op set
+    AND same with-duplicates length (the column's nonzero value is
+    1/len_with_dups). Groups by a per-trace byte signature of (sorted op
+    ids, tracelen). Returns counts[t] = size of t's kind.
+    """
+    order = np.lexsort((inc_op, inc_trace))
+    tr = inc_trace[order]
+    op = inc_op[order]
+    starts = np.searchsorted(tr, np.arange(n_traces), side="left")
+    ends = np.searchsorted(tr, np.arange(n_traces), side="right")
+    tlen = np.asarray(tracelen)
+    sigs = {}
+    kind_of = np.zeros(n_traces, dtype=np.int64)
+    for t in range(n_traces):
+        key = (op[starts[t] : ends[t]].tobytes(), float(tlen[t]))
+        kind_of[t] = sigs.setdefault(key, len(sigs))
+    counts = np.bincount(kind_of, minlength=len(sigs))
+    return counts[kind_of].astype(np.float64)
+
+
+def _preference(kind, tracelen, anomaly: bool, cfg: PageRankConfig):
+    """pagerank.py:68-85 in array form, float64."""
+    inv_kind = 1.0 / kind
+    inv_len = 1.0 / tracelen
+    kind_sum = inv_kind.sum()
+    if not anomaly:
+        return inv_kind / kind_sum
+    num_sum = inv_len.sum()
+    if cfg.preference == "reference":
+        return cfg.phi / num_sum / (kind / kind_sum * cfg.phi + inv_len)
+    if cfg.preference == "paper":
+        return (
+            cfg.phi * inv_len / num_sum
+            + (1.0 - cfg.phi) * inv_kind / kind_sum
+        )
+    raise ValueError(f"unknown preference form {cfg.preference!r}")
+
+
+def _iterate_sparse(p, pref, v_pad: int, cfg: PageRankConfig):
+    """pageRank (pagerank.py:116-130) over COO entries: each dense matvec
+    becomes gather -> weighted bincount. float64 throughout (the dense
+    oracle's vectors are float64 too — f32 matrix @ f64 vector promotes).
+    """
+    d = cfg.damping
+    alpha = cfg.call_weight
+    t = p["n_traces"]
+    n_total = float(p["n_ops"] + t)
+    v_s = np.where(p["op_present"], 1.0 / n_total, 0.0)
+    v_r = np.full(t, 1.0 / n_total)
+    for _ in range(cfg.iterations):
+        sr = np.bincount(
+            p["inc_op"],
+            weights=p["sr_val"] * v_r[p["inc_trace"]],
+            minlength=v_pad,
+        )
+        ss = np.bincount(
+            p["ss_child"],
+            weights=p["ss_val"] * v_s[p["ss_parent"]],
+            minlength=v_pad,
+        )
+        new_s = d * (sr + alpha * ss)
+        new_r = (
+            d
+            * np.bincount(
+                p["inc_trace"],
+                weights=p["rs_val"] * v_s[p["inc_op"]],
+                minlength=t,
+            )
+            + (1.0 - d) * pref
+        )
+        if cfg.max_normalize_each_iter:
+            new_s = new_s / np.amax(new_s)
+            new_r = new_r / np.amax(new_r)
+        if cfg.tol is not None:
+            delta = max(
+                float(np.max(np.abs(new_s - v_s))),
+                float(np.max(np.abs(new_r - v_r))),
+            )
+            v_s, v_r = new_s, new_r
+            if delta <= cfg.tol:
+                break
+        else:
+            v_s, v_r = new_s, new_r
+    return v_s / np.amax(v_s)
+
+
+def _partition_rank(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
+    """One partition's (weight[v_pad], trace_num[v_pad]) — the sparse twin
+    of numpy_ref.trace_pagerank, with kinds and coverage counts recomputed
+    independently of the build's aux arrays."""
+    p = _partition_arrays(g)
+    v_pad = g.op_present.shape[0]
+    kinds = recompute_kinds(
+        p["inc_trace"], p["inc_op"], p["tracelen"], p["n_traces"]
+    )
+    pref = _preference(kinds, p["tracelen"], anomaly, cfg)
+    v_s = _iterate_sparse(p, pref, v_pad, cfg)
+    total = float(v_s[p["op_present"]].sum())
+    weight = np.where(p["op_present"], v_s * total / p["n_ops"], 0.0)
+    trace_num = np.bincount(p["inc_op"], minlength=v_pad).astype(np.int64)
+    return weight, trace_num, p
+
+
+def rank_window_sparse(
+    graph: WindowGraph,
+    op_names: List[str],
+    pagerank_cfg: PageRankConfig = PageRankConfig(),
+    spectrum_cfg: SpectrumConfig = SpectrumConfig(),
+) -> Tuple[List[str], List[float]]:
+    """Full-window oracle ranking from the padded COO graph: returns the
+    top ``n_rows`` (name-tiebroken, matching the device path's
+    vocab-index tie key over the name-sorted window vocab)."""
+    n_weight, n_num, n_p = _partition_rank(graph.normal, False, pagerank_cfg)
+    a_weight, a_num, a_p = _partition_rank(graph.abnormal, True, pagerank_cfg)
+    in_a = np.asarray(graph.abnormal.op_present)
+    in_n = np.asarray(graph.normal.op_present)
+    eps = spectrum_cfg.eps
+    scored = {}
+    for vi in np.flatnonzero(in_a | in_n):
+        cell = {}
+        if in_a[vi]:
+            a = a_weight[vi]
+            cell["ef"] = a * a_num[vi]
+            cell["nf"] = a * (a_p["n_traces"] - a_num[vi])
+            if in_n[vi]:
+                nw = n_weight[vi]
+                cell["ep"] = nw * n_num[vi]
+                cell["np"] = nw * (n_p["n_traces"] - n_num[vi])
+            else:
+                cell["ep"] = eps
+                cell["np"] = eps
+        else:  # only-in-normal branch (online_rca.py:60-69, asymmetric)
+            nw = n_weight[vi]
+            cell["ef"] = eps
+            cell["nf"] = eps
+            cell["ep"] = (1 + nw) * n_num[vi]
+            cell["np"] = n_p["n_traces"] - n_num[vi]
+        scored[int(vi)] = spectrum_score(cell, spectrum_cfg.method)
+    ranked = sorted(scored.items(), key=lambda x: (-x[1], op_names[x[0]]))
+    top = ranked[: spectrum_cfg.n_rows]
+    return [op_names[vi] for vi, _ in top], [float(s) for _, s in top]

@@ -1,0 +1,70 @@
+"""Sparse full-scale oracle (rank_backends.sparse_oracle) vs the dense
+oracle: same window, same partitions -> same ranked names and
+near-identical float64 scores. The sparse oracle exists to verify the
+device path at sizes the dense [V, T] matrices can't reach, so IT must
+first be proven against the dense oracle where both run.
+"""
+
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig, SpectrumConfig
+from microrank_tpu.graph import build_window_graph
+from microrank_tpu.rank_backends import NumpyRefBackend
+from microrank_tpu.rank_backends.sparse_oracle import rank_window_sparse
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+def _compare(case, cfg):
+    nrm, abn = partition_case(case)
+    top_d, sc_d = NumpyRefBackend(cfg).rank_window(case.abnormal, nrm, abn)
+    graph, op_names, _, _ = build_window_graph(case.abnormal, nrm, abn)
+    top_s, sc_s = rank_window_sparse(
+        graph, op_names, cfg.pagerank, cfg.spectrum
+    )
+    assert top_d, "dense oracle produced no ranking"
+    # The dense oracle's default tiebreak is "name", matching the sparse
+    # oracle's (-score, name) sort — so the full ranked lists must agree
+    # positionally, not just as sets.
+    assert top_s == top_d
+    # Both float64; the residual difference is pure summation-order
+    # reassociation (bincount entry order vs dense BLAS column order).
+    np.testing.assert_allclose(sc_s, sc_d, rtol=1e-6)
+
+
+def test_sparse_matches_dense_default(small_case):
+    _compare(small_case, MicroRankConfig())
+
+
+def test_sparse_matches_dense_pod_level(pod_case):
+    _compare(pod_case, MicroRankConfig())
+
+
+@pytest.mark.parametrize("method", ["ochiai", "tarantula", "dstar2"])
+def test_sparse_matches_dense_methods(small_case, method):
+    _compare(
+        small_case,
+        MicroRankConfig(spectrum=SpectrumConfig(method=method)),
+    )
+
+
+def test_sparse_matches_dense_paper_preference(small_case):
+    from microrank_tpu.config import PageRankConfig
+
+    _compare(
+        small_case,
+        MicroRankConfig(pagerank=PageRankConfig(preference="paper")),
+    )
+
+
+def test_sparse_oracle_duplicate_span_traces():
+    # Kind dedup must separate traces with the same unique op set but
+    # different with-duplicate lengths (the p_sr column VALUE differs) —
+    # a regression guard for the byte-signature grouping.
+    case = generate_case(
+        SyntheticConfig(
+            n_operations=16, n_traces=150, seed=5, child_keep_prob=0.9
+        )
+    )
+    _compare(case, MicroRankConfig())
