@@ -470,13 +470,13 @@ def main(argv=None) -> int:
     )
     p_eval.add_argument("--cases", type=int, default=20)
     p_eval.add_argument("--operations", type=int, default=30)
-    p_eval.add_argument("--traces", type=int, default=200)
+    p_eval.add_argument("--traces", type=int, default=400)
     p_eval.add_argument("--pods", type=int, default=1)
-    p_eval.add_argument("--kinds", type=int, default=24)
+    p_eval.add_argument("--kinds", type=int, default=48)
     p_eval.add_argument("--faults", type=int, default=1)
     p_eval.add_argument("--fault-ms", type=float, default=2000.0)
     p_eval.add_argument(
-        "--keep-prob", type=float, default=0.6,
+        "--keep-prob", type=float, default=0.15,
         help="per-kind subtree keep probability: trace-kind breadth "
         "(lower = narrower, more request-like traces)",
     )

@@ -159,7 +159,9 @@ def test_graft_entry_points():
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
     assert out[0].shape == out[1].shape
-    mod.dryrun_multichip(8)
+    # Small shapes for the unit suite; the driver runs the full
+    # config-2-sized dryrun (defaults) itself.
+    mod.dryrun_multichip(8, n_operations=48, target_spans=1_000)
 
 
 def test_table_rca_sharded_matches_default(tmp_path):
