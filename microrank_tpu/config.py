@@ -158,6 +158,12 @@ class RuntimeConfig:
     # Validate fetched ranking scores for NaN/inf (nearly free: results are
     # already on host when checked).
     validate_numerics: bool = True
+    # Additionally assert the finite-score invariant INSIDE the compiled
+    # program via jax.experimental.checkify (rank_window_checked) —
+    # catches NaN/inf at the device boundary with the failing check
+    # named, at the cost of an error-state thread through the program.
+    # Off by default; the host-side check above stays on regardless.
+    device_checks: bool = False
     # Window-loop pipelining (table lane): number of device rank programs
     # allowed in flight before the host blocks. 2 overlaps window N's
     # device execution with window N+1's host graph build (jax async

@@ -56,6 +56,13 @@ class TableRCA:
             # dispatch; per-window dispatch checks this at rank time.
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
+            if config.runtime.device_checks:
+                self.log.warning(
+                    "device_checks applies to single-device dispatch "
+                    "only; the sharded path runs without checkify "
+                    "instrumentation (host-side validate_numerics still "
+                    "applies)"
+                )
             if config.runtime.kernel not in ("auto",) + SHARD_KERNELS:
                 self.log.warning(
                     "kernel=%r is not shard-capable; the sharded path "
@@ -237,6 +244,7 @@ class TableRCA:
                 cfg.spectrum,
                 kernel,
                 cfg.runtime.blob_staging,
+                checked=cfg.runtime.device_checks,
             )
         return top_idx, top_scores, n_valid, op_names
 

@@ -135,13 +135,72 @@ def stage_rank_blob(graph: WindowGraph, pagerank_cfg, spectrum_cfg, kernel):
     )
 
 
+def _rank_window_blob_checked_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
+):
+    from .jax_tpu import rank_window_checked_core
+
+    graph = unpack_graph_blob(blob, layout)
+    return rank_window_checked_core(
+        graph, pagerank_cfg, spectrum_cfg, kernel
+    )
+
+
+_BLOB_CHECKED_JIT = None
+
+
+def _blob_checked_jit():
+    global _BLOB_CHECKED_JIT
+    if _BLOB_CHECKED_JIT is None:
+        from jax.experimental import checkify
+
+        _BLOB_CHECKED_JIT = jax.jit(
+            checkify.checkify(
+                _rank_window_blob_checked_core, errors=checkify.user_checks
+            ),
+            static_argnums=(1, 2, 3, 4),
+        )
+    return _BLOB_CHECKED_JIT
+
+
 def stage_rank_window(
-    graph: WindowGraph, pagerank_cfg, spectrum_cfg, kernel, blob: bool
+    graph: WindowGraph,
+    pagerank_cfg,
+    spectrum_cfg,
+    kernel,
+    blob: bool,
+    checked: bool = False,
 ):
     """The one single-device stage+dispatch seam both the backend
     (JaxBackend.rank_window) and the pipeline (TableRCA.launch_rank)
     call: blob staging when enabled, per-leaf device_put otherwise. The
-    graph should already be device_subset-stripped for ``kernel``."""
+    graph should already be device_subset-stripped for ``kernel``.
+
+    ``checked`` (RuntimeConfig.device_checks) dispatches the
+    checkify-instrumented program instead — still blob-staged when
+    ``blob`` is on, module-level jit cache either way — and raises
+    ``checkify.JaxRuntimeError`` on an in-program invariant failure.
+    """
+    if checked:
+        from jax.experimental import checkify
+
+        if blob:
+            blob_arr, layout = pack_graph_blob(graph)
+            err, out = _blob_checked_jit()(
+                jax.device_put(blob_arr),
+                layout,
+                pagerank_cfg,
+                spectrum_cfg,
+                kernel,
+            )
+        else:
+            from .jax_tpu import _checked_jit
+
+            err, out = _checked_jit()(
+                jax.device_put(graph), pagerank_cfg, spectrum_cfg, kernel
+            )
+        checkify.check_error(err)
+        return out
     if blob:
         return stage_rank_blob(graph, pagerank_cfg, spectrum_cfg, kernel)
     from .jax_tpu import rank_window_device

@@ -851,6 +851,73 @@ def rank_window_all_methods_core(
     )
 
 
+def rank_window_checked_core(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str = "coo",
+):
+    """rank_window_core plus in-program checkify assertions (SURVEY.md
+    §5 sanitizers row): the finite-score invariant is checked on the
+    padded [k] outputs INSIDE the compiled program, before they ever
+    reach the host — vs RuntimeConfig.validate_numerics, which only sees
+    fetched host values."""
+    from jax.experimental import checkify
+
+    top_idx, top_scores, n_valid = rank_window_core(
+        graph, pagerank_cfg, spectrum_cfg, None, kernel
+    )
+    live = jnp.arange(top_scores.shape[0]) < n_valid
+    checkify.check(
+        jnp.all(jnp.where(live, jnp.isfinite(top_scores), True)),
+        "non-finite ranked score inside the device program "
+        "(preference vector or spectrum formula produced NaN/inf)",
+    )
+    checkify.check(
+        jnp.logical_and(n_valid >= 0, n_valid <= top_scores.shape[0]),
+        "n_valid outside [0, k]",
+    )
+    return top_idx, top_scores, n_valid
+
+
+def _checked_jit():
+    # Module-level cached jit (built lazily once): a per-call
+    # jax.jit(checkify.checkify(lambda ...)) would retrace and recompile
+    # every invocation.
+    global _CHECKED_JIT
+    if _CHECKED_JIT is None:
+        from jax.experimental import checkify
+
+        _CHECKED_JIT = jax.jit(
+            checkify.checkify(
+                rank_window_checked_core, errors=checkify.user_checks
+            ),
+            static_argnums=(1, 2, 3),
+        )
+    return _CHECKED_JIT
+
+
+_CHECKED_JIT = None
+
+
+def rank_window_checked(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str = "coo",
+):
+    """checkify-instrumented window rank. Raises
+    ``checkify.JaxRuntimeError`` naming the failed check. Opt-in via
+    RuntimeConfig.device_checks (adds an error-state thread through the
+    program); the default host-side validation stays on either way.
+    Compilation is cached module-level, same as rank_window_device."""
+    from jax.experimental import checkify
+
+    err, out = _checked_jit()(graph, pagerank_cfg, spectrum_cfg, kernel)
+    checkify.check_error(err)
+    return out
+
+
 rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
 rank_window_all_methods_device = jax.jit(
     rank_window_all_methods_core, static_argnums=(1, 2, 3, 4)
@@ -992,6 +1059,7 @@ class JaxBackend:
             self.config.spectrum,
             kernel,
             rt.blob_staging,
+            checked=rt.device_checks,
         )
         # One batched fetch — piecemeal int()/float() conversions on device
         # arrays each pay a full RPC round trip on tunneled-TPU runtimes.

@@ -1,0 +1,130 @@
+"""In-program sanitizers (SURVEY.md §5): checkify device checks and
+donated-buffer correctness — the two planned items the aux row was
+missing through round 3."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.graph import build_window_graph
+from microrank_tpu.rank_backends.jax_tpu import (
+    rank_window_checked,
+    rank_window_device,
+)
+
+
+def _graph(case):
+    nrm, abn = partition_case(case)
+    graph, names, _, _ = build_window_graph(case.abnormal, nrm, abn)
+    return graph, names
+
+
+def test_checked_rank_matches_unchecked(small_case):
+    cfg = MicroRankConfig()
+    graph, _ = _graph(small_case)
+    dg = jax.tree.map(jnp.asarray, graph)
+    ref = rank_window_device(dg, cfg.pagerank, cfg.spectrum, None, "coo")
+    got = rank_window_checked(dg, cfg.pagerank, cfg.spectrum, "coo")
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_checked_rank_traps_nonfinite(small_case):
+    # Poison one incidence value so a division feeds NaN into the
+    # ranking; the in-program check must name the invariant instead of
+    # letting NaN flow to the host.
+    from jax.experimental import checkify
+
+    cfg = MicroRankConfig()
+    graph, _ = _graph(small_case)
+    bad_sr = np.asarray(graph.abnormal.sr_val).copy()
+    bad_sr[0] = np.nan
+    poisoned = graph._replace(
+        abnormal=graph.abnormal._replace(sr_val=bad_sr)
+    )
+    with pytest.raises(checkify.JaxRuntimeError, match="non-finite"):
+        rank_window_checked(
+            jax.tree.map(jnp.asarray, poisoned),
+            cfg.pagerank,
+            cfg.spectrum,
+            "coo",
+        )
+
+
+def test_backend_device_checks_flag(small_case):
+    # RuntimeConfig.device_checks routes JaxBackend through the checked
+    # program and must not change the ranking.
+    from dataclasses import replace
+
+    from microrank_tpu.rank_backends import get_backend
+
+    nrm, abn = partition_case(small_case)
+    cfg = MicroRankConfig()
+    top_a, sc_a = get_backend(cfg).rank_window(small_case.abnormal, nrm, abn)
+    cfg_c = cfg.replace(runtime=replace(cfg.runtime, device_checks=True))
+    top_b, sc_b = get_backend(cfg_c).rank_window(
+        small_case.abnormal, nrm, abn
+    )
+    assert top_a == top_b
+    np.testing.assert_allclose(sc_a, sc_b, rtol=1e-6)
+
+
+def test_donated_graph_buffers_rank_identically(small_case):
+    # Buffer donation lets XLA reuse the staged graph's memory for
+    # outputs; the ranking must be unchanged. (CPU ignores donation with
+    # a warning — the assertion is still exact there; on TPU this
+    # exercises real aliasing.)
+    cfg = MicroRankConfig()
+    graph, _ = _graph(small_case)
+    ref = rank_window_device(
+        jax.tree.map(jnp.asarray, graph),
+        cfg.pagerank,
+        cfg.spectrum,
+        None,
+        "coo",
+    )
+    donated_fn = jax.jit(
+        lambda g: __import__(
+            "microrank_tpu.rank_backends.jax_tpu", fromlist=["x"]
+        ).rank_window_core(g, cfg.pagerank, cfg.spectrum, None, "coo"),
+        donate_argnums=(0,),
+    )
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # CPU "donation not implemented"
+        got = donated_fn(jax.tree.map(jnp.asarray, graph))
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
+
+
+def test_pipeline_lane_honors_device_checks(small_case, tmp_path):
+    case = small_case
+    # The table/pipeline lane (the path bench and the CLI use) must
+    # route device_checks through the checked program, not ignore it.
+    from dataclasses import replace
+
+    import pytest as _pytest
+
+    from microrank_tpu.native import native_available
+    from microrank_tpu.pipeline import run_rca_native
+
+    if not native_available():
+        _pytest.skip("native lane unavailable")
+    case.normal.to_csv(tmp_path / "normal.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "abnormal.csv", index=False)
+    cfg = MicroRankConfig()
+    base = run_rca_native(
+        tmp_path / "normal.csv", tmp_path / "abnormal.csv", cfg,
+        tmp_path / "out_base",
+    )
+    cfg_c = cfg.replace(runtime=replace(cfg.runtime, device_checks=True))
+    checked = run_rca_native(
+        tmp_path / "normal.csv", tmp_path / "abnormal.csv", cfg_c,
+        tmp_path / "out_checked",
+    )
+    assert [r.ranking for r in checked] == [r.ranking for r in base]
+    assert any(r.ranking for r in base)
