@@ -437,7 +437,7 @@ def build_window_graph(
     normal_ids: Iterable,
     abnormal_ids: Iterable,
     strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
-    pad_policy: str = "pow2",
+    pad_policy: str = "pow2q",
     min_pad: int = 8,
     aux: str = "auto",
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
@@ -527,7 +527,7 @@ def build_detect_batch(
     span_df: pd.DataFrame,
     slo_vocab: Vocab,
     strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
-    pad_policy: str = "pow2",
+    pad_policy: str = "pow2q",
     min_pad: int = 8,
 ) -> Tuple[DetectBatch, List]:
     """Intern one detection window's spans for the vectorized detector.

@@ -82,7 +82,7 @@ def detect_batch_from_table(
     table,
     mask: np.ndarray,
     slo_vocab: Vocab,
-    pad_policy: str = "pow2",
+    pad_policy: str = "pow2q",
     min_pad: int = 8,
 ) -> Tuple[DetectBatch, np.ndarray]:
     """DetectBatch for the masked window rows.
@@ -166,7 +166,7 @@ def build_window_graph_from_table(
     mask: np.ndarray,
     normal_trace_codes: Iterable[int],
     abnormal_trace_codes: Iterable[int],
-    pad_policy: str = "pow2",
+    pad_policy: str = "pow2q",
     min_pad: int = 8,
     use_native: bool = True,
     aux: str = "auto",

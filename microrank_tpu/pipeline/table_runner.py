@@ -362,6 +362,16 @@ class TableRCA:
                 "ordering); running synchronously"
             )
             async_mode = False
+        if async_mode and cfg.runtime.device_checks:
+            # checkify's error check is a synchronous device fetch, so
+            # each checked dispatch blocks its worker thread — the
+            # pipeline overlap would be silently lost. Make the trade
+            # explicit: checks are a debug mode, run synchronously.
+            self.log.warning(
+                "device_checks forces synchronous dispatch (the "
+                "in-program error check fetches device state per window)"
+            )
+            async_mode = False
         stage_pool = fetch_pool = None
         if async_mode:
             from concurrent.futures import ThreadPoolExecutor

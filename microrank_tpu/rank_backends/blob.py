@@ -182,9 +182,9 @@ def stage_rank_window(
     ``checkify.JaxRuntimeError`` on an in-program invariant failure.
     """
     if checked:
-        from jax.experimental import checkify
-
         if blob:
+            from jax.experimental import checkify
+
             blob_arr, layout = pack_graph_blob(graph)
             err, out = _blob_checked_jit()(
                 jax.device_put(blob_arr),
@@ -193,14 +193,13 @@ def stage_rank_window(
                 spectrum_cfg,
                 kernel,
             )
-        else:
-            from .jax_tpu import _checked_jit
+            checkify.check_error(err)
+            return out
+        from .jax_tpu import rank_window_checked
 
-            err, out = _checked_jit()(
-                jax.device_put(graph), pagerank_cfg, spectrum_cfg, kernel
-            )
-        checkify.check_error(err)
-        return out
+        return rank_window_checked(
+            jax.device_put(graph), pagerank_cfg, spectrum_cfg, kernel
+        )
     if blob:
         return stage_rank_blob(graph, pagerank_cfg, spectrum_cfg, kernel)
     from .jax_tpu import rank_window_device

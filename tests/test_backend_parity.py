@@ -149,12 +149,31 @@ def test_scatterfree_kernels_match_coo(small_case, kernel):
     ti_k, ts_k = np.asarray(ti_k), np.asarray(ts_k)
     # Top-1 parity plus same candidate set; exact positional equality is
     # not guaranteed — different summation trees perturb tied scores.
+    # The candidate-set comparison excludes entries whose score ties the
+    # truncation boundary: a near-tie straddling the top-k cut can
+    # legally swap which op makes the list (same rule as decisive()
+    # below).
     assert ti_c[0] == ti_k[0]
-    assert set(ti_c.tolist()) == set(ti_k.tolist())
+    rtol_cut = 2e-2 if kernel == "packed_bf16" else 1e-4
+
+    def _decided(ti, ts):
+        fin = ts[np.isfinite(ts)]
+        cut = fin.min() if fin.size else 0.0
+        return {
+            int(i)
+            for i, s in zip(ti.tolist(), ts.tolist())
+            if np.isfinite(s)
+            and abs(s - cut) > rtol_cut * max(abs(cut), 1e-12)
+        }
+
+    assert _decided(ti_c, ts_c) == _decided(ti_k, ts_k)
     if kernel != "packed_bf16":
         sc_c = dict(zip(ti_c.tolist(), ts_c.tolist()))
         sc_k = dict(zip(ti_k.tolist(), ts_k.tolist()))
-        for op, v in sc_c.items():
+        # Score closeness over the ops BOTH truncated lists kept (a
+        # boundary-tied op can legally appear in only one).
+        for op in set(sc_c) & set(sc_k):
+            v = sc_c[op]
             if np.isfinite(v):
                 assert abs(v - sc_k[op]) <= 1e-4 * max(abs(v), 1e-12), op
 
