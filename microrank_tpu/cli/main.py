@@ -102,6 +102,28 @@ def _config_from_args(args) -> "MicroRankConfig":
             backend=args.backend,
             mesh_shape=_parse_mesh(getattr(args, "mesh", None)),
             kernel=getattr(args, "kernel", "auto"),
+            # Flags only the `run` parser defines: absent/None attrs fall
+            # back to RuntimeConfig's own defaults (single source of
+            # truth — `eval` shares this builder without these flags).
+            **{
+                k: v
+                for k, v in {
+                    # store_true flags: only override when actually set.
+                    "async_dispatch": (
+                        False if getattr(args, "sync_dispatch", False) else None
+                    ),
+                    "blob_staging": (
+                        False
+                        if getattr(args, "no_blob_staging", False)
+                        else None
+                    ),
+                    "device_checks": (
+                        True if getattr(args, "device_checks", False) else None
+                    ),
+                    "pipeline_depth": getattr(args, "pipeline_depth", None),
+                }.items()
+                if v is not None
+            },
         ),
     )
     if args.reference_compat:
@@ -438,6 +460,26 @@ def main(argv=None) -> int:
         "--profile-dir",
         help="wrap the window loop in a jax.profiler trace and write the "
         "Perfetto dump here (rank 0 only in distributed runs)",
+    )
+    p_run.add_argument(
+        "--sync-dispatch", action="store_true",
+        help="disable the async stage/fetch worker threads (default on: "
+        "staging and fetch RPC latency overlap the next window's host "
+        "work)",
+    )
+    p_run.add_argument(
+        "--pipeline-depth", type=_positive_int, default=None,
+        help="device rank programs allowed in flight (1 = synchronous)",
+    )
+    p_run.add_argument(
+        "--no-blob-staging", action="store_true",
+        help="stage graphs as per-leaf transfers instead of one packed "
+        "uint32 buffer",
+    )
+    p_run.add_argument(
+        "--device-checks", action="store_true",
+        help="assert the finite-score invariant INSIDE the compiled "
+        "program (checkify; forces synchronous dispatch)",
     )
     p_run.add_argument(
         "--distributed", action="store_true",
