@@ -5,9 +5,12 @@ paths and constants edited in source (online_rca.py:219-255; README.md
 instructs editing the file). Here:
 
     python -m microrank_tpu.cli run    --normal N.csv --abnormal A.csv -o out/
-    python -m microrank_tpu.cli synth  -o data/ --spans 10000 --operations 100
-    python -m microrank_tpu.cli bench  ...        (thin wrapper over bench.py)
+    python -m microrank_tpu.cli synth  -o data/ --operations 100 --traces 500
+    python -m microrank_tpu.cli eval   --cases 40 [--faults 2] [--detection]
     python -m microrank_tpu.cli collect ...       (optional ClickHouse export)
+
+(The benchmark lives at the repo root — ``python bench.py`` — because it
+drives repo-local cached datasets, not the installed package.)
 """
 
 from __future__ import annotations

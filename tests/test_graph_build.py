@@ -119,3 +119,24 @@ def test_kind_hash_path_matches_exact(monkeypatch):
     for side in ("normal", "abnormal"):
         a, b = getattr(g_exact, side), getattr(g_hash, side)
         np.testing.assert_array_equal(a.kind, b.kind, err_msg=side)
+
+
+def test_pad_to_pow2q_contract():
+    # pow2q buckets: >= n, >= min_pad, multiples of 8 once >= 64, at
+    # most 25% waste past 64, and monotone in n.
+    from microrank_tpu.graph.structures import pad_to
+
+    prev = 0
+    for n in range(1, 5000):
+        p = pad_to(n, "pow2q")
+        assert p >= n
+        assert p >= 8
+        if p >= 64:
+            assert p % 8 == 0
+        if n >= 64:
+            assert p <= n * 1.25 + 8, (n, p)
+        assert p >= prev
+        prev = p
+    # min_pad floor respected even where quarter steps would undershoot.
+    assert pad_to(5, "pow2q", min_pad=128) == 128
+    assert pad_to(200, "pow2q", min_pad=256) == 256
