@@ -124,6 +124,7 @@ def _config_from_args(args) -> "MicroRankConfig":
                         True if getattr(args, "device_checks", False) else None
                     ),
                     "pipeline_depth": getattr(args, "pipeline_depth", None),
+                    "fetch_mode": getattr(args, "fetch_mode", None),
                 }.items()
                 if v is not None
             },
@@ -483,6 +484,12 @@ def main(argv=None) -> int:
         "--device-checks", action="store_true",
         help="assert the finite-score invariant INSIDE the compiled "
         "program (checkify; forces synchronous dispatch)",
+    )
+    p_run.add_argument(
+        "--fetch-mode", choices=["stream", "bulk"], default=None,
+        help="result fetches: per-window ('stream', lowest sink "
+        "latency) or batched over runtime.bulk_fetch_windows windows "
+        "('bulk', highest replay throughput on high-latency links)",
     )
     p_run.add_argument(
         "--distributed", action="store_true",

@@ -259,28 +259,36 @@ class TableRCA:
             *self.prepare_rank(table, mask, nrm_codes, abn_codes)
         )
 
-    def finalize_rank(self, handles):
-        """Force a dispatched rank's results to host (blocks if needed).
-
-        One batched ``jax.device_get`` — per-buffer fetches each pay a full
-        RPC round trip on tunneled-TPU runtimes (~78 ms apiece measured),
-        so never convert device scalars/arrays piecemeal on this path.
-        Multi-host runs route through fetch_replicated (allgather of any
-        process-spanning shards)."""
+    def finalize_rank_many(self, handles_list):
+        """Force MANY dispatched ranks' results to host in ONE batched
+        ``jax.device_get`` — per-buffer (and per-window) fetches each pay
+        a full RPC round trip on tunneled-TPU runtimes (~78-110 ms apiece
+        measured), so never convert device scalars/arrays piecemeal on
+        this path, and prefer joining several windows per call
+        (fetch_mode="bulk"). Multi-host runs route through
+        fetch_replicated (allgather of any process-spanning shards).
+        Returns [(names, scores), ...] in input order."""
         from ..parallel.distributed import fetch_replicated
 
-        top_idx, top_scores, n_valid, op_names = handles
-        top_idx, top_scores, n_valid = fetch_replicated(
-            (top_idx, top_scores, n_valid)
+        fetched = fetch_replicated(
+            tuple((h[0], h[1], h[2]) for h in handles_list)
         )
-        n = int(n_valid)
-        names = [op_names[int(i)] for i in top_idx[:n]]
-        scores = [float(s) for s in top_scores[:n]]
-        if self.config.runtime.validate_numerics:
-            from ..utils.guards import assert_finite_scores
+        out = []
+        for h, (top_idx, top_scores, n_valid) in zip(handles_list, fetched):
+            op_names = h[3]
+            n = int(n_valid)
+            names = [op_names[int(i)] for i in top_idx[:n]]
+            scores = [float(s) for s in top_scores[:n]]
+            if self.config.runtime.validate_numerics:
+                from ..utils.guards import assert_finite_scores
 
-            assert_finite_scores(scores, "TableRCA.rank_window")
-        return names, scores
+                assert_finite_scores(scores, "TableRCA.rank_window")
+            out.append((names, scores))
+        return out
+
+    def finalize_rank(self, handles):
+        """Force a dispatched rank's results to host (blocks if needed)."""
+        return self.finalize_rank_many([handles])[0]
 
     def rank_window(self, table, mask, nrm_codes, abn_codes):
         """Rank one window given its row mask and trace-code partitions."""
@@ -372,12 +380,28 @@ class TableRCA:
                 "in-program error check fetches device state per window)"
             )
             async_mode = False
+        # Bulk fetch: defer result fetches and join up to
+        # bulk_fetch_windows windows in ONE batched device_get — each
+        # per-window fetch pays a full RPC round trip on tunneled
+        # runtimes, and the outputs deferred are only the top-k arrays.
+        bulk = cfg.runtime.fetch_mode == "bulk" and not batch_windows
+        if cfg.runtime.fetch_mode not in ("stream", "bulk"):
+            raise ValueError(
+                f"unknown fetch_mode {cfg.runtime.fetch_mode!r}"
+            )
+        if bulk and jax.process_count() > 1:
+            self.log.warning(
+                "fetch_mode='bulk' is single-process only (collective "
+                "ordering of the batched allgather); streaming instead"
+            )
+            bulk = False
         stage_pool = fetch_pool = None
         if async_mode:
             from concurrent.futures import ThreadPoolExecutor
 
             stage_pool = ThreadPoolExecutor(1, "mr-stage")
-            fetch_pool = ThreadPoolExecutor(1, "mr-fetch")
+            if not bulk:  # bulk joins fetches itself, in batches
+                fetch_pool = ThreadPoolExecutor(1, "mr-fetch")
 
         results: List[WindowResult] = []
         pending = []  # (result, mask, nrm, abn) for deferred batched rank
@@ -447,16 +471,45 @@ class TableRCA:
                 names, scores = self.finalize_rank(handles)
             _set_ranking(result, timings, names, scores)
 
+        def _flush_bulk():
+            """Join EVERY deferred window's results in one batched fetch
+            (fetch_mode="bulk"); the single RPC's wall time lands on the
+            first flushed window's rank_wait. ALL rankings are assigned
+            before anything emits — ``inflight`` stays populated until
+            then, so no batch-mate can reach the sink half-finished —
+            and only then does one _emit_ready release the batch in
+            window order."""
+            if not inflight:
+                return
+            items = inflight[:]
+            handles = [
+                h.result() if hasattr(h, "result") else h
+                for _, h, _ in items
+            ]
+            with items[0][2].stage("rank_wait"):
+                ranked = self.finalize_rank_many(handles)
+            for (result, _, timings), (names, scores) in zip(items, ranked):
+                result.ranking = list(zip(names, scores))
+                result.timings = timings.as_dict()
+            inflight.clear()
+            _emit_ready()
+
+        loop_depth = (
+            max(1, int(cfg.runtime.bulk_fetch_windows)) if bulk else depth
+        )
+        finalize_cb = _flush_bulk if bulk else _finalize_one
+
         try:
             self._window_loop(
-                table, current, end, detect_us, skip_us, depth,
+                table, current, end, detect_us, skip_us, loop_depth,
                 batch_windows, results, pending, inflight, finishing,
-                next_cursor, stage_pool, _finalize_one, _complete_one,
+                next_cursor, stage_pool, finalize_cb, _complete_one,
                 _emit_ready,
             )
         finally:
             if stage_pool is not None:
                 stage_pool.shutdown(wait=False, cancel_futures=True)
+            if fetch_pool is not None:
                 fetch_pool.shutdown(wait=False, cancel_futures=True)
 
         if batch_windows and pending:
