@@ -104,7 +104,7 @@ CONFIG_PRESETS = {
     "2": dict(spans=10_000, ops=500),      # synthetic Erdős–Rényi
     "3": dict(spans=50_000, ops=1_000),    # Online-Boutique scale
     "4": dict(spans=250_000, ops=2_000, batch=8),  # TrainTicket, vmapped
-    "5": dict(spans=1_000_000, ops=5_000, replay=4),  # sharded-mesh target
+    "5": dict(spans=1_000_000, ops=5_000, replay=8),  # sharded-mesh target
     "6": dict(spans=4_000_000, ops=10_000),  # stretch (EVALUATION.md row)
 }
 
