@@ -88,3 +88,22 @@ def test_blob_rank_matches_per_leaf_staging(small_case, kernel):
         np.asarray(ref[1]), np.asarray(got[1]), rtol=1e-5
     )
     assert int(ref[2]) == int(got[2])
+
+
+def test_blob_roundtrip_exact_padding(small_case):
+    # pad_policy="exact" produces odd array lengths (non-multiple-of-4
+    # byte counts for uint8/bool leaves) — the word-padding path must
+    # still round-trip bit-exactly.
+    graph, _ = _graph_for(small_case, pad_policy="exact")
+    blob, layout = pack_graph_blob(graph)
+    out = jax.jit(unpack_graph_blob, static_argnums=1)(blob, layout)
+    for part_name in ("normal", "abnormal"):
+        src, dst = getattr(graph, part_name), getattr(out, part_name)
+        for f, a, b in zip(src._fields, src, dst):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape and a.dtype == b.dtype, f
+            np.testing.assert_array_equal(
+                np.atleast_1d(a).view(np.uint8),
+                np.atleast_1d(b).view(np.uint8),
+                err_msg=f"{part_name}.{f}",
+            )
