@@ -68,3 +68,56 @@ def test_sparse_oracle_duplicate_span_traces():
         )
     )
     _compare(case, MicroRankConfig())
+
+
+def test_fuzz_full_ranking_parity_vs_jax():
+    # Property-style sweep: random medium-scale workloads, full-ranking
+    # tie-aware parity between the device path and the float64 sparse
+    # oracle (the check bench runs at 1M spans, here across topology
+    # space). Catches kernel/oracle drift no single fixture would.
+    import jax
+    import jax.numpy as jnp
+
+    from microrank_tpu.rank_backends.jax_tpu import (
+        choose_kernel,
+        rank_window_device,
+    )
+
+    rng = np.random.default_rng(7)
+    cfg = MicroRankConfig()
+    checked = 0
+    for trial in range(8):
+        scfg = SyntheticConfig(
+            n_operations=int(rng.integers(30, 300)),
+            n_traces=int(rng.integers(150, 1200)),
+            n_kinds=int(rng.integers(16, 64)),
+            child_keep_prob=float(rng.uniform(0.2, 0.7)),
+            seed=int(rng.integers(0, 10_000)),
+        )
+        case = generate_case(scfg)
+        nrm, abn = partition_case(case)
+        if not (nrm and abn):
+            continue
+        graph, op_names, _, _ = build_window_graph(case.abnormal, nrm, abn)
+        kernel = choose_kernel(graph)
+        ti, ts, nv = rank_window_device(
+            jax.tree.map(jnp.asarray, graph),
+            cfg.pagerank,
+            cfg.spectrum,
+            None,
+            kernel,
+        )
+        names_j = [op_names[int(i)] for i in np.asarray(ti)[: int(nv)]]
+        scores_j = [float(s) for s in np.asarray(ts)[: int(nv)]]
+        top_o, sc_o = rank_window_sparse(
+            graph, op_names, cfg.pagerank, cfg.spectrum
+        )
+        # Top-1 must agree exactly; deeper ranks tie-aware (f32 vs f64).
+        assert names_j and names_j[0] == top_o[0], (scfg, names_j[:3], top_o[:3])
+        for r in range(min(5, len(names_j), len(top_o))):
+            sa, sb = scores_j[r], sc_o[r]
+            assert abs(sa - sb) <= 2e-3 * max(abs(sa), abs(sb), 1e-12), (
+                scfg, r, sa, sb,
+            )
+        checked += 1
+    assert checked >= 5
