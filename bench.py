@@ -304,30 +304,15 @@ def _analytic_iter_cost(graph, kernel):
 def _tie_aware_topk_parity(
     names_a, scores_a, names_b, scores_b, k: int, rtol: float = 1e-3
 ) -> bool:
-    """Positional top-k agreement where a name mismatch is forgiven only
-    inside a tied score group: both lists must carry ~equal scores at the
-    mismatched position (ties may permute across float dtypes — the
-    device path iterates in f32, the oracle in f64)."""
-    n = min(k, len(names_a), len(names_b))
-    if n < min(k, max(len(names_a), len(names_b))):
-        return False
-    for i in range(n):
-        sa, sb = scores_a[i], scores_b[i]
-        if abs(sa - sb) > rtol * max(abs(sa), abs(sb), 1e-12):
-            return False  # scores at this rank must agree regardless
-        if names_a[i] != names_b[i]:
-            # Permuted tie: each mismatched name must appear in the
-            # OTHER list with a score tied to this rank's — membership
-            # alone would accept genuinely swapped (non-tied) rankings.
-            try:
-                sb_of_a = scores_b[names_b[:k].index(names_a[i])]
-                sa_of_b = scores_a[names_a[:k].index(names_b[i])]
-            except ValueError:
-                return False
-            for other in (sb_of_a, sa_of_b):
-                if abs(other - sa) > rtol * max(abs(other), abs(sa), 1e-12):
-                    return False
-    return True
+    """Positional top-k agreement, ties may permute — the ONE shared
+    comparator (microrank_tpu.utils.ranking_compare; the dryrun gate
+    uses the same function)."""
+    from microrank_tpu.utils.ranking_compare import tie_aware_topk_agreement
+
+    ok, _ = tie_aware_topk_agreement(
+        names_a, scores_a, names_b, scores_b, k, rtol
+    )
+    return ok
 
 
 def _time_median(fn, repeats: int) -> float:
@@ -593,12 +578,20 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
     # detect = the generator's window span, skip = 0. fetch_mode="bulk"
     # is the replay-throughput configuration (one batched result fetch
     # instead of a ~110 ms RPC per window) — a first-class product mode
-    # (`run --fetch-mode bulk`), not a bench special case.
+    # (`run --fetch-mode bulk`), not a bench special case. The replay
+    # honors the same BENCH_KERNEL / BENCH_BLOB forcing as the
+    # single-window phase, so a forced-kernel bench's headline measures
+    # that kernel.
     cfg = cfg.replace(
         window=WindowConfig(
             detect_minutes=float(truth["window_minutes"]), skip_minutes=0.0
         ),
-        runtime=dataclasses.replace(cfg.runtime, fetch_mode="bulk"),
+        runtime=dataclasses.replace(
+            cfg.runtime,
+            fetch_mode="bulk",
+            kernel=os.environ.get("BENCH_KERNEL", "auto"),
+            blob_staging=_use_blob(),
+        ),
     )
     rca = TableRCA(cfg)
     rca.fit_baseline(normal_table)

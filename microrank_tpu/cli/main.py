@@ -489,7 +489,8 @@ def main(argv=None) -> int:
         "--fetch-mode", choices=["stream", "bulk"], default=None,
         help="result fetches: per-window ('stream', lowest sink "
         "latency) or batched over runtime.bulk_fetch_windows windows "
-        "('bulk', highest replay throughput on high-latency links)",
+        "('bulk', highest replay throughput on high-latency links; "
+        "supersedes --pipeline-depth as the in-flight bound)",
     )
     p_run.add_argument(
         "--distributed", action="store_true",

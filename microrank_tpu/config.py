@@ -194,7 +194,12 @@ class RuntimeConfig:
     # replay length). Results reach the sink in bursts and the resume
     # cursor advances later (a crash re-runs more windows).
     # Single-process only; outputs are tiny (top-k), so deferral holds
-    # no significant device memory.
+    # no significant device memory (program INPUTS free as each program
+    # executes, so the flush cadence does not pin staged graphs).
+    # NOTE: in bulk mode ``bulk_fetch_windows`` SUPERSEDES
+    # pipeline_depth as the in-flight bound — the flush is the
+    # backpressure; a strict low-depth requirement needs
+    # fetch_mode="stream".
     fetch_mode: str = "stream"     # "stream" | "bulk"
     bulk_fetch_windows: int = 32
     # Stage single-device window graphs as ONE packed uint32 buffer

@@ -203,25 +203,42 @@ def resolve_aux(
     quarter of the budget (the unpacked-f32 budget itself is applied at
     kernel-choice time: within it the kernel is "packed", past it
     "packed_blocked" streams column blocks so only the bitmap must be
-    resident) -> "csr" when even the bitmaps blow that. Explicit modes
-    ("packed" | "csr" | "all" | "none") pass through for forced-kernel
-    runs.
+    resident) -> "csr" when even the bitmaps blow that.
+
+    "auto_all" (the sharded path's mode) -> "all" inside the bitmap
+    budget, "csr" past it: the mesh kernel choice depends on the
+    PER-SHARD packed footprint, which this window-level policy can't
+    anticipate, so both view families are built and
+    _resolve_shard_kernel picks — keeping the csr fallback available
+    where the single-device "auto" would have built bitmaps only.
+
+    Explicit modes ("packed" | "csr" | "all" | "none") pass through for
+    forced-kernel runs.
     """
-    if aux != "auto":
+    if aux not in ("auto", "auto_all"):
         return aux
     bits_total = packed_bits_bytes(v_pad, t_pads)
-    return "packed" if bits_total <= dense_budget_bytes // 4 else "csr"
+    if bits_total > dense_budget_bytes // 4:
+        return "csr"
+    return "all" if aux == "auto_all" else "packed"
 
 
-def aux_for_kernel(kernel: str) -> str:
+def aux_for_kernel(kernel: str, sharded: bool = False) -> str:
     """The build aux mode a forced RuntimeConfig.kernel needs."""
-    return {
+    mode = {
         "auto": "auto",
         "csr": "csr",
         "packed": "packed",
         "packed_bf16": "packed",
         "packed_blocked": "packed",
     }.get(kernel, "none")
+    if sharded and mode == "auto":
+        # Mesh dispatch: build BOTH view families (inside the bitmap
+        # budget) so the per-shard packed-footprint check at kernel
+        # choice can fall back to csr — the window-level auto policy
+        # cannot anticipate the shard count.
+        return "auto_all"
+    return mode
 
 
 def _scatter_bits(rows, cols, v_pad: int, n_cols: int) -> np.ndarray:

@@ -188,7 +188,7 @@ class TableRCA:
         if self._mesh is not None:
             k = cfg.runtime.kernel
             shard_kernel = k if k in SHARD_KERNELS else "auto"
-            build_aux = aux_for_kernel(shard_kernel)
+            build_aux = aux_for_kernel(shard_kernel, sharded=True)
         else:
             shard_kernel = None
             build_aux = aux_for_kernel(cfg.runtime.kernel)
@@ -619,13 +619,14 @@ class TableRCA:
         # Concurrently-resident windows per device: the whole batch under
         # single-device vmap, ceil(B/windows-axis) on a mesh.
         per_device = -(-len(pending) // w_n)
+        build_aux = aux_for_kernel(kernel, sharded=self._mesh is not None)
         with timings.stage("build"):
             for _, mask, nrm, abn in pending:
                 graph, _, _, _ = build_window_graph_from_table(
                     table, mask, nrm, abn,
                     pad_policy=cfg.runtime.pad_policy,
                     min_pad=cfg.runtime.min_pad,
-                    aux=aux_for_kernel(kernel),
+                    aux=build_aux,
                     dense_budget_bytes=max(
                         1, cfg.runtime.dense_budget_bytes // per_device
                     ),

@@ -1,0 +1,62 @@
+"""Tie-aware ranked-list agreement — the ONE comparator the bench's
+full-window oracle gate and the multichip dryrun's sharded-vs-single
+gate share (review finding: three bespoke copies with subtly different
+tie rules can silently drift; tie semantics live here).
+
+Two rankings from different compute paths (f32 device kernels, f64
+oracle, sharded summation trees) may legally permute EXACT ties and
+wobble scores by reassociation — but any non-tied positional difference
+is a real disagreement. The rules:
+
+* lengths (clamped to k) must match;
+* scores must agree rank by rank within ``rtol``;
+* an id mismatch at a rank is forgiven only when BOTH ids appear in the
+  other list's top-k with a score tied to this rank's (a genuinely
+  permuted tie) — membership alone would accept swapped non-tied
+  rankings;
+* with ``exempt_last`` (full truncated lists), the final kept rank is
+  exempt from the membership rule: a near-tie straddling the top-k cut
+  can legally swap an id across it (the score check above still binds).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+
+def tie_aware_topk_agreement(
+    ids_a: Sequence,
+    scores_a: Sequence[float],
+    ids_b: Sequence,
+    scores_b: Sequence[float],
+    k: int,
+    rtol: float = 1e-3,
+    exempt_last: bool = False,
+) -> Tuple[bool, str]:
+    """Returns (agree, reason); ``reason`` names the first failure."""
+    n = min(k, len(ids_a), len(ids_b))
+    if n < min(k, max(len(ids_a), len(ids_b))):
+        return False, (
+            f"length mismatch: {len(ids_a)} vs {len(ids_b)} entries "
+            f"within top-{k}"
+        )
+    ids_a, ids_b = list(ids_a[:k]), list(ids_b[:k])
+    for r in range(n):
+        sa, sb = float(scores_a[r]), float(scores_b[r])
+        if abs(sa - sb) > rtol * max(abs(sa), abs(sb), 1e-12):
+            return False, f"score mismatch at rank {r}: {sa} vs {sb}"
+        if ids_a[r] == ids_b[r]:
+            continue
+        if exempt_last and r == n - 1:
+            continue  # legal swap across the truncation cut
+        if ids_a[r] not in ids_b or ids_b[r] not in ids_a:
+            return False, (
+                f"id mismatch at rank {r}: {ids_a[r]!r} vs {ids_b[r]!r}"
+            )
+        # Each swapped id's score in the OTHER list must tie this rank's.
+        sb_of_a = float(scores_b[ids_b.index(ids_a[r])])
+        sa_of_b = float(scores_a[ids_a.index(ids_b[r])])
+        for cross in (sb_of_a, sa_of_b):
+            if abs(cross - sa) > rtol * max(abs(cross), abs(sa), 1e-12):
+                return False, f"non-tied id swap at rank {r}"
+    return True, "ok"

@@ -1,0 +1,55 @@
+"""The shared tie-aware ranked-list comparator (utils.ranking_compare)
+behind both the bench's full-window oracle gate and the multichip
+dryrun's sharded-vs-single gate."""
+
+from microrank_tpu.utils.ranking_compare import tie_aware_topk_agreement
+
+
+def _ok(*a, **kw):
+    agree, why = tie_aware_topk_agreement(*a, **kw)
+    return agree
+
+
+def test_identical_lists_agree():
+    assert _ok(["x", "y"], [1.0, 0.5], ["x", "y"], [1.0, 0.5], 2)
+
+
+def test_true_tie_permutation_agrees():
+    assert _ok(["x", "y"], [1.0, 1.0], ["y", "x"], [1.0, 1.0], 2)
+
+
+def test_swapped_non_tied_rankings_fail():
+    assert not _ok(["x", "y"], [1.0, 0.5], ["y", "x"], [1.0, 0.5], 2)
+
+
+def test_different_id_fails():
+    assert not _ok(["x", "y"], [1.0, 0.5], ["x", "z"], [1.0, 0.5], 2)
+
+
+def test_score_mismatch_fails():
+    assert not _ok(["x", "y"], [1.0, 0.5], ["x", "y"], [1.0, 0.4], 2)
+
+
+def test_length_mismatch_within_k_fails():
+    assert not _ok(["x", "y"], [1.0, 0.5], ["x"], [1.0], 2)
+
+
+def test_truncation_boundary_swap_needs_exemption():
+    # Last kept rank holds different near-tied ids (the other fell past
+    # the cut): fails strictly, passes with exempt_last.
+    a = (["x", "y"], [1.0, 0.5])
+    b = (["x", "z"], [1.0, 0.5])
+    assert not _ok(*a, *b, 2)
+    assert _ok(*a, *b, 2, exempt_last=True)
+
+
+def test_exemption_does_not_cover_inner_ranks():
+    a = (["x", "q", "y"], [1.0, 0.7, 0.5])
+    b = (["x", "r", "y"], [1.0, 0.7, 0.5])
+    assert not _ok(*a, *b, 3, exempt_last=True)
+
+
+def test_k_truncates_longer_lists():
+    assert _ok(
+        ["x", "y", "a"], [1.0, 0.5, 0.1], ["x", "y", "b"], [1.0, 0.5, 0.2], 2
+    )

@@ -112,12 +112,16 @@ def test_fuzz_full_ranking_parity_vs_jax():
         top_o, sc_o = rank_window_sparse(
             graph, op_names, cfg.pagerank, cfg.spectrum
         )
-        # Top-1 must agree exactly; deeper ranks tie-aware (f32 vs f64).
+        # Top-1 exactly; top-5 via the SAME tie-aware comparator the
+        # bench oracle gate uses (f32 device vs f64 oracle ties).
+        from microrank_tpu.utils.ranking_compare import (
+            tie_aware_topk_agreement,
+        )
+
         assert names_j and names_j[0] == top_o[0], (scfg, names_j[:3], top_o[:3])
-        for r in range(min(5, len(names_j), len(top_o))):
-            sa, sb = scores_j[r], sc_o[r]
-            assert abs(sa - sb) <= 2e-3 * max(abs(sa), abs(sb), 1e-12), (
-                scfg, r, sa, sb,
-            )
+        ok, why = tie_aware_topk_agreement(
+            names_j, scores_j, top_o, sc_o, k=5, rtol=2e-3
+        )
+        assert ok, (scfg, why, names_j[:5], top_o[:5])
         checked += 1
     assert checked >= 5
