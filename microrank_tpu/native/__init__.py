@@ -27,6 +27,7 @@ import numpy as np
 _SRCS = [
     Path(__file__).parent / "span_loader.cpp",
     Path(__file__).parent / "graph_builder.cpp",
+    Path(__file__).parent / "detector.cpp",
 ]
 _LIB = Path(__file__).parent / "libmrspan.so"
 _lib: Optional[ctypes.CDLL] = None
@@ -156,6 +157,27 @@ def _load_library() -> ctypes.CDLL:
     ]
     lib.mr_free_built.restype = None
     lib.mr_free_built.argtypes = [ctypes.c_void_p]
+    lib.mr_detect_window.restype = ctypes.c_int
+    lib.mr_detect_window.argtypes = [
+        ctypes.c_int64,   # n_spans
+        i32p,             # trace_id
+        i32p,             # svc_op
+        i64p,             # duration_us
+        i64p,             # start_us
+        i64p,             # end_us
+        ctypes.c_int64,   # w0_us
+        ctypes.c_int64,   # w1_us
+        i32p,             # remap
+        ctypes.c_int64,   # n_svc_vocab
+        f32p,             # thresh_ms
+        ctypes.c_int64,   # n_slo_vocab
+        ctypes.c_float,   # slack_ms
+        ctypes.c_int64,   # n_traces_total
+        u8p,              # mask out
+        i32p,             # nrm out
+        i32p,             # abn out
+        i64p,             # counts out
+    ]
     _lib = lib
     return lib
 
@@ -507,11 +529,75 @@ def build_window_padded(
         lib.mr_free_built(handle)
 
 
+def detect_window_native(
+    table: SpanTable,
+    w0_us: int,
+    w1_us: int,
+    remap: np.ndarray,
+    thresh_ms: np.ndarray,
+    slack_ms: float,
+):
+    """Fused one-scan window detection (detector.cpp): window mask +
+    per-trace expected/real + normal/abnormal partition, numerically
+    identical to detect_batch_from_table + detect_numpy (parity-tested).
+
+    ``remap`` maps table svc-op ids into the SLO vocab (int32, -1 for
+    unseen); ``thresh_ms`` is the float32 mu + k*sigma array over that
+    vocab. Returns (mask bool[S], nrm int32[], abn int32[],
+    n_window_spans, n_traces_seen). Raises NativeUnavailable when the
+    library can't build.
+    """
+    lib = _load_library()
+    n_spans = table.n_spans
+    n_total = len(table.trace_names)
+    mask = np.empty(n_spans, dtype=np.uint8)
+    nrm = np.empty(n_total, dtype=np.int32)
+    abn = np.empty(n_total, dtype=np.int32)
+    counts = np.zeros(4, dtype=np.int64)
+    remap = np.ascontiguousarray(remap, dtype=np.int32)
+    thresh_ms = np.ascontiguousarray(thresh_ms, dtype=np.float32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.mr_detect_window(
+        ctypes.c_int64(n_spans),
+        table.trace_id.ctypes.data_as(i32p),
+        table.svc_op.ctypes.data_as(i32p),
+        table.duration_us.ctypes.data_as(i64p),
+        table.start_us.ctypes.data_as(i64p),
+        table.end_us.ctypes.data_as(i64p),
+        ctypes.c_int64(int(w0_us)),
+        ctypes.c_int64(int(w1_us)),
+        remap.ctypes.data_as(i32p),
+        ctypes.c_int64(len(remap)),
+        thresh_ms.ctypes.data_as(f32p),
+        ctypes.c_int64(len(thresh_ms)),
+        ctypes.c_float(float(slack_ms)),
+        ctypes.c_int64(n_total),
+        mask.ctypes.data_as(u8p),
+        nrm.ctypes.data_as(i32p),
+        abn.ctypes.data_as(i32p),
+        counts.ctypes.data_as(i64p),
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"mr_detect_window failed (rc={rc})")
+    n_nrm, n_abn, n_window, n_seen = (int(c) for c in counts)
+    return (
+        mask.view(np.bool_),
+        nrm[:n_nrm].copy(),
+        abn[:n_abn].copy(),
+        n_window,
+        n_seen,
+    )
+
+
 __all__ = [
     "SpanTable",
     "PaddedPartition",
     "NativeUnavailable",
     "load_span_table",
     "build_window_padded",
+    "detect_window_native",
     "native_available",
 ]

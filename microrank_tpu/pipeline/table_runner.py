@@ -44,6 +44,8 @@ class TableRCA:
         validate_tiebreak(config.spectrum)
         self.slo_vocab = None
         self.baseline = None
+        self._thresh = None       # mu + k*sigma f32, set by fit_baseline
+        self._remap_cache = None  # (id(table), svc-op -> SLO vocab remap)
         self._mesh = None
         if config.runtime.mesh_shape is not None:
             from ..parallel.mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh
@@ -164,13 +166,71 @@ class TableRCA:
         )
 
     def fit_baseline(self, normal_table) -> None:
+        from ..detect.detector import _thresholds
+
         self.slo_vocab, self.baseline = compute_slo_from_table(
             normal_table, stat=self.config.detector.slo_stat
         )
+        self._thresh = _thresholds(self.baseline, self.config.detector)
+        self._remap_cache = None
         self.log.info(
             "fitted SLO baseline (native lane): %d operations",
             len(self.slo_vocab),
         )
+
+    def _detect_window(self, table, w0: int, w1: int):
+        """One window's detection: (mask, nrm_codes, abn_codes,
+        n_window_spans) — the fused C++ scan (native.detect_window_native,
+        one pass over the table) when the native library is available,
+        the numpy twin otherwise. Both produce identical partitions
+        (parity-tested)."""
+        from ..native import NativeUnavailable, native_available
+
+        cfg = self.config
+        if native_available():
+            from ..native import detect_window_native
+
+            # Keyed by id() — valid because run() clears the cache on
+            # exit, and the table is alive for the whole run (id reuse
+            # is impossible while the key's referent is alive). A strong
+            # table reference here would pin ~GB-scale columns on the
+            # TableRCA instance after run() returns.
+            if (
+                self._remap_cache is None
+                or self._remap_cache[0] != id(table)
+            ):
+                self._remap_cache = (
+                    id(table),
+                    np.ascontiguousarray(
+                        self.slo_vocab.encode(table.svc_op_names),
+                        dtype=np.int32,
+                    ),
+                )
+            try:
+                mask, nrm, abn, n_window, _ = detect_window_native(
+                    table,
+                    w0,
+                    w1,
+                    self._remap_cache[1],
+                    self._thresh,
+                    cfg.detector.slack_ms,
+                )
+                return mask, nrm, abn, n_window
+            except NativeUnavailable:
+                pass  # fall through to numpy
+        mask = window_rows(table, w0, w1)
+        n_window = int(mask.sum())
+        if n_window == 0:
+            return mask, None, None, 0
+        batch, trace_codes = detect_batch_from_table(
+            table, mask, self.slo_vocab,
+            cfg.runtime.pad_policy, cfg.runtime.min_pad,
+        )
+        det = detect_numpy(batch, self.baseline, cfg.detector)
+        t = len(trace_codes)
+        abn = trace_codes[det.abnormal[:t]]
+        nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
+        return mask, nrm, abn, n_window
 
     def prepare_rank(self, table, mask, nrm_codes, abn_codes):
         """Host half of a window rank: build the graph (pure host compute,
@@ -511,6 +571,10 @@ class TableRCA:
                 stage_pool.shutdown(wait=False, cancel_futures=True)
             if fetch_pool is not None:
                 fetch_pool.shutdown(wait=False, cancel_futures=True)
+            # The remap cache is keyed by id(table); drop it so the id
+            # key can't alias a future table and the remap array doesn't
+            # outlive the run.
+            self._remap_cache = None
 
         if batch_windows and pending:
             self._rank_pending(table, pending)
@@ -536,20 +600,16 @@ class TableRCA:
             result = WindowResult(start=_iso(w0), end=_iso(w1), anomaly=False)
             ranked = False
 
-            mask = window_rows(table, w0, w1)
-            if not mask.any():
+            with timings.stage("detect"):
+                mask, nrm, abn, n_window = self._detect_window(
+                    table, w0, w1
+                )
+            if n_window == 0:
                 result.skipped_reason = "empty_window"
             else:
-                with timings.stage("detect"):
-                    batch, trace_codes = detect_batch_from_table(
-                        table, mask, self.slo_vocab,
-                        cfg.runtime.pad_policy, cfg.runtime.min_pad,
-                    )
-                    det = detect_numpy(batch, self.baseline, cfg.detector)
-                t = len(trace_codes)
-                abn = trace_codes[det.abnormal[:t]]
-                nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
-                result.anomaly = bool(det.flag)
+                result.anomaly = (
+                    len(abn) >= cfg.detector.min_abnormal_traces
+                )
                 result.n_normal, result.n_abnormal = len(nrm), len(abn)
                 result.n_traces = len(nrm) + len(abn)
                 if result.anomaly and (len(nrm) == 0 or len(abn) == 0):

@@ -298,3 +298,64 @@ def test_edge_bitmap_and_fallback_agree(csv_pair, monkeypatch):
         np.testing.assert_array_equal(a, base_a)
         np.testing.assert_array_equal(b, base_b)
         _assert_graphs_equal(g, base_g)
+
+
+def test_native_detect_matches_numpy(tmp_path):
+    """The fused C++ detector must produce IDENTICAL window masks and
+    normal/abnormal partitions as detect_batch_from_table + detect_numpy
+    across several windows of a multi-window timeline (including an
+    empty window past the end)."""
+    import numpy as np
+
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.detect import detect_numpy
+    from microrank_tpu.detect.detector import _thresholds
+    from microrank_tpu.graph.table_ops import (
+        compute_slo_from_table,
+        detect_batch_from_table,
+        window_rows,
+    )
+    from microrank_tpu.native import (
+        detect_window_native,
+        load_span_table,
+        native_available,
+    )
+    from microrank_tpu.testing import SyntheticConfig
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    if not native_available():
+        pytest.skip("native lane unavailable")
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=13),
+        4,
+        [0, 2],
+    )
+    tl.normal.to_csv(tmp_path / "normal.csv", index=False)
+    tl.timeline.to_csv(tmp_path / "abnormal.csv", index=False)
+    normal = load_span_table(tmp_path / "normal.csv")
+    table = load_span_table(tmp_path / "abnormal.csv")
+    cfg = MicroRankConfig()
+    vocab, baseline = compute_slo_from_table(normal)
+    thresh = _thresholds(baseline, cfg.detector)
+    remap = vocab.encode(table.svc_op_names).astype(np.int32)
+
+    w_us = int(tl.window_minutes * 60e6)
+    start = int(tl.start.value // 1000)
+    for b in range(6):  # windows 4..5 are past the end (empty)
+        w0, w1 = start + b * w_us, start + (b + 1) * w_us
+        n_mask, n_nrm, n_abn, n_window, n_seen = detect_window_native(
+            table, w0, w1, remap, thresh, cfg.detector.slack_ms
+        )
+        mask = window_rows(table, w0, w1)
+        np.testing.assert_array_equal(np.asarray(n_mask), mask, f"mask w{b}")
+        assert n_window == int(mask.sum()), b
+        if n_window == 0:
+            assert len(n_nrm) == 0 and len(n_abn) == 0
+            continue
+        batch, codes = detect_batch_from_table(table, mask, vocab)
+        det = detect_numpy(batch, baseline, cfg.detector)
+        t = len(codes)
+        abn = codes[det.abnormal[:t]]
+        nrm = codes[det.valid[:t] & ~det.abnormal[:t]]
+        np.testing.assert_array_equal(np.sort(n_abn), np.sort(abn), f"abn w{b}")
+        np.testing.assert_array_equal(np.sort(n_nrm), np.sort(nrm), f"nrm w{b}")
