@@ -20,8 +20,9 @@ Pipeline benched is the native lane: C++ mmap ingest (interned arrays) ->
 int-only window build -> jitted rank. Synthetic chaos-case CSVs are
 generated once and cached under bench_data/.
 
-Config via env: BENCH_CONFIG=1..5 selects a BASELINE.json workload preset
-(default 5 = 1M spans / 5k ops); BENCH_SPANS / BENCH_OPS override the
+Config via env: BENCH_CONFIG=1..7 selects a workload preset
+(BASELINE.json's five plus the 4M stretch and the 16M ceiling probe;
+default 5 = 1M spans / 5k ops); BENCH_SPANS / BENCH_OPS override the
 preset's sizes; BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000),
 BENCH_KERNEL
 (auto|packed|packed_bf16|packed_blocked|csr|coo|dense|dense_bf16|pallas),
@@ -93,7 +94,7 @@ def _ensure_data(spans_target, n_ops, fault_ms):
     return case_dir, truth
 
 
-# BASELINE.json's five workload configs, selectable via BENCH_CONFIG=1..5
+# Workload presets, selectable via BENCH_CONFIG=1..7
 # (BENCH_SPANS / BENCH_OPS still override individually). Config 4 is the
 # "batched multi-window spectrum (8 windows vmapped)" preset: the window
 # is time-sliced into `batch` sub-windows, each detected/partitioned
@@ -106,6 +107,7 @@ CONFIG_PRESETS = {
     "4": dict(spans=250_000, ops=2_000, batch=8),  # TrainTicket, vmapped
     "5": dict(spans=1_000_000, ops=5_000, replay=8),  # sharded-mesh target
     "6": dict(spans=4_000_000, ops=10_000),  # stretch (EVALUATION.md row)
+    "7": dict(spans=16_000_000, ops=16_000),  # 16M-span ceiling probe
 }
 
 
@@ -430,25 +432,25 @@ def _run_batched(
     import jax
     import numpy as np
 
-    from microrank_tpu.detect import detect_numpy
     from microrank_tpu.graph.build import aux_for_kernel
-    from microrank_tpu.graph.table_ops import (
-        build_window_graph_from_table,
-        detect_batch_from_table,
-    )
+    from microrank_tpu.graph.table_ops import build_window_graph_from_table
     from microrank_tpu.parallel import stack_window_graphs
 
     w_us = int(truth["window_minutes"] * 60e6)
     start = int(truth["start_us"])
     edges = [start + b * w_us for b in range(n_batch + 1)]
 
+    from microrank_tpu.detect.detector import _thresholds
+    from microrank_tpu.graph.table_ops import detect_window_partition
+
+    thresh = _thresholds(baseline, cfg.detector)
+    remap = slo_vocab.encode(table.svc_op_names).astype(np.int32)
+
     def detect_window(b):
-        m = (table.start_us >= edges[b]) & (table.end_us <= edges[b + 1])
-        batch, codes = detect_batch_from_table(table, m, slo_vocab)
-        det = detect_numpy(batch, baseline, cfg.detector)
-        t = len(codes)
-        abn = codes[det.abnormal[:t]]
-        nrm = codes[det.valid[:t] & ~det.abnormal[:t]]
+        m, nrm, abn, _ = detect_window_partition(
+            table, edges[b], edges[b + 1], slo_vocab, baseline,
+            cfg.detector, remap=remap, thresh=thresh,
+        )
         return m, nrm, abn
 
     def build_all():
@@ -655,11 +657,9 @@ def main() -> int:
     import numpy as np
 
     from microrank_tpu.config import MicroRankConfig
-    from microrank_tpu.detect import detect_numpy
     from microrank_tpu.graph.table_ops import (
         build_window_graph_from_table,
         compute_slo_from_table,
-        detect_batch_from_table,
     )
     from microrank_tpu.native import load_span_table, native_available
     from microrank_tpu.rank_backends.jax_tpu import JaxBackend, choose_kernel
@@ -697,14 +697,18 @@ def main() -> int:
             truth, case_dir, oracle_spans,
             os.environ.get("BENCH_KERNEL", "auto"),
         )
-    mask = np.ones(n_spans, dtype=bool)
-    batch, trace_codes = detect_batch_from_table(
-        abnormal_table, mask, slo_vocab
+    # The shared detection seam (fused C++ scan; same path TableRCA
+    # runs, with its own numpy fallback inside).
+    from microrank_tpu.graph.table_ops import detect_window_partition
+
+    mask, nrm, abn, _ = detect_window_partition(
+        abnormal_table,
+        int(abnormal_table.start_us.min()),
+        int(abnormal_table.end_us.max()),
+        slo_vocab,
+        baseline,
+        cfg.detector,
     )
-    det = detect_numpy(batch, baseline, cfg.detector)
-    t = len(trace_codes)
-    abn = trace_codes[det.abnormal[:t]]
-    nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
     detect_s = time.perf_counter() - t0
     log(
         f"detect+partition: {detect_s:.2f}s "

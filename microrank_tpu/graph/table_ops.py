@@ -126,6 +126,61 @@ def detect_batch_from_table(
     return batch, uniques
 
 
+def detect_window_partition(
+    table,
+    w0_us: int,
+    w1_us: int,
+    slo_vocab: Vocab,
+    baseline,
+    detector_cfg,
+    remap: np.ndarray | None = None,
+    thresh: np.ndarray | None = None,
+    pad_policy: str = "pow2q",
+    min_pad: int = 8,
+):
+    """THE window-detection seam (used by TableRCA, bench single-window
+    and bench batched modes alike): returns (mask, nrm_codes, abn_codes,
+    n_window_spans) for one [w0, w1) window — the fused C++ scan
+    (native.detect_window_native) when available, the numpy twin
+    otherwise; both produce identical partitions (parity-tested).
+
+    ``remap``/``thresh`` may be passed precomputed (callers looping over
+    many windows cache them); otherwise they are derived here.
+    """
+    from ..detect import detect_numpy
+    from ..detect.detector import _thresholds
+    from ..native import NativeUnavailable, native_available
+
+    if native_available():
+        from ..native import detect_window_native
+
+        if remap is None:
+            remap = np.ascontiguousarray(
+                slo_vocab.encode(table.svc_op_names), dtype=np.int32
+            )
+        if thresh is None:
+            thresh = _thresholds(baseline, detector_cfg)
+        try:
+            mask, nrm, abn, n_window, _ = detect_window_native(
+                table, w0_us, w1_us, remap, thresh, detector_cfg.slack_ms
+            )
+            return mask, nrm, abn, n_window
+        except NativeUnavailable:
+            pass  # fall through to numpy
+    mask = window_rows(table, w0_us, w1_us)
+    n_window = int(mask.sum())
+    if n_window == 0:
+        return mask, None, None, 0
+    batch, trace_codes = detect_batch_from_table(
+        table, mask, slo_vocab, pad_policy, min_pad
+    )
+    det = detect_numpy(batch, baseline, detector_cfg)
+    t = len(trace_codes)
+    abn = trace_codes[det.abnormal[:t]]
+    nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
+    return mask, nrm, abn, n_window
+
+
 def _graph_from_padded(p):
     """Wrap one native PaddedPartition (already padded) as PartitionGraph.
 

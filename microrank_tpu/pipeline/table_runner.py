@@ -179,58 +179,38 @@ class TableRCA:
         )
 
     def _detect_window(self, table, w0: int, w1: int):
-        """One window's detection: (mask, nrm_codes, abn_codes,
-        n_window_spans) — the fused C++ scan (native.detect_window_native,
-        one pass over the table) when the native library is available,
-        the numpy twin otherwise. Both produce identical partitions
-        (parity-tested)."""
-        from ..native import NativeUnavailable, native_available
+        """One window's detection via the shared seam
+        (graph.table_ops.detect_window_partition — fused C++ scan with a
+        numpy fallback), with the SLO remap cached per run.
+        """
+        from ..graph.table_ops import detect_window_partition
 
         cfg = self.config
-        if native_available():
-            from ..native import detect_window_native
-
-            # Keyed by id() — valid because run() clears the cache on
-            # exit, and the table is alive for the whole run (id reuse
-            # is impossible while the key's referent is alive). A strong
-            # table reference here would pin ~GB-scale columns on the
-            # TableRCA instance after run() returns.
-            if (
-                self._remap_cache is None
-                or self._remap_cache[0] != id(table)
-            ):
-                self._remap_cache = (
-                    id(table),
-                    np.ascontiguousarray(
-                        self.slo_vocab.encode(table.svc_op_names),
-                        dtype=np.int32,
-                    ),
-                )
-            try:
-                mask, nrm, abn, n_window, _ = detect_window_native(
-                    table,
-                    w0,
-                    w1,
-                    self._remap_cache[1],
-                    self._thresh,
-                    cfg.detector.slack_ms,
-                )
-                return mask, nrm, abn, n_window
-            except NativeUnavailable:
-                pass  # fall through to numpy
-        mask = window_rows(table, w0, w1)
-        n_window = int(mask.sum())
-        if n_window == 0:
-            return mask, None, None, 0
-        batch, trace_codes = detect_batch_from_table(
-            table, mask, self.slo_vocab,
-            cfg.runtime.pad_policy, cfg.runtime.min_pad,
+        # Keyed by id() — valid because run() clears the cache on exit,
+        # and the table is alive for the whole run (id reuse is
+        # impossible while the key's referent is alive). A strong table
+        # reference here would pin ~GB-scale columns on the TableRCA
+        # instance after run() returns.
+        if self._remap_cache is None or self._remap_cache[0] != id(table):
+            self._remap_cache = (
+                id(table),
+                np.ascontiguousarray(
+                    self.slo_vocab.encode(table.svc_op_names),
+                    dtype=np.int32,
+                ),
+            )
+        return detect_window_partition(
+            table,
+            w0,
+            w1,
+            self.slo_vocab,
+            self.baseline,
+            cfg.detector,
+            remap=self._remap_cache[1],
+            thresh=self._thresh,
+            pad_policy=cfg.runtime.pad_policy,
+            min_pad=cfg.runtime.min_pad,
         )
-        det = detect_numpy(batch, self.baseline, cfg.detector)
-        t = len(trace_codes)
-        abn = trace_codes[det.abnormal[:t]]
-        nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
-        return mask, nrm, abn, n_window
 
     def prepare_rank(self, table, mask, nrm_codes, abn_codes):
         """Host half of a window rank: build the graph (pure host compute,
