@@ -140,3 +140,36 @@ def test_pad_to_pow2q_contract():
     # min_pad floor respected even where quarter steps would undershoot.
     assert pad_to(5, "pow2q", min_pad=128) == 128
     assert pad_to(200, "pow2q", min_pad=256) == 256
+
+
+def test_resolve_aux_modes():
+    from microrank_tpu.graph.build import (
+        packed_bits_bytes,
+        resolve_aux,
+    )
+
+    v, t_pads = 1024, (2048, 256)
+    bits = packed_bits_bytes(v, t_pads)
+    big = bits * 4 + 1  # budget whose quarter fits the bitmaps
+    small = bits * 4 - 1  # quarter just misses
+    # Single-device auto: packed inside the bitmap budget, csr past it.
+    assert resolve_aux("auto", v, t_pads, big) == "packed"
+    assert resolve_aux("auto", v, t_pads, small) == "csr"
+    # Sharded auto_all: BOTH families inside the budget (so the
+    # per-shard kernel choice can fall back to csr), csr past it.
+    assert resolve_aux("auto_all", v, t_pads, big) == "all"
+    assert resolve_aux("auto_all", v, t_pads, small) == "csr"
+    # Explicit modes pass through.
+    for mode in ("packed", "csr", "all", "none"):
+        assert resolve_aux(mode, v, t_pads, small) == mode
+
+
+def test_aux_for_kernel_sharded_promotion():
+    from microrank_tpu.graph.build import aux_for_kernel
+
+    assert aux_for_kernel("auto") == "auto"
+    assert aux_for_kernel("auto", sharded=True) == "auto_all"
+    # Non-auto kernels are unaffected by the sharded hint.
+    assert aux_for_kernel("packed", sharded=True) == "packed"
+    assert aux_for_kernel("csr", sharded=True) == "csr"
+    assert aux_for_kernel("dense", sharded=True) == "none"
