@@ -125,6 +125,9 @@ def _config_from_args(args) -> "MicroRankConfig":
                     ),
                     "pipeline_depth": getattr(args, "pipeline_depth", None),
                     "fetch_mode": getattr(args, "fetch_mode", None),
+                    "bulk_fetch_windows": getattr(
+                        args, "bulk_fetch_windows", None
+                    ),
                 }.items()
                 if v is not None
             },
@@ -179,6 +182,14 @@ def cmd_run(args) -> int:
             )
 
     cfg = _config_from_args(args)
+    if (
+        getattr(args, "bulk_fetch_windows", None) is not None
+        and cfg.runtime.fetch_mode != "bulk"
+    ):
+        log.warning(
+            "--bulk-fetch-windows has no effect without "
+            "--fetch-mode bulk (streaming fetches are per-window)"
+        )
 
     engine = args.engine
     if engine == "auto":
@@ -488,9 +499,13 @@ def main(argv=None) -> int:
     p_run.add_argument(
         "--fetch-mode", choices=["stream", "bulk"], default=None,
         help="result fetches: per-window ('stream', lowest sink "
-        "latency) or batched over runtime.bulk_fetch_windows windows "
+        "latency) or batched over --bulk-fetch-windows windows "
         "('bulk', highest replay throughput on high-latency links; "
         "supersedes --pipeline-depth as the in-flight bound)",
+    )
+    p_run.add_argument(
+        "--bulk-fetch-windows", type=_positive_int, default=None,
+        help="windows joined per batched fetch in --fetch-mode bulk",
     )
     p_run.add_argument(
         "--distributed", action="store_true",
