@@ -22,7 +22,7 @@ from .config import (
     WindowConfig,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "MicroRankConfig",
