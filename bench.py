@@ -152,6 +152,24 @@ def _ensure_batch_data(spans_target, n_ops, fault_ms, n_batch):
     return case_dir, truth
 
 
+def _collapse_mode() -> str:
+    """Trace-kind collapse at graph build (BENCH_COLLAPSE=auto|on|off;
+    default auto — RuntimeConfig.collapse_kinds' default). Exactness is
+    re-checked every run: the full-window float64 oracle ranks an
+    UNCOLLAPSED build of the same window."""
+    mode = os.environ.get("BENCH_COLLAPSE", "auto")
+    if mode not in ("auto", "on", "off"):
+        log(f"unknown BENCH_COLLAPSE={mode!r}; using 'auto'")
+        return "auto"
+    return mode
+
+
+def _prefer_bf16() -> bool:
+    """auto-kernel bf16 preference (BENCH_BF16=0 restores f32 packed —
+    RuntimeConfig.prefer_bf16's default is on)."""
+    return os.environ.get("BENCH_BF16", "1") != "0"
+
+
 def _time_staging() -> bool:
     """Staging is part of the headline by default (the honest end-to-end
     number — VERDICT r3 #2/#3); BENCH_TIME_STAGING=0 excludes it to
@@ -460,7 +478,8 @@ def _run_batched(
             if not (len(nrm) and len(abn)):
                 continue
             g, _, _, _ = build_window_graph_from_table(
-                table, m, nrm, abn, aux=aux_for_kernel(kernel)
+                table, m, nrm, abn, aux=aux_for_kernel(kernel),
+                collapse=_collapse_mode(),
             )
             graphs.append(g)
             total += int(m.sum())
@@ -472,7 +491,10 @@ def _run_batched(
     stacked, op_names, spans_used, n_windows = build_all()
     from microrank_tpu.rank_backends.jax_tpu import choose_kernel as _choose
 
-    resolved = kernel if kernel != "auto" else _choose(stacked)
+    resolved = (
+        kernel if kernel != "auto"
+        else _choose(stacked, prefer_bf16=_prefer_bf16())
+    )
     log(f"batched mode: {n_windows}/{n_batch} sub-windows partitioned, "
         f"{spans_used} spans; kernel={resolved}")
 
@@ -725,13 +747,24 @@ def main() -> int:
 
     def build():
         return build_window_graph_from_table(
-            abnormal_table, mask, nrm, abn, aux=aux_for_kernel(kernel)
+            abnormal_table, mask, nrm, abn, aux=aux_for_kernel(kernel),
+            collapse=_collapse_mode(),
         )
 
     graph, op_names, _, _ = build()
     if kernel == "auto":
-        kernel = choose_kernel(graph)
-    log(f"pagerank kernel: {kernel}")
+        kernel = choose_kernel(graph, prefer_bf16=_prefer_bf16())
+    collapsed = int(graph.normal.n_cols) >= 0
+    log(
+        f"pagerank kernel: {kernel}"
+        + (
+            f"; kind-collapsed trace axes "
+            f"{int(graph.normal.n_traces)}->{int(graph.normal.n_cols)} / "
+            f"{int(graph.abnormal.n_traces)}->{int(graph.abnormal.n_cols)}"
+            if collapsed
+            else ""
+        )
+    )
 
     # Host->device staging happens once per window in a real pipeline
     # (and overlaps the next window's host build there — jax dispatch is
@@ -835,6 +868,7 @@ def main() -> int:
                 g2, _, _, _ = build_window_graph_from_table(
                     abnormal_table, mask, nrm, abn,
                     aux=aux_for_kernel(other),
+                    collapse=_collapse_mode(),
                 )
                 h2, _, _ = _stage_once(g2, other)
 
@@ -900,9 +934,18 @@ def main() -> int:
             rank_window_sparse,
         )
 
+        # The oracle ranks an UNCOLLAPSED build of the same window — that
+        # makes this check validate the kind-collapse end to end (device
+        # on collapsed vs float64 per-trace semantics), not just the
+        # kernel. aux="none": the oracle reads only the COO entries.
+        oracle_graph = graph
+        if collapsed:
+            oracle_graph, _, _, _ = build_window_graph_from_table(
+                abnormal_table, mask, nrm, abn, aux="none", collapse="off"
+            )
         t0 = time.perf_counter()
         top_full_o, sc_full_o = rank_window_sparse(
-            graph, op_names, cfg.pagerank, cfg.spectrum
+            oracle_graph, op_names, cfg.pagerank, cfg.spectrum
         )
         full_oracle_s = time.perf_counter() - t0
         nv = int(n_valid)

@@ -158,6 +158,22 @@ class RuntimeConfig:
     # Budget for the packed kernel's unpacked f32 matrices, summed over
     # both partitions (graph.build.resolve_aux applies it at build time).
     dense_budget_bytes: int = 2 << 30
+    # Kind-collapse the trace axis at graph build
+    # (graph.build.collapse_window_graph): identical p_sr columns — the
+    # reference's own trace-kind equivalence (pagerank.py:54-66) — merge
+    # into one column carrying its multiplicity, shrinking staged bytes,
+    # HBM traffic and matvec width by T/kinds with exact ranking
+    # semantics (full-window float64-oracle parity is checked by the
+    # bench against an uncollapsed build every run). "auto" (default)
+    # collapses only when the axis actually shrinks; "on" always; "off"
+    # never (the pre-round-5 layout).
+    collapse_kinds: str = "auto"   # "auto" | "on" | "off"
+    # kernel="auto" resolves the in-budget bitmap path to "packed_bf16"
+    # (bf16 operands, f32 accumulation — measured 1.55x faster per
+    # iteration than f32 "packed" with rank parity tested) instead of
+    # f32 "packed". Scores move within bf16 rounding; set False for
+    # bit-level f32 score reproduction.
+    prefer_bf16: bool = True
     # Validate fetched ranking scores for NaN/inf (nearly free: results are
     # already on host when checked).
     validate_numerics: bool = True

@@ -64,15 +64,15 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 _DENSE_KIND_BUDGET = 1_000_000
 
 
-def _trace_kinds(
+def _trace_kind_groups(
     u_trace: np.ndarray,
     u_op: np.ndarray,
     tracelen: np.ndarray,
     n_traces: int,
-) -> np.ndarray:
-    """Kind-size per trace from sorted unique (trace, op) pairs — fully
-    vectorized (no per-trace Python loop), replacing the reference's
-    O(T^2·O) all-pairs column comparison (pagerank.py:54-66).
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Group traces into dedup kinds from sorted unique (trace, op) pairs
+    — fully vectorized (no per-trace Python loop), replacing the
+    reference's O(T^2·O) all-pairs column comparison (pagerank.py:54-66).
 
     Two traces are one kind iff they cover the same unique-op set AND have
     the same span count (that is exactly p_sr-column float equality).
@@ -82,10 +82,14 @@ def _trace_kinds(
     Small windows: exact np.unique over padded [T, max_ops+1] rows.
     Large windows: np.unique over (sum-of-splitmix64(op), two salts,
     n_unique, tracelen) — O(E) memory regardless of row length.
+
+    Returns (inverse[n_traces] group id per trace, counts[G] per group).
     """
-    kind = np.zeros(n_traces, dtype=np.int32)
     if len(u_trace) == 0:
-        return kind
+        return (
+            np.zeros(n_traces, dtype=np.int64),
+            np.array([n_traces] if n_traces else [], dtype=np.int64),
+        )
     n_unique = np.bincount(u_trace, minlength=n_traces).astype(np.int64)
     max_ops = int(n_unique.max())
     starts = np.concatenate(([0], np.cumsum(n_unique)[:-1]))
@@ -98,25 +102,53 @@ def _trace_kinds(
         _, inverse, counts = np.unique(
             mat, axis=0, return_inverse=True, return_counts=True
         )
-    else:
-        ops64 = u_op.astype(np.uint64)
-        h1 = _splitmix64(ops64)
-        h2 = _splitmix64(ops64 ^ np.uint64(0xD6E8FEB86659FD93))
-        with np.errstate(over="ignore"):
-            s1 = np.add.reduceat(h1, starts)
-            s2 = np.add.reduceat(h2, starts)
-        keys = np.stack(
-            [
-                s1,
-                s2,
-                n_unique.astype(np.uint64),
-                tracelen[:n_traces].astype(np.uint64),
-            ],
-            axis=1,
-        )
-        _, inverse, counts = np.unique(
-            keys, axis=0, return_inverse=True, return_counts=True
-        )
+        return inverse.reshape(-1).astype(np.int64), counts.astype(np.int64)
+
+    # Large windows: two 64-bit set-hash sums per trace. The per-entry
+    # hash is a GATHER from two splitmix64 tables over the op vocab (ops
+    # are small interned ints) — memory-bound instead of 3 multiply/xor
+    # rounds per entry, ~20x cheaper at the 1M-span scale; the summed
+    # keys are identical in strength to hashing each entry directly.
+    n_vocab = int(u_op.max()) + 1
+    base = np.arange(n_vocab, dtype=np.uint64)
+    tab1 = _splitmix64(base)
+    tab2 = _splitmix64(base ^ np.uint64(0xD6E8FEB86659FD93))
+    with np.errstate(over="ignore"):
+        s1 = np.add.reduceat(tab1[u_op], starts)
+        s2 = np.add.reduceat(tab2[u_op], starts)
+    # Group-by via one lexsort over the four key columns + boundary scan
+    # (np.unique(axis=0)'s void-view sort measures ~10x slower here).
+    tl = tracelen[:n_traces].astype(np.uint64, copy=False)
+    nu = n_unique.astype(np.uint64, copy=False)
+    order = np.lexsort((tl, nu, s2, s1))
+    ks1, ks2 = s1[order], s2[order]
+    knu, ktl = nu[order], tl[order]
+    new_group = np.empty(n_traces, dtype=bool)
+    new_group[0] = True
+    new_group[1:] = (
+        (ks1[1:] != ks1[:-1])
+        | (ks2[1:] != ks2[:-1])
+        | (knu[1:] != knu[:-1])
+        | (ktl[1:] != ktl[:-1])
+    )
+    group_sorted = np.cumsum(new_group) - 1
+    inverse = np.empty(n_traces, dtype=np.int64)
+    inverse[order] = group_sorted
+    counts = np.bincount(group_sorted)
+    return inverse, counts.astype(np.int64)
+
+
+def _trace_kinds(
+    u_trace: np.ndarray,
+    u_op: np.ndarray,
+    tracelen: np.ndarray,
+    n_traces: int,
+) -> np.ndarray:
+    """Kind-size per trace (C10): counts[group] scattered back per trace."""
+    kind = np.zeros(n_traces, dtype=np.int32)
+    if n_traces == 0 or len(u_trace) == 0:
+        return kind
+    inverse, counts = _trace_kind_groups(u_trace, u_op, tracelen, n_traces)
     kind[:] = counts[inverse]
     return kind
 
@@ -357,8 +389,14 @@ def _build_partition(
     pad_policy: str,
     min_pad: int,
     aux: str = "auto",
+    compute_kinds: bool = True,
 ) -> Tuple[PartitionGraph, np.ndarray]:
     """Build one partition's padded graph from pure int arrays.
+
+    ``compute_kinds=False`` skips the kind-size pass (kind stays 0) —
+    for collapse-bound builds, where collapse_window_graph regroups the
+    traces itself and rewrites ``kind`` either way (running both would
+    do the O(E) grouping twice per partition).
 
     Returns (graph, global_trace_ids) where ``global_trace_ids[i]`` is the
     window-global trace id of partition-local trace i.
@@ -394,7 +432,11 @@ def _build_partition(
         e_parent = np.zeros(0, dtype=np.int32)
         ss_val = np.zeros(0, dtype=np.float32)
 
-    kind = _trace_kinds(u_trace, u_op, tracelen, n_traces)
+    kind = (
+        _trace_kinds(u_trace, u_op, tracelen, n_traces)
+        if compute_kinds
+        else np.zeros(n_traces, dtype=np.int32)
+    )
 
     e_pad = pad_to(len(u_op), pad_policy, min_pad)
     c_pad = pad_to(len(e_child), pad_policy, min_pad)
@@ -458,6 +500,7 @@ def build_window_graph(
     min_pad: int = 8,
     aux: str = "auto",
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
+    collapse: str = "off",
 ) -> Tuple[WindowGraph, List[str], List, List]:
     """Build both partitions of a window over one shared op vocab.
 
@@ -465,6 +508,10 @@ def build_window_graph(
     vectorized ``[V]`` computation: ops absent from a partition have no
     incidence entries, stay at score 0 through the iteration, and are
     masked by ``op_present`` (SURVEY.md C14 plan).
+
+    ``collapse`` ("off" | "auto" | "on"): kind-collapse the trace axes
+    (collapse_window_graph) — the core build then skips the per-trace aux
+    views and the post-pass constructs them on the collapsed shapes.
 
     Returns (graph, op_names, normal_trace_ids, abnormal_trace_ids).
     """
@@ -509,7 +556,13 @@ def build_window_graph(
     t_pads = [
         pad_to(max(len(set(c)), 1), pad_policy, min_pad) for c in code_lists
     ]
-    mode = resolve_aux(aux, v_pad, t_pads, dense_budget_bytes)
+    # Collapsing: the aux views are built by the post-pass on the
+    # collapsed shapes — skip them in the core build.
+    mode = (
+        "none"
+        if collapse != "off"
+        else resolve_aux(aux, v_pad, t_pads, dense_budget_bytes)
+    )
 
     parts = []
     id_lists = []
@@ -532,12 +585,218 @@ def build_window_graph(
             pad_policy,
             min_pad,
             mode,
+            compute_kinds=(collapse == "off"),
         )
         parts.append(part)
         id_lists.append([tr_uniques[c] for c in local_codes])
 
     graph = WindowGraph(normal=parts[0], abnormal=parts[1])
+    if collapse != "off":
+        graph = collapse_window_graph(
+            graph, aux, pad_policy, min_pad, dense_budget_bytes, collapse
+        )
     return graph, list(op_uniques), id_lists[0], id_lists[1]
+
+
+def _collapse_partition(
+    part: PartitionGraph,
+    mode: str,
+    pad_policy: str,
+    min_pad: int,
+    groups: Tuple[np.ndarray, np.ndarray] | None = None,
+) -> PartitionGraph:
+    """Collapse one partition's trace axis to its distinct kind columns.
+
+    Identical p_sr columns (same unique-op set AND same span count — the
+    reference's kind definition, pagerank.py:54-66) are merged into one
+    column whose multiplicity m folds into the forward values
+    (sr_val = m/len, and inv_tracelen scattered from it): a dense matvec
+    over duplicate columns sums m identical terms, which is exactly one
+    term scaled by m. The backward direction and the preference vector
+    assign equal values to equal columns, so keeping one is exact (the
+    device adjusts its two preference normalization sums by the
+    multiplicity — jax_tpu.preference_vector). Per-op statistics
+    (cov_unique, rs_val, call edges, n_traces) keep their TRUE
+    full-trace values: the spectrum and the iteration's initial value
+    are collapse-invariant by construction.
+
+    ``mode`` is the RESOLVED aux mode for the collapsed shapes.
+    """
+    n_inc = int(part.n_inc)
+    n_traces = int(part.n_traces)
+    u_op = np.asarray(part.inc_op[:n_inc])
+    u_trace = np.asarray(part.inc_trace[:n_inc])
+    tracelen = np.asarray(part.tracelen[:n_traces]).astype(np.int64)
+    inverse, counts = groups if groups is not None else _trace_kind_groups(
+        u_trace, u_op, tracelen, n_traces
+    )
+    n_kinds = len(counts)
+    # Representative = the lowest-id trace of each group; groups are then
+    # renumbered in representative order so the selected entries stay
+    # sorted by (column, op) — the storage invariant csr_auxiliary needs.
+    first_idx = np.full(n_kinds, n_traces, dtype=np.int64)
+    np.minimum.at(first_idx, inverse, np.arange(n_traces, dtype=np.int64))
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(n_kinds, dtype=np.int64)
+    rank[order] = np.arange(n_kinds, dtype=np.int64)
+    is_rep = np.zeros(n_traces, dtype=bool)
+    is_rep[first_idx] = True
+
+    sel = is_rep[u_trace]
+    c_op = u_op[sel]
+    c_col = rank[inverse[u_trace[sel]]].astype(np.int32)
+    mult = counts[order]                       # [G] multiplicity per column
+    c_len = tracelen[first_idx[order]]         # [G] span count per column
+    # Forward values fold the multiplicity: p_sr's column appears once but
+    # stands for m traces (m/len in one f64 division, cast once).
+    sr_val = (mult[c_col] / c_len[c_col]).astype(np.float32)
+    rs_val = np.asarray(part.rs_val[:n_inc])[sel]  # per-op value: unchanged
+
+    e_pad = pad_to(len(c_op), pad_policy, min_pad)
+    t_pad = pad_to(n_kinds, pad_policy, min_pad)
+    v_pad = int(part.cov_unique.shape[0])
+    n_ss = int(part.n_ss)
+
+    p_inc_op = pad1d(c_op.astype(np.int32), e_pad)
+    p_inc_trace = pad1d(c_col, e_pad)
+    p_sr_val = pad1d(sr_val, e_pad)
+    p_rs_val = pad1d(rs_val, e_pad)
+    (
+        tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
+        cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+    ) = build_aux_views(
+        p_inc_op, p_inc_trace, p_sr_val, p_rs_val,
+        part.ss_child, part.ss_parent, part.ss_val,
+        len(c_op), n_ss, v_pad, t_pad, mode,
+    )
+    return part._replace(
+        inc_op=p_inc_op,
+        inc_trace=p_inc_trace,
+        sr_val=p_sr_val,
+        rs_val=p_rs_val,
+        inc_trace_opmajor=tr_om,
+        sr_val_opmajor=sr_om,
+        inc_indptr_op=indptr_op,
+        inc_indptr_trace=indptr_trace,
+        ss_indptr=ss_indptr,
+        cov_bits=cov_bits,
+        ss_bits=ss_bits,
+        inv_tracelen=inv_len,
+        inv_cov_dup=inv_cov,
+        inv_outdeg=inv_out,
+        kind=pad1d(mult.astype(np.int32), t_pad, fill=1),
+        tracelen=pad1d(c_len.astype(np.int32), t_pad, fill=1),
+        n_inc=np.int32(len(c_op)),
+        n_cols=np.int32(n_kinds),
+    )
+
+
+def _rebuild_aux(part: PartitionGraph, mode: str) -> PartitionGraph:
+    """Construct the aux views a core ``aux="none"`` build skipped, on the
+    partition's existing (uncollapsed) arrays — the no-collapse exit of
+    collapse_window_graph."""
+    v_pad = int(part.cov_unique.shape[0])
+    t_pad = int(part.kind.shape[0])
+    (
+        tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
+        cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+    ) = build_aux_views(
+        part.inc_op, part.inc_trace, part.sr_val, part.rs_val,
+        part.ss_child, part.ss_parent, part.ss_val,
+        int(part.n_inc), int(part.n_ss), v_pad, t_pad, mode,
+    )
+    return part._replace(
+        inc_trace_opmajor=tr_om,
+        sr_val_opmajor=sr_om,
+        inc_indptr_op=indptr_op,
+        inc_indptr_trace=indptr_trace,
+        ss_indptr=ss_indptr,
+        cov_bits=cov_bits,
+        ss_bits=ss_bits,
+        inv_tracelen=inv_len,
+        inv_cov_dup=inv_cov,
+        inv_outdeg=inv_out,
+    )
+
+
+def collapse_window_graph(
+    graph: WindowGraph,
+    aux: str = "auto",
+    pad_policy: str = "pow2q",
+    min_pad: int = 8,
+    dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
+    collapse: str = "auto",
+) -> WindowGraph:
+    """Kind-collapse both partitions' trace axes and (re)build aux views.
+
+    The exact trace-axis compression the reference's own kind-dedup
+    implies (pagerank.py:54-66): real systems exhibit few distinct trace
+    shapes, so the [V, T] coverage pattern usually holds T' << T distinct
+    columns — collapsing shrinks staged bytes, per-iteration HBM traffic
+    and matvec width by T/T' with bit-identical ranking semantics (the
+    parity suite and the bench's full-window float64 oracle check run
+    device-on-collapsed against oracle-on-uncollapsed).
+
+    The caller should run the CORE build with ``aux="none"`` (skip the
+    big per-trace bitmaps) and pass the REQUESTED aux here; this resolves
+    it against the collapsed shapes. ``collapse="auto"`` collapses only
+    when it shrinks the trace axis (when it doesn't, the aux views are
+    built on the original arrays instead — same result as a direct
+    build); ``"on"`` always collapses.
+    """
+    if collapse not in ("auto", "on"):
+        raise ValueError(f"unknown collapse mode {collapse!r}")
+    parts = (graph.normal, graph.abnormal)
+    groups = []
+    for p in parts:
+        n_inc = int(p.n_inc)
+        n_tr = int(p.n_traces)
+        groups.append(
+            _trace_kind_groups(
+                np.asarray(p.inc_trace[:n_inc]),
+                np.asarray(p.inc_op[:n_inc]),
+                np.asarray(p.tracelen[:n_tr]).astype(np.int64),
+                n_tr,
+            )
+        )
+    total_g = sum(len(counts) for _, counts in groups)
+    total_t = sum(int(p.n_traces) for p in parts)
+    if collapse == "auto" and total_g >= total_t:
+        t_pads = tuple(int(p.kind.shape[0]) for p in parts)
+        mode = resolve_aux(
+            aux, int(parts[0].cov_unique.shape[0]), t_pads,
+            dense_budget_bytes,
+        )
+        # Rewrite kind from the grouping just computed — collapse-bound
+        # core builds skip their own kind pass (compute_kinds=False).
+        declined = []
+        for p, (inverse, counts) in zip(parts, groups):
+            kind = (
+                counts[inverse].astype(np.int32)
+                if len(inverse)
+                else np.zeros(0, np.int32)
+            )
+            declined.append(
+                _rebuild_aux(
+                    p._replace(
+                        kind=pad1d(kind, int(p.kind.shape[0]), fill=1)
+                    ),
+                    mode,
+                )
+            )
+        return WindowGraph(normal=declined[0], abnormal=declined[1])
+    t_pads = tuple(
+        pad_to(max(len(counts), 1), pad_policy, min_pad)
+        for _, counts in groups
+    )
+    mode = resolve_aux(
+        aux, int(parts[0].cov_unique.shape[0]), t_pads, dense_budget_bytes
+    )
+    new_parts = [
+        _collapse_partition(p, mode, pad_policy, min_pad, grp)
+        for p, grp in zip(parts, groups)
+    ]
+    return WindowGraph(normal=new_parts[0], abnormal=new_parts[1])
 
 
 def build_detect_batch(

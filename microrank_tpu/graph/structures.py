@@ -75,6 +75,15 @@ class PartitionGraph(NamedTuple):
     n_traces: np.ndarray    # traces in this partition      (reference T)
     n_inc: np.ndarray       # actual incidence entries
     n_ss: np.ndarray        # actual call edges
+    # Kind-collapsed trace axis (graph.build.collapse_window_graph): -1
+    # means the trace axis is per-trace (one column per trace, the
+    # uncollapsed layout); >= 0 means identical p_sr columns were merged
+    # (the reference's own kind-dedup insight, pagerank.py:54-66) and the
+    # axis holds ``n_cols`` distinct kind columns. ``kind`` then carries
+    # each column's multiplicity, ``sr_val``/``inv_tracelen`` fold it in
+    # (m/len), and ``n_traces`` still counts TRUE traces (the spectrum
+    # and the iteration's initial value need the real count).
+    n_cols: np.ndarray = np.int32(-1)
 
 
 class WindowGraph(NamedTuple):

@@ -213,6 +213,7 @@ def _graph_from_padded(p):
         n_traces=np.int32(p.n_traces),
         n_inc=np.int32(p.n_inc),
         n_ss=np.int32(p.n_ss),
+        n_cols=np.int32(p.n_cols),
     )
 
 
@@ -226,6 +227,7 @@ def build_window_graph_from_table(
     use_native: bool = True,
     aux: str = "auto",
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
+    collapse: str = "off",
 ) -> Tuple[WindowGraph, List[str], np.ndarray, np.ndarray]:
     """Both partitions' graphs from table rows — ints end to end.
 
@@ -234,8 +236,16 @@ def build_window_graph_from_table(
     library is available (and ``use_native``), both partitions build in
     C++ via fused single-scan counting sorts (graph_builder.cpp); the
     numpy fallback below is array-identical.
+
+    ``collapse`` ("off" | "auto" | "on"): kind-collapse the trace axes
+    (graph.build.collapse_window_graph) — the core build then skips the
+    per-trace aux views and the post-pass constructs them on the
+    collapsed shapes.
+
     Returns (graph, op_names, normal_codes, abnormal_codes).
     """
+    from .build import collapse_window_graph
+
     vocab_size = len(table.pod_op_names)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
     if mask is None:
@@ -245,12 +255,31 @@ def build_window_graph_from_table(
     abnormal_trace_codes = list(abnormal_trace_codes)
     # Window-level aux resolution (one decision for both partitions; every
     # partition code comes from detection over these same rows, so the
-    # local trace count equals the code count).
+    # local trace count equals the code count). Collapsing: the aux views
+    # are built by the post-pass on the collapsed shapes instead.
     t_pads = [
         pad_to(max(len(set(c)), 1), pad_policy, min_pad)
         for c in (normal_trace_codes, abnormal_trace_codes)
     ]
-    mode = resolve_aux(aux, v_pad, t_pads, dense_budget_bytes)
+    if collapse != "off":
+        # The native lane collapses in C++ (mr_collapse_window) and
+        # resolves aux against the collapsed shapes there; the numpy
+        # fallback runs the core build with aux="none" and the python
+        # post-pass below.
+        mode = "none"
+        native_mode = aux
+    else:
+        mode = native_mode = resolve_aux(
+            aux, v_pad, t_pads, dense_budget_bytes
+        )
+
+    def _finish(graph):
+        if collapse != "off":
+            return collapse_window_graph(
+                graph, aux, pad_policy, min_pad, dense_budget_bytes,
+                collapse,
+            )
+        return graph
 
     if use_native:
         from ..native import (
@@ -281,7 +310,9 @@ def build_window_graph_from_table(
                     vocab_size,
                     v_pad,
                     lambda n: pad_to(n, pad_policy, min_pad),
-                    mode,
+                    native_mode,
+                    collapse=collapse,
+                    dense_budget_bytes=dense_budget_bytes,
                 )
             except NativeUnavailable:
                 raw_n = raw_a = None  # fall through to the numpy lane
@@ -290,6 +321,7 @@ def build_window_graph_from_table(
                     normal=_graph_from_padded(raw_n),
                     abnormal=_graph_from_padded(raw_a),
                 )
+                # Collapse (when requested) already happened in C++.
                 return (
                     graph,
                     list(table.pod_op_names),
@@ -334,9 +366,15 @@ def build_window_graph_from_table(
             pad_policy,
             min_pad,
             mode,
+            compute_kinds=(collapse == "off"),
         )
         parts.append(part)
         code_arrays.append(local)
 
     graph = WindowGraph(normal=parts[0], abnormal=parts[1])
-    return graph, list(table.pod_op_names), code_arrays[0], code_arrays[1]
+    return (
+        _finish(graph),
+        list(table.pod_op_names),
+        code_arrays[0],
+        code_arrays[1],
+    )

@@ -155,6 +155,10 @@ def _load_library() -> ctypes.CDLL:
         i32p, f32p,                      # tr_om, sr_om
         i32p, i32p, i32p,                # indptr_op, indptr_trace, ss_indptr
     ]
+    lib.mr_collapse_window.restype = ctypes.c_int32
+    lib.mr_collapse_window.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p
+    ]
     lib.mr_free_built.restype = None
     lib.mr_free_built.argtypes = [ctypes.c_void_p]
     lib.mr_detect_window.restype = ctypes.c_int
@@ -386,6 +390,11 @@ class PaddedPartition(NamedTuple):
     n_traces: int
     n_inc: int
     n_ss: int
+    # Kind-collapsed trace axis (mr_collapse_window): -1 = per-trace
+    # layout; >= 0 = the axis holds this many kind columns while
+    # n_traces still counts TRUE traces (graph.structures.PartitionGraph
+    # n_cols semantics).
+    n_cols: int = -1
 
 
 def build_window_padded(
@@ -399,6 +408,8 @@ def build_window_padded(
     v_pad: int,
     pad,
     mode: str = "none",
+    collapse: str = "off",
+    dense_budget_bytes: Optional[int] = None,
 ) -> Tuple[PaddedPartition, PaddedPartition]:
     """Build both partitions' COO graphs in C++ (fused single scans),
     exported directly into padded numpy buffers (single copy).
@@ -407,12 +418,24 @@ def build_window_padded(
     global trace codes; ``row_mask`` (bool over rows, or None for all)
     is the detection window (get_span semantics applied upstream);
     ``pad`` maps a true length to its padded length (>= the true length).
-    ``mode`` is a RESOLVED aux mode (graph.build.resolve_aux): which
-    kernel views ("packed" bitmaps / "csr" orderings / "all" / "none") the
-    C++ side additionally exports.
+    ``mode`` is an aux mode: RESOLVED ("packed" | "csr" | "all" | "none")
+    — which kernel views the C++ side additionally exports — or, with
+    ``collapse`` enabled, the unresolved "auto"/"auto_all" request, which
+    is resolved here AGAINST THE COLLAPSED trace shapes (the collapse
+    happens in C++ before the views are exported, so the per-trace
+    bitmaps are never built).
+
+    ``collapse`` ("off" | "auto" | "on"): kind-collapse the trace axes in
+    C++ (mr_collapse_window — the native twin of
+    graph.build.collapse_window_graph, array-identical outputs).
     """
-    if mode not in ("packed", "csr", "all", "none"):
-        raise ValueError(f"unresolved aux mode {mode!r}")
+    if mode not in ("packed", "csr", "all", "none", "auto", "auto_all"):
+        raise ValueError(f"unknown aux mode {mode!r}")
+    if mode in ("auto", "auto_all") and collapse == "off":
+        raise ValueError(
+            "aux mode 'auto'/'auto_all' is resolved here only under "
+            "collapse; resolve_aux it at the call site otherwise"
+        )
     lib = _load_library()
     pod_op = np.ascontiguousarray(pod_op, dtype=np.int32)
     trace_id = np.ascontiguousarray(trace_id, dtype=np.int32)
@@ -442,13 +465,39 @@ def build_window_padded(
     if not handle:
         raise NativeUnavailable("mr_build_window2 allocation failed")
     try:
+        true_traces = None
+        if collapse != "off":
+            true_out = np.zeros(2, dtype=np.int64)
+            rc = int(
+                lib.mr_collapse_window(
+                    handle,
+                    ctypes.c_int32(1 if collapse == "auto" else 0),
+                    true_out.ctypes.data_as(i64p),
+                )
+            )
+            if rc < 0:
+                raise NativeUnavailable(
+                    "mr_collapse_window allocation failed"
+                )
+            if rc == 1:
+                true_traces = (int(true_out[0]), int(true_out[1]))
         sizes = np.zeros(8, dtype=np.int64)
         lib.mr_window_sizes(handle, sizes.ctypes.data_as(i64p))
+        if mode in ("auto", "auto_all"):
+            from ..graph.build import resolve_aux
+
+            t_pads = (pad(int(sizes[2])), pad(int(sizes[6])))
+            mode = resolve_aux(
+                mode, v_pad, t_pads,
+                *(() if dense_budget_bytes is None
+                  else (dense_budget_bytes,)),
+            )
         out = []
         want_bits = mode in ("packed", "all")
         want_csr = mode in ("csr", "all")
         for idx in range(2):
             n_inc, n_ss, n_tr, n_ops = (int(x) for x in sizes[4 * idx: 4 * idx + 4])
+            true_tr = true_traces[idx] if true_traces is not None else n_tr
             e_pad, c_pad, t_pad = pad(n_inc), pad(n_ss), pad(n_tr)
             t8 = (t_pad + 7) // 8
             v8 = (v_pad + 7) // 8
@@ -462,7 +511,9 @@ def build_window_padded(
                 ss_val=np.zeros(c_pad, np.float32),
                 kind=np.ones(t_pad, np.int32),
                 tracelen=np.ones(t_pad, np.int32),
-                local_uniques=np.zeros(n_tr, np.int32),
+                # The true trace list survives the collapse (codes are
+                # the caller's partition contract, not column labels).
+                local_uniques=np.zeros(true_tr, np.int32),
                 cov_unique=np.zeros(v_pad, np.int32),
                 op_present=np.zeros(v_pad, np.bool_),
                 inc_trace_opmajor=np.zeros(e_pad if want_csr else 0, np.int32),
@@ -478,9 +529,10 @@ def build_window_padded(
                 inv_cov_dup=np.zeros(v_pad, np.float32),
                 inv_outdeg=np.zeros(v_pad, np.float32),
                 n_ops=n_ops,
-                n_traces=n_tr,
+                n_traces=true_tr,
                 n_inc=n_inc,
                 n_ss=n_ss,
+                n_cols=(n_tr if true_traces is not None else -1),
             )
             lib.mr_export_partition(
                 handle, ctypes.c_int32(idx),

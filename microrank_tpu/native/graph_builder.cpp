@@ -81,6 +81,15 @@ struct BuiltPartition {
   std::vector<int32_t> cov_unique;
   std::vector<uint8_t> op_present;
   int64_t n_ops = 0;
+  // Kind grouping (finish_partition's kinds phase, kept for
+  // mr_collapse_window): group id per trace, size per group. Group ids
+  // are assigned in first-encounter order over ascending trace ids, so
+  // they double as the collapsed column order.
+  std::vector<int32_t> group_of;
+  int64_t n_groups = 0;
+  // After mr_collapse_window: the TRUE trace count (kind/tracelen then
+  // hold one entry per kind column). -1 = not collapsed.
+  int64_t n_traces_true = -1;
 };
 
 }  // namespace
@@ -349,11 +358,13 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
 
   // Trace kinds: two traces are one kind iff identical unique-op sequence
   // AND identical span count (== p_sr-column equality, pagerank.py:54-66).
-  // Hash prefilter + exact compare on collision — always exact.
+  // Hash prefilter + exact compare on collision — always exact. The
+  // grouping is kept on the partition for mr_collapse_window.
   out->kind.assign(n_traces, 0);
   {
     std::unordered_map<uint64_t, std::vector<int32_t>> groups;  // hash -> reps
-    std::vector<int32_t> group_of(n_traces, -1);
+    auto& group_of = out->group_of;
+    group_of.assign(n_traces, -1);
     std::vector<int32_t> group_count;
     groups.reserve(static_cast<size_t>(n_traces) * 2);
     for (int64_t t = 0; t < n_traces; ++t) {
@@ -379,7 +390,73 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
     }
     for (int64_t t = 0; t < n_traces; ++t)
       out->kind[t] = group_count[group_of[t]];
+    out->n_groups = static_cast<int64_t>(group_count.size());
   }
+}
+
+// Collapse one partition's trace axis to its distinct kind columns, in
+// place (the C++ twin of graph/build.py:_collapse_partition — see there
+// for the exactness argument). Representative = the first trace of each
+// group; group ids are already in first-encounter (= representative
+// ascending) order, so the collapsed incidence stays sorted by
+// (column, op). Forward values fold the multiplicity (m/len, computed in
+// double and cast once, matching the numpy lane bit for bit); rs_val,
+// call edges and the per-op statistics keep their TRUE full-trace
+// values. kind[g] becomes the multiplicity, tracelen[g] the
+// representative's span count; local_uniques (the true trace list) is
+// untouched.
+void collapse_partition(BuiltPartition* p) {
+  const int64_t n_traces = static_cast<int64_t>(p->kind.size());
+  if (p->n_traces_true >= 0) return;  // already collapsed
+  if (n_traces == 0) {
+    p->n_traces_true = 0;
+    return;
+  }
+  const int64_t n_groups = p->n_groups;
+  std::vector<int32_t> rep(n_groups, -1);
+  std::vector<int32_t> count(n_groups, 0);
+  for (int64_t t = 0; t < n_traces; ++t) {
+    const int32_t g = p->group_of[t];
+    if (rep[g] < 0) rep[g] = static_cast<int32_t>(t);
+    ++count[g];
+  }
+  // Per-trace entry offsets (entries are trace-major).
+  std::vector<int64_t> off(n_traces + 1, 0);
+  for (int64_t i = 0; i < static_cast<int64_t>(p->inc_op.size()); ++i)
+    ++off[p->inc_trace[i] + 1];
+  for (int64_t t = 0; t < n_traces; ++t) off[t + 1] += off[t];
+
+  std::vector<int32_t> new_op, new_trace;
+  std::vector<float> new_sr, new_rs;
+  int64_t n_new = 0;
+  for (int64_t g = 0; g < n_groups; ++g)
+    n_new += off[rep[g] + 1] - off[rep[g]];
+  new_op.reserve(n_new);
+  new_trace.reserve(n_new);
+  new_sr.reserve(n_new);
+  new_rs.reserve(n_new);
+  std::vector<int32_t> new_kind(n_groups), new_len(n_groups);
+  for (int64_t g = 0; g < n_groups; ++g) {
+    const int32_t r = rep[g];
+    const float sr = static_cast<float>(
+        static_cast<double>(count[g]) /
+        static_cast<double>(p->tracelen[r]));
+    for (int64_t i = off[r]; i < off[r + 1]; ++i) {
+      new_op.push_back(p->inc_op[i]);
+      new_trace.push_back(static_cast<int32_t>(g));
+      new_sr.push_back(sr);
+      new_rs.push_back(p->rs_val[i]);
+    }
+    new_kind[g] = count[g];
+    new_len[g] = p->tracelen[r];
+  }
+  p->inc_op.swap(new_op);
+  p->inc_trace.swap(new_trace);
+  p->sr_val.swap(new_sr);
+  p->rs_val.swap(new_rs);
+  p->kind.swap(new_kind);
+  p->tracelen.swap(new_len);
+  p->n_traces_true = n_traces;
 }
 
 }  // namespace
@@ -551,7 +628,38 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
   return g;
 }
 
+// Kind-collapse both partitions' trace axes in place (see
+// collapse_partition above). ``auto_mode`` != 0 collapses only when the
+// combined axis actually shrinks (the graph/build.py collapse="auto"
+// rule); 0 always collapses. Returns 1 when collapsed (out_true[i] then
+// holds partition i's TRUE trace count while mr_window_sizes reports the
+// kind-column count), 0 when left per-trace. Call before the export
+// functions; idempotent.
+int32_t mr_collapse_window(MrBuiltWindow* g, int32_t auto_mode,
+                           int64_t* out_true) {
+  if (g->parts[0].n_traces_true >= 0) {  // already collapsed
+    out_true[0] = g->parts[0].n_traces_true;
+    out_true[1] = g->parts[1].n_traces_true;
+    return 1;
+  }
+  const int64_t t_total = static_cast<int64_t>(g->parts[0].kind.size()) +
+                          static_cast<int64_t>(g->parts[1].kind.size());
+  const int64_t g_total = g->parts[0].n_groups + g->parts[1].n_groups;
+  if (auto_mode && g_total >= t_total) return 0;
+  try {
+    for (int i = 0; i < 2; ++i) {
+      collapse_partition(&g->parts[i]);
+      out_true[i] = g->parts[i].n_traces_true;
+    }
+  } catch (...) {
+    return -1;  // allocation failure — caller falls back to numpy
+  }
+  return 1;
+}
+
 // sizes[8]: per partition (normal, abnormal): n_inc, n_ss, n_traces, n_ops.
+// After mr_collapse_window, "n_traces" is the kind-COLUMN count (the
+// padded trace-axis extent); the true counts come from that call.
 void mr_window_sizes(const MrBuiltWindow* g, int64_t* sizes) {
   for (int i = 0; i < 2; ++i) {
     const BuiltPartition& p = g->parts[i];
@@ -605,10 +713,11 @@ void mr_export_bitmaps(const MrBuiltWindow* g, int32_t idx, uint8_t* cov_bits,
     cov_bits[static_cast<int64_t>(v) * t8 + (t >> 3)] |=
         static_cast<uint8_t>(128u >> (t & 7));
     inv_cov[v] = p.rs_val[i];
+    // Scattered from the entry values (not recomputed as 1/len) so the
+    // kind-collapsed layout's folded m/len forward weights carry over
+    // exactly — identical to graph/build.py:packed_aux either way.
+    inv_len[t] = p.sr_val[i];
   }
-  const int64_t n_tr = static_cast<int64_t>(p.tracelen.size());
-  for (int64_t t = 0; t < n_tr; ++t)
-    inv_len[t] = 1.0f / static_cast<float>(p.tracelen[t]);
   const int64_t n_ss = static_cast<int64_t>(p.ss_child.size());
   for (int64_t i = 0; i < n_ss; ++i) {
     const int32_t c = p.ss_child[i], par = p.ss_parent[i];
@@ -629,10 +738,14 @@ void mr_export_csr(const MrBuiltWindow* g, int32_t idx, int64_t vocab,
                    int32_t* ss_indptr) {
   const BuiltPartition& p = g->parts[idx];
   const int64_t n_inc = static_cast<int64_t>(p.inc_op.size());
+  // Histogram the CURRENT incidence — cov_unique keeps the true
+  // per-trace coverage counts, which overcount the entries after a
+  // kind collapse (one entry per covering kind, not per trace).
+  std::vector<int32_t> op_count(vocab, 0);
+  for (int64_t i = 0; i < n_inc; ++i) ++op_count[p.inc_op[i]];
   indptr_op[0] = 0;
   for (int64_t o = 0; o < v_pad; ++o)
-    indptr_op[o + 1] =
-        indptr_op[o] + (o < vocab ? p.cov_unique[o] : 0);
+    indptr_op[o + 1] = indptr_op[o] + (o < vocab ? op_count[o] : 0);
   std::vector<int32_t> cur(indptr_op, indptr_op + vocab);
   for (int64_t i = 0; i < n_inc; ++i) {
     const int32_t pos = cur[p.inc_op[i]]++;

@@ -179,6 +179,7 @@ def stack_window_graphs(
             n_traces=np.stack([p.n_traces for p in parts]),
             n_inc=np.stack([p.n_inc for p in parts]),
             n_ss=np.stack([p.n_ss for p in parts]),
+            n_cols=np.stack([np.int32(p.n_cols) for p in parts]),
         )
 
     return WindowGraph(
@@ -229,6 +230,7 @@ def _partition_specs(
             n_traces=per_window,
             n_inc=per_window,
             n_ss=per_window,
+            n_cols=per_window,
         )
     return PartitionGraph(
         inc_op=entry,
@@ -261,6 +263,7 @@ def _partition_specs(
         n_traces=per_window,
         n_inc=per_window,
         n_ss=per_window,
+        n_cols=per_window,
     )
 
 

@@ -9,6 +9,7 @@ program. This is the path the benchmark and high-volume replays use.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
@@ -93,21 +94,23 @@ class TableRCA:
             # batch mixing in-budget and past-budget windows degrades to
             # the ~7x-slower coo path even though every graph carries
             # bitmaps). packed_blocked itself is single-device-only.
+            # ADVICE r4: the footprint uses the POST-STACK shapes —
+            # _stage_sharded re-pads every trace axis to the batch max
+            # rounded to 8*S, so the per-device block is that rounded
+            # max / S, not each graph's own pad / S.
             from ..graph.build import packed_unpacked_bytes
 
             s = int(self._mesh.devices.shape[1])
             budget = self.config.runtime.dense_budget_bytes
-            fits = all(
-                packed_unpacked_bytes(
-                    int(g.normal.cov_unique.shape[-1]),
-                    tuple(
-                        -(-int(p.kind.shape[-1]) // s)
-                        for p in (g.normal, g.abnormal)
-                    ),
-                )
-                <= budget
-                for g in graphs
+            t_per_dev = tuple(
+                -(-max(int(getattr(g, side).kind.shape[-1]) for g in graphs)
+                  // (8 * s)) * 8
+                for side in ("normal", "abnormal")
             )
+            v_max = max(
+                int(g.normal.cov_unique.shape[-1]) for g in graphs
+            )
+            fits = packed_unpacked_bytes(v_max, t_per_dev) <= budget
             has_csr = all(
                 int(p.inc_indptr_op.shape[-1]) > 0
                 for g in graphs
@@ -121,13 +124,21 @@ class TableRCA:
                     self.log.warning(
                         "sharded packed footprint exceeds "
                         "dense_budget_bytes and no CSR views were built; "
-                        "proceeding with 'packed' — build with aux='all' "
-                        "to enable the csr fallback"
+                        "proceeding with the packed family — build with "
+                        "aux='all' to enable the csr fallback"
                     )
-                return "packed"
+                return (
+                    "packed_bf16"
+                    if self.config.runtime.prefer_bf16
+                    else "packed"
+                )
             return "csr"
         kernels = {
-            choose_kernel(g, self.config.runtime.dense_budget_bytes)
+            choose_kernel(
+                g,
+                self.config.runtime.dense_budget_bytes,
+                self.config.runtime.prefer_bf16,
+            )
             for g in graphs
         }
         # Without bitmaps choose_kernel only returns csr/coo here.
@@ -241,6 +252,7 @@ class TableRCA:
             min_pad=cfg.runtime.min_pad,
             aux=build_aux,
             dense_budget_bytes=cfg.runtime.dense_budget_bytes,
+            collapse=cfg.runtime.collapse_kinds,
         )
         if self._mesh is not None:
             if int(self._mesh.devices.shape[0]) != 1:
@@ -255,7 +267,9 @@ class TableRCA:
             shard_kernel = cfg.runtime.kernel
             if shard_kernel == "auto":
                 shard_kernel = choose_kernel(
-                    graph, cfg.runtime.dense_budget_bytes
+                    graph,
+                    cfg.runtime.dense_budget_bytes,
+                    cfg.runtime.prefer_bf16,
                 )
         return graph, op_names, shard_kernel
 
@@ -404,6 +418,16 @@ class TableRCA:
         # collectives in program order on every rank, which worker
         # threads cannot guarantee — force synchronous there.
         async_mode = bool(cfg.runtime.async_dispatch) and not batch_windows
+        if batch_windows and cfg.runtime.device_checks:
+            # ADVICE r4: _rank_pending dispatches the batched program,
+            # which has no checkify variant — say so instead of silently
+            # dropping the user's in-program checks (host-side
+            # validate_numerics still applies to every window).
+            self.log.warning(
+                "device_checks applies to per-window dispatch only; "
+                "run(batch_windows=True) ranks without checkify "
+                "instrumentation"
+            )
         if async_mode and jax.process_count() > 1:
             self.log.warning(
                 "async_dispatch is single-process only (collective "
@@ -513,12 +537,15 @@ class TableRCA:
 
         def _flush_bulk():
             """Join EVERY deferred window's results in one batched fetch
-            (fetch_mode="bulk"); the single RPC's wall time lands on the
-            first flushed window's rank_wait. ALL rankings are assigned
-            before anything emits — ``inflight`` stays populated until
-            then, so no batch-mate can reach the sink half-finished —
-            and only then does one _emit_ready release the batch in
-            window order."""
+            (fetch_mode="bulk"). ALL rankings are assigned before
+            anything emits — ``inflight`` stays populated until then, so
+            no batch-mate can reach the sink half-finished — and only
+            then does one _emit_ready release the batch in window order.
+
+            Timing (ADVICE r4): the single RPC's wall time is reported as
+            a batch-level ``bulk_fetch_ms`` key amortized evenly over the
+            batch (each window also records the batch size), instead of
+            skewing one window's rank_wait with the whole batch's cost."""
             if not inflight:
                 return
             items = inflight[:]
@@ -526,11 +553,16 @@ class TableRCA:
                 h.result() if hasattr(h, "result") else h
                 for _, h, _ in items
             ]
-            with items[0][2].stage("rank_wait"):
-                ranked = self.finalize_rank_many(handles)
+            t0 = time.perf_counter()
+            ranked = self.finalize_rank_many(handles)
+            wait_s = time.perf_counter() - t0
             for (result, _, timings), (names, scores) in zip(items, ranked):
                 result.ranking = list(zip(names, scores))
-                result.timings = timings.as_dict()
+                result.timings = {
+                    **timings.as_dict(),
+                    "bulk_fetch_ms": round(wait_s * 1e3 / len(items), 3),
+                    "bulk_fetch_windows": len(items),
+                }
             inflight.clear()
             _emit_ready()
 
@@ -670,6 +702,7 @@ class TableRCA:
                     dense_budget_bytes=max(
                         1, cfg.runtime.dense_budget_bytes // per_device
                     ),
+                    collapse=cfg.runtime.collapse_kinds,
                 )
                 graphs.append(graph)
         with timings.stage("rank_batched"):
@@ -692,7 +725,9 @@ class TableRCA:
                 stacked = stack_window_graphs(graphs)
                 if kernel == "auto":
                     kernel = choose_kernel(
-                        stacked, cfg.runtime.dense_budget_bytes // per_device
+                        stacked,
+                        cfg.runtime.dense_budget_bytes // per_device,
+                        cfg.runtime.prefer_bf16,
                     )
                 top_idx, top_scores, n_valid = stage_rank_windows_batched(
                     device_subset(stacked, kernel),

@@ -33,6 +33,13 @@ from .numpy_ref import spectrum_score
 
 def _partition_arrays(g: PartitionGraph):
     """Slice the live (unpadded) COO arrays of one partition."""
+    if int(g.n_cols) >= 0:
+        raise ValueError(
+            "the sparse oracle ranks UNCOLLAPSED graphs only (its whole "
+            "point is independence from the device path's "
+            "transformations) — build the window with collapse='off' "
+            "and compare the device's collapsed ranking against it"
+        )
     e = int(g.n_inc)
     c = int(g.n_ss)
     t = int(g.n_traces)

@@ -19,6 +19,15 @@ from microrank_tpu.testing import SyntheticConfig, generate_case
 
 
 def _compare(case, cfg, score_rtol=1e-3):
+    import dataclasses
+
+    # Score-tolerance comparisons pin the f32 kernel: the default
+    # prefer_bf16 auto kernel moves scores within bf16 rounding (~1e-3
+    # relative), which is rank-stable (covered by the bf16 parity tests
+    # below) but outside this suite's tight score_rtol.
+    cfg = cfg.replace(
+        runtime=dataclasses.replace(cfg.runtime, prefer_bf16=False)
+    )
     nrm, abn = partition_case(case)
     top_o, sc_o = NumpyRefBackend(cfg).rank_window(case.abnormal, nrm, abn)
     top_j, sc_j = get_backend(cfg).rank_window(case.abnormal, nrm, abn)

@@ -1,0 +1,255 @@
+"""Trace-kind collapse (graph.build.collapse_window_graph) parity.
+
+The collapse merges identical p_sr columns — the reference's own
+trace-kind equivalence (pagerank.py:54-66) — into one column carrying
+its multiplicity. These tests pin the exactness argument: every kernel's
+ranking on the collapsed graph equals its ranking on the uncollapsed
+graph (scores within f32 reassociation tolerance), across the
+single-device, batched and sharded dispatch paths, and the collapsed
+device ranking still matches the float64 sparse oracle ranking the
+UNCOLLAPSED graph.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from microrank_tpu.config import MicroRankConfig, RuntimeConfig
+from microrank_tpu.graph.build import (
+    build_window_graph,
+    collapse_window_graph,
+)
+from microrank_tpu.rank_backends.jax_tpu import (
+    choose_kernel,
+    rank_window_device,
+)
+from microrank_tpu.rank_backends.sparse_oracle import rank_window_sparse
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+from conftest import partition_case
+
+CFG = MicroRankConfig()
+
+
+@pytest.fixture(scope="module")
+def kind_case():
+    """A case with strong kind structure (few distinct trace shapes)."""
+    return generate_case(
+        SyntheticConfig(n_operations=60, n_kinds=6, n_traces=400, seed=3)
+    )
+
+
+@pytest.fixture(scope="module")
+def graphs(kind_case):
+    nrm, abn = partition_case(kind_case)
+    g0, names, _, _ = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="all", collapse="off"
+    )
+    g1, names1, _, _ = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="all", collapse="on"
+    )
+    assert names == names1
+    return g0, g1, names, (nrm, abn)
+
+
+def _ranked_names(graph, names, kernel):
+    ti, ts, nv = jax.device_get(
+        rank_window_device(graph, CFG.pagerank, CFG.spectrum, None, kernel)
+    )
+    n = int(nv)
+    return (
+        [names[int(i)] for i in ti[:n]],
+        np.asarray(ts[:n], dtype=np.float64),
+    )
+
+
+def test_collapse_shrinks_and_marks(graphs):
+    g0, g1, _, _ = graphs
+    assert int(g0.normal.n_cols) == -1
+    assert int(g1.normal.n_cols) >= 0
+    # The generator samples traces from 6 kind templates.
+    assert int(g1.normal.n_cols) <= 8
+    assert int(g1.abnormal.n_cols) <= 8
+    # True trace counts are preserved (the spectrum needs them).
+    assert int(g1.normal.n_traces) == int(g0.normal.n_traces)
+    assert int(g1.abnormal.n_traces) == int(g0.abnormal.n_traces)
+    # kind carries the multiplicity; it must re-total to the trace count.
+    n = int(g1.normal.n_cols)
+    assert int(np.asarray(g1.normal.kind[:n]).sum()) == int(
+        g0.normal.n_traces
+    )
+
+
+@pytest.mark.parametrize(
+    "kernel", ["packed", "packed_bf16", "packed_blocked", "coo", "csr",
+               "dense"]
+)
+def test_collapse_rank_parity_per_kernel(graphs, kernel):
+    g0, g1, names, _ = graphs
+    names0, scores0 = _ranked_names(g0, names, kernel)
+    names1, scores1 = _ranked_names(g1, names, kernel)
+    assert names0 == names1
+    np.testing.assert_allclose(scores0, scores1, rtol=2e-3, atol=1e-5)
+
+
+def test_collapsed_device_matches_uncollapsed_float64_oracle(graphs):
+    g0, g1, names, _ = graphs
+    top_o, _ = rank_window_sparse(g0, names, CFG.pagerank, CFG.spectrum)
+    names1, _ = _ranked_names(g1, names, "packed")
+    assert names1[:5] == top_o[:5]
+
+
+def test_sparse_oracle_rejects_collapsed_graphs(graphs):
+    _, g1, names, _ = graphs
+    with pytest.raises(ValueError, match="UNCOLLAPSED"):
+        rank_window_sparse(g1, names, CFG.pagerank, CFG.spectrum)
+
+
+def test_collapse_auto_skips_when_no_shrink(kind_case):
+    """collapse='auto' on an all-unique-kind window keeps the per-trace
+    layout (and still builds the aux views the core build skipped)."""
+    nrm, abn = partition_case(kind_case)
+    g0, _, _, _ = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="all", collapse="off"
+    )
+    g_auto = collapse_window_graph(g0, aux="all", collapse="auto")
+    # The kind case shrinks, so auto collapses.
+    assert int(g_auto.normal.n_cols) >= 0
+
+    # An all-unique-kind window (every trace covers a distinct op set):
+    # auto must keep the per-trace layout AND construct the aux views
+    # the collapse-bound core build (aux="none") skipped.
+    import pandas as pd
+
+    rows = []
+    for t in range(6):
+        for o in range(t + 1):  # trace t covers ops 0..t — all distinct
+            rows.append(
+                {
+                    "traceID": f"t{t}",
+                    "spanID": f"t{t}-s{o}",
+                    "ParentSpanId": f"t{t}-s{o - 1}" if o else "",
+                    "operationName": f"op{o}",
+                    "serviceName": f"svc{o}",
+                    "podName": f"svc{o}-0",
+                    "duration": 1000,
+                    "startTime": pd.Timestamp("2025-01-01 00:00:00"),
+                    "endTime": pd.Timestamp("2025-01-01 00:00:01"),
+                }
+            )
+    df = pd.DataFrame(rows)
+    g_uniq, _, _, _ = build_window_graph(
+        df, ["t0", "t1", "t2"], ["t3", "t4", "t5"], aux="all",
+        collapse="auto",
+    )
+    assert int(g_uniq.normal.n_cols) == -1
+    assert g_uniq.normal.cov_bits.shape[-1] > 0  # aux views present
+    # collapse="on" still collapses (1:1) and marks the axis.
+    g_on, _, _, _ = build_window_graph(
+        df, ["t0", "t1", "t2"], ["t3", "t4", "t5"], aux="all",
+        collapse="on",
+    )
+    assert int(g_on.normal.n_cols) == int(g_on.normal.n_traces)
+
+
+def test_collapse_preference_forms(graphs):
+    """Both preference forms ('reference' code form and paper Eq (7))
+    stay rank-identical under collapse."""
+    import dataclasses
+
+    g0, g1, names, _ = graphs
+    for pref in ("reference", "paper"):
+        cfg = dataclasses.replace(CFG.pagerank, preference=pref)
+        a = jax.device_get(
+            rank_window_device(g0, cfg, CFG.spectrum, None, "packed")
+        )
+        b = jax.device_get(
+            rank_window_device(g1, cfg, CFG.spectrum, None, "packed")
+        )
+        n = int(a[2])
+        assert int(b[2]) == n
+        assert [names[int(i)] for i in a[0][:n]] == [
+            names[int(i)] for i in b[0][:n]
+        ]
+
+
+def test_collapse_auto_kernel_resolution(graphs):
+    _, g1, _, _ = graphs
+    assert choose_kernel(g1) == "packed"
+    assert choose_kernel(g1, prefer_bf16=True) == "packed_bf16"
+
+
+def test_collapsed_batched_and_sharded_paths(graphs):
+    """Stacked-batch vmap and the 2D-mesh shard_map paths rank collapsed
+    windows identically to the uncollapsed single-device ranking."""
+    from microrank_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        WINDOW_AXIS,
+        make_mesh,
+    )
+    from microrank_tpu.parallel.sharded_rank import (
+        rank_windows_batched,
+        rank_windows_sharded,
+        stack_window_graphs,
+    )
+
+    g0, g1, names, _ = graphs
+    base, _ = _ranked_names(g0, names, "packed")
+
+    stacked = stack_window_graphs([g1, g1])
+    ti, ts, nv = jax.device_get(
+        rank_windows_batched(stacked, CFG.pagerank, CFG.spectrum, "packed")
+    )
+    for b in range(2):
+        n = int(nv[b])
+        assert [names[int(i)] for i in ti[b][:n]] == base
+
+    if len(jax.devices()) >= 4:
+        # Sharded ranking of the COLLAPSED graph vs the same kernel's
+        # single-device ranking of the SAME collapsed graph (isolates
+        # the sharding; summation-tree differences across kernels can
+        # permute exact tail ties) — plus top-5 agreement with the
+        # uncollapsed baseline.
+        mesh = make_mesh((2, 2), (WINDOW_AXIS, SHARD_AXIS))
+        for kernel in ("packed", "packed_bf16", "coo", "csr"):
+            single, _ = _ranked_names(g1, names, kernel)
+            stacked = stack_window_graphs(
+                [g1, g1], shard_multiple=2, trace_multiple=16
+            )
+            ti, ts, nv = jax.device_get(
+                rank_windows_sharded(
+                    jax.device_put(stacked),
+                    CFG.pagerank,
+                    CFG.spectrum,
+                    mesh,
+                    kernel,
+                )
+            )
+            for b in range(2):
+                n = int(nv[b])
+                ranked = [names[int(i)] for i in ti[b][:n]]
+                assert ranked == single, kernel
+                assert ranked[:5] == base[:5], kernel
+
+
+def test_runtime_config_plumbs_collapse(kind_case, tmp_path):
+    """TableRCA with collapse_kinds='auto'/'on' matches 'off' end to end
+    (native lane, real pipeline)."""
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+
+    kind_case.normal.to_csv(tmp_path / "normal.csv", index=False)
+    kind_case.abnormal.to_csv(tmp_path / "abnormal.csv", index=False)
+
+    def run(rt):
+        rca = TableRCA(MicroRankConfig(runtime=rt))
+        rca.fit_baseline(load_span_table(tmp_path / "normal.csv"))
+        res = rca.run(load_span_table(tmp_path / "abnormal.csv"))
+        return [
+            [n for n, _ in r.ranking] if r.ranking else None for r in res
+        ]
+
+    base = run(RuntimeConfig(collapse_kinds="off", prefer_bf16=False))
+    assert run(RuntimeConfig(collapse_kinds="auto", prefer_bf16=False)) == base
+    assert run(RuntimeConfig(collapse_kinds="on", prefer_bf16=True)) == base

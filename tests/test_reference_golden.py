@@ -153,7 +153,16 @@ def test_full_rca_matches_reference(case):
     assert oracle_top == ref_top
     np.testing.assert_allclose(oracle_scores, ref_scores, rtol=1e-9)
 
-    jax_top, jax_scores = JaxBackend(cfg).rank_window(
+    # Pin the f32 kernel: the tight 2e-3 score comparison against the
+    # reference's float64 computation leaves no room for the default
+    # bf16 auto kernel's rounding (rank parity under bf16 is covered by
+    # the backend-parity suite).
+    import dataclasses
+
+    cfg_f32 = cfg.replace(
+        runtime=dataclasses.replace(cfg.runtime, prefer_bf16=False)
+    )
+    jax_top, jax_scores = JaxBackend(cfg_f32).rank_window(
         case.abnormal, normal_list, abnormal_list
     )
     assert jax_top[0] == ref_top[0]
