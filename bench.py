@@ -465,21 +465,21 @@ def _run_batched(
     remap = slo_vocab.encode(table.svc_op_names).astype(np.int32)
 
     def detect_window(b):
-        m, nrm, abn, _ = detect_window_partition(
+        m, nrm, abn, _, rng = detect_window_partition(
             table, edges[b], edges[b + 1], slo_vocab, baseline,
-            cfg.detector, remap=remap, thresh=thresh,
+            cfg.detector, remap=remap, thresh=thresh, with_range=True,
         )
-        return m, nrm, abn
+        return m, nrm, abn, rng
 
     def build_all():
         graphs, names, total = [], list(table.pod_op_names), 0
         for b in range(n_batch):
-            m, nrm, abn = detect_window(b)
+            m, nrm, abn, rng = detect_window(b)
             if not (len(nrm) and len(abn)):
                 continue
             g, _, _, _ = build_window_graph_from_table(
                 table, m, nrm, abn, aux=aux_for_kernel(kernel),
-                collapse=_collapse_mode(),
+                collapse=_collapse_mode(), row_range=rng,
             )
             graphs.append(g)
             total += int(m.sum())
@@ -551,7 +551,7 @@ def _run_batched(
     w0 = pd.Timestamp(np.datetime64(int(edges[0]), "us"))
     w1 = pd.Timestamp(np.datetime64(int(edges[1]), "us"))
     sub_df = sub_df[(sub_df["startTime"] >= w0) & (sub_df["endTime"] <= w1)]
-    m0, nrm0, abn0 = detect_window(0)
+    m0, nrm0, abn0, _ = detect_window(0)
     oracle_sps, _, _, _, _ = _oracle_subsample(
         cfg, sub_df, table.trace_names, nrm0, abn0, int(m0.sum()),
         oracle_spans,
@@ -615,6 +615,12 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
             fetch_mode="bulk",
             kernel=os.environ.get("BENCH_KERNEL", "auto"),
             blob_staging=_use_blob(),
+            # Group dispatches: one staging RPC per group instead of per
+            # window (the replay is dispatch-RPC-bound once the host
+            # work is O(window); `run --dispatch-batch-windows`).
+            dispatch_batch_windows=int(
+                os.environ.get("BENCH_DISPATCH_BATCH", 4)
+            ),
         ),
     )
     rca = TableRCA(cfg)

@@ -128,6 +128,9 @@ def _config_from_args(args) -> "MicroRankConfig":
                     "bulk_fetch_windows": getattr(
                         args, "bulk_fetch_windows", None
                     ),
+                    "dispatch_batch_windows": getattr(
+                        args, "dispatch_batch_windows", None
+                    ),
                 }.items()
                 if v is not None
             },
@@ -506,6 +509,13 @@ def main(argv=None) -> int:
     p_run.add_argument(
         "--bulk-fetch-windows", type=_positive_int, default=None,
         help="windows joined per batched fetch in --fetch-mode bulk",
+    )
+    p_run.add_argument(
+        "--dispatch-batch-windows", type=_positive_int, default=None,
+        help="group this many anomalous windows into one stacked "
+        "stage+dispatch (one staging transfer per group — the replay "
+        "throughput knob on high-latency links; 1 = lowest per-window "
+        "latency)",
     )
     p_run.add_argument(
         "--distributed", action="store_true",

@@ -78,6 +78,33 @@ def window_rows(table, start_us: int, end_us: int) -> np.ndarray:
     return (table.start_us >= start_us) & (table.end_us <= end_us)
 
 
+def window_span_range(table, start_us: int, end_us: int):
+    """Candidate row range [lo, hi) of one window on a TIME-SORTED table.
+
+    Every qualifying row (start >= w0 AND end <= w1, with end >= start)
+    has start in [w0, w1], which is contiguous under the sort — so the
+    per-window predicates only need to run on this slice, making window
+    work O(window) instead of O(table) on multi-window replays.
+    """
+    lo = int(np.searchsorted(table.start_us, start_us, "left"))
+    hi = int(np.searchsorted(table.start_us, end_us, "right"))
+    return lo, hi
+
+
+def _slice_table(table, lo: int, hi: int):
+    """Row-slice view of a SpanTable (cheap; parent_row values stay
+    table-absolute — detection never reads them)."""
+    return table._replace(
+        trace_id=table.trace_id[lo:hi],
+        svc_op=table.svc_op[lo:hi],
+        pod_op=table.pod_op[lo:hi],
+        duration_us=table.duration_us[lo:hi],
+        start_us=table.start_us[lo:hi],
+        end_us=table.end_us[lo:hi],
+        parent_row=table.parent_row[lo:hi],
+    )
+
+
 def detect_batch_from_table(
     table,
     mask: np.ndarray,
@@ -137,6 +164,7 @@ def detect_window_partition(
     thresh: np.ndarray | None = None,
     pad_policy: str = "pow2q",
     min_pad: int = 8,
+    with_range: bool = False,
 ):
     """THE window-detection seam (used by TableRCA, bench single-window
     and bench batched modes alike): returns (mask, nrm_codes, abn_codes,
@@ -144,12 +172,35 @@ def detect_window_partition(
     (native.detect_window_native) when available, the numpy twin
     otherwise; both produce identical partitions (parity-tested).
 
+    Time-sorted tables only scan the window's candidate row slice
+    (window_span_range). ``with_range=True`` appends that (lo, hi) range
+    to the return tuple AND returns the mask over the slice (length
+    hi-lo — expanding it to table length costs an O(table) allocation
+    per window, which the row-range consumers never need); without it
+    the mask is full-length.
+
     ``remap``/``thresh`` may be passed precomputed (callers looping over
     many windows cache them); otherwise they are derived here.
     """
     from ..detect import detect_numpy
     from ..detect.detector import _thresholds
     from ..native import NativeUnavailable, native_available
+
+    n_spans = table.n_spans
+    if getattr(table, "time_sorted", False):
+        lo, hi = window_span_range(table, w0_us, w1_us)
+    else:
+        lo, hi = 0, n_spans
+    sub = table if (lo, hi) == (0, n_spans) else _slice_table(table, lo, hi)
+
+    def ret(sub_mask, nrm, abn, n_window):
+        if with_range:  # slice-local mask, paired with its range
+            return sub_mask, nrm, abn, n_window, (lo, hi)
+        if (lo, hi) == (0, n_spans):
+            return sub_mask, nrm, abn, n_window
+        mask = np.zeros(n_spans, dtype=sub_mask.dtype)
+        mask[lo:hi] = sub_mask
+        return mask, nrm, abn, n_window
 
     if native_available():
         from ..native import detect_window_native
@@ -161,24 +212,24 @@ def detect_window_partition(
         if thresh is None:
             thresh = _thresholds(baseline, detector_cfg)
         try:
-            mask, nrm, abn, n_window, _ = detect_window_native(
-                table, w0_us, w1_us, remap, thresh, detector_cfg.slack_ms
+            sub_mask, nrm, abn, n_window, _ = detect_window_native(
+                sub, w0_us, w1_us, remap, thresh, detector_cfg.slack_ms
             )
-            return mask, nrm, abn, n_window
+            return ret(sub_mask, nrm, abn, n_window)
         except NativeUnavailable:
             pass  # fall through to numpy
-    mask = window_rows(table, w0_us, w1_us)
-    n_window = int(mask.sum())
+    sub_mask = window_rows(sub, w0_us, w1_us)
+    n_window = int(sub_mask.sum())
     if n_window == 0:
-        return mask, None, None, 0
+        return ret(sub_mask, None, None, 0)
     batch, trace_codes = detect_batch_from_table(
-        table, mask, slo_vocab, pad_policy, min_pad
+        sub, sub_mask, slo_vocab, pad_policy, min_pad
     )
     det = detect_numpy(batch, baseline, detector_cfg)
     t = len(trace_codes)
     abn = trace_codes[det.abnormal[:t]]
     nrm = trace_codes[det.valid[:t] & ~det.abnormal[:t]]
-    return mask, nrm, abn, n_window
+    return ret(sub_mask, nrm, abn, n_window)
 
 
 def _graph_from_padded(p):
@@ -228,6 +279,7 @@ def build_window_graph_from_table(
     aux: str = "auto",
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
     collapse: str = "off",
+    row_range: Tuple[int, int] | None = None,
 ) -> Tuple[WindowGraph, List[str], np.ndarray, np.ndarray]:
     """Both partitions' graphs from table rows — ints end to end.
 
@@ -242,14 +294,29 @@ def build_window_graph_from_table(
     per-trace aux views and the post-pass constructs them on the
     collapsed shapes.
 
+    ``row_range`` (lo, hi): every True row of ``mask`` lies inside this
+    slice (detect_window_partition's with_range output on a time-sorted
+    table) — the build then touches only the slice, O(window) instead of
+    O(table) on multi-window replays. ``mask`` may be table-length or
+    already slice-local (length hi-lo, as with_range returns it).
+
     Returns (graph, op_names, normal_codes, abnormal_codes).
     """
     from .build import collapse_window_graph
 
     vocab_size = len(table.pod_op_names)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
+    lo, hi = row_range if row_range is not None else (0, table.n_spans)
+    # Normalize the mask to SLICE-LOCAL form (all uses below are).
     if mask is None:
-        mask = np.ones(table.n_spans, dtype=bool)
+        mask = np.ones(hi - lo, dtype=bool)
+    elif len(mask) != hi - lo:
+        if len(mask) != table.n_spans:
+            raise ValueError(
+                f"mask length {len(mask)} matches neither the row_range "
+                f"({hi - lo}) nor the table ({table.n_spans})"
+            )
+        mask = mask[lo:hi]
 
     normal_trace_codes = list(normal_trace_codes)
     abnormal_trace_codes = list(abnormal_trace_codes)
@@ -298,13 +365,24 @@ def build_window_graph_from_table(
                 nf[ncodes] = 1
             if len(acodes):
                 af[acodes] = 1
-            full = bool(np.all(mask))
+            sub_mask = mask  # slice-local (normalized above)
+            full = bool(np.all(sub_mask))
+            if (lo, hi) == (0, table.n_spans):
+                parent_in = table.parent_row
+            else:
+                # Slice-local parent rows; parents outside the slice
+                # cannot be window rows, so -1 them (the C++ mask check
+                # covers in-slice parents outside the window).
+                p = table.parent_row[lo:hi]
+                parent_in = np.where(
+                    (p >= lo) & (p < hi), p - lo, np.int64(-1)
+                )
             try:
                 raw_n, raw_a = build_window_padded(
-                    table.pod_op,
-                    table.trace_id,
-                    table.parent_row,
-                    None if full else mask,
+                    table.pod_op[lo:hi],
+                    table.trace_id[lo:hi],
+                    parent_in,
+                    None if full else sub_mask,
                     nf,
                     af,
                     vocab_size,
@@ -328,16 +406,22 @@ def build_window_graph_from_table(
                     raw_n.local_uniques.astype(np.int64),
                     raw_a.local_uniques.astype(np.int64),
                 )
-    rows = np.flatnonzero(mask)
+    rows = lo + np.flatnonzero(mask)
     op_codes = table.pod_op[rows].astype(np.int64)
     g_trace = table.trace_id[rows].astype(np.int64)
 
-    # Parent linkage restricted to the window: map table-row -> window-pos.
-    pos_in_window = np.full(table.n_spans, -1, dtype=np.int64)
-    pos_in_window[rows] = np.arange(len(rows))
+    # Parent linkage restricted to the window: map slice-row -> window-pos
+    # (slice-local scatter — O(window) when a row_range is given).
+    pos_in_window = np.full(hi - lo, -1, dtype=np.int64)
+    pos_in_window[rows - lo] = np.arange(len(rows))
     parent = table.parent_row[rows]
+    parent_local = np.where(
+        (parent >= lo) & (parent < hi), parent - lo, np.int64(-1)
+    )
     parent_pos = np.where(
-        parent >= 0, pos_in_window[np.clip(parent, 0, None)], -1
+        parent_local >= 0,
+        pos_in_window[np.clip(parent_local, 0, None)],
+        -1,
     )
 
     n_total_traces = len(table.trace_names)

@@ -56,6 +56,11 @@ class SpanTable(NamedTuple):
     trace_names: List[str]
     svc_op_names: List[str]
     pod_op_names: List[str]
+    # Rows sorted by start_us ascending (sort_table_by_time — the loader
+    # does it once per dump, sidecar-cached). Window seams then slice a
+    # searchsorted row range instead of scanning every row per window —
+    # O(window) detection/build on multi-window replays.
+    time_sorted: bool = False
 
     @property
     def n_spans(self) -> int:
@@ -130,6 +135,7 @@ def _load_library() -> ctypes.CDLL:
         u8p,             # abnormal_flag
         ctypes.c_int64,  # n_total_traces
         ctypes.c_int64,  # vocab_size
+        ctypes.c_int32,  # collapse_mode (0 off / 1 auto / 2 on)
     ]
     lib.mr_window_sizes.restype = None
     lib.mr_window_sizes.argtypes = [ctypes.c_void_p, i64p]
@@ -204,7 +210,43 @@ def native_available() -> bool:
 
 # v2: op vocabularies canonicalized to name-sorted order (the vocab index
 # is the device ranking's tie key — it must equal ascending op name).
-_SIDECAR_VERSION = 2
+# v3: rows time-sorted at load (sort_table_by_time) so window seams can
+# slice searchsorted row ranges; older sidecars reload + re-sort.
+_SIDECAR_VERSION = 3
+
+
+def sort_table_by_time(table: SpanTable) -> SpanTable:
+    """Reorder rows by ascending start_us (stable) and remap parent_row.
+
+    Every consumer is row-order independent: detection accumulates
+    per-trace sums (float64 over exact int durations), the graph build's
+    counting sorts key on interned ids, and window masks are pure time
+    predicates — so sorting changes no result, it only makes window row
+    ranges contiguous. Already-sorted inputs return unchanged (flag set).
+    """
+    if table.time_sorted:
+        return table
+    start = table.start_us
+    n = int(start.shape[0])
+    if n == 0 or bool(np.all(start[1:] >= start[:-1])):
+        return table._replace(time_sorted=True)
+    order = np.argsort(start, kind="stable")
+    inv = np.empty(n, dtype=np.int64)
+    inv[order] = np.arange(n, dtype=np.int64)
+    old_parent = table.parent_row[order]
+    parent = np.where(
+        old_parent >= 0, inv[np.clip(old_parent, 0, None)], -1
+    )
+    return table._replace(
+        trace_id=np.ascontiguousarray(table.trace_id[order]),
+        svc_op=np.ascontiguousarray(table.svc_op[order]),
+        pod_op=np.ascontiguousarray(table.pod_op[order]),
+        duration_us=np.ascontiguousarray(table.duration_us[order]),
+        start_us=np.ascontiguousarray(start[order]),
+        end_us=np.ascontiguousarray(table.end_us[order]),
+        parent_row=np.ascontiguousarray(parent),
+        time_sorted=True,
+    )
 
 
 def _sort_vocab(codes: np.ndarray, names: List[str]):
@@ -259,6 +301,7 @@ def _load_sidecar(path: Path, side: Path) -> Optional[SpanTable]:
                 trace_names=list(z["trace_names"]),
                 svc_op_names=list(z["svc_op_names"]),
                 pod_op_names=list(z["pod_op_names"]),
+                time_sorted=True,  # v3 sidecars store sorted rows
             )
     except (OSError, KeyError, ValueError, zipfile.BadZipFile):
         return None
@@ -330,19 +373,21 @@ def load_span_table(
             arr(t.pod_op, np.int32),
             _decode_vocab(t.pod_blob, t.pod_offsets, int(t.n_pod_ops)),
         )
-        table = SpanTable(
-            trace_id=arr(t.trace_id, np.int32),
-            svc_op=svc_op,
-            pod_op=pod_op,
-            duration_us=arr(t.duration_us, np.int64),
-            start_us=arr(t.start_us, np.int64),
-            end_us=arr(t.end_us, np.int64),
-            parent_row=arr(t.parent_row, np.int64),
-            trace_names=_decode_vocab(
-                t.trace_blob, t.trace_offsets, int(t.n_traces)
-            ),
-            svc_op_names=svc_names,
-            pod_op_names=pod_names,
+        table = sort_table_by_time(
+            SpanTable(
+                trace_id=arr(t.trace_id, np.int32),
+                svc_op=svc_op,
+                pod_op=pod_op,
+                duration_us=arr(t.duration_us, np.int64),
+                start_us=arr(t.start_us, np.int64),
+                end_us=arr(t.end_us, np.int64),
+                parent_row=arr(t.parent_row, np.int64),
+                trace_names=_decode_vocab(
+                    t.trace_blob, t.trace_offsets, int(t.n_traces)
+                ),
+                svc_op_names=svc_names,
+                pod_op_names=pod_names,
+            )
         )
         if cache:
             _save_sidecar(side, path, table)
@@ -461,6 +506,10 @@ def build_window_padded(
         af.ctypes.data_as(u8p),
         ctypes.c_int64(len(nf)),
         ctypes.c_int64(vocab_size),
+        # The collapse happens INSIDE the build (before the incidence
+        # emit — the per-trace entry arrays are never materialized);
+        # mr_collapse_window below then just reports the true counts.
+        ctypes.c_int32({"off": 0, "auto": 1, "on": 2}[collapse]),
     )
     if not handle:
         raise NativeUnavailable("mr_build_window2 allocation failed")

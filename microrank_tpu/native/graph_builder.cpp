@@ -81,14 +81,15 @@ struct BuiltPartition {
   std::vector<int32_t> cov_unique;
   std::vector<uint8_t> op_present;
   int64_t n_ops = 0;
-  // Kind grouping (finish_partition's kinds phase, kept for
-  // mr_collapse_window): group id per trace, size per group. Group ids
-  // are assigned in first-encounter order over ascending trace ids, so
-  // they double as the collapsed column order.
+  // Kind grouping (analyze_partition's kinds phase): group id per trace,
+  // size per group. Group ids are assigned in first-encounter order over
+  // ascending trace ids, so they double as the collapsed column order.
   std::vector<int32_t> group_of;
+  std::vector<int32_t> group_count;
   int64_t n_groups = 0;
-  // After mr_collapse_window: the TRUE trace count (kind/tracelen then
-  // hold one entry per kind column). -1 = not collapsed.
+  // Collapsed emit (emit_partition(collapse=true)): the TRUE trace
+  // count — kind/tracelen then hold one entry per kind column
+  // (mr_collapse_window reports it). -1 = per-trace layout.
   int64_t n_traces_true = -1;
 };
 
@@ -134,6 +135,11 @@ struct PartScratch {
   std::vector<int64_t> tr_off;         // [n_traces+1] bucket offsets
   std::vector<int32_t> by_trace_op;    // [n_p] ops bucketed by local trace
   int64_t n_p = 0;
+  // analyze_partition outputs consumed by emit_partition: unique-op
+  // count + set hash per trace, and the unique-entry prefix offsets.
+  std::vector<int32_t> n_uniq;
+  std::vector<uint64_t> trace_hash;
+  std::vector<int64_t> u_start;
 };
 
 // Worker count for the intra-partition trace chunks: the hardware
@@ -206,7 +212,7 @@ void parallel_chunks(int64_t n, const int64_t* prefix, int k, Fn fn) {
   if (first_err) std::rethrow_exception(first_err);
 }
 
-void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
+void analyze_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
   const int64_t n_traces = static_cast<int64_t>(out->local_uniques.size());
   auto& tracelen = out->tracelen;
   const std::vector<int64_t>& tr_off = sc.tr_off;
@@ -216,8 +222,10 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
   // disjoint, so trace chunks run on the thread pool (chunk boundaries
   // balanced by span counts via tr_off; the per-trace sorts are the
   // single-core hot spot at the 4M-span scale).
-  std::vector<int32_t> n_uniq(n_traces, 0);
-  std::vector<uint64_t> trace_hash(n_traces, 0);
+  auto& n_uniq = sc.n_uniq;
+  auto& trace_hash = sc.trace_hash;
+  n_uniq.assign(n_traces, 0);
+  trace_hash.assign(n_traces, 0);
   // RAII phase scopes: .emplace() prints the previous phase (destructor)
   // and starts the next; unwinding destroys the active one.
   std::optional<PhaseTimer> tm;
@@ -246,19 +254,118 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
         }
       });
 
-  if (profile_enabled()) tm.emplace("emit");
+  if (profile_enabled()) tm.emplace("cov+kinds");
 
-  // Pass 2 — one serial emit of the unique incidence; rs_val is fused in
-  // (cov_dup is final after the stats scan, so 1/cov needs no extra pass).
+  // Unique-coverage histogram straight from the deduped buckets (the
+  // emit may be collapsed, so the per-trace entries can't be counted
+  // from the output arrays).
+  auto& u_start = sc.u_start;
+  u_start.assign(n_traces + 1, 0);
+  for (int64_t t = 0; t < n_traces; ++t)
+    u_start[t + 1] = u_start[t] + n_uniq[t];
+  out->cov_unique.assign(vocab, 0);
+  auto& cov_unique = out->cov_unique;
+  for (int64_t t = 0; t < n_traces; ++t) {
+    const int32_t* b = by_trace_op.data() + tr_off[t];
+    for (int32_t j = 0; j < n_uniq[t]; ++j) ++cov_unique[b[j]];
+  }
+  out->op_present.assign(vocab, 0);
+  for (int64_t o = 0; o < vocab; ++o)
+    if (cov_unique[o] > 0) {
+      out->op_present[o] = 1;
+      ++out->n_ops;
+    }
+
+  // Trace kinds: two traces are one kind iff identical unique-op
+  // sequence AND identical span count (== p_sr-column equality,
+  // pagerank.py:54-66). Hash prefilter + exact bucket compare on
+  // collision — always exact. Group ids in first-encounter order.
+  {
+    std::unordered_map<uint64_t, std::vector<int32_t>> groups;
+    auto& group_of = out->group_of;
+    auto& group_count = out->group_count;
+    group_of.assign(n_traces, -1);
+    group_count.clear();
+    groups.reserve(static_cast<size_t>(n_traces) * 2);
+    for (int64_t t = 0; t < n_traces; ++t) {
+      auto& reps = groups[trace_hash[t]];
+      int32_t g = -1;
+      for (int32_t rep : reps) {
+        if (n_uniq[rep] != n_uniq[t] || tracelen[rep] != tracelen[t])
+          continue;
+        if (std::memcmp(by_trace_op.data() + tr_off[rep],
+                        by_trace_op.data() + tr_off[t],
+                        static_cast<size_t>(n_uniq[t]) *
+                            sizeof(int32_t)) == 0) {
+          g = group_of[rep];
+          break;
+        }
+      }
+      if (g < 0) {
+        g = static_cast<int32_t>(group_count.size());
+        group_count.push_back(0);
+        reps.push_back(static_cast<int32_t>(t));
+      }
+      group_of[t] = g;
+      ++group_count[g];
+    }
+    out->n_groups = static_cast<int64_t>(group_count.size());
+  }
+}
+
+void emit_partition(PartScratch& sc, BuiltPartition* out, bool collapse) {
+  const int64_t n_traces = static_cast<int64_t>(out->local_uniques.size());
+  const std::vector<int64_t>& tr_off = sc.tr_off;
+  const std::vector<int32_t>& by_trace_op = sc.by_trace_op;
+  const std::vector<int32_t>& n_uniq = sc.n_uniq;
+  const std::vector<int64_t>& u_start = sc.u_start;
+  auto& tracelen = out->tracelen;
   auto& inc_op = out->inc_op;
   auto& inc_trace = out->inc_trace;
   auto& sr_val = out->sr_val;
   auto& rs_val = out->rs_val;
-  out->cov_unique.assign(vocab, 0);
-  auto& cov_unique = out->cov_unique;
-  std::vector<int64_t> u_start(n_traces + 1, 0);
-  for (int64_t t = 0; t < n_traces; ++t)
-    u_start[t + 1] = u_start[t] + n_uniq[t];
+  std::optional<PhaseTimer> tm;
+  if (profile_enabled()) tm.emplace(collapse ? "emit-collapsed" : "emit");
+
+  if (collapse) {
+    // Emit ONE column per kind group, multiplicity folded into the
+    // forward value (m/len in double, cast once — the numpy lane's
+    // exact arithmetic). The 1M-entry per-trace emit never happens.
+    const int64_t n_groups = out->n_groups;
+    std::vector<int32_t> rep(n_groups, -1);
+    for (int64_t t = 0; t < n_traces; ++t)
+      if (rep[out->group_of[t]] < 0)
+        rep[out->group_of[t]] = static_cast<int32_t>(t);
+    int64_t n_inc = 0;
+    for (int64_t g = 0; g < n_groups; ++g) n_inc += n_uniq[rep[g]];
+    inc_op.resize(n_inc);
+    inc_trace.resize(n_inc);
+    sr_val.resize(n_inc);
+    rs_val.resize(n_inc);
+    std::vector<int32_t> new_kind(n_groups), new_len(n_groups);
+    int64_t w = 0;
+    for (int64_t g = 0; g < n_groups; ++g) {
+      const int32_t r = rep[g];
+      const float sr = static_cast<float>(
+          static_cast<double>(out->group_count[g]) /
+          static_cast<double>(tracelen[r]));
+      const int32_t* b = by_trace_op.data() + tr_off[r];
+      for (int32_t j = 0; j < n_uniq[r]; ++j, ++w) {
+        const int32_t op = b[j];
+        inc_op[w] = op;
+        inc_trace[w] = static_cast<int32_t>(g);
+        sr_val[w] = sr;
+        rs_val[w] = 1.0f / static_cast<float>(sc.cov_dup[op]);
+      }
+      new_kind[g] = out->group_count[g];
+      new_len[g] = tracelen[r];
+    }
+    out->kind.swap(new_kind);
+    tracelen.swap(new_len);
+    out->n_traces_true = n_traces;
+    return;
+  }
+
   const int64_t n_inc = u_start[n_traces];
   inc_op.resize(n_inc);
   inc_trace.resize(n_inc);
@@ -280,16 +387,13 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
           }
         }
       });
-  // cov_unique is a vocab-sized histogram of the unique incidence — one
-  // serial pass (racy if chunked without per-thread copies).
-  for (int64_t i = 0; i < n_inc; ++i) ++cov_unique[inc_op[i]];
-  out->op_present.assign(vocab, 0);
-  for (int64_t o = 0; o < vocab; ++o)
-    if (cov_unique[o] > 0) {
-      out->op_present[o] = 1;
-      ++out->n_ops;
-    }
+  out->kind.assign(n_traces, 0);
+  for (int64_t t = 0; t < n_traces; ++t)
+    out->kind[t] = out->group_count[out->group_of[t]];
+}
 
+void edges_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
+  std::optional<PhaseTimer> tm;
   if (profile_enabled()) tm.emplace("edges");
 
   if (!sc.edge_bits.empty()) {
@@ -354,121 +458,23 @@ void finish_partition(PartScratch& sc, int64_t vocab, BuiltPartition* out) {
     }
   }
 
-  if (profile_enabled()) tm.emplace("kinds");
-
-  // Trace kinds: two traces are one kind iff identical unique-op sequence
-  // AND identical span count (== p_sr-column equality, pagerank.py:54-66).
-  // Hash prefilter + exact compare on collision — always exact. The
-  // grouping is kept on the partition for mr_collapse_window.
-  out->kind.assign(n_traces, 0);
-  {
-    std::unordered_map<uint64_t, std::vector<int32_t>> groups;  // hash -> reps
-    auto& group_of = out->group_of;
-    group_of.assign(n_traces, -1);
-    std::vector<int32_t> group_count;
-    groups.reserve(static_cast<size_t>(n_traces) * 2);
-    for (int64_t t = 0; t < n_traces; ++t) {
-      const int64_t s = u_start[t], e = u_start[t + 1];
-      auto& reps = groups[trace_hash[t]];
-      int32_t g = -1;
-      for (int32_t rep : reps) {
-        const int64_t rs = u_start[rep], re = u_start[rep + 1];
-        if (re - rs != e - s || tracelen[rep] != tracelen[t]) continue;
-        if (std::memcmp(&inc_op[rs], &inc_op[s],
-                        static_cast<size_t>(e - s) * sizeof(int32_t)) == 0) {
-          g = group_of[rep];
-          break;
-        }
-      }
-      if (g < 0) {
-        g = static_cast<int32_t>(group_count.size());
-        group_count.push_back(0);
-        reps.push_back(static_cast<int32_t>(t));
-      }
-      group_of[t] = g;
-      ++group_count[g];
-    }
-    for (int64_t t = 0; t < n_traces; ++t)
-      out->kind[t] = group_count[group_of[t]];
-    out->n_groups = static_cast<int64_t>(group_count.size());
-  }
-}
-
-// Collapse one partition's trace axis to its distinct kind columns, in
-// place (the C++ twin of graph/build.py:_collapse_partition — see there
-// for the exactness argument). Representative = the first trace of each
-// group; group ids are already in first-encounter (= representative
-// ascending) order, so the collapsed incidence stays sorted by
-// (column, op). Forward values fold the multiplicity (m/len, computed in
-// double and cast once, matching the numpy lane bit for bit); rs_val,
-// call edges and the per-op statistics keep their TRUE full-trace
-// values. kind[g] becomes the multiplicity, tracelen[g] the
-// representative's span count; local_uniques (the true trace list) is
-// untouched.
-void collapse_partition(BuiltPartition* p) {
-  const int64_t n_traces = static_cast<int64_t>(p->kind.size());
-  if (p->n_traces_true >= 0) return;  // already collapsed
-  if (n_traces == 0) {
-    p->n_traces_true = 0;
-    return;
-  }
-  const int64_t n_groups = p->n_groups;
-  std::vector<int32_t> rep(n_groups, -1);
-  std::vector<int32_t> count(n_groups, 0);
-  for (int64_t t = 0; t < n_traces; ++t) {
-    const int32_t g = p->group_of[t];
-    if (rep[g] < 0) rep[g] = static_cast<int32_t>(t);
-    ++count[g];
-  }
-  // Per-trace entry offsets (entries are trace-major).
-  std::vector<int64_t> off(n_traces + 1, 0);
-  for (int64_t i = 0; i < static_cast<int64_t>(p->inc_op.size()); ++i)
-    ++off[p->inc_trace[i] + 1];
-  for (int64_t t = 0; t < n_traces; ++t) off[t + 1] += off[t];
-
-  std::vector<int32_t> new_op, new_trace;
-  std::vector<float> new_sr, new_rs;
-  int64_t n_new = 0;
-  for (int64_t g = 0; g < n_groups; ++g)
-    n_new += off[rep[g] + 1] - off[rep[g]];
-  new_op.reserve(n_new);
-  new_trace.reserve(n_new);
-  new_sr.reserve(n_new);
-  new_rs.reserve(n_new);
-  std::vector<int32_t> new_kind(n_groups), new_len(n_groups);
-  for (int64_t g = 0; g < n_groups; ++g) {
-    const int32_t r = rep[g];
-    const float sr = static_cast<float>(
-        static_cast<double>(count[g]) /
-        static_cast<double>(p->tracelen[r]));
-    for (int64_t i = off[r]; i < off[r + 1]; ++i) {
-      new_op.push_back(p->inc_op[i]);
-      new_trace.push_back(static_cast<int32_t>(g));
-      new_sr.push_back(sr);
-      new_rs.push_back(p->rs_val[i]);
-    }
-    new_kind[g] = count[g];
-    new_len[g] = p->tracelen[r];
-  }
-  p->inc_op.swap(new_op);
-  p->inc_trace.swap(new_trace);
-  p->sr_val.swap(new_sr);
-  p->rs_val.swap(new_rs);
-  p->kind.swap(new_kind);
-  p->tracelen.swap(new_len);
-  p->n_traces_true = n_traces;
 }
 
 }  // namespace
 
 extern "C" {
 
+// ``collapse_mode``: 0 = per-trace layout, 1 = kind-collapse when the
+// combined trace axis shrinks (graph/build.py collapse="auto"), 2 =
+// always collapse. Collapsing happens BEFORE the incidence emit, so the
+// per-trace entry arrays are never materialized.
 MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
                                 const int64_t* parent_row, int64_t n_rows,
                                 const uint8_t* row_mask,
                                 const uint8_t* normal_flag,
                                 const uint8_t* abnormal_flag,
-                                int64_t n_total_traces, int64_t vocab_size) {
+                                int64_t n_total_traces, int64_t vocab_size,
+                                int32_t collapse_mode) {
   MrBuiltWindow* g = nullptr;
   try {
     g = new MrBuiltWindow();
@@ -603,9 +609,21 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
     // its trace chunks (parallel_chunks), which balances arbitrarily
     // skewed partitions — the old one-thread-per-partition overlap
     // bought nothing when one partition held 40x the entries (the usual
-    // detection outcome).
-    finish_partition(sc[0], vocab_size, &g->parts[0]);
-    finish_partition(sc[1], vocab_size, &g->parts[1]);
+    // detection outcome). Analyze both first: the auto collapse
+    // decision needs both partitions' kind-group counts before either
+    // emits.
+    analyze_partition(sc[0], vocab_size, &g->parts[0]);
+    analyze_partition(sc[1], vocab_size, &g->parts[1]);
+    const int64_t t_total =
+        static_cast<int64_t>(g->parts[0].local_uniques.size()) +
+        static_cast<int64_t>(g->parts[1].local_uniques.size());
+    const int64_t grp_total = g->parts[0].n_groups + g->parts[1].n_groups;
+    const bool do_collapse =
+        collapse_mode == 2 || (collapse_mode == 1 && grp_total < t_total);
+    emit_partition(sc[0], &g->parts[0], do_collapse);
+    emit_partition(sc[1], &g->parts[1], do_collapse);
+    edges_partition(sc[0], vocab_size, &g->parts[0]);
+    edges_partition(sc[1], vocab_size, &g->parts[1]);
   } catch (const std::bad_alloc&) {
     delete g;
     return nullptr;
@@ -628,32 +646,15 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
   return g;
 }
 
-// Kind-collapse both partitions' trace axes in place (see
-// collapse_partition above). ``auto_mode`` != 0 collapses only when the
-// combined axis actually shrinks (the graph/build.py collapse="auto"
-// rule); 0 always collapses. Returns 1 when collapsed (out_true[i] then
-// holds partition i's TRUE trace count while mr_window_sizes reports the
-// kind-column count), 0 when left per-trace. Call before the export
-// functions; idempotent.
-int32_t mr_collapse_window(MrBuiltWindow* g, int32_t auto_mode,
+// Query whether mr_build_window2 collapsed the trace axes (its
+// collapse_mode argument): returns 1 and fills out_true[i] with each
+// partition's TRUE trace count when kind-collapsed (mr_window_sizes then
+// reports the kind-COLUMN counts), 0 when the per-trace layout was kept.
+int32_t mr_collapse_window(const MrBuiltWindow* g, int32_t /*unused*/,
                            int64_t* out_true) {
-  if (g->parts[0].n_traces_true >= 0) {  // already collapsed
-    out_true[0] = g->parts[0].n_traces_true;
-    out_true[1] = g->parts[1].n_traces_true;
-    return 1;
-  }
-  const int64_t t_total = static_cast<int64_t>(g->parts[0].kind.size()) +
-                          static_cast<int64_t>(g->parts[1].kind.size());
-  const int64_t g_total = g->parts[0].n_groups + g->parts[1].n_groups;
-  if (auto_mode && g_total >= t_total) return 0;
-  try {
-    for (int i = 0; i < 2; ++i) {
-      collapse_partition(&g->parts[i]);
-      out_true[i] = g->parts[i].n_traces_true;
-    }
-  } catch (...) {
-    return -1;  // allocation failure — caller falls back to numpy
-  }
+  if (g->parts[0].n_traces_true < 0) return 0;
+  out_true[0] = g->parts[0].n_traces_true;
+  out_true[1] = g->parts[1].n_traces_true;
   return 1;
 }
 

@@ -192,7 +192,9 @@ class TableRCA:
     def _detect_window(self, table, w0: int, w1: int):
         """One window's detection via the shared seam
         (graph.table_ops.detect_window_partition — fused C++ scan with a
-        numpy fallback), with the SLO remap cached per run.
+        numpy fallback), with the SLO remap cached per run. Returns
+        (mask, nrm, abn, n_window, row_range) — the candidate row slice
+        makes per-window work O(window) on time-sorted tables.
         """
         from ..graph.table_ops import detect_window_partition
 
@@ -221,9 +223,12 @@ class TableRCA:
             thresh=self._thresh,
             pad_policy=cfg.runtime.pad_policy,
             min_pad=cfg.runtime.min_pad,
+            with_range=True,
         )
 
-    def prepare_rank(self, table, mask, nrm_codes, abn_codes):
+    def prepare_rank(
+        self, table, mask, nrm_codes, abn_codes, row_range=None
+    ):
         """Host half of a window rank: build the graph (pure host compute,
         no PJRT calls). Returns (graph, op_names, kernel) for
         ``launch_rank`` — the seam the async pipeline splits at."""
@@ -253,6 +258,7 @@ class TableRCA:
             aux=build_aux,
             dense_budget_bytes=cfg.runtime.dense_budget_bytes,
             collapse=cfg.runtime.collapse_kinds,
+            row_range=row_range,
         )
         if self._mesh is not None:
             if int(self._mesh.devices.shape[0]) != 1:
@@ -302,7 +308,9 @@ class TableRCA:
             )
         return top_idx, top_scores, n_valid, op_names
 
-    def dispatch_rank(self, table, mask, nrm_codes, abn_codes):
+    def dispatch_rank(
+        self, table, mask, nrm_codes, abn_codes, row_range=None
+    ):
         """Build one window's graph and dispatch its device rank program.
 
         Returns opaque handles (device arrays still in flight — jax
@@ -310,8 +318,20 @@ class TableRCA:
         to build the next window while the device executes this one.
         """
         return self.launch_rank(
-            *self.prepare_rank(table, mask, nrm_codes, abn_codes)
+            *self.prepare_rank(table, mask, nrm_codes, abn_codes, row_range)
         )
+
+    def _assign_topk(self, result, op_names, ti_row, ts_row, n, label):
+        """The one top-k -> WindowResult.ranking assignment (shared by
+        the chunked, batched and bulk lanes): slice by n_valid, map
+        through the op vocab, validate, zip."""
+        names = [op_names[int(i)] for i in ti_row[:n]]
+        scores = [float(s) for s in ts_row[:n]]
+        if self.config.runtime.validate_numerics:
+            from ..utils.guards import assert_finite_scores
+
+            assert_finite_scores(scores, label)
+        result.ranking = list(zip(names, scores))
 
     def finalize_rank_many(self, handles_list):
         """Force MANY dispatched ranks' results to host in ONE batched
@@ -459,12 +479,39 @@ class TableRCA:
                 "ordering of the batched allgather); streaming instead"
             )
             bulk = False
+        # Micro-batched dispatch (dispatch_batch_windows > 1): group K
+        # anomalous windows into ONE stacked stage+dispatch — one
+        # staging RPC per group instead of per window. Single-device,
+        # single-process, unchecked only.
+        chunk_n = max(1, int(cfg.runtime.dispatch_batch_windows))
+        if chunk_n > 1:
+            reason = None
+            if batch_windows:
+                reason = (
+                    "batch_windows=True already ranks every anomalous "
+                    "window in one dispatch"
+                )
+            elif self._mesh is not None:
+                reason = "a mesh is configured (sharded dispatch)"
+            elif jax.process_count() > 1:
+                reason = "multi-process runs need per-rank ordering"
+            elif cfg.runtime.device_checks:
+                reason = "device_checks has no batched checkify variant"
+            if reason is not None:
+                self.log.warning(
+                    "dispatch_batch_windows=%d ignored: %s; dispatching "
+                    "per window",
+                    chunk_n,
+                    reason,
+                )
+                chunk_n = 1
+
         stage_pool = fetch_pool = None
         if async_mode:
             from concurrent.futures import ThreadPoolExecutor
 
             stage_pool = ThreadPoolExecutor(1, "mr-stage")
-            if not bulk:  # bulk joins fetches itself, in batches
+            if not bulk and chunk_n == 1:  # bulk/chunked join in batches
                 fetch_pool = ThreadPoolExecutor(1, "mr-fetch")
 
         results: List[WindowResult] = []
@@ -492,11 +539,17 @@ class TableRCA:
             nonlocal emitted
             if sink is None or batch_windows:
                 return
-            # finishing entries are older than inflight entries.
+            # Oldest-first: finishing < inflight < chunk_pending (built
+            # but not yet dispatched groups also block emission).
             if finishing:
                 stop = id(finishing[0][0])
             elif inflight:
-                stop = id(inflight[0][0])
+                head = inflight[0][0]
+                stop = (
+                    id(head[0][0]) if isinstance(head, list) else id(head)
+                )
+            elif chunk_pending:
+                stop = id(chunk_pending[0][0])
             else:
                 stop = None
             while emitted < len(results):
@@ -535,6 +588,96 @@ class TableRCA:
                 names, scores = self.finalize_rank(handles)
             _set_ranking(result, timings, names, scores)
 
+        chunk_pending = []  # (result, graph, op_names, kernel, timings)
+
+        def _launch_chunk(items):
+            """Stage + dispatch one group of windows as a single stacked
+            vmapped program (runs on the stage worker in async mode —
+            PJRT calls only, the graphs are already built)."""
+            from ..parallel.sharded_rank import stack_window_graphs
+            from ..rank_backends.blob import stage_rank_windows_batched
+            from ..rank_backends.jax_tpu import device_subset
+
+            graphs = [g for _, g, _, _, _ in items]
+            kernels = {k for _, _, _, k, _ in items}
+            if len(kernels) == 1:
+                kern = kernels.pop()
+                stacked = stack_window_graphs(
+                    [device_subset(g, kern) for g in graphs]
+                )
+            else:
+                # Mixed per-window choices: re-resolve on the stacked
+                # views (stacking already degraded mixed aux families).
+                stacked = stack_window_graphs(graphs)
+                kern = choose_kernel(
+                    stacked,
+                    max(
+                        1,
+                        cfg.runtime.dense_budget_bytes // len(items),
+                    ),
+                    cfg.runtime.prefer_bf16,
+                )
+                stacked = device_subset(stacked, kern)
+            return stage_rank_windows_batched(
+                stacked,
+                cfg.pagerank,
+                cfg.spectrum,
+                kern,
+                cfg.runtime.blob_staging,
+            )
+
+        def _flush_chunk():
+            if not chunk_pending:
+                return
+            items = chunk_pending[:]
+            chunk_pending.clear()
+            handles = (
+                stage_pool.submit(_launch_chunk, items)
+                if stage_pool is not None
+                else _launch_chunk(items)
+            )
+            inflight.append((items, handles, None))
+
+        def _assign_chunk(items, ti, ts, nv, wait_ms_per_window):
+            for b, (result, _, names, _, timings) in enumerate(items):
+                self._assign_topk(
+                    result, names, ti[b], ts[b], int(nv[b]),
+                    "TableRCA chunked window",
+                )
+                result.timings = {
+                    **timings.as_dict(),
+                    "chunk_fetch_ms": round(wait_ms_per_window, 3),
+                    "chunk_windows": len(items),
+                }
+
+        def _finalize_chunk_one():
+            """Join the oldest dispatched group (one batched fetch)."""
+            items, handles, _ = inflight.pop(0)
+            h = handles.result() if hasattr(handles, "result") else handles
+            t0 = time.perf_counter()
+            ti, ts, nv = jax.device_get(h)
+            wait_ms = (time.perf_counter() - t0) * 1e3
+            _assign_chunk(items, ti, ts, nv, wait_ms / len(items))
+            _emit_ready()
+
+        def _flush_bulk_chunks():
+            """Join EVERY dispatched group in ONE batched device_get."""
+            if not inflight:
+                return
+            entries = inflight[:]
+            hs = [
+                e[1].result() if hasattr(e[1], "result") else e[1]
+                for e in entries
+            ]
+            t0 = time.perf_counter()
+            fetched = jax.device_get(tuple(hs))
+            wait_ms = (time.perf_counter() - t0) * 1e3
+            n_total = sum(len(e[0]) for e in entries)
+            for (items, _, _), (ti, ts, nv) in zip(entries, fetched):
+                _assign_chunk(items, ti, ts, nv, wait_ms / n_total)
+            inflight.clear()
+            _emit_ready()
+
         def _flush_bulk():
             """Join EVERY deferred window's results in one batched fetch
             (fetch_mode="bulk"). ALL rankings are assigned before
@@ -569,14 +712,19 @@ class TableRCA:
         loop_depth = (
             max(1, int(cfg.runtime.bulk_fetch_windows)) if bulk else depth
         )
-        finalize_cb = _flush_bulk if bulk else _finalize_one
+        if chunk_n > 1:
+            finalize_cb = (
+                _flush_bulk_chunks if bulk else _finalize_chunk_one
+            )
+        else:
+            finalize_cb = _flush_bulk if bulk else _finalize_one
 
         try:
             self._window_loop(
                 table, current, end, detect_us, skip_us, loop_depth,
                 batch_windows, results, pending, inflight, finishing,
                 next_cursor, stage_pool, finalize_cb, _complete_one,
-                _emit_ready,
+                _emit_ready, chunk_n, chunk_pending, _flush_chunk, bulk,
             )
         finally:
             if stage_pool is not None:
@@ -601,10 +749,19 @@ class TableRCA:
         self, table, current, end, detect_us, skip_us, depth,
         batch_windows, results, pending, inflight, finishing,
         next_cursor, stage_pool, _finalize_one, _complete_one,
-        _emit_ready,
+        _emit_ready, chunk_n=1, chunk_pending=None, _flush_chunk=None,
+        chunk_bulk=False,
     ):
         """The sliding-window detect/dispatch loop of run() (factored out
-        so the worker pools shut down on any exit path)."""
+        so the worker pools shut down on any exit path).
+
+        ``chunk_n > 1``: micro-batched dispatch — prepared windows gather
+        in ``chunk_pending`` and ``_flush_chunk`` stages each full group
+        as one stacked program. ``depth`` then bounds GROUPS in flight
+        (stream fetches — joining by windows would fetch every group
+        right after its own dispatch, losing the build/execute overlap)
+        or WINDOWS in flight (``chunk_bulk``, where depth is
+        bulk_fetch_windows and the join is one fetch of everything)."""
         cfg = self.config
         while current < end:
             w0, w1 = current, current + detect_us
@@ -613,7 +770,7 @@ class TableRCA:
             ranked = False
 
             with timings.stage("detect"):
-                mask, nrm, abn, n_window = self._detect_window(
+                mask, nrm, abn, n_window, row_range = self._detect_window(
                     table, w0, w1
                 )
             if n_window == 0:
@@ -631,19 +788,34 @@ class TableRCA:
                         nrm, abn = abn, nrm
                     ranked = True
                     if batch_windows:
-                        pending.append((result, mask, nrm, abn))
+                        pending.append((result, mask, nrm, abn, row_range))
+                    elif chunk_n > 1:
+                        with timings.stage("rank_dispatch"):
+                            graph, op_names, kernel = self.prepare_rank(
+                                table, mask, nrm, abn, row_range
+                            )
+                        chunk_pending.append(
+                            (result, graph, op_names, kernel, timings)
+                        )
+                        if len(chunk_pending) >= chunk_n:
+                            _flush_chunk()
+                        if chunk_bulk:
+                            if sum(len(e[0]) for e in inflight) >= depth:
+                                _finalize_one()
+                        elif len(inflight) > depth:
+                            _finalize_one()
                     else:
                         with timings.stage("rank_dispatch"):
                             if stage_pool is not None:
                                 prep = self.prepare_rank(
-                                    table, mask, nrm, abn
+                                    table, mask, nrm, abn, row_range
                                 )
                                 handles = stage_pool.submit(
                                     self.launch_rank, *prep
                                 )
                             else:
                                 handles = self.dispatch_rank(
-                                    table, mask, nrm, abn
+                                    table, mask, nrm, abn, row_range
                                 )
                         inflight.append((result, handles, timings))
                         if len(inflight) >= depth:
@@ -658,6 +830,8 @@ class TableRCA:
             next_cursor[id(result)] = current
             _emit_ready()
 
+        if chunk_n > 1 and chunk_pending:
+            _flush_chunk()  # dispatch the final partial group
         while inflight:
             _finalize_one()
         while finishing:
@@ -693,7 +867,7 @@ class TableRCA:
         per_device = -(-len(pending) // w_n)
         build_aux = aux_for_kernel(kernel, sharded=self._mesh is not None)
         with timings.stage("build"):
-            for _, mask, nrm, abn in pending:
+            for _, mask, nrm, abn, row_range in pending:
                 graph, _, _, _ = build_window_graph_from_table(
                     table, mask, nrm, abn,
                     pad_policy=cfg.runtime.pad_policy,
@@ -703,6 +877,7 @@ class TableRCA:
                         1, cfg.runtime.dense_budget_bytes // per_device
                     ),
                     collapse=cfg.runtime.collapse_kinds,
+                    row_range=row_range,
                 )
                 graphs.append(graph)
         with timings.stage("rank_batched"):
@@ -742,15 +917,11 @@ class TableRCA:
                 (top_idx, top_scores, n_valid)
             )
         shared = timings.as_dict()
-        for b, (result, _, _, _) in enumerate(pending):
-            n = int(n_valid[b])
-            names = [op_names[int(i)] for i in top_idx[b, :n]]
-            scores = [float(s) for s in top_scores[b, :n]]
-            if cfg.runtime.validate_numerics:
-                from ..utils.guards import assert_finite_scores
-
-                assert_finite_scores(scores, f"TableRCA batched window {b}")
-            result.ranking = list(zip(names, scores))
+        for b, (result, _, _, _, _) in enumerate(pending):
+            self._assign_topk(
+                result, op_names, top_idx[b], top_scores[b],
+                int(n_valid[b]), f"TableRCA batched window {b}",
+            )
             result.timings = {**result.timings, **shared}
 
 

@@ -622,3 +622,126 @@ def test_table_rca_resume_with_bulk_fetch(tmp_path):
     assert len(resumed) == len(first) - 1
     assert [r.start for r in resumed] == [r.start for r in first[1:]]
     assert [r.ranking for r in resumed] == [r.ranking for r in first[1:]]
+
+
+@pytest.mark.parametrize(
+    "chunk_n,fetch_mode,async_mode",
+    [
+        (2, "stream", True),   # partial final group (5 windows % 2)
+        (3, "bulk", True),
+        (4, "bulk", False),
+        (8, "stream", False),  # one group larger than the window count
+    ],
+)
+def test_chunked_dispatch_matches_per_window(
+    tmp_path, chunk_n, fetch_mode, async_mode
+):
+    """dispatch_batch_windows > 1 (micro-batched dispatch: one stacked
+    stage+rank per group) must reproduce the per-window rankings, emit
+    to the sink in window order, and handle partial final groups —
+    across stream/bulk x sync/async."""
+    import dataclasses
+
+    from microrank_tpu.config import RuntimeConfig, WindowConfig
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=40, n_kinds=8, n_traces=120, seed=5),
+        5,
+        [0, 2, 3, 4],
+    )
+    tl.normal.to_csv(tmp_path / "normal.csv", index=False)
+    tl.timeline.to_csv(tmp_path / "timeline.csv", index=False)
+    normal = load_span_table(tmp_path / "normal.csv")
+    timeline = load_span_table(tmp_path / "timeline.csv")
+
+    def run(rt, out_dir=None):
+        cfg = MicroRankConfig(
+            window=WindowConfig(
+                detect_minutes=tl.window_minutes, skip_minutes=0.0
+            ),
+            runtime=rt,
+        )
+        rca = TableRCA(cfg)
+        rca.fit_baseline(normal)
+        return rca.run(timeline, out_dir=out_dir)
+
+    base = run(RuntimeConfig(dispatch_batch_windows=1))
+    out = tmp_path / f"out_{chunk_n}_{fetch_mode}_{async_mode}"
+    got = run(
+        RuntimeConfig(
+            dispatch_batch_windows=chunk_n,
+            fetch_mode=fetch_mode,
+            async_dispatch=async_mode,
+        ),
+        out_dir=out,
+    )
+    assert [r.start for r in got] == [r.start for r in base]
+    assert [
+        [n for n, _ in r.ranking] if r.ranking else None for r in got
+    ] == [
+        [n for n, _ in r.ranking] if r.ranking else None for r in base
+    ]
+    # Sink emission is per window, in window order, all rankings present.
+    lines = [
+        json.loads(l)
+        for l in (out / "windows.jsonl").read_text().splitlines()
+    ]
+    assert [l["start"] for l in lines] == [r.start for r in got]
+    for rec in lines:
+        if rec["anomaly"] and not rec.get("skipped_reason"):
+            assert rec["ranking"], rec["start"]
+            assert "chunk_windows" in rec["timings"]
+
+
+def test_chunked_dispatch_demotes_with_warning(tmp_path, caplog):
+    """Conflicting modes (mesh / device_checks / batch_windows) demote
+    dispatch_batch_windows to per-window dispatch WITH a warning."""
+    import logging
+
+    from microrank_tpu.config import RuntimeConfig, WindowConfig
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=24, n_traces=80, seed=5), 2, [0, 1]
+    )
+    tl.normal.to_csv(tmp_path / "normal.csv", index=False)
+    tl.timeline.to_csv(tmp_path / "timeline.csv", index=False)
+    normal = load_span_table(tmp_path / "normal.csv")
+    timeline = load_span_table(tmp_path / "timeline.csv")
+
+    cfg = MicroRankConfig(
+        window=WindowConfig(
+            detect_minutes=tl.window_minutes, skip_minutes=0.0
+        ),
+        runtime=RuntimeConfig(
+            dispatch_batch_windows=4, device_checks=True
+        ),
+    )
+    rca = TableRCA(cfg)
+    rca.fit_baseline(normal)
+    with caplog.at_level(logging.WARNING):
+        res = rca.run(timeline)
+    assert any(
+        "dispatch_batch_windows" in rec.message for rec in caplog.records
+    )
+    assert any(r.ranking for r in res)
+
+    caplog.clear()
+    cfg2 = MicroRankConfig(
+        window=WindowConfig(
+            detect_minutes=tl.window_minutes, skip_minutes=0.0
+        ),
+        runtime=RuntimeConfig(dispatch_batch_windows=4),
+    )
+    rca2 = TableRCA(cfg2)
+    rca2.fit_baseline(normal)
+    with caplog.at_level(logging.WARNING):
+        rca2.run(timeline, batch_windows=True)
+    assert any(
+        "dispatch_batch_windows" in rec.message for rec in caplog.records
+    )
