@@ -229,6 +229,12 @@ def cmd_run(args) -> int:
             "sharded path); rerun with --engine native"
         )
         return 2
+    if getattr(args, "follow", False) and engine != "native":
+        log.error(
+            "--follow needs the native engine (the poll loop tails via "
+            "the C++ ingest); rerun with --engine native"
+        )
+        return 2
 
     # In a multi-process run every process executes the same pipeline —
     # the sharded TableRCA programs are collective; only rank 0 writes
@@ -264,6 +270,44 @@ def cmd_run(args) -> int:
             resume = False
         rca = TableRCA(cfg)
         rca.fit_baseline(load_span_table(args.normal, cache=primary))
+        if getattr(args, "follow", False):
+            # Online mode: tail the growing abnormal CSV, ranking
+            # windows as they close (pipeline.follow). The window
+            # cursor in out_dir makes polls and restarts incremental.
+            if multiprocess:
+                log.error(
+                    "--follow is single-process (the poll loop cannot "
+                    "synchronize collective window sequences)"
+                )
+                return 2
+            if out_dir is None:
+                log.error("--follow needs -o/--output (window cursor)")
+                return 2
+            from ..pipeline.follow import run_follow
+
+            def _print_batch(batch):
+                for r in batch:
+                    if r.ranking:
+                        print(f"window {r.start}:")
+                        for rank, (name, score) in enumerate(
+                            r.ranking, 1
+                        ):
+                            print(
+                                f"  {rank:2d}. {name:<50s} {score:.8f}"
+                            )
+
+            with trace_context(profile_dir):
+                n = run_follow(
+                    rca,
+                    args.abnormal,
+                    out_dir,
+                    poll_seconds=args.poll_seconds,
+                    grace_us=int(args.follow_grace_seconds * 1e6),
+                    idle_exit=args.follow_idle_exit or 0,
+                    on_results=_print_batch,
+                )
+            log.info("follow: %d windows ranked; results in %s", n, out_dir)
+            return 0
         with trace_context(profile_dir):
             results = rca.run(
                 load_span_table(args.abnormal, cache=primary),
@@ -363,6 +407,8 @@ def _report_dict(rep) -> dict:
     return {
         "recall_at": rep.recall_at,
         "exam_score": rep.exam_score,
+        # The paper's unnormalized Exam form (Tables 4-6 comparability).
+        "exam_score_paper": rep.exam_score_paper,
         "detection_rate": rep.detection_rate,
     }
 
@@ -518,6 +564,26 @@ def main(argv=None) -> int:
         "latency)",
     )
     p_run.add_argument(
+        "--follow", action="store_true",
+        help="online mode: tail the (growing) --abnormal CSV and rank "
+        "windows as they close; the window cursor in -o makes polls "
+        "and restarts incremental (native engine, single process)",
+    )
+    p_run.add_argument(
+        "--poll-seconds", type=float, default=5.0,
+        help="--follow: seconds between file polls",
+    )
+    p_run.add_argument(
+        "--follow-grace-seconds", type=float, default=0.0,
+        help="--follow: hold a window open this long past its end for "
+        "straggler spans before ranking it",
+    )
+    p_run.add_argument(
+        "--follow-idle-exit", type=_positive_int, default=None,
+        help="--follow: exit after this many consecutive polls without "
+        "file growth (default: follow forever)",
+    )
+    p_run.add_argument(
         "--distributed", action="store_true",
         help="join a multi-host jax.distributed runtime before any "
         "device work (coordinator from --coordinator or "
@@ -614,7 +680,14 @@ def main(argv=None) -> int:
 
 def _enable_jit_cache() -> None:
     """Persist compiled XLA programs across CLI invocations (first TPU
-    compile is tens of seconds; cached reloads are near-instant)."""
+    compile is seconds; cached reloads are near-instant — a second
+    process on the same config reports compile_ms ~ 0, see
+    tests/test_pipeline.py::test_persistent_compile_cache_across_processes).
+
+    The min-compile-time/min-entry-size gates are zeroed: jax's
+    defaults only persist compilations slower than 1 s, which would
+    skip most of this framework's windows-shaped programs and every
+    CPU run."""
     import os
 
     try:
@@ -628,6 +701,14 @@ def _enable_jit_cache() -> None:
         )
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
+        for knob, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ):
+            try:
+                jax.config.update(knob, value)
+            except AttributeError:  # older jax without the knob
+                pass
     except Exception:  # pragma: no cover - cache is best-effort
         pass
 

@@ -1,0 +1,151 @@
+"""Follow/tail mode: rank windows of a GROWING trace dump as they close.
+
+The reference's README documents a (historical) online loop over a live
+Elasticsearch backend (/root/reference/README.md:40-47); its current
+code — and this repo's batch mode — replay static CSV dumps. This
+module makes "online RCA" literal for the file-drop deployment shape:
+a collector (collect/clickhouse.py, or any exporter) appends spans to a
+CSV; ``follow_table`` polls the file, ingests what's new, and ranks
+every detection window that has CLOSED since the last poll, emitting
+results incrementally through the normal sink.
+
+Closure rule: a window [w0, w1) is ranked only once the ingest horizon
+(the newest span START seen, minus ``grace_us`` for stragglers) passes
+w1 — ``TableRCA.run(end_us=horizon, complete_only=True)``. The window
+cursor (pipeline.checkpoint) persists the NEXT window start across
+polls AND process restarts, so a crashed follower resumes exactly where
+it stopped — the same at-least-once semantics as batch resume.
+
+Ingest cost per poll: ``load_span_table`` re-parses the grown file WITH
+THE SIDECAR CACHE OFF — a write racing the parse could pin a sidecar
+whose recorded (mtime, size) matches the appended file but whose
+content predates the append, silently dropping the tail forever; and
+rewriting a full-table .npz every poll would be a second O(file) cost.
+A full re-parse per poll is O(file) — fine at the minutes-scale windows
+this mode targets; a byte-offset incremental parser is the known
+optimization if sub-second polls over multi-GB files are ever needed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Callable, Iterator, List, Optional
+
+from ..utils.logging import get_logger
+from .results import WindowResult
+
+log = get_logger("microrank_tpu.pipeline.follow")
+
+
+def follow_table(
+    rca,
+    path,
+    out_dir,
+    poll_seconds: float = 5.0,
+    grace_us: int = 0,
+    idle_exit: int = 0,
+    max_polls: int = 0,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Iterator[List[WindowResult]]:
+    """Tail ``path`` (a growing traces CSV) and yield each poll's NEWLY
+    ranked window results.
+
+    ``rca`` is a fitted TableRCA (``fit_baseline`` already called);
+    ``out_dir`` is REQUIRED — the window cursor lives there and is what
+    makes polls (and restarts) incremental. ``idle_exit`` > 0 stops
+    after that many consecutive polls without file growth (0 = follow
+    forever); ``max_polls`` bounds total polls (0 = unbounded).
+    ``sleep`` is injectable for tests.
+    """
+    from ..native import load_span_table
+
+    if out_dir is None:
+        raise ValueError(
+            "follow mode needs out_dir: the window cursor there is "
+            "what makes polls incremental"
+        )
+    path = Path(path)
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    last_size = -1
+    idle = 0
+    polls = 0
+    while True:
+        polls += 1
+        size = os.path.getsize(path) if path.exists() else -1
+        if size == last_size or size < 0:
+            idle += 1
+            if idle_exit and idle >= idle_exit:
+                log.info(
+                    "follow: no growth for %d polls; exiting", idle
+                )
+                return
+            if max_polls and polls >= max_polls:
+                return
+            sleep(poll_seconds)
+            continue
+        idle = 0
+        try:
+            table = load_span_table(path, cache=False)
+        except (ValueError, OSError) as exc:
+            # A torn final line (the collector flushed mid-row) parses
+            # as an error THIS poll and as valid data the next — a tail
+            # loop must retry, not die. last_size stays unchanged so
+            # the next poll re-reads even without further growth.
+            log.warning("follow: ingest failed (%s); retrying", exc)
+            if max_polls and polls >= max_polls:
+                return
+            sleep(poll_seconds)
+            continue
+        last_size = size
+        if table.n_spans == 0:
+            if max_polls and polls >= max_polls:
+                return
+            sleep(poll_seconds)
+            continue
+        horizon = int(table.start_us.max()) - int(grace_us)
+        new = rca.run(
+            table,
+            out_dir=out_dir,
+            resume=True,
+            end_us=horizon,
+            complete_only=True,
+        )
+        emitted = [r for r in new if r.ranking]
+        log.info(
+            "follow poll %d: %d bytes, horizon %s, %d windows scanned, "
+            "%d ranked",
+            polls, size, horizon, len(new), len(emitted),
+        )
+        yield new
+        if max_polls and polls >= max_polls:
+            return
+        sleep(poll_seconds)
+
+
+def run_follow(
+    rca,
+    path,
+    out_dir,
+    poll_seconds: float = 5.0,
+    grace_us: int = 0,
+    idle_exit: int = 0,
+    max_polls: int = 0,
+    on_results: Optional[Callable[[List[WindowResult]], None]] = None,
+) -> int:
+    """Drive follow_table to completion (the CLI entry): returns the
+    total number of ranked windows."""
+    ranked = 0
+    for batch in follow_table(
+        rca, path, out_dir,
+        poll_seconds=poll_seconds,
+        grace_us=grace_us,
+        idle_exit=idle_exit,
+        max_polls=max_polls,
+    ):
+        if on_results is not None:
+            on_results(batch)
+        ranked += sum(1 for r in batch if r.ranking)
+    return ranked

@@ -377,8 +377,17 @@ class TableRCA:
         sink: Optional[ResultSink] = None,
         batch_windows: bool = False,
         resume: bool = False,
+        end_us: Optional[int] = None,
+        complete_only: bool = False,
     ) -> List[WindowResult]:
         """Slide over the table; RCA every anomalous window.
+
+        ``end_us`` bounds the window loop (default: the table's last
+        span end); ``complete_only`` skips a final window that would
+        extend past that bound instead of ranking it partially — the
+        follow/tail mode's closure rule (pipeline.follow), where the
+        bound is the ingest horizon and a half-filled window must wait
+        for the next poll.
 
         ``batch_windows=True`` runs two-phase: detection decides the
         window advance rule (it alone does — ranking never feeds back into
@@ -424,6 +433,8 @@ class TableRCA:
         depth = max(1, int(cfg.runtime.pipeline_depth))
         current = int(table.start_us.min())
         end = int(table.end_us.max())
+        if end_us is not None:
+            end = min(end, int(end_us))
         if resume and cursor is not None:
             saved = cursor.load()
             if saved is not None:
@@ -725,6 +736,7 @@ class TableRCA:
                 batch_windows, results, pending, inflight, finishing,
                 next_cursor, stage_pool, finalize_cb, _complete_one,
                 _emit_ready, chunk_n, chunk_pending, _flush_chunk, bulk,
+                complete_only,
             )
         finally:
             if stage_pool is not None:
@@ -742,7 +754,14 @@ class TableRCA:
             for r in results:
                 _emit(r)
         if cursor is not None:
-            cursor.clear()
+            if end_us is not None or complete_only:
+                # Bounded runs (the follow/tail mode's polls) leave the
+                # cursor at the next unranked window so the next poll —
+                # or a restarted process — continues from there. The
+                # per-window saves above already advanced it.
+                pass
+            else:
+                cursor.clear()
         return results
 
     def _window_loop(
@@ -750,7 +769,7 @@ class TableRCA:
         batch_windows, results, pending, inflight, finishing,
         next_cursor, stage_pool, _finalize_one, _complete_one,
         _emit_ready, chunk_n=1, chunk_pending=None, _flush_chunk=None,
-        chunk_bulk=False,
+        chunk_bulk=False, complete_only=False,
     ):
         """The sliding-window detect/dispatch loop of run() (factored out
         so the worker pools shut down on any exit path).
@@ -763,7 +782,9 @@ class TableRCA:
         or WINDOWS in flight (``chunk_bulk``, where depth is
         bulk_fetch_windows and the join is one fetch of everything)."""
         cfg = self.config
-        while current < end:
+        while (
+            current + detect_us <= end if complete_only else current < end
+        ):
             w0, w1 = current, current + detect_us
             timings = StageTimings()
             result = WindowResult(start=_iso(w0), end=_iso(w1), anomaly=False)

@@ -1,0 +1,222 @@
+"""Generate the vendored OTel-demo-shaped trace fixture (run once; the
+CSVs are committed).
+
+Provenance: this environment has no network egress, so a genuine
+opentelemetry-demo ClickHouse dump cannot be fetched or recorded. This
+fixture is the honest offline substitute: spans shaped like the PUBLIC
+opentelemetry-demo architecture (frontend -> checkout -> payment /
+email / shipping -> quote, cart, product-catalog, recommendation, ad,
+currency — the well-known Astronomy-Shop call graph), exported in the
+EXACT raw ClickHouse CSV contract the reference's collect_data.py
+produces (`Timestamp, TraceId, SpanId, ParentSpanId, SpanName,
+ServiceName, PodName, Duration, SpanKind, TraceStart, TraceEnd`;
+Duration in microseconds, trace-level start/end datetimes), and
+carrying the REAL-DATA QUIRKS the synthetic perf generator never
+exercises:
+
+* rows shuffled out of time order (exports are not time-sorted);
+* ~2% orphan ParentSpanIds (parents sampled out of the export — both
+  the reference's merge linkage and our positional lookup must drop
+  the edge, not crash);
+* one duplicated SpanId across two different spans (IN THE NORMAL
+  WINDOW ONLY: the SLO baseline never reads linkage, so the documented
+  positional-vs-merge deviation — graph/build.py:22-26 — cannot
+  perturb the golden ranking comparison, while the loader still has to
+  survive the duplicate);
+* a SpanName containing a comma + quotes (CSV quoting path);
+* 128-bit hex TraceIds / 64-bit hex SpanIds, k8s-style pod names.
+
+The abnormal window injects +1800 ms into paymentservice Charge; the
+latency propagates up checkout -> frontend inclusively, exactly like a
+real payment outage. tests/test_reference_golden.py golden-tests the
+full detect -> partition -> rank pipeline on these files against the
+actual reference implementation.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+HERE = Path(__file__).parent
+
+# (service, operation) tree per trace kind: list of (idx, parent_idx,
+# service, span name). idx 0 is the root. Shapes follow the public
+# opentelemetry-demo (Astronomy Shop) request flows.
+KINDS = {
+    "home": [
+        (0, -1, "frontend", "GET /"),
+        (1, 0, "frontend", "grpc.oteldemo.ProductCatalogService/ListProducts"),
+        (2, 1, "productcatalogservice", "oteldemo.ProductCatalogService/ListProducts"),
+        (3, 0, "frontend", "grpc.oteldemo.RecommendationService/ListRecommendations"),
+        (4, 3, "recommendationservice", "oteldemo.RecommendationService/ListRecommendations"),
+        (5, 4, "productcatalogservice", "oteldemo.ProductCatalogService/GetProduct"),
+        (6, 0, "frontend", "grpc.oteldemo.AdService/GetAds"),
+        (7, 6, "adservice", "oteldemo.AdService/GetAds"),
+        (8, 0, "frontend", "grpc.oteldemo.CurrencyService/GetSupportedCurrencies"),
+        (9, 8, "currencyservice", "oteldemo.CurrencyService/GetSupportedCurrencies"),
+    ],
+    "product": [
+        (0, -1, "frontend", "GET /api/products/{id}"),
+        (1, 0, "productcatalogservice", "oteldemo.ProductCatalogService/GetProduct"),
+        (2, 0, "frontend", "grpc.oteldemo.RecommendationService/ListRecommendations"),
+        (3, 2, "recommendationservice", "oteldemo.RecommendationService/ListRecommendations"),
+        (4, 3, "productcatalogservice", "oteldemo.ProductCatalogService/GetProduct"),
+        (5, 0, "currencyservice", "oteldemo.CurrencyService/Convert"),
+        (6, 0, "adservice", "oteldemo.AdService/GetAds"),
+    ],
+    # The cart page also fetches a shipping estimate (shipping -> quote),
+    # like the demo's /api/shipping flow — so shipping/quote ops appear
+    # in a NON-checkout kind too, the way they do in the real system.
+    "cart": [
+        (0, -1, "frontend", "POST /api/cart"),
+        (1, 0, "cartservice", "oteldemo.CartService/AddItem"),
+        (2, 0, "productcatalogservice", "oteldemo.ProductCatalogService/GetProduct"),
+        (3, 0, "cartservice", "oteldemo.CartService/GetCart"),
+        (4, 0, "shippingservice", "oteldemo.ShippingService/GetQuote"),
+        (5, 4, "quoteservice", "CalculateQuote"),
+        (6, 0, "currencyservice", "oteldemo.CurrencyService/Convert"),
+    ],
+    # The SpanName with a comma exercises CSV quoting end to end.
+    "compare": [
+        (0, -1, "frontend", 'GET /api/products?ids=1,2,3'),
+        (1, 0, "productcatalogservice", "oteldemo.ProductCatalogService/GetProduct"),
+        (2, 0, "currencyservice", "oteldemo.CurrencyService/Convert"),
+    ],
+    "checkout": [
+        (0, -1, "frontend", "POST /api/checkout"),
+        (1, 0, "checkoutservice", "oteldemo.CheckoutService/PlaceOrder"),
+        (2, 1, "cartservice", "oteldemo.CartService/GetCart"),
+        (3, 1, "productcatalogservice", "oteldemo.ProductCatalogService/GetProduct"),
+        (4, 1, "currencyservice", "oteldemo.CurrencyService/Convert"),
+        (5, 1, "shippingservice", "oteldemo.ShippingService/GetQuote"),
+        (6, 5, "quoteservice", "CalculateQuote"),
+        (7, 1, "paymentservice", "oteldemo.PaymentService/Charge"),
+        (8, 1, "emailservice", "POST /send_order_confirmation"),
+        (9, 1, "shippingservice", "oteldemo.ShippingService/ShipOrder"),
+        (10, 1, "cartservice", "oteldemo.CartService/EmptyCart"),
+    ],
+}
+
+KIND_WEIGHTS = {"home": 0.3, "product": 0.3, "cart": 0.15,
+                "compare": 0.05, "checkout": 0.2}
+
+# Mean own-time (ms) per service (lognormal sigma 0.35 around these).
+MEAN_OWN_MS = {
+    "frontend": 4.0, "productcatalogservice": 2.0,
+    "recommendationservice": 3.0, "adservice": 2.5,
+    "currencyservice": 1.0, "cartservice": 2.0, "checkoutservice": 5.0,
+    "shippingservice": 2.5, "quoteservice": 1.5, "paymentservice": 6.0,
+    "emailservice": 4.0,
+}
+
+POD = {
+    s: f"{s}-{h}"
+    for s, h in {
+        "frontend": "7d9f8c6b5-x2v4q",
+        "productcatalogservice": "5f6d8b9c44-mq7zl",
+        "recommendationservice": "6c8d7f9b55-kp3wn",
+        "adservice": "84c5f6d7e8-rt2vx",
+        "currencyservice": "9b8a7c6d5e-fh4jk",
+        "cartservice": "4e5f6a7b8c-zw9qm",
+        "checkoutservice": "7a8b9c0d1e-ns6tp",
+        "shippingservice": "2c3d4e5f6a-gb8vr",
+        "quoteservice": "8d9e0f1a2b-lm5cx",
+        "paymentservice": "3f4a5b6c7d-qy7hz",
+        "emailservice": "5a6b7c8d9e-dk2jw",
+    }.items()
+}
+
+FAULT_SERVICE = "paymentservice"
+FAULT_LATENCY_MS = 1800.0
+
+
+def _hex(rng: np.random.Generator, n: int) -> str:
+    return "".join(rng.choice(list("0123456789abcdef"), size=n))
+
+
+def _render_window(
+    rng: np.random.Generator,
+    n_traces: int,
+    t0: pd.Timestamp,
+    window_minutes: float,
+    faulted: bool,
+) -> pd.DataFrame:
+    kinds = list(KINDS)
+    probs = np.array([KIND_WEIGHTS[k] for k in kinds])
+    rows = []
+    offsets = np.sort(rng.uniform(0, window_minutes * 60e6, size=n_traces))
+    for ti in range(n_traces):
+        kind = kinds[int(rng.choice(len(kinds), p=probs))]
+        tree = KINDS[kind]
+        trace_id = _hex(rng, 32)
+        span_ids = [_hex(rng, 16) for _ in tree]
+        own_ms = np.array(
+            [
+                rng.lognormal(np.log(MEAN_OWN_MS[svc]), 0.35)
+                for _, _, svc, _ in tree
+            ]
+        )
+        if faulted:
+            for i, (_, _, svc, _) in enumerate(tree):
+                if svc == FAULT_SERVICE:
+                    own_ms[i] += FAULT_LATENCY_MS
+        # Inclusive durations: deepest-first accumulation into parents.
+        dur_ms = own_ms.copy()
+        for i in range(len(tree) - 1, 0, -1):
+            dur_ms[tree[i][1]] += dur_ms[i]
+        start_us = int(offsets[ti])
+        trace_start = t0 + pd.Timedelta(microseconds=start_us)
+        trace_end = trace_start + pd.Timedelta(
+            microseconds=float(dur_ms[0]) * 1000.0
+        )
+        for i, (idx, parent, svc, name) in enumerate(tree):
+            parent_id = span_ids[parent] if parent >= 0 else ""
+            # ~2% orphan parents: the parent span was sampled out of the
+            # export — the id exists but its row does not.
+            if parent >= 0 and rng.random() < 0.02:
+                parent_id = _hex(rng, 16)
+            rows.append(
+                {
+                    "Timestamp": trace_start,
+                    "TraceId": trace_id,
+                    "SpanId": span_ids[i],
+                    "ParentSpanId": parent_id,
+                    "SpanName": name,
+                    "ServiceName": svc,
+                    "PodName": POD[svc],
+                    "Duration": int(round(dur_ms[i] * 1000.0)),  # µs
+                    "SpanKind": "Server" if parent < 0 else "Client",
+                    "TraceStart": trace_start,
+                    "TraceEnd": trace_end,
+                }
+            )
+    df = pd.DataFrame(rows)
+    # Exports are not time-ordered: shuffle.
+    return df.sample(frac=1.0, random_state=int(rng.integers(1 << 31)))
+
+
+def main() -> None:
+    rng = np.random.default_rng(20260730)
+    t0 = pd.Timestamp("2026-03-01 09:00:00")
+    t1 = t0 + pd.Timedelta(minutes=5)
+    normal = _render_window(rng, 260, t0, 5.0, faulted=False)
+    # Duplicate SpanId across two DIFFERENT spans, normal window only
+    # (SLO reads no linkage, so this exercises the loader's documented
+    # positional-match deviation without touching the ranked window).
+    dup = normal.iloc[0].copy()
+    victim = normal.index[5]
+    normal.loc[victim, "SpanId"] = dup["SpanId"]
+    abnormal = _render_window(rng, 260, t1, 5.0, faulted=True)
+    normal.to_csv(HERE / "normal.csv", index=False)
+    abnormal.to_csv(HERE / "abnormal.csv", index=False)
+    print(
+        f"wrote {len(normal)} normal + {len(abnormal)} abnormal spans; "
+        f"fault: {POD[FAULT_SERVICE]}_{FAULT_SERVICE and 'oteldemo.PaymentService/Charge'}"
+    )
+
+
+if __name__ == "__main__":
+    main()

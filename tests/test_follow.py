@@ -1,0 +1,169 @@
+"""Follow/tail mode (pipeline.follow): rank windows of a growing CSV as
+they close, with cursor-checkpointed restarts — the "online RCA" the
+reference's README advertises (README.md:40-47) made literal.
+"""
+
+import json
+from pathlib import Path
+
+import pandas as pd
+import pytest
+
+from microrank_tpu.config import MicroRankConfig, RuntimeConfig, WindowConfig
+from microrank_tpu.native import load_span_table
+from microrank_tpu.pipeline.follow import follow_table, run_follow
+from microrank_tpu.pipeline.table_runner import TableRCA
+from microrank_tpu.testing import SyntheticConfig
+from microrank_tpu.testing.synthetic import generate_timeline
+
+
+@pytest.fixture(scope="module")
+def timeline():
+    return generate_timeline(
+        SyntheticConfig(n_operations=40, n_kinds=8, n_traces=120, seed=5),
+        4,
+        [0, 1, 2, 3],
+    )
+
+
+def _window_frame(tl, w):
+    w0 = tl.start + pd.Timedelta(minutes=w * tl.window_minutes)
+    w1 = w0 + pd.Timedelta(minutes=tl.window_minutes)
+    df = tl.timeline
+    return df[(df["startTime"] >= w0) & (df["startTime"] < w1)]
+
+
+def _rca(tl, tmp_path):
+    cfg = MicroRankConfig(
+        window=WindowConfig(
+            detect_minutes=tl.window_minutes, skip_minutes=0.0
+        ),
+        # Ingest caching off in the poll loop tests: every poll reloads
+        # a grown file anyway, and sidecars would litter tmp_path.
+        runtime=RuntimeConfig(),
+    )
+    rca = TableRCA(cfg)
+    normal_csv = tmp_path / "normal.csv"
+    if not normal_csv.exists():
+        tl.normal.to_csv(normal_csv, index=False)
+    rca.fit_baseline(load_span_table(normal_csv))
+    return rca
+
+
+def test_follow_ranks_windows_incrementally(timeline, tmp_path):
+    """Appending spans while the follower polls emits each newly CLOSED
+    window exactly once, in order, through the normal sink."""
+    tl = timeline
+    csv = tmp_path / "stream.csv"
+    out = tmp_path / "out"
+    # Two complete windows + the third one's spans up to its middle:
+    # only windows 0 and 1 have closed (the horizon is the newest span
+    # start, inside window 2).
+    w0, w1, w2, w3 = (_window_frame(tl, w) for w in range(4))
+    pd.concat([w0, w1, w2]).to_csv(csv, index=False)
+
+    rca = _rca(tl, tmp_path)
+    polls = follow_table(
+        rca, csv, out, poll_seconds=0.0, idle_exit=1, sleep=lambda s: None
+    )
+    first = next(polls)
+    starts1 = [r.start for r in first if r.ranking]
+    assert len(starts1) == 2  # windows 0 and 1 closed; 2 still open
+
+    # The stream grows: window 3 arrives, closing window 2 (horizon
+    # moves into window 3).
+    pd.concat([w0, w1, w2, w3]).to_csv(csv, index=False)
+    second = next(polls)
+    starts2 = [r.start for r in second if r.ranking]
+    assert len(starts2) == 1  # ONLY window 2 — no re-ranking of 0/1
+    assert starts2[0] not in starts1
+
+    # No growth -> idle_exit stops the generator.
+    with pytest.raises(StopIteration):
+        next(polls)
+
+    # The sink saw every ranked window once, in window order.
+    lines = [
+        json.loads(l)
+        for l in (out / "windows.jsonl").read_text().splitlines()
+    ]
+    ranked = [l["start"] for l in lines if l["ranking"]]
+    assert ranked == starts1 + starts2
+    assert len(set(ranked)) == len(ranked)
+    # Every faulted closed window names the injected fault top-1.
+    for l in lines:
+        if l["ranking"]:
+            assert l["ranking"][0][0] == tl.fault_pod_op
+
+
+def test_follow_restart_resumes_from_cursor(timeline, tmp_path):
+    """A NEW follower process (fresh TableRCA) over the same out_dir
+    picks up at the cursor instead of re-ranking from the start."""
+    tl = timeline
+    csv = tmp_path / "stream.csv"
+    out = tmp_path / "out"
+    w0, w1, w2, w3 = (_window_frame(tl, w) for w in range(4))
+    pd.concat([w0, w1, w2]).to_csv(csv, index=False)
+
+    rca1 = _rca(tl, tmp_path)
+    n1 = run_follow(rca1, csv, out, poll_seconds=0.0, max_polls=1)
+    assert n1 == 2
+
+    # "Crash"; the file grows; a fresh process follows the same out dir.
+    pd.concat([w0, w1, w2, w3]).to_csv(csv, index=False)
+    rca2 = _rca(tl, tmp_path)
+    n2 = run_follow(rca2, csv, out, poll_seconds=0.0, max_polls=1)
+    assert n2 == 1  # only the newly closed window — no duplicates
+
+    lines = [
+        json.loads(l)
+        for l in (out / "windows.jsonl").read_text().splitlines()
+    ]
+    ranked = [l["start"] for l in lines if l["ranking"]]
+    assert len(ranked) == 3
+    assert ranked == sorted(ranked)
+
+
+def test_follow_requires_out_dir(timeline, tmp_path):
+    tl = timeline
+    csv = tmp_path / "stream.csv"
+    _window_frame(tl, 0).to_csv(csv, index=False)
+    rca = _rca(tl, tmp_path)
+    with pytest.raises(ValueError, match="out_dir"):
+        next(follow_table(rca, csv, None, poll_seconds=0.0))
+
+
+def test_follow_cli_flag(timeline, tmp_path):
+    """`run --follow --follow-idle-exit 1` drives the same loop end to
+    end through the CLI."""
+    from microrank_tpu.cli.main import main
+
+    tl = timeline
+    csv = tmp_path / "stream.csv"
+    out = tmp_path / "cli_out"
+    normal_csv = tmp_path / "normal.csv"
+    tl.normal.to_csv(normal_csv, index=False)
+    pd.concat(
+        [_window_frame(tl, 0), _window_frame(tl, 1)]
+    ).to_csv(csv, index=False)
+
+    rc = main(
+        [
+            "run",
+            "--normal", str(normal_csv),
+            "--abnormal", str(csv),
+            "-o", str(out),
+            "--follow",
+            "--poll-seconds", "0",
+            "--follow-idle-exit", "1",
+            "--detect-minutes", str(tl.window_minutes),
+            "--skip-minutes", "0",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        json.loads(l)
+        for l in (out / "windows.jsonl").read_text().splitlines()
+    ]
+    assert sum(1 for l in lines if l["ranking"]) == 1  # window 0 closed
+    assert (out / "cursor.json").exists()
