@@ -367,21 +367,14 @@ def build_window_graph_from_table(
                 af[acodes] = 1
             sub_mask = mask  # slice-local (normalized above)
             full = bool(np.all(sub_mask))
-            if (lo, hi) == (0, table.n_spans):
-                parent_in = table.parent_row
-            else:
-                # Slice-local parent rows; parents outside the slice
-                # cannot be window rows, so -1 them (the C++ mask check
-                # covers in-slice parents outside the window).
-                p = table.parent_row[lo:hi]
-                parent_in = np.where(
-                    (p >= lo) & (p < hi), p - lo, np.int64(-1)
-                )
             try:
+                # parent_row stays ABSOLUTE; the C++ scan subtracts
+                # parent_base and bounds-checks — parents outside the
+                # slice drop their edge (they cannot be window rows).
                 raw_n, raw_a = build_window_padded(
                     table.pod_op[lo:hi],
                     table.trace_id[lo:hi],
-                    parent_in,
+                    table.parent_row[lo:hi],
                     None if full else sub_mask,
                     nf,
                     af,
@@ -391,6 +384,7 @@ def build_window_graph_from_table(
                     native_mode,
                     collapse=collapse,
                     dense_budget_bytes=dense_budget_bytes,
+                    parent_base=lo,
                 )
             except NativeUnavailable:
                 raw_n = raw_a = None  # fall through to the numpy lane

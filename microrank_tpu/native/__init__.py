@@ -136,6 +136,7 @@ def _load_library() -> ctypes.CDLL:
         ctypes.c_int64,  # n_total_traces
         ctypes.c_int64,  # vocab_size
         ctypes.c_int32,  # collapse_mode (0 off / 1 auto / 2 on)
+        ctypes.c_int64,  # parent_base (slice offset for parent_row)
     ]
     lib.mr_window_sizes.restype = None
     lib.mr_window_sizes.argtypes = [ctypes.c_void_p, i64p]
@@ -455,6 +456,7 @@ def build_window_padded(
     mode: str = "none",
     collapse: str = "off",
     dense_budget_bytes: Optional[int] = None,
+    parent_base: int = 0,
 ) -> Tuple[PaddedPartition, PaddedPartition]:
     """Build both partitions' COO graphs in C++ (fused single scans),
     exported directly into padded numpy buffers (single copy).
@@ -473,6 +475,12 @@ def build_window_padded(
     ``collapse`` ("off" | "auto" | "on"): kind-collapse the trace axes in
     C++ (mr_collapse_window — the native twin of
     graph.build.collapse_window_graph, array-identical outputs).
+
+    ``parent_base``: subtracted from each parent_row entry inside the
+    C++ scan — callers passing a [lo, hi) table slice hand the ABSOLUTE
+    parent rows plus lo instead of remapping in numpy (the O(window)
+    np.where cost more than the whole build). Out-of-range parents drop
+    their edge, same as -1.
     """
     if mode not in ("packed", "csr", "all", "none", "auto", "auto_all"):
         raise ValueError(f"unknown aux mode {mode!r}")
@@ -510,6 +518,7 @@ def build_window_padded(
         # emit — the per-trace entry arrays are never materialized);
         # mr_collapse_window below then just reports the true counts.
         ctypes.c_int32({"off": 0, "auto": 1, "on": 2}[collapse]),
+        ctypes.c_int64(int(parent_base)),
     )
     if not handle:
         raise NativeUnavailable("mr_build_window2 allocation failed")

@@ -468,13 +468,20 @@ extern "C" {
 // combined trace axis shrinks (graph/build.py collapse="auto"), 2 =
 // always collapse. Collapsing happens BEFORE the incidence emit, so the
 // per-trace entry arrays are never materialized.
+//
+// ``parent_base``: value subtracted from each parent_row entry to map it
+// into this call's row space (callers passing a [lo, hi) table slice
+// hand the ABSOLUTE parent rows + lo; remapping inline here replaced an
+// O(window) numpy pass that cost more than the whole build). Out-of-
+// range results — absent parents (-1 absolute) and parents outside the
+// slice — drop the edge exactly like the old -1 convention.
 MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
                                 const int64_t* parent_row, int64_t n_rows,
                                 const uint8_t* row_mask,
                                 const uint8_t* normal_flag,
                                 const uint8_t* abnormal_flag,
                                 int64_t n_total_traces, int64_t vocab_size,
-                                int32_t collapse_mode) {
+                                int32_t collapse_mode, int64_t parent_base) {
   MrBuiltWindow* g = nullptr;
   try {
     g = new MrBuiltWindow();
@@ -524,7 +531,7 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
       const uint8_t code = part_bit[t];
       if (!code) continue;
       const int32_t op = pod_op[r];
-      const int64_t pr = parent_row[r];
+      const int64_t pr = parent_row[r] - parent_base;
       const auto record_edge = [&](PartScratch& s, int32_t child,
                                    int32_t parent) {
         ++s.outdeg_dup[parent];
@@ -544,7 +551,7 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
         ++s.counts_global[t];
         ++s.cov_dup[op];
         ++s.n_p;
-        if (pr >= 0 && (!row_mask || row_mask[pr]) &&
+        if (pr >= 0 && pr < n_rows && (!row_mask || row_mask[pr]) &&
             (part_bit[trace_id[pr]] & code)) {
           record_edge(s, op, pod_op[pr]);
         }
@@ -553,7 +560,7 @@ MrBuiltWindow* mr_build_window2(const int32_t* pod_op, const int32_t* trace_id,
       // Rare: a caller listed the trace in BOTH partitions.
       uint8_t ecode = 0;
       int32_t pop = 0;
-      if (pr >= 0 && (!row_mask || row_mask[pr])) {
+      if (pr >= 0 && pr < n_rows && (!row_mask || row_mask[pr])) {
         ecode = static_cast<uint8_t>(code & part_bit[trace_id[pr]]);
         pop = pod_op[pr];
       }
