@@ -1,0 +1,39 @@
+"""``mrlint`` — repo-specific TPU-correctness static analysis.
+
+The failure modes that actually ship in JAX/TPU code are invisible to a
+value-level test suite until they cost a cliff on real hardware: a
+``float()`` on a traced value that forces a host sync inside a jit
+region, a stray ``np.float64`` scalar that silently upcasts the bf16
+ranking path, a ``jax.jit`` rebuilt per call that recompiles forever, a
+donated buffer read after dispatch. ``mrlint`` machine-checks these as
+*invariants* of this codebase (they were previously conventions buried
+in SURVEY.md §5):
+
+  R1 host-sync     no np.*/float()/int()/bool()/.item() on traced values
+                   inside jit/pjit/shard_map call graphs
+  R2 dtype-drift   no float64 dtypes in jax-importing ranking modules
+                   (the bf16/f32 device path must not silently upcast)
+  R3 retrace       no jax.jit built per call without a cache; no Python
+                   branch on a traced value; no unhashable static args
+  R4 donation      no read of a buffer after it was passed in a donated
+                   argument position
+  R5 contracts     public rank/spectrum entry points carry @contract
+                   shape/dtype annotations (analysis.contracts)
+
+Run it::
+
+    python -m microrank_tpu.cli lint [paths...]     # exit 1 on findings
+
+or as the pytest-collected suite ``tests/test_mrlint.py`` (tier-1).
+Suppress a finding on its line (justification required)::
+
+    x = float(tr)  # mrlint: disable=R1(host scalar needed for logging)
+
+The escape hatch is itself linted: a bare ``disable=R1`` without a
+reason is reported as R0.
+"""
+
+from .core import RULES, Violation, lint_paths, lint_source  # noqa: F401
+from . import rules  # noqa: F401  (imports register the rule set)
+
+__all__ = ["RULES", "Violation", "lint_paths", "lint_source"]

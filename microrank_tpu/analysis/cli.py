@@ -1,0 +1,60 @@
+"""``python -m microrank_tpu.cli lint`` — the mrlint command surface."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def add_lint_parser(sub) -> None:
+    p = sub.add_parser(
+        "lint",
+        help="TPU-correctness static analysis (mrlint rules R1-R5)",
+        description=(
+            "AST lint of the repo's TPU invariants: host syncs inside "
+            "jit graphs (R1), float64 drift on the bf16 ranking path "
+            "(R2), recompilation hazards (R3), donated-buffer reuse "
+            "(R4), missing shape/dtype contracts on rank/spectrum "
+            "entry points (R5). Suppress a finding in place with "
+            "`# mrlint: disable=RN(reason)` — the reason is mandatory."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["microrank_tpu"],
+        help="files or directories to lint (default: microrank_tpu/)",
+    )
+    p.add_argument(
+        "--rules",
+        help="comma-separated subset to run (e.g. R1,R3); default all",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.set_defaults(fn=cmd_lint)
+
+
+def cmd_lint(args) -> int:
+    from . import RULES, lint_paths
+
+    if args.list_rules:
+        width = max(len(r.name) for r in RULES.values())
+        for rule in sorted(RULES.values(), key=lambda r: r.name):
+            print(f"{rule.name:<{width}}  [{rule.slug}] {rule.summary}")
+        return 0
+    rules: List[str] | None = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}")
+            return 2
+    violations = lint_paths(args.paths, rules=rules)
+    for v in violations:
+        print(v.format())
+    n = len(violations)
+    if n:
+        print(f"mrlint: {n} finding{'s' if n != 1 else ''}")
+        return 1
+    print("mrlint: clean")
+    return 0

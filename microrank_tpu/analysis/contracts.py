@@ -1,0 +1,260 @@
+"""Shape/dtype contracts for the rank/spectrum entry points (mrlint R5).
+
+A contract is a declarative spec attached to a function::
+
+    @contract(
+        graph="windowgraph",
+        returns=("int32[K]", "float32[K]", "int32[]"),
+    )
+    def rank_window_core(graph, pagerank_cfg, spectrum_cfg, ...): ...
+
+Spec grammar (strings, parsed at import time so typos fail fast):
+
+* ``"float32[K]"``   — dtype + symbolic dims; same letter must unify to
+  the same extent across the whole signature (``K`` here ties the two
+  return vectors together);
+* ``"int32[]"``      — 0-d scalar array;
+* ``"uint32[N]"``    — any one axis, bound to ``N``;
+* ``"float32[*]"``   — dtype checked, rank/shape free;
+* ``"windowgraph"``  — a ``WindowGraph``: every field of both partitions
+  is dtype-checked against the layout in graph/structures.py (the
+  host<->device data contract), shapes free (padding varies);
+* ``"any"``          — presence only.
+
+Checks run on ``.shape``/``.dtype`` ONLY — never on values — so they
+are trace-compatible: under ``jax.jit`` the wrapper executes once per
+compilation (trace time) against abstract tracers and costs nothing per
+cached call; on host arrays it validates eagerly. Enabled via
+``utils.guards.contract_checks`` (the backends gate it on
+``RuntimeConfig.validate_numerics``); disabled, the wrapper is a few
+nanoseconds of flag check.
+
+Violations raise :class:`microrank_tpu.utils.guards.ContractError`.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple, Union
+
+from ..utils.guards import ContractError, contracts_enabled
+
+_SPEC_RE = re.compile(r"^([a-z0-9_]+)(?:\[([A-Za-z0-9_,* ]*)\])?$")
+
+# The canonical field dtypes of a PartitionGraph (graph/structures.py) —
+# the host<->device data contract the builders, blob codec and kernels
+# all assume. n_* dynamic extents are int32 0-d; bitmaps uint8.
+PARTITION_FIELD_DTYPES: Dict[str, str] = {
+    "inc_op": "int32",
+    "inc_trace": "int32",
+    "sr_val": "float32",
+    "rs_val": "float32",
+    "ss_child": "int32",
+    "ss_parent": "int32",
+    "ss_val": "float32",
+    "inc_trace_opmajor": "int32",
+    "sr_val_opmajor": "float32",
+    "inc_indptr_op": "int32",
+    "inc_indptr_trace": "int32",
+    "ss_indptr": "int32",
+    "cov_bits": "uint8",
+    "ss_bits": "uint8",
+    "inv_tracelen": "float32",
+    "inv_cov_dup": "float32",
+    "inv_outdeg": "float32",
+    "kind": "int32",
+    "tracelen": "int32",
+    "cov_unique": "int32",
+    "op_present": "bool",
+    "n_ops": "int32",
+    "n_traces": "int32",
+    "n_inc": "int32",
+    "n_ss": "int32",
+    "n_cols": "int32",
+}
+
+
+@dataclass(frozen=True)
+class ArraySpec:
+    dtype: Optional[str]                       # None = any dtype
+    dims: Optional[Tuple[Union[str, int], ...]]  # None = any rank; () = 0-d
+
+    def describe(self) -> str:
+        if self.dims is None:
+            d = "[*]"
+        else:
+            d = "[" + ",".join(str(x) for x in self.dims) + "]"
+        return f"{self.dtype or 'any'}{d}"
+
+
+@dataclass(frozen=True)
+class GraphSpec:
+    """Dtype contract over every field of a WindowGraph's partitions."""
+
+
+@dataclass(frozen=True)
+class AnySpec:
+    pass
+
+
+Spec = Union[ArraySpec, GraphSpec, AnySpec]
+
+
+def parse_spec(text: str) -> Spec:
+    t = text.strip()
+    if t.lower() == "any":
+        return AnySpec()
+    if t.lower() == "windowgraph":
+        return GraphSpec()
+    m = _SPEC_RE.match(t)
+    if not m:
+        raise ValueError(f"unparseable contract spec {text!r}")
+    dtype, dims_text = m.group(1).lower(), m.group(2)
+    if dims_text is None:
+        return ArraySpec(dtype=dtype, dims=None)
+    dims_text = dims_text.strip()
+    if dims_text == "*":
+        return ArraySpec(dtype=dtype, dims=None)
+    if not dims_text:
+        return ArraySpec(dtype=dtype, dims=())
+    dims: list = []
+    for part in dims_text.split(","):
+        part = part.strip()
+        dims.append(int(part) if part.isdigit() else part)
+    return ArraySpec(dtype=dtype, dims=tuple(dims))
+
+
+def _dtype_name(value) -> Optional[str]:
+    dt = getattr(value, "dtype", None)
+    return None if dt is None else str(dt)
+
+
+def check_value(value, spec: Spec, where: str, env: Dict[str, int]) -> None:
+    """Validate one value against one spec, unifying symbolic dims into
+    ``env``. Raises ContractError with the argument/return path named."""
+    if isinstance(spec, AnySpec):
+        return
+    if isinstance(spec, GraphSpec):
+        parts = getattr(value, "_fields", None)
+        if parts != ("normal", "abnormal"):
+            raise ContractError(
+                f"{where}: expected a WindowGraph, got {type(value).__name__}"
+            )
+        for pname in ("normal", "abnormal"):
+            part = getattr(value, pname)
+            for fname, want in PARTITION_FIELD_DTYPES.items():
+                field = getattr(part, fname, None)
+                if field is None:
+                    continue
+                got = _dtype_name(field)
+                if got != want:
+                    raise ContractError(
+                        f"{where}.{pname}.{fname}: dtype {got} != "
+                        f"contract {want} (the host<->device graph "
+                        "layout in graph/structures.py)"
+                    )
+        return
+    got_dtype = _dtype_name(value)
+    if got_dtype is None:
+        raise ContractError(
+            f"{where}: expected an array ({spec.describe()}), got "
+            f"{type(value).__name__}"
+        )
+    if spec.dtype is not None and got_dtype != spec.dtype:
+        raise ContractError(
+            f"{where}: dtype {got_dtype} != contract {spec.describe()}"
+        )
+    if spec.dims is None:
+        return
+    shape = tuple(getattr(value, "shape", ()))
+    if len(shape) != len(spec.dims):
+        raise ContractError(
+            f"{where}: rank {len(shape)} (shape {shape}) != contract "
+            f"{spec.describe()}"
+        )
+    for axis, (dim, extent) in enumerate(zip(spec.dims, shape)):
+        if isinstance(dim, int):
+            if extent != dim:
+                raise ContractError(
+                    f"{where}: axis {axis} has extent {extent} != "
+                    f"contract {spec.describe()}"
+                )
+        else:
+            bound = env.setdefault(dim, int(extent))
+            if bound != int(extent):
+                raise ContractError(
+                    f"{where}: axis {axis} extent {extent} conflicts "
+                    f"with {dim}={bound} bound elsewhere in the "
+                    "signature"
+                )
+
+
+def contract(returns=None, **arg_specs):
+    """Attach (and, when enabled, enforce) a shape/dtype contract.
+
+    ``arg_specs`` map parameter names to spec strings; ``returns`` is a
+    spec string or tuple of them (matched elementwise against a tuple
+    result). Parsed at decoration time; enforced only under
+    ``utils.guards.contract_checks(True)`` — which the backends enter
+    when ``RuntimeConfig.validate_numerics`` is on.
+    """
+    parsed_args = {k: parse_spec(v) for k, v in arg_specs.items()}
+    parsed_returns = None
+    if returns is not None:
+        if isinstance(returns, (tuple, list)):
+            parsed_returns = tuple(parse_spec(r) for r in returns)
+        else:
+            parsed_returns = parse_spec(returns)
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+        unknown = set(parsed_args) - set(sig.parameters)
+        if unknown:
+            raise ValueError(
+                f"@contract on {fn.__name__}: unknown parameters {unknown}"
+            )
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not contracts_enabled():
+                return fn(*args, **kwargs)
+            env: Dict[str, int] = {}
+            bound = sig.bind_partial(*args, **kwargs)
+            for name, spec in parsed_args.items():
+                if name in bound.arguments:
+                    check_value(
+                        bound.arguments[name],
+                        spec,
+                        f"{fn.__name__}({name})",
+                        env,
+                    )
+            out = fn(*args, **kwargs)
+            if parsed_returns is not None:
+                if isinstance(parsed_returns, tuple):
+                    if not isinstance(out, (tuple, list)) or len(out) != len(
+                        parsed_returns
+                    ):
+                        raise ContractError(
+                            f"{fn.__name__} -> expected a {len(parsed_returns)}"
+                            f"-tuple, got {type(out).__name__}"
+                        )
+                    for i, (val, spec) in enumerate(
+                        zip(out, parsed_returns)
+                    ):
+                        check_value(
+                            val, spec, f"{fn.__name__} -> [{i}]", env
+                        )
+                else:
+                    check_value(out, parsed_returns, f"{fn.__name__} ->", env)
+            return out
+
+        wrapper.__mrlint_contract__ = {
+            "args": parsed_args,
+            "returns": parsed_returns,
+        }
+        return wrapper
+
+    return deco

@@ -1,0 +1,276 @@
+"""mrlint framework: module parsing, rule registry, disable comments.
+
+A lint run parses every target file once into a :class:`ModuleInfo`
+(AST + per-line disable pragmas), wraps the set in a :class:`Project`
+(cross-module symbol/import resolution plus the traced-call-graph
+analysis in ``analysis.traced``), then asks each registered rule for
+violations. Suppression happens centrally: a violation whose line (or
+whose immediately preceding comment-only line) carries
+``# mrlint: disable=<RULE>(<reason>)`` for its rule is dropped; a
+disable pragma without a reason is reported as R0 — the escape hatch
+must leave an audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True, order=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class Rule:
+    """One lint rule. Subclasses set ``name``/``slug``/``summary`` and
+    implement ``check(module, project) -> iterable of Violation``."""
+
+    name: str = ""
+    slug: str = ""
+    summary: str = ""
+
+    def check(self, module: "ModuleInfo", project: "Project"):
+        raise NotImplementedError
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule (by its ``name``) to the registry."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    RULES[inst.name] = inst
+    return cls
+
+
+# `# mrlint: disable=R1(reason), R2(other reason)` — reasons may hold any
+# character but ")," so multiple pragmas on one line stay parseable.
+_PRAGMA = re.compile(r"#\s*mrlint:\s*disable=(.*)$")
+_ENTRY = re.compile(r"(R\d+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    rel: str
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # line -> {rule: reason}; reason "" means a bare (unjustified) pragma.
+    disables: Dict[int, Dict[str, str]] = field(default_factory=dict)
+
+    @property
+    def imports_jax(self) -> bool:
+        return any(
+            m == "jax" or m.startswith("jax.")
+            for m in self.import_aliases.values()
+        )
+
+    @property
+    def import_aliases(self) -> Dict[str, str]:
+        """Local name -> absolute dotted module for plain ``import``/
+        ``import .. as ..`` statements (external modules; relative
+        imports are resolved separately by Project)."""
+        if not hasattr(self, "_aliases"):
+            aliases: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        aliases[a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                    for a in node.names:
+                        aliases[a.asname or a.name] = (
+                            f"{node.module}.{a.name}" if node.module else a.name
+                        )
+            self._aliases = aliases
+        return self._aliases
+
+    def dotted(self, node) -> Optional[str]:
+        """Resolve a Name/Attribute chain to an absolute dotted path using
+        the module's import aliases (``jnp.float64`` -> ``jax.numpy.
+        float64``); None when the root is not an imported name."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.import_aliases.get(node.id)
+        if base is None:
+            return None
+        return ".".join([base] + list(reversed(parts)))
+
+
+def _parse_text(source: str, path: Path, rel: str) -> ModuleInfo:
+    tree = ast.parse(source, filename=rel)
+    lines = source.splitlines()
+    info = ModuleInfo(
+        path=path, rel=rel, source=source, tree=tree, lines=lines
+    )
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA.search(text)
+        if not m:
+            continue
+        entries = {
+            rule: (reason or "").strip()
+            for rule, reason in _ENTRY.findall(m.group(1))
+        }
+        if not entries:
+            continue
+        stripped = text[: m.start()].strip()
+        # A comment-only pragma line guards the NEXT line; an end-of-line
+        # pragma guards its own.
+        info.disables.setdefault(i if stripped else i + 1, {}).update(entries)
+    return info
+
+
+def parse_module(path: Path, rel: Optional[str] = None) -> ModuleInfo:
+    return _parse_text(path.read_text(), path, rel or str(path))
+
+
+class Project:
+    """The lint unit: a set of modules linted together, with lazy
+    cross-module analyses (symbol table, traced-call-graph taint)."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self._traced = None
+
+    @property
+    def traced(self):
+        """The traced-call-graph analysis (analysis.traced.TracedAnalysis),
+        computed once per project."""
+        if self._traced is None:
+            from .traced import TracedAnalysis
+
+            self._traced = TracedAnalysis(self)
+        return self._traced
+
+    def module_for(self, path: Path) -> Optional[ModuleInfo]:
+        for m in self.modules:
+            if m.path == path:
+                return m
+        return None
+
+    def resolve_relative(self, module: ModuleInfo, node: ast.ImportFrom):
+        """Resolve a relative ``from``-import to a project module path
+        (``from ..ops.segment import x`` inside rank_backends/ ->
+        .../ops/segment.py). Returns the ModuleInfo or None."""
+        if node.level == 0:
+            return None
+        base = module.path.parent
+        for _ in range(node.level - 1):
+            base = base.parent
+        target = base
+        if node.module:
+            for part in node.module.split("."):
+                target = target / part
+        for candidate in (target.with_suffix(".py"), target / "__init__.py"):
+            found = self.module_for(candidate)
+            if found is not None:
+                return found
+        return None
+
+
+def collect_files(paths: Iterable[str]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    return files
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Optional[Iterable[str]] = None
+) -> List[Violation]:
+    """Lint files/directories as ONE project (cross-module call graphs
+    resolve within the set). Returns sorted, suppression-filtered
+    violations — including R0 for unjustified disables."""
+    files = collect_files(paths)
+    modules = [parse_module(f, rel=str(f)) for f in files]
+    return _run(Project(modules), rules)
+
+
+def lint_source(
+    source: str,
+    filename: str = "<snippet>",
+    rules: Optional[Iterable[str]] = None,
+) -> List[Violation]:
+    """Lint one in-memory snippet (the fixture-test entry point)."""
+    info = _parse_text(source, Path(filename), filename)
+    return _run(Project([info]), rules)
+
+
+def _run(
+    project: Project, rules: Optional[Iterable[str]] = None
+) -> List[Violation]:
+    active = (
+        list(RULES.values())
+        if rules is None
+        else [RULES[r] for r in rules]
+    )
+    out: List[Violation] = []
+    for module in project.modules:
+        found: List[Violation] = []
+        for rule in active:
+            found.extend(rule.check(module, project))
+        for v in found:
+            pragma = module.disables.get(v.line, {})
+            if v.rule in pragma:
+                if pragma[v.rule]:
+                    continue  # justified suppression
+                out.append(
+                    Violation(
+                        path=v.path,
+                        line=v.line,
+                        col=v.col,
+                        rule="R0",
+                        message=(
+                            f"disable={v.rule} without a justification — "
+                            "write # mrlint: disable="
+                            f"{v.rule}(why this is safe)"
+                        ),
+                    )
+                )
+            else:
+                out.append(v)
+        # Pragmas that never matched a violation but carry no reason are
+        # still unjustified escape hatches.
+        for line, entries in module.disables.items():
+            for rule_name, reason in entries.items():
+                if reason:
+                    continue
+                already = any(
+                    v.rule == "R0" and v.line == line for v in out
+                )
+                if not already:
+                    out.append(
+                        Violation(
+                            path=module.rel,
+                            line=line,
+                            col=0,
+                            rule="R0",
+                            message=(
+                                f"disable={rule_name} without a "
+                                "justification — write # mrlint: "
+                                f"disable={rule_name}(why this is safe)"
+                            ),
+                        )
+                    )
+    return sorted(out)

@@ -1,0 +1,338 @@
+"""The mrlint rule set (R1-R5). See analysis/__init__ for the catalog.
+
+Each rule is intentionally heuristic — it encodes THIS repo's TPU
+invariants, not general Python semantics — and every finding can be
+suppressed in place with ``# mrlint: disable=RN(reason)`` (a reason is
+mandatory; bare disables are reported as R0 by the framework).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import ModuleInfo, Project, Rule, Violation, register
+
+
+def _v(module: ModuleInfo, node, rule: str, message: str) -> Violation:
+    return Violation(
+        path=module.rel,
+        line=getattr(node, "lineno", getattr(node, "line", 0)),
+        col=getattr(node, "col_offset", getattr(node, "col", 0)),
+        rule=rule,
+        message=message,
+    )
+
+
+@register
+class HostSyncRule(Rule):
+    """R1: no implicit host sync on traced values inside jit call graphs.
+
+    ``float()``/``int()``/``bool()``/``.item()``/``np.asarray``/
+    ``jax.device_get`` on a value reachable from a non-static parameter
+    of a jitted function either crashes at trace time
+    (TracerArrayConversionError) or — in op-by-op execution — silently
+    serializes dispatch with a device->host round trip per call (~90 ms
+    on tunneled-TPU runtimes). The traced-call-graph analysis in
+    analysis/traced.py decides what is traced; ``.shape``/``.dtype``
+    reads are static and exempt.
+    """
+
+    name = "R1"
+    slug = "host-sync"
+    summary = "implicit host sync on a traced value inside a jit region"
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.traced.events:
+            if ev.kind == "host-sync" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class DtypeDriftRule(Rule):
+    """R2: no float64 in jax-importing ranking modules.
+
+    The device path is f32/bf16 end to end (PageRankConfig/
+    RuntimeConfig.dtype); a ``np.float64`` scalar or ``dtype="float64"``
+    leaking into a jnp expression upcasts the whole chain on CPU (and
+    silently truncates on TPU), defeating the bf16 MXU path and breaking
+    cross-backend score parity. Host-side float64 oracles
+    (sparse_oracle, numpy_ref) import numpy only and are out of scope by
+    construction.
+    """
+
+    name = "R2"
+    slug = "dtype-drift"
+    summary = "float64 dtype in a jax-importing ranking module"
+
+    _BAD_ATTRS = {"float64", "double", "float_"}
+
+    def check(self, module: ModuleInfo, project: Project):
+        if not module.imports_jax:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and node.attr in self._BAD_ATTRS:
+                dotted = module.dotted(node)
+                if dotted and dotted.split(".")[0] in ("numpy", "jax"):
+                    yield _v(
+                        module,
+                        node,
+                        self.name,
+                        f"`{dotted}` in a device-path module — the "
+                        "ranking pipeline is f32/bf16 (RuntimeConfig."
+                        "dtype); a float64 scalar upcasts every jnp "
+                        "expression it touches",
+                    )
+            elif (
+                isinstance(node, ast.Constant)
+                and node.value == "float64"
+            ):
+                yield _v(
+                    module,
+                    node,
+                    self.name,
+                    '"float64" dtype string in a device-path module — '
+                    "the ranking pipeline is f32/bf16",
+                )
+
+
+@register
+class RetraceRule(Rule):
+    """R3: recompilation hazards.
+
+    (a) ``jax.jit``/``pjit`` built inside a function body creates a new
+    cache per call — every invocation retraces and recompiles. Allowed
+    only in the module-cache idiom (the enclosing function declares a
+    ``global`` it assigns the wrapper to, or is ``functools.lru_cache``/
+    ``functools.cache``-decorated).
+    (b) a Python ``if``/``while`` on a traced value concretizes the
+    tracer (error under jit; a retrace per distinct value with plain
+    tracing) — from the same taint analysis as R1.
+    (c) a list/dict/set literal passed in a static position of a known
+    jit wrapper is unhashable and fails cache lookup.
+    """
+
+    name = "R3"
+    slug = "retrace"
+    summary = "jit recompilation hazard"
+
+    def check(self, module: ModuleInfo, project: Project):
+        yield from self._jit_in_body(module, project)
+        for ev in project.traced.events:
+            if ev.kind == "tracer-branch" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+        yield from self._unhashable_static(module, project)
+
+    def _jit_in_body(self, module: ModuleInfo, project: Project):
+        class _Walker(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[ast.FunctionDef] = []
+                self.found = []
+
+            def visit_FunctionDef(self, node):
+                self.stack.append(node)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node):
+                if self.stack:
+                    dotted = module.dotted(node.func)
+                    if dotted in (
+                        "jax.jit",
+                        "jax.pjit",
+                        "jax.experimental.pjit.pjit",
+                    ):
+                        self.found.append((node, self.stack[-1]))
+                self.generic_visit(node)
+
+        w = _Walker()
+        w.visit(module.tree)
+        for call, fn in w.found:
+            if any(
+                isinstance(s, ast.Global)
+                for s in ast.walk(fn)
+            ):
+                continue  # module-cache idiom (global singleton)
+            if any(
+                (module.dotted(d) or "").startswith("functools.")
+                and (module.dotted(d) or "").endswith(("cache", "lru_cache"))
+                or isinstance(d, ast.Call)
+                and (module.dotted(d.func) or "").startswith("functools.")
+                for d in fn.decorator_list
+            ):
+                continue  # cached factory
+            yield _v(
+                module,
+                call,
+                self.name,
+                f"jax.jit built inside `{fn.name}` without a module "
+                "cache — a fresh wrapper per call retraces and "
+                "recompiles every invocation; hoist the jit to module "
+                "level or cache it behind a `global` singleton",
+            )
+
+    def _unhashable_static(self, module: ModuleInfo, project: Project):
+        analysis = project.traced
+        wrappers = {
+            (id(w.module), w.bound_name): w
+            for w in analysis.wrappers
+            if w.bound_name and (w.static_argnums or w.static_argnames)
+        }
+        if not wrappers:
+            return
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+            ):
+                continue
+            w = wrappers.get((id(module), node.func.id))
+            if w is None:
+                continue
+            names = ()
+            if w.target is not None:
+                names = w.target.params
+            for i, arg in enumerate(node.args):
+                static = i in w.static_argnums or (
+                    i < len(names) and names[i] in w.static_argnames
+                )
+                if static and isinstance(
+                    arg, (ast.List, ast.Dict, ast.Set)
+                ):
+                    yield _v(
+                        module,
+                        arg,
+                        self.name,
+                        f"unhashable {type(arg).__name__.lower()} literal "
+                        f"in static position {i} of `{node.func.id}` — "
+                        "static args are jit cache keys and must be "
+                        "hashable; pass a tuple (or mark the arg "
+                        "non-static)",
+                    )
+
+
+@register
+class DonationRule(Rule):
+    """R4: no read of a buffer after it was donated.
+
+    ``donate_argnums`` hands the argument's device buffer to XLA for
+    reuse; the Python array object still exists but its buffer is
+    deleted once the computation consumes it — a later read raises
+    "Array has been deleted" (or, worse, returns stale data on runtimes
+    without donation checks). Flags loads of a name after it was passed
+    in a donated position of a known jit wrapper in the same function.
+    """
+
+    name = "R4"
+    slug = "donation"
+    summary = "buffer read after donation"
+
+    def check(self, module: ModuleInfo, project: Project):
+        analysis = project.traced
+        donating = {
+            (id(w.module), w.bound_name): w
+            for w in analysis.wrappers
+            if w.bound_name and w.donate_argnums
+        }
+        if not donating:
+            return
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            donated = {}  # var name -> donation call line
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load
+                    ):
+                        if (
+                            node.id in donated
+                            and node.lineno > donated[node.id]
+                        ):
+                            yield _v(
+                                module,
+                                node,
+                                self.name,
+                                f"`{node.id}` read after being donated "
+                                f"(donate_argnums call at line "
+                                f"{donated[node.id]}) — the buffer is "
+                                "handed to XLA and deleted; reorder the "
+                                "read before the call or drop the "
+                                "donation",
+                            )
+                            donated.pop(node.id)
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name
+                    ):
+                        w = donating.get((id(module), node.func.id))
+                        if w is None:
+                            continue
+                        for pos in w.donate_argnums:
+                            if pos < len(node.args) and isinstance(
+                                node.args[pos], ast.Name
+                            ):
+                                donated[node.args[pos].id] = node.lineno
+        return
+
+
+@register
+class ContractRule(Rule):
+    """R5: public rank/spectrum entry points declare @contract specs.
+
+    Module-level public functions named ``rank_window*``/
+    ``rank_windows*`` (and ``spectrum_scores``) are the seams every
+    backend, batch path and test drives — their shape/dtype signatures
+    are the repo's data contract and must be machine-readable
+    (analysis.contracts.contract), which also arms the trace-time
+    checker behind RuntimeConfig.validate_numerics.
+    """
+
+    name = "R5"
+    slug = "contract"
+    summary = "public rank/spectrum entry point without @contract"
+
+    _NAMES = ("rank_window", "rank_windows")
+
+    def check(self, module: ModuleInfo, project: Project):
+        for node in module.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if node.name.startswith("_"):
+                continue
+            if not (
+                node.name.startswith(self._NAMES)
+                or node.name == "spectrum_scores"
+            ):
+                continue
+            if self._has_contract(module, node):
+                continue
+            yield _v(
+                module,
+                node,
+                self.name,
+                f"public entry point `{node.name}` has no @contract "
+                "shape/dtype annotation (analysis.contracts) — the "
+                "rank/spectrum seams carry machine-checked signatures",
+            )
+
+    @staticmethod
+    def _has_contract(module: ModuleInfo, node: ast.FunctionDef) -> bool:
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(target, ast.Name) and target.id == "contract":
+                return True
+            if (
+                isinstance(target, ast.Attribute)
+                and target.attr == "contract"
+            ):
+                return True
+        return False
+
+
+def iter_rules() -> Iterable[Rule]:
+    from .core import RULES
+
+    return RULES.values()

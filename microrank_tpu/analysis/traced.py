@@ -1,0 +1,640 @@
+"""Traced-call-graph analysis: which functions run under jax tracing,
+and which of their values are traced (tainted) vs trace-static.
+
+Roots are functions wrapped by ``jax.jit``/``pjit`` (as a call, a
+decorator, or through ``functools.partial``/``checkify.checkify``).
+Parameters in ``static_argnums``/``static_argnames`` positions are
+static; everything else entering a root is a traced value. Tracedness
+propagates through the project call graph: a callee's parameter becomes
+traced when any traced caller passes it a traced-rooted expression
+(fixpoint over the module set being linted).
+
+Within a traced function a simple forward taint walk tracks locals:
+
+* attribute reads of ``shape``/``dtype``/``ndim``/``size`` BREAK taint
+  (static under tracing — branching or ``int()`` on them is fine);
+* ``len``/``isinstance``/``type``/``range``/``min``/``max`` of static
+  operands stay static; any expression over a tainted operand is
+  tainted;
+* nested ``def``/``lambda`` parameters are treated as tainted when the
+  enclosing function is traced (they are the loop/vmap bodies of the
+  kernels — their arguments are device values by construction).
+
+The walk emits the events rules R1 (host sync) and R3 (Python branch on
+a tracer) report, and records project-internal call edges with per-
+parameter taint for the propagation above. R4 uses the jit-wrapper
+index (``donate_argnums`` positions) collected during root discovery.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_JIT_NAMES = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+_UNWRAP_NAMES = {
+    "jax.experimental.checkify.checkify",
+    "checkify.checkify",
+}
+# Attribute reads that are static under tracing (break taint).
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
+# Builtins whose result is host-static regardless of inputs; calling
+# them ON a tainted value is itself the R1 event (flagged separately).
+_SCALARIZERS = {"float", "int", "bool", "complex"}
+_STATIC_BUILTINS = {"len", "isinstance", "type", "range", "hasattr"}
+# Method names that force a host sync on a traced value.
+_SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
+_SYNC_EXTERNALS = {"jax.device_get"}
+
+
+@dataclass
+class FuncDef:
+    module: object               # core.ModuleInfo
+    node: ast.FunctionDef
+    name: str
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        return (
+            [p.arg for p in a.posonlyargs]
+            + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs]
+        )
+
+
+@dataclass
+class JitWrapper:
+    """One jax.jit wrap site: the wrapped project function (if resolved),
+    static/donated positions, and the local name the wrapper is bound to
+    (assignment target or decorated function name)."""
+
+    module: object
+    bound_name: Optional[str]
+    target: Optional[FuncDef]
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    line: int = 0
+
+
+@dataclass
+class Event:
+    kind: str                    # "host-sync" | "tracer-branch"
+    module: object
+    line: int
+    col: int
+    message: str
+
+
+def _int_tuple(node) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+class TracedAnalysis:
+    def __init__(self, project):
+        self.project = project
+        self.defs: Dict[Tuple[int, str], FuncDef] = {}
+        self.wrappers: List[JitWrapper] = []
+        self.traced: Dict[int, Set[str]] = {}   # id(FuncDef) -> tainted params
+        self._by_id: Dict[int, FuncDef] = {}
+        self.events: List[Event] = []
+        self._index_defs()
+        self._find_roots()
+        self._propagate()
+        self._collect_events()
+
+    # ---------------------------------------------------------- indexing
+
+    def _index_defs(self) -> None:
+        for mod in self.project.modules:
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fd = FuncDef(module=mod, node=node, name=node.name)
+                    self.defs[(id(mod), node.name)] = fd
+
+    def resolve(self, module, name: str) -> Optional[FuncDef]:
+        """Resolve a bare name used in ``module`` to a project function:
+        a module-level def, or a relative-imported one."""
+        fd = self.defs.get((id(module), name))
+        if fd is not None:
+            return fd
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.level > 0:
+                for a in node.names:
+                    if (a.asname or a.name) == name:
+                        target_mod = self.project.resolve_relative(
+                            module, node
+                        )
+                        if target_mod is not None:
+                            return self.defs.get((id(target_mod), a.name))
+        return None
+
+    # ------------------------------------------------------------- roots
+
+    def _jit_target(self, module, call: ast.Call):
+        """If ``call`` is jax.jit(...)/pjit(...), return the wrapped
+        FuncDef (unwrapping checkify) or None-but-jit. Returns
+        (is_jit, target)."""
+        dotted = module.dotted(call.func)
+        if dotted not in _JIT_NAMES:
+            return False, None
+        if not call.args:
+            return True, None
+        inner = call.args[0]
+        if isinstance(inner, ast.Call):
+            inner_dotted = module.dotted(inner.func)
+            if (
+                inner_dotted in _UNWRAP_NAMES
+                or (inner_dotted or "").endswith(".checkify")
+            ) and inner.args:
+                inner = inner.args[0]
+        if isinstance(inner, ast.Name):
+            return True, self.resolve(module, inner.id)
+        return True, None
+
+    def _wrapper_from_call(
+        self, module, call: ast.Call, bound: Optional[str]
+    ) -> Optional[JitWrapper]:
+        is_jit, target = self._jit_target(module, call)
+        if not is_jit:
+            return None
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        return JitWrapper(
+            module=module,
+            bound_name=bound,
+            target=target,
+            static_argnums=_int_tuple(kw.get("static_argnums")),
+            static_argnames=_str_tuple(kw.get("static_argnames")),
+            donate_argnums=_int_tuple(kw.get("donate_argnums")),
+            line=call.lineno,
+        )
+
+    def _find_roots(self) -> None:
+        for mod in self.project.modules:
+            for node in ast.walk(mod.tree):
+                # X = jax.jit(f, ...) anywhere (module level or cached
+                # inside a factory function).
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    bound = (
+                        node.targets[0].id
+                        if len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        else None
+                    )
+                    w = self._wrapper_from_call(mod, node.value, bound)
+                    if w:
+                        self.wrappers.append(w)
+                # Decorated defs: @jax.jit / @functools.partial(jax.jit,..)
+                elif isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for dec in node.decorator_list:
+                        w = self._wrapper_from_decorator(mod, node, dec)
+                        if w:
+                            self.wrappers.append(w)
+        for w in self.wrappers:
+            if w.target is None:
+                continue
+            params = w.target.params
+            static = {
+                params[i] for i in w.static_argnums if i < len(params)
+            } | set(w.static_argnames)
+            tainted = {p for p in params if p not in static}
+            self._mark(w.target, tainted)
+
+    def _wrapper_from_decorator(
+        self, module, fn: ast.FunctionDef, dec
+    ) -> Optional[JitWrapper]:
+        fd = self.defs.get((id(module), fn.name)) or FuncDef(
+            module=module, node=fn, name=fn.name
+        )
+        dotted = module.dotted(dec)
+        if dotted in _JIT_NAMES:
+            return JitWrapper(
+                module=module, bound_name=fn.name, target=fd, line=fn.lineno
+            )
+        if isinstance(dec, ast.Call):
+            dec_dotted = module.dotted(dec.func)
+            kw = {k.arg: k.value for k in dec.keywords if k.arg}
+            if dec_dotted in _JIT_NAMES:
+                return JitWrapper(
+                    module=module,
+                    bound_name=fn.name,
+                    target=fd,
+                    static_argnums=_int_tuple(kw.get("static_argnums")),
+                    static_argnames=_str_tuple(kw.get("static_argnames")),
+                    donate_argnums=_int_tuple(kw.get("donate_argnums")),
+                    line=fn.lineno,
+                )
+            if dec_dotted == "functools.partial" and dec.args:
+                if module.dotted(dec.args[0]) in _JIT_NAMES:
+                    return JitWrapper(
+                        module=module,
+                        bound_name=fn.name,
+                        target=fd,
+                        static_argnums=_int_tuple(kw.get("static_argnums")),
+                        static_argnames=_str_tuple(
+                            kw.get("static_argnames")
+                        ),
+                        donate_argnums=_int_tuple(kw.get("donate_argnums")),
+                        line=fn.lineno,
+                    )
+        return None
+
+    # ------------------------------------------------------- propagation
+
+    def _mark(self, fd: FuncDef, tainted: Set[str]) -> bool:
+        self._by_id[id(fd)] = fd
+        cur = self.traced.setdefault(id(fd), set())
+        before = len(cur)
+        cur |= tainted
+        return len(cur) != before or before == 0 and not tainted
+
+    def _propagate(self) -> None:
+        # Fixpoint: re-walk every traced function until no callee's taint
+        # set grows. Monotone, so it terminates.
+        changed = True
+        while changed:
+            changed = False
+            for fid, tainted in list(self.traced.items()):
+                fd = self._by_id[fid]
+                walker = _TaintWalker(self, fd, set(tainted))
+                walker.run()
+                for callee, callee_tainted in walker.calls:
+                    if id(callee) not in self.traced:
+                        self._by_id[id(callee)] = callee
+                        self.traced[id(callee)] = set()
+                        changed = True
+                    cur = self.traced[id(callee)]
+                    if callee_tainted - cur:
+                        cur |= callee_tainted
+                        changed = True
+
+    def _collect_events(self) -> None:
+        seen = set()
+        for fid, tainted in self.traced.items():
+            fd = self._by_id[fid]
+            walker = _TaintWalker(self, fd, set(tainted), emit=True)
+            walker.run()
+            for ev in walker.events:
+                key = (id(ev.module), ev.line, ev.col, ev.kind, ev.message)
+                if key not in seen:
+                    seen.add(key)
+                    self.events.append(ev)
+
+    def traced_functions(self) -> List[FuncDef]:
+        return [self._by_id[fid] for fid in self.traced]
+
+
+def _identity_test(test) -> bool:
+    """``x is None`` / ``x is not None`` (and `and`/`or` chains of them)
+    never call ``__bool__`` on a tracer — identity is decided by the
+    Python object, so branching on it is trace-safe."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_identity_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _identity_test(test.operand)
+    return False
+
+
+class _TaintWalker:
+    """Forward taint walk over one traced function's body."""
+
+    def __init__(self, analysis, fd: FuncDef, tainted: Set[str], emit=False):
+        self.analysis = analysis
+        self.fd = fd
+        self.module = fd.module
+        self.tainted = set(tainted)
+        self.emit = emit
+        self.events: List[Event] = []
+        self.calls: List[Tuple[FuncDef, Set[str]]] = []
+
+    def run(self) -> None:
+        for stmt in self.fd.node.body:
+            self._stmt(stmt)
+
+    # ------------------------------------------------------- taint query
+
+    def is_tainted(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value) or self.is_tainted(
+                node.slice
+            )
+        if isinstance(node, ast.Call):
+            dotted = self.module.dotted(node.func)
+            if isinstance(node.func, ast.Name) and node.func.id in (
+                _STATIC_BUILTINS | _SCALARIZERS
+            ):
+                return False
+            if dotted is not None and dotted.split(".")[0] in (
+                "math", "dataclasses", "functools"
+            ):
+                return False
+            args_tainted = any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(k.value) for k in node.keywords
+            )
+            # Method on a tainted object (x.astype(...), x.sum()).
+            if isinstance(node.func, ast.Attribute) and self.is_tainted(
+                node.func.value
+            ):
+                return True
+            if isinstance(node.func, ast.Name) and self.is_tainted(
+                node.func
+            ):
+                return True
+            return args_tainted
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return (
+                self.is_tainted(node.body)
+                or self.is_tainted(node.orelse)
+                or self.is_tainted(node.test)
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.is_tainted(v) for v in node.values if v)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.is_tainted(node.elt) or any(
+                self.is_tainted(g.iter) for g in node.generators
+            )
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, ast.JoinedStr):
+            return False
+        if isinstance(node, ast.Slice):
+            return any(
+                self.is_tainted(p)
+                for p in (node.lower, node.upper, node.step)
+            )
+        return False
+
+    # --------------------------------------------------------- statements
+
+    def _assign_target(self, target, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, tainted)
+        # Attribute/Subscript stores don't create locals.
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: its params receive device values from the
+            # enclosing traced context (loop bodies, vmapped lambdas).
+            inner = _TaintWalker(
+                self.analysis,
+                FuncDef(module=self.module, node=stmt, name=stmt.name),
+                self.tainted
+                | {
+                    a.arg
+                    for a in (
+                        stmt.args.posonlyargs
+                        + stmt.args.args
+                        + stmt.args.kwonlyargs
+                    )
+                },
+                emit=self.emit,
+            )
+            inner.run()
+            self.events.extend(inner.events)
+            self.calls.extend(inner.calls)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            t = self.is_tainted(stmt.value)
+            for target in stmt.targets:
+                self._assign_target(target, t)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value)
+            if self.is_tainted(stmt.value):
+                self._assign_target(stmt.target, True)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+                self._assign_target(stmt.target, self.is_tainted(stmt.value))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test)
+            if (
+                self.emit
+                and self.is_tainted(stmt.test)
+                and not _identity_test(stmt.test)
+            ):
+                kw = "while" if isinstance(stmt, ast.While) else "if"
+                self.events.append(
+                    Event(
+                        kind="tracer-branch",
+                        module=self.module,
+                        line=stmt.lineno,
+                        col=stmt.col_offset,
+                        message=(
+                            f"Python `{kw}` on a traced value inside a "
+                            "jit region — concretizes the tracer "
+                            "(TracerBoolConversionError at best, a "
+                            "silent retrace per value at worst); use "
+                            "jnp.where/lax.cond or hoist the branch to "
+                            "a static argument"
+                        ),
+                    )
+                )
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            self._assign_target(stmt.target, self.is_tainted(stmt.iter))
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign_target(
+                        item.optional_vars,
+                        self.is_tainted(item.context_expr),
+                    )
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value)
+            return
+        # Raise/Assert/Import/Pass/Global/...: scan embedded expressions.
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._scan_expr(node)
+
+    # -------------------------------------------------------- expressions
+
+    def _scan_expr(self, expr) -> None:
+        """Walk an expression tree: record project-call edges and (in emit
+        mode) host-sync events."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                # Treated like a nested def: params tainted, body scanned
+                # by this same walk (ast.walk already descends into it,
+                # so just add the params to the taint set first).
+                for a in (
+                    node.args.posonlyargs
+                    + node.args.args
+                    + node.args.kwonlyargs
+                ):
+                    self.tainted.add(a.arg)
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._record_call(node)
+            if self.emit:
+                self._check_call(node)
+
+    def _record_call(self, call: ast.Call) -> None:
+        if not isinstance(call.func, ast.Name):
+            return
+        target = self.analysis.resolve(self.module, call.func.id)
+        if target is None:
+            return
+        params = target.params
+        tainted_params: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break  # positions unknown past a splat
+            if i < len(params) and self.is_tainted(arg):
+                tainted_params.add(params[i])
+        for k in call.keywords:
+            if k.arg and k.arg in params and self.is_tainted(k.value):
+                tainted_params.add(k.arg)
+        self.calls.append((target, tainted_params))
+
+    def _check_call(self, call: ast.Call) -> None:
+        args_tainted = any(self.is_tainted(a) for a in call.args)
+        # float(x)/int(x)/bool(x) on a traced value.
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in _SCALARIZERS
+            and args_tainted
+        ):
+            self._event_sync(
+                call,
+                f"`{call.func.id}()` on a traced value forces a "
+                "host sync (blocks dispatch, breaks inside jit); keep "
+                "the value on device or fetch it once with "
+                "jax.device_get after dispatch",
+            )
+            return
+        # x.item() / x.tolist() / jax.device_get(x) / np.*(x).
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _SYNC_METHODS and self.is_tainted(
+                call.func.value
+            ):
+                self._event_sync(
+                    call,
+                    f"`.{call.func.attr}()` on a traced value forces a "
+                    "host sync inside a jit region",
+                )
+                return
+        dotted = self.module.dotted(call.func)
+        if dotted is None:
+            return
+        if dotted in _SYNC_EXTERNALS and args_tainted:
+            self._event_sync(
+                call,
+                "`jax.device_get` inside a traced region — fetch results "
+                "after dispatch, outside the jit boundary",
+            )
+            return
+        root = dotted.split(".")[0]
+        if root == "numpy" and args_tainted:
+            self._event_sync(
+                call,
+                f"`{dotted.replace('numpy', 'np', 1)}` on a traced value "
+                "— numpy concretizes tracers (TracerArrayConversionError "
+                "under jit, a silent device->host sync outside); use the "
+                "jnp equivalent",
+            )
+
+    def _event_sync(self, call: ast.Call, message: str) -> None:
+        self.events.append(
+            Event(
+                kind="host-sync",
+                module=self.module,
+                line=call.lineno,
+                col=call.col_offset,
+                message=message,
+            )
+        )

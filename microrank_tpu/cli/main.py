@@ -672,6 +672,10 @@ def main(argv=None) -> int:
     )
     p_col.set_defaults(fn=cmd_collect)
 
+    from ..analysis.cli import add_lint_parser
+
+    add_lint_parser(sub)
+
     args = parser.parse_args(argv)
     if args.fn in (cmd_run, cmd_eval):  # jax-touching commands only
         _enable_jit_cache()

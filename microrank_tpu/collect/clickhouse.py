@@ -56,7 +56,10 @@ class ChaosEvent:
 
 
 def load_events_toml(path) -> List[ChaosEvent]:
-    import tomllib  # stdlib (3.11+) — no third-party toml needed to read
+    try:
+        import tomllib  # stdlib (3.11+) — no third-party toml needed
+    except ModuleNotFoundError:  # 3.10: same API under the backport name
+        import tomli as tomllib
 
     with open(path, "rb") as f:
         data = tomllib.load(f)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def coo_matvec(rows, cols, vals, x, n_rows: int):
@@ -22,6 +23,40 @@ def coo_matvec(rows, cols, vals, x, n_rows: int):
     return jax.ops.segment_sum(
         vals * jnp.take(x, cols, mode="clip"), rows, num_segments=n_rows
     )
+
+
+def _two_sum(a, b):
+    """Knuth TwoSum: s + e == a + b exactly (s = fl(a+b), e the rounding
+    error). Pure adds/subtracts — no FMA contraction can break it, and
+    XLA does not reassociate float adds."""
+    s = a + b
+    t = s - a
+    e = (a - (s - t)) + (b - t)
+    return s, e
+
+
+def compensated_cumsum(x):
+    """Double-f32 inclusive prefix sum: returns (hi, lo) with
+    ``hi[i] + lo[i]`` carrying the prefix sum to ~2x f32 precision.
+
+    A plain f32 ``jnp.cumsum`` makes each element's rounding depend on
+    its global prefix position — two value-identical rows of a CSR
+    matrix land on different prefixes and round differently, which is
+    exactly how the csr kernel once broke exact score ties the other
+    kernels (per-row summation trees) preserved
+    (tests/test_collapse.py::test_collapse_rank_parity_per_kernel[csr]).
+    Compensating the scan keeps the error per prefix at ~1 ulp
+    regardless of position. Cost: 7 adds per combine instead of 1, on a
+    [E] vector — noise next to the gathers around it.
+    """
+    zeros = jnp.zeros_like(x)
+
+    def comb(a, b):
+        hi, e = _two_sum(a[0], b[0])
+        return hi, e + a[1] + b[1]
+
+    hi, lo = lax.associative_scan(comb, (x, zeros))
+    return hi, lo
 
 
 def segment_count(ids, n_segments: int, live=None):

@@ -286,26 +286,31 @@ class TableRCA:
         opaque handles (device arrays still in flight — jax dispatch is
         async) to pass to ``finalize_rank``."""
         cfg = self.config
-        if self._mesh is not None:
-            from ..parallel.sharded_rank import rank_windows_sharded
+        from ..utils.guards import contract_checks
 
-            batched = self._stage_sharded([graph], kernel)
-            ti, ts, nv = rank_windows_sharded(
-                batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
-            )
-            top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
-        else:
-            from ..rank_backends.blob import stage_rank_window
-            from ..rank_backends.jax_tpu import device_subset
+        # validate_numerics also arms the trace-time @contract checks on
+        # the rank entry points (analysis.contracts).
+        with contract_checks(cfg.runtime.validate_numerics):
+            if self._mesh is not None:
+                from ..parallel.sharded_rank import rank_windows_sharded
 
-            top_idx, top_scores, n_valid = stage_rank_window(
-                device_subset(graph, kernel),
-                cfg.pagerank,
-                cfg.spectrum,
-                kernel,
-                cfg.runtime.blob_staging,
-                checked=cfg.runtime.device_checks,
-            )
+                batched = self._stage_sharded([graph], kernel)
+                ti, ts, nv = rank_windows_sharded(
+                    batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
+                )
+                top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
+            else:
+                from ..rank_backends.blob import stage_rank_window
+                from ..rank_backends.jax_tpu import device_subset
+
+                top_idx, top_scores, n_valid = stage_rank_window(
+                    device_subset(graph, kernel),
+                    cfg.pagerank,
+                    cfg.spectrum,
+                    kernel,
+                    cfg.runtime.blob_staging,
+                    checked=cfg.runtime.device_checks,
+                )
         return top_idx, top_scores, n_valid, op_names
 
     def dispatch_rank(

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..analysis.contracts import contract
 from ..graph.structures import PartitionGraph, WindowGraph
 
 # (field, dtype str, shape, word offset, word count) per leaf, one tuple
@@ -90,6 +91,10 @@ def unpack_graph_blob(blob, layout: BlobLayout) -> WindowGraph:
     return WindowGraph(normal=parts[0], abnormal=parts[1])
 
 
+@contract(
+    blob="uint32[N]",
+    returns=("int32[K]", "float32[K]", "int32[]"),
+)
 def rank_window_blob_core(
     blob, layout, pagerank_cfg, spectrum_cfg, psum_axis=None, kernel="coo"
 ):
@@ -104,6 +109,10 @@ rank_window_blob_device = jax.jit(
 )
 
 
+@contract(
+    blob="uint32[N]",
+    returns=("int32[B,K]", "float32[B,K]", "int32[B]"),
+)
 def rank_windows_batched_blob_core(
     blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
 ):

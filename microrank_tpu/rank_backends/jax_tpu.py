@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..analysis.contracts import contract
 from ..config import MicroRankConfig, PageRankConfig, SpectrumConfig
 from ..graph.structures import PartitionGraph, WindowGraph
 from ..ops.segment import coo_matvec
@@ -499,19 +500,33 @@ def _partition_setup(
 
         def csr_rowsum(prod, indptr):
             """LOCAL row sums (per-shard partial when sharded — the
-            caller psums, combining vectors first to save collectives)."""
-            cs = jnp.concatenate(
-                [jnp.zeros((1,), jnp.float32), jnp.cumsum(prod)]
-            )
+            caller psums, combining vectors first to save collectives).
+
+            The prefix sum is COMPENSATED (double-f32, ops.segment.
+            compensated_cumsum): a plain f32 cumsum rounds each row's
+            difference by its global prefix position, so two
+            value-identical rows could emerge unequal and flip an exact
+            score tie the per-row-summing kernels (coo/packed/dense)
+            preserve — the root cause of the csr collapse-parity
+            failure. The difference is taken per component and summed
+            hi-first to keep the recovered row sum within ~1 ulp."""
+            from ..ops.segment import compensated_cumsum
+
+            hi, lo_c = compensated_cumsum(prod)
+            z = jnp.zeros((1,), jnp.float32)
+            hi = jnp.concatenate([z, hi])
+            lo_c = jnp.concatenate([z, lo_c])
             n_local = prod.shape[0]
-            lo = (
+            base = (
                 0
                 if psum_axis is None
                 else lax.axis_index(psum_axis) * n_local
             )
-            a = jnp.clip(indptr[:-1], lo, lo + n_local) - lo
-            b = jnp.clip(indptr[1:], lo, lo + n_local) - lo
-            return jnp.take(cs, b) - jnp.take(cs, a)
+            a = jnp.clip(indptr[:-1], base, base + n_local) - base
+            b = jnp.clip(indptr[1:], base, base + n_local) - base
+            return (jnp.take(hi, b) - jnp.take(hi, a)) + (
+                jnp.take(lo_c, b) - jnp.take(lo_c, a)
+            )
 
         def matvecs(sv, rv):
             y_sr = csr_rowsum(
@@ -769,6 +784,10 @@ def top_k_tiebroken(scores, k: int):
     return -neg_sorted[:k], idx_sorted[:k]
 
 
+@contract(
+    graph="windowgraph",
+    returns=("int32[K]", "float32[K]", "int32[]"),
+)
 def rank_window_core(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
@@ -831,6 +850,10 @@ def window_weights(
     return n_weight, a_weight
 
 
+@contract(
+    graph="windowgraph",
+    returns=("int32[M,K]", "float32[M,K]", "int32[]"),
+)
 def rank_window_all_methods_core(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
@@ -869,6 +892,10 @@ def rank_window_all_methods_core(
     )
 
 
+@contract(
+    graph="windowgraph",
+    returns=("int32[K]", "float32[K]", "int32[]"),
+)
 def rank_window_checked_core(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
@@ -918,6 +945,10 @@ def _checked_jit():
 _CHECKED_JIT = None
 
 
+@contract(
+    graph="windowgraph",
+    returns=("int32[K]", "float32[K]", "int32[]"),
+)
 def rank_window_checked(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
@@ -1082,16 +1113,21 @@ class JaxBackend:
             kernel = choose_kernel(
                 graph, rt.dense_budget_bytes, rt.prefer_bf16
             )
+        from ..utils.guards import contract_checks
         from .blob import stage_rank_window
 
-        top_idx, top_scores, n_valid = stage_rank_window(
-            device_subset(graph, kernel),
-            self.config.pagerank,
-            self.config.spectrum,
-            kernel,
-            rt.blob_staging,
-            checked=rt.device_checks,
-        )
+        # validate_numerics also arms the trace-time @contract checks on
+        # the rank entry points (analysis.contracts) — one knob, both
+        # the host-side score validation and the signature contracts.
+        with contract_checks(rt.validate_numerics):
+            top_idx, top_scores, n_valid = stage_rank_window(
+                device_subset(graph, kernel),
+                self.config.pagerank,
+                self.config.spectrum,
+                kernel,
+                rt.blob_staging,
+                checked=rt.device_checks,
+            )
         # One batched fetch — piecemeal int()/float() conversions on device
         # arrays each pay a full RPC round trip on tunneled-TPU runtimes.
         top_idx, top_scores, n_valid = jax.device_get(
@@ -1136,15 +1172,18 @@ class JaxBackend:
             kernel = choose_kernel(
                 graph, rt.dense_budget_bytes, rt.prefer_bf16
             )
-        top_idx, top_scores, n_valid = jax.device_get(
-            rank_window_all_methods_device(
-                jax.device_put(device_subset(graph, kernel)),
-                self.config.pagerank,
-                self.config.spectrum,
-                None,
-                kernel,
+        from ..utils.guards import contract_checks
+
+        with contract_checks(rt.validate_numerics):
+            top_idx, top_scores, n_valid = jax.device_get(
+                rank_window_all_methods_device(
+                    jax.device_put(device_subset(graph, kernel)),
+                    self.config.pagerank,
+                    self.config.spectrum,
+                    None,
+                    kernel,
+                )
             )
-        )
         n = int(n_valid)
         return {
             m: (

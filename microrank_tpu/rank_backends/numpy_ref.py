@@ -22,6 +22,7 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..config import PageRankConfig, SpectrumConfig
 
 EPS_DEFAULT = 1e-7
@@ -327,6 +328,7 @@ def calculate_spectrum(
     return top_list, score_list
 
 
+@contract(normal_graph="any", abnormal_graph="any")
 def rank_window_dicts(
     normal_graph,
     abnormal_graph,

@@ -26,6 +26,7 @@ from typing import List, Tuple
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..config import PageRankConfig, SpectrumConfig
 from ..graph.structures import PartitionGraph, WindowGraph
 from .numpy_ref import spectrum_score
@@ -167,6 +168,7 @@ def _partition_rank(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
     return weight, trace_num, p
 
 
+@contract(graph="windowgraph")
 def rank_window_sparse(
     graph: WindowGraph,
     op_names: List[str],

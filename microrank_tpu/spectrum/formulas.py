@@ -16,6 +16,8 @@ from typing import Callable, Dict
 
 import jax.numpy as jnp
 
+from ..analysis.contracts import contract
+
 
 def _dstar2(ef, nf, ep, np_):
     return ef * ef / (ep + nf)
@@ -89,6 +91,13 @@ FORMULAS: Dict[str, Callable] = {
 METHODS = tuple(k for k in FORMULAS if k != "simplematching")
 
 
+@contract(
+    ef="float32[V]",
+    nf="float32[V]",
+    ep="float32[V]",
+    np_="float32[V]",
+    returns="float32[V]",
+)
 def spectrum_scores(ef, nf, ep, np_, method: str):
     """Vectorized spectrum score for one (static) method name."""
     try:

@@ -5,9 +5,19 @@ remains is numeric — NaN/inf escaping a division in the preference vector
 or a spectrum formula. Backends validate fetched scores by default
 (``RuntimeConfig.validate_numerics``); for deep debugging, enable
 ``jax.config.update("jax_debug_nans", True)`` to trap the originating op.
+
+This module also holds the process-wide switch for the shape/dtype
+contracts on the rank/spectrum entry points
+(``analysis.contracts.contract``, mrlint rule R5): backends enter
+``contract_checks(cfg.runtime.validate_numerics)`` around dispatch, so
+one RuntimeConfig knob gates both the host-side score validation and
+the trace-time signature contracts.
 """
 
 from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -16,14 +26,54 @@ class NumericsError(RuntimeError):
     pass
 
 
+class ContractError(TypeError):
+    """A value violated an ``analysis.contracts.contract`` spec."""
+
+
+_state = threading.local()
+
+
+def contracts_enabled() -> bool:
+    """Whether @contract specs are enforced in this thread (default off —
+    the decorator is then a flag check)."""
+    return getattr(_state, "contracts", False)
+
+
+@contextmanager
+def contract_checks(enabled: bool):
+    """Enable/disable contract enforcement for the dynamic extent of the
+    block (thread-local — the async dispatch workers validate or skip
+    independently of the main thread)."""
+    prev = getattr(_state, "contracts", False)
+    _state.contracts = bool(enabled)
+    try:
+        yield
+    finally:
+        _state.contracts = prev
+
+
+def set_contract_checks(enabled: bool) -> None:
+    """Imperative form of :func:`contract_checks` (process setup paths)."""
+    _state.contracts = bool(enabled)
+
+
 def assert_finite_scores(scores, context: str) -> None:
-    """Raise if any ranked score is NaN or infinite."""
-    arr = np.asarray(scores, dtype=np.float64)
+    """Raise NumericsError if any ranked score is NaN/inf — or if the
+    scores cannot be interpreted as numbers at all (a corrupted fetch
+    should fail as a numerics error at the validation boundary, not as
+    a numpy cast error deep in the caller)."""
+    try:
+        arr = np.asarray(scores, dtype=np.float64)
+    except (TypeError, ValueError) as e:
+        raise NumericsError(
+            f"non-numeric ranking scores in {context}: {e}"
+        ) from None
     bad = ~np.isfinite(arr)
     if bad.any():
-        idx = np.flatnonzero(bad)[:5].tolist()
+        idx = np.flatnonzero(bad.reshape(-1))[:5].tolist()
+        flat = arr.reshape(-1)
         raise NumericsError(
             f"non-finite ranking scores in {context}: positions {idx} of "
-            f"{arr.size} (values {[float(arr[i]) for i in idx]}); enable "
+            f"{arr.size} (values {[float(flat[i]) for i in idx]}); enable "
             "jax_debug_nans to locate the producing op"
         )

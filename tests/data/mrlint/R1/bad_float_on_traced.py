@@ -1,0 +1,10 @@
+"""R1 true positive: float() on a traced value inside a jitted function."""
+import jax
+
+
+def scale_by_host(x):
+    s = float(x)  # host sync on a tracer
+    return x * s
+
+
+scale_jit = jax.jit(scale_by_host)

@@ -1,0 +1,11 @@
+"""R1 true positive: .item() on a traced value inside a decorated jit."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def pick(x, mode):
+    limit = x.max().item()  # device->host sync per call
+    return jnp.clip(x, 0.0, limit)

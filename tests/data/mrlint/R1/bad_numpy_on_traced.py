@@ -1,0 +1,17 @@
+"""R1 true positive: np.asarray on a traced value, reached transitively
+(the jitted root calls a helper that concretizes its argument)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _helper(v):
+    host = np.asarray(v)  # concretizes the tracer
+    return jnp.asarray(host.sum())
+
+
+def entry(x):
+    return _helper(x * 2.0)
+
+
+entry_jit = jax.jit(entry)

@@ -1,0 +1,20 @@
+"""R1 true negative: the same host conversions OUTSIDE any traced graph,
+plus static .shape/int() use INSIDE one."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def traced(x, cfg):
+    n = int(x.shape[0])  # shapes are static under tracing — fine
+    return jnp.sum(x) / n
+
+
+traced_jit = jax.jit(traced, static_argnames=("cfg",))
+
+
+def host_fetch(x):
+    # Not jitted, not called from a traced function: float()/np.asarray
+    # here are ordinary host code.
+    arr = np.asarray(x)
+    return float(arr.sum())

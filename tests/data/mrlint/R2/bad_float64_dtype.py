@@ -1,0 +1,8 @@
+"""R2 true positive: float64 dtypes in a jax-importing module."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen(x):
+    y = jnp.asarray(x, dtype=np.float64)  # upcasts the whole chain
+    return y.astype("float64")

@@ -1,0 +1,7 @@
+"""R2 true negative: a numpy-only float64 oracle (no jax import) — the
+sparse_oracle/numpy_ref pattern — is out of R2's scope by design."""
+import numpy as np
+
+
+def oracle(x):
+    return np.asarray(x, dtype=np.float64).sum()

@@ -1,0 +1,7 @@
+"""R3 true positive: jax.jit built per call — a fresh wrapper (and a
+fresh compile cache) every invocation."""
+import jax
+
+
+def run(fn, x):
+    return jax.jit(fn)(x)  # recompiles every call

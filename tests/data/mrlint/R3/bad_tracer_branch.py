@@ -1,0 +1,11 @@
+"""R3 true positive: Python `if` on a traced value."""
+import jax
+
+
+def relu_ish(x):
+    if x > 0:  # concretizes the tracer
+        return x
+    return -x
+
+
+relu_jit = jax.jit(relu_ish)

@@ -1,0 +1,14 @@
+"""R3 true positive: a list literal passed in a static jit position —
+static args are cache keys and must be hashable."""
+import jax
+
+
+def apply(x, opts):
+    return x
+
+
+apply_jit = jax.jit(apply, static_argnums=(1,))
+
+
+def call(x):
+    return apply_jit(x, [1, 2, 3])

@@ -1,0 +1,24 @@
+"""R3 true negatives: the module-cache jit idiom, a static branch on a
+config, and a hashable static call."""
+import jax
+
+
+def compute(x, mode):
+    return x if mode == "fwd" else -x  # mode is static — fine
+
+
+compute_jit = jax.jit(compute, static_argnums=(1,))
+
+
+def call(x):
+    return compute_jit(x, "fwd")
+
+
+_CACHED = None
+
+
+def cached_jit():
+    global _CACHED
+    if _CACHED is None:
+        _CACHED = jax.jit(compute, static_argnums=(1,))
+    return _CACHED

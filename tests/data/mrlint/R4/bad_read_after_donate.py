@@ -1,0 +1,15 @@
+"""R4 true positive: a buffer read after being passed in a donated
+argument position."""
+import jax
+
+
+def bump(x):
+    return x + 1
+
+
+bump_donated = jax.jit(bump, donate_argnums=(0,))
+
+
+def run(x):
+    y = bump_donated(x)
+    return y + x  # x's buffer was handed to XLA — deleted by now

@@ -1,0 +1,16 @@
+"""R4 true negative: the donated buffer is never read after the call
+(reads before it are fine, as is donating the last use)."""
+import jax
+
+
+def bump(x):
+    return x + 1
+
+
+bump_donated = jax.jit(bump, donate_argnums=(0,))
+
+
+def run(x):
+    total = x.sum()  # read BEFORE donation — fine
+    y = bump_donated(x)
+    return y + total

@@ -1,0 +1,5 @@
+"""R5 true positive: a public rank entry point with no @contract."""
+
+
+def rank_window_plain(graph, cfg):
+    return graph, cfg

@@ -1,0 +1,16 @@
+"""R5 true negatives: a @contract-annotated entry point, and private /
+non-entry-point names that are out of scope."""
+from microrank_tpu.analysis.contracts import contract
+
+
+@contract(graph="windowgraph", returns=("int32[K]", "float32[K]", "int32[]"))
+def rank_window_annotated(graph, cfg):
+    return graph, cfg
+
+
+def _rank_window_private(graph):  # private: out of scope
+    return graph
+
+
+def build_graph(graph):  # not a rank/spectrum seam
+    return graph

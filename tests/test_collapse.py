@@ -93,6 +93,25 @@ def test_collapse_rank_parity_per_kernel(graphs, kernel):
     np.testing.assert_allclose(scores0, scores1, rtol=2e-3, atol=1e-5)
 
 
+@pytest.mark.parametrize(
+    "kernel", ["coo", "csr", "dense", "packed", "packed_blocked"]
+)
+def test_collapse_cross_kernel_parity(graphs, kernel):
+    """Regression pin for the csr collapse-parity failure: the synthetic
+    kind case holds an EXACT float64 score tie (ops 012/044 both at
+    47.798213540 under the oracle), and the csr kernel's plain-f32
+    global cumsum once rounded the two rows differently on the collapsed
+    entry layout, swapping them past the tie-break. With the compensated
+    prefix sum (ops.segment.compensated_cumsum) every kernel must
+    produce the SAME name ranking as the coo kernel on the uncollapsed
+    graph — on both the collapsed and uncollapsed builds."""
+    g0, g1, names, _ = graphs
+    base, _ = _ranked_names(g0, names, "coo")
+    for g in (g0, g1):
+        ranked, _ = _ranked_names(g, names, kernel)
+        assert ranked == base, kernel
+
+
 def test_collapsed_device_matches_uncollapsed_float64_oracle(graphs):
     g0, g1, names, _ = graphs
     top_o, _ = rank_window_sparse(g0, names, CFG.pagerank, CFG.spectrum)

@@ -54,7 +54,10 @@ def test_collect_requires_clickhouse(tmp_path):
 
 
 def test_manifest_toml_roundtrip(tmp_path):
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # 3.10: same API under the backport name
+        import tomli as tomllib
 
     from microrank_tpu.collect.clickhouse import manifest_toml
 

@@ -1,0 +1,93 @@
+"""utils/guards.py edge cases: assert_finite_scores boundary behavior and
+the thread-local contract switch."""
+
+import numpy as np
+import pytest
+
+from microrank_tpu.utils.guards import (
+    ContractError,
+    NumericsError,
+    assert_finite_scores,
+    contract_checks,
+    contracts_enabled,
+    set_contract_checks,
+)
+
+
+def test_empty_scores_pass():
+    assert_finite_scores([], "empty")
+    assert_finite_scores(np.zeros(0, np.float32), "empty-array")
+
+
+def test_finite_scores_pass():
+    assert_finite_scores([1.0, 2.5, -3.0], "ok")
+    assert_finite_scores(np.arange(5, dtype=np.float32), "ok-array")
+
+
+def test_all_nan_raises_with_positions():
+    with pytest.raises(NumericsError, match=r"positions \[0, 1, 2\]"):
+        assert_finite_scores([np.nan, np.nan, np.nan], "nan-case")
+
+
+def test_inf_only_raises():
+    with pytest.raises(NumericsError, match="inf"):
+        assert_finite_scores([np.inf], "inf-case")
+    with pytest.raises(NumericsError, match="-inf"):
+        assert_finite_scores([-np.inf], "neg-inf-case")
+
+
+def test_mixed_reports_first_five_bad_positions():
+    scores = [0.0, np.nan, 1.0, np.inf, np.nan, np.nan, np.nan, np.nan]
+    with pytest.raises(NumericsError, match=r"positions \[1, 3, 4, 5, 6\]"):
+        assert_finite_scores(scores, "mixed")
+
+
+def test_scalar_nan_raises():
+    with pytest.raises(NumericsError):
+        assert_finite_scores(np.float64("nan"), "scalar")
+
+
+def test_non_array_input_raises_numerics_error():
+    # A corrupted fetch should surface as a numerics failure at the
+    # validation boundary, not a numpy cast error deep in the caller.
+    with pytest.raises(NumericsError, match="non-numeric"):
+        assert_finite_scores(["not", "numbers"], "strings")
+    with pytest.raises(NumericsError, match="non-numeric"):
+        assert_finite_scores(object(), "object")
+
+
+def test_context_names_the_failure_site():
+    with pytest.raises(NumericsError, match="JaxBackend.rank_window"):
+        assert_finite_scores([np.nan], "JaxBackend.rank_window")
+
+
+def test_contract_switch_defaults_off_and_restores():
+    assert not contracts_enabled()
+    with contract_checks(True):
+        assert contracts_enabled()
+        with contract_checks(False):
+            assert not contracts_enabled()
+        assert contracts_enabled()
+    assert not contracts_enabled()
+
+
+def test_contract_switch_restores_on_error():
+    with pytest.raises(RuntimeError):
+        with contract_checks(True):
+            raise RuntimeError("boom")
+    assert not contracts_enabled()
+
+
+def test_set_contract_checks_imperative():
+    set_contract_checks(True)
+    try:
+        assert contracts_enabled()
+    finally:
+        set_contract_checks(False)
+    assert not contracts_enabled()
+
+
+def test_contract_error_is_type_error():
+    # Callers catching TypeError (the natural category for a signature
+    # violation) see contract failures too.
+    assert issubclass(ContractError, TypeError)

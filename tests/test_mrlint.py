@@ -1,0 +1,208 @@
+"""mrlint suite: per-rule fixture corpus, suppression semantics, the
+repo-tree cleanliness gate, and the trace-time contract checker.
+
+Fixture layout: tests/data/mrlint/<RULE>/bad_*.py must fire <RULE>;
+good_*.py must not. The tree gate is the PR invariant the CLI enforces
+(`python -m microrank_tpu.cli lint microrank_tpu/` exits 0).
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from microrank_tpu.analysis import RULES, lint_paths, lint_source
+
+DATA = Path(__file__).parent / "data" / "mrlint"
+REPO_PKG = Path(__file__).parent.parent / "microrank_tpu"
+
+_FIXTURES = sorted(
+    (rule_dir.name, f)
+    for rule_dir in DATA.iterdir()
+    if rule_dir.is_dir()
+    for f in rule_dir.glob("*.py")
+)
+
+
+def _rules_fired(path: Path):
+    return {v.rule for v in lint_paths([str(path)])}
+
+
+def test_rule_catalog_complete():
+    assert set(RULES) == {"R1", "R2", "R3", "R4", "R5"}
+    for rule in RULES.values():
+        assert rule.slug and rule.summary
+
+
+def test_every_rule_has_positive_and_negative_fixtures():
+    by_rule = {}
+    for rule, f in _FIXTURES:
+        by_rule.setdefault(rule, set()).add(f.name.split("_")[0])
+    for rule in RULES:
+        assert by_rule.get(rule) == {"bad", "good"}, (
+            f"{rule} needs at least one bad_* and one good_* fixture"
+        )
+
+
+@pytest.mark.parametrize(
+    "rule,path",
+    [(r, f) for r, f in _FIXTURES],
+    ids=[f"{r}-{f.stem}" for r, f in _FIXTURES],
+)
+def test_fixture(rule, path):
+    fired = _rules_fired(path)
+    if path.name.startswith("bad_"):
+        assert rule in fired, f"{path.name} should trigger {rule}"
+    else:
+        assert rule not in fired, f"{path.name} should not trigger {rule}"
+
+
+def test_repo_tree_is_clean():
+    """The PR invariant: the package lints clean (violations are fixed
+    or carry a justified # mrlint: disable=...)."""
+    violations = lint_paths([str(REPO_PKG)])
+    assert violations == [], "\n".join(v.format() for v in violations)
+
+
+def test_cli_lint_exits_zero_on_tree(capsys):
+    from microrank_tpu.cli.main import main
+
+    assert main(["lint", str(REPO_PKG)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_lint_exits_nonzero_on_bad(capsys):
+    from microrank_tpu.cli.main import main
+
+    bad = DATA / "R3" / "bad_tracer_branch.py"
+    assert main(["lint", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "R3" in out and "finding" in out
+
+
+_BAD_SNIPPET = """\
+import jax
+
+
+def f(x):
+    return x * float(x)
+{pragma}
+
+f_jit = jax.jit(f)
+"""
+
+
+def test_disable_with_reason_suppresses():
+    src = _BAD_SNIPPET.format(pragma="").replace(
+        "return x * float(x)",
+        "return x * float(x)  # mrlint: disable=R1(fixture: known sync)",
+    )
+    assert all(v.rule != "R1" for v in lint_source(src))
+
+
+def test_disable_on_preceding_line_suppresses():
+    src = _BAD_SNIPPET.format(pragma="").replace(
+        "    return x * float(x)",
+        "    # mrlint: disable=R1(fixture: known sync)\n"
+        "    return x * float(x)",
+    )
+    assert all(v.rule != "R1" for v in lint_source(src))
+
+
+def test_bare_disable_reported_as_r0():
+    src = _BAD_SNIPPET.format(pragma="").replace(
+        "return x * float(x)",
+        "return x * float(x)  # mrlint: disable=R1",
+    )
+    rules = {v.rule for v in lint_source(src)}
+    assert "R0" in rules and "R1" not in rules
+
+
+def test_wrong_rule_disable_does_not_suppress():
+    src = _BAD_SNIPPET.format(pragma="").replace(
+        "return x * float(x)",
+        "return x * float(x)  # mrlint: disable=R2(wrong rule)",
+    )
+    assert "R1" in {v.rule for v in lint_source(src)}
+
+
+# ---------------------------------------------------------------- contracts
+
+
+def test_contract_disabled_by_default():
+    from microrank_tpu.spectrum.formulas import spectrum_scores
+
+    a = np.ones(4, np.float32)
+    bad = np.ones(4, np.float64)
+    # No enforcement outside contract_checks: promotes silently.
+    assert str(spectrum_scores(a, a, a, bad, "dstar2").dtype) == "float32"
+
+
+def test_contract_dtype_and_dim_unification():
+    from microrank_tpu.spectrum.formulas import spectrum_scores
+    from microrank_tpu.utils.guards import ContractError, contract_checks
+
+    a = np.ones(4, np.float32)
+    with contract_checks(True):
+        out = spectrum_scores(a, a, a, a, "dstar2")
+        assert str(out.dtype) == "float32"
+        with pytest.raises(ContractError, match="dtype float64"):
+            spectrum_scores(a, a, a, np.ones(4, np.float64), "dstar2")
+        with pytest.raises(ContractError, match="conflicts"):
+            spectrum_scores(a, a, a, np.ones(5, np.float32), "dstar2")
+
+
+def test_contract_on_rank_entry_point_trace_time():
+    """The jitted rank path traces under an armed contract: a graph whose
+    field dtype drifted from the structures.py layout is rejected before
+    compilation."""
+    import dataclasses
+
+    import jax
+
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.graph.build import build_window_graph
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_core
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+    from microrank_tpu.utils.guards import ContractError, contract_checks
+
+    cfg = MicroRankConfig()
+    case = generate_case(
+        SyntheticConfig(n_operations=8, n_kinds=4, n_traces=24, seed=0)
+    )
+    ids = sorted(set(case.abnormal["traceID"]))
+    graph, names, _, _ = build_window_graph(
+        case.abnormal, ids[::2], ids[1::2], aux="all"
+    )
+    with contract_checks(True):
+        top_idx, top_scores, n_valid = rank_window_core(
+            graph, cfg.pagerank, cfg.spectrum, None, "coo"
+        )
+        assert str(np.asarray(top_scores).dtype) == "float32"
+
+        drifted = graph._replace(
+            normal=graph.normal._replace(
+                sr_val=np.asarray(graph.normal.sr_val, np.float64)
+            )
+        )
+        with pytest.raises(ContractError, match="sr_val"):
+            rank_window_core(drifted, cfg.pagerank, cfg.spectrum, None, "coo")
+
+
+def test_contract_spec_parser_rejects_garbage():
+    from microrank_tpu.analysis.contracts import parse_spec
+
+    with pytest.raises(ValueError):
+        parse_spec("float32[K")
+    spec = parse_spec("int32[B,K]")
+    assert spec.dims == ("b", "k") or spec.dims == ("B", "K")
+
+
+def test_contract_decorator_rejects_unknown_param():
+    from microrank_tpu.analysis.contracts import contract
+
+    with pytest.raises(ValueError, match="unknown parameters"):
+
+        @contract(nope="float32[K]")
+        def f(x):
+            return x
