@@ -55,6 +55,44 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_SENTINEL = None
+
+
+def _host_sentinel():
+    """Process-wide contention sentinel (obs.host): loadavg + CPU-steal
+    sampling so a bench artifact recorded on a contended host carries
+    its own asterisk (round 5's replay was silently 1.7x slower in the
+    artifact of record because host contention was invisible)."""
+    global _SENTINEL
+    if _SENTINEL is None:
+        from microrank_tpu.obs.host import ContentionSentinel
+
+        _SENTINEL = ContentionSentinel()
+        _SENTINEL.sample()  # arm the steal differencing
+    return _SENTINEL
+
+
+def _host_fields(start_sample, end_sample) -> dict:
+    """The artifact's self-flagging host block: both samples plus a
+    headline `contended` bool (either end saw load/steal pressure)."""
+    contended = bool(
+        start_sample.get("contended") or end_sample.get("contended")
+    )
+    if contended:
+        log(
+            "WARNING: host contended during the bench "
+            f"(start {start_sample}, end {end_sample}) — treat the "
+            "headline as a lower bound"
+        )
+    return {
+        "host": {
+            "start": start_sample,
+            "end": end_sample,
+            "contended": contended,
+        }
+    }
+
+
 def _ensure_data(spans_target, n_ops, fault_ms):
     """Generate (or reuse) the cached chaos-case CSV pair."""
     root = Path(__file__).parent / "bench_data"
@@ -450,6 +488,8 @@ def _run_batched(
     import jax
     import numpy as np
 
+    host_start = _host_sentinel().sample()
+
     from microrank_tpu.graph.build import aux_for_kernel
     from microrank_tpu.graph.table_ops import build_window_graph_from_table
     from microrank_tpu.parallel import stack_window_graphs
@@ -568,6 +608,7 @@ def _run_batched(
                 "rank_ms": round(rank_s * 1e3, 1),
                 "staging_ms": round(stage_s * 1e3, 1),
                 "compile_ms": round(max(first_s - rank_s, 0.0) * 1e3, 1),
+                **_host_fields(host_start, _host_sentinel().sample()),
             }
         )
     )
@@ -625,6 +666,7 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
     )
     rca = TableRCA(cfg)
     rca.fit_baseline(normal_table)
+    host_start = _host_sentinel().sample()
     t0 = time.perf_counter()
     rca.run(table)
     warm_s = time.perf_counter() - t0
@@ -655,12 +697,68 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
         f"aggregate; fault top-1 in {hits}/{len(ranked)} windows; "
         f"{replay_s * 1e3 / len(ranked):.0f}ms/window"
     )
+    from microrank_tpu.obs.metrics import snapshot_to_result_fields
+
+    # One more (untimed) pass with an output dir when asked: produces
+    # the run journal + metrics snapshot for this exact workload and
+    # reconciles its per-window telemetry against the replay headline
+    # (BENCH_JOURNAL_DIR=path; kept off the timed passes so journaling
+    # cannot skew the number it documents).
+    journal_fields = {}
+    jdir = os.environ.get("BENCH_JOURNAL_DIR")
+    if jdir:
+        from microrank_tpu.obs import read_journal
+
+        rca.run(table, out_dir=jdir)
+        events = read_journal(Path(jdir) / "journal.jsonl")
+        windows = [
+            e for e in events
+            if e["event"] == "window" and e.get("outcome") == "ranked"
+        ]
+        iters = [w.get("rank_iterations") for w in windows]
+
+        def _rank_ms(w):
+            # StageTimings keys are seconds; the *_ms fetch-amortization
+            # keys are already milliseconds.
+            ms = 0.0
+            for k, v in (w.get("timings") or {}).items():
+                if k.endswith("_ms"):
+                    ms += v
+                elif k.startswith("rank"):
+                    ms += v * 1e3
+            return ms
+
+        rank_ms = [_rank_ms(w) for w in windows]
+        journal_fields = {
+            "journal_windows": len(windows),
+            "journal_iterations_total": sum(i or 0 for i in iters),
+            "journal_rank_ms_per_window": round(
+                sum(rank_ms) / max(len(windows), 1), 1
+            ),
+            "journal_dir": jdir,
+        }
+        log(
+            f"journal reconciliation: {len(windows)} ranked windows, "
+            f"{sum(i or 0 for i in iters)} device iterations, "
+            f"{journal_fields['journal_rank_ms_per_window']:.0f} "
+            "rank-ms/window (vs replay "
+            f"{replay_s * 1e3 / len(ranked):.0f} ms/window)"
+        )
+
     return {
+        **journal_fields,
         "replay_spans_per_sec": round(sps, 1),
         "replay_windows": len(ranked),
         "replay_ms": round(replay_s * 1e3, 1),
         "replay_ms_per_window": round(replay_s * 1e3 / len(ranked), 1),
         "replay_fault_hits": hits,
+        # Telemetry accumulated by the replay's product path (the
+        # TableRCA run records staging bytes + jit retraces): a retrace
+        # count that grows with the window count is a compile storm.
+        "replay_telemetry": snapshot_to_result_fields(),
+        "replay_host": _host_fields(host_start, _host_sentinel().sample())[
+            "host"
+        ],
     }
 
 
@@ -693,7 +791,8 @@ def main() -> int:
     from microrank_tpu.rank_backends.jax_tpu import JaxBackend, choose_kernel
 
     _enable_compile_cache()
-    log(f"devices: {jax.devices()}")
+    host_start = _host_sentinel().sample()
+    log(f"devices: {jax.devices()}; host load {host_start['norm_load']}")
     if not native_available():
         log("FATAL: native span loader unavailable (g++ missing?)")
         return 1
@@ -989,6 +1088,7 @@ def main() -> int:
             else {}
         ),
         **({"device": device_profile} if device_profile else {}),
+        **_host_fields(host_start, _host_sentinel().sample()),
     }
 
     # Pipelined replay over a multi-window timeline: the aggregate

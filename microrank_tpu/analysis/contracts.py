@@ -96,11 +96,27 @@ class GraphSpec:
 
 
 @dataclass(frozen=True)
+class DetectBatchSpec:
+    """Dtype contract over a DetectBatch (the detector's input seam)."""
+
+
+@dataclass(frozen=True)
 class AnySpec:
     pass
 
 
-Spec = Union[ArraySpec, GraphSpec, AnySpec]
+Spec = Union[ArraySpec, GraphSpec, DetectBatchSpec, AnySpec]
+
+# The canonical DetectBatch field dtypes (graph/structures.py) — the
+# detector seam's data contract (spec "detectbatch"). op/trace span
+# arrays must share one extent; the n_* extents are 0-d int32.
+DETECT_FIELD_DTYPES: Dict[str, str] = {
+    "op": "int32",
+    "trace": "int32",
+    "duration_us": "float32",
+    "n_spans": "int32",
+    "n_traces": "int32",
+}
 
 
 def parse_spec(text: str) -> Spec:
@@ -109,6 +125,8 @@ def parse_spec(text: str) -> Spec:
         return AnySpec()
     if t.lower() == "windowgraph":
         return GraphSpec()
+    if t.lower() == "detectbatch":
+        return DetectBatchSpec()
     m = _SPEC_RE.match(t)
     if not m:
         raise ValueError(f"unparseable contract spec {text!r}")
@@ -136,6 +154,42 @@ def check_value(value, spec: Spec, where: str, env: Dict[str, int]) -> None:
     """Validate one value against one spec, unifying symbolic dims into
     ``env``. Raises ContractError with the argument/return path named."""
     if isinstance(spec, AnySpec):
+        return
+    if isinstance(spec, DetectBatchSpec):
+        fields = getattr(value, "_fields", None)
+        if fields != tuple(DETECT_FIELD_DTYPES):
+            raise ContractError(
+                f"{where}: expected a DetectBatch, got "
+                f"{type(value).__name__}"
+            )
+        span_extent = None
+        for fname, want in DETECT_FIELD_DTYPES.items():
+            field = getattr(value, fname)
+            got = _dtype_name(field)
+            if got != want:
+                raise ContractError(
+                    f"{where}.{fname}: dtype {got} != contract {want} "
+                    "(the detector seam's layout in graph/structures.py)"
+                )
+            shape = tuple(getattr(field, "shape", ()))
+            if fname in ("op", "trace", "duration_us"):
+                if len(shape) != 1:
+                    raise ContractError(
+                        f"{where}.{fname}: rank {len(shape)} != 1 "
+                        "(padded span axis)"
+                    )
+                if span_extent is None:
+                    span_extent = shape[0]
+                elif shape[0] != span_extent:
+                    raise ContractError(
+                        f"{where}.{fname}: span axis {shape[0]} != "
+                        f"{span_extent} bound by a sibling field"
+                    )
+            elif shape != ():
+                raise ContractError(
+                    f"{where}.{fname}: expected a 0-d extent, got "
+                    f"shape {shape}"
+                )
         return
     if isinstance(spec, GraphSpec):
         parts = getattr(value, "_fields", None)

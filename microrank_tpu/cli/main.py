@@ -7,6 +7,7 @@ instructs editing the file). Here:
     python -m microrank_tpu.cli run    --normal N.csv --abnormal A.csv -o out/
     python -m microrank_tpu.cli synth  -o data/ --operations 100 --traces 500
     python -m microrank_tpu.cli eval   --cases 40 [--faults 2] [--detection]
+    python -m microrank_tpu.cli stats  out/       (telemetry exposition)
     python -m microrank_tpu.cli collect ...       (optional ClickHouse export)
 
 (The benchmark lives at the repo root — ``python bench.py`` — because it
@@ -143,6 +144,55 @@ def _config_from_args(args) -> "MicroRankConfig":
     return cfg
 
 
+def cmd_stats(args) -> int:
+    """Offline metrics exposition: re-emit a finished run's snapshot
+    (``metrics.json`` written at run end) as Prometheus text or JSON,
+    and summarize the run journal when present."""
+    import os
+
+    from ..obs import read_journal, registry_from_json
+    from ..obs.journal import JOURNAL_NAME
+
+    target = Path(args.target)
+    snap_path = target / "metrics.json" if target.is_dir() else target
+    if not snap_path.exists():
+        print(
+            f"no metrics snapshot at {snap_path} (run `cli run -o "
+            f"{target}` first, or point at a metrics.json)",
+            file=sys.stderr,
+        )
+        return 2
+    data = json.loads(snap_path.read_text())
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+    else:
+        # Round-trip through the registry so the text form is generated
+        # by the same exposition code the live endpoint uses.
+        print(registry_from_json(data).to_prometheus(), end="")
+    if args.journal:
+        jpath = (
+            target / JOURNAL_NAME
+            if target.is_dir()
+            else target.parent / JOURNAL_NAME
+        )
+        events = read_journal(jpath)
+        if events:
+            windows = [e for e in events if e["event"] == "window"]
+            ranked = [w for w in windows if w.get("outcome") == "ranked"]
+            contended = sum(
+                1
+                for w in windows
+                if (w.get("host") or {}).get("contended")
+            )
+            print(
+                f"# journal: {len(windows)} windows ({len(ranked)} "
+                f"ranked), {contended} contended samples, "
+                f"{os.path.getsize(jpath)} bytes",
+                file=sys.stderr,
+            )
+    return 0
+
+
 def cmd_run(args) -> int:
     from ..utils.logging import get_logger
 
@@ -185,6 +235,27 @@ def cmd_run(args) -> int:
             )
 
     cfg = _config_from_args(args)
+    if getattr(args, "metrics_port", None) is not None and primary:
+        from ..obs.server import start_metrics_server
+
+        server = start_metrics_server(args.metrics_port)
+        log.info(
+            "metrics endpoint: http://127.0.0.1:%d/metrics (+ "
+            "/metrics.json, /healthz)",
+            server.port,
+        )
+
+    def _write_metrics(dest) -> None:
+        """Persist the metrics snapshot next to the results so
+        `cli stats <out_dir>` works after the process exits."""
+        if dest is None or not cfg.runtime.telemetry:
+            return
+        from ..obs import get_registry
+        from ..obs.metrics import ensure_catalog
+
+        ensure_catalog()
+        get_registry().write_snapshot(dest)
+
     if (
         getattr(args, "bulk_fetch_windows", None) is not None
         and cfg.runtime.fetch_mode != "bulk"
@@ -307,6 +378,7 @@ def cmd_run(args) -> int:
                     on_results=_print_batch,
                 )
             log.info("follow: %d windows ranked; results in %s", n, out_dir)
+            _write_metrics(out_dir)
             return 0
         with trace_context(profile_dir):
             results = rca.run(
@@ -346,6 +418,7 @@ def cmd_run(args) -> int:
         with trace_context(profile_dir):
             results = rca.run(abnormal, out_dir=out_dir, resume=args.resume)
     n_anom = sum(r.anomaly for r in results)
+    _write_metrics(out_dir)
     log.info(
         "processed %d windows, %d anomalous; results in %s",
         len(results),
@@ -584,6 +657,13 @@ def main(argv=None) -> int:
         "file growth (default: follow forever)",
     )
     p_run.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve live telemetry over HTTP on this port (127.0.0.1): "
+        "/metrics (Prometheus text), /metrics.json, /healthz; 0 picks "
+        "a free port. The snapshot is also written to -o at run end "
+        "for offline `stats`",
+    )
+    p_run.add_argument(
         "--distributed", action="store_true",
         help="join a multi-host jax.distributed runtime before any "
         "device work (coordinator from --coordinator or "
@@ -671,6 +751,26 @@ def main(argv=None) -> int:
         help="concurrent ClickHouse queries (reference: Semaphore(2))",
     )
     p_col.set_defaults(fn=cmd_collect)
+
+    p_stats = sub.add_parser(
+        "stats",
+        help="re-emit a finished run's metrics snapshot (Prometheus "
+        "text or JSON) and summarize its journal",
+    )
+    p_stats.add_argument(
+        "target",
+        help="a run output directory (reads metrics.json there) or a "
+        "metrics.json path",
+    )
+    p_stats.add_argument(
+        "--format", choices=["prom", "json"], default="prom",
+        help="exposition format (default: Prometheus text)",
+    )
+    p_stats.add_argument(
+        "--journal", action="store_true",
+        help="also print a one-line journal summary to stderr",
+    )
+    p_stats.set_defaults(fn=cmd_stats)
 
     from ..analysis.cli import add_lint_parser
 

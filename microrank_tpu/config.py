@@ -177,6 +177,19 @@ class RuntimeConfig:
     # Validate fetched ranking scores for NaN/inf (nearly free: results are
     # already on host when checked).
     validate_numerics: bool = True
+    # Carry the per-partition power-iteration residual trace and the
+    # iterations-to-tolerance count out of the jitted rank program
+    # (rank_window_traced_core) inside the existing result fetch — no
+    # extra host sync or RPC; the per-step cost is an O(V+T) delta next
+    # to the matvecs (<1% measured). Off: the plain 3-output program.
+    convergence_trace: bool = True
+    # Pipeline-level telemetry: per-run JSONL journal (out_dir/
+    # journal.jsonl — one event per window with timings, convergence,
+    # queue depth and a host-contention sample) plus the metrics
+    # snapshot (metrics.json/.prom) written at run end for `cli stats`.
+    # The metrics registry itself (obs.registry) always records; this
+    # gates the file outputs.
+    telemetry: bool = True
     # Additionally assert the finite-score invariant INSIDE the compiled
     # program via jax.experimental.checkify (rank_window_checked) —
     # catches NaN/inf at the device boundary with the failing check

@@ -31,6 +31,7 @@ from typing import FrozenSet, Iterable, List, Tuple
 import numpy as np
 import pandas as pd
 
+from ..analysis.contracts import contract
 from ..io.interning import Vocab
 from ..io.naming import operation_names
 from ..io.schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES
@@ -799,6 +800,7 @@ def collapse_window_graph(
     return WindowGraph(normal=new_parts[0], abnormal=new_parts[1])
 
 
+@contract(returns=("detectbatch", "any"))
 def build_detect_batch(
     span_df: pd.DataFrame,
     slo_vocab: Vocab,
@@ -811,6 +813,11 @@ def build_detect_batch(
     Service-level naming (the detector/SLO vocab); ops unseen in the SLO
     baseline get id -1 and contribute 0 expected duration — the reference's
     bare-``except`` behavior (anormaly_detector.py:66-67).
+
+    The ``detectbatch`` return contract (armed behind
+    RuntimeConfig.validate_numerics like the rank seams) machine-checks
+    the detector's input layout: int32 op/trace + float32 duration on a
+    shared padded span axis, 0-d int32 extents.
     """
     names = operation_names(span_df, "service", strip_services)
     op = slo_vocab.encode_series(names)

@@ -14,6 +14,7 @@ from typing import Iterable, List, Tuple
 
 import numpy as np
 
+from ..analysis.contracts import contract
 from ..io.interning import Vocab
 from .build import (
     DEFAULT_DENSE_BUDGET_BYTES,
@@ -105,6 +106,7 @@ def _slice_table(table, lo: int, hi: int):
     )
 
 
+@contract(returns=("detectbatch", "any"))
 def detect_batch_from_table(
     table,
     mask: np.ndarray,
@@ -117,6 +119,8 @@ def detect_batch_from_table(
     Returns (batch, trace_codes) where trace_codes[i] is the table-global
     trace id of window-local trace i. The table's svc-op ids are remapped
     into the SLO vocab (unseen -> -1, the reference's bare-except rule).
+    The ``detectbatch`` contract (armed behind validate_numerics)
+    machine-checks the layout, same as the pandas-lane builder.
     """
     rows = np.flatnonzero(mask)
     remap = slo_vocab.encode(table.svc_op_names)

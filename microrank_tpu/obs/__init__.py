@@ -1,0 +1,35 @@
+"""End-to-end telemetry: metrics registry, run journal, host sentinel.
+
+The observability seam the rest of the framework records into —
+see ``registry`` (Counter/Gauge/Histogram + Prometheus/JSON exposition),
+``metrics`` (the canonical metric set + recording helpers), ``journal``
+(per-run JSONL event log), ``host`` (contention sentinel) and ``server``
+(the ``--metrics-port`` HTTP endpoint). ``cli stats`` re-exposes a
+finished run's snapshot offline.
+"""
+
+from .host import ContentionSentinel
+from .journal import JOURNAL_NAME, RunJournal, read_journal
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    registry_from_json,
+    set_registry,
+)
+
+__all__ = [
+    "ContentionSentinel",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JOURNAL_NAME",
+    "MetricsRegistry",
+    "RunJournal",
+    "get_registry",
+    "read_journal",
+    "registry_from_json",
+    "set_registry",
+]

@@ -1,0 +1,110 @@
+"""Per-run JSONL journal: one machine-readable event per window.
+
+``windows.jsonl`` (pipeline.results) records WHAT was ranked; the
+journal records HOW the run behaved — per-window timings, device
+convergence (iterations + residual), queue depth at dispatch, and a
+host-contention sample — so a replay whose throughput was quietly eaten
+by host load (the round-5 artifact undersold the build 1.7x exactly this
+way) is self-flagging. Events:
+
+* ``run_start`` — config digest (backend/kernel/pad_policy/...), host
+  snapshot, schema version;
+* ``window`` — one per emitted WindowResult: bounds, outcome, partition
+  sizes, timings dict, rank_iterations / rank_residual (device
+  convergence trace), kernel, queue_depth, host sample;
+* ``follow_poll`` — one per follow-mode poll: size, horizon, counters;
+* ``run_end`` — totals + a flat telemetry summary (retraces, staged
+  bytes).
+
+The writer appends line-buffered JSON under a lock (the async fetch
+worker can finalize windows while the main thread emits); every event
+carries ``ts`` (epoch seconds) and ``schema``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+JOURNAL_NAME = "journal.jsonl"
+
+
+class RunJournal:
+    """Append-only JSONL event writer for one pipeline run."""
+
+    def __init__(self, path, sentinel=None):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        if sentinel is None:
+            from .host import ContentionSentinel
+
+            sentinel = ContentionSentinel()
+        self.sentinel = sentinel
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {"event": event, "ts": time.time(),
+               "schema": SCHEMA_VERSION, **fields}
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+
+    def run_start(self, **config_fields) -> None:
+        self.emit("run_start", host=self.sentinel.sample(), **config_fields)
+
+    def window(self, result, queue_depth: Optional[int] = None) -> None:
+        """One emitted WindowResult -> one journal event. Samples host
+        contention inline (two syscalls + one /proc read)."""
+        outcome = (
+            "ranked" if result.ranking
+            else ("skipped" if result.skipped_reason else "clean")
+        )
+        self.emit(
+            "window",
+            start=result.start,
+            end=result.end,
+            anomaly=bool(result.anomaly),
+            outcome=outcome,
+            skipped_reason=result.skipped_reason,
+            n_traces=result.n_traces,
+            n_abnormal=result.n_abnormal,
+            timings=result.timings,
+            rank_iterations=result.rank_iterations,
+            rank_residual=result.rank_residual,
+            kernel=result.kernel,
+            queue_depth=(
+                queue_depth if queue_depth is not None
+                else result.queue_depth
+            ),
+            top1=(result.ranking[0][0] if result.ranking else None),
+            host=self.sentinel.sample(),
+        )
+
+    def run_end(self, **fields) -> None:
+        from .metrics import snapshot_to_result_fields
+
+        self.emit(
+            "run_end",
+            host=self.sentinel.sample(),
+            telemetry=snapshot_to_result_fields(),
+            **fields,
+        )
+
+
+def read_journal(path) -> list:
+    """Parse a journal back into event dicts (tests, ``cli stats``)."""
+    out = []
+    p = Path(path)
+    if not p.exists():
+        return out
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if line:
+            out.append(json.loads(line))
+    return out

@@ -1,0 +1,268 @@
+"""The repo's canonical metric set + the recording helpers hot paths call.
+
+Every metric the pipeline emits is declared here ONCE (name, help,
+labels, buckets), so exposition stays consistent across the backend, the
+table/pandas runners, follow mode and the bench — and ``cli stats`` can
+document what a snapshot contains by construction. Helpers are plain
+functions over the process registry; the hot-path cost is a dict lookup
+plus a locked float add.
+
+Naming: ``microrank_<noun>_<unit>`` with ``_total`` on counters, the
+Prometheus convention.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .registry import Counter, Gauge, Histogram, get_registry
+
+# Iteration-count buckets: the reference runs exactly 25; tol runs vary.
+ITER_BUCKETS = (1, 2, 4, 8, 12, 16, 20, 25, 32, 50, 100, 200)
+# Residuals decay geometrically from O(1); log-spaced down to f32 noise.
+RESIDUAL_BUCKETS = tuple(10.0 ** -e for e in range(12, -1, -1))
+BYTE_BUCKETS = tuple(float(1 << s) for s in range(10, 34, 2))
+
+
+def stage_seconds() -> Histogram:
+    return get_registry().histogram(
+        "microrank_stage_seconds",
+        "Wall-clock of each pipeline stage (StageTimings feed)",
+        labelnames=("stage",),
+    )
+
+
+def windows_total() -> Counter:
+    return get_registry().counter(
+        "microrank_windows_total",
+        "Detection windows processed, by outcome",
+        labelnames=("outcome",),  # ranked | clean | skipped
+    )
+
+
+def rank_iterations() -> Histogram:
+    return get_registry().histogram(
+        "microrank_rank_iterations",
+        "Power-iteration steps per ranked window (device-side trace)",
+        labelnames=("kernel",),
+        buckets=ITER_BUCKETS,
+    )
+
+
+def rank_final_residual() -> Histogram:
+    return get_registry().histogram(
+        "microrank_rank_final_residual",
+        "Final L-inf power-iteration residual per ranked window "
+        "(max over both partitions)",
+        labelnames=("kernel",),
+        buckets=RESIDUAL_BUCKETS,
+    )
+
+
+def staged_bytes() -> Counter:
+    return get_registry().counter(
+        "microrank_staged_bytes_total",
+        "Host->device bytes staged for rank programs",
+        labelnames=("path",),  # blob | tree | sharded
+    )
+
+
+def staged_pad_bytes() -> Counter:
+    return get_registry().counter(
+        "microrank_staged_pad_bytes_total",
+        "Estimated padding-waste bytes inside staged graphs "
+        "(pad_policy overhead: padded minus true extents)",
+        labelnames=("path",),
+    )
+
+
+def staging_transfers() -> Counter:
+    return get_registry().counter(
+        "microrank_staging_transfers_total",
+        "Host->device staging transfers issued",
+        labelnames=("path",),
+    )
+
+
+def jit_retraces() -> Counter:
+    return get_registry().counter(
+        "microrank_jit_retraces_total",
+        "New jit cache entries per rank program (first compile counts; "
+        "a growing count across same-shaped windows is a compile storm "
+        "— check pad_policy)",
+        labelnames=("program",),
+    )
+
+
+def pipeline_inflight() -> Gauge:
+    return get_registry().gauge(
+        "microrank_pipeline_inflight",
+        "Rank dispatches currently in flight (windows, or groups on the "
+        "chunked lane)",
+        labelnames=("lane",),  # window | chunk
+    )
+
+
+def follow_polls() -> Counter:
+    return get_registry().counter(
+        "microrank_follow_polls_total", "Follow-mode file polls"
+    )
+
+
+def follow_parse_failures() -> Counter:
+    return get_registry().counter(
+        "microrank_follow_parse_failures_total",
+        "Follow-mode ingest parse failures (torn tail lines retried)",
+    )
+
+
+def follow_rotations() -> Counter:
+    return get_registry().counter(
+        "microrank_follow_rotations_total",
+        "Follow-mode file rotations/truncations detected "
+        "(size < last seen size)",
+    )
+
+
+def host_load_gauge() -> Gauge:
+    return get_registry().gauge(
+        "microrank_host_norm_load",
+        "1-minute load average / CPU count at the last sample",
+    )
+
+
+def host_steal_gauge() -> Gauge:
+    return get_registry().gauge(
+        "microrank_host_steal_ratio",
+        "CPU steal fraction over the last sample interval",
+    )
+
+
+def ensure_catalog() -> None:
+    """Register the whole canonical metric set in the current registry
+    (no samples added). Snapshot/exposition paths call this so a scrape
+    or `cli stats` always shows the full catalog — a retrace counter at
+    its HELP/TYPE header with no growth is itself information."""
+    for ctor in (
+        stage_seconds, windows_total, rank_iterations,
+        rank_final_residual, staged_bytes, staged_pad_bytes,
+        staging_transfers, jit_retraces, pipeline_inflight,
+        follow_polls, follow_parse_failures, follow_rotations,
+        host_load_gauge, host_steal_gauge,
+    ):
+        ctor()
+
+
+# ---------------------------------------------------------------------------
+# Recording helpers
+
+
+def record_window_outcome(outcome: str) -> None:
+    windows_total().inc(outcome=outcome)
+
+
+def record_convergence(
+    kernel: str, n_iters: int, final_residual: float
+) -> None:
+    """Per-window convergence telemetry (host side, post-fetch)."""
+    rank_iterations().observe(float(n_iters), kernel=kernel)
+    if np.isfinite(final_residual):
+        rank_final_residual().observe(float(final_residual), kernel=kernel)
+
+
+def record_staging(
+    path: str, n_bytes: int, n_transfers: int, pad_bytes: int = 0
+) -> None:
+    staged_bytes().inc(float(n_bytes), path=path)
+    staging_transfers().inc(float(n_transfers), path=path)
+    if pad_bytes > 0:
+        staged_pad_bytes().inc(float(pad_bytes), path=path)
+
+
+def graph_staging_stats(graph) -> Tuple[int, int]:
+    """(total_bytes, est_pad_bytes) of a (possibly batched) WindowGraph.
+
+    Padding waste is estimated from the dynamic extents each axis family
+    carries (n_inc/n_ss/n_traces-or-n_cols/n_ops) against the padded
+    shapes — entry/trace/op vectors scale by their live fraction; bitmap
+    and indptr waste is folded in at the same last-axis ratio. An
+    estimate, not an audit: it exists to make pad_policy overhead a
+    counter instead of folklore.
+    """
+    total = 0
+    pad = 0
+    for part in (graph.normal, graph.abnormal):
+        t_live = np.where(
+            np.asarray(part.n_cols) >= 0, part.n_cols, part.n_traces
+        ).astype(np.int64)
+        n_inc = np.asarray(part.n_inc, dtype=np.int64)
+        n_ss = np.asarray(part.n_ss, dtype=np.int64)
+        n_ops = np.asarray(part.n_ops, dtype=np.int64)
+        # field -> live extent along its LAST axis (bitmaps in bytes).
+        live_of = {
+            "inc_op": n_inc, "inc_trace": n_inc, "sr_val": n_inc,
+            "rs_val": n_inc, "inc_trace_opmajor": n_inc,
+            "sr_val_opmajor": n_inc,
+            "ss_child": n_ss, "ss_parent": n_ss, "ss_val": n_ss,
+            "inv_tracelen": t_live, "kind": t_live, "tracelen": t_live,
+            "cov_bits": -(-t_live // 8), "ss_bits": -(-n_ops // 8),
+            "inv_cov_dup": n_ops, "inv_outdeg": n_ops,
+            "cov_unique": n_ops, "op_present": n_ops,
+            "inc_indptr_op": n_ops, "inc_indptr_trace": t_live,
+            "ss_indptr": n_ops,
+        }
+        for f in part._fields:
+            arr = np.asarray(getattr(part, f))
+            total += arr.nbytes
+            live = live_of.get(f)
+            if live is None or arr.ndim == 0 or arr.shape[-1] == 0:
+                continue
+            frac = float(
+                np.clip(1.0 - np.mean(live) / arr.shape[-1], 0.0, 1.0)
+            )
+            pad += int(arr.nbytes * frac)
+    return total, pad
+
+
+_jit_cache_sizes: Dict[str, int] = {}
+
+
+def record_retrace(program: str, jitted_fn) -> None:
+    """Count jit cache growth for a module-level jitted entry point.
+
+    Call AFTER a dispatch: if the wrapper's cache grew since the last
+    observation, the call traced+compiled (or reloaded from the
+    persistent cache) — either way, a new program shape. Counts the
+    first compile too; a flat counter across a replay is the healthy
+    signature, growth per window is the pad_policy="exact" storm.
+    """
+    counter = jit_retraces()  # register even when nothing grew — an
+    # exposed zero IS the healthy signal
+    size_fn = getattr(jitted_fn, "_cache_size", None)
+    if size_fn is None:  # older jax without the introspection hook
+        return
+    try:
+        size = int(size_fn())
+    except Exception:
+        return
+    prev = _jit_cache_sizes.get(program, 0)
+    if size > prev:
+        counter.inc(float(size - prev), program=program)
+    _jit_cache_sizes[program] = size
+
+
+def snapshot_to_result_fields(registry=None) -> Dict[str, float]:
+    """Small flat dict of headline telemetry (bench artifact embedding)."""
+    reg = registry or get_registry()
+    out: Dict[str, float] = {}
+    retr = reg.get("microrank_jit_retraces_total")
+    if retr is not None:
+        out["jit_retraces"] = sum(
+            s["value"] for s in retr.samples()
+        )
+    staged = reg.get("microrank_staged_bytes_total")
+    if staged is not None:
+        out["staged_bytes"] = sum(s["value"] for s in staged.samples())
+    return out

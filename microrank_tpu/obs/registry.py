@@ -1,0 +1,401 @@
+"""Low-overhead, thread-safe metrics registry (SURVEY.md §5 observability).
+
+The reference has zero self-instrumentation (its paper's Table 7
+latencies were measured externally); before this module the pipeline's
+only visibility was a per-window ``StageTimings`` dict and ad-hoc bench
+prints. Here every subsystem records into process-global Counter/Gauge/
+Histogram metrics, exposed two ways:
+
+* Prometheus text exposition (``MetricsRegistry.to_prometheus``) — the
+  format scrapers expect, served live by ``obs.server`` behind the CLI's
+  ``--metrics-port`` and re-emitted offline by ``cli stats``;
+* a JSON snapshot (``to_json``/``registry_from_json``) — written to the
+  run's output directory so a finished run's metrics survive the
+  process (``cli stats out_dir/`` round-trips it back to text form).
+
+Design constraints (the pipeline pushes ~12-20M spans/s — telemetry must
+cost <2% of replay throughput):
+
+* one ``threading.Lock`` per metric, held only for a dict update — the
+  async stage/fetch workers and the main thread record concurrently;
+* label values are joined into a tuple key at call time; no string
+  formatting happens until exposition;
+* metric registration is idempotent (``registry.counter(name, ...)``
+  returns the existing metric), so call sites just look up by name and
+  hot paths can cache the handle.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "registry_from_json",
+]
+
+# Latency-shaped default buckets (seconds): 100 us .. ~100 s.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 100.0,
+)
+
+_LABEL_ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _escape_label(value: str) -> str:
+    return "".join(_LABEL_ESCAPES.get(c, c) for c in str(value))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integers bare, +Inf spelled out."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+class _Metric:
+    """Shared label-keyed storage. Subclasses define the sample shape."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
+        pairs = [
+            f'{n}="{_escape_label(v)}"'
+            for n, v in zip(self.labelnames, key)
+        ]
+        if extra:
+            pairs.append(extra)
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+    # -- serialization ---------------------------------------------------
+    def samples(self) -> List[dict]:
+        with self._lock:
+            items = list(self._values.items())
+        return [
+            {"labels": dict(zip(self.labelnames, key)), "value": val}
+            for key, val in items
+        ]
+
+    def to_json(self) -> dict:
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "labelnames": list(self.labelnames),
+            "samples": self.samples(),
+        }
+
+    def expose(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, val in items:
+            lines.append(f"{self.name}{self._label_str(key)} {_fmt(val)}")
+        return lines
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (increments may be fractional)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return float(self._values.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    """A value that goes up and down (queue depth, load average)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return float(self._values.get(self._key(labels), 0.0))
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, labelnames)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs  # +Inf is implicit
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            state = self._values.get(key)
+            if state is None:
+                state = self._values[key] = {
+                    "counts": [0] * (len(self.buckets) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            # First bucket whose bound >= v (linear scan: bucket lists
+            # are short and this stays allocation-free).
+            i = len(self.buckets)
+            for j, b in enumerate(self.buckets):
+                if v <= b:
+                    i = j
+                    break
+            state["counts"][i] += 1
+            state["sum"] += v
+            state["count"] += 1
+
+    def snapshot(self, **labels: str) -> Optional[dict]:
+        with self._lock:
+            state = self._values.get(self._key(labels))
+            if state is None:
+                return None
+            return {
+                "counts": list(state["counts"]),
+                "sum": state["sum"],
+                "count": state["count"],
+            }
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            items = list(self._values.items())
+        out = []
+        for key, st in items:
+            out.append(
+                {
+                    "labels": dict(zip(self.labelnames, key)),
+                    "buckets": list(st["counts"]),
+                    "sum": st["sum"],
+                    "count": st["count"],
+                }
+            )
+        return out
+
+    def to_json(self) -> dict:
+        d = super().to_json()
+        d["bucket_bounds"] = list(self.buckets)
+        return d
+
+    def expose(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, st in items:
+            cum = 0
+            for bound, n in zip(
+                list(self.buckets) + [math.inf], st["counts"]
+            ):
+                cum += n
+                extra = f'le="{_fmt(bound)}"'
+                lines.append(
+                    f"{self.name}_bucket{self._label_str(key, extra)} {cum}"
+                )
+            lines.append(
+                f"{self.name}_sum{self._label_str(key)} {_fmt(st['sum'])}"
+            )
+            lines.append(
+                f"{self.name}_count{self._label_str(key)} {st['count']}"
+            )
+        return lines
+
+
+class MetricsRegistry:
+    """Process-global (or test-local) collection of named metrics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help: str, labelnames, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != tuple(
+                    labelnames
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind} with labels {existing.labelnames}"
+                    )
+                return existing
+            metric = cls(name, help, labelnames, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(), key=lambda m: m.name)
+
+    def clear(self) -> None:
+        """Drop every metric (tests; a fresh run keeps its counters)."""
+        with self._lock:
+            self._metrics.clear()
+
+    # -- exposition ------------------------------------------------------
+    def to_prometheus(self) -> str:
+        lines: List[str] = []
+        for m in self.metrics():
+            lines.extend(m.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> dict:
+        return {
+            "ts": time.time(),
+            "metrics": {m.name: m.to_json() for m in self.metrics()},
+        }
+
+    def write_snapshot(self, out_dir) -> None:
+        """Persist both exposition forms into a run's output directory
+        (``metrics.json`` + ``metrics.prom``) for offline ``cli stats``."""
+        from pathlib import Path
+
+        out = Path(out_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        (out / "metrics.json").write_text(
+            json.dumps(self.to_json(), indent=2)
+        )
+        (out / "metrics.prom").write_text(self.to_prometheus())
+
+
+def registry_from_json(data: dict) -> MetricsRegistry:
+    """Rebuild a registry from a ``to_json`` snapshot (``cli stats``)."""
+    reg = MetricsRegistry()
+    for name, md in data.get("metrics", {}).items():
+        labelnames = tuple(md.get("labelnames", ()))
+        kind = md.get("type")
+        if kind == "counter":
+            c = reg.counter(name, md.get("help", ""), labelnames)
+            for s in md.get("samples", ()):
+                c.inc(float(s["value"]), **s.get("labels", {}))
+        elif kind == "gauge":
+            g = reg.gauge(name, md.get("help", ""), labelnames)
+            for s in md.get("samples", ()):
+                g.set(float(s["value"]), **s.get("labels", {}))
+        elif kind == "histogram":
+            h = reg.histogram(
+                name,
+                md.get("help", ""),
+                labelnames,
+                buckets=md.get("bucket_bounds", DEFAULT_BUCKETS),
+            )
+            for s in md.get("samples", ()):
+                key = h._key(s.get("labels", {}))
+                with h._lock:
+                    h._values[key] = {
+                        "counts": list(s["buckets"]),
+                        "sum": float(s["sum"]),
+                        "count": int(s["count"]),
+                    }
+        else:  # unknown kinds round-trip as gauges of their raw samples
+            g = reg.gauge(name, md.get("help", ""), labelnames)
+            for s in md.get("samples", ()):
+                if isinstance(s.get("value"), (int, float)):
+                    g.set(float(s["value"]), **s.get("labels", {}))
+    return reg
+
+
+_default_lock = threading.Lock()
+_default: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry every subsystem records into."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default
+
+
+def set_registry(registry: Optional[MetricsRegistry]) -> None:
+    """Swap the process-global registry (tests install a fresh one)."""
+    global _default
+    with _default_lock:
+        _default = registry

@@ -1,0 +1,80 @@
+"""Optional HTTP metrics endpoint (``cli run --metrics-port N``).
+
+A stdlib ``ThreadingHTTPServer`` on a daemon thread — no new
+dependencies, nothing listening unless asked. Routes:
+
+* ``/metrics``      — Prometheus text exposition (scrape target);
+* ``/metrics.json`` — the JSON snapshot form;
+* ``/healthz``      — liveness probe.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .registry import MetricsRegistry, get_registry
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _make_handler(registry: MetricsRegistry):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.split("?")[0] == "/metrics":
+                body = registry.to_prometheus().encode()
+                ctype = PROM_CONTENT_TYPE
+            elif self.path.split("?")[0] == "/metrics.json":
+                body = json.dumps(registry.to_json()).encode()
+                ctype = "application/json"
+            elif self.path.split("?")[0] == "/healthz":
+                body = b"ok\n"
+                ctype = "text/plain"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # scrapes are not log events
+            pass
+
+    return Handler
+
+
+class MetricsServer:
+    """Owns the listening socket + serving thread; ``close()`` to stop."""
+
+    def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1"):
+        if registry is None:
+            from .metrics import ensure_catalog
+
+            ensure_catalog()  # scrapes see the full catalog from poll 1
+            registry = get_registry()
+        self.httpd = ThreadingHTTPServer(
+            (host, int(port)), _make_handler(registry)
+        )
+        self.port = self.httpd.server_address[1]  # resolved (port 0 = any)
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="mr-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_metrics_server(
+    port: int, registry: Optional[MetricsRegistry] = None
+) -> MetricsServer:
+    """Start serving the registry on ``port`` (0 picks a free port)."""
+    return MetricsServer(port, registry)

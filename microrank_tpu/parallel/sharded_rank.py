@@ -345,6 +345,54 @@ def rank_windows_sharded(
     )(batched)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@contract(
+    batched="windowgraph",
+    returns=(
+        "int32[B,K]", "float32[B,K]", "int32[B]", "float32[B,2,I]",
+        "int32[B]",
+    ),
+)
+def rank_windows_sharded_traced(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    mesh: Mesh,
+    kernel: str = "coo",
+):
+    """rank_windows_sharded plus the device convergence trace
+    (jax_tpu.rank_window_traced_core): two extra outputs —
+    residuals [B, 2, iterations] and n_iters [B] — replicated over the
+    shard axis by construction (the per-step deltas are pmax'd whenever
+    part of the carry is sharded), so the window-axis out_specs are
+    sound exactly like the existing three."""
+    from ..rank_backends.jax_tpu import rank_window_traced_core
+
+    if kernel not in SHARD_KERNELS:
+        raise ValueError(
+            f"kernel {kernel!r} is not shard-capable; use one of "
+            f"{SHARD_KERNELS}"
+        )
+    specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
+    in_specs = (WindowGraph(normal=specs, abnormal=specs),)
+    out_specs = tuple(P(WINDOW_AXIS) for _ in range(5))
+
+    def kernel_fn(graph: WindowGraph):
+        return jax.vmap(
+            lambda g: rank_window_traced_core(
+                g, pagerank_cfg, spectrum_cfg, SHARD_AXIS, kernel
+            )
+        )(graph)
+
+    return shard_map(
+        kernel_fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=False,
+    )(batched)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _rank_windows_batched_jit(
     batched: WindowGraph,
@@ -383,6 +431,55 @@ def rank_windows_batched(
     if kernel == "auto":
         kernel = choose_kernel(batched)
     return _rank_windows_batched_jit(
+        jax.device_put(device_subset(batched, kernel)),
+        pagerank_cfg,
+        spectrum_cfg,
+        kernel,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _rank_windows_batched_traced_jit(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str,
+):
+    from ..rank_backends.jax_tpu import (
+        divide_block_budget,
+        rank_window_traced_core,
+    )
+
+    pagerank_cfg = divide_block_budget(
+        pagerank_cfg, kernel, batched.normal.kind.shape[0]
+    )
+    return jax.vmap(
+        lambda g: rank_window_traced_core(
+            g, pagerank_cfg, spectrum_cfg, None, kernel
+        )
+    )(batched)
+
+
+@contract(
+    batched="windowgraph",
+    returns=(
+        "int32[B,K]", "float32[B,K]", "int32[B]", "float32[B,2,I]",
+        "int32[B]",
+    ),
+)
+def rank_windows_batched_traced(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str = "auto",
+):
+    """rank_windows_batched plus per-window convergence traces
+    (residuals [B, 2, I], n_iters [B])."""
+    from ..rank_backends.jax_tpu import choose_kernel, device_subset
+
+    if kernel == "auto":
+        kernel = choose_kernel(batched)
+    return _rank_windows_batched_traced_jit(
         jax.device_put(device_subset(batched, kernel)),
         pagerank_cfg,
         spectrum_cfg,

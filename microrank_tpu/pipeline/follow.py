@@ -55,11 +55,20 @@ def follow_table(
     ``rca`` is a fitted TableRCA (``fit_baseline`` already called);
     ``out_dir`` is REQUIRED — the window cursor lives there and is what
     makes polls (and restarts) incremental. ``idle_exit`` > 0 stops
-    after that many consecutive polls without file growth (0 = follow
-    forever); ``max_polls`` bounds total polls (0 = unbounded).
-    ``sleep`` is injectable for tests.
+    after that many consecutive polls without PROGRESS — no file growth
+    OR a failed ingest parse both count (advisor round 5: a permanently
+    torn/corrupt tail used to starve idle_exit forever, retrying without
+    ever counting as idle). File rotation/truncation (``size <
+    last_size``) is detected, counted (``follow_rotations``) and
+    re-read from scratch. (0 = follow forever); ``max_polls`` bounds
+    total polls (0 = unbounded). ``sleep`` is injectable for tests.
     """
     from ..native import load_span_table
+    from ..obs.metrics import (
+        follow_parse_failures,
+        follow_polls,
+        follow_rotations,
+    )
 
     if out_dir is None:
         raise ValueError(
@@ -74,7 +83,20 @@ def follow_table(
     polls = 0
     while True:
         polls += 1
+        follow_polls().inc()
         size = os.path.getsize(path) if path.exists() else -1
+        if 0 <= size < last_size:
+            # Rotation/truncation: the collector replaced the file (or
+            # something rewrote it shorter). Re-read from scratch — the
+            # window cursor still guards against re-RANKING old windows,
+            # so a rotated-in file that restarts the timeline simply
+            # yields nothing new until it passes the cursor again.
+            log.warning(
+                "follow: file shrank %d -> %d bytes "
+                "(rotation/truncation); re-reading", last_size, size,
+            )
+            follow_rotations().inc()
+            last_size = -1
         if size == last_size or size < 0:
             idle += 1
             if idle_exit and idle >= idle_exit:
@@ -86,19 +108,29 @@ def follow_table(
                 return
             sleep(poll_seconds)
             continue
-        idle = 0
         try:
             table = load_span_table(path, cache=False)
         except (ValueError, OSError) as exc:
             # A torn final line (the collector flushed mid-row) parses
             # as an error THIS poll and as valid data the next — a tail
             # loop must retry, not die. last_size stays unchanged so
-            # the next poll re-reads even without further growth.
+            # the next poll re-reads even without further growth — but
+            # the failure COUNTS toward idle_exit: a tail that never
+            # parses again must not starve the exit condition.
             log.warning("follow: ingest failed (%s); retrying", exc)
+            follow_parse_failures().inc()
+            idle += 1
+            if idle_exit and idle >= idle_exit:
+                log.info(
+                    "follow: %d polls without progress (last: parse "
+                    "failure); exiting", idle,
+                )
+                return
             if max_polls and polls >= max_polls:
                 return
             sleep(poll_seconds)
             continue
+        idle = 0
         last_size = size
         if table.n_spans == 0:
             if max_polls and polls >= max_polls:

@@ -30,6 +30,22 @@ class WindowResult:
     ranking: List[Tuple[str, float]] = field(default_factory=list)
     timings: Dict[str, float] = field(default_factory=dict)
     skipped_reason: Optional[str] = None
+    # Telemetry (obs subsystem): device-side convergence trace of the
+    # rank program (power-iteration steps run + final joint L-inf
+    # residual), the kernel that ranked the window, and how many
+    # dispatches were in flight when this one launched. None when the
+    # window wasn't ranked or convergence_trace is off.
+    rank_iterations: Optional[int] = None
+    rank_residual: Optional[float] = None
+    kernel: Optional[str] = None
+    queue_depth: Optional[int] = None
+
+    def apply_convergence(self, conv: Optional[dict]) -> None:
+        """Fold a convergence summary ({iterations, final_residual, ...})
+        into the record (shared by every fetch lane)."""
+        if conv:
+            self.rank_iterations = conv.get("iterations")
+            self.rank_residual = conv.get("final_residual")
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)

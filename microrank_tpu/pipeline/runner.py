@@ -26,6 +26,7 @@ from ..config import MicroRankConfig
 from ..detect import compute_slo, detect_numpy
 from ..graph import build_detect_batch
 from ..io.loader import window_spans
+from ..obs.metrics import record_window_outcome
 from ..rank_backends import get_backend
 from ..utils.logging import get_logger
 from ..utils.profiling import StageTimings
@@ -65,7 +66,12 @@ class OnlineRCA:
         """Detect + partition one window; returns (flag, normal, abnormal)."""
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before detection")
-        batch, trace_ids = build_detect_batch(window_df, self.slo_vocab)
+        from ..utils.guards import contract_checks
+
+        # validate_numerics arms the DetectBatch layout contract the
+        # same way it arms the rank-seam contracts.
+        with contract_checks(self.config.runtime.validate_numerics):
+            batch, trace_ids = build_detect_batch(window_df, self.slo_vocab)
         res = detect_numpy(batch, self.baseline, self.config.detector)
         abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
         nrm = [
@@ -93,6 +99,17 @@ class OnlineRCA:
             if out_dir is not None
             else None
         )
+        journal = None
+        if out_dir is not None and cfg.runtime.telemetry:
+            from ..obs import JOURNAL_NAME, RunJournal
+
+            journal = RunJournal(Path(out_dir) / JOURNAL_NAME)
+            journal.run_start(
+                pipeline="pandas",
+                backend=self.backend.name,
+                kernel=cfg.runtime.kernel,
+                pad_policy=cfg.runtime.pad_policy,
+            )
 
         detect_td = pd.Timedelta(minutes=cfg.window.detect_minutes)
         skip_td = pd.Timedelta(minutes=cfg.window.skip_minutes)
@@ -133,6 +150,9 @@ class OnlineRCA:
                             window_df, nrm, abn
                         )
                     result.ranking = list(zip(top, scores))
+                    result.apply_convergence(
+                        getattr(self.backend, "last_convergence", None)
+                    )
                     self.log.info(
                         "window %s: anomaly (%d/%d abnormal), top-1 %s",
                         w_start,
@@ -143,8 +163,14 @@ class OnlineRCA:
 
             result.timings = timings.as_dict()
             results.append(result)
+            record_window_outcome(
+                "ranked" if result.ranking
+                else ("skipped" if result.skipped_reason else "clean")
+            )
             if sink is not None:
                 sink.emit(result)
+            if journal is not None:
+                journal.window(result)
 
             if result.anomaly and result.ranking:
                 current = current + skip_td  # +4 min (online_rca.py:215)
@@ -152,6 +178,11 @@ class OnlineRCA:
             if cursor is not None:
                 cursor.save(str(current))
 
+        if journal is not None:
+            journal.run_end(
+                windows=len(results),
+                ranked=sum(1 for r in results if r.ranking),
+            )
         if cursor is not None:
             cursor.clear()
         return results

@@ -36,6 +36,12 @@ def _iso(us: int) -> str:
     return str(np.datetime64(int(us), "us"))
 
 
+def _gauge_inflight(lane: str, n: int) -> None:
+    from ..obs.metrics import pipeline_inflight
+
+    pipeline_inflight().set(n, lane=lane)
+
+
 class TableRCA:
     def __init__(self, config: MicroRankConfig = MicroRankConfig()):
         from ..rank_backends.jax_tpu import validate_tiebreak
@@ -43,6 +49,16 @@ class TableRCA:
         self.config = config
         self.log = get_logger("microrank_tpu.pipeline.table")
         validate_tiebreak(config.spectrum)
+        if config.runtime.device_checks and config.runtime.convergence_trace:
+            from ..utils.logging import warn_once
+
+            warn_once(
+                self.log,
+                "conv-trace-device-checks",
+                "convergence_trace is disabled under device_checks (the "
+                "checkify program has no residual-traced twin); windows "
+                "will journal without iteration/residual telemetry",
+            )
         self.slo_vocab = None
         self.baseline = None
         self._thresh = None       # mu + k*sigma f32, set by fit_baseline
@@ -169,6 +185,10 @@ class TableRCA:
                 8 * shard_n if kernel in ("packed", "packed_bf16") else 1
             ),
         )
+        from ..obs.metrics import graph_staging_stats, record_staging
+
+        total, pad = graph_staging_stats(stacked)
+        record_staging("sharded", total, len(graphs), pad)
         pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
         return global_put(
             stacked,
@@ -212,19 +232,25 @@ class TableRCA:
                     dtype=np.int32,
                 ),
             )
-        return detect_window_partition(
-            table,
-            w0,
-            w1,
-            self.slo_vocab,
-            self.baseline,
-            cfg.detector,
-            remap=self._remap_cache[1],
-            thresh=self._thresh,
-            pad_policy=cfg.runtime.pad_policy,
-            min_pad=cfg.runtime.min_pad,
-            with_range=True,
-        )
+        from ..utils.guards import contract_checks
+
+        # validate_numerics arms the @contract on the DetectBatch build
+        # (graph.table_ops.detect_batch_from_table) like it does on the
+        # rank entry points.
+        with contract_checks(cfg.runtime.validate_numerics):
+            return detect_window_partition(
+                table,
+                w0,
+                w1,
+                self.slo_vocab,
+                self.baseline,
+                cfg.detector,
+                remap=self._remap_cache[1],
+                thresh=self._thresh,
+                pad_policy=cfg.runtime.pad_policy,
+                min_pad=cfg.runtime.min_pad,
+                with_range=True,
+            )
 
     def prepare_rank(
         self, table, mask, nrm_codes, abn_codes, row_range=None
@@ -279,39 +305,88 @@ class TableRCA:
                 )
         return graph, op_names, shard_kernel
 
+    def _conv_enabled(self) -> bool:
+        """Whether dispatches carry the device convergence trace (the
+        checkify program has no traced twin — device_checks wins)."""
+        rt = self.config.runtime
+        return bool(rt.convergence_trace) and not rt.device_checks
+
+    def _apply_conv(self, result, conv) -> None:
+        """Fold a fetched convergence summary into the WindowResult and
+        the per-kernel registry metrics."""
+        result.apply_convergence(conv)
+        if conv:
+            from ..obs.metrics import record_convergence
+
+            record_convergence(
+                result.kernel or "auto",
+                conv["iterations"],
+                conv["final_residual"]
+                if conv["final_residual"] is not None
+                else float("nan"),
+            )
+
+    @staticmethod
+    def _conv_summary(residuals, n_iters):
+        """{iterations, final_residual, residuals} from FETCHED arrays
+        ([2, I] or a row thereof) — host-side, post-device_get only."""
+        res = np.asarray(
+            residuals,
+            dtype=np.float64,  # mrlint: disable=R2(host-side summary of an already-fetched trace; never re-enters a jnp expression)
+        )
+        n = int(n_iters)
+        joint = res.max(axis=0)[:n]
+        return {
+            "iterations": n,
+            "final_residual": float(joint[-1]) if n else None,
+            "residuals": [float(x) for x in joint],
+        }
+
     def launch_rank(self, graph, op_names, kernel):
         """Device half of a window rank: stage the graph (device_put /
-        global_put) and dispatch the jitted program. Latency-bound PJRT
-        calls only — safe to run on a staging worker thread. Returns
-        opaque handles (device arrays still in flight — jax dispatch is
-        async) to pass to ``finalize_rank``."""
+        global_put) and dispatch the jitted program — with the
+        convergence trace in the output tuple when
+        runtime.convergence_trace is on. Latency-bound PJRT calls only —
+        safe to run on a staging worker thread. Returns opaque handles
+        ``(device_outputs, op_names)`` (arrays still in flight — jax
+        dispatch is async) to pass to ``finalize_rank``."""
         cfg = self.config
+        conv = self._conv_enabled()
         from ..utils.guards import contract_checks
 
         # validate_numerics also arms the trace-time @contract checks on
         # the rank entry points (analysis.contracts).
         with contract_checks(cfg.runtime.validate_numerics):
             if self._mesh is not None:
-                from ..parallel.sharded_rank import rank_windows_sharded
+                from ..parallel.sharded_rank import (
+                    rank_windows_sharded,
+                    rank_windows_sharded_traced,
+                )
 
                 batched = self._stage_sharded([graph], kernel)
-                ti, ts, nv = rank_windows_sharded(
+                fn = (
+                    rank_windows_sharded_traced
+                    if conv
+                    else rank_windows_sharded
+                )
+                batch_outs = fn(
                     batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
                 )
-                top_idx, top_scores, n_valid = ti[0], ts[0], nv[0]
+                outs = tuple(o[0] for o in batch_outs)
             else:
                 from ..rank_backends.blob import stage_rank_window
                 from ..rank_backends.jax_tpu import device_subset
 
-                top_idx, top_scores, n_valid = stage_rank_window(
+                outs = stage_rank_window(
                     device_subset(graph, kernel),
                     cfg.pagerank,
                     cfg.spectrum,
                     kernel,
                     cfg.runtime.blob_staging,
                     checked=cfg.runtime.device_checks,
+                    conv_trace=conv,
                 )
-        return top_idx, top_scores, n_valid, op_names
+        return outs, op_names
 
     def dispatch_rank(
         self, table, mask, nrm_codes, abn_codes, row_range=None
@@ -344,17 +419,17 @@ class TableRCA:
         a full RPC round trip on tunneled-TPU runtimes (~78-110 ms apiece
         measured), so never convert device scalars/arrays piecemeal on
         this path, and prefer joining several windows per call
-        (fetch_mode="bulk"). Multi-host runs route through
-        fetch_replicated (allgather of any process-spanning shards).
-        Returns [(names, scores), ...] in input order."""
+        (fetch_mode="bulk"). The convergence trace rides the same fetch.
+        Multi-host runs route through fetch_replicated (allgather of any
+        process-spanning shards). Returns [(names, scores, conv), ...]
+        in input order; ``conv`` is the _conv_summary dict or None."""
         from ..parallel.distributed import fetch_replicated
 
-        fetched = fetch_replicated(
-            tuple((h[0], h[1], h[2]) for h in handles_list)
-        )
+        fetched = fetch_replicated(tuple(h[0] for h in handles_list))
         out = []
-        for h, (top_idx, top_scores, n_valid) in zip(handles_list, fetched):
-            op_names = h[3]
+        for h, outs in zip(handles_list, fetched):
+            op_names = h[1]
+            top_idx, top_scores, n_valid = outs[:3]
             n = int(n_valid)
             names = [op_names[int(i)] for i in top_idx[:n]]
             scores = [float(s) for s in top_scores[:n]]
@@ -362,18 +437,26 @@ class TableRCA:
                 from ..utils.guards import assert_finite_scores
 
                 assert_finite_scores(scores, "TableRCA.rank_window")
-            out.append((names, scores))
+            conv = (
+                self._conv_summary(outs[3], outs[4])
+                if len(outs) > 3
+                else None
+            )
+            out.append((names, scores, conv))
         return out
 
     def finalize_rank(self, handles):
-        """Force a dispatched rank's results to host (blocks if needed)."""
+        """Force a dispatched rank's results to host (blocks if needed).
+        Returns (names, scores, conv-summary-or-None)."""
         return self.finalize_rank_many([handles])[0]
 
     def rank_window(self, table, mask, nrm_codes, abn_codes):
-        """Rank one window given its row mask and trace-code partitions."""
-        return self.finalize_rank(
+        """Rank one window given its row mask and trace-code partitions;
+        returns (names, scores)."""
+        names, scores, _ = self.finalize_rank(
             self.dispatch_rank(table, mask, nrm_codes, abn_codes)
         )
+        return names, scores
 
     def run(
         self,
@@ -430,6 +513,25 @@ class TableRCA:
             if out_dir is not None
             else None
         )
+        journal = None
+        if out_dir is not None and cfg.runtime.telemetry:
+            from ..obs import JOURNAL_NAME, RunJournal
+
+            journal = RunJournal(Path(out_dir) / JOURNAL_NAME)
+            journal.run_start(
+                pipeline="table",
+                kernel=cfg.runtime.kernel,
+                pad_policy=cfg.runtime.pad_policy,
+                collapse_kinds=cfg.runtime.collapse_kinds,
+                pipeline_depth=cfg.runtime.pipeline_depth,
+                fetch_mode=cfg.runtime.fetch_mode,
+                batch_windows=bool(batch_windows),
+                mesh=(
+                    list(cfg.runtime.mesh_shape)
+                    if cfg.runtime.mesh_shape
+                    else None
+                ),
+            )
         if table.n_spans == 0:
             return []
 
@@ -539,6 +641,8 @@ class TableRCA:
 
         def _emit(r):
             sink.emit(r)
+            if journal is not None:
+                journal.window(r)
             # Not in batch mode: there all ranking completes BEFORE any
             # emit, so per-window saves would be N redundant writes
             # right before cursor.clear().
@@ -575,20 +679,22 @@ class TableRCA:
                 _emit(r)
                 emitted += 1
 
-        def _set_ranking(result, timings, names, scores):
+        def _set_ranking(result, timings, names, scores, conv=None):
             result.ranking = list(zip(names, scores))
             result.timings = timings.as_dict()
+            self._apply_conv(result, conv)
             _emit_ready()
 
         def _complete_one():
             """Join the oldest async fetch and emit its window."""
             result, fut, timings = finishing.pop(0)
             with timings.stage("rank_wait"):
-                names, scores = fut.result()
-            _set_ranking(result, timings, names, scores)
+                names, scores, conv = fut.result()
+            _set_ranking(result, timings, names, scores, conv)
 
         def _finalize_one():
             result, handles, timings = inflight.pop(0)
+            _gauge_inflight("window", len(inflight))
             if fetch_pool is not None:
                 # handles is the staging future: chain its join with the
                 # fetch on the fetch worker so the device_get RPC of
@@ -601,8 +707,8 @@ class TableRCA:
                     _complete_one()
                 return
             with timings.stage("rank_wait"):
-                names, scores = self.finalize_rank(handles)
-            _set_ranking(result, timings, names, scores)
+                names, scores, conv = self.finalize_rank(handles)
+            _set_ranking(result, timings, names, scores, conv)
 
         chunk_pending = []  # (result, graph, op_names, kernel, timings)
 
@@ -640,6 +746,7 @@ class TableRCA:
                 cfg.spectrum,
                 kern,
                 cfg.runtime.blob_staging,
+                conv_trace=self._conv_enabled(),
             )
 
         def _flush_chunk():
@@ -653,8 +760,10 @@ class TableRCA:
                 else _launch_chunk(items)
             )
             inflight.append((items, handles, None))
+            _gauge_inflight("chunk", len(inflight))
 
-        def _assign_chunk(items, ti, ts, nv, wait_ms_per_window):
+        def _assign_chunk(items, outs, wait_ms_per_window):
+            ti, ts, nv = outs[:3]
             for b, (result, _, names, _, timings) in enumerate(items):
                 self._assign_topk(
                     result, names, ti[b], ts[b], int(nv[b]),
@@ -665,15 +774,20 @@ class TableRCA:
                     "chunk_fetch_ms": round(wait_ms_per_window, 3),
                     "chunk_windows": len(items),
                 }
+                if len(outs) > 3:
+                    self._apply_conv(
+                        result, self._conv_summary(outs[3][b], outs[4][b])
+                    )
 
         def _finalize_chunk_one():
             """Join the oldest dispatched group (one batched fetch)."""
             items, handles, _ = inflight.pop(0)
+            _gauge_inflight("chunk", len(inflight))
             h = handles.result() if hasattr(handles, "result") else handles
             t0 = time.perf_counter()
-            ti, ts, nv = jax.device_get(h)
+            outs = jax.device_get(h)
             wait_ms = (time.perf_counter() - t0) * 1e3
-            _assign_chunk(items, ti, ts, nv, wait_ms / len(items))
+            _assign_chunk(items, outs, wait_ms / len(items))
             _emit_ready()
 
         def _flush_bulk_chunks():
@@ -689,9 +803,10 @@ class TableRCA:
             fetched = jax.device_get(tuple(hs))
             wait_ms = (time.perf_counter() - t0) * 1e3
             n_total = sum(len(e[0]) for e in entries)
-            for (items, _, _), (ti, ts, nv) in zip(entries, fetched):
-                _assign_chunk(items, ti, ts, nv, wait_ms / n_total)
+            for (items, _, _), outs in zip(entries, fetched):
+                _assign_chunk(items, outs, wait_ms / n_total)
             inflight.clear()
+            _gauge_inflight("chunk", 0)
             _emit_ready()
 
         def _flush_bulk():
@@ -715,14 +830,18 @@ class TableRCA:
             t0 = time.perf_counter()
             ranked = self.finalize_rank_many(handles)
             wait_s = time.perf_counter() - t0
-            for (result, _, timings), (names, scores) in zip(items, ranked):
+            for (result, _, timings), (names, scores, conv) in zip(
+                items, ranked
+            ):
                 result.ranking = list(zip(names, scores))
                 result.timings = {
                     **timings.as_dict(),
                     "bulk_fetch_ms": round(wait_s * 1e3 / len(items), 3),
                     "bulk_fetch_windows": len(items),
                 }
+                self._apply_conv(result, conv)
             inflight.clear()
+            _gauge_inflight("window", 0)
             _emit_ready()
 
         loop_depth = (
@@ -758,6 +877,11 @@ class TableRCA:
         if batch_windows and sink is not None:
             for r in results:
                 _emit(r)
+        if journal is not None:
+            journal.run_end(
+                windows=len(results),
+                ranked=sum(1 for r in results if r.ranking),
+            )
         if cursor is not None:
             if end_us is not None or complete_only:
                 # Bounded runs (the follow/tail mode's polls) leave the
@@ -786,6 +910,8 @@ class TableRCA:
         right after its own dispatch, losing the build/execute overlap)
         or WINDOWS in flight (``chunk_bulk``, where depth is
         bulk_fetch_windows and the join is one fetch of everything)."""
+        from ..obs.metrics import record_window_outcome
+
         cfg = self.config
         while (
             current + detect_us <= end if complete_only else current < end
@@ -820,6 +946,8 @@ class TableRCA:
                             graph, op_names, kernel = self.prepare_rank(
                                 table, mask, nrm, abn, row_range
                             )
+                        result.kernel = kernel
+                        result.queue_depth = len(inflight)
                         chunk_pending.append(
                             (result, graph, op_names, kernel, timings)
                         )
@@ -828,25 +956,33 @@ class TableRCA:
                         if chunk_bulk:
                             if sum(len(e[0]) for e in inflight) >= depth:
                                 _finalize_one()
-                        elif len(inflight) > depth:
+                        elif len(inflight) >= depth:
+                            # Groups in flight bound by >= depth like the
+                            # per-window lane — the pre-fix > let depth+1
+                            # groups pile onto the device (advisor r5).
                             _finalize_one()
                     else:
                         with timings.stage("rank_dispatch"):
+                            prep = self.prepare_rank(
+                                table, mask, nrm, abn, row_range
+                            )
+                            result.kernel = prep[2]
                             if stage_pool is not None:
-                                prep = self.prepare_rank(
-                                    table, mask, nrm, abn, row_range
-                                )
                                 handles = stage_pool.submit(
                                     self.launch_rank, *prep
                                 )
                             else:
-                                handles = self.dispatch_rank(
-                                    table, mask, nrm, abn, row_range
-                                )
+                                handles = self.launch_rank(*prep)
+                        result.queue_depth = len(inflight)
                         inflight.append((result, handles, timings))
+                        _gauge_inflight("window", len(inflight))
                         if len(inflight) >= depth:
                             _finalize_one()
 
+            record_window_outcome(
+                "ranked" if ranked
+                else ("skipped" if result.skipped_reason else "clean")
+            )
             results.append(result)
             if not (result.anomaly and not result.skipped_reason) or batch_windows:
                 result.timings = timings.as_dict()
@@ -906,6 +1042,7 @@ class TableRCA:
                     row_range=row_range,
                 )
                 graphs.append(graph)
+        conv = self._conv_enabled()
         with timings.stage("rank_batched"):
             if self._mesh is not None:
                 if kernel == "auto":
@@ -916,7 +1053,16 @@ class TableRCA:
                 batched = self._stage_sharded(
                     graphs + [graphs[-1]] * n_pad, kernel
                 )
-                top_idx, top_scores, n_valid = rank_windows_sharded(
+                from ..parallel.sharded_rank import (
+                    rank_windows_sharded_traced,
+                )
+
+                fn = (
+                    rank_windows_sharded_traced
+                    if conv
+                    else rank_windows_sharded
+                )
+                outs = fn(
                     batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
                 )
             else:
@@ -930,25 +1076,31 @@ class TableRCA:
                         cfg.runtime.dense_budget_bytes // per_device,
                         cfg.runtime.prefer_bf16,
                     )
-                top_idx, top_scores, n_valid = stage_rank_windows_batched(
+                outs = stage_rank_windows_batched(
                     device_subset(stacked, kernel),
                     cfg.pagerank,
                     cfg.spectrum,
                     kernel,
                     cfg.runtime.blob_staging,
+                    conv_trace=conv,
                 )
             # One batched fetch: per-buffer transfers each pay an RPC
-            # round trip on tunneled-TPU runtimes.
-            top_idx, top_scores, n_valid = fetch_replicated(
-                (top_idx, top_scores, n_valid)
-            )
+            # round trip on tunneled-TPU runtimes; the convergence
+            # traces ride the same fetch.
+            outs = fetch_replicated(tuple(outs))
+        top_idx, top_scores, n_valid = outs[:3]
         shared = timings.as_dict()
         for b, (result, _, _, _, _) in enumerate(pending):
+            result.kernel = kernel
             self._assign_topk(
                 result, op_names, top_idx[b], top_scores[b],
                 int(n_valid[b]), f"TableRCA batched window {b}",
             )
             result.timings = {**result.timings, **shared}
+            if len(outs) > 3:
+                self._apply_conv(
+                    result, self._conv_summary(outs[3][b], outs[4][b])
+                )
 
 
 def run_rca_native(

@@ -16,6 +16,11 @@ class NumpyRefBackend:
 
     def __init__(self, config: MicroRankConfig = MicroRankConfig()):
         self.config = config
+        # Residual traces of the most recent rank_window call (same
+        # shape as JaxBackend.last_convergence) when
+        # runtime.convergence_trace is on — the parity suite's oracle
+        # side and the pandas runner's journal feed.
+        self.last_convergence = None
 
     def rank_window(
         self, span_df, normal_ids, abnormal_ids
@@ -28,14 +33,31 @@ class NumpyRefBackend:
         validate_partitions(normal_ids, abnormal_ids)
         normal_graph = pagerank_graph_dicts(normal_ids, span_df)
         abnormal_graph = pagerank_graph_dicts(abnormal_ids, span_df)
-        return numpy_ref.rank_window_dicts(
+        conv = {} if self.config.runtime.convergence_trace else None
+        out = numpy_ref.rank_window_dicts(
             normal_graph,
             abnormal_graph,
             n_normal_traces=len(normal_ids),
             n_abnormal_traces=len(abnormal_ids),
             pagerank_cfg=self.config.pagerank,
             spectrum_cfg=self.config.spectrum,
+            conv_out=conv,
         )
+        self.last_convergence = None
+        if conv is not None:
+            joint = [
+                max(n, a)
+                for n, a in zip(conv["normal"], conv["abnormal"])
+            ]
+            self.last_convergence = {
+                "iterations": conv["iterations"],
+                "final_residual": joint[-1] if joint else None,
+                "residuals": {
+                    "normal": conv["normal"],
+                    "abnormal": conv["abnormal"],
+                },
+            }
+        return out
 
 
 def get_backend(config: MicroRankConfig) -> RankBackend:

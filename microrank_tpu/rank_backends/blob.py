@@ -53,6 +53,7 @@ def _leaf_entries(part: PartitionGraph, off: int):
     return tuple(entries), off
 
 
+@contract(graph="windowgraph", returns=("uint32[N]", "any"))
 def pack_graph_blob(graph: WindowGraph) -> Tuple[np.ndarray, BlobLayout]:
     """Host side: one uint32 buffer + the static layout describing it."""
     n_entries, off = _leaf_entries(graph.normal, 0)
@@ -82,8 +83,12 @@ def _decode_leaf(blob, dtype_str: str, shape: Tuple[int, ...], off: int, n_words
     raise TypeError(f"blob staging: unsupported leaf dtype {dtype_str!r}")
 
 
+@contract(blob="uint32[N]", returns="windowgraph")
 def unpack_graph_blob(blob, layout: BlobLayout) -> WindowGraph:
-    """Device side (traced): rebuild the WindowGraph from the blob."""
+    """Device side (traced): rebuild the WindowGraph from the blob —
+    the @contract closes the pack/unpack round trip: the rebuilt graph
+    must carry the canonical field dtypes (shape-only checks, so the
+    wrapper is trace-compatible and costs nothing per cached call)."""
     parts = [
         PartitionGraph(*(_decode_leaf(blob, *e[1:]) for e in entries))
         for entries in layout
@@ -131,17 +136,56 @@ rank_windows_batched_blob_device = jax.jit(
 )
 
 
-def stage_rank_blob(graph: WindowGraph, pagerank_cfg, spectrum_cfg, kernel):
-    """Pack + single-transfer stage + dispatch one window's rank program.
+@contract(
+    blob="uint32[N]",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]"
+    ),
+)
+def rank_window_traced_blob_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, psum_axis=None, kernel="coo"
+):
+    """Blob twin of jax_tpu.rank_window_traced_core: the convergence
+    trace (residuals + iteration count) is part of the program's output
+    tuple — telemetry rides the existing result blob, no extra sync."""
+    from .jax_tpu import rank_window_traced_core
 
-    Single-device twin of jax_tpu.rank_window_device over device_put; the
-    sharded path keeps global_put (shards need per-device placement the
-    single blob cannot express).
-    """
-    blob, layout = pack_graph_blob(graph)
-    return rank_window_blob_device(
-        jax.device_put(blob), layout, pagerank_cfg, spectrum_cfg, None, kernel
+    graph = unpack_graph_blob(blob, layout)
+    return rank_window_traced_core(
+        graph, pagerank_cfg, spectrum_cfg, psum_axis, kernel
     )
+
+
+rank_window_traced_blob_device = jax.jit(
+    rank_window_traced_blob_core, static_argnums=(1, 2, 3, 4, 5)
+)
+
+
+@contract(
+    blob="uint32[N]",
+    returns=(
+        "int32[B,K]", "float32[B,K]", "int32[B]", "float32[B,2,I]",
+        "int32[B]",
+    ),
+)
+def rank_windows_traced_batched_blob_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
+):
+    from .jax_tpu import divide_block_budget, rank_window_traced_core
+
+    graph = unpack_graph_blob(blob, layout)
+    b = graph.normal.kind.shape[0]
+    pagerank_cfg = divide_block_budget(pagerank_cfg, kernel, b)
+    return jax.vmap(
+        lambda g: rank_window_traced_core(
+            g, pagerank_cfg, spectrum_cfg, None, kernel
+        )
+    )(graph)
+
+
+rank_windows_traced_batched_blob_device = jax.jit(
+    rank_windows_traced_batched_blob_core, static_argnums=(1, 2, 3, 4)
+)
 
 
 def _rank_window_blob_checked_core(
@@ -172,6 +216,16 @@ def _blob_checked_jit():
     return _BLOB_CHECKED_JIT
 
 
+def _account_staging(graph: WindowGraph, path: str, n_transfers: int):
+    """Staging telemetry: bytes, transfer count, pad-waste estimate —
+    the counters that turn compile storms and pad_policy overhead into
+    data (obs.metrics). Host-side arrays only; ~52 nbytes reads."""
+    from ..obs.metrics import graph_staging_stats, record_staging
+
+    total, pad = graph_staging_stats(graph)
+    record_staging(path, total, n_transfers, pad)
+
+
 def stage_rank_window(
     graph: WindowGraph,
     pagerank_cfg,
@@ -179,22 +233,33 @@ def stage_rank_window(
     kernel,
     blob: bool,
     checked: bool = False,
+    conv_trace: bool = False,
 ):
     """The one single-device stage+dispatch seam both the backend
     (JaxBackend.rank_window) and the pipeline (TableRCA.launch_rank)
     call: blob staging when enabled, per-leaf device_put otherwise. The
     graph should already be device_subset-stripped for ``kernel``.
+    Every dispatch records staged bytes/transfers and jit-cache growth
+    into the metrics registry (obs.metrics).
 
     ``checked`` (RuntimeConfig.device_checks) dispatches the
     checkify-instrumented program instead — still blob-staged when
     ``blob`` is on, module-level jit cache either way — and raises
     ``checkify.JaxRuntimeError`` on an in-program invariant failure.
+    ``conv_trace`` (RuntimeConfig.convergence_trace) dispatches the
+    residual-traced program: the return grows to a 5-tuple whose last
+    two entries are (residuals float32[2, I], n_iters int32), still all
+    device values. The checkify variant has no traced twin — ``checked``
+    wins and the caller gets the plain 3-tuple.
     """
+    from ..obs.metrics import record_retrace
+
     if checked:
         if blob:
             from jax.experimental import checkify
 
             blob_arr, layout = pack_graph_blob(graph)
+            _account_staging(graph, "blob", 1)
             err, out = _blob_checked_jit()(
                 jax.device_put(blob_arr),
                 layout,
@@ -206,28 +271,78 @@ def stage_rank_window(
             return out
         from .jax_tpu import rank_window_checked
 
+        _account_staging(graph, "tree", len(jax.tree.leaves(graph)))
         return rank_window_checked(
             jax.device_put(graph), pagerank_cfg, spectrum_cfg, kernel
         )
     if blob:
-        return stage_rank_blob(graph, pagerank_cfg, spectrum_cfg, kernel)
-    from .jax_tpu import rank_window_device
+        blob_arr, layout = pack_graph_blob(graph)
+        _account_staging(graph, "blob", 1)
+        fn = (
+            rank_window_traced_blob_device
+            if conv_trace
+            else rank_window_blob_device
+        )
+        out = fn(
+            jax.device_put(blob_arr), layout, pagerank_cfg, spectrum_cfg,
+            None, kernel,
+        )
+        record_retrace(
+            "rank_window_blob_traced" if conv_trace else "rank_window_blob",
+            fn,
+        )
+        return out
+    from .jax_tpu import rank_window_device, rank_window_traced_device
 
-    return rank_window_device(
+    _account_staging(graph, "tree", len(jax.tree.leaves(graph)))
+    fn = rank_window_traced_device if conv_trace else rank_window_device
+    out = fn(
         jax.device_put(graph), pagerank_cfg, spectrum_cfg, None, kernel
     )
+    record_retrace(
+        "rank_window_traced" if conv_trace else "rank_window", fn
+    )
+    return out
 
 
 def stage_rank_windows_batched(
-    batched: WindowGraph, pagerank_cfg, spectrum_cfg, kernel, blob: bool
+    batched: WindowGraph,
+    pagerank_cfg,
+    spectrum_cfg,
+    kernel,
+    blob: bool,
+    conv_trace: bool = False,
 ):
     """Batched twin of stage_rank_window (one vmapped program over a
-    stacked graph). The stacked graph should already be subset-stripped."""
+    stacked graph). The stacked graph should already be subset-stripped.
+    ``conv_trace`` appends per-window (residuals [B, 2, I],
+    n_iters [B]) to the return tuple."""
+    from ..obs.metrics import record_retrace
+
     if blob:
         blob_arr, layout = pack_graph_blob(batched)
-        return rank_windows_batched_blob_device(
-            jax.device_put(blob_arr), layout, pagerank_cfg, spectrum_cfg, kernel
+        _account_staging(batched, "blob", 1)
+        fn = (
+            rank_windows_traced_batched_blob_device
+            if conv_trace
+            else rank_windows_batched_blob_device
         )
-    from ..parallel.sharded_rank import rank_windows_batched
+        out = fn(
+            jax.device_put(blob_arr), layout, pagerank_cfg, spectrum_cfg,
+            kernel,
+        )
+        record_retrace(
+            "rank_windows_batched_blob_traced"
+            if conv_trace
+            else "rank_windows_batched_blob",
+            fn,
+        )
+        return out
+    from ..parallel.sharded_rank import (
+        rank_windows_batched,
+        rank_windows_batched_traced,
+    )
 
-    return rank_windows_batched(batched, pagerank_cfg, spectrum_cfg, kernel)
+    _account_staging(batched, "tree", len(jax.tree.leaves(batched)))
+    fn = rank_windows_batched_traced if conv_trace else rank_windows_batched
+    return fn(batched, pagerank_cfg, spectrum_cfg, kernel)

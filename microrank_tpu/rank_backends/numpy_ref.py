@@ -18,7 +18,7 @@ float32 @ float64 promotes.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -36,6 +36,7 @@ def page_rank_iterate(
     n_ops: int,
     n_traces: int,
     cfg: PageRankConfig,
+    record: Optional[List[float]] = None,
 ) -> np.ndarray:
     """Power iteration (reference ``pageRank``, pagerank.py:116-130).
 
@@ -44,6 +45,11 @@ def page_rank_iterate(
     (pagerank.py:126-127 — not in the paper but load-bearing for score
     parity). ``cfg.tol`` adds the same early-exit rule as the device
     backend: stop once the L-inf change of both vectors is below tol.
+
+    ``record``: list the per-iteration L-inf residual (max over both
+    vectors, AFTER normalization) is appended to — the oracle twin of
+    the device convergence trace (jax_tpu.window_weights_traced), same
+    definition so the parity suite can pin them against each other.
     """
     d = cfg.damping
     alpha = cfg.call_weight
@@ -55,16 +61,17 @@ def page_rank_iterate(
         if cfg.max_normalize_each_iter:
             new_s = new_s / np.amax(new_s)
             new_r = new_r / np.amax(new_r)
-        if cfg.tol is not None:
+        need_delta = cfg.tol is not None or record is not None
+        if need_delta:
             delta = max(
                 float(np.max(np.abs(new_s - v_s))),
                 float(np.max(np.abs(new_r - v_r))),
             )
-            v_s, v_r = new_s, new_r
-            if delta <= cfg.tol:
-                break
-        else:
-            v_s, v_r = new_s, new_r
+            if record is not None:
+                record.append(delta)
+        v_s, v_r = new_s, new_r
+        if cfg.tol is not None and delta <= cfg.tol:
+            break
     return v_s / np.amax(v_s)
 
 
@@ -179,6 +186,7 @@ def trace_pagerank(
     pr_trace: Dict[str, List[str]],
     anomaly: bool,
     cfg: PageRankConfig = PageRankConfig(),
+    record: Optional[List[float]] = None,
 ) -> Tuple[Dict[str, float], Dict[str, int]]:
     """Reference ``trace_pagerank`` (pagerank.py:15-112), value-identical.
 
@@ -199,7 +207,9 @@ def trace_pagerank(
 
     pref = _preference_vector(trace_index, pr_trace, kind_list, anomaly, cfg)
 
-    result = page_rank_iterate(p_ss, p_sr, p_rs, pref, n_ops, n_traces, cfg)
+    result = page_rank_iterate(
+        p_ss, p_sr, p_rs, pref, n_ops, n_traces, cfg, record=record
+    )
 
     total = float(sum(result[node_index[op]][0] for op in operation_operation))
     trace_num_list = {
@@ -336,14 +346,27 @@ def rank_window_dicts(
     n_abnormal_traces: int,
     pagerank_cfg: PageRankConfig = PageRankConfig(),
     spectrum_cfg: SpectrumConfig = SpectrumConfig(),
+    conv_out: Optional[dict] = None,
 ) -> Tuple[List[str], List[float]]:
     """Full oracle ranking of one window from the two partitions' graph
     dicts — the composition the orchestrator performs at
-    online_rca.py:180-201."""
-    normal_result, normal_num = trace_pagerank(*normal_graph, False, pagerank_cfg)
-    anomaly_result, anomaly_num = trace_pagerank(
-        *abnormal_graph, True, pagerank_cfg
+    online_rca.py:180-201.
+
+    ``conv_out``: dict the per-partition residual traces are written
+    into ({"normal": [...], "abnormal": [...]}) — the oracle side of
+    the convergence-trace parity suite."""
+    rec_n = [] if conv_out is not None else None
+    rec_a = [] if conv_out is not None else None
+    normal_result, normal_num = trace_pagerank(
+        *normal_graph, False, pagerank_cfg, record=rec_n
     )
+    anomaly_result, anomaly_num = trace_pagerank(
+        *abnormal_graph, True, pagerank_cfg, record=rec_a
+    )
+    if conv_out is not None:
+        conv_out["normal"] = rec_n
+        conv_out["abnormal"] = rec_a
+        conv_out["iterations"] = max(len(rec_n), len(rec_a))
     return calculate_spectrum(
         anomaly_result=anomaly_result,
         normal_result=normal_result,

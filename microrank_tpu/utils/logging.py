@@ -25,3 +25,15 @@ def get_logger(name: str = "microrank_tpu") -> logging.Logger:
         root.setLevel(logging.INFO)
         _configured = True
     return logging.getLogger(name)
+
+
+_warned: set = set()
+
+
+def warn_once(logger: logging.Logger, key: str, msg: str, *args) -> None:
+    """Per-process once-only warning (telemetry paths that would
+    otherwise warn every window — e.g. conv-trace x device_checks)."""
+    if key in _warned:
+        return
+    _warned.add(key)
+    logger.warning(msg, *args)

@@ -3,8 +3,12 @@
 The reference has no self-timing at all (its paper reports module latencies
 measured externally, Table 7). Here every pipeline stage records into a
 ``StageTimings`` struct so each window result carries
-ingest/detect/build/rank timings; ``jax.profiler`` trace export can be
-layered on via ``trace_context`` for deep dives.
+ingest/detect/build/rank timings — and every stage duration ALSO feeds
+the process metrics registry (``obs.metrics.stage_seconds`` histogram,
+labeled by stage), so ``cli stats`` / the ``--metrics-port`` endpoint see
+cumulative stage distributions without touching the per-window records.
+``jax.profiler`` trace export can be layered on via ``trace_context``
+for deep dives.
 """
 
 from __future__ import annotations
@@ -28,8 +32,14 @@ class StageTimings:
         try:
             yield
         finally:
-            self._acc[name] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._acc[name] += dt
             self._counts[name] += 1
+            # Mirror into the registry histogram (a locked list update;
+            # ~1 us — noise next to any stage worth timing).
+            from ..obs.metrics import stage_seconds
+
+            stage_seconds().observe(dt, stage=name)
 
     def as_dict(self) -> Dict[str, float]:
         return {k: round(v, 6) for k, v in self._acc.items()}

@@ -1,0 +1,574 @@
+"""Telemetry subsystem (microrank_tpu.obs): registry semantics,
+exposition formats, journal schema, convergence-trace parity between the
+numpy oracle and the jitted kernels, contention sentinel, follow-mode
+counters, and the DetectBatch/blob-codec contracts.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from microrank_tpu.config import (
+    MicroRankConfig,
+    PageRankConfig,
+    RuntimeConfig,
+    WindowConfig,
+)
+from microrank_tpu.obs import (
+    MetricsRegistry,
+    get_registry,
+    read_journal,
+    registry_from_json,
+    set_registry,
+)
+from microrank_tpu.obs.journal import RunJournal
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+@pytest.fixture
+def registry():
+    """Install a fresh process registry; restore the old one after."""
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_concurrent_increments_are_exact(registry):
+    c = registry.counter("t_total", "test", labelnames=("k",))
+    n_threads, per_thread = 8, 2000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc(k="a")
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert c.value(k="a") == n_threads * per_thread
+
+
+def test_gauge_set_inc_dec(registry):
+    g = registry.gauge("t_gauge", "test")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+
+
+def test_histogram_buckets_cumulative_and_sum(registry):
+    h = registry.histogram("t_hist", "test", buckets=(1.0, 5.0, 10.0))
+    for v in (0.5, 0.7, 3.0, 7.0, 100.0):
+        h.observe(v)
+    s = h.snapshot()
+    assert s["counts"] == [2, 1, 1, 1]  # (..1], (1..5], (5..10], (10..inf)
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(111.2)
+
+
+def test_prometheus_exposition_format(registry):
+    c = registry.counter("t_reqs_total", "requests", labelnames=("path",))
+    c.inc(3, path='a"b\\c')
+    h = registry.histogram("t_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(2.0)
+    text = registry.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE t_reqs_total counter" in lines
+    assert "# TYPE t_lat_seconds histogram" in lines
+    # Label escaping: quote and backslash escaped in the value.
+    assert 't_reqs_total{path="a\\"b\\\\c"} 3' in lines
+    # Histogram: cumulative buckets ending at +Inf == count.
+    bucket_lines = [l for l in lines if l.startswith("t_lat_seconds_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts)
+    assert bucket_lines[-1].startswith('t_lat_seconds_bucket{le="+Inf"}')
+    assert counts[-1] == 2
+    assert "t_lat_seconds_count 2" in lines
+    # Every sample line: name{labels} value — no stray whitespace.
+    for l in lines:
+        if l.startswith("#") or not l:
+            continue
+        assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$", l), l
+
+
+def test_registry_json_roundtrip(registry):
+    registry.counter("t_c_total", "c", labelnames=("x",)).inc(7, x="1")
+    registry.gauge("t_g", "g").set(3.5)
+    h = registry.histogram("t_h", "h", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(9.0)
+    snap = registry.to_json()
+    rebuilt = registry_from_json(json.loads(json.dumps(snap)))
+    assert rebuilt.to_prometheus() == registry.to_prometheus()
+
+
+def test_registry_idempotent_and_conflicting_registration(registry):
+    a = registry.counter("t_same", "x", labelnames=("l",))
+    b = registry.counter("t_same", "x", labelnames=("l",))
+    assert a is b
+    with pytest.raises(ValueError, match="already registered"):
+        registry.gauge("t_same", "x")
+
+
+# ---------------------------------------------------------------- journal
+
+
+def test_journal_schema_roundtrip(tmp_path, registry):
+    from microrank_tpu.pipeline.results import WindowResult
+
+    j = RunJournal(tmp_path / "journal.jsonl")
+    j.run_start(pipeline="test", kernel="auto")
+    r = WindowResult(start="s", end="e", anomaly=True, n_traces=10)
+    r.ranking = [("op", 1.0)]
+    r.rank_iterations = 25
+    r.rank_residual = 1e-6
+    r.kernel = "coo"
+    j.window(r, queue_depth=1)
+    j.run_end(windows=1, ranked=1)
+    events = read_journal(tmp_path / "journal.jsonl")
+    assert [e["event"] for e in events] == ["run_start", "window", "run_end"]
+    for e in events:
+        assert e["schema"] == 1 and "ts" in e
+    w = events[1]
+    assert w["outcome"] == "ranked"
+    assert w["rank_iterations"] == 25
+    assert w["rank_residual"] == pytest.approx(1e-6)
+    assert w["kernel"] == "coo"
+    assert w["queue_depth"] == 1
+    assert w["top1"] == "op"
+    assert "norm_load" in w["host"] and "steal_ratio" in w["host"]
+    assert "telemetry" in events[2]
+
+
+def test_table_run_journal_reconciles_with_results(tmp_path, registry):
+    """A TableRCA run's journal carries per-window rank timings and the
+    device iteration count for every ranked window (acceptance: the
+    journal reconciles with the run's own totals)."""
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=30, n_kinds=8, n_traces=100, seed=11),
+        3,
+        [0, 1, 2],
+    )
+    normal_csv = tmp_path / "normal.csv"
+    abn_csv = tmp_path / "abn.csv"
+    tl.normal.to_csv(normal_csv, index=False)
+    tl.timeline.to_csv(abn_csv, index=False)
+    cfg = MicroRankConfig(
+        window=WindowConfig(detect_minutes=tl.window_minutes, skip_minutes=0.0)
+    )
+    rca = TableRCA(cfg)
+    rca.fit_baseline(load_span_table(normal_csv))
+    out = tmp_path / "out"
+    results = rca.run(load_span_table(abn_csv), out_dir=out)
+    ranked = [r for r in results if r.ranking]
+    assert ranked, "timeline should rank at least one window"
+
+    events = read_journal(out / "journal.jsonl")
+    assert events[0]["event"] == "run_start"
+    assert events[-1]["event"] == "run_end"
+    windows = [e for e in events if e["event"] == "window"]
+    journal_ranked = [w for w in windows if w["outcome"] == "ranked"]
+    assert len(windows) == len(results)
+    assert len(journal_ranked) == len(ranked)
+    assert events[-1]["ranked"] == len(ranked)
+    for w in journal_ranked:
+        # Device-side convergence made it out of the jitted program.
+        assert w["rank_iterations"] == cfg.pagerank.iterations
+        assert w["rank_residual"] is not None
+        assert w["kernel"] is not None
+        assert "rank_wait" in w["timings"] or "rank_dispatch" in w["timings"]
+        assert w["queue_depth"] is not None
+    # Registry counters agree with the run's own accounting.
+    iters = registry.get("microrank_rank_iterations")
+    total_iters = sum(s["count"] for s in iters.samples())
+    assert total_iters == len(ranked)
+    # Registered on every dispatch; samples appear only when the jit
+    # cache actually grows (an earlier test in the same process may
+    # already have compiled these program shapes).
+    retraces = registry.get("microrank_jit_retraces_total")
+    assert retraces is not None
+    staged = registry.get("microrank_staged_bytes_total")
+    assert sum(s["value"] for s in staged.samples()) > 0
+    # WindowResults mirror the journal (same objects that hit the sink).
+    for r in ranked:
+        assert r.rank_iterations == cfg.pagerank.iterations
+
+
+# ------------------------------------------------- convergence-trace parity
+
+
+def _halves(df):
+    tids = list(df["traceID"].unique())
+    return tids[: len(tids) // 2], tids[len(tids) // 2 :]
+
+
+@pytest.mark.parametrize("kernel", ["coo", "csr", "packed", "dense"])
+def test_convergence_trace_parity_oracle_vs_device(kernel, registry):
+    """The device residual trace matches the numpy oracle's (same
+    definition: post-normalization L-inf change per partition) within
+    f32-vs-f64 tolerance, per kernel."""
+    from microrank_tpu.rank_backends import NumpyRefBackend
+    from microrank_tpu.rank_backends.jax_tpu import JaxBackend
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_kinds=6, n_traces=80, seed=7)
+    )
+    nrm, abn = _halves(case.abnormal)
+    cfg = MicroRankConfig(
+        runtime=RuntimeConfig(kernel=kernel, prefer_bf16=False)
+    )
+    jb = JaxBackend(cfg)
+    jb.rank_window(case.abnormal, nrm, abn)
+    ob = NumpyRefBackend(cfg)
+    ob.rank_window(case.abnormal, nrm, abn)
+    conv_j, conv_o = jb.last_convergence, ob.last_convergence
+    assert conv_j is not None and conv_o is not None
+    assert conv_j["iterations"] == cfg.pagerank.iterations
+    assert conv_o["iterations"] == cfg.pagerank.iterations
+    for side in ("normal", "abnormal"):
+        dev = np.asarray(conv_j["residuals"][side])
+        ora = np.asarray(conv_o["residuals"][side])
+        assert dev.shape == ora.shape
+        np.testing.assert_allclose(
+            dev, ora, rtol=0.05, atol=1e-4,
+            err_msg=f"{kernel} {side} residual trace diverged",
+        )
+
+
+def test_convergence_trace_tol_iterations_parity(registry):
+    """iterations-to-tolerance: the device while_loop and the oracle
+    early-exit agree (joint vs per-partition stop differs by at most
+    one boundary step)."""
+    from microrank_tpu.rank_backends import NumpyRefBackend
+    from microrank_tpu.rank_backends.jax_tpu import JaxBackend
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_kinds=6, n_traces=80, seed=9)
+    )
+    nrm, abn = _halves(case.abnormal)
+    cfg = MicroRankConfig(
+        pagerank=PageRankConfig(tol=1e-3, iterations=60),
+        runtime=RuntimeConfig(kernel="coo", prefer_bf16=False),
+    )
+    jb = JaxBackend(cfg)
+    jb.rank_window(case.abnormal, nrm, abn)
+    ob = NumpyRefBackend(cfg)
+    ob.rank_window(case.abnormal, nrm, abn)
+    it_j = jb.last_convergence["iterations"]
+    it_o = ob.last_convergence["iterations"]
+    assert it_j < 60, "tol should stop the loop early"
+    assert abs(it_j - it_o) <= 1
+    assert jb.last_convergence["final_residual"] <= 1e-3 * 1.05
+
+
+def test_batched_traced_matches_per_window(registry):
+    """The vmapped traced program returns per-window traces equal to the
+    single-window ones."""
+    import jax
+
+    from microrank_tpu.graph.build import build_window_graph
+    from microrank_tpu.parallel.sharded_rank import (
+        rank_windows_batched_traced,
+        stack_window_graphs,
+    )
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_traced_device
+
+    cfg = MicroRankConfig()
+    graphs = []
+    for seed in (1, 2):
+        case = generate_case(
+            SyntheticConfig(n_operations=15, n_kinds=5, n_traces=50, seed=seed)
+        )
+        nrm, abn = _halves(case.abnormal)
+        g, _, _, _ = build_window_graph(case.abnormal, nrm, abn, aux="none")
+        graphs.append(g)
+    stacked = stack_window_graphs(graphs)
+    ti_b, ts_b, nv_b, res_b, it_b = jax.device_get(
+        rank_windows_batched_traced(
+            stacked, cfg.pagerank, cfg.spectrum, "coo"
+        )
+    )
+    for b, g in enumerate(graphs):
+        ti, ts, nv, res, it = jax.device_get(
+            rank_window_traced_device(g, cfg.pagerank, cfg.spectrum, None, "coo")
+        )
+        assert int(it_b[b]) == int(it)
+        np.testing.assert_allclose(res_b[b], res, rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------- sentinel
+
+
+def test_contention_sentinel_smoke(registry):
+    from microrank_tpu.obs.host import ContentionSentinel
+
+    s = ContentionSentinel()
+    first = s.sample()
+    second = s.sample()
+    for sample in (first, second):
+        assert set(sample) >= {
+            "load1", "load5", "cpus", "norm_load", "steal_ratio",
+            "contended",
+        }
+        assert sample["cpus"] >= 1
+        assert 0.0 <= sample["steal_ratio"] <= 1.0
+        assert sample["norm_load"] >= 0.0
+        assert isinstance(sample["contended"], bool)
+    # The gauges mirror the last sample.
+    assert registry.get("microrank_host_norm_load") is not None
+
+
+def test_sentinel_flags_high_load(registry):
+    from microrank_tpu.obs.host import ContentionSentinel
+
+    s = ContentionSentinel(load_threshold=-1.0)  # everything is contended
+    assert s.sample()["contended"] is True
+
+
+# ---------------------------------------------------------- follow counters
+
+
+def _follow_rca(tmp_path, tl):
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+
+    cfg = MicroRankConfig(
+        window=WindowConfig(detect_minutes=tl.window_minutes, skip_minutes=0.0)
+    )
+    rca = TableRCA(cfg)
+    normal_csv = tmp_path / "normal.csv"
+    if not normal_csv.exists():
+        tl.normal.to_csv(normal_csv, index=False)
+    rca.fit_baseline(load_span_table(normal_csv))
+    return rca
+
+
+@pytest.fixture(scope="module")
+def follow_timeline():
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    return generate_timeline(
+        SyntheticConfig(n_operations=30, n_kinds=8, n_traces=90, seed=13),
+        3,
+        [0, 1, 2],
+    )
+
+
+def test_follow_parse_failures_count_toward_idle_exit(
+    tmp_path, registry, follow_timeline
+):
+    """A permanently unparseable tail must trip idle_exit (advisor r5:
+    it used to retry forever without ever counting as idle) and emit
+    follow_parse_failures."""
+    from microrank_tpu.pipeline.follow import follow_table
+
+    csv = tmp_path / "stream.csv"
+    csv.write_text("totally,not\na traces file\n")
+    rca = _follow_rca(tmp_path, follow_timeline)
+    sizes = iter([10, 20, 30, 40, 50])
+
+    def grow(_):
+        # Grow the (still unparseable) file every poll so the no-growth
+        # idle path never triggers — only the parse-failure path can.
+        csv.write_text("garbage," * next(sizes) + "\n")
+
+    polls = follow_table(
+        rca, csv, tmp_path / "out", poll_seconds=0.0, idle_exit=3,
+        sleep=grow,
+    )
+    with pytest.raises(StopIteration):
+        next(polls)
+    failures = registry.get("microrank_follow_parse_failures_total")
+    assert failures is not None and failures.value() >= 3
+
+
+def test_follow_detects_rotation(tmp_path, registry, follow_timeline):
+    """Shrinking the file (rotation/truncation) is detected, counted,
+    and the follower re-reads instead of treating it as idle."""
+    from microrank_tpu.pipeline.follow import follow_table
+
+    tl = follow_timeline
+
+    def window_frame(w):
+        w0 = tl.start + pd.Timedelta(minutes=w * tl.window_minutes)
+        w1 = w0 + pd.Timedelta(minutes=tl.window_minutes)
+        df = tl.timeline
+        return df[(df["startTime"] >= w0) & (df["startTime"] < w1)]
+
+    csv = tmp_path / "stream.csv"
+    out = tmp_path / "out"
+    pd.concat([window_frame(0), window_frame(1), window_frame(2)]).to_csv(
+        csv, index=False
+    )
+    rca = _follow_rca(tmp_path, follow_timeline)
+    polls = follow_table(
+        rca, csv, out, poll_seconds=0.0, idle_exit=2, sleep=lambda s: None
+    )
+    first = next(polls)
+    assert sum(1 for r in first if r.ranking) == 2  # windows 0+1 closed
+
+    # Rotate: the collector replaced the file with a shorter one.
+    window_frame(0).to_csv(csv, index=False)
+    second = next(polls)
+    # Nothing NEW ranks (the cursor is past the rotated-in content)...
+    assert sum(1 for r in second if r.ranking) == 0
+    # ...but the rotation was seen and counted, not mistaken for growth.
+    rotations = registry.get("microrank_follow_rotations_total")
+    assert rotations is not None and rotations.value() == 1
+    with pytest.raises(StopIteration):
+        next(polls)
+
+
+# ---------------------------------------------------------------- contracts
+
+
+def test_detect_batch_contract_enforced(registry):
+    from microrank_tpu.detect import compute_slo
+    from microrank_tpu.graph.build import build_detect_batch
+    from microrank_tpu.utils.guards import ContractError, contract_checks
+
+    case = generate_case(
+        SyntheticConfig(n_operations=10, n_kinds=4, n_traces=30, seed=3)
+    )
+    vocab, _ = compute_slo(case.normal)
+    with contract_checks(True):
+        batch, tids = build_detect_batch(case.abnormal, vocab)
+    assert batch.op.dtype == np.int32
+
+    from microrank_tpu.analysis.contracts import contract
+
+    @contract(batch="detectbatch")
+    def consume(batch):
+        return batch
+
+    with contract_checks(True):
+        consume(batch)
+        with pytest.raises(ContractError, match="dtype"):
+            consume(batch._replace(duration_us=batch.duration_us.astype(np.float64)))
+        with pytest.raises(ContractError, match="span axis"):
+            consume(batch._replace(trace=batch.trace[:-1]))
+        with pytest.raises(ContractError, match="DetectBatch"):
+            consume((1, 2))
+
+
+def test_blob_codec_contract_roundtrip(registry):
+    import jax
+
+    from microrank_tpu.graph.build import build_window_graph
+    from microrank_tpu.rank_backends.blob import (
+        pack_graph_blob,
+        unpack_graph_blob,
+    )
+    from microrank_tpu.utils.guards import ContractError, contract_checks
+
+    case = generate_case(
+        SyntheticConfig(n_operations=12, n_kinds=4, n_traces=40, seed=5)
+    )
+    nrm, abn = _halves(case.abnormal)
+    graph, _, _, _ = build_window_graph(case.abnormal, nrm, abn, aux="all")
+    with contract_checks(True):
+        blob, layout = pack_graph_blob(graph)
+        assert blob.dtype == np.uint32
+        rebuilt = unpack_graph_blob(jax.numpy.asarray(blob), layout)
+        # Round-trip is bit-exact on every leaf.
+        for pname in ("normal", "abnormal"):
+            a, b = getattr(graph, pname), getattr(rebuilt, pname)
+            for f in a._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                    err_msg=f"{pname}.{f}",
+                )
+        # A dtype-corrupted graph fails the pack contract.
+        bad = graph._replace(
+            normal=graph.normal._replace(
+                sr_val=graph.normal.sr_val.astype(np.float64)
+            )
+        )
+        with pytest.raises(ContractError, match="dtype"):
+            pack_graph_blob(bad)
+
+
+# ------------------------------------------------------------------ cli
+
+
+def test_cli_stats_emits_prometheus(tmp_path, registry, capsys):
+    """`cli run` writes the snapshot + journal; `cli stats` re-emits
+    valid Prometheus text covering retraces, staged bytes and the
+    per-kernel convergence metrics (the acceptance surface)."""
+    from microrank_tpu.cli.main import main
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=25, n_kinds=8, n_traces=80, seed=17),
+        2,
+        [0, 1],
+    )
+    normal_csv = tmp_path / "normal.csv"
+    abn_csv = tmp_path / "abn.csv"
+    tl.normal.to_csv(normal_csv, index=False)
+    tl.timeline.to_csv(abn_csv, index=False)
+    out = tmp_path / "out"
+    rc = main(
+        [
+            "run",
+            "--normal", str(normal_csv),
+            "--abnormal", str(abn_csv),
+            "-o", str(out),
+            "--detect-minutes", str(tl.window_minutes),
+            "--skip-minutes", "0",
+        ]
+    )
+    assert rc == 0
+    assert (out / "metrics.json").exists()
+    assert (out / "metrics.prom").exists()
+    assert (out / "journal.jsonl").exists()
+    capsys.readouterr()
+
+    rc = main(["stats", str(out), "--journal"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "# TYPE microrank_jit_retraces_total counter" in text
+    assert "microrank_staged_bytes_total" in text
+    assert "# TYPE microrank_rank_iterations histogram" in text
+    assert "microrank_rank_final_residual" in text
+    assert re.search(r'microrank_rank_iterations_count\{kernel="\w+"\} \d+', text)
+
+    rc = main(["stats", str(out), "--format", "json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert "microrank_rank_iterations" in data["metrics"]
+
+
+def test_metrics_http_server(registry):
+    import urllib.request
+
+    from microrank_tpu.obs.server import start_metrics_server
+
+    registry.counter("t_live_total", "x").inc(4)
+    server = start_metrics_server(0, registry)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "t_live_total 4" in body
+        jbody = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read()
+        )
+        assert jbody["metrics"]["t_live_total"]["samples"][0]["value"] == 4
+        assert (
+            urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
+        )
+    finally:
+        server.close()
