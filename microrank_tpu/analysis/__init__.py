@@ -19,6 +19,8 @@ in SURVEY.md §5):
                    argument position
   R5 contracts     public rank/spectrum entry points carry @contract
                    shape/dtype annotations (analysis.contracts)
+  R6 device-put    no jax.device_put inside traced code — staging
+                   happens at the dispatch boundary, not under a trace
 
 Run it::
 

@@ -332,6 +332,30 @@ class ContractRule(Rule):
         return False
 
 
+@register
+class DevicePutRule(Rule):
+    """R6: no ``jax.device_put`` inside traced code.
+
+    Staging belongs at the dispatch boundary (blob.stage_rank_window /
+    the per-leaf device_put right before a jitted call). Inside a jit
+    call graph the call is not a transfer at all — it traces to a
+    placement hint that can silently pin the operand's sharding against
+    the surrounding program's layout — and on the op-by-op path it
+    serializes dispatch with one blocking RPC per call. Same traced-
+    call-graph analysis as R1; host-side staging helpers that are never
+    reached from a jit root are exempt by construction.
+    """
+
+    name = "R6"
+    slug = "device-put-traced"
+    summary = "jax.device_put inside a traced region"
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.traced.events:
+            if ev.kind == "device-put" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
 def iter_rules() -> Iterable[Rule]:
     from .core import RULES
 

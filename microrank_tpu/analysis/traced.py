@@ -611,6 +611,28 @@ class _TaintWalker:
         dotted = self.module.dotted(call.func)
         if dotted is None:
             return
+        if dotted == "jax.device_put":
+            # Tainted or not: staging a host constant from inside a
+            # traced region is the same mistake (R6 reports these).
+            self.events.append(
+                Event(
+                    kind="device-put",
+                    module=self.module,
+                    line=call.lineno,
+                    col=call.col_offset,
+                    message=(
+                        "`jax.device_put` inside a traced region — "
+                        "under jit it is no transfer at all (it traces "
+                        "to a placement hint that can silently pin the "
+                        "operand's sharding), and in op-by-op execution "
+                        "it adds a blocking RPC per call; stage inputs "
+                        "at the dispatch boundary "
+                        "(rank_backends.blob.stage_rank_window) and "
+                        "pass them in as arguments"
+                    ),
+                )
+            )
+            return
         if dotted in _SYNC_EXTERNALS and args_tainted:
             self._event_sync(
                 call,

@@ -5,9 +5,11 @@ paths and constants edited in source (online_rca.py:219-255; README.md
 instructs editing the file). Here:
 
     python -m microrank_tpu.cli run    --normal N.csv --abnormal A.csv -o out/
+    python -m microrank_tpu.cli serve  --normal N.csv --port 8377 -o out/
     python -m microrank_tpu.cli synth  -o data/ --operations 100 --traces 500
     python -m microrank_tpu.cli eval   --cases 40 [--faults 2] [--detection]
     python -m microrank_tpu.cli stats  out/       (telemetry exposition)
+    python -m microrank_tpu.cli stats  --diff before/ after/   (deltas)
     python -m microrank_tpu.cli collect ...       (optional ClickHouse export)
 
 (The benchmark lives at the repo root — ``python bench.py`` — because it
@@ -144,16 +146,9 @@ def _config_from_args(args) -> "MicroRankConfig":
     return cfg
 
 
-def cmd_stats(args) -> int:
-    """Offline metrics exposition: re-emit a finished run's snapshot
-    (``metrics.json`` written at run end) as Prometheus text or JSON,
-    and summarize the run journal when present."""
-    import os
-
-    from ..obs import read_journal, registry_from_json
-    from ..obs.journal import JOURNAL_NAME
-
-    target = Path(args.target)
+def _load_snapshot(target: Path):
+    """Resolve a stats target (run dir or metrics.json path) to its
+    parsed snapshot dict, or None with a message on stderr."""
     snap_path = target / "metrics.json" if target.is_dir() else target
     if not snap_path.exists():
         print(
@@ -161,8 +156,50 @@ def cmd_stats(args) -> int:
             f"{target}` first, or point at a metrics.json)",
             file=sys.stderr,
         )
+        return None
+    return json.loads(snap_path.read_text())
+
+
+def cmd_stats(args) -> int:
+    """Offline metrics exposition: re-emit a finished run's snapshot
+    (``metrics.json`` written at run end) as Prometheus text or JSON,
+    and summarize the run journal when present. ``--diff`` takes TWO
+    targets and emits after-minus-before deltas (counters/histograms
+    subtract; gauges keep the after reading) — compare two runs, or a
+    snapshot taken before and after a traffic window."""
+    import os
+
+    from ..obs import read_journal, registry_from_json
+    from ..obs.journal import JOURNAL_NAME
+
+    if args.diff:
+        if len(args.target) != 2:
+            print(
+                "--diff takes exactly two targets: "
+                "`cli stats --diff before/ after/`",
+                file=sys.stderr,
+            )
+            return 2
+        from ..obs import diff_registries
+
+        snaps = [_load_snapshot(Path(t)) for t in args.target]
+        if any(s is None for s in snaps):
+            return 2
+        delta = diff_registries(
+            registry_from_json(snaps[0]), registry_from_json(snaps[1])
+        )
+        if args.format == "json":
+            print(json.dumps(delta.to_json(), indent=2))
+        else:
+            print(delta.to_prometheus(), end="")
+        return 0
+    if len(args.target) != 1:
+        print("stats takes one target (or two with --diff)", file=sys.stderr)
         return 2
-    data = json.loads(snap_path.read_text())
+    target = Path(args.target[0])
+    data = _load_snapshot(target)
+    if data is None:
+        return 2
     if args.format == "json":
         print(json.dumps(data, indent=2))
     else:
@@ -433,6 +470,49 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Online RCA service: accept windows over HTTP, coalesce concurrent
+    requests into padded micro-batches, rank on device, degrade to the
+    numpy_ref oracle on dispatch failure (serve/ subsystem)."""
+    import dataclasses
+
+    from ..config import ServeConfig
+    from ..io import load_traces_csv
+    from ..serve import ServeService, run_serve
+    from ..utils.logging import get_logger
+
+    log = get_logger("microrank_tpu.cli")
+    cfg = _config_from_args(args)
+    overrides = {
+        k: v
+        for k, v in {
+            "host": args.host,
+            "port": args.port,
+            "max_queue_depth": args.max_queue_depth,
+            "retry_after_seconds": args.retry_after,
+            "max_batch_windows": args.max_batch_windows,
+            "max_wait_ms": args.max_wait_ms,
+            "request_timeout_seconds": args.request_timeout,
+            "drain_seconds": args.drain_seconds,
+            "warmup": False if args.no_warmup else None,
+            "fallback": False if args.no_fallback else None,
+            "inject_dispatch_failures": args.inject_dispatch_failures,
+        }.items()
+        if v is not None
+    }
+    cfg = cfg.replace(serve=dataclasses.replace(cfg.serve, **overrides))
+    service = ServeService(cfg, out_dir=args.output)
+    service.fit_baseline(load_traces_csv(args.normal))
+    for spec in args.dataset or ():
+        name, _, path = spec.partition("=")
+        if not name or not path:
+            log.error("--dataset takes NAME=CSV_PATH, got %r", spec)
+            return 2
+        service.add_dataset(name, load_traces_csv(path))
+    service.start()
+    return run_serve(service, cfg.serve.host, cfg.serve.port)
+
+
 def cmd_synth(args) -> int:
     from ..testing import SyntheticConfig, generate_case
 
@@ -677,6 +757,75 @@ def main(argv=None) -> int:
     _add_config_flags(p_run)
     p_run.set_defaults(fn=cmd_run)
 
+    p_srv = sub.add_parser(
+        "serve",
+        help="online RCA service: HTTP requests coalesced into "
+        "micro-batched device dispatches, with admission control and "
+        "numpy_ref graceful degradation",
+    )
+    p_srv.add_argument(
+        "--normal", required=True,
+        help="normal-period traces.csv (SLO baseline fitted at startup)",
+    )
+    p_srv.add_argument(
+        "--dataset", action="append", metavar="NAME=CSV",
+        help="pre-stage an abnormal dump; requests may then send "
+        '{"dataset": NAME, "start": ..., "end": ...} instead of inline '
+        "spans (repeatable)",
+    )
+    p_srv.add_argument("--host", default=None, help="bind address")
+    p_srv.add_argument(
+        "--port", type=int, default=None,
+        help="listen port (0 picks a free port; default 8377)",
+    )
+    p_srv.add_argument(
+        "-o", "--output", default=None,
+        help="service output directory: journal.jsonl per batch/window "
+        "+ metrics snapshot written at drain",
+    )
+    p_srv.add_argument(
+        "--max-queue-depth", type=_positive_int, default=None,
+        help="admission bound: requests admitted at once before the "
+        "service answers 429 + Retry-After",
+    )
+    p_srv.add_argument(
+        "--retry-after", type=float, default=None,
+        help="Retry-After seconds on 429/503 responses",
+    )
+    p_srv.add_argument(
+        "--max-batch-windows", type=_positive_int, default=None,
+        help="micro-batch ceiling: a shape bucket dispatches as soon "
+        "as it holds this many requests",
+    )
+    p_srv.add_argument(
+        "--max-wait-ms", type=float, default=None,
+        help="micro-batch latency bound: a bucket dispatches once its "
+        "oldest request waited this long (the latency/occupancy knob)",
+    )
+    p_srv.add_argument(
+        "--request-timeout", type=float, default=None,
+        help="seconds an HTTP caller waits before 504",
+    )
+    p_srv.add_argument(
+        "--drain-seconds", type=float, default=None,
+        help="SIGTERM drain bound for in-flight requests",
+    )
+    p_srv.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the startup jit warmup (first requests pay compile)",
+    )
+    p_srv.add_argument(
+        "--no-fallback", action="store_true",
+        help="disable numpy_ref degradation: failed batches answer 500",
+    )
+    p_srv.add_argument(
+        "--inject-dispatch-failures", type=int, default=None,
+        help="chaos/test knob: fail this many device dispatches with "
+        "an injected error (drives the degradation path)",
+    )
+    _add_config_flags(p_srv)
+    p_srv.set_defaults(fn=cmd_serve)
+
     p_synth = sub.add_parser("synth", help="generate a synthetic chaos case")
     p_synth.add_argument("-o", "--output", required=True)
     p_synth.add_argument("--operations", type=int, default=40)
@@ -759,8 +908,16 @@ def main(argv=None) -> int:
     )
     p_stats.add_argument(
         "target",
+        nargs="+",
         help="a run output directory (reads metrics.json there) or a "
-        "metrics.json path",
+        "metrics.json path; with --diff, exactly two of these "
+        "(before after)",
+    )
+    p_stats.add_argument(
+        "--diff", action="store_true",
+        help="emit after-minus-before metric deltas between TWO "
+        "targets (counters/histograms subtract, gauges keep the "
+        "after reading)",
     )
     p_stats.add_argument(
         "--format", choices=["prom", "json"], default="prom",
@@ -777,7 +934,7 @@ def main(argv=None) -> int:
     add_lint_parser(sub)
 
     args = parser.parse_args(argv)
-    if args.fn in (cmd_run, cmd_eval):  # jax-touching commands only
+    if args.fn in (cmd_run, cmd_eval, cmd_serve):  # jax-touching only
         _enable_jit_cache()
     return args.fn(args)
 

@@ -252,6 +252,48 @@ class RuntimeConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online RCA service knobs (``cli serve`` — serve/ subsystem).
+
+    The service coalesces concurrent requests into padded micro-batches
+    (one vmapped device dispatch ranks many tenants' windows), bounds its
+    queue with admission control, and degrades to the numpy_ref oracle
+    when the device path fails.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8377
+    # Admission control: requests admitted (queued or in flight through
+    # the batcher) at once. Past it the service answers 429 with a
+    # Retry-After header instead of letting the queue grow unboundedly.
+    max_queue_depth: int = 64
+    retry_after_seconds: float = 1.0
+    # Micro-batching: a shape bucket dispatches as soon as it holds
+    # max_batch_windows requests, or when its oldest request has waited
+    # max_wait_ms — the latency/occupancy knob (0 disables coalescing
+    # waits entirely: every request dispatches alone).
+    max_batch_windows: int = 8
+    max_wait_ms: float = 25.0
+    # Per-request ceiling an HTTP caller waits before 504 (the request
+    # itself is NOT cancelled — its batch completes and is journaled).
+    request_timeout_seconds: float = 60.0
+    # Compile the batched rank program at startup (occupancies 1 and 2)
+    # so the first real requests don't pay the trace+compile stall.
+    warmup: bool = True
+    # Graceful degradation: after a failed device dispatch (one retry),
+    # rank each batch member on the numpy_ref oracle and mark the
+    # response ``degraded``. Off: the batch's requests fail with 500.
+    fallback: bool = True
+    # SIGTERM drain bound: seconds to wait for in-flight requests before
+    # the process force-exits.
+    drain_seconds: float = 10.0
+    # Chaos/test knob: fail this many device dispatches (including
+    # retries) with an injected error before behaving normally — drives
+    # the degradation path end to end without a real device fault.
+    inject_dispatch_failures: int = 0
+
+
+@dataclass(frozen=True)
 class MicroRankConfig:
     detector: DetectorConfig = field(default_factory=DetectorConfig)
     pagerank: PageRankConfig = field(default_factory=PageRankConfig)
@@ -259,6 +301,7 @@ class MicroRankConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     compat: CompatConfig = field(default_factory=CompatConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -292,4 +335,5 @@ class MicroRankConfig:
             window=_mk(WindowConfig, d.get("window", {})),
             compat=_mk(CompatConfig, d.get("compat", {})),
             runtime=_mk(RuntimeConfig, d.get("runtime", {})),
+            serve=_mk(ServeConfig, d.get("serve", {})),
         )

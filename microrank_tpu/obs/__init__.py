@@ -15,6 +15,7 @@ from .registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    diff_registries,
     get_registry,
     registry_from_json,
     set_registry,
@@ -28,6 +29,7 @@ __all__ = [
     "JOURNAL_NAME",
     "MetricsRegistry",
     "RunJournal",
+    "diff_registries",
     "get_registry",
     "read_journal",
     "registry_from_json",

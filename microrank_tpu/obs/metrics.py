@@ -126,6 +126,56 @@ def follow_rotations() -> Counter:
     )
 
 
+def serve_requests() -> Counter:
+    return get_registry().counter(
+        "microrank_serve_requests_total",
+        "RCA service requests, by outcome",
+        # ranked | clean | skipped | rejected | failed
+        labelnames=("outcome",),
+    )
+
+
+def serve_queue_depth() -> Gauge:
+    return get_registry().gauge(
+        "microrank_serve_queue_depth",
+        "Requests admitted and not yet answered (admission-control "
+        "depth; 429s start past ServeConfig.max_queue_depth)",
+    )
+
+
+def serve_batch_windows() -> Histogram:
+    return get_registry().histogram(
+        "microrank_serve_batch_windows",
+        "Windows coalesced per device dispatch (micro-batch occupancy; "
+        "a mass at 1 under concurrent load means buckets never match — "
+        "check pad_policy and max_wait_ms)",
+        buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+    )
+
+
+def serve_last_batch_gauge() -> Gauge:
+    return get_registry().gauge(
+        "microrank_serve_last_batch_windows",
+        "Occupancy of the most recent non-warmup device dispatch",
+    )
+
+
+def serve_degraded() -> Counter:
+    return get_registry().counter(
+        "microrank_serve_degraded_total",
+        "Requests answered by the numpy_ref fallback after a failed "
+        "device dispatch (responses carry degraded=true)",
+    )
+
+
+def serve_stage_seconds() -> Histogram:
+    return get_registry().histogram(
+        "microrank_serve_stage_seconds",
+        "Wall-clock of each request stage in the RCA service",
+        labelnames=("stage",),  # queue | build | rank | total
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -150,6 +200,8 @@ def ensure_catalog() -> None:
         rank_final_residual, staged_bytes, staged_pad_bytes,
         staging_transfers, jit_retraces, pipeline_inflight,
         follow_polls, follow_parse_failures, follow_rotations,
+        serve_requests, serve_queue_depth, serve_batch_windows,
+        serve_last_batch_gauge, serve_degraded, serve_stage_seconds,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -170,6 +222,19 @@ def record_convergence(
     rank_iterations().observe(float(n_iters), kernel=kernel)
     if np.isfinite(final_residual):
         rank_final_residual().observe(float(final_residual), kernel=kernel)
+
+
+def record_serve_request(outcome: str, total_seconds: float = None) -> None:
+    serve_requests().inc(outcome=outcome)
+    if total_seconds is not None:
+        serve_stage_seconds().observe(float(total_seconds), stage="total")
+
+
+def record_serve_batch(occupancy: int, degraded: int = 0) -> None:
+    serve_batch_windows().observe(float(occupancy))
+    serve_last_batch_gauge().set(float(occupancy))
+    if degraded:
+        serve_degraded().inc(float(degraded))
 
 
 def record_staging(

@@ -41,6 +41,7 @@ __all__ = [
     "get_registry",
     "set_registry",
     "registry_from_json",
+    "diff_registries",
 ]
 
 # Latency-shaped default buckets (seconds): 100 us .. ~100 s.
@@ -379,6 +380,53 @@ def registry_from_json(data: dict) -> MetricsRegistry:
                 if isinstance(s.get("value"), (int, float)):
                     g.set(float(s["value"]), **s.get("labels", {}))
     return reg
+
+
+def diff_registries(
+    before: MetricsRegistry, after: MetricsRegistry
+) -> MetricsRegistry:
+    """``after - before`` as a new registry (``cli stats --diff``).
+
+    Counters and histogram bucket counts/sums subtract per label set
+    (clamped at zero — a counter that went DOWN means the process
+    restarted between snapshots, and a negative "delta" would be
+    noise, not information). Gauges are point-in-time readings, so the
+    diff keeps the ``after`` value. Metrics present only in ``after``
+    diff against zero; metrics that disappeared are dropped.
+    """
+    out = MetricsRegistry()
+    for m in after.metrics():
+        prev = before.get(m.name)
+        prev_ok = prev is not None and type(prev) is type(m)
+        if isinstance(m, Counter):
+            c = out.counter(m.name, m.help, m.labelnames)
+            for s in m.samples():
+                base = prev.value(**s["labels"]) if prev_ok else 0.0
+                c.inc(max(0.0, float(s["value"]) - base), **s["labels"])
+        elif isinstance(m, Histogram):
+            same_bounds = prev_ok and prev.buckets == m.buckets
+            h = out.histogram(m.name, m.help, m.labelnames, m.buckets)
+            for s in m.samples():
+                p = prev.snapshot(**s["labels"]) if same_bounds else None
+                if p is None:
+                    p = {"counts": [0] * len(s["buckets"]), "sum": 0.0,
+                         "count": 0}
+                key = h._key(s["labels"])
+                with h._lock:
+                    h._values[key] = {
+                        "counts": [
+                            max(0, a - b)
+                            for a, b in zip(s["buckets"], p["counts"])
+                        ],
+                        "sum": max(0.0, s["sum"] - p["sum"]),
+                        "count": max(0, s["count"] - p["count"]),
+                    }
+        else:  # gauges (and unknown kinds): the after reading stands
+            g = out.gauge(m.name, m.help, m.labelnames)
+            for s in m.samples():
+                if isinstance(s.get("value"), (int, float)):
+                    g.set(float(s["value"]), **s["labels"])
+    return out
 
 
 _default_lock = threading.Lock()

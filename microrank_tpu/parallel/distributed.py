@@ -26,6 +26,43 @@ from typing import Optional
 _initialized = False
 
 
+def cpu_collectives_supported() -> bool:
+    """True when this jax/jaxlib can run cross-process collectives on
+    the CPU backend (Gloo TCP transport + the config knob that selects
+    it). Older jaxlibs hard-raise "Multiprocess computations aren't
+    implemented on the CPU backend" inside any sharded program that
+    spans processes — tests gate on this probe instead of failing
+    unconditionally (ROADMAP open item). Probing imports no backend.
+    """
+    try:
+        from jax._src.lib import xla_extension
+    except ImportError:
+        return False
+    return hasattr(xla_extension, "make_gloo_tcp_collectives")
+
+
+def _enable_cpu_collectives() -> None:
+    """Select Gloo CPU collectives BEFORE the CPU client initializes
+    (the choice is baked into client creation). No-op on accelerator
+    runtimes — their ICI/DCN collectives need no plumbing — and on
+    jaxlibs without the knob."""
+    import jax
+
+    platforms = os.environ.get("JAX_PLATFORMS") or ""
+    try:
+        platforms = platforms or (jax.config.jax_platforms or "")
+    except AttributeError:  # pragma: no cover - very old jax
+        pass
+    if "cpu" not in platforms.lower().split(","):
+        return
+    if not cpu_collectives_supported():
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - knob absent on this jax
+        pass
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -70,6 +107,10 @@ def initialize_distributed(
             )
         return False
 
+    # CPU runtimes need the Gloo collectives selected before the client
+    # exists, or every cross-process psum raises "Multiprocess
+    # computations aren't implemented on the CPU backend".
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -39,6 +39,15 @@ class WindowResult:
     rank_residual: Optional[float] = None
     kernel: Optional[str] = None
     queue_depth: Optional[int] = None
+    # Request-scoped fields (serve/ subsystem): the caller-supplied
+    # request id and tenant, whether the response came from the
+    # numpy_ref fallback after a failed device dispatch, and how many
+    # windows shared this window's device dispatch (micro-batch
+    # occupancy). All None/False on the offline pipelines.
+    request_id: Optional[str] = None
+    tenant: Optional[str] = None
+    degraded: bool = False
+    batch_windows: Optional[int] = None
 
     def apply_convergence(self, conv: Optional[dict]) -> None:
         """Fold a convergence summary ({iterations, final_residual, ...})

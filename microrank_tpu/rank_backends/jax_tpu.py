@@ -1223,6 +1223,37 @@ def choose_kernel(
     return "coo"
 
 
+def prepare_window_graph(span_df, normal_ids, abnormal_ids, config):
+    """Host half of a device rank, shared by JaxBackend and the serve
+    batcher: build the padded window graph under the config's pad
+    policy, resolve kernel="auto", and strip the fields the kernel
+    never reads. Returns ``(graph, op_names, kernel)`` with the graph
+    already ``device_subset``-stripped for ``kernel``.
+    """
+    from ..graph.build import aux_for_kernel, build_window_graph
+    from .base import validate_partitions
+
+    normal_ids = list(normal_ids)
+    abnormal_ids = list(abnormal_ids)
+    validate_partitions(normal_ids, abnormal_ids)
+    validate_tiebreak(config.spectrum)
+    rt = config.runtime
+    graph, op_names, _, _ = build_window_graph(
+        span_df,
+        normal_ids,
+        abnormal_ids,
+        pad_policy=rt.pad_policy,
+        min_pad=rt.min_pad,
+        aux=aux_for_kernel(rt.kernel),
+        dense_budget_bytes=rt.dense_budget_bytes,
+        collapse=rt.collapse_kinds,
+    )
+    kernel = rt.kernel
+    if kernel == "auto":
+        kernel = choose_kernel(graph, rt.dense_budget_bytes, rt.prefer_bf16)
+    return device_subset(graph, kernel), op_names, kernel
+
+
 class JaxBackend:
     """The ``rank_backends`` seam's device implementation.
 
@@ -1243,29 +1274,10 @@ class JaxBackend:
     def rank_window(
         self, span_df, normal_ids, abnormal_ids
     ) -> Tuple[List[str], List[float]]:
-        from ..graph.build import aux_for_kernel, build_window_graph
-        from .base import validate_partitions
-
-        normal_ids = list(normal_ids)
-        abnormal_ids = list(abnormal_ids)
-        validate_partitions(normal_ids, abnormal_ids)
-        validate_tiebreak(self.config.spectrum)
         rt = self.config.runtime
-        graph, op_names, _, _ = build_window_graph(
-            span_df,
-            normal_ids,
-            abnormal_ids,
-            pad_policy=rt.pad_policy,
-            min_pad=rt.min_pad,
-            aux=aux_for_kernel(rt.kernel),
-            dense_budget_bytes=rt.dense_budget_bytes,
-            collapse=rt.collapse_kinds,
+        graph, op_names, kernel = prepare_window_graph(
+            span_df, normal_ids, abnormal_ids, self.config
         )
-        kernel = rt.kernel
-        if kernel == "auto":
-            kernel = choose_kernel(
-                graph, rt.dense_budget_bytes, rt.prefer_bf16
-            )
         from ..utils.guards import contract_checks
         from .blob import stage_rank_window
 
@@ -1275,7 +1287,7 @@ class JaxBackend:
         # the host-side score validation and the signature contracts.
         with contract_checks(rt.validate_numerics):
             out = stage_rank_window(
-                device_subset(graph, kernel),
+                graph,
                 self.config.pagerank,
                 self.config.spectrum,
                 kernel,
@@ -1325,36 +1337,18 @@ class JaxBackend:
         Returns {method: ([op names], [scores])} in METHODS order — the
         cheap way to produce a paper-style per-formula comparison.
         """
-        from ..graph.build import aux_for_kernel, build_window_graph
         from ..spectrum.formulas import METHODS
-        from .base import validate_partitions
 
-        normal_ids = list(normal_ids)
-        abnormal_ids = list(abnormal_ids)
-        validate_partitions(normal_ids, abnormal_ids)
-        validate_tiebreak(self.config.spectrum)
         rt = self.config.runtime
-        graph, op_names, _, _ = build_window_graph(
-            span_df,
-            normal_ids,
-            abnormal_ids,
-            pad_policy=rt.pad_policy,
-            min_pad=rt.min_pad,
-            aux=aux_for_kernel(rt.kernel),
-            dense_budget_bytes=rt.dense_budget_bytes,
-            collapse=rt.collapse_kinds,
+        graph, op_names, kernel = prepare_window_graph(
+            span_df, normal_ids, abnormal_ids, self.config
         )
-        kernel = rt.kernel
-        if kernel == "auto":
-            kernel = choose_kernel(
-                graph, rt.dense_budget_bytes, rt.prefer_bf16
-            )
         from ..utils.guards import contract_checks
 
         with contract_checks(rt.validate_numerics):
             top_idx, top_scores, n_valid = jax.device_get(
                 rank_window_all_methods_device(
-                    jax.device_put(device_subset(graph, kernel)),
+                    jax.device_put(graph),
                     self.config.pagerank,
                     self.config.spectrum,
                     None,

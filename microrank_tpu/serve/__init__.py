@@ -1,0 +1,46 @@
+"""Online RCA service (``cli serve``): the request path the offline
+runners never had — asyncio HTTP frontend (server), per-tenant fair
+scheduling (scheduler), cross-request micro-batching keyed by pad
+buckets (batcher), admission control (admission), and the wire protocol
+(protocol). One device dispatch ranks many tenants' windows; device
+faults degrade to the numpy_ref oracle instead of dropping requests.
+"""
+
+from .admission import AdmissionController
+from .batcher import MicroBatcher, PendingWindow, bucket_key
+from .protocol import (
+    ProtocolError,
+    RankRequest,
+    parse_rank_request,
+    response_body,
+    spans_to_frame,
+)
+from .scheduler import BatchScheduler, ShutdownError
+from .server import (
+    HttpFrontend,
+    ServeHandle,
+    ServeService,
+    ServiceDraining,
+    ServiceOverloaded,
+    run_serve,
+)
+
+__all__ = [
+    "AdmissionController",
+    "BatchScheduler",
+    "HttpFrontend",
+    "MicroBatcher",
+    "PendingWindow",
+    "ProtocolError",
+    "RankRequest",
+    "ServeHandle",
+    "ServeService",
+    "ServiceDraining",
+    "ServiceOverloaded",
+    "ShutdownError",
+    "bucket_key",
+    "parse_rank_request",
+    "response_body",
+    "run_serve",
+    "spans_to_frame",
+]

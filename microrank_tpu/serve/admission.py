@@ -1,0 +1,59 @@
+"""Admission control: a bounded count of requests in the service.
+
+The queue between the HTTP frontend and the batching scheduler must not
+grow without bound — a traffic spike would otherwise turn into unbounded
+memory (parked span payloads) and unbounded tail latency (requests
+serviced minutes after their window closed). One counter covers a
+request's whole residency: admitted at the frontend, released when its
+response future resolves. Past ``max_depth`` the frontend answers 429
+with ``Retry-After`` — load sheds at the edge, the device keeps ranking
+the admitted set. A draining service (SIGTERM received) admits nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class AdmissionController:
+    def __init__(self, max_depth: int, retry_after_seconds: float = 1.0):
+        self.max_depth = int(max_depth)
+        self.retry_after_seconds = float(retry_after_seconds)
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._closed = False
+
+    def try_admit(self) -> bool:
+        """One admission slot, or False (429 / 503 at the caller)."""
+        from ..obs.metrics import serve_queue_depth
+
+        with self._lock:
+            if self._closed or self._depth >= self.max_depth:
+                return False
+            self._depth += 1
+            depth = self._depth
+        serve_queue_depth().set(float(depth))
+        return True
+
+    def release(self) -> None:
+        from ..obs.metrics import serve_queue_depth
+
+        with self._lock:
+            self._depth = max(0, self._depth - 1)
+            depth = self._depth
+        serve_queue_depth().set(float(depth))
+
+    def close(self) -> None:
+        """Stop admitting (drain mode); in-flight slots still release."""
+        with self._lock:
+            self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth

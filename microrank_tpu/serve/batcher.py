@@ -1,0 +1,302 @@
+"""Micro-batching across concurrent requests, keyed by pad buckets.
+
+The offline pipelines already stack same-shaped window graphs and rank
+them in one vmapped program (``dispatch_batch_windows``,
+``batch_windows``); serving turns that inward-facing trick into the
+request path: concurrent requests whose padded graphs land in the same
+pad-policy bucket (``RuntimeConfig.pad_policy`` — the same buckets that
+keep the jit cache small offline) stack into ONE device dispatch, so a
+busy service amortizes dispatch/staging RPC overhead across tenants
+exactly like a batching inference server amortizes a forward pass. A
+bucket flushes when it reaches ``max_batch_windows`` or when its oldest
+request has waited ``max_wait_ms``.
+
+Graceful degradation: a failed device dispatch is retried once as a
+batch; if the retry fails too, every member is re-ranked individually on
+the ``numpy_ref`` oracle (pure host path, no jit, same semantics) and
+the responses carry ``degraded: true`` — the service answers slowly
+rather than not at all. No request is dropped on a device fault.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import MicroRankConfig
+from ..pipeline.results import WindowResult
+from .protocol import RankRequest
+
+
+def bucket_key(graph, kernel: str) -> Tuple:
+    """Shape signature of a (kernel-stripped) window graph: the jit
+    cache key modulo config. Two graphs with equal keys stack into one
+    batch whose compiled program is shared across every batch of the
+    same occupancy."""
+    import jax
+
+    return (kernel,) + tuple(
+        tuple(np.asarray(leaf).shape) for leaf in jax.tree.leaves(graph)
+    )
+
+
+@dataclass
+class PendingWindow:
+    """One admitted request, built and parked for coalescing."""
+
+    request: RankRequest
+    result: WindowResult
+    span_df: object                  # kept for the numpy_ref fallback
+    normal_ids: List[str]
+    abnormal_ids: List[str]
+    graph: object
+    op_names: List[str]
+    kernel: str
+    future: Future
+    enqueued: float                  # monotonic, at admission
+    built: float = 0.0               # monotonic, graph build done
+    on_done: Optional[Callable] = None
+    _finished: bool = field(default=False, repr=False)
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if error is not None:
+            self.future.set_exception(error)
+        else:
+            self.future.set_result(self.result)
+        if self.on_done is not None:
+            self.on_done(self, error)
+
+
+def _conv_summary(residuals, n_iters) -> dict:
+    """Host-side summary of one window's FETCHED convergence row."""
+    res = np.asarray(
+        residuals,
+        dtype=np.float64,  # mrlint: disable=R2(host-side summary of an already-fetched trace; never re-enters a jnp expression)
+    )
+    n = int(n_iters)
+    joint = res.max(axis=0)[:n]
+    return {
+        "iterations": n,
+        "final_residual": float(joint[-1]) if n else None,
+        "residuals": [float(x) for x in joint],
+    }
+
+
+class MicroBatcher:
+    """Owns the shape buckets and the device dispatch of full batches.
+
+    Single-threaded by design: only the batching scheduler calls in
+    (the lock guards the cheap bucket bookkeeping so stats can be read
+    from the HTTP thread). Dispatch itself is synchronous — the
+    scheduler thread is the device's program-order guarantee.
+    """
+
+    def __init__(self, config: MicroRankConfig, journal=None):
+        self.config = config
+        self.serve = config.serve
+        self.journal = journal
+        self._lock = threading.Lock()
+        # bucket key -> FIFO of PendingWindow (insertion order = age).
+        self._buckets: Dict[Tuple, List[PendingWindow]] = {}
+        self._inject_failures = int(self.serve.inject_dispatch_failures)
+        self.dispatches = 0
+
+    # ------------------------------------------------------------ intake
+    def submit(self, pw: PendingWindow) -> None:
+        key = bucket_key(pw.graph, pw.kernel)
+        with self._lock:
+            self._buckets.setdefault(key, []).append(pw)
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._buckets.values())
+
+    def next_deadline(self) -> Optional[float]:
+        """Monotonic time the oldest parked request must flush by."""
+        wait_s = max(0.0, float(self.serve.max_wait_ms)) / 1e3
+        with self._lock:
+            oldest = min(
+                (b[0].built for b in self._buckets.values() if b),
+                default=None,
+            )
+        return None if oldest is None else oldest + wait_s
+
+    def take_ready(self, force: bool = False) -> List[List[PendingWindow]]:
+        """Pop every bucket that is full, past its max-wait deadline, or
+        (``force``, drain mode) non-empty."""
+        now = time.monotonic()
+        wait_s = max(0.0, float(self.serve.max_wait_ms)) / 1e3
+        cap = max(1, int(self.serve.max_batch_windows))
+        out: List[List[PendingWindow]] = []
+        with self._lock:
+            for key in list(self._buckets):
+                bucket = self._buckets[key]
+                while len(bucket) >= cap:
+                    out.append(bucket[:cap])
+                    del bucket[:cap]
+                if bucket and (
+                    force or now - bucket[0].built >= wait_s
+                ):
+                    out.append(bucket[:])
+                    bucket.clear()
+                if not bucket:
+                    del self._buckets[key]
+        return out
+
+    # ---------------------------------------------------------- dispatch
+    def dispatch(self, items: List[PendingWindow], warmup=False) -> None:
+        """Rank one coalesced batch; resolves every member's future."""
+        t0 = time.monotonic()
+        try:
+            outs = self._device_dispatch(items)
+        except Exception as first:
+            self._log().warning(
+                "batch dispatch failed (%d windows): %s; retrying once",
+                len(items), first,
+            )
+            try:
+                outs = self._device_dispatch(items)
+            except Exception as second:
+                self._degrade(items, second, warmup=warmup)
+                return
+        batch_ms = (time.monotonic() - t0) * 1e3
+        self._assign(items, outs, batch_ms)
+        if not warmup:
+            from ..obs.metrics import record_serve_batch
+
+            record_serve_batch(len(items))
+        self.dispatches += 1
+        self._journal_batch(
+            items, batch_ms, degraded=0, warmup=warmup
+        )
+        for pw in items:
+            pw.finish()
+
+    def _device_dispatch(self, items: List[PendingWindow]):
+        if self._inject_failures > 0:
+            self._inject_failures -= 1
+            raise RuntimeError(
+                "injected device dispatch failure "
+                "(ServeConfig.inject_dispatch_failures)"
+            )
+        import jax
+
+        from ..parallel.sharded_rank import stack_window_graphs
+        from ..rank_backends.blob import stage_rank_windows_batched
+        from ..utils.guards import contract_checks
+
+        rt = self.config.runtime
+        stacked = stack_window_graphs([pw.graph for pw in items])
+        kernel = items[0].kernel
+        with contract_checks(rt.validate_numerics):
+            handles = stage_rank_windows_batched(
+                stacked,
+                self.config.pagerank,
+                self.config.spectrum,
+                kernel,
+                rt.blob_staging,
+                conv_trace=bool(rt.convergence_trace),
+            )
+        return jax.device_get(handles)
+
+    def _assign(self, items, outs, batch_ms: float) -> None:
+        ti, ts, nv = outs[:3]
+        per_window_ms = batch_ms / max(1, len(items))
+        for b, pw in enumerate(items):
+            n = int(nv[b])
+            names = [pw.op_names[int(i)] for i in ti[b][:n]]
+            scores = [float(s) for s in ts[b][:n]]
+            if self.config.runtime.validate_numerics:
+                from ..utils.guards import assert_finite_scores
+
+                assert_finite_scores(scores, "serve batch window")
+            pw.result.ranking = list(zip(names, scores))
+            pw.result.batch_windows = len(items)
+            pw.result.timings["rank_ms"] = round(per_window_ms, 3)
+            if len(outs) > 3:
+                conv = _conv_summary(outs[3][b], outs[4][b])
+                pw.result.apply_convergence(conv)
+                from ..obs.metrics import record_convergence
+
+                record_convergence(
+                    pw.kernel,
+                    conv["iterations"],
+                    conv["final_residual"]
+                    if conv["final_residual"] is not None
+                    else float("nan"),
+                )
+
+    # -------------------------------------------------------- degradation
+    def _degrade(self, items, error, warmup=False) -> None:
+        """Device path is down for this batch: answer from the numpy_ref
+        oracle per request (``fallback``), or fail the batch."""
+        if not self.serve.fallback:
+            for pw in items:
+                pw.finish(error=error)
+            return
+        self._log().error(
+            "batch dispatch failed twice (%s); degrading %d windows to "
+            "numpy_ref", error, len(items),
+        )
+        from ..rank_backends import NumpyRefBackend
+
+        backend = NumpyRefBackend(self.config)
+        done = []  # (pw, error) — futures resolve only after the
+        # batch's metrics/journal record, so a response never races its
+        # own telemetry.
+        degraded = 0
+        for pw in items:
+            t0 = time.monotonic()
+            try:
+                names, scores = backend.rank_window(
+                    pw.span_df, pw.normal_ids, pw.abnormal_ids
+                )
+            except Exception as e:
+                done.append((pw, e))
+                continue
+            pw.result.ranking = list(zip(names, scores))
+            pw.result.degraded = True
+            pw.result.kernel = "numpy_ref"
+            pw.result.batch_windows = 1
+            pw.result.timings["rank_ms"] = round(
+                (time.monotonic() - t0) * 1e3, 3
+            )
+            pw.result.apply_convergence(backend.last_convergence)
+            degraded += 1
+            done.append((pw, None))
+        if not warmup:
+            from ..obs.metrics import record_serve_batch
+
+            record_serve_batch(len(items), degraded=degraded)
+        self._journal_batch(items, 0.0, degraded=degraded, warmup=warmup)
+        for pw, err in done:
+            pw.finish(error=err)
+
+    # ------------------------------------------------------------- misc
+    def _journal_batch(self, items, batch_ms, degraded, warmup) -> None:
+        if self.journal is None:
+            return
+        self.journal.emit(
+            "serve_batch",
+            occupancy=len(items),
+            kernel=items[0].kernel if items else None,
+            dispatch_ms=round(batch_ms, 3),
+            degraded=degraded,
+            warmup=bool(warmup),
+            requests=[pw.request.request_id for pw in items],
+            tenants=sorted({pw.request.tenant for pw in items}),
+        )
+
+    @staticmethod
+    def _log():
+        from ..utils.logging import get_logger
+
+        return get_logger("microrank_tpu.serve")

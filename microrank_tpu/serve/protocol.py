@@ -1,0 +1,110 @@
+"""Wire protocol of the online RCA service (serve/).
+
+One request = one detection window. Two payload forms:
+
+* inline spans — ``{"spans": [{span record}, ...]}``: the caller ships
+  the window's span rows (canonical schema or raw ClickHouse column
+  names, same rename rule as CSV ingest);
+* pre-staged dataset — ``{"dataset": "name", "start": ..., "end": ...}``:
+  the server slices a dump it loaded at startup (``--dataset NAME=CSV``)
+  to the requested time range.
+
+Either form may carry ``tenant`` (fair-dequeue key, default "default")
+and ``request_id`` (echoed back; generated when absent). The response is
+the request-scoped ``WindowResult`` serialization (pipeline.results)
+plus batching telemetry — including ``degraded: true`` when the answer
+came from the numpy_ref fallback path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..pipeline.results import WindowResult
+
+_req_counter = itertools.count(1)
+
+
+class ProtocolError(ValueError):
+    """Malformed request — maps to HTTP 400."""
+
+    status = 400
+
+
+@dataclass
+class RankRequest:
+    request_id: str
+    tenant: str = "default"
+    spans: Optional[List[dict]] = None
+    dataset: Optional[str] = None
+    start: Optional[str] = None
+    end: Optional[str] = None
+
+
+def parse_rank_request(body: bytes) -> RankRequest:
+    """Parse + validate one POST /rank body."""
+    try:
+        data = json.loads(body or b"")
+    except json.JSONDecodeError as e:
+        raise ProtocolError(f"request body is not JSON: {e}") from None
+    if not isinstance(data, dict):
+        raise ProtocolError("request body must be a JSON object")
+    spans = data.get("spans")
+    dataset = data.get("dataset")
+    if (spans is None) == (dataset is None):
+        raise ProtocolError(
+            'provide exactly one of "spans" (inline span records) or '
+            '"dataset" (a pre-staged dump name)'
+        )
+    if spans is not None:
+        if not isinstance(spans, list) or not spans:
+            raise ProtocolError('"spans" must be a non-empty list')
+        if not all(isinstance(s, dict) for s in spans):
+            raise ProtocolError('"spans" entries must be objects')
+    tenant = str(data.get("tenant") or "default")
+    request_id = str(
+        data.get("request_id") or f"req-{next(_req_counter)}"
+    )
+    return RankRequest(
+        request_id=request_id,
+        tenant=tenant,
+        spans=spans,
+        dataset=dataset,
+        start=data.get("start"),
+        end=data.get("end"),
+    )
+
+
+def spans_to_frame(spans: List[dict]):
+    """Inline span records -> the canonical span DataFrame (same rename
+    + column contract as CSV ingest, io.loader)."""
+    import pandas as pd
+
+    from ..io.schema import CLICKHOUSE_RENAME, validate_columns
+
+    df = pd.DataFrame(spans).rename(columns=CLICKHOUSE_RENAME)
+    try:
+        validate_columns(df.columns)
+    except ValueError as e:
+        raise ProtocolError(str(e)) from None
+    try:
+        df["startTime"] = pd.to_datetime(df["startTime"], format="mixed")
+        df["endTime"] = pd.to_datetime(df["endTime"], format="mixed")
+    except (ValueError, TypeError) as e:
+        raise ProtocolError(f"unparseable span timestamps: {e}") from None
+    return df
+
+
+def response_body(result: WindowResult) -> bytes:
+    """One answered request -> the JSON response payload."""
+    d = dataclasses.asdict(result)
+    d["ranking"] = [[n, float(s)] for n, s in result.ranking]
+    return json.dumps(d).encode()
+
+
+def error_body(message: str, **extra) -> bytes:
+    return json.dumps({"error": message, **extra}).encode()

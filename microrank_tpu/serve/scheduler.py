@@ -1,0 +1,138 @@
+"""The batching scheduler: one thread owns the device.
+
+Requests enter per-tenant FIFOs (the HTTP frontend's threads only
+enqueue); a single scheduler thread pops them **fairly** (round-robin
+across tenants, so one chatty tenant cannot starve the rest), runs the
+host half (parse -> detect -> partition -> padded graph build), parks
+rankable windows in the micro-batcher's shape buckets, and dispatches
+full or aged batches. Single-threaded device ownership is also the
+program-order guarantee jax dispatch needs — the serving twin of the
+offline runners' rule that collectives are issued by one thread.
+
+Drain: ``stop(drain=True)`` (the SIGTERM path) processes everything
+already admitted — queues empty, every bucket force-flushed, every
+future resolved — before the thread exits; ``drain=False`` fails queued
+requests fast with a shutdown error.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from .batcher import MicroBatcher
+from .protocol import RankRequest
+
+_IDLE_POLL_S = 0.2
+
+
+class ShutdownError(RuntimeError):
+    """Queued request abandoned by a non-draining shutdown."""
+
+    status = 503
+
+
+class BatchScheduler(threading.Thread):
+    def __init__(self, service, journal=None):
+        super().__init__(name="mr-serve-sched", daemon=True)
+        self.service = service
+        self.batcher = MicroBatcher(service.config, journal=journal)
+        self._cond = threading.Condition()
+        self._tenants: "OrderedDict[str, deque]" = OrderedDict()
+        self._rr = 0                 # round-robin cursor over tenant keys
+        self._stopping = False
+        self._draining = False
+
+    # ------------------------------------------------------------ intake
+    def submit(
+        self,
+        request: RankRequest,
+        on_done: Optional[Callable] = None,
+    ) -> Future:
+        """Enqueue one admitted request; returns its response future."""
+        fut: Future = Future()
+        entry = (request, fut, time.monotonic(), on_done)
+        with self._cond:
+            if self._stopping:
+                fut.set_exception(ShutdownError("service shutting down"))
+                return fut
+            self._tenants.setdefault(request.tenant, deque()).append(entry)
+            self._cond.notify()
+        return fut
+
+    def queued(self) -> int:
+        with self._cond:
+            return sum(len(q) for q in self._tenants.values())
+
+    # ------------------------------------------------------- fair dequeue
+    def _pop_fair(self, timeout: float):
+        """Round-robin pop across tenant FIFOs: each turn serves the
+        next tenant that has work, so interleaved arrivals from N
+        tenants dequeue N-fairly regardless of per-tenant burst size."""
+        with self._cond:
+            if not any(self._tenants.values()):
+                self._cond.wait(timeout=max(0.0, timeout))
+            names = list(self._tenants)
+            for i in range(len(names)):
+                name = names[(self._rr + i) % len(names)]
+                q = self._tenants.get(name)
+                if q:
+                    self._rr = (names.index(name) + 1) % max(1, len(names))
+                    entry = q.popleft()
+                    if not q:
+                        del self._tenants[name]
+                    return entry
+        return None
+
+    # --------------------------------------------------------------- run
+    def run(self) -> None:
+        while True:
+            deadline = self.batcher.next_deadline()
+            timeout = (
+                _IDLE_POLL_S
+                if deadline is None
+                else min(_IDLE_POLL_S, max(0.0, deadline - time.monotonic()))
+            )
+            entry = self._pop_fair(timeout)
+            if entry is not None:
+                self._process(entry)
+            # In-flight (already built) windows always complete at
+            # shutdown — only queued-not-yet-built requests are failed
+            # by a non-draining stop.
+            force = self._stopping and self.queued() == 0
+            for batch in self.batcher.take_ready(force=force):
+                self.batcher.dispatch(batch)
+            with self._cond:
+                if (
+                    self._stopping
+                    and not any(self._tenants.values())
+                    and self.batcher.pending() == 0
+                ):
+                    return
+
+    def _process(self, entry) -> None:
+        request, fut, enqueued, on_done = entry
+        pw = self.service.build_pending(request, fut, enqueued, on_done)
+        if pw is not None:
+            self.batcher.submit(pw)
+
+    # -------------------------------------------------------------- stop
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the thread; ``drain`` answers everything admitted first."""
+        with self._cond:
+            self._stopping = True
+            self._draining = drain
+            if not drain:
+                for q in self._tenants.values():
+                    for request, fut, _, on_done in q:
+                        err = ShutdownError("service shutting down")
+                        fut.set_exception(err)
+                        if on_done is not None:
+                            on_done(None, err)
+                self._tenants.clear()
+            self._cond.notify_all()
+        if self.is_alive():
+            self.join(timeout=timeout)

@@ -1,0 +1,592 @@
+"""The online RCA service: asyncio HTTP frontend + service facade.
+
+``cli serve`` wires this up: fit the SLO baseline from a normal-period
+dump, optionally pre-stage named abnormal dumps, then answer
+``POST /rank`` requests — each one a detection window — with ranked
+suspects. Concurrent requests coalesce into padded micro-batches
+(serve.batcher), admission control bounds the queue (serve.admission),
+and SIGTERM drains in-flight work before exit.
+
+Routes:
+
+* ``POST /rank``     — rank one window (see serve.protocol for payloads);
+* ``GET /healthz``   — liveness + drain state + queue depth (JSON);
+* ``GET /metrics``   — Prometheus text exposition (same registry the
+  offline pipelines record into);
+* ``GET /metrics.json`` — the JSON snapshot form.
+
+The frontend is stdlib-only asyncio (no aiohttp in the image): a
+hand-rolled HTTP/1.1 parser over ``asyncio.start_server`` streams. The
+event loop never blocks on device work — handlers await the scheduler's
+response futures via ``asyncio.wrap_future``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+from ..config import MicroRankConfig
+from ..pipeline.results import WindowResult
+from ..utils.logging import get_logger
+from .admission import AdmissionController
+from .protocol import (
+    ProtocolError,
+    RankRequest,
+    error_body,
+    parse_rank_request,
+    response_body,
+    spans_to_frame,
+)
+from .scheduler import BatchScheduler
+
+
+class ServiceOverloaded(Exception):
+    """Admission queue full — HTTP 429 + Retry-After."""
+
+    status = 429
+
+
+class ServiceDraining(Exception):
+    """Shutdown in progress — HTTP 503 + Retry-After."""
+
+    status = 503
+
+
+class ServeService:
+    """Service facade: baseline + datasets + admission + scheduler."""
+
+    def __init__(self, config: MicroRankConfig, out_dir=None):
+        self.config = config
+        self.serve = config.serve
+        self.log = get_logger("microrank_tpu.serve")
+        self.admission = AdmissionController(
+            self.serve.max_queue_depth, self.serve.retry_after_seconds
+        )
+        self.journal = None
+        self.out_dir = Path(out_dir) if out_dir is not None else None
+        if self.out_dir is not None and config.runtime.telemetry:
+            from ..obs import JOURNAL_NAME, RunJournal
+
+            self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
+        self.scheduler = BatchScheduler(self, journal=self.journal)
+        self.datasets: Dict[str, object] = {}
+        self.slo_vocab = None
+        self.baseline = None
+        self.draining = False
+        self._stopped = False
+
+    # ------------------------------------------------------------- setup
+    def fit_baseline(self, normal_df) -> None:
+        from ..detect import compute_slo
+
+        self.slo_vocab, self.baseline = compute_slo(
+            normal_df, stat=self.config.detector.slo_stat
+        )
+        self.log.info(
+            "fitted SLO baseline: %d operations", len(self.slo_vocab)
+        )
+
+    def add_dataset(self, name: str, span_df) -> None:
+        """Pre-stage an abnormal dump; requests address it by name."""
+        self.datasets[name] = span_df
+        self.log.info("staged dataset %r: %d spans", name, len(span_df))
+
+    def start(self) -> None:
+        from ..obs.metrics import ensure_catalog
+
+        if self.baseline is None:
+            raise RuntimeError("call fit_baseline() before start()")
+        ensure_catalog()
+        if self.journal is not None:
+            self.journal.run_start(
+                pipeline="serve",
+                kernel=self.config.runtime.kernel,
+                pad_policy=self.config.runtime.pad_policy,
+                max_batch_windows=self.serve.max_batch_windows,
+                max_wait_ms=self.serve.max_wait_ms,
+                max_queue_depth=self.serve.max_queue_depth,
+            )
+        if self.serve.warmup:
+            self.warmup()
+        self.scheduler.start()
+
+    def warmup(self) -> None:
+        """Trace+compile the batched rank program before traffic: one
+        occupancy-1 and one occupancy-2 dispatch over a small synthetic
+        window (the persistent jit cache makes repeats near-instant).
+        Runs before the scheduler thread starts — exclusive device use.
+        Warmup dispatches don't pollute the occupancy metrics."""
+        import pandas as pd
+
+        from ..rank_backends.jax_tpu import prepare_window_graph
+        from ..testing import SyntheticConfig, generate_case
+        from .batcher import PendingWindow
+
+        t0 = time.monotonic()
+        case = generate_case(
+            SyntheticConfig(n_operations=12, n_traces=60, seed=0)
+        )
+        flag, nrm, abn = _detect_partition(
+            self.config, *_case_slo(case), case.abnormal
+        )
+        if not flag or not nrm or not abn:  # pragma: no cover - fixed seed
+            self.log.warning("warmup case did not partition; skipping")
+            return
+        graph, names, kernel = prepare_window_graph(
+            case.abnormal, nrm, abn, self.config
+        )
+
+        def _pw():
+            from concurrent.futures import Future
+
+            return PendingWindow(
+                request=RankRequest(request_id="warmup", tenant="warmup"),
+                result=WindowResult(start="", end="", anomaly=True),
+                span_df=case.abnormal,
+                normal_ids=nrm,
+                abnormal_ids=abn,
+                graph=graph,
+                op_names=names,
+                kernel=kernel,
+                future=Future(),
+                enqueued=time.monotonic(),
+                built=time.monotonic(),
+            )
+
+        for occupancy in (1, 2):
+            self.scheduler.batcher.dispatch(
+                [_pw() for _ in range(occupancy)], warmup=True
+            )
+        self.log.info(
+            "warmup: compiled batched rank program (occupancies 1, 2, "
+            "kernel %s) in %.1fs",
+            kernel, time.monotonic() - t0,
+        )
+
+    # ----------------------------------------------------------- request
+    def submit(self, request: RankRequest):
+        """Admission-checked entry: returns the response future, or
+        raises ServiceOverloaded/ServiceDraining."""
+        from ..obs.metrics import record_serve_request
+
+        if self.draining:
+            record_serve_request("rejected")
+            raise ServiceDraining("service is draining")
+        if not self.admission.try_admit():
+            record_serve_request("rejected")
+            raise ServiceOverloaded("request queue is full")
+        return self.scheduler.submit(request, on_done=self._on_done)
+
+    def _on_done(self, pw, error) -> None:
+        """Completion hook for every admitted request, on every path
+        (ranked, clean, degraded, failed, shutdown): release the
+        admission slot, record outcome + latency, journal the window."""
+        from ..obs.metrics import record_serve_request
+
+        self.admission.release()
+        if pw is None:  # abandoned by a non-draining shutdown
+            record_serve_request("failed")
+            return
+        result = pw.result
+        total_s = time.monotonic() - pw.enqueued
+        if error is not None:
+            outcome = (
+                "invalid" if isinstance(error, ProtocolError) else "failed"
+            )
+        elif result.ranking:
+            outcome = "ranked"
+        elif result.skipped_reason:
+            outcome = "skipped"
+        else:
+            outcome = "clean"
+        record_serve_request(outcome, total_s)
+        if self.journal is not None and error is None:
+            self.journal.window(result)
+
+    def build_pending(self, request, fut, enqueued, on_done):
+        """Scheduler-thread host half: window frame -> detect ->
+        partition -> padded graph. Returns a PendingWindow to coalesce,
+        or None when the request resolved immediately (clean window,
+        degenerate partition, bad payload)."""
+        from ..obs.metrics import serve_stage_seconds
+        from .batcher import PendingWindow
+
+        queue_s = time.monotonic() - enqueued
+        serve_stage_seconds().observe(queue_s, stage="queue")
+        result = WindowResult(
+            start="", end="", anomaly=False,
+            request_id=request.request_id, tenant=request.tenant,
+        )
+        result.timings["queue_ms"] = round(queue_s * 1e3, 3)
+        pw = PendingWindow(
+            request=request, result=result, span_df=None,
+            normal_ids=[], abnormal_ids=[], graph=None, op_names=[],
+            kernel="", future=fut, enqueued=enqueued, on_done=on_done,
+        )
+        t0 = time.monotonic()
+        try:
+            window_df = self._window_frame(request)
+            result.start = str(window_df["startTime"].min())
+            result.end = str(window_df["endTime"].max())
+            flag, nrm, abn = _detect_partition(
+                self.config, self.slo_vocab, self.baseline, window_df
+            )
+            result.anomaly = bool(flag)
+            result.n_normal, result.n_abnormal = len(nrm), len(abn)
+            result.n_traces = len(nrm) + len(abn)
+            if not flag:
+                pw.finish()
+                return None
+            if not nrm or not abn:
+                result.skipped_reason = "degenerate_partition"
+                pw.finish()
+                return None
+            from ..rank_backends.jax_tpu import prepare_window_graph
+
+            graph, names, kernel = prepare_window_graph(
+                window_df, nrm, abn, self.config
+            )
+        except Exception as e:
+            pw.finish(error=e)
+            return None
+        build_s = time.monotonic() - t0
+        serve_stage_seconds().observe(build_s, stage="build")
+        result.timings["build_ms"] = round(build_s * 1e3, 3)
+        result.kernel = kernel
+        pw.span_df = window_df
+        pw.normal_ids, pw.abnormal_ids = nrm, abn
+        pw.graph, pw.op_names, pw.kernel = graph, names, kernel
+        pw.built = time.monotonic()
+        return pw
+
+    def _window_frame(self, request: RankRequest):
+        if request.spans is not None:
+            return spans_to_frame(request.spans)
+        df = self.datasets.get(request.dataset)
+        if df is None:
+            raise ProtocolError(
+                f"unknown dataset {request.dataset!r}; staged: "
+                f"{sorted(self.datasets)}"
+            )
+        import pandas as pd
+
+        from ..io.loader import window_spans
+
+        start = (
+            pd.Timestamp(request.start) if request.start else None
+        )
+        end = pd.Timestamp(request.end) if request.end else None
+        out = window_spans(df, start, end)
+        if len(out) == 0:
+            raise ProtocolError(
+                f"dataset {request.dataset!r} has no spans in "
+                f"[{request.start}, {request.end}]"
+            )
+        return out
+
+    # ---------------------------------------------------------- shutdown
+    def begin_drain(self) -> None:
+        """Stop admitting; everything admitted will still be answered."""
+        self.draining = True
+        self.admission.close()
+
+    def shutdown(self, drain: bool = True, timeout=None) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self.begin_drain()
+        if timeout is None:
+            timeout = self.serve.drain_seconds
+        if self.scheduler.is_alive() or self.scheduler.queued():
+            self.scheduler.stop(drain=drain, timeout=timeout)
+        elif not self.scheduler.is_alive():
+            # never started (direct-drive tests): flush parked work
+            self.scheduler._stopping = True
+            for batch in self.scheduler.batcher.take_ready(force=True):
+                self.scheduler.batcher.dispatch(batch)
+        if self.journal is not None:
+            self.journal.run_end(dispatches=self.scheduler.batcher.dispatches)
+        if self.out_dir is not None and self.config.runtime.telemetry:
+            from ..obs import get_registry
+            from ..obs.metrics import ensure_catalog
+
+            ensure_catalog()
+            get_registry().write_snapshot(self.out_dir)
+
+
+def _case_slo(case):
+    from ..detect import compute_slo
+
+    return compute_slo(case.normal)
+
+
+def _detect_partition(config, slo_vocab, baseline, window_df):
+    """Detect + partition one window frame (the serving twin of
+    OnlineRCA.detect_window)."""
+    from ..detect import detect_numpy
+    from ..graph import build_detect_batch
+    from ..utils.guards import contract_checks
+
+    with contract_checks(config.runtime.validate_numerics):
+        batch, trace_ids = build_detect_batch(window_df, slo_vocab)
+    res = detect_numpy(batch, baseline, config.detector)
+    abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
+    nrm = [
+        t
+        for t, a, v in zip(trace_ids, res.abnormal, res.valid)
+        if v and not a
+    ]
+    return bool(res.flag), nrm, abn
+
+
+# ---------------------------------------------------------------- HTTP
+
+
+class HttpFrontend:
+    """Minimal asyncio HTTP/1.1 frontend over the service."""
+
+    def __init__(self, service: ServeService, host="127.0.0.1", port=0):
+        self.service = service
+        self.host = host
+        self.port = int(port)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._active = 0
+        self._idle = asyncio.Event()
+        self._idle.set()
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def drain_and_close(self, timeout: float) -> None:
+        """Stop accepting, then wait (bounded) for in-flight handlers."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        try:
+            await asyncio.wait_for(self._idle.wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+            self.service.log.warning(
+                "drain timeout: %d request(s) still in flight",
+                self._active,
+            )
+
+    # ---------------------------------------------------------- handling
+    async def _handle(self, reader, writer) -> None:
+        self._active += 1
+        self._idle.clear()
+        try:
+            req = await self._read_request(reader)
+            if req is None:
+                return
+            method, path, body = req
+            status, ctype, payload = await self._route(method, path, body)
+            await self._respond(writer, status, ctype, payload)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, Exception):
+                pass
+            self._active -= 1
+            if self._active == 0:
+                self._idle.set()
+
+    @staticmethod
+    async def _read_request(reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, path, _ = line.decode("latin-1").split(None, 2)
+        except ValueError:
+            return None
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        n = int(headers.get("content-length") or 0)
+        body = await reader.readexactly(n) if n else b""
+        return method.upper(), path.split("?")[0], body
+
+    async def _route(self, method, path, body):
+        svc = self.service
+        if method == "POST" and path == "/rank":
+            return await self._rank(body)
+        if method == "GET" and path == "/healthz":
+            payload = json.dumps(
+                {
+                    "status": "draining" if svc.draining else "ok",
+                    "queue_depth": svc.admission.depth,
+                    "dispatches": svc.scheduler.batcher.dispatches,
+                }
+            ).encode()
+            return 200, "application/json", payload
+        if method == "GET" and path == "/metrics":
+            from ..obs import get_registry
+            from ..obs.server import PROM_CONTENT_TYPE
+
+            return 200, PROM_CONTENT_TYPE, get_registry().to_prometheus().encode()
+        if method == "GET" and path == "/metrics.json":
+            from ..obs import get_registry
+
+            return (
+                200,
+                "application/json",
+                json.dumps(get_registry().to_json()).encode(),
+            )
+        return 404, "application/json", error_body("no such route")
+
+    async def _rank(self, body):
+        svc = self.service
+        retry = {"retry_after": svc.admission.retry_after_seconds}
+        try:
+            request = parse_rank_request(body)
+        except ProtocolError as e:
+            return 400, "application/json", error_body(str(e))
+        try:
+            fut = svc.submit(request)
+        except (ServiceOverloaded, ServiceDraining) as e:
+            return e.status, "application/json", error_body(str(e), **retry)
+        try:
+            result = await asyncio.wait_for(
+                asyncio.wrap_future(fut),
+                timeout=svc.serve.request_timeout_seconds,
+            )
+        except asyncio.TimeoutError:
+            return (
+                504,
+                "application/json",
+                error_body(
+                    "request timed out in the service; its batch will "
+                    "still complete and be journaled",
+                    request_id=request.request_id,
+                ),
+            )
+        except ProtocolError as e:
+            return 400, "application/json", error_body(str(e))
+        except Exception as e:
+            return (
+                500,
+                "application/json",
+                error_body(str(e), request_id=request.request_id),
+            )
+        return 200, "application/json", response_body(result)
+
+    async def _respond(self, writer, status, ctype, payload) -> None:
+        reason = {
+            200: "OK", 400: "Bad Request", 404: "Not Found",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            503: "Service Unavailable", 504: "Gateway Timeout",
+        }.get(status, "OK")
+        head = [
+            f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(payload)}",
+            "Connection: close",
+        ]
+        if status in (429, 503):
+            retry = max(
+                1, int(round(self.service.admission.retry_after_seconds))
+            )
+            head.append(f"Retry-After: {retry}")
+        writer.write(
+            ("\r\n".join(head) + "\r\n\r\n").encode() + payload
+        )
+        await writer.drain()
+
+
+class ServeHandle:
+    """Run the HTTP frontend on a background thread (tests, embedding).
+
+    ``cli serve`` uses ``run_serve`` (foreground loop + signal
+    handlers) instead; this wrapper exists so a test can start a fully
+    wired service, speak real HTTP to it, and stop it deterministically.
+    """
+
+    def __init__(self, service: ServeService, host="127.0.0.1", port=0):
+        self.service = service
+        self.frontend = HttpFrontend(service, host, port)
+        self.port: Optional[int] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt: Optional[asyncio.Event] = None
+
+    def start(self) -> int:
+        started = threading.Event()
+
+        async def _main():
+            self._loop = asyncio.get_running_loop()
+            self._stop_evt = asyncio.Event()
+            self.port = await self.frontend.start()
+            started.set()
+            await self._stop_evt.wait()
+            await self.frontend.drain_and_close(
+                self.service.serve.drain_seconds
+            )
+
+        self._thread = threading.Thread(
+            target=lambda: asyncio.run(_main()),
+            name="mr-serve-http",
+            daemon=True,
+        )
+        self._thread.start()
+        if not started.wait(timeout=30):
+            raise RuntimeError("HTTP frontend failed to start")
+        return self.port
+
+    def stop(self, drain: bool = True) -> None:
+        self.service.begin_drain()
+        if self._loop is not None and self._stop_evt is not None:
+            self._loop.call_soon_threadsafe(self._stop_evt.set)
+        if self._thread is not None:
+            self._thread.join(timeout=self.service.serve.drain_seconds + 30)
+        self.service.shutdown(drain=drain)
+
+
+def run_serve(service: ServeService, host: str, port: int) -> int:
+    """Foreground serve loop (``cli serve``): start the frontend, block
+    until SIGTERM/SIGINT, then drain — in-flight batches complete, the
+    metrics snapshot and journal land in the output directory."""
+    import signal
+
+    log = service.log
+
+    async def _amain():
+        frontend = HttpFrontend(service, host, port)
+        bound = await frontend.start()
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        log.info(
+            "serving RCA on http://%s:%d (POST /rank; /healthz, "
+            "/metrics); max_batch=%d max_wait=%.0fms queue<=%d",
+            host, bound, service.serve.max_batch_windows,
+            service.serve.max_wait_ms, service.serve.max_queue_depth,
+        )
+        await stop.wait()
+        log.info("signal received: draining in-flight requests")
+        service.begin_drain()
+        await frontend.drain_and_close(service.serve.drain_seconds)
+
+    asyncio.run(_amain())
+    service.shutdown(drain=True)
+    log.info(
+        "drained; %d batch dispatches served",
+        service.scheduler.batcher.dispatches,
+    )
+    return 0

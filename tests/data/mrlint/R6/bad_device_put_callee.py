@@ -1,0 +1,16 @@
+"""R6 positive: device_put in a helper REACHED from a jit root (the
+traced-call-graph propagation must see through the call)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _stage_inner(v):
+    return jax.device_put(v) + jnp.float32(1)
+
+
+def kernel(x):
+    return _stage_inner(x) * 2
+
+
+kernel_jit = jax.jit(kernel)

@@ -1,0 +1,12 @@
+"""R6 positive: jax.device_put inside a jitted function body."""
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_step(x):
+    weights = jax.device_put(jnp.ones((4,)))  # traces to a hint, not a put
+    return x * weights
+
+
+rank_step_jit = jax.jit(rank_step)

@@ -1,0 +1,17 @@
+"""R6 negative: device_put at the dispatch boundary (host side), the
+staged value passed INTO the jitted program as an argument."""
+
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x):
+    return x * jnp.float32(2)
+
+
+kernel_jit = jax.jit(kernel)
+
+
+def stage_and_dispatch(host_array):
+    staged = jax.device_put(host_array)  # real transfer, outside any trace
+    return kernel_jit(staged)

@@ -68,6 +68,19 @@ def test_global_put_single_process_equals_device_put():
     reason="multi-process test disabled",
 )
 def test_two_process_mesh_ranks_like_single_process(tmp_path):
+    # Capability gate (ROADMAP open item): cross-process CPU collectives
+    # need the Gloo transport; jaxlibs without it raise "Multiprocess
+    # computations aren't implemented on the CPU backend" inside the
+    # workers' psums. initialize_distributed selects Gloo when the probe
+    # passes, so on capable jaxlibs this test runs for real.
+    from microrank_tpu.parallel.distributed import cpu_collectives_supported
+
+    if not cpu_collectives_supported():
+        pytest.skip(
+            "this jaxlib lacks CPU Gloo collectives "
+            "(make_gloo_tcp_collectives); cross-process CPU psums "
+            "cannot run"
+        )
     # Expected result: the in-process (2, 4) sharded ranking.
     cfg = MicroRankConfig()
     graphs = []
@@ -80,10 +93,11 @@ def test_two_process_mesh_ranks_like_single_process(tmp_path):
         graphs.append(graph)
     mesh = make_mesh((2, 4))
     stacked = stack_window_graphs(graphs, shard_multiple=4)
-    sti, _, snv = rank_windows_sharded(
+    sti, sts, snv = rank_windows_sharded(
         jax.tree.map(jnp.asarray, stacked), cfg.pagerank, cfg.spectrum, mesh
     )
     expected_idx = np.asarray(sti)
+    expected_scores = np.asarray(sts, np.float64)
     expected_nv = np.asarray(snv)
 
     # Shared tables for the full-pipeline (TableRCA) leg of the worker.
@@ -108,7 +122,7 @@ def test_two_process_mesh_ranks_like_single_process(tmp_path):
         )
         single.fit_baseline(load_span_table(table_dir / "n.csv"))
         expected_table = [
-            [n for n, _ in r.ranking] if r.ranking else None
+            [[n, float(s)] for n, s in r.ranking] if r.ranking else None
             for r in single.run(load_span_table(table_dir / "a.csv"))
         ]
 
@@ -146,18 +160,50 @@ def test_two_process_mesh_ranks_like_single_process(tmp_path):
     for p, log_text in zip(procs, logs):
         assert p.returncode == 0, log_text[-2000:]
 
-    for pid, out in enumerate(outs):
-        res = json.loads(out.read_text())
+    from microrank_tpu.utils.ranking_compare import tie_aware_topk_agreement
+
+    dumps = [json.loads(out.read_text()) for out in outs]
+    # The two processes see the SAME allgathered result, bit-identical —
+    # they ran one collective program.
+    assert dumps[0]["top_idx"] == dumps[1]["top_idx"]
+    assert dumps[0]["top_scores"] == dumps[1]["top_scores"]
+    assert dumps[0].get("table_rankings") == dumps[1].get("table_rankings")
+    for pid, res in enumerate(dumps):
         assert res["process_index"] == pid
         assert res["is_primary"] == (pid == 0)
-        # Every process sees the FULL batch (allgathered), identical to
-        # the single-process sharded ranking.
-        np.testing.assert_array_equal(np.asarray(res["top_idx"]), expected_idx)
+        # Versus the single-process sharded ranking: the cross-process
+        # Gloo reduction tree may legally reassociate f32 sums, so
+        # near-exact ties can permute — the shared tie-aware comparator
+        # (bench/multichip gate semantics) decides agreement.
         np.testing.assert_array_equal(np.asarray(res["n_valid"]), expected_nv)
+        for w in range(expected_idx.shape[0]):
+            nv = int(expected_nv[w])
+            got_idx = res["top_idx"][w][:nv]
+            got_scores = res["top_scores"][w][:nv]
+            ok, reason = tie_aware_topk_agreement(
+                expected_idx[w][:nv].tolist(),
+                expected_scores[w][:nv].tolist(),
+                got_idx,
+                got_scores,
+                k=nv,
+                rtol=1e-3,
+            )
+            assert ok, f"window {w}: {reason}"
         # The full TableRCA pipeline over the process-spanning mesh must
-        # rank exactly like the single-process (1, 8) mesh.
+        # agree with the single-process (1, 8) mesh the same way.
         if expected_table is not None:
-            assert res["table_rankings"] == expected_table
+            got_table = res["table_rankings"]
+            assert len(got_table) == len(expected_table)
+            for w, (exp, got) in enumerate(zip(expected_table, got_table)):
+                if exp is None or got is None:
+                    assert exp == got, f"table window {w}"
+                    continue
+                ok, reason = tie_aware_topk_agreement(
+                    [n for n, _ in exp], [s for _, s in exp],
+                    [n for n, _ in got], [s for _, s in got],
+                    k=len(exp), rtol=1e-3,
+                )
+                assert ok, f"table window {w}: {reason}"
 
 
 def test_initialize_partial_config_falls_back(monkeypatch):

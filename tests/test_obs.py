@@ -552,6 +552,70 @@ def test_cli_stats_emits_prometheus(tmp_path, registry, capsys):
     assert "microrank_rank_iterations" in data["metrics"]
 
 
+def test_diff_registries_subtracts_counters_and_histograms():
+    from microrank_tpu.obs import diff_registries
+
+    before, after = MetricsRegistry(), MetricsRegistry()
+    before.counter("c_total", "x", ("k",)).inc(3, k="a")
+    after.counter("c_total", "x", ("k",)).inc(5, k="a")
+    after.counter("c_total", "x", ("k",)).inc(2, k="b")  # new label set
+    before.gauge("g", "x").set(7)
+    after.gauge("g", "x").set(4)
+    hb = before.histogram("h", "x", buckets=(1, 10))
+    ha = after.histogram("h", "x", buckets=(1, 10))
+    hb.observe(0.5)
+    ha.observe(0.5)
+    ha.observe(5.0)
+    # A counter that went DOWN (process restart) clamps at zero.
+    before.counter("reset_total", "x").inc(9)
+    after.counter("reset_total", "x").inc(2)
+
+    delta = diff_registries(before, after)
+    assert delta.get("c_total").value(k="a") == 2
+    assert delta.get("c_total").value(k="b") == 2
+    assert delta.get("g").value() == 4  # gauges keep the after reading
+    snap = delta.get("h").snapshot()
+    assert snap["count"] == 1 and snap["counts"] == [0, 1, 0]
+    assert delta.get("reset_total").value() == 0
+
+
+def test_cli_stats_diff_between_snapshots(tmp_path, registry, capsys):
+    """`cli stats --diff before/ after/`: after-minus-before deltas in
+    both exposition formats (the PR 2 follow-up)."""
+    from microrank_tpu.cli.main import main
+
+    for name, windows in (("before", 2), ("after", 5)):
+        reg = MetricsRegistry()
+        reg.counter(
+            "microrank_windows_total", "w", ("outcome",)
+        ).inc(windows, outcome="ranked")
+        d = tmp_path / name
+        reg.write_snapshot(d)
+    rc = main(
+        ["stats", "--diff", str(tmp_path / "before"), str(tmp_path / "after")]
+    )
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert 'microrank_windows_total{outcome="ranked"} 3' in text
+
+    rc = main(
+        [
+            "stats", "--diff",
+            str(tmp_path / "before"), str(tmp_path / "after"),
+            "--format", "json",
+        ]
+    )
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    samples = data["metrics"]["microrank_windows_total"]["samples"]
+    assert samples == [
+        {"labels": {"outcome": "ranked"}, "value": 3.0}
+    ]
+
+    # Wrong arity is a usage error, not a crash.
+    assert main(["stats", "--diff", str(tmp_path / "before")]) == 2
+
+
 def test_metrics_http_server(registry):
     import urllib.request
 

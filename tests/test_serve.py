@@ -1,0 +1,470 @@
+"""Online RCA service (serve/): protocol validation, admission control
+(429 + Retry-After), cross-request micro-batching (>= 2 concurrent
+requests -> ONE device dispatch), per-tenant fair dequeue, numpy_ref
+graceful degradation under injected dispatch failure, drain-on-shutdown,
+and the end-to-end CLI SIGTERM smoke.
+
+HTTP tests speak real HTTP to a fully wired service on a background
+event loop (ServeHandle); scheduler/batcher unit tests drive the
+components directly.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import pytest
+
+from microrank_tpu.config import MicroRankConfig, ServeConfig
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.serve import (
+    AdmissionController,
+    ProtocolError,
+    RankRequest,
+    ServeHandle,
+    ServeService,
+    parse_rank_request,
+    spans_to_frame,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture(scope="module")
+def case():
+    return generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+
+
+@pytest.fixture(scope="module")
+def spans_payload(case):
+    df = case.abnormal.copy()
+    df["startTime"] = df["startTime"].astype(str)
+    df["endTime"] = df["endTime"].astype(str)
+    return {"spans": df.to_dict("records")}
+
+
+def _service(case, tmp_path=None, **serve_kw):
+    serve_kw.setdefault("warmup", False)
+    serve_kw.setdefault("max_wait_ms", 2000.0)
+    cfg = MicroRankConfig(serve=ServeConfig(**serve_kw))
+    svc = ServeService(
+        cfg, out_dir=None if tmp_path is None else tmp_path
+    )
+    svc.fit_baseline(case.normal)
+    return svc
+
+
+def _post(port, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/rank",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return r.status, r.read()
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def test_parse_rank_request_validates():
+    with pytest.raises(ProtocolError, match="not JSON"):
+        parse_rank_request(b"{nope")
+    with pytest.raises(ProtocolError, match="JSON object"):
+        parse_rank_request(b"[1]")
+    with pytest.raises(ProtocolError, match="exactly one"):
+        parse_rank_request(b"{}")
+    with pytest.raises(ProtocolError, match="exactly one"):
+        parse_rank_request(b'{"spans": [{}], "dataset": "d"}')
+    with pytest.raises(ProtocolError, match="non-empty"):
+        parse_rank_request(b'{"spans": []}')
+    r = parse_rank_request(b'{"dataset": "d", "tenant": "t1"}')
+    assert r.dataset == "d" and r.tenant == "t1" and r.request_id
+    r2 = parse_rank_request(
+        b'{"spans": [{"a": 1}], "request_id": "abc"}'
+    )
+    assert r2.request_id == "abc" and r2.tenant == "default"
+
+
+def test_spans_to_frame_enforces_schema(spans_payload):
+    df = spans_to_frame(spans_payload["spans"])
+    assert len(df) == len(spans_payload["spans"])
+    with pytest.raises(ProtocolError, match="missing required columns"):
+        spans_to_frame([{"traceID": "t1"}])
+
+
+# --------------------------------------------------------------- admission
+
+
+def test_admission_controller_bounds_depth(registry):
+    adm = AdmissionController(max_depth=2)
+    assert adm.try_admit() and adm.try_admit()
+    assert not adm.try_admit()
+    assert adm.depth == 2
+    adm.release()
+    assert adm.try_admit()
+    adm.close()
+    adm.release()
+    assert not adm.try_admit()  # closed admits nothing
+
+
+# ------------------------------------------------------------ fair dequeue
+
+
+def test_scheduler_pops_round_robin_across_tenants(case, registry):
+    svc = _service(case)
+    sched = svc.scheduler  # thread NOT started: we drive _pop_fair
+    order = []
+    for tenant, rid in [
+        ("a", "a1"), ("a", "a2"), ("a", "a3"), ("b", "b1"), ("b", "b2"),
+    ]:
+        sched.submit(RankRequest(request_id=rid, tenant=tenant))
+    while True:
+        entry = sched._pop_fair(timeout=0)
+        if entry is None:
+            break
+        order.append(entry[0].request_id)
+    # One chatty tenant (a, 3 queued) cannot starve tenant b: pops
+    # alternate while both have work.
+    assert order == ["a1", "b1", "a2", "b2", "a3"]
+
+
+# ------------------------------------------------- batching + degradation
+
+
+def test_concurrent_requests_coalesce_into_one_dispatch(
+    case, spans_payload, registry, tmp_path
+):
+    """Acceptance: >= 2 concurrent requests -> ONE device dispatch
+    (batch-occupancy metric > 1), every request answered."""
+    svc = _service(case, tmp_path=tmp_path, max_batch_windows=4)
+    svc.add_dataset("case7", case.abnormal)
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        payloads = [
+            {**spans_payload, "tenant": "t0"},
+            {"dataset": "case7", "tenant": "t1"},
+            {**spans_payload, "tenant": "t2"},
+            {"dataset": "case7", "tenant": "t3"},
+        ]
+        with ThreadPoolExecutor(4) as ex:
+            results = [
+                f.result()
+                for f in [ex.submit(_post, port, p) for p in payloads]
+            ]
+        for status, body, _ in results:
+            assert status == 200
+            assert body["anomaly"] is True
+            assert body["ranking"]
+            assert body["degraded"] is False
+            # All four landed in one stacked vmapped program.
+            assert body["batch_windows"] == 4
+        assert svc.scheduler.batcher.dispatches == 1
+        occupancy = registry.get(
+            "microrank_serve_last_batch_windows"
+        ).value()
+        assert occupancy > 1
+        # The /metrics scrape exposes the occupancy histogram.
+        _, prom = _get(port, "/metrics")
+        assert b"microrank_serve_batch_windows_bucket" in prom
+        _, health = _get(port, "/healthz")
+        assert json.loads(health)["status"] == "ok"
+    finally:
+        handle.stop()
+    # Journal carries one serve_batch event with all four requests.
+    from microrank_tpu.obs import read_journal
+
+    events = read_journal(tmp_path / "journal.jsonl")
+    batches = [e for e in events if e["event"] == "serve_batch"]
+    assert len(batches) == 1 and batches[0]["occupancy"] == 4
+    assert len([e for e in events if e["event"] == "window"]) == 4
+
+
+def test_admission_control_answers_429_with_retry_after(
+    case, spans_payload, registry
+):
+    svc = _service(
+        case,
+        max_batch_windows=8,
+        max_wait_ms=4000.0,
+        max_queue_depth=2,
+        retry_after_seconds=2.0,
+    )
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        with ThreadPoolExecutor(2) as ex:
+            parked = [
+                ex.submit(_post, port, {**spans_payload, "tenant": t})
+                for t in ("a", "b")
+            ]
+            deadline = time.monotonic() + 10
+            while (
+                svc.admission.depth < 2 and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            status, body, headers = _post(
+                port, {**spans_payload, "tenant": "c"}
+            )
+            assert status == 429
+            assert "queue is full" in body["error"]
+            assert headers.get("Retry-After") == "2"
+            # The admitted requests are NOT dropped by the shed.
+            for f in parked:
+                s, b, _ = f.result()
+                assert s == 200 and b["ranking"]
+        rejected = registry.get(
+            "microrank_serve_requests_total"
+        ).value(outcome="rejected")
+        assert rejected >= 1
+    finally:
+        handle.stop()
+
+
+def test_injected_dispatch_failure_degrades_to_numpy(
+    case, spans_payload, registry
+):
+    """Acceptance: device dispatch fails (injected) + retry fails ->
+    every batch member re-ranked on numpy_ref, responses carry
+    degraded=true, no request dropped."""
+    svc = _service(
+        case,
+        max_batch_windows=2,
+        inject_dispatch_failures=2,  # initial dispatch + its retry
+    )
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        with ThreadPoolExecutor(2) as ex:
+            results = [
+                f.result()
+                for f in [
+                    ex.submit(
+                        _post, port, {**spans_payload, "tenant": t}
+                    )
+                    for t in ("a", "b")
+                ]
+            ]
+        for status, body, _ in results:
+            assert status == 200
+            assert body["degraded"] is True
+            assert body["kernel"] == "numpy_ref"
+            assert body["ranking"]
+        assert registry.get(
+            "microrank_serve_degraded_total"
+        ).value() == 2
+        # The device path recovered for later requests (injection spent).
+        status, body, _ = _post(port, spans_payload)
+        assert status == 200 and body["degraded"] is False
+    finally:
+        handle.stop()
+
+
+def test_failed_dispatch_without_fallback_answers_500(
+    case, spans_payload, registry
+):
+    svc = _service(
+        case, fallback=False, inject_dispatch_failures=2,
+        max_batch_windows=1,
+    )
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        status, body, _ = _post(port, spans_payload)
+        assert status == 500
+        assert "injected" in body["error"]
+    finally:
+        handle.stop()
+
+
+# ------------------------------------------------------- clean / invalid
+
+
+def test_clean_window_and_bad_requests(case, registry):
+    svc = _service(case, max_wait_ms=50.0)
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        # Normal-period spans: no anomaly, no ranking, immediate answer.
+        df = case.normal.copy()
+        df["startTime"] = df["startTime"].astype(str)
+        df["endTime"] = df["endTime"].astype(str)
+        status, body, _ = _post(port, {"spans": df.to_dict("records")})
+        assert status == 200
+        assert body["anomaly"] is False and body["ranking"] == []
+        # Unknown dataset -> 400.
+        status, body, _ = _post(port, {"dataset": "nope"})
+        assert status == 400 and "unknown dataset" in body["error"]
+        # Malformed body -> 400.
+        status, body, _ = _post(port, {"tenant": "x"})
+        assert status == 400
+        # Unknown route -> 404.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/nope", method="GET"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 404
+    finally:
+        handle.stop()
+
+
+# ------------------------------------------------------------------ drain
+
+
+def test_drain_completes_parked_requests(case, registry):
+    """Shutdown with drain: requests parked in a bucket (max_wait not
+    yet reached) are force-flushed and answered before the scheduler
+    thread exits — the SIGTERM semantics, driven directly."""
+    svc = _service(case, max_batch_windows=8, max_wait_ms=60_000.0)
+    svc.start()
+    df = case.abnormal.copy()
+    df["startTime"] = df["startTime"].astype(str)
+    df["endTime"] = df["endTime"].astype(str)
+    records = df.to_dict("records")
+    futs = [
+        svc.submit(
+            RankRequest(
+                request_id=f"r{i}", tenant=f"t{i}", spans=records
+            )
+        )
+        for i in range(2)
+    ]
+    # Wait until both are built and PARKED (no dispatch: 60s max_wait).
+    deadline = time.monotonic() + 30
+    while (
+        svc.scheduler.batcher.pending() < 2
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    assert svc.scheduler.batcher.pending() == 2
+    assert svc.scheduler.batcher.dispatches == 0
+    svc.shutdown(drain=True)
+    for f in futs:
+        result = f.result(timeout=60)
+        assert result.ranking and result.batch_windows == 2
+    assert not svc.scheduler.is_alive()
+
+
+def test_shutdown_without_drain_fails_queued_fast(case, registry):
+    svc = _service(case)
+    svc.start()
+    # Stop the scheduler from consuming by enqueueing AFTER stop began:
+    # drain=False fails queued entries instead of ranking them.
+    svc.scheduler.stop(drain=False, timeout=30)
+    fut = svc.scheduler.submit(
+        RankRequest(request_id="late", tenant="t", spans=[{"a": 1}])
+    )
+    from microrank_tpu.serve import ShutdownError
+
+    with pytest.raises(ShutdownError):
+        fut.result(timeout=10)
+
+
+# ------------------------------------------------------------- CLI smoke
+
+
+def test_serve_cli_sigterm_drains(tmp_path):
+    """End to end through the CLI: start `cli serve`, POST one window
+    over HTTP, SIGTERM the process, expect a clean drain (exit 0) with
+    journal + metrics snapshot written."""
+    case = generate_case(
+        SyntheticConfig(n_operations=16, n_traces=80, seed=3)
+    )
+    normal_csv = tmp_path / "normal.csv"
+    case.normal.to_csv(normal_csv, index=False)
+    abnormal_csv = tmp_path / "abnormal.csv"
+    case.abnormal.to_csv(abnormal_csv, index=False)
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    out_dir = tmp_path / "serve_out"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).parent.parent),
+    }
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "microrank_tpu.cli", "serve",
+            "--normal", str(normal_csv),
+            "--dataset", f"case={abnormal_csv}",
+            "--port", str(port),
+            "-o", str(out_dir),
+            "--no-warmup",
+            "--max-wait-ms", "50",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                status, _ = _get(port, "/healthz")
+                up = status == 200
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.25)
+        assert up, (proc.poll(), proc.stdout and "server never came up")
+        status, body, _ = _post(port, {"dataset": "case"}, timeout=120)
+        assert status == 200 and body["ranking"]
+        status, prom = _get(port, "/metrics")
+        assert b"microrank_serve_requests_total" in prom
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0, out[-2000:]
+        assert "drained" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    assert (out_dir / "journal.jsonl").exists()
+    assert (out_dir / "metrics.json").exists()
+    events = [
+        json.loads(line)
+        for line in (out_dir / "journal.jsonl").read_text().splitlines()
+    ]
+    assert events[0]["event"] == "run_start"
+    assert events[-1]["event"] == "run_end"
+    assert any(e["event"] == "serve_batch" for e in events)

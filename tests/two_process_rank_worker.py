@@ -72,11 +72,14 @@ def main() -> int:
     top_idx, top_scores, n_valid = rank_windows_sharded(
         batched, cfg.pagerank, cfg.spectrum, mesh
     )
-    top_idx, n_valid = fetch_replicated((top_idx, n_valid))
+    top_idx, top_scores, n_valid = fetch_replicated(
+        (top_idx, top_scores, n_valid)
+    )
     result = {
         "process_index": int(jax.process_index()),
         "is_primary": bool(is_primary()),
         "top_idx": np.asarray(top_idx).tolist(),
+        "top_scores": np.asarray(top_scores, np.float64).tolist(),
         "n_valid": np.asarray(n_valid).tolist(),
     }
 
@@ -99,7 +102,7 @@ def main() -> int:
             load_span_table(os.path.join(table_dir, "a.csv"), cache=False)
         )
         result["table_rankings"] = [
-            [n for n, _ in r.ranking] if r.ranking else None
+            [[n, float(s)] for n, s in r.ranking] if r.ranking else None
             for r in records
         ]
 
