@@ -6,6 +6,7 @@ instructs editing the file). Here:
 
     python -m microrank_tpu.cli run    --normal N.csv --abnormal A.csv -o out/
     python -m microrank_tpu.cli serve  --normal N.csv --port 8377 -o out/
+    python -m microrank_tpu.cli stream --source tail --input live.csv -o out/
     python -m microrank_tpu.cli synth  -o data/ --operations 100 --traces 500
     python -m microrank_tpu.cli eval   --cases 40 [--faults 2] [--detection]
     python -m microrank_tpu.cli stats  out/       (telemetry exposition)
@@ -494,6 +495,16 @@ def cmd_serve(args) -> int:
             "max_wait_ms": args.max_wait_ms,
             "request_timeout_seconds": args.request_timeout,
             "drain_seconds": args.drain_seconds,
+            "warmup_occupancies": (
+                tuple(
+                    int(x)
+                    for x in args.warmup_occupancies.split(",")
+                    if x.strip()
+                )
+                if args.warmup_occupancies
+                else None
+            ),
+            "build_workers": args.build_workers,
             "warmup": False if args.no_warmup else None,
             "fallback": False if args.no_fallback else None,
             "inject_dispatch_failures": args.inject_dispatch_failures,
@@ -511,6 +522,125 @@ def cmd_serve(args) -> int:
         service.add_dataset(name, load_traces_csv(path))
     service.start()
     return run_serve(service, cfg.serve.host, cfg.serve.port)
+
+
+def cmd_stream(args) -> int:
+    """Continuous RCA engine (stream/): an unbounded span source feeds
+    an event-time windower with watermarks; online SLO baselines arm the
+    detector on every closed window; only abnormal windows pay for graph
+    build + device rank; ranked windows dedup into incidents with an
+    open/update/resolve lifecycle."""
+    import dataclasses
+
+    from ..stream import (
+        FileTailSource,
+        ReplaySource,
+        StdoutIncidentSink,
+        StreamEngine,
+        SyntheticSource,
+    )
+    from ..utils.logging import get_logger
+
+    log = get_logger("microrank_tpu.cli")
+    cfg = _config_from_args(args)
+    overrides = {
+        k: v
+        for k, v in {
+            # Stream windows share the detector's window width flag.
+            "window_minutes": args.detect_minutes,
+            "slide_minutes": args.slide_minutes,
+            "allowed_lateness_seconds": args.lateness_seconds,
+            "baseline_decay": args.baseline_decay,
+            "min_healthy_windows": args.min_healthy_windows,
+            "resolve_after_windows": args.resolve_after,
+            "cooldown_windows": args.cooldown,
+            "fingerprint_top_k": args.fingerprint_top_k,
+            "build_workers": args.build_workers,
+            "webhook_url": args.webhook,
+            "max_windows": args.max_windows,
+        }.items()
+        if v is not None
+    }
+    cfg = cfg.replace(stream=dataclasses.replace(cfg.stream, **overrides))
+
+    if args.source == "synthetic":
+        from ..testing import SyntheticConfig
+
+        faulted = [
+            int(x)
+            for x in (args.fault_windows or "").split(",")
+            if x.strip()
+        ]
+        source = SyntheticSource(
+            n_windows=args.windows,
+            faulted=faulted,
+            synth_config=SyntheticConfig(
+                n_operations=args.operations,
+                n_pods=args.pods,
+                n_kinds=args.kinds,
+                n_traces=args.traces,
+                fault_latency_ms=args.fault_ms,
+                window_minutes=args.detect_minutes,
+                seed=args.seed,
+            ),
+            pace_seconds=args.pace_seconds,
+        )
+        log.info(
+            "synthetic source: %d windows, fault windows %s, "
+            "injected fault %s",
+            args.windows, faulted or "none", source.fault_pod_op,
+        )
+    elif args.input is None:
+        log.error("--source %s needs --input TRACES_CSV", args.source)
+        return 2
+    elif args.source == "replay":
+        source = ReplaySource(
+            args.input,
+            chunk_spans=args.chunk_spans,
+            pace_seconds=args.pace_seconds,
+            rate=args.rate,
+        )
+    else:  # tail
+        source = FileTailSource(
+            args.input,
+            poll_seconds=args.poll_seconds,
+            idle_exit=args.idle_exit or 0,
+        )
+
+    normal_df = None
+    if args.normal:
+        from ..io import load_traces_csv
+
+        normal_df = load_traces_csv(args.normal)
+    if getattr(args, "metrics_port", None) is not None:
+        from ..obs.server import start_metrics_server
+
+        server = start_metrics_server(args.metrics_port)
+        log.info(
+            "metrics endpoint: http://127.0.0.1:%d/metrics", server.port
+        )
+    engine = StreamEngine(
+        cfg,
+        source,
+        out_dir=args.output,
+        normal_df=normal_df,
+        incident_sinks=[StdoutIncidentSink()],
+    )
+    s = engine.run()
+    for r in s.results:
+        if r.ranking:
+            print(f"window {r.start}:")
+            for rank, (name, score) in enumerate(r.ranking, 1):
+                print(f"  {rank:2d}. {name:<50s} {score:.8f}")
+    log.info(
+        "stream done: %d windows (%d ranked, %d clean, %d empty, "
+        "%d skipped, %d warmup), %d gated dispatches, %d late spans "
+        "dropped, incidents %d opened / %d resolved; results in %s",
+        s.windows, s.ranked, s.clean, s.empty, s.skipped, s.warmup,
+        s.dispatches, s.late_spans, s.incidents_opened,
+        s.incidents_resolved, args.output,
+    )
+    return 0
 
 
 def cmd_synth(args) -> int:
@@ -815,6 +945,16 @@ def main(argv=None) -> int:
         help="skip the startup jit warmup (first requests pay compile)",
     )
     p_srv.add_argument(
+        "--warmup-occupancies", default=None, metavar="N,N,...",
+        help="batch occupancies the startup warmup compiles (default "
+        '"1,2"); every entry must be <= --max-batch-windows',
+    )
+    p_srv.add_argument(
+        "--build-workers", type=int, default=None,
+        help="build-pool threads running host graph builds off the "
+        "scheduler thread (0 = serial builds on the scheduler thread)",
+    )
+    p_srv.add_argument(
         "--no-fallback", action="store_true",
         help="disable numpy_ref degradation: failed batches answer 500",
     )
@@ -825,6 +965,123 @@ def main(argv=None) -> int:
     )
     _add_config_flags(p_srv)
     p_srv.set_defaults(fn=cmd_serve)
+
+    p_stream = sub.add_parser(
+        "stream",
+        help="continuous RCA: event-time windows closed at the "
+        "watermark, online SLO baselines, anomaly-gated device "
+        "ranking, incident lifecycle",
+    )
+    p_stream.add_argument(
+        "--source",
+        default="synthetic",
+        choices=["synthetic", "tail", "replay"],
+        help="span source: paced synthetic timeline, growing-CSV tail, "
+        "or staged-CSV replay with pacing",
+    )
+    p_stream.add_argument(
+        "--input",
+        help="traces CSV for --source tail (growing) / replay (staged)",
+    )
+    p_stream.add_argument(
+        "--normal",
+        help="normal-period traces.csv seeding the online SLO baseline "
+        "(else the baseline cold-starts from the first "
+        "--min-healthy-windows windows; the synthetic source seeds "
+        "from its own normal window)",
+    )
+    p_stream.add_argument("-o", "--output", default="stream_out")
+    p_stream.add_argument(
+        "--slide-minutes", type=float, default=None,
+        help="sliding-window step (default: tumbling windows of "
+        "--detect-minutes)",
+    )
+    p_stream.add_argument(
+        "--lateness-seconds", type=float, default=None,
+        help="allowed out-of-order lateness before the watermark "
+        "closes a window (later spans are dropped and counted)",
+    )
+    p_stream.add_argument(
+        "--baseline-decay", type=float, default=None,
+        help="exponential-decay weight one healthy window contributes "
+        "to the online SLO baseline",
+    )
+    p_stream.add_argument(
+        "--min-healthy-windows", type=_positive_int, default=None,
+        help="cold-start windows absorbed before detection arms "
+        "(ignored when the baseline is seeded)",
+    )
+    p_stream.add_argument(
+        "--resolve-after", type=_positive_int, default=None,
+        help="consecutive healthy windows that resolve an incident",
+    )
+    p_stream.add_argument(
+        "--cooldown", type=int, default=None,
+        help="windows a resolved fingerprint is suppressed instead of "
+        "reopened (flap damping)",
+    )
+    p_stream.add_argument(
+        "--fingerprint-top-k", type=_positive_int, default=None,
+        help="tie-aware top-k suspect set size fingerprinting each "
+        "ranked window",
+    )
+    p_stream.add_argument(
+        "--build-workers", type=int, default=None,
+        help="build-pool threads overlapping host graph builds with "
+        "device ranking",
+    )
+    p_stream.add_argument(
+        "--webhook", help="POST every incident transition here (JSON)"
+    )
+    p_stream.add_argument(
+        "--max-windows", type=int, default=None,
+        help="stop after this many closed windows (CI/smoke bound; "
+        "default: run until the source ends)",
+    )
+    p_stream.add_argument(
+        "--pace-seconds", type=float, default=0.0,
+        help="synthetic/replay: sleep between emitted span chunks",
+    )
+    p_stream.add_argument(
+        "--chunk-spans", type=_positive_int, default=5000,
+        help="replay: spans per emitted chunk",
+    )
+    p_stream.add_argument(
+        "--rate", type=float, default=None,
+        help="replay: event-time faithful pacing at RATE x real time "
+        "(overrides --pace-seconds)",
+    )
+    p_stream.add_argument(
+        "--poll-seconds", type=float, default=2.0,
+        help="tail: seconds between file polls",
+    )
+    p_stream.add_argument(
+        "--idle-exit", type=_positive_int, default=None,
+        help="tail: exit after this many consecutive polls without "
+        "progress (default: tail forever)",
+    )
+    p_stream.add_argument(
+        "--windows", type=_positive_int, default=8,
+        help="synthetic: timeline length in windows",
+    )
+    p_stream.add_argument(
+        "--fault-windows", default="3",
+        help='synthetic: comma list of faulted window indices ("" = '
+        "none)",
+    )
+    p_stream.add_argument("--operations", type=int, default=30)
+    p_stream.add_argument("--pods", type=int, default=1)
+    p_stream.add_argument("--kinds", type=int, default=24)
+    p_stream.add_argument("--traces", type=int, default=300)
+    p_stream.add_argument("--fault-ms", type=float, default=2000.0)
+    p_stream.add_argument("--seed", type=int, default=0)
+    p_stream.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve live telemetry over HTTP on this port; the "
+        "snapshot also lands in -o at exit",
+    )
+    _add_config_flags(p_stream)
+    p_stream.set_defaults(fn=cmd_stream)
 
     p_synth = sub.add_parser("synth", help="generate a synthetic chaos case")
     p_synth.add_argument("-o", "--output", required=True)
@@ -934,7 +1191,7 @@ def main(argv=None) -> int:
     add_lint_parser(sub)
 
     args = parser.parse_args(argv)
-    if args.fn in (cmd_run, cmd_eval, cmd_serve):  # jax-touching only
+    if args.fn in (cmd_run, cmd_eval, cmd_serve, cmd_stream):  # jax-touching only
         _enable_jit_cache()
     return args.fn(args)
 

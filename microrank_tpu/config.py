@@ -291,6 +291,67 @@ class ServeConfig:
     # retries) with an injected error before behaving normally — drives
     # the degradation path end to end without a real device fault.
     inject_dispatch_failures: int = 0
+    # Startup warmup compiles the batched rank program at these
+    # occupancies (the jit cache key includes the batch size, so a full
+    # batch at an uncompiled occupancy pays a first-hit compile under
+    # traffic). Every entry must be >= 1 and <= max_batch_windows —
+    # validated at service start.
+    warmup_occupancies: Tuple[int, ...] = (1, 2)
+    # Host graph builds (parse -> detect -> partition -> padded graph)
+    # run on this many build-pool worker threads so they overlap the
+    # scheduler thread's device dispatches; 0 builds on the scheduler
+    # thread (the pre-pool serial behavior).
+    build_workers: int = 2
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    """Continuous RCA engine knobs (``cli stream`` — stream/ subsystem).
+
+    The engine consumes an unbounded span stream, closes event-time
+    windows at the watermark, detects every window against ONLINE SLO
+    baselines, and gates the expensive graph-build + device-rank path on
+    the detector — the paper's always-on monitor shape, vs the batch
+    replay of ``cli run`` and the request/response path of ``cli serve``.
+    """
+
+    # Event-time windowing: tumbling windows of ``window_minutes`` when
+    # slide_minutes is None, sliding (overlapping) windows otherwise.
+    window_minutes: float = 5.0
+    slide_minutes: Optional[float] = None
+    # Watermark lag: a window [s, s+w) closes only once the max span
+    # start time seen passes s+w+lateness — out-of-order spans within
+    # the bound still land in their window; spans older than the
+    # watermark are DROPPED and counted (stream_late_spans metric).
+    allowed_lateness_seconds: float = 30.0
+    # Online SLO baseline: exponential-decay weight one healthy window
+    # contributes to the per-operation mean/std and P^2 quantile state.
+    baseline_decay: float = 0.1
+    # Cold start (no --normal seed dump): treat this many initial
+    # windows as healthy baseline-feeding warmup before detection arms.
+    min_healthy_windows: int = 1
+    # Incident lifecycle: consecutive healthy windows that resolve an
+    # open incident, and the post-resolve window count during which the
+    # same fingerprint is suppressed instead of reopened (flap damping).
+    resolve_after_windows: int = 2
+    cooldown_windows: int = 2
+    # Fingerprint: the tie-aware top-k suspect set of a ranked window
+    # (exact score ties at the k-th rank expand the set). Consecutive
+    # abnormal windows whose fingerprints match exactly or overlap by
+    # >= fingerprint_jaccard dedup into one incident.
+    fingerprint_top_k: int = 5
+    fingerprint_jaccard: float = 0.5
+    # Build worker pool: threads running host graph builds so window
+    # N+1's build overlaps window N's device rank; pipeline_windows
+    # bounds abnormal windows in flight (build submitted, rank pending).
+    build_workers: int = 2
+    pipeline_windows: int = 2
+    # Optional incident webhook: every lifecycle transition POSTs its
+    # JSON event here (best-effort, 2 s timeout, failures counted).
+    webhook_url: Optional[str] = None
+    # Stop after this many CLOSED windows (0 = run until the source
+    # ends) — the CI/smoke bound.
+    max_windows: int = 0
 
 
 @dataclass(frozen=True)
@@ -302,6 +363,7 @@ class MicroRankConfig:
     compat: CompatConfig = field(default_factory=CompatConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    stream: StreamConfig = field(default_factory=StreamConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -326,6 +388,8 @@ class MicroRankConfig:
                 flt["mesh_shape"] = tuple(flt["mesh_shape"])
             if typ is RuntimeConfig and flt.get("mesh_axes") is not None:
                 flt["mesh_axes"] = tuple(flt["mesh_axes"])
+            if typ is ServeConfig and flt.get("warmup_occupancies") is not None:
+                flt["warmup_occupancies"] = tuple(flt["warmup_occupancies"])
             return typ(**flt)
 
         return cls(
@@ -336,4 +400,5 @@ class MicroRankConfig:
             compat=_mk(CompatConfig, d.get("compat", {})),
             runtime=_mk(RuntimeConfig, d.get("runtime", {})),
             serve=_mk(ServeConfig, d.get("serve", {})),
+            stream=_mk(StreamConfig, d.get("stream", {})),
         )

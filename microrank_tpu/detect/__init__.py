@@ -1,10 +1,37 @@
 from .detector import DetectResult, detect_jax, detect_numpy
 from .slo import compute_slo, slo_as_dict
 
+
+def detect_partition(config, slo_vocab, baseline, window_df):
+    """Detect + partition one window frame: returns
+    ``(flag, normal_ids, abnormal_ids)``.
+
+    The shared twin of ``OnlineRCA.detect_window`` used by every
+    non-batch path (serve request handling, the streaming engine):
+    valid traces split into abnormal (exceeded expected duration) and
+    normal; invalid (non-positive duration) traces drop, matching the
+    reference's edge semantics.
+    """
+    from ..graph import build_detect_batch
+    from ..utils.guards import contract_checks
+
+    with contract_checks(config.runtime.validate_numerics):
+        batch, trace_ids = build_detect_batch(window_df, slo_vocab)
+    res = detect_numpy(batch, baseline, config.detector)
+    abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
+    nrm = [
+        t
+        for t, a, v in zip(trace_ids, res.abnormal, res.valid)
+        if v and not a
+    ]
+    return bool(res.flag), nrm, abn
+
+
 __all__ = [
     "DetectResult",
     "detect_jax",
     "detect_numpy",
+    "detect_partition",
     "compute_slo",
     "slo_as_dict",
 ]

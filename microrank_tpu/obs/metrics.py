@@ -72,8 +72,9 @@ def staged_bytes() -> Counter:
 def staged_pad_bytes() -> Counter:
     return get_registry().counter(
         "microrank_staged_pad_bytes_total",
-        "Estimated padding-waste bytes inside staged graphs "
-        "(pad_policy overhead: padded minus true extents)",
+        "Padding-waste bytes inside staged graphs, audited per staged "
+        "leaf against its exact live extents (pad_policy overhead: "
+        "padded minus true bytes)",
         labelnames=("path",),
     )
 
@@ -176,6 +177,63 @@ def serve_stage_seconds() -> Histogram:
     )
 
 
+def stream_windows() -> Counter:
+    return get_registry().counter(
+        "microrank_stream_windows_total",
+        "Streaming windows closed at the watermark, by outcome",
+        # ranked | clean | empty | skipped | warmup
+        labelnames=("outcome",),
+    )
+
+
+def stream_dispatches() -> Counter:
+    return get_registry().counter(
+        "microrank_stream_dispatches_total",
+        "Anomaly-GATED device rank dispatches in streaming mode (the "
+        "detector runs on every window; graph build + device rank only "
+        "on abnormal ones — this staying below the window counter IS "
+        "the gate working)",
+    )
+
+
+def stream_late_spans() -> Counter:
+    return get_registry().counter(
+        "microrank_stream_late_spans_total",
+        "Spans dropped for arriving past the watermark (older than "
+        "every window they belong to, beyond allowed lateness)",
+    )
+
+
+def stream_incidents() -> Counter:
+    return get_registry().counter(
+        "microrank_stream_incidents_total",
+        "Incident lifecycle transitions",
+        labelnames=("transition",),  # open | update | resolve | suppressed
+    )
+
+
+def stream_open_incidents() -> Gauge:
+    return get_registry().gauge(
+        "microrank_stream_open_incidents",
+        "Incidents currently open in the streaming engine",
+    )
+
+
+def build_pool_inflight() -> Gauge:
+    return get_registry().gauge(
+        "microrank_build_pool_inflight",
+        "Host graph builds currently running on build-pool workers "
+        "(stream engine + serve scheduler share the pool seam)",
+    )
+
+
+def build_pool_builds() -> Counter:
+    return get_registry().counter(
+        "microrank_build_pool_builds_total",
+        "Host graph builds completed on build-pool workers",
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -202,6 +260,9 @@ def ensure_catalog() -> None:
         follow_polls, follow_parse_failures, follow_rotations,
         serve_requests, serve_queue_depth, serve_batch_windows,
         serve_last_batch_gauge, serve_degraded, serve_stage_seconds,
+        stream_windows, stream_dispatches, stream_late_spans,
+        stream_incidents, stream_open_incidents,
+        build_pool_inflight, build_pool_builds,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -235,6 +296,30 @@ def record_serve_batch(occupancy: int, degraded: int = 0) -> None:
     serve_last_batch_gauge().set(float(occupancy))
     if degraded:
         serve_degraded().inc(float(degraded))
+
+
+def record_stream_window(outcome: str) -> None:
+    stream_windows().inc(outcome=outcome)
+
+
+def record_stream_dispatch() -> None:
+    stream_dispatches().inc()
+
+
+def record_incident(transition: str, open_now: int = None) -> None:
+    stream_incidents().inc(transition=transition)
+    if open_now is not None:
+        stream_open_incidents().set(float(open_now))
+
+
+def record_build_pool(
+    inflight: int = None, build_seconds: float = None
+) -> None:
+    if inflight is not None:
+        build_pool_inflight().set(float(inflight))
+    if build_seconds is not None:
+        build_pool_builds().inc()
+        stage_seconds().observe(float(build_seconds), stage="build_pool")
 
 
 def record_staging(
@@ -288,6 +373,78 @@ def graph_staging_stats(graph) -> Tuple[int, int]:
                 np.clip(1.0 - np.mean(live) / arr.shape[-1], 0.0, 1.0)
             )
             pad += int(arr.nbytes * frac)
+    return total, pad
+
+
+def graph_staging_audit(graph) -> Tuple[int, int]:
+    """(total_bytes, pad_bytes) of a (possibly batched) WindowGraph,
+    AUDITED leaf by leaf against exact live extents — what the staging
+    layer actually ships vs what the window actually needed.
+
+    Unlike ``graph_staging_stats`` (the historical estimate, kept for
+    comparison), no mean-live-fraction folding: each vector leaf's true
+    size is the per-window sum of its clipped live extent, indptr leaves
+    count their ``live+1`` offsets, and the 2-D bitmaps account BOTH
+    axes (padded op rows beyond ``n_ops`` AND padded byte columns beyond
+    ``ceil(live/8)`` — the row-axis waste the estimate never saw).
+    Leaves ``device_subset`` stripped for the kernel have zero bytes and
+    contribute nothing, so the counter reflects the staged reality.
+    """
+    scalars = {"n_ops", "n_traces", "n_inc", "n_ss", "n_cols"}
+    total = 0
+    pad = 0
+    for part in (graph.normal, graph.abnormal):
+        t_live = np.where(
+            np.asarray(part.n_cols) >= 0, part.n_cols, part.n_traces
+        )
+        n_inc = np.atleast_1d(np.asarray(part.n_inc)).astype(np.int64)
+        n_ss = np.atleast_1d(np.asarray(part.n_ss)).astype(np.int64)
+        n_ops = np.atleast_1d(np.asarray(part.n_ops)).astype(np.int64)
+        t_live = np.atleast_1d(np.asarray(t_live)).astype(np.int64)
+        vec_live = {
+            "inc_op": n_inc, "inc_trace": n_inc, "sr_val": n_inc,
+            "rs_val": n_inc, "inc_trace_opmajor": n_inc,
+            "sr_val_opmajor": n_inc,
+            "ss_child": n_ss, "ss_parent": n_ss, "ss_val": n_ss,
+            "inv_tracelen": t_live, "kind": t_live, "tracelen": t_live,
+            "inv_cov_dup": n_ops, "inv_outdeg": n_ops,
+            "cov_unique": n_ops, "op_present": n_ops,
+            "inc_indptr_op": n_ops + 1,
+            "inc_indptr_trace": t_live + 1,
+            "ss_indptr": n_ops + 1,
+        }
+        bit_live = {
+            "cov_bits": (n_ops, -(-t_live // 8)),
+            "ss_bits": (n_ops, -(-n_ops // 8)),
+        }
+        for f in part._fields:
+            arr = np.asarray(getattr(part, f))
+            total += arr.nbytes
+            if f in scalars or arr.nbytes == 0:
+                continue
+            if f in bit_live:
+                rows_live, cols_live = bit_live[f]
+                rows_pad, cols_pad = arr.shape[-2], arr.shape[-1]
+                b = arr.size // (rows_pad * cols_pad)
+                if len(rows_live) not in (1, b):
+                    continue  # unrecognized stacking: skip, stay honest
+                rl = np.broadcast_to(
+                    np.clip(rows_live, 0, rows_pad), (b,)
+                )
+                cl = np.broadcast_to(
+                    np.clip(cols_live, 0, cols_pad), (b,)
+                )
+                pad += arr.nbytes - int((rl * cl).sum()) * arr.itemsize
+            else:
+                live = vec_live.get(f)
+                if live is None or arr.ndim == 0:
+                    continue
+                last = arr.shape[-1]
+                rows = arr.size // last
+                if len(live) not in (1, rows):
+                    continue
+                lv = np.broadcast_to(np.clip(live, 0, last), (rows,))
+                pad += (rows * last - int(lv.sum())) * arr.itemsize
     return total, pad
 
 

@@ -39,6 +39,69 @@ from .results import WindowResult
 log = get_logger("microrank_tpu.pipeline.follow")
 
 
+class TailTracker:
+    """Shared tail-poll bookkeeping — ONE source of truth for the tail
+    rules, used by the batch follow loop below and the streaming
+    ``stream.sources.FileTailSource``:
+
+    * growth detection (``size == last`` counts idle);
+    * rotation/truncation (``size < last``): counted
+      (``follow_rotations``), ``rotated`` flagged so callers reset
+      their cursors, and the file re-reads from scratch;
+    * parse failures (torn final line): counted
+      (``follow_parse_failures``) AND counted toward ``idle_exit`` — a
+      permanently corrupt tail must not starve the exit condition
+      (advisor round 5);
+    * ``idle_exit`` consecutive no-progress polls stop the loop
+      (0 = follow forever).
+    """
+
+    def __init__(self, idle_exit: int = 0):
+        self.idle_exit = int(idle_exit)
+        self.last_size = -1
+        self.idle = 0
+        self.rotated = False
+
+    def _idle_tick(self) -> str:
+        self.idle += 1
+        if self.idle_exit and self.idle >= self.idle_exit:
+            return "exit"
+        return "idle"
+
+    def observe_size(self, size: int) -> str:
+        """Classify one poll's file size: "grew" | "idle" | "exit"."""
+        from ..obs.metrics import follow_polls, follow_rotations
+
+        follow_polls().inc()
+        self.rotated = False
+        if 0 <= size < self.last_size:
+            log.warning(
+                "follow: file shrank %d -> %d bytes "
+                "(rotation/truncation); re-reading", self.last_size, size,
+            )
+            follow_rotations().inc()
+            self.last_size = -1
+            self.rotated = True
+        if size == self.last_size or size < 0:
+            return self._idle_tick()
+        return "grew"
+
+    def parse_failed(self, exc) -> str:
+        """One failed ingest parse: "retry" | "exit". ``last_size``
+        stays unchanged so the next poll re-reads even without
+        further growth."""
+        from ..obs.metrics import follow_parse_failures
+
+        log.warning("follow: ingest failed (%s); retrying", exc)
+        follow_parse_failures().inc()
+        return "exit" if self._idle_tick() == "exit" else "retry"
+
+    def parsed(self, size: int) -> None:
+        """One successful parse at ``size`` bytes resets the idle run."""
+        self.idle = 0
+        self.last_size = size
+
+
 def follow_table(
     rca,
     path,
@@ -64,11 +127,6 @@ def follow_table(
     total polls (0 = unbounded). ``sleep`` is injectable for tests.
     """
     from ..native import load_span_table
-    from ..obs.metrics import (
-        follow_parse_failures,
-        follow_polls,
-        follow_rotations,
-    )
 
     if out_dir is None:
         raise ValueError(
@@ -78,30 +136,21 @@ def follow_table(
     path = Path(path)
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
-    last_size = -1
-    idle = 0
+    tracker = TailTracker(idle_exit=idle_exit)
     polls = 0
     while True:
         polls += 1
-        follow_polls().inc()
         size = os.path.getsize(path) if path.exists() else -1
-        if 0 <= size < last_size:
-            # Rotation/truncation: the collector replaced the file (or
-            # something rewrote it shorter). Re-read from scratch — the
-            # window cursor still guards against re-RANKING old windows,
-            # so a rotated-in file that restarts the timeline simply
-            # yields nothing new until it passes the cursor again.
-            log.warning(
-                "follow: file shrank %d -> %d bytes "
-                "(rotation/truncation); re-reading", last_size, size,
-            )
-            follow_rotations().inc()
-            last_size = -1
-        if size == last_size or size < 0:
-            idle += 1
-            if idle_exit and idle >= idle_exit:
+        # Rotation note: the tracker re-reads from scratch; the window
+        # cursor still guards against re-RANKING old windows, so a
+        # rotated-in file that restarts the timeline simply yields
+        # nothing new until it passes the cursor again.
+        status = tracker.observe_size(size)
+        if status != "grew":
+            if status == "exit":
                 log.info(
-                    "follow: no growth for %d polls; exiting", idle
+                    "follow: no progress for %d polls; exiting",
+                    tracker.idle,
                 )
                 return
             if max_polls and polls >= max_polls:
@@ -112,26 +161,19 @@ def follow_table(
             table = load_span_table(path, cache=False)
         except (ValueError, OSError) as exc:
             # A torn final line (the collector flushed mid-row) parses
-            # as an error THIS poll and as valid data the next — a tail
-            # loop must retry, not die. last_size stays unchanged so
-            # the next poll re-reads even without further growth — but
-            # the failure COUNTS toward idle_exit: a tail that never
-            # parses again must not starve the exit condition.
-            log.warning("follow: ingest failed (%s); retrying", exc)
-            follow_parse_failures().inc()
-            idle += 1
-            if idle_exit and idle >= idle_exit:
+            # as an error THIS poll and as valid data the next — retry,
+            # with the failure counting toward idle_exit (tracker).
+            if tracker.parse_failed(exc) == "exit":
                 log.info(
                     "follow: %d polls without progress (last: parse "
-                    "failure); exiting", idle,
+                    "failure); exiting", tracker.idle,
                 )
                 return
             if max_polls and polls >= max_polls:
                 return
             sleep(poll_seconds)
             continue
-        idle = 0
-        last_size = size
+        tracker.parsed(size)
         if table.n_spans == 0:
             if max_polls and polls >= max_polls:
                 return

@@ -217,12 +217,14 @@ def _blob_checked_jit():
 
 
 def _account_staging(graph: WindowGraph, path: str, n_transfers: int):
-    """Staging telemetry: bytes, transfer count, pad-waste estimate —
-    the counters that turn compile storms and pad_policy overhead into
-    data (obs.metrics). Host-side arrays only; ~52 nbytes reads."""
-    from ..obs.metrics import graph_staging_stats, record_staging
+    """Staging telemetry: bytes, transfer count, pad waste — the
+    counters that turn compile storms and pad_policy overhead into data
+    (obs.metrics). Pad waste is AUDITED per staged leaf against exact
+    live extents (graph_staging_audit), not estimated from mean live
+    fractions. Host-side arrays only; ~52 nbytes reads."""
+    from ..obs.metrics import graph_staging_audit, record_staging
 
-    total, pad = graph_staging_stats(graph)
+    total, pad = graph_staging_audit(graph)
     record_staging(path, total, n_transfers, pad)
 
 

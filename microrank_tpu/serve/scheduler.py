@@ -2,12 +2,17 @@
 
 Requests enter per-tenant FIFOs (the HTTP frontend's threads only
 enqueue); a single scheduler thread pops them **fairly** (round-robin
-across tenants, so one chatty tenant cannot starve the rest), runs the
-host half (parse -> detect -> partition -> padded graph build), parks
-rankable windows in the micro-batcher's shape buckets, and dispatches
-full or aged batches. Single-threaded device ownership is also the
-program-order guarantee jax dispatch needs — the serving twin of the
-offline runners' rule that collectives are issued by one thread.
+across tenants, so one chatty tenant cannot starve the rest), hands the
+host half (parse -> detect -> partition -> padded graph build) to the
+build worker pool (stream.pool — the seam shared with the streaming
+engine), parks rankable windows in the micro-batcher's shape buckets,
+and dispatches full or aged batches. Host builds overlap device
+dispatch under load; every DEVICE touch stays on the scheduler thread —
+single-threaded device ownership is the program-order guarantee jax
+dispatch needs, the serving twin of the offline runners' rule that
+collectives are issued by one thread. ``build_pool=None``
+(ServeConfig.build_workers=0) restores serial builds on the scheduler
+thread.
 
 Drain: ``stop(drain=True)`` (the SIGTERM path) processes everything
 already admitted — queues empty, every bucket force-flushed, every
@@ -36,13 +41,15 @@ class ShutdownError(RuntimeError):
 
 
 class BatchScheduler(threading.Thread):
-    def __init__(self, service, journal=None):
+    def __init__(self, service, journal=None, build_pool=None):
         super().__init__(name="mr-serve-sched", daemon=True)
         self.service = service
         self.batcher = MicroBatcher(service.config, journal=journal)
+        self.build_pool = build_pool
         self._cond = threading.Condition()
         self._tenants: "OrderedDict[str, deque]" = OrderedDict()
         self._rr = 0                 # round-robin cursor over tenant keys
+        self._builds = 0             # host builds in flight on the pool
         self._stopping = False
         self._draining = False
 
@@ -99,25 +106,67 @@ class BatchScheduler(threading.Thread):
             entry = self._pop_fair(timeout)
             if entry is not None:
                 self._process(entry)
-            # In-flight (already built) windows always complete at
-            # shutdown — only queued-not-yet-built requests are failed
-            # by a non-draining stop.
-            force = self._stopping and self.queued() == 0
+            # In-flight (already built or still building) windows always
+            # complete at shutdown — only queued-not-yet-built requests
+            # are failed by a non-draining stop.
+            force = (
+                self._stopping
+                and self.queued() == 0
+                and self.builds_inflight() == 0
+            )
             for batch in self.batcher.take_ready(force=force):
                 self.batcher.dispatch(batch)
             with self._cond:
                 if (
                     self._stopping
                     and not any(self._tenants.values())
+                    and self._builds == 0
                     and self.batcher.pending() == 0
                 ):
                     return
 
+    def builds_inflight(self) -> int:
+        with self._cond:
+            return self._builds
+
     def _process(self, entry) -> None:
         request, fut, enqueued, on_done = entry
-        pw = self.service.build_pending(request, fut, enqueued, on_done)
-        if pw is not None:
-            self.batcher.submit(pw)
+        if self.build_pool is None:
+            pw = self.service.build_pending(
+                request, fut, enqueued, on_done
+            )
+            if pw is not None:
+                self.batcher.submit(pw)
+            return
+        # Host half off-thread: the pool builds while THIS thread keeps
+        # dispatching ready batches; the completion callback parks the
+        # built window (batcher.submit is thread-safe) and nudges the
+        # scheduler, which alone touches the device.
+        with self._cond:
+            self._builds += 1
+
+        def _done(f):
+            pw = None
+            try:
+                pw = f.result()
+            except Exception as e:  # noqa: BLE001 - build_pending
+                # resolves its own failures; this catches only wrapper
+                # faults, which must still answer the request.
+                if not fut.done():
+                    fut.set_exception(e)
+                    if on_done is not None:
+                        on_done(None, e)
+            if pw is not None:
+                self.batcher.submit(pw)
+            with self._cond:
+                self._builds -= 1
+                self._cond.notify()
+
+        self.build_pool.submit(
+            self.service.build_pending,
+            request, fut, enqueued, on_done,
+            on_done=_done,
+        )
 
     # -------------------------------------------------------------- stop
     def stop(self, drain: bool = True, timeout: Optional[float] = None):

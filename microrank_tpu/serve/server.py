@@ -73,7 +73,16 @@ class ServeService:
             from ..obs import JOURNAL_NAME, RunJournal
 
             self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
-        self.scheduler = BatchScheduler(self, journal=self.journal)
+        self.build_pool = None
+        if self.serve.build_workers > 0:
+            from ..stream.pool import BuildWorkerPool
+
+            self.build_pool = BuildWorkerPool(
+                self.serve.build_workers, name="mr-serve-build"
+            )
+        self.scheduler = BatchScheduler(
+            self, journal=self.journal, build_pool=self.build_pool
+        )
         self.datasets: Dict[str, object] = {}
         self.slo_vocab = None
         self.baseline = None
@@ -112,15 +121,30 @@ class ServeService:
                 max_queue_depth=self.serve.max_queue_depth,
             )
         if self.serve.warmup:
+            occs = self.serve.warmup_occupancies
+            bad = [
+                o
+                for o in occs
+                if int(o) < 1 or int(o) > self.serve.max_batch_windows
+            ]
+            if not occs or bad:
+                raise ValueError(
+                    f"warmup_occupancies {tuple(occs)} invalid: every "
+                    f"entry must be in [1, max_batch_windows="
+                    f"{self.serve.max_batch_windows}]"
+                )
             self.warmup()
         self.scheduler.start()
 
     def warmup(self) -> None:
         """Trace+compile the batched rank program before traffic: one
-        occupancy-1 and one occupancy-2 dispatch over a small synthetic
-        window (the persistent jit cache makes repeats near-instant).
-        Runs before the scheduler thread starts — exclusive device use.
-        Warmup dispatches don't pollute the occupancy metrics."""
+        dispatch per configured occupancy
+        (ServeConfig.warmup_occupancies) over a small synthetic window
+        (the persistent jit cache makes repeats near-instant) — a full
+        batch at an uncompiled occupancy would otherwise pay a first-hit
+        compile under traffic. Runs before the scheduler thread starts —
+        exclusive device use. Warmup dispatches don't pollute the
+        occupancy metrics."""
         import pandas as pd
 
         from ..rank_backends.jax_tpu import prepare_window_graph
@@ -158,14 +182,17 @@ class ServeService:
                 built=time.monotonic(),
             )
 
-        for occupancy in (1, 2):
+        occupancies = tuple(
+            int(o) for o in self.serve.warmup_occupancies
+        )
+        for occupancy in occupancies:
             self.scheduler.batcher.dispatch(
                 [_pw() for _ in range(occupancy)], warmup=True
             )
         self.log.info(
-            "warmup: compiled batched rank program (occupancies 1, 2, "
+            "warmup: compiled batched rank program (occupancies %s, "
             "kernel %s) in %.1fs",
-            kernel, time.monotonic() - t0,
+            list(occupancies), kernel, time.monotonic() - t0,
         )
 
     # ----------------------------------------------------------- request
@@ -309,6 +336,8 @@ class ServeService:
             self.scheduler._stopping = True
             for batch in self.scheduler.batcher.take_ready(force=True):
                 self.scheduler.batcher.dispatch(batch)
+        if self.build_pool is not None:
+            self.build_pool.shutdown()
         if self.journal is not None:
             self.journal.run_end(dispatches=self.scheduler.batcher.dispatches)
         if self.out_dir is not None and self.config.runtime.telemetry:
@@ -326,22 +355,11 @@ def _case_slo(case):
 
 
 def _detect_partition(config, slo_vocab, baseline, window_df):
-    """Detect + partition one window frame (the serving twin of
-    OnlineRCA.detect_window)."""
-    from ..detect import detect_numpy
-    from ..graph import build_detect_batch
-    from ..utils.guards import contract_checks
+    """Detect + partition one window frame (shared with the streaming
+    engine — detect.detect_partition)."""
+    from ..detect import detect_partition
 
-    with contract_checks(config.runtime.validate_numerics):
-        batch, trace_ids = build_detect_batch(window_df, slo_vocab)
-    res = detect_numpy(batch, baseline, config.detector)
-    abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
-    nrm = [
-        t
-        for t, a, v in zip(trace_ids, res.abnormal, res.valid)
-        if v and not a
-    ]
-    return bool(res.flag), nrm, abn
+    return detect_partition(config, slo_vocab, baseline, window_df)
 
 
 # ---------------------------------------------------------------- HTTP

@@ -1,0 +1,52 @@
+"""Continuous RCA engine (``cli stream``): the always-on workload.
+
+An unbounded span source (``sources``: file tail, paced CSV replay,
+synthetic generator) feeds an event-time windower with watermarks and
+bounded lateness (``window``); every closed window runs the detector
+against online SLO baselines (``baseline``: exponential-decay mean/std
++ P^2 quantiles, frozen during incidents); only ABNORMAL windows pay
+for graph build + device rank, with host builds overlapped on a worker
+pool (``pool``, shared with serve/); ranked windows dedup into
+incidents with open/update/resolve lifecycle and pluggable sinks
+(``incidents``). ``engine`` wires it together.
+"""
+
+from .baseline import OnlineBaseline, P2Quantile
+from .engine import (
+    INCIDENT_LOG_NAME,
+    StreamEngine,
+    StreamSummary,
+    run_stream,
+)
+from .incidents import (
+    Incident,
+    IncidentTracker,
+    JsonlIncidentSink,
+    StdoutIncidentSink,
+    WebhookIncidentSink,
+    ranking_fingerprint,
+)
+from .pool import BuildWorkerPool
+from .sources import FileTailSource, ReplaySource, SyntheticSource
+from .window import ClosedWindow, StreamWindower
+
+__all__ = [
+    "BuildWorkerPool",
+    "ClosedWindow",
+    "FileTailSource",
+    "INCIDENT_LOG_NAME",
+    "Incident",
+    "IncidentTracker",
+    "JsonlIncidentSink",
+    "OnlineBaseline",
+    "P2Quantile",
+    "ReplaySource",
+    "StdoutIncidentSink",
+    "StreamEngine",
+    "StreamSummary",
+    "StreamWindower",
+    "SyntheticSource",
+    "WebhookIncidentSink",
+    "ranking_fingerprint",
+    "run_stream",
+]

@@ -1,0 +1,231 @@
+"""Online SLO baselines: exponential-decay mean/std + P^2 quantiles.
+
+The batch pipelines fit the SLO baseline ONCE from a normal-period dump
+(``detect.slo.compute_slo``) and never revisit it; a continuous engine
+cannot — operations appear, latencies drift, and a baseline frozen at
+deploy time slowly turns every window anomalous (or none). Here each
+operation carries:
+
+* exponential-decay first/second moments (``m1``/``m2``) updated from
+  every HEALTHY window's per-op sample mean — mean and population std
+  fall out as ``m1`` and ``sqrt(m2 - m1^2)``, matching the batch
+  baseline's shape while forgetting old traffic at ``decay`` per window;
+* a P^2 streaming quantile estimator (Jain & Chlamtac 1985: five
+  markers, O(1) state and O(1) per sample, no sample buffer) so the
+  percentile SLO statistics (``DetectorConfig.slo_stat="p99"`` etc.)
+  work online too.
+
+The estimators update ONLY on healthy windows and FREEZE while an
+incident is open — otherwise the fault's own latencies would absorb
+into the baseline and the detector would declare recovery by drift
+rather than by the system actually recovering (the classic
+self-poisoning failure of online anomaly detection).
+
+``snapshot()`` renders the current state as the ``(Vocab, SloBaseline)``
+pair every existing detector entry point consumes — streaming mode
+swaps the baseline's PRODUCER, not the detector.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..detect.slo import slo_quantile
+from ..graph.structures import SloBaseline
+from ..io.interning import Vocab
+from ..io.naming import operation_names
+from ..io.schema import US_PER_MS
+
+
+class P2Quantile:
+    """Jain & Chlamtac's P^2 algorithm: one quantile, five markers.
+
+    Exact over the first five samples; afterwards the three interior
+    markers track the q-, q/2- and (1+q)/2-quantile positions via
+    piecewise-parabolic height adjustment. State is 15 floats per
+    (operation, quantile) — the whole point next to a sample buffer.
+    """
+
+    __slots__ = ("q", "n", "heights", "pos", "desired", "incr")
+
+    def __init__(self, q: float):
+        self.q = float(q)
+        self.n = 0
+        self.heights: List[float] = []
+        self.pos = np.arange(1.0, 6.0)
+        self.desired = np.array(
+            [1.0, 1 + 2 * q, 1 + 4 * q, 3 + 2 * q, 5.0]
+        )
+        self.incr = np.array([0.0, q / 2, q, (1 + q) / 2, 1.0])
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        if self.n < 5:
+            self.heights.append(x)
+            self.heights.sort()
+            self.n += 1
+            return
+        h = self.heights
+        if x < h[0]:
+            h[0] = x
+            k = 0
+        elif x >= h[4]:
+            h[4] = x
+            k = 3
+        else:
+            k = 0
+            while x >= h[k + 1]:
+                k += 1
+        self.pos[k + 1 :] += 1.0
+        self.desired += self.incr
+        self.n += 1
+        for i in (1, 2, 3):
+            d = self.desired[i] - self.pos[i]
+            step_up = self.pos[i + 1] - self.pos[i]
+            step_dn = self.pos[i - 1] - self.pos[i]
+            if (d >= 1 and step_up > 1) or (d <= -1 and step_dn < -1):
+                s = 1.0 if d >= 1 else -1.0
+                cand = h[i] + s / (step_up - step_dn) * (
+                    (self.pos[i] - self.pos[i - 1] + s)
+                    * (h[i + 1] - h[i])
+                    / step_up
+                    + (self.pos[i + 1] - self.pos[i] - s)
+                    * (h[i] - h[i - 1])
+                    / step_dn
+                )
+                if not h[i - 1] < cand < h[i + 1]:
+                    # Parabolic estimate left the bracket: linear step.
+                    j = i + (1 if s > 0 else -1)
+                    cand = h[i] + s * (h[j] - h[i]) / (
+                        self.pos[j] - self.pos[i]
+                    )
+                h[i] = cand
+                self.pos[i] += s
+
+    def value(self) -> float:
+        if self.n == 0:
+            return float("nan")
+        if self.n <= 5:
+            h = sorted(self.heights)
+            # Exact quantile over the few samples held so far.
+            return float(np.quantile(h, self.q))
+        return float(self.heights[2])
+
+
+class _OpState:
+    """One operation's online baseline state (durations in ms)."""
+
+    __slots__ = ("m1", "m2", "windows", "p2")
+
+    def __init__(self, quantile: Optional[float]):
+        self.m1 = 0.0
+        self.m2 = 0.0
+        self.windows = 0
+        self.p2 = P2Quantile(quantile) if quantile is not None else None
+
+
+class OnlineBaseline:
+    """Per-operation streaming SLO state behind the batch detector's
+    ``(Vocab, SloBaseline)`` interface."""
+
+    def __init__(
+        self,
+        decay: float = 0.1,
+        slo_stat: str = "mean",
+        min_windows: int = 1,
+        p2_seed_cap: int = 2048,
+    ):
+        if not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
+        self.decay = float(decay)
+        self.slo_stat = slo_stat
+        self.quantile = (
+            None if slo_stat == "mean" else slo_quantile(slo_stat)
+        )
+        self.min_windows = int(min_windows)
+        self.p2_seed_cap = int(p2_seed_cap)
+        self._ops: Dict[str, _OpState] = {}
+        self.frozen = False
+        self.seeded = False
+        self.n_updates = 0      # healthy windows absorbed
+        self.n_frozen_skips = 0
+
+    # ------------------------------------------------------------- state
+    @property
+    def ready(self) -> bool:
+        """Detection arms once seeded or fed ``min_windows`` windows."""
+        return bool(self._ops) and (
+            self.seeded or self.n_updates >= self.min_windows
+        )
+
+    def freeze(self) -> None:
+        self.frozen = True
+
+    def thaw(self) -> None:
+        self.frozen = False
+
+    # ------------------------------------------------------------ intake
+    def _grouped_ms(self, span_df: pd.DataFrame):
+        names = operation_names(span_df, "service")
+        dur_ms = span_df["duration"].astype(float) / US_PER_MS
+        return dur_ms.groupby(names.to_numpy())
+
+    def seed(self, normal_df: pd.DataFrame) -> None:
+        """Initialize from a normal-period dump (the batch baseline's
+        input) so detection arms immediately; the P^2 markers absorb at
+        most ``p2_seed_cap`` strided samples per op (seeding is one-time
+        but a multi-GB dump should not cost a per-span Python loop)."""
+        for name, dur in self._grouped_ms(normal_df):
+            st = self._ops.setdefault(str(name), _OpState(self.quantile))
+            vals = dur.to_numpy()
+            st.m1 = float(vals.mean())
+            st.m2 = float((vals**2).mean())
+            st.windows += 1
+            if st.p2 is not None:
+                stride = max(1, len(vals) // self.p2_seed_cap)
+                for x in vals[::stride]:
+                    st.p2.update(x)
+        self.seeded = True
+
+    def update(self, window_df: pd.DataFrame) -> bool:
+        """Absorb one HEALTHY window; no-op (False) while frozen."""
+        if self.frozen:
+            self.n_frozen_skips += 1
+            return False
+        a = self.decay
+        for name, dur in self._grouped_ms(window_df):
+            st = self._ops.get(str(name))
+            vals = dur.to_numpy()
+            w_m1 = float(vals.mean())
+            w_m2 = float((vals**2).mean())
+            if st is None:
+                st = self._ops[str(name)] = _OpState(self.quantile)
+                st.m1, st.m2 = w_m1, w_m2
+            else:
+                st.m1 = (1 - a) * st.m1 + a * w_m1
+                st.m2 = (1 - a) * st.m2 + a * w_m2
+            st.windows += 1
+            if st.p2 is not None:
+                for x in vals:
+                    st.p2.update(x)
+        self.n_updates += 1
+        return True
+
+    # ----------------------------------------------------------- egress
+    def snapshot(self) -> Tuple[Vocab, SloBaseline]:
+        """The detector-facing view: name-sorted vocab + dense arrays
+        (center per ``slo_stat``, population-style std)."""
+        names = sorted(self._ops)
+        center = np.empty(len(names), np.float32)
+        std = np.empty(len(names), np.float32)
+        for i, n in enumerate(names):
+            st = self._ops[n]
+            var = max(0.0, st.m2 - st.m1 * st.m1)
+            std[i] = np.float32(var**0.5)
+            center[i] = np.float32(
+                st.m1 if st.p2 is None else st.p2.value()
+            )
+        return Vocab(names), SloBaseline(mean_ms=center, std_ms=std)

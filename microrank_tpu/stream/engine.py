@@ -1,0 +1,407 @@
+"""The continuous RCA engine (``cli stream``): the paper made literal.
+
+MicroRank is described as an always-on monitor — the anomaly detector
+watches live traces and only wakes the PageRank/spectrum machinery when
+a window deviates from SLO. The batch pipelines replay finished dumps
+and the serve path answers explicit requests; this engine closes the
+gap: an unbounded span source feeds an event-time windower
+(stream.window), every CLOSED window runs the cheap detector against
+ONLINE SLO baselines (stream.baseline), and only ABNORMAL windows pay
+for graph build + device rank — the gated-dispatch counter staying
+below the window counter is the design working.
+
+Overlap: abnormal windows' host graph builds run on the build worker
+pool (stream.pool) while THIS thread — the only one touching jax, the
+program-order rule — dispatches the previous window's rank; during an
+incident burst (consecutive abnormal windows, exactly when latency
+matters) window N+1 builds while window N ranks. Healthy windows drain
+the pipeline first so the incident lifecycle (stream.incidents)
+observes windows strictly in order.
+
+Baseline poisoning guard: baselines update only on healthy windows and
+freeze while any incident is open, so a fault's own latencies cannot
+absorb into the SLO and mask the recovery.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from ..config import MicroRankConfig
+from ..pipeline.results import ResultSink, WindowResult
+from ..utils.logging import get_logger
+from ..utils.profiling import StageTimings
+from .baseline import OnlineBaseline
+from .incidents import (
+    IncidentTracker,
+    JsonlIncidentSink,
+    WebhookIncidentSink,
+)
+from .pool import BuildWorkerPool
+from .window import ClosedWindow, StreamWindower
+
+INCIDENT_LOG_NAME = "incidents.jsonl"
+
+
+@dataclass
+class _PendingRank:
+    """One abnormal window: build submitted, device rank pending."""
+
+    closed: ClosedWindow
+    result: WindowResult
+    future: object              # -> (graph, op_names, kernel)
+
+
+@dataclass
+class StreamSummary:
+    windows: int = 0
+    ranked: int = 0
+    clean: int = 0
+    empty: int = 0
+    skipped: int = 0
+    warmup: int = 0
+    dispatches: int = 0
+    late_spans: int = 0
+    incidents_opened: int = 0
+    incidents_resolved: int = 0
+    results: List[WindowResult] = field(default_factory=list)
+
+
+class _JournalIncidentSink:
+    """Mirror incident transitions into the run journal."""
+
+    def __init__(self, journal):
+        self.journal = journal
+
+    def emit(self, event: dict) -> None:
+        self.journal.emit(
+            event["event"],
+            **{k: v for k, v in event.items() if k != "event"},
+        )
+
+
+class StreamEngine:
+    """Drive one span source through windowing, gated RCA, incidents."""
+
+    def __init__(
+        self,
+        config: MicroRankConfig,
+        source,
+        out_dir=None,
+        normal_df=None,
+        incident_sinks: Optional[List] = None,
+    ):
+        self.config = config
+        sc = config.stream
+        self.source = source
+        self.log = get_logger("microrank_tpu.stream")
+        self.out_dir = Path(out_dir) if out_dir is not None else None
+        slide_us = (
+            None
+            if sc.slide_minutes is None
+            else int(sc.slide_minutes * 60e6)
+        )
+        self.windower = StreamWindower(
+            width_us=int(sc.window_minutes * 60e6),
+            slide_us=slide_us,
+            lateness_us=int(sc.allowed_lateness_seconds * 1e6),
+        )
+        self.baseline = OnlineBaseline(
+            decay=sc.baseline_decay,
+            slo_stat=config.detector.slo_stat,
+            min_windows=sc.min_healthy_windows,
+        )
+        if normal_df is None:
+            normal_df = getattr(source, "normal", None)
+        if normal_df is not None:
+            self.baseline.seed(normal_df)
+        self.pool = BuildWorkerPool(
+            sc.build_workers, name="mr-stream-build"
+        )
+        self.journal = None
+        self.sink = None
+        sinks = list(incident_sinks or [])
+        if self.out_dir is not None:
+            self.sink = ResultSink(
+                self.out_dir,
+                overwrite_csv=config.compat.overwrite_results,
+            )
+            sinks.append(
+                JsonlIncidentSink(self.out_dir / INCIDENT_LOG_NAME)
+            )
+            if config.runtime.telemetry:
+                from ..obs import JOURNAL_NAME, RunJournal
+
+                self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
+                sinks.append(_JournalIncidentSink(self.journal))
+        if sc.webhook_url:
+            sinks.append(WebhookIncidentSink(sc.webhook_url))
+        self.tracker = IncidentTracker(
+            top_k=sc.fingerprint_top_k,
+            resolve_after=sc.resolve_after_windows,
+            cooldown_windows=sc.cooldown_windows,
+            jaccard=sc.fingerprint_jaccard,
+            sinks=sinks,
+        )
+        self._pending: Deque[_PendingRank] = deque()
+        self.summary = StreamSummary()
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> StreamSummary:
+        from ..obs.metrics import ensure_catalog
+
+        ensure_catalog()
+        sc = self.config.stream
+        if self.journal is not None:
+            self.journal.run_start(
+                pipeline="stream",
+                kernel=self.config.runtime.kernel,
+                pad_policy=self.config.runtime.pad_policy,
+                window_minutes=sc.window_minutes,
+                slide_minutes=sc.slide_minutes,
+                lateness_seconds=sc.allowed_lateness_seconds,
+                seeded=self.baseline.seeded,
+            )
+        try:
+            done = False
+            for batch in self.source:
+                for w in self.windower.add(batch):
+                    self._process(w)
+                    if self._max_reached():
+                        done = True
+                        break
+                if done:
+                    break
+            if not done:
+                for w in self.windower.flush():
+                    self._process(w)
+                    if self._max_reached():
+                        break
+            self._drain_all()
+        finally:
+            self.pool.shutdown()
+            self.summary.late_spans = self.windower.dropped_late
+            if self.journal is not None:
+                self.journal.run_end(
+                    windows=self.summary.windows,
+                    ranked=self.summary.ranked,
+                    dispatches=self.summary.dispatches,
+                    late_spans=self.summary.late_spans,
+                    incidents_opened=self.summary.incidents_opened,
+                    incidents_resolved=self.summary.incidents_resolved,
+                )
+            if (
+                self.out_dir is not None
+                and self.config.runtime.telemetry
+            ):
+                from ..obs import get_registry
+
+                get_registry().write_snapshot(self.out_dir)
+        return self.summary
+
+    def _max_reached(self) -> bool:
+        mw = self.config.stream.max_windows
+        return bool(mw) and self.summary.windows >= mw
+
+    # -------------------------------------------------------- per window
+    def _process(self, closed: ClosedWindow) -> None:
+        self.summary.windows += 1
+        result = WindowResult(
+            start=closed.start, end=closed.end, anomaly=False
+        )
+        if closed.n_spans == 0:
+            self._drain_all()
+            result.skipped_reason = "empty_window"
+            self._finalize(result, "empty")
+            return
+        if not self.baseline.ready:
+            # Cold start: feed the baseline, don't detect yet.
+            self._drain_all()
+            self.baseline.update(closed.frame)
+            result.n_traces = int(closed.frame["traceID"].nunique())
+            result.skipped_reason = "baseline_warmup"
+            self._finalize(result, "warmup")
+            return
+        from ..detect import detect_partition
+
+        timings = StageTimings()
+        with timings.stage("detect"):
+            vocab, slo = self.baseline.snapshot()
+            flag, nrm, abn = detect_partition(
+                self.config, vocab, slo, closed.frame
+            )
+        result.timings = timings.as_dict()
+        result.anomaly = bool(flag)
+        result.n_normal, result.n_abnormal = len(nrm), len(abn)
+        result.n_traces = len(nrm) + len(abn)
+        if not flag:
+            self._drain_all()
+            self._finalize(result, "clean", frame=closed.frame)
+            return
+        if not nrm or not abn:
+            self._drain_all()
+            result.skipped_reason = "degenerate_partition"
+            self._finalize(result, "skipped")
+            return
+        # Gate open: host build on the pool; rank on THIS thread when it
+        # lands — consecutive abnormal windows overlap build(N+1) with
+        # rank(N). Healthy windows drained the pipe above, so lifecycle
+        # observation order == window order.
+        from ..rank_backends.jax_tpu import prepare_window_graph
+
+        fut = self.pool.submit(
+            prepare_window_graph, closed.frame, nrm, abn, self.config
+        )
+        self._pending.append(_PendingRank(closed, result, fut))
+        while len(self._pending) >= max(
+            1, self.config.stream.pipeline_windows
+        ):
+            self._rank_head()
+
+    # ---------------------------------------------------------- ranking
+    def _drain_all(self) -> None:
+        while self._pending:
+            self._rank_head()
+
+    def _rank_head(self) -> None:
+        p = self._pending.popleft()
+        try:
+            graph, op_names, kernel = p.future.result()
+        except Exception as e:  # noqa: BLE001 - a bad window must not
+            # kill the engine; the window records the failure and the
+            # stream moves on.
+            self.log.error(
+                "window %s: graph build failed: %s", p.result.start, e
+            )
+            p.result.skipped_reason = f"build_failed: {e}"
+            self._finalize(p.result, "skipped")
+            return
+        p.result.queue_depth = len(self._pending)
+        try:
+            self._dispatch_rank(p.result, graph, op_names, kernel)
+        except Exception as e:  # noqa: BLE001 - same containment rule
+            self.log.error(
+                "window %s: device rank failed: %s", p.result.start, e
+            )
+            p.result.skipped_reason = f"rank_failed: {e}"
+            p.result.ranking = []
+            self._finalize(p.result, "skipped")
+            return
+        self._finalize(p.result, "ranked")
+
+    def _dispatch_rank(self, result, graph, op_names, kernel) -> None:
+        import jax
+
+        from ..obs.metrics import record_stream_dispatch
+        from ..rank_backends.blob import stage_rank_window
+        from ..utils.guards import contract_checks
+
+        rt = self.config.runtime
+        conv = bool(rt.convergence_trace) and not rt.device_checks
+        t0 = time.monotonic()
+        with contract_checks(rt.validate_numerics):
+            out = stage_rank_window(
+                graph,
+                self.config.pagerank,
+                self.config.spectrum,
+                kernel,
+                rt.blob_staging,
+                checked=rt.device_checks,
+                conv_trace=conv,
+            )
+        out = jax.device_get(out)
+        record_stream_dispatch()
+        self.summary.dispatches += 1
+        top_idx, top_scores, n_valid = out[:3]
+        n = int(n_valid)
+        names = [op_names[int(i)] for i in top_idx[:n]]
+        scores = [float(s) for s in top_scores[:n]]
+        if rt.validate_numerics:
+            from ..utils.guards import assert_finite_scores
+
+            assert_finite_scores(scores, "stream window")
+        result.ranking = list(zip(names, scores))
+        result.kernel = kernel
+        result.timings["rank_ms"] = round(
+            (time.monotonic() - t0) * 1e3, 3
+        )
+        if conv:
+            from ..obs.metrics import record_convergence
+
+            res = np.asarray(
+                out[3],
+                dtype=np.float64,  # mrlint: disable=R2(host-side summary of an already-fetched trace; never re-enters a jnp expression)
+            )
+            n_it = int(out[4])
+            final = (
+                float(res[:, n_it - 1].max()) if n_it else float("nan")
+            )
+            record_convergence(kernel, n_it, final)
+            result.apply_convergence(
+                {"iterations": n_it, "final_residual": final}
+            )
+
+    # ------------------------------------------------------ finalization
+    def _finalize(self, result, outcome: str, frame=None) -> None:
+        from ..obs.metrics import record_stream_window
+
+        record_stream_window(outcome)
+        setattr(
+            self.summary, outcome, getattr(self.summary, outcome) + 1
+        )
+        if outcome == "ranked":
+            inc = self.tracker.observe_ranked(
+                result.start, result.ranking
+            )
+            if inc is not None:
+                self.summary.incidents_opened = self.tracker.opened
+                self.log.info(
+                    "window %s: anomaly -> %s (%d windows), top-1 %s",
+                    result.start, inc.incident_id, inc.windows,
+                    result.ranking[0][0] if result.ranking else "-",
+                )
+        elif outcome != "warmup":
+            resolved = self.tracker.observe_healthy(result.start)
+            self.summary.incidents_resolved = self.tracker.resolved
+            for inc in resolved:
+                self.log.info(
+                    "window %s: %s resolved after %d windows",
+                    result.start, inc.incident_id, inc.windows,
+                )
+        # Freeze tracks the lifecycle: baselines absorb healthy traffic
+        # only while no incident is open (anti-poisoning rule).
+        if self.tracker.has_open:
+            self.baseline.freeze()
+        else:
+            self.baseline.thaw()
+        if outcome == "clean" and frame is not None:
+            self.baseline.update(frame)   # no-op while frozen
+        self.summary.results.append(result)
+        if self.sink is not None:
+            self.sink.emit(result)
+        if self.journal is not None:
+            self.journal.window(result)
+
+
+def run_stream(
+    config: MicroRankConfig,
+    source,
+    out_dir=None,
+    normal_df=None,
+    on_result=None,
+) -> StreamSummary:
+    """Build and drive a StreamEngine to completion (the CLI entry)."""
+    engine = StreamEngine(
+        config, source, out_dir=out_dir, normal_df=normal_df
+    )
+    summary = engine.run()
+    if on_result is not None:
+        for r in summary.results:
+            on_result(r)
+    return summary

@@ -1,0 +1,247 @@
+"""Incident lifecycle: fingerprint, dedup, open/update/resolve, sinks.
+
+A continuous engine that emits one ranked suspect list per abnormal
+window buries the operator in duplicates — a 40-minute fault at
+5-minute windows is ONE incident, not eight alerts. Here every ranked
+window is fingerprinted by its tie-aware top-k suspect set (exact score
+ties at the cut expand the set, so a legally permuted tie cannot split
+an incident); consecutive windows whose fingerprints match — exactly or
+by Jaccard overlap >= ``fingerprint_jaccard``, absorbing top-k tail
+wobble across windows of the same fault — dedup into one OPEN incident
+that UPDATEs per window and RESOLVEs after ``resolve_after_windows``
+consecutive healthy windows. A resolved fingerprint enters a cooldown:
+re-flagging within ``cooldown_windows`` windows is suppressed (counted,
+not alerted) — flap damping for faults straddling the detector's edge.
+
+Transitions emit structured events to pluggable sinks: a JSONL incident
+log (``incidents.jsonl``), stdout one-liners, and a best-effort webhook
+POST (2 s timeout; failures counted, never raised into the engine).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.stream.incidents")
+
+
+def ranking_fingerprint(
+    ranking: Sequence[Tuple[str, float]], k: int, rtol: float = 1e-6
+) -> FrozenSet[str]:
+    """Tie-aware top-k suspect set of one ranked window.
+
+    Takes the top-k names plus every name whose score ties the k-th
+    score within ``rtol`` — two windows whose rankings differ only by a
+    permuted exact tie (different kernels/summation trees legally do
+    this, see utils.ranking_compare) produce the SAME fingerprint.
+    """
+    if not ranking:
+        return frozenset()
+    k = min(max(1, int(k)), len(ranking))
+    cut = float(ranking[k - 1][1])
+    tol = rtol * max(abs(cut), 1e-12)
+    return frozenset(
+        name
+        for i, (name, score) in enumerate(ranking)
+        if i < k or float(score) >= cut - tol
+    )
+
+
+def _jaccard(a: FrozenSet[str], b: FrozenSet[str]) -> float:
+    if not a or not b:
+        return 0.0
+    return len(a & b) / len(a | b)
+
+
+@dataclass
+class Incident:
+    incident_id: str
+    fingerprint: FrozenSet[str]
+    opened_at: str                 # window start (event time)
+    last_seen: str
+    windows: int = 1
+    healthy_streak: int = 0
+    top: List[Tuple[str, float]] = field(default_factory=list)
+    status: str = "open"           # open | resolved
+
+    def to_event(self, transition: str, **extra) -> dict:
+        return {
+            "event": f"incident_{transition}",
+            "incident_id": self.incident_id,
+            "fingerprint": sorted(self.fingerprint),
+            "opened_at": self.opened_at,
+            "last_seen": self.last_seen,
+            "windows": self.windows,
+            "top": [[n, float(s)] for n, s in self.top[:10]],
+            **extra,
+        }
+
+
+class JsonlIncidentSink:
+    """Append one JSON line per lifecycle transition."""
+
+    def __init__(self, path):
+        from pathlib import Path
+
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def emit(self, event: dict) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"ts": time.time(), **event}) + "\n")
+
+
+class StdoutIncidentSink:
+    def emit(self, event: dict) -> None:
+        top1 = event["top"][0][0] if event.get("top") else "-"
+        print(
+            f"[incident] {event['event']} {event['incident_id']} "
+            f"windows={event['windows']} top1={top1} "
+            f"at={event['last_seen']}"
+        )
+
+
+class WebhookIncidentSink:
+    """Best-effort JSON POST per transition (2 s timeout, never raises)."""
+
+    def __init__(self, url: str, timeout: float = 2.0):
+        self.url = url
+        self.timeout = float(timeout)
+        self.failures = 0
+
+    def emit(self, event: dict) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps(event).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception as e:  # noqa: BLE001 - alerting must not kill RCA
+            self.failures += 1
+            log.warning("incident webhook failed (%s): %s", self.url, e)
+
+
+class IncidentTracker:
+    """Window-ordered incident state machine over ranked/healthy windows."""
+
+    def __init__(
+        self,
+        top_k: int = 5,
+        resolve_after: int = 2,
+        cooldown_windows: int = 2,
+        jaccard: float = 0.5,
+        sinks: Optional[List] = None,
+    ):
+        self.top_k = int(top_k)
+        self.resolve_after = max(1, int(resolve_after))
+        self.cooldown_windows = max(0, int(cooldown_windows))
+        self.jaccard = float(jaccard)
+        self.sinks = list(sinks or [])
+        self._open: Dict[FrozenSet[str], Incident] = {}
+        self._cooldown: Dict[FrozenSet[str], int] = {}  # fp -> window#
+        self._window_no = 0
+        self._ids = 0
+        self.opened = 0
+        self.resolved = 0
+        self.suppressed = 0
+
+    # ------------------------------------------------------------- state
+    @property
+    def has_open(self) -> bool:
+        return bool(self._open)
+
+    def open_incidents(self) -> List[Incident]:
+        return list(self._open.values())
+
+    # ------------------------------------------------------------ intake
+    def observe_ranked(
+        self, window_start: str, ranking: Sequence[Tuple[str, float]]
+    ) -> Optional[Incident]:
+        """One abnormal RANKED window; returns the incident it mapped to
+        (None when suppressed by cooldown)."""
+        self._window_no += 1
+        fp = ranking_fingerprint(ranking, self.top_k)
+        from ..obs.metrics import record_incident
+
+        # Dedup against open incidents: exact match, else best overlap.
+        match = self._open.get(fp)
+        if match is None and self._open:
+            best = max(
+                self._open.values(),
+                key=lambda inc: _jaccard(fp, inc.fingerprint),
+            )
+            if _jaccard(fp, best.fingerprint) >= self.jaccard:
+                match = best
+        if match is not None:
+            match.windows += 1
+            match.healthy_streak = 0
+            match.last_seen = window_start
+            match.top = list(ranking)
+            record_incident("update")
+            self._emit(match.to_event("update"))
+            return match
+        # Cooldown: the same (or overlapping) fingerprint resolved
+        # within the last cooldown_windows windows — suppress, count.
+        for cfp, resolved_no in list(self._cooldown.items()):
+            if self._window_no - resolved_no > self.cooldown_windows:
+                del self._cooldown[cfp]
+            elif cfp == fp or _jaccard(fp, cfp) >= self.jaccard:
+                self.suppressed += 1
+                record_incident("suppressed")
+                log.info(
+                    "incident suppressed (cooldown): %s", sorted(fp)
+                )
+                return None
+        self._ids += 1
+        inc = Incident(
+            incident_id=f"inc-{self._ids}",
+            fingerprint=fp,
+            opened_at=window_start,
+            last_seen=window_start,
+            top=list(ranking),
+        )
+        self._open[fp] = inc
+        self.opened += 1
+        record_incident("open", open_now=len(self._open))
+        self._emit(inc.to_event("open"))
+        return inc
+
+    def observe_healthy(self, window_start: str) -> List[Incident]:
+        """One healthy (clean/empty/skipped) window; returns incidents
+        it resolved."""
+        self._window_no += 1
+        resolved: List[Incident] = []
+        from ..obs.metrics import record_incident
+
+        for fp, inc in list(self._open.items()):
+            inc.healthy_streak += 1
+            if inc.healthy_streak >= self.resolve_after:
+                inc.status = "resolved"
+                del self._open[fp]
+                self._cooldown[fp] = self._window_no
+                self.resolved += 1
+                resolved.append(inc)
+                record_incident("resolve", open_now=len(self._open))
+                self._emit(
+                    inc.to_event("resolve", resolved_at=window_start)
+                )
+        return resolved
+
+    # ------------------------------------------------------------- sinks
+    def _emit(self, event: dict) -> None:
+        for sink in self.sinks:
+            try:
+                sink.emit(event)
+            except Exception as e:  # noqa: BLE001 - sink faults stay local
+                log.warning(
+                    "incident sink %s failed: %s", type(sink).__name__, e
+                )

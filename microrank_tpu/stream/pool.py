@@ -1,0 +1,88 @@
+"""Build worker pool: host graph builds off the dispatch thread.
+
+Both always-on paths have the same hot-loop shape: a single thread owns
+the device (program-order guarantee for jax dispatch) and must not spend
+its time in pandas/numpy graph construction while the device sits idle.
+The pool is the seam that fixes it in both places:
+
+* the stream engine submits window N+1's build here while its own
+  thread dispatches window N's rank — the build/rank overlap the table
+  lane gets from its stage/fetch workers, for the streaming loop;
+* the serve scheduler (serve/scheduler.py) routes ``build_pending``
+  through the same pool, so request-path host builds overlap device
+  dispatch under load (the ROADMAP "build worker pool" follow-up).
+
+Only HOST work runs here — callers keep every ``jax`` dispatch on their
+own thread, preserving the one-thread-owns-the-device rule the offline
+runners document (RuntimeConfig.async_dispatch's collective-order
+constraint).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional, Set
+
+
+class BuildWorkerPool:
+    """A small thread pool with build accounting.
+
+    ``build_threads`` records the idents that ran builds (tests assert
+    builds left the dispatch thread); the inflight gauge and build
+    counter land in the shared metrics registry.
+    """
+
+    def __init__(self, workers: int = 2, name: str = "mr-build"):
+        self.workers = max(1, int(workers))
+        self._ex = ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix=name
+        )
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.build_threads: Set[int] = set()
+        self.builds = 0
+
+    def submit(
+        self,
+        fn: Callable,
+        *args,
+        on_done: Optional[Callable] = None,
+        **kwargs,
+    ) -> Future:
+        """Run ``fn(*args, **kwargs)`` on a worker; ``on_done(future)``
+        (when given) fires on the worker thread after completion —
+        exceptions from ``fn`` live in the future, not the worker."""
+        from ..obs.metrics import record_build_pool
+
+        with self._lock:
+            self._inflight += 1
+            record_build_pool(inflight=self._inflight)
+
+        def _run():
+            t0 = time.monotonic()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self.builds += 1
+                    self.build_threads.add(threading.get_ident())
+                    record_build_pool(
+                        inflight=self._inflight,
+                        build_seconds=time.monotonic() - t0,
+                    )
+
+        fut = self._ex.submit(_run)
+        if on_done is not None:
+            fut.add_done_callback(on_done)
+        return fut
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._ex.shutdown(wait=wait)

@@ -1,0 +1,188 @@
+"""Pluggable span sources for the streaming engine.
+
+A source is any iterable of canonical span DataFrames (io.schema
+columns, parsed timestamps) — the engine does not care where spans come
+from. Three deployment shapes ship:
+
+* ``FileTailSource`` — tail a GROWING traces CSV, the file-drop shape
+  ``pipeline/follow.py`` serves for the batch runner, sharing its
+  ``TailTracker`` bookkeeping (and the ``follow_*`` metrics): torn
+  final lines parse as a failure this poll and as data the next,
+  rotation/truncation (size shrank) re-reads from scratch,
+  ``idle_exit`` bounds consecutive no-progress polls. Unlike follow.py
+  — which re-ranks via the window cursor — the tail yields only rows
+  past the last yielded count; the engine's watermark handles
+  everything downstream.
+* ``ReplaySource`` — a staged CSV replayed with pacing: chunks emit in
+  event-time order, optionally slept between (fixed ``pace_seconds`` or
+  event-time faithful at ``rate`` x real time) — load generation and
+  demos without a live collector.
+* ``SyntheticSource`` — the in-process generator
+  (``testing.synthetic.generate_timeline``) as a paced stream, with
+  chosen windows carrying an injected fault. Exposes the ground truth
+  (``fault_pod_op``) and the baseline-seeding normal window; the
+  CI smoke and the acceptance tests run on it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import pandas as pd
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.stream.sources")
+
+
+def _sorted_chunks(
+    df: pd.DataFrame, chunk_spans: int
+) -> List[pd.DataFrame]:
+    df = df.sort_values("startTime", kind="stable").reset_index(drop=True)
+    return [
+        df.iloc[i : i + chunk_spans]
+        for i in range(0, len(df), max(1, int(chunk_spans)))
+    ]
+
+
+class ReplaySource:
+    """Replay a staged traces CSV (or an in-memory frame) with pacing."""
+
+    def __init__(
+        self,
+        path_or_frame,
+        chunk_spans: int = 5000,
+        pace_seconds: float = 0.0,
+        rate: Optional[float] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if isinstance(path_or_frame, pd.DataFrame):
+            self._df = path_or_frame
+        else:
+            from ..io import load_traces_csv
+
+            self._df = load_traces_csv(path_or_frame)
+        self.chunk_spans = int(chunk_spans)
+        self.pace_seconds = float(pace_seconds)
+        self.rate = rate
+        self.sleep = sleep
+        self.sleeps: List[float] = []   # what pacing actually did (tests)
+
+    def __iter__(self) -> Iterator[pd.DataFrame]:
+        chunks = _sorted_chunks(self._df, self.chunk_spans)
+        for i, chunk in enumerate(chunks):
+            yield chunk
+            if i == len(chunks) - 1:
+                break
+            if self.rate:
+                # Event-time faithful pacing: sleep the event-time gap
+                # to the next chunk, compressed by ``rate``.
+                gap_s = (
+                    chunks[i + 1]["startTime"].iloc[0]
+                    - chunk["startTime"].iloc[-1]
+                ).total_seconds()
+                delay = max(0.0, gap_s / float(self.rate))
+            else:
+                delay = self.pace_seconds
+            if delay > 0:
+                self.sleeps.append(delay)
+                self.sleep(delay)
+
+
+class SyntheticSource:
+    """Paced synthetic timeline with injected fault windows."""
+
+    def __init__(
+        self,
+        n_windows: int,
+        faulted: Sequence[int],
+        synth_config=None,
+        chunk_spans: int = 4000,
+        pace_seconds: float = 0.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        from ..testing import SyntheticConfig, generate_timeline
+
+        cfg = synth_config or SyntheticConfig()
+        tl = generate_timeline(cfg, int(n_windows), list(faulted))
+        self.timeline = tl
+        self.normal = tl.normal                 # baseline seed dump
+        self.fault_pod_op = tl.fault_pod_op     # ground truth
+        self.window_faulted = tl.window_faulted
+        self._replay = ReplaySource(
+            tl.timeline,
+            chunk_spans=chunk_spans,
+            pace_seconds=pace_seconds,
+            sleep=sleep,
+        )
+
+    def __iter__(self) -> Iterator[pd.DataFrame]:
+        return iter(self._replay)
+
+
+class FileTailSource:
+    """Tail a growing traces CSV; yield only the newly appended rows."""
+
+    def __init__(
+        self,
+        path,
+        poll_seconds: float = 2.0,
+        idle_exit: int = 0,
+        max_polls: int = 0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.path = Path(path)
+        self.poll_seconds = float(poll_seconds)
+        self.idle_exit = int(idle_exit)
+        self.max_polls = int(max_polls)
+        self.sleep = sleep
+
+    def __iter__(self) -> Iterator[pd.DataFrame]:
+        from ..io import load_traces_csv
+        from ..pipeline.follow import TailTracker
+
+        tracker = TailTracker(idle_exit=self.idle_exit)
+        last_rows = 0
+        polls = 0
+        while True:
+            polls += 1
+            size = (
+                os.path.getsize(self.path) if self.path.exists() else -1
+            )
+            status = tracker.observe_size(size)
+            if tracker.rotated:
+                # The collector replaced the file: restart the row
+                # cursor with the re-read.
+                last_rows = 0
+            if status != "grew":
+                if status == "exit":
+                    log.info(
+                        "tail: no progress for %d polls; done",
+                        tracker.idle,
+                    )
+                    return
+                if self.max_polls and polls >= self.max_polls:
+                    return
+                self.sleep(self.poll_seconds)
+                continue
+            try:
+                df = load_traces_csv(self.path)
+            except (ValueError, OSError) as exc:
+                # Torn final line: error this poll, valid data the next
+                # (the tracker counts it toward idle_exit).
+                if tracker.parse_failed(exc) == "exit":
+                    return
+                if self.max_polls and polls >= self.max_polls:
+                    return
+                self.sleep(self.poll_seconds)
+                continue
+            tracker.parsed(size)
+            if len(df) > last_rows:
+                yield df.iloc[last_rows:]
+                last_rows = len(df)
+            if self.max_polls and polls >= self.max_polls:
+                return
+            self.sleep(self.poll_seconds)

@@ -1,0 +1,171 @@
+"""Event-time windower with watermarks (stream/ subsystem).
+
+The batch pipelines slice a FINISHED dump into windows after the fact
+(``window_spans`` over a static DataFrame); a continuous engine has to
+decide *when a window is complete* while spans are still arriving, out
+of order. The standard streaming answer — the one Flink/Beam-shaped
+trace pipelines use — is the watermark: the engine tracks the maximum
+span START time it has seen, subtracts an allowed-lateness bound, and
+declares every window whose end precedes that watermark CLOSED. Spans
+that arrive inside the bound still land in their (earlier) window;
+spans older than every window they belong to are dropped and counted
+(``microrank_stream_late_spans_total``) — bounded state, bounded
+reordering, explicit loss accounting.
+
+Windows are tumbling (slide == width, the batch runner's layout) or
+sliding (slide < width: each span lands in ceil(width/slide) windows).
+Closed windows emit IN ORDER of window start, including EMPTY windows
+(a silent gap in traffic is itself a signal worth journaling — and the
+engine must advance the incident lifecycle's healthy streak through it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+
+@dataclass
+class ClosedWindow:
+    """One window the watermark sealed: [start_us, end_us) event time."""
+
+    start_us: int
+    end_us: int
+    frame: Optional[pd.DataFrame]   # None for an empty window
+
+    @property
+    def n_spans(self) -> int:
+        return 0 if self.frame is None else len(self.frame)
+
+    @property
+    def start(self) -> str:
+        return str(pd.Timestamp(self.start_us * 1000))
+
+    @property
+    def end(self) -> str:
+        return str(pd.Timestamp(self.end_us * 1000))
+
+
+def _event_us(frame: pd.DataFrame) -> np.ndarray:
+    """Span event time (startTime) as int64 microseconds."""
+    return (
+        pd.to_datetime(frame["startTime"]).astype("int64").to_numpy()
+        // 1000
+    )
+
+
+class StreamWindower:
+    """Assign spans to event-time windows; close them at the watermark.
+
+    ``add(frame)`` buffers the batch's spans into their window(s) and
+    returns every window that CLOSED as a result (in start order);
+    ``flush()`` closes everything still open (end of stream). Window
+    boundaries align to the EPOCH (origin = first span's time floored to
+    a slide multiple — the Flink/Beam convention): boundaries are a pure
+    function of wall time, so restarts and replays produce identical
+    windows and a collector cutting dumps on round timestamps never
+    straddles them.
+    """
+
+    def __init__(
+        self,
+        width_us: int,
+        slide_us: Optional[int] = None,
+        lateness_us: int = 0,
+    ):
+        self.width_us = int(width_us)
+        self.slide_us = int(slide_us) if slide_us else self.width_us
+        if not 0 < self.slide_us <= self.width_us:
+            raise ValueError(
+                f"slide ({self.slide_us}) must be in (0, width="
+                f"{self.width_us}]"
+            )
+        self.lateness_us = max(0, int(lateness_us))
+        self.origin_us: Optional[int] = None
+        self.max_event_us: Optional[int] = None
+        self.dropped_late = 0
+        self._next = 0                       # next window index to emit
+        self._buffers: Dict[int, List[pd.DataFrame]] = {}
+
+    # ------------------------------------------------------------ intake
+    def add(self, frame: pd.DataFrame) -> List[ClosedWindow]:
+        """Buffer one span batch; return the windows it closed."""
+        if frame is None or len(frame) == 0:
+            return []
+        t = _event_us(frame)
+        if self.origin_us is None:
+            first = int(t.min())
+            # Index 0 is the EARLIEST epoch-aligned window that can hold
+            # the first span (overlap-1 slides back); tumbling reduces
+            # to flooring the first span to a width boundary.
+            n_overlap = -(-self.width_us // self.slide_us)
+            self.origin_us = (
+                first // self.slide_us - (n_overlap - 1)
+            ) * self.slide_us
+            self.max_event_us = first
+        rel = t - self.origin_us
+        base = np.floor_divide(rel, self.slide_us)
+        # A span at rel belongs to windows i = base-j (j = 0..overlap-1)
+        # with i*slide <= rel < i*slide + width. Window i has emitted iff
+        # i < _next, so a span whose NEWEST window (i = base) already
+        # emitted can land nowhere: it is late beyond the bound. (rel < 0
+        # — before the origin — floors base negative and lands here too.)
+        late = base < self._next
+        self.dropped_late += int(late.sum())
+        if late.any():
+            from ..obs.metrics import stream_late_spans
+
+            stream_late_spans().inc(float(late.sum()))
+        n_overlap = -(-self.width_us // self.slide_us)
+        for j in range(n_overlap):
+            i = base - j
+            ok = (
+                (i >= self._next)
+                & (rel - i * self.slide_us < self.width_us)
+            )
+            if not ok.any():
+                continue
+            sub = frame[ok]
+            i_ok = i[ok]
+            for idx in np.unique(i_ok):
+                self._buffers.setdefault(int(idx), []).append(
+                    sub[i_ok == idx]
+                )
+        self.max_event_us = max(self.max_event_us, int(t.max()))
+        return self._emit_closed()
+
+    # ---------------------------------------------------------- emission
+    def _window_bounds(self, i: int) -> Tuple[int, int]:
+        s = self.origin_us + i * self.slide_us
+        return s, s + self.width_us
+
+    def _pop_window(self, i: int) -> ClosedWindow:
+        s, e = self._window_bounds(i)
+        parts = self._buffers.pop(i, None)
+        frame = pd.concat(parts, ignore_index=True) if parts else None
+        return ClosedWindow(start_us=s, end_us=e, frame=frame)
+
+    def _emit_closed(self) -> List[ClosedWindow]:
+        if self.origin_us is None:
+            return []
+        watermark = self.max_event_us - self.lateness_us
+        out: List[ClosedWindow] = []
+        while self._window_bounds(self._next)[1] <= watermark:
+            out.append(self._pop_window(self._next))
+            self._next += 1
+        return out
+
+    def flush(self) -> List[ClosedWindow]:
+        """Close every remaining open window (end of stream)."""
+        out: List[ClosedWindow] = []
+        if self.origin_us is None:
+            return out
+        while self._buffers:
+            last = max(self._buffers)
+            while self._next <= last:
+                out.append(self._pop_window(self._next))
+                self._next += 1
+        return out

@@ -1,15 +1,21 @@
 from .synthetic import (
     SyntheticCase,
     SyntheticConfig,
+    SyntheticTimeline,
     Topology,
     generate_case,
     generate_case_with_spans,
+    generate_timeline,
+    generate_timeline_with_spans,
 )
 
 __all__ = [
     "SyntheticCase",
     "SyntheticConfig",
+    "SyntheticTimeline",
     "Topology",
     "generate_case",
     "generate_case_with_spans",
+    "generate_timeline",
+    "generate_timeline_with_spans",
 ]

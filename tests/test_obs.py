@@ -636,3 +636,46 @@ def test_metrics_http_server(registry):
         )
     finally:
         server.close()
+
+
+# ------------------------------------------------------- pad-waste audit
+
+
+def test_pad_waste_audit_vs_estimate_on_known_window():
+    """The staged_pad_bytes metric now AUDITS actual staged leaf shapes
+    (graph_staging_audit) instead of estimating from mean live
+    fractions; regression-compare the two on a known window."""
+    from microrank_tpu.detect import compute_slo, detect_partition
+    from microrank_tpu.graph.build import build_window_graph
+    from microrank_tpu.obs.metrics import (
+        graph_staging_audit,
+        graph_staging_stats,
+    )
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=150, seed=3)
+    )
+    vocab, baseline = compute_slo(case.normal)
+    cfg = MicroRankConfig()
+    flag, nrm, abn = detect_partition(cfg, vocab, baseline, case.abnormal)
+    assert flag and nrm and abn
+    graph, _, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, pad_policy="pow2", aux="all"
+    )
+    total_e, pad_e = graph_staging_stats(graph)
+    total_a, pad_a = graph_staging_audit(graph)
+    # Same staged leaves, so identical totals; both see real pow2 waste.
+    assert total_a == total_e
+    assert 0 < pad_e < total_e and 0 < pad_a < total_a
+    # The audit counts the bitmaps' op-ROW waste (padded vocab rows
+    # beyond n_ops) that the estimate folds at the last-axis ratio only,
+    # and the indptrs' true live+1 offsets; the two agree within the
+    # estimate's error band but are NOT the same number.
+    assert pad_a == pytest.approx(pad_e, rel=0.6)
+    assert pad_a != pad_e
+    # The audit follows what is ACTUALLY staged: stripping the fields
+    # the packed kernel never reads (device_subset) shrinks the report.
+    from microrank_tpu.rank_backends.jax_tpu import device_subset
+
+    total_s, pad_s = graph_staging_audit(device_subset(graph, "packed"))
+    assert total_s < total_a and pad_s < pad_a

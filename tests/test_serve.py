@@ -468,3 +468,69 @@ def test_serve_cli_sigterm_drains(tmp_path):
     assert events[0]["event"] == "run_start"
     assert events[-1]["event"] == "run_end"
     assert any(e["event"] == "serve_batch" for e in events)
+
+
+# ------------------------------------------------- build pool + warmup
+
+
+def test_builds_run_off_scheduler_thread(case, spans_payload, registry):
+    """Satellite: the scheduler routes host graph builds through the
+    shared build worker pool (stream.pool), so request-path builds
+    overlap device dispatch instead of serializing on the scheduler
+    thread."""
+    svc = _service(case, max_wait_ms=50.0)
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        status, body, _ = _post(port, spans_payload)
+        assert status == 200 and body["ranking"]
+        assert svc.build_pool is not None
+        assert svc.build_pool.builds >= 1
+        # Every build ran on a pool worker, never the scheduler thread.
+        assert svc.scheduler.ident not in svc.build_pool.build_threads
+    finally:
+        handle.stop()
+
+
+def test_serial_builds_without_pool_still_serve(
+    case, spans_payload, registry
+):
+    svc = _service(case, max_wait_ms=50.0, build_workers=0)
+    assert svc.build_pool is None
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        status, body, _ = _post(port, spans_payload)
+        assert status == 200 and body["ranking"]
+    finally:
+        handle.stop()
+
+
+def test_warmup_occupancies_configurable(case, registry):
+    svc = _service(
+        case,
+        warmup=True,
+        warmup_occupancies=(1,),
+        max_batch_windows=4,
+    )
+    svc.start()
+    try:
+        # Exactly one warmup dispatch (occupancy 1) instead of the old
+        # hardcoded {1, 2}.
+        assert svc.scheduler.batcher.dispatches == 1
+    finally:
+        svc.shutdown()
+
+
+def test_warmup_occupancies_validated_against_max_batch(case, registry):
+    svc = _service(
+        case,
+        warmup=True,
+        warmup_occupancies=(1, 9),
+        max_batch_windows=4,
+    )
+    with pytest.raises(ValueError, match="warmup_occupancies"):
+        svc.start()
+    svc.shutdown()

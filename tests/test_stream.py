@@ -1,0 +1,496 @@
+"""Streaming RCA engine (stream/): event-time windower edge cases
+(out-of-order within lateness, late-drop counting, empty windows,
+sliding overlap), online SLO baselines (EW moments, P^2 quantiles,
+freeze semantics), incident lifecycle (tie-aware fingerprints, dedup,
+resolve, cooldown suppression), the build worker pool, and the
+end-to-end acceptance run: a synthetic paced source with one injected
+fault window ranks ONLY abnormal windows (gated dispatches < windows),
+opens exactly one fingerprint-deduped incident with the fault in its
+top-5, and resolves it after recovery. All on CPU jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from microrank_tpu.config import MicroRankConfig, StreamConfig
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.stream import (
+    BuildWorkerPool,
+    FileTailSource,
+    IncidentTracker,
+    OnlineBaseline,
+    P2Quantile,
+    ReplaySource,
+    StreamEngine,
+    StreamWindower,
+    SyntheticSource,
+    WebhookIncidentSink,
+    ranking_fingerprint,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+T0 = pd.Timestamp("2025-03-01 00:00:00")
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+def _spans(*offsets_s, tag="s"):
+    """Minimal span frame for windower tests: startTime only matters."""
+    return pd.DataFrame(
+        {
+            "traceID": [f"{tag}{i}" for i in range(len(offsets_s))],
+            "startTime": [
+                T0 + pd.Timedelta(seconds=o) for o in offsets_s
+            ],
+            "off": list(offsets_s),
+        }
+    )
+
+
+# ----------------------------------------------------------- windower
+
+
+def test_windower_tumbling_closes_in_order(registry):
+    w = StreamWindower(width_us=60_000_000)
+    first = w.add(_spans(10, 70))
+    # Watermark 70 seals the epoch-aligned minute window [0,60) only.
+    assert [c.start_us for c in first] == [int(T0.value // 1000)]
+    assert sorted(first[0].frame["off"]) == [10]
+    closed = w.add(_spans(130))
+    assert [c.start_us for c in closed] == [
+        int(T0.value // 1000) + 60_000_000,
+    ]
+    assert sorted(closed[0].frame["off"]) == [70]
+    assert w.dropped_late == 0
+
+
+def test_windower_out_of_order_within_lateness_lands_in_window(registry):
+    w = StreamWindower(width_us=60_000_000, lateness_us=30_000_000)
+    assert w.add(_spans(10, 80)) == []      # watermark 50: [0,60) open
+    assert w.add(_spans(50, tag="late")) == []   # out of order, in bound
+    closed = w.add(_spans(200))
+    assert sorted(closed[0].frame["off"]) == [10, 50]
+    assert w.dropped_late == 0
+
+
+def test_windower_late_past_watermark_increments_dropped(registry):
+    w = StreamWindower(width_us=60_000_000)
+    w.add(_spans(10))
+    w.add(_spans(130))                       # seals [0,60) and [60,120)
+    closed = w.add(_spans(30, tag="late"))   # window long gone
+    assert closed == []
+    assert w.dropped_late == 1
+    assert (
+        registry.get("microrank_stream_late_spans_total").value() == 1
+    )
+    # The late span is nowhere: flush yields only the live window.
+    left = w.flush()
+    assert [sorted(c.frame["off"]) for c in left if c.frame is not None] == [
+        [130]
+    ]
+
+
+def test_windower_emits_empty_windows_through_gaps(registry):
+    w = StreamWindower(width_us=60_000_000)
+    w.add(_spans(10))
+    closed = w.add(_spans(400))              # gap: minutes 1..5 empty
+    assert len(closed) == 6
+    assert closed[0].n_spans == 1
+    assert all(c.n_spans == 0 for c in closed[1:])
+    assert all(c.frame is None for c in closed[1:])
+
+
+def test_windower_sliding_span_lands_in_overlapping_windows(registry):
+    w = StreamWindower(width_us=120_000_000, slide_us=60_000_000)
+    w.add(_spans(70))
+    closed = w.flush()
+    hits = [c for c in closed if c.n_spans]
+    # [0,120) and [60,180) both hold the span.
+    assert [c.start_us for c in hits] == [
+        int(T0.value // 1000),
+        int(T0.value // 1000) + 60_000_000,
+    ]
+
+
+# ----------------------------------------------------------- baseline
+
+
+def test_p2_quantile_tracks_numpy():
+    rng = np.random.default_rng(0)
+    xs = rng.lognormal(mean=1.0, sigma=0.6, size=5000)
+    p2 = P2Quantile(0.9)
+    for x in xs:
+        p2.update(x)
+    exact = float(np.quantile(xs, 0.9))
+    assert abs(p2.value() - exact) / exact < 0.05
+
+
+def _op_frame(dur_ms, op="opA", n=20, tag="t"):
+    return pd.DataFrame(
+        {
+            "traceID": [f"{tag}{i}" for i in range(n)],
+            "serviceName": ["svcA"] * n,
+            "operationName": [op] * n,
+            "duration": [int(dur_ms * 1000)] * n,
+            "startTime": [T0] * n,
+            "endTime": [T0] * n,
+        }
+    )
+
+
+def test_online_baseline_updates_decay_and_freeze():
+    ob = OnlineBaseline(decay=0.5, min_windows=1)
+    ob.update(_op_frame(100.0))
+    vocab, base = ob.snapshot()
+    assert vocab.name(0) == "svcA_opA"
+    assert base.mean_ms[0] == pytest.approx(100.0)
+    ob.freeze()
+    assert not ob.update(_op_frame(900.0))   # frozen: no poisoning
+    _, base2 = ob.snapshot()
+    assert base2.mean_ms[0] == pytest.approx(100.0)
+    ob.thaw()
+    ob.update(_op_frame(900.0))
+    _, base3 = ob.snapshot()
+    # EW with decay 0.5: halfway toward the new window mean.
+    assert base3.mean_ms[0] == pytest.approx(500.0)
+    assert ob.n_frozen_skips == 1
+
+
+def test_online_baseline_seed_matches_batch_slo():
+    from microrank_tpu.detect import compute_slo
+
+    case = generate_case(
+        SyntheticConfig(n_operations=16, n_traces=120, seed=4)
+    )
+    ob = OnlineBaseline(decay=0.2)
+    ob.seed(case.normal)
+    assert ob.ready
+    vocab, base = ob.snapshot()
+    bvocab, bbase = compute_slo(case.normal)
+    assert vocab.names == bvocab.names
+    np.testing.assert_allclose(
+        base.mean_ms, bbase.mean_ms, rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        base.std_ms, bbase.std_ms, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_online_baseline_percentile_stat():
+    ob = OnlineBaseline(decay=0.5, slo_stat="p90")
+    rng = np.random.default_rng(1)
+    n = 400
+    frame = _op_frame(1.0, n=n)
+    dur_ms = rng.lognormal(mean=2.0, sigma=0.5, size=n)
+    frame["duration"] = (dur_ms * 1000).astype(int)
+    ob.update(frame)
+    _, base = ob.snapshot()
+    exact = float(np.quantile(frame["duration"] / 1000.0, 0.9))
+    assert abs(base.mean_ms[0] - exact) / exact < 0.15
+
+
+# ---------------------------------------------------------- incidents
+
+
+def test_ranking_fingerprint_expands_exact_ties():
+    ranking = [
+        ("a", 1.0), ("b", 0.9), ("c", 0.5), ("d", 0.5), ("e", 0.5),
+        ("f", 0.4),
+    ]
+    assert ranking_fingerprint(ranking, 3) == frozenset("abcde")
+    assert ranking_fingerprint(ranking, 6) == frozenset("abcdef")
+    assert ranking_fingerprint([], 5) == frozenset()
+
+
+def test_incident_tracker_open_update_resolve_cooldown(registry):
+    events = []
+
+    class Sink:
+        def emit(self, e):
+            events.append(e)
+
+    tr = IncidentTracker(
+        top_k=3, resolve_after=2, cooldown_windows=2, sinks=[Sink()]
+    )
+    rank = [("a", 1.0), ("b", 0.8), ("c", 0.6)]
+    inc = tr.observe_ranked("w1", rank)
+    assert inc is not None and tr.has_open and tr.opened == 1
+    # Consecutive window, same fingerprint: dedup into the SAME incident.
+    assert tr.observe_ranked("w2", rank).incident_id == inc.incident_id
+    assert tr.opened == 1 and inc.windows == 2
+    # One healthy window is not enough to resolve.
+    assert tr.observe_healthy("w3") == []
+    assert tr.has_open
+    resolved = tr.observe_healthy("w4")
+    assert [i.incident_id for i in resolved] == [inc.incident_id]
+    assert not tr.has_open and tr.resolved == 1
+    # Re-flag inside the cooldown: suppressed, not reopened.
+    assert tr.observe_ranked("w5", rank) is None
+    assert tr.suppressed == 1 and tr.opened == 1
+    # Past the cooldown: a fresh incident opens.
+    tr.observe_healthy("w6")
+    tr.observe_healthy("w7")
+    inc2 = tr.observe_ranked("w8", rank)
+    assert inc2 is not None and inc2.incident_id != inc.incident_id
+    kinds = [e["event"] for e in events]
+    assert kinds == [
+        "incident_open", "incident_update", "incident_resolve",
+        "incident_open",
+    ]
+
+
+def test_incident_tracker_jaccard_dedups_tail_wobble(registry):
+    tr = IncidentTracker(top_k=5, resolve_after=2, jaccard=0.5)
+    inc = tr.observe_ranked(
+        "w1", [("a", 1.0), ("b", 0.9), ("c", 0.8), ("d", 0.7), ("e", 0.6)]
+    )
+    # Same fault, wobbled tail: 4/6 Jaccard overlap -> same incident.
+    same = tr.observe_ranked(
+        "w2", [("a", 1.0), ("b", 0.9), ("c", 0.8), ("d", 0.7), ("x", 0.6)]
+    )
+    assert same.incident_id == inc.incident_id
+    # A disjoint suspect set is a DIFFERENT incident.
+    other = tr.observe_ranked(
+        "w3", [("p", 1.0), ("q", 0.9), ("r", 0.8), ("s", 0.7), ("t", 0.6)]
+    )
+    assert other.incident_id != inc.incident_id
+    assert tr.opened == 2
+
+
+def test_webhook_sink_counts_failures_without_raising():
+    sink = WebhookIncidentSink(
+        "http://127.0.0.1:9/nope", timeout=0.2
+    )
+    sink.emit({"event": "incident_open", "top": []})
+    assert sink.failures == 1
+
+
+# --------------------------------------------------------- build pool
+
+
+def test_build_pool_runs_off_caller_thread(registry):
+    pool = BuildWorkerPool(workers=2)
+    try:
+        fut = pool.submit(lambda: threading.get_ident())
+        ident = fut.result(timeout=30)
+        assert ident != threading.get_ident()
+        assert ident in pool.build_threads
+        boom = pool.submit(lambda: 1 / 0)
+        assert isinstance(
+            boom.exception(timeout=30), ZeroDivisionError
+        )
+        assert pool.inflight == 0 and pool.builds == 2
+    finally:
+        pool.shutdown()
+
+
+# ------------------------------------------------------------ sources
+
+
+def test_replay_source_chunks_in_event_order_and_paces():
+    sleeps = []
+    df = _spans(30, 10, 20, 40)
+    src = ReplaySource(
+        df, chunk_spans=2, pace_seconds=0.5, sleep=sleeps.append
+    )
+    chunks = list(src)
+    assert [list(c["off"]) for c in chunks] == [[10, 20], [30, 40]]
+    assert sleeps == [0.5]
+
+
+def test_file_tail_source_yields_only_new_rows(tmp_path, registry):
+    case = generate_case(
+        SyntheticConfig(n_operations=10, n_traces=40, seed=2)
+    )
+    df = case.normal
+    csv = tmp_path / "grow.csv"
+    half = len(df) // 2
+    df.iloc[:half].to_csv(csv, index=False)
+    batches = []
+    src = FileTailSource(csv, poll_seconds=0, max_polls=3, sleep=lambda s: None)
+    it = iter(src)
+    batches.append(next(it))
+    df.iloc[half:].to_csv(csv, mode="a", header=False, index=False)
+    batches.append(next(it))
+    assert len(batches[0]) == half
+    assert len(batches[1]) == len(df) - half
+    assert registry.get("microrank_follow_polls_total").value() >= 2
+
+
+# ------------------------------------------------------------- engine
+
+
+def _engine_config(**stream_kw):
+    stream_kw.setdefault("allowed_lateness_seconds", 5.0)
+    return MicroRankConfig(stream=StreamConfig(**stream_kw))
+
+
+def test_stream_engine_acceptance_gated_incident_lifecycle(
+    registry, tmp_path
+):
+    """Acceptance: paced synthetic source, one injected fault window ->
+    only abnormal windows rank (gated dispatches < windows), exactly one
+    fingerprint-deduped incident opens with the fault op in its top-5,
+    and it resolves after recovery."""
+    src = SyntheticSource(
+        n_windows=8,
+        faulted=[3],
+        synth_config=SyntheticConfig(
+            n_operations=24, n_traces=200, n_kinds=16, seed=5
+        ),
+        pace_seconds=0.01,
+        sleep=lambda s: None,
+    )
+    eng = StreamEngine(_engine_config(), src, out_dir=tmp_path)
+    s = eng.run()
+    assert s.windows == 8
+    assert s.ranked == 1 and s.dispatches == 1
+    assert s.clean == 7 and s.warmup == 0       # seeded baseline
+    assert s.late_spans == 0
+    assert s.incidents_opened == 1 and s.incidents_resolved == 1
+    # The gate in /metrics: dispatch counter < window counter.
+    dispatches = registry.get(
+        "microrank_stream_dispatches_total"
+    ).value()
+    windows = sum(
+        smp["value"]
+        for smp in registry.get(
+            "microrank_stream_windows_total"
+        ).samples()
+    )
+    assert dispatches == 1 and dispatches < windows == 8
+    # Incident log: one open with the fault in its top-5, one resolve.
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "incidents.jsonl")
+        .read_text()
+        .splitlines()
+    ]
+    assert [e["event"] for e in events] == [
+        "incident_open", "incident_resolve",
+    ]
+    top5 = [n for n, _ in events[0]["top"][:5]]
+    assert src.fault_pod_op in top5
+    assert events[0]["incident_id"] == events[1]["incident_id"]
+    # Journal: run envelopes, one window event per window, incidents.
+    from microrank_tpu.obs import read_journal
+
+    jev = read_journal(tmp_path / "journal.jsonl")
+    assert jev[0]["event"] == "run_start"
+    assert jev[0]["pipeline"] == "stream"
+    assert len([e for e in jev if e["event"] == "window"]) == 8
+    assert any(e["event"] == "incident_open" for e in jev)
+    assert jev[-1]["event"] == "run_end"
+    assert jev[-1]["dispatches"] == 1
+    # Metrics snapshot written for offline `cli stats`.
+    assert (tmp_path / "metrics.json").exists()
+    # Ranked window results landed in the normal sink too.
+    assert (tmp_path / "windows.jsonl").exists()
+
+
+def test_stream_engine_empty_window_journals_without_dispatch(
+    registry, tmp_path
+):
+    case = generate_case(
+        SyntheticConfig(n_operations=12, n_traces=100, seed=6)
+    )
+    # Two clean windows with a one-window gap between them.
+    shifted = case.normal.copy()
+    for col in ("startTime", "endTime"):
+        shifted[col] = shifted[col] + pd.Timedelta(minutes=10)
+    shifted["traceID"] = "g" + shifted["traceID"].astype(str)
+    frames = pd.concat(
+        [case.normal, shifted], ignore_index=True
+    )
+    eng = StreamEngine(
+        _engine_config(),
+        ReplaySource(frames, chunk_spans=100_000),
+        out_dir=tmp_path,
+        normal_df=case.normal,
+    )
+    s = eng.run()
+    assert s.empty == 1 and s.dispatches == 0 and s.ranked == 0
+    from microrank_tpu.obs import read_journal
+
+    empties = [
+        e
+        for e in read_journal(tmp_path / "journal.jsonl")
+        if e["event"] == "window"
+        and e.get("skipped_reason") == "empty_window"
+    ]
+    assert len(empties) == 1
+    assert (
+        registry.get("microrank_stream_windows_total").value(
+            outcome="empty"
+        )
+        == 1
+    )
+    assert registry.get("microrank_stream_dispatches_total").value() == 0
+
+
+def test_stream_engine_cold_start_warms_baseline(registry, tmp_path):
+    case = generate_case(
+        SyntheticConfig(n_operations=12, n_traces=100, seed=8)
+    )
+    eng = StreamEngine(
+        _engine_config(min_healthy_windows=1),
+        ReplaySource(case.normal, chunk_spans=100_000),
+        out_dir=tmp_path,
+    )
+    s = eng.run()
+    # Unseeded: the first window feeds the baseline instead of detecting.
+    assert s.warmup == 1 and s.dispatches == 0
+    assert eng.baseline.ready
+
+
+# ---------------------------------------------------------- CLI smoke
+
+
+def test_stream_cli_smoke(tmp_path):
+    out_dir = tmp_path / "stream_out"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).parent.parent),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "microrank_tpu.cli", "stream",
+            "--source", "synthetic",
+            "--windows", "6", "--fault-windows", "2",
+            "--operations", "16", "--traces", "120", "--kinds", "12",
+            "--seed", "9", "--lateness-seconds", "5",
+            "-o", str(out_dir),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stream done" in proc.stderr or "stream done" in proc.stdout
+    events = [
+        json.loads(line)
+        for line in (out_dir / "incidents.jsonl")
+        .read_text()
+        .splitlines()
+    ]
+    kinds = [e["event"] for e in events]
+    assert "incident_open" in kinds and "incident_resolve" in kinds
+    assert (out_dir / "metrics.json").exists()
+    assert (out_dir / "journal.jsonl").exists()
