@@ -33,7 +33,11 @@ staging-excluded methodology; it is always measured and reported as
 "staging_ms" either way; both modes stage once outside the repeat loop,
 at the same pipeline boundary). BENCH_BLOB=0 replaces the default
 single-buffer blob staging (one transfer) with per-leaf device_put
-(~50 RPC round trips on the tunneled runtime).
+(~50 RPC round trips on the tunneled runtime). Replay presets also run
+the adaptive-router replay (PR 5; BENCH_ROUTER=0 skips): group-wise
+dispatch through dispatch.DispatchRouter with double-buffered staging —
+the artifact gains "route" (vmapped/sharded), "overlap_ms" (staging
+hidden behind rank) and a "router" block with ms/window.
 Details go to stderr; stdout carries only the JSON line.
 
 Reference baseline context: the reference's PageRank Scorer takes 5.5 s
@@ -762,6 +766,114 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
     }
 
 
+def _run_router(cfg, spans_per_window, n_ops, fault_ms, n_windows):
+    """Adaptive-router replay (PR 5): the same per-window graphs the
+    batched mode builds, dispatched GROUP-wise through the shared
+    DispatchRouter with double-buffered staging — group i+1's blob pack
+    + H2D transfer overlaps group i's device execution, so staging_ms
+    leaves the critical path. Produces the artifact's ``route`` /
+    ``overlap_ms`` columns; ms/window here is the number to hold
+    against BENCH_r05's 82 ms replay (where staging was additive)."""
+    import numpy as np
+
+    from microrank_tpu.detect.detector import _thresholds
+    from microrank_tpu.dispatch import DispatchRouter
+    from microrank_tpu.graph.build import aux_for_kernel
+    from microrank_tpu.graph.table_ops import (
+        build_window_graph_from_table,
+        compute_slo_from_table,
+        detect_window_partition,
+    )
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.rank_backends.jax_tpu import (
+        choose_kernel,
+        device_subset,
+    )
+
+    case_dir, truth = _ensure_batch_data(
+        spans_per_window * n_windows, n_ops, fault_ms, n_windows
+    )
+    normal = load_span_table(case_dir / "normal.csv")
+    table = load_span_table(case_dir / "abnormal.csv")
+    slo_vocab, baseline = compute_slo_from_table(normal)
+    kernel = os.environ.get("BENCH_KERNEL", "auto")
+    w_us = int(truth["window_minutes"] * 60e6)
+    start = int(truth["start_us"])
+    edges = [start + b * w_us for b in range(n_windows + 1)]
+    thresh = _thresholds(baseline, cfg.detector)
+    remap = slo_vocab.encode(table.svc_op_names).astype(np.int32)
+    graphs, spans_used = [], 0
+    for b in range(n_windows):
+        m, nrm, abn, _, rng = detect_window_partition(
+            table, edges[b], edges[b + 1], slo_vocab, baseline,
+            cfg.detector, remap=remap, thresh=thresh, with_range=True,
+        )
+        if not (len(nrm) and len(abn)):
+            continue
+        g, _, _, _ = build_window_graph_from_table(
+            table, m, nrm, abn, aux=aux_for_kernel(kernel),
+            collapse=_collapse_mode(), row_range=rng,
+        )
+        graphs.append(g)
+        spans_used += int(m.sum())
+    if not graphs:
+        log("router replay: no window partitioned; skipping")
+        return None
+    resolved = (
+        kernel
+        if kernel != "auto"
+        else choose_kernel(graphs[0], prefer_bf16=_prefer_bf16())
+    )
+    graphs = [device_subset(g, resolved) for g in graphs]
+    group_n = max(1, int(os.environ.get("BENCH_DISPATCH_BATCH", 4)))
+    groups = [
+        graphs[i : i + group_n] for i in range(0, len(graphs), group_n)
+    ]
+    router = DispatchRouter(cfg)
+
+    def drive():
+        infos = []
+        for i, gr in enumerate(groups):
+            nxt = (
+                (groups[i + 1], resolved)
+                if i + 1 < len(groups)
+                else None
+            )
+            _, info = router.rank_batch(
+                gr, resolved, next_batch=nxt, record=False
+            )
+            infos.append(info)
+        return infos
+
+    drive()  # warm pass: compiles every group occupancy outside the timer
+    t0 = time.perf_counter()
+    infos = drive()
+    total_s = time.perf_counter() - t0
+    overlap_ms = sum(i.overlap_ms for i in infos)
+    routes = sorted({i.route for i in infos})
+    ms_per_window = total_s * 1e3 / len(graphs)
+    log(
+        f"router replay: {len(graphs)} windows in {len(groups)} "
+        f"group dispatches ({group_n}/group, route {routes}) in "
+        f"{total_s * 1e3:.0f}ms -> {ms_per_window:.0f} ms/window; "
+        f"{overlap_ms:.0f}ms of staging overlapped with rank"
+    )
+    return {
+        "route": routes[0] if len(routes) == 1 else routes,
+        "overlap_ms": round(overlap_ms, 1),
+        "router": {
+            "windows": len(graphs),
+            "dispatches": len(groups),
+            "group_windows": group_n,
+            "kernel": resolved,
+            "routes": routes,
+            "ms_per_window": round(ms_per_window, 1),
+            "overlap_ms": round(overlap_ms, 1),
+            "spans_per_sec": round(spans_used / total_s, 1),
+        },
+    }
+
+
 def main() -> int:
     config_key = os.environ.get("BENCH_CONFIG", "5")
     preset = CONFIG_PRESETS.get(config_key)
@@ -1111,6 +1223,18 @@ def main() -> int:
             result["vs_baseline"] = round(
                 rep["replay_spans_per_sec"] / oracle_sps, 2
             )
+        # Router-driven replay: route + overlap columns (double-buffered
+        # staging overlapping rank — BENCH_ROUTER=0 skips).
+        if os.environ.get("BENCH_ROUTER", "1") != "0":
+            try:
+                routed = _run_router(
+                    cfg, spans_target, n_ops, fault_ms, replay_n
+                )
+            except Exception as exc:  # diagnostics must not eat the metric
+                log(f"router replay failed ({exc!r}); continuing")
+                routed = None
+            if routed is not None:
+                result.update(routed)
 
     print(json.dumps(result))
     return 0

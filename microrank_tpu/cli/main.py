@@ -50,6 +50,25 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help="reproduce the reference code exactly, documented quirks "
         "included (partition swap, overwritten result.csv)",
     )
+    p.add_argument(
+        "--compile-cache-dir", default=None,
+        help="persistent XLA compilation cache directory (compiled rank "
+        "programs reload across process restarts instead of re-paying "
+        "the ~1.7s first-call compile; default ~/.cache/microrank_tpu/"
+        "jit, MICRORANK_JIT_CACHE env overrides)",
+    )
+    p.add_argument(
+        "--sharded-threshold-mb", type=float, default=None,
+        help="dispatch router size threshold: batches whose staged "
+        "device footprint reaches this many MB route to the sharded "
+        "mesh path (needs --mesh; default 64)",
+    )
+    p.add_argument(
+        "--coalesce-windows", type=_positive_int, default=None,
+        help="dispatch router burst coalescing: same-pad-bucket stream "
+        "windows queued behind an in-flight dispatch coalesce into one "
+        "vmapped program, up to this many (1 disables; default 8)",
+    )
     p.add_argument("--config-json", help="load a full MicroRankConfig dict")
 
 
@@ -77,6 +96,7 @@ def _config_from_args(args) -> "MicroRankConfig":
     from ..config import (
         CompatConfig,
         DetectorConfig,
+        DispatchConfig,
         MicroRankConfig,
         PageRankConfig,
         RuntimeConfig,
@@ -87,7 +107,20 @@ def _config_from_args(args) -> "MicroRankConfig":
     if args.config_json:
         with open(args.config_json) as f:
             return MicroRankConfig.from_dict(json.load(f))
+    dispatch_overrides = {
+        k: v
+        for k, v in {
+            "sharded_bytes_threshold": (
+                int(args.sharded_threshold_mb * (1 << 20))
+                if getattr(args, "sharded_threshold_mb", None) is not None
+                else None
+            ),
+            "coalesce_windows": getattr(args, "coalesce_windows", None),
+        }.items()
+        if v is not None
+    }
     cfg = MicroRankConfig(
+        dispatch=DispatchConfig(**dispatch_overrides),
         detector=DetectorConfig(
             k_sigma=args.k_sigma,
             slack_ms=args.slack_ms,
@@ -134,6 +167,9 @@ def _config_from_args(args) -> "MicroRankConfig":
                     ),
                     "dispatch_batch_windows": getattr(
                         args, "dispatch_batch_windows", None
+                    ),
+                    "compile_cache_dir": getattr(
+                        args, "compile_cache_dir", None
                     ),
                 }.items()
                 if v is not None
@@ -273,6 +309,8 @@ def cmd_run(args) -> int:
             )
 
     cfg = _config_from_args(args)
+    if cfg.runtime.compile_cache_dir:
+        _enable_jit_cache(cfg.runtime)  # re-point at the configured dir
     if getattr(args, "metrics_port", None) is not None and primary:
         from ..obs.server import start_metrics_server
 
@@ -556,6 +594,7 @@ def cmd_stream(args) -> int:
             "cooldown_windows": args.cooldown,
             "fingerprint_top_k": args.fingerprint_top_k,
             "build_workers": args.build_workers,
+            "pipeline_windows": args.pipeline_windows,
             "webhook_url": args.webhook,
             "max_windows": args.max_windows,
         }.items()
@@ -959,6 +998,13 @@ def main(argv=None) -> int:
         help="disable numpy_ref degradation: failed batches answer 500",
     )
     p_srv.add_argument(
+        "--mesh",
+        help='device mesh for the dispatch router\'s sharded route: "8" '
+        'or "2x4" — batches past --sharded-threshold-mb (or filling the '
+        "windows axis) rank via shard_map instead of the single-device "
+        "vmapped program",
+    )
+    p_srv.add_argument(
         "--inject-dispatch-failures", type=int, default=None,
         help="chaos/test knob: fail this many device dispatches with "
         "an injected error (drives the degradation path)",
@@ -1032,6 +1078,18 @@ def main(argv=None) -> int:
     )
     p_stream.add_argument(
         "--webhook", help="POST every incident transition here (JSON)"
+    )
+    p_stream.add_argument(
+        "--pipeline-windows", type=_positive_int, default=None,
+        help="abnormal windows in flight (build submitted, rank "
+        "pending) before the engine ranks the head — also the burst "
+        "depth available to the router's coalescing",
+    )
+    p_stream.add_argument(
+        "--mesh",
+        help='device mesh for the dispatch router\'s sharded route: "8" '
+        'or "2x4" — windows past --sharded-threshold-mb rank via '
+        "shard_map instead of the single-device program",
     )
     p_stream.add_argument(
         "--max-windows", type=int, default=None,
@@ -1196,39 +1254,19 @@ def main(argv=None) -> int:
     return args.fn(args)
 
 
-def _enable_jit_cache() -> None:
+def _enable_jit_cache(runtime=None) -> None:
     """Persist compiled XLA programs across CLI invocations (first TPU
     compile is seconds; cached reloads are near-instant — a second
     process on the same config reports compile_ms ~ 0, see
     tests/test_pipeline.py::test_persistent_compile_cache_across_processes).
+    One wiring point since PR 5: dispatch.cache.configure_compile_cache
+    (MICRORANK_JIT_CACHE env > RuntimeConfig.compile_cache_dir /
+    --compile-cache-dir > the user-cache default; min-compile-time and
+    min-entry-size gates zeroed so windows-shaped programs and CPU runs
+    persist too)."""
+    from ..dispatch import configure_compile_cache
 
-    The min-compile-time/min-entry-size gates are zeroed: jax's
-    defaults only persist compilations slower than 1 s, which would
-    skip most of this framework's windows-shaped programs and every
-    CPU run."""
-    import os
-
-    try:
-        import jax
-
-        cache_dir = os.environ.get(
-            "MICRORANK_JIT_CACHE",
-            os.path.join(
-                os.path.expanduser("~"), ".cache", "microrank_tpu", "jit"
-            ),
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        for knob, value in (
-            ("jax_persistent_cache_min_compile_time_secs", 0.0),
-            ("jax_persistent_cache_min_entry_size_bytes", 0),
-        ):
-            try:
-                jax.config.update(knob, value)
-            except AttributeError:  # older jax without the knob
-                pass
-    except Exception:  # pragma: no cover - cache is best-effort
-        pass
+    configure_compile_cache(runtime)
 
 
 if __name__ == "__main__":

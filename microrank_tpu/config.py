@@ -71,6 +71,20 @@ class PageRankConfig:
     # fewest power-of-two blocks that fit). Static under jit (part of
     # the config cache key), so changing it recompiles correctly.
     packed_block_bytes: int = 128 << 20
+    # Entry-sharded (coo/csr/pallas) cross-shard combine: True replaces
+    # the plain psum of the dense SpMV partials with a compensated
+    # all-gather TwoSum fold (ops.segment.compensated_psum). Evaluated
+    # for the ROADMAP compensated-scan item (PR 5) and left OFF: unlike
+    # the csr prefix scan — where a plain cumsum rounded value-identical
+    # rows differently WITHIN one program and deterministically flipped
+    # exact ties — the sharded combine's reassociation is dominated by
+    # the per-shard partials' own f32 rounding, which no combine-order
+    # fix can recover (measured on the 4-window CPU-mesh batch: worst
+    # relative score drift 1.7e-6 plain vs 1.66e-6 compensated, both
+    # well inside the tie-aware tolerance the cross-shard parity
+    # regression test pins). Kept as an opt-in for shard-count-
+    # invariance experiments; costs S x the collective bytes.
+    compensated_psum: bool = False
 
 
 @dataclass(frozen=True)
@@ -249,6 +263,60 @@ class RuntimeConfig:
     # (round 3: 5 MB staged in 1,675 ms of pure latency). The sharded
     # path ignores this (shards need per-device placement).
     blob_staging: bool = True
+    # Persistent XLA compilation cache directory (jax_compilation_cache_dir).
+    # None resolves MICRORANK_JIT_CACHE, else ~/.cache/microrank_tpu/jit —
+    # the CLI default since PR 5. First-call compile of the fused rank
+    # program costs ~1.7 s per process cold (BENCH_r05); a warm restart
+    # reloads it in milliseconds. dispatch.cache.configure_compile_cache
+    # is the one wiring point (CLI, serve, stream, bench all call it).
+    compile_cache_dir: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class DispatchConfig:
+    """Adaptive dispatch router knobs (``dispatch/`` subsystem).
+
+    Serve's scheduler and stream's engine both hand prepared window
+    graphs to one shared DispatchRouter, which (a) routes by size —
+    batches whose staged device footprint crosses
+    ``sharded_bytes_threshold`` (or whose occupancy fills the mesh's
+    windows axis) go to ``parallel.rank_windows_sharded``, small ones
+    keep the vmapped single-device program; (b) coalesces same-bucket
+    stream windows queued behind an in-flight dispatch into one vmapped
+    program; (c) double-buffers staging so the next batch's H2D
+    transfer overlaps the current batch's device execution.
+    """
+
+    # Route a batch to the sharded mesh path once its post-device_subset
+    # staged footprint reaches this many bytes (and a mesh is
+    # configured + the kernel is shard-capable). 0 shards everything a
+    # mesh can take; a huge value keeps everything vmapped.
+    sharded_bytes_threshold: int = 64 << 20
+    # Occupancy trigger: a batch holding at least the mesh windows-axis
+    # size of windows also routes sharded (the windows axis is full, so
+    # the mesh is busy even if each graph is small). Only fires when the
+    # mesh's windows axis is > 1.
+    shard_on_full_occupancy: bool = True
+    # Stream burst coalescing: same-pad-bucket windows pending behind
+    # the current dispatch coalesce into one vmapped program, up to this
+    # many (1 disables — every abnormal window dispatches alone).
+    coalesce_windows: int = 8
+    # Double-buffered staging: stage the NEXT ready batch (host blob
+    # pack + H2D transfer) after dispatching the current program and
+    # before fetching its results, so staging overlaps device execution
+    # and leaves the critical path.
+    double_buffer: bool = True
+    # Donate the staged blob buffer to the rank program (the program
+    # never aliases its input, so XLA may reuse the memory for outputs
+    # — halves peak staging HBM under double-buffering). Skipped on
+    # backends without donation support (CPU).
+    donate_staging: bool = True
+    # Record warmed program shapes (kernel + occupancies) into a
+    # manifest next to the persistent compile cache and replay it at
+    # startup, so a restarted serve/stream process re-traces every
+    # program it will need while the on-disk cache makes each compile a
+    # reload instead of the ~1.7 s cold build.
+    warmup_manifest: bool = True
 
 
 @dataclass(frozen=True)
@@ -341,6 +409,13 @@ class StreamConfig:
     # >= fingerprint_jaccard dedup into one incident.
     fingerprint_top_k: int = 5
     fingerprint_jaccard: float = 0.5
+    # Drift-aware dedup: a window that dedups into an open incident
+    # (same/overlapping top-k SET) but whose suspect SCORE vector moved
+    # by more than this relative L-inf distance since the incident's
+    # last update emits ``incident_update`` with ``drifted: true`` —
+    # the fault is evolving even though the suspects look the same.
+    # <= 0 disables drift flagging.
+    fingerprint_score_drift: float = 0.25
     # Build worker pool: threads running host graph builds so window
     # N+1's build overlaps window N's device rank; pipeline_windows
     # bounds abnormal windows in flight (build submitted, rank pending).
@@ -364,6 +439,7 @@ class MicroRankConfig:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
+    dispatch: DispatchConfig = field(default_factory=DispatchConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -401,4 +477,5 @@ class MicroRankConfig:
             runtime=_mk(RuntimeConfig, d.get("runtime", {})),
             serve=_mk(ServeConfig, d.get("serve", {})),
             stream=_mk(StreamConfig, d.get("stream", {})),
+            dispatch=_mk(DispatchConfig, d.get("dispatch", {})),
         )

@@ -1,0 +1,37 @@
+"""Adaptive dispatch routing (PR 5).
+
+One shared seam between "a prepared window graph" and "a device
+program": size-aware sharded-vs-vmapped routing, burst coalescing
+buckets, double-buffered staging, and the persistent compile cache +
+warmup manifest. Serve's scheduler and stream's engine both dispatch
+through here; the batch pipelines keep their own lanes (they already
+pipeline via the table runner) but share the underlying staging and
+kernel-resolution helpers.
+"""
+
+from .cache import (
+    CompileCacheProbe,
+    WARMUP_MANIFEST_NAME,
+    configure_compile_cache,
+    load_manifest,
+    manifest_occupancies,
+    record_manifest_entry,
+    resolve_cache_dir,
+)
+from .router import DispatchRouter, RouteInfo, bucket_key
+from .warmup import synthetic_prepared, warm_occupancies
+
+__all__ = [
+    "CompileCacheProbe",
+    "DispatchRouter",
+    "RouteInfo",
+    "WARMUP_MANIFEST_NAME",
+    "bucket_key",
+    "configure_compile_cache",
+    "load_manifest",
+    "manifest_occupancies",
+    "record_manifest_entry",
+    "resolve_cache_dir",
+    "synthetic_prepared",
+    "warm_occupancies",
+]

@@ -1,0 +1,209 @@
+"""Persistent compile cache wiring + the warmup-program manifest.
+
+First-call compile of the fused rank program costs ~1.7 s per process
+cold (BENCH_r05's compile_ms); the compiled XLA executable is a pure
+function of the HLO, so a restarted serve/stream process re-paying it is
+waste. Two mechanisms close the gap:
+
+* the **persistent compilation cache** (``jax_compilation_cache_dir``):
+  compiled programs land on disk keyed by HLO hash and reload in
+  milliseconds. ``configure_compile_cache`` is the ONE wiring point —
+  the CLI, the serve/stream entry points and the bench all call it; the
+  directory resolves ``MICRORANK_JIT_CACHE`` (env) over
+  ``RuntimeConfig.compile_cache_dir`` over the user-cache default. The
+  min-compile-time/min-entry-size gates are zeroed: jax's defaults only
+  persist compilations slower than 1 s, which would skip most of this
+  framework's windows-shaped programs and every CPU run.
+
+* the **warmup manifest** (``warmup_manifest.json`` next to the cache):
+  the on-disk cache only helps when the program is *requested*, and a
+  restarted process doesn't know which occupancies/kernels it compiled
+  last time until traffic arrives. Serve and stream record the program
+  shapes they warmed/dispatched; a restart replays the manifest at
+  startup — every trace hits the persistent cache, so the whole replay
+  costs milliseconds and the first real window/request pays nothing.
+
+``CompileCacheProbe`` turns cache behavior into metrics: it counts the
+cache directory's entries around each observed compile — the entry
+count growing is a miss (a fresh compile persisted), unchanged is a hit
+(pure reload) — feeding ``microrank_compile_cache_events_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.dispatch.cache")
+
+WARMUP_MANIFEST_NAME = "warmup_manifest.json"
+
+_configured_dir: Optional[str] = None
+
+
+def resolve_cache_dir(runtime=None) -> str:
+    """Cache directory precedence: MICRORANK_JIT_CACHE env >
+    RuntimeConfig.compile_cache_dir > the user-cache default."""
+    env = os.environ.get("MICRORANK_JIT_CACHE")
+    if env:
+        return env
+    if runtime is not None and getattr(runtime, "compile_cache_dir", None):
+        return str(runtime.compile_cache_dir)
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "microrank_tpu", "jit"
+    )
+
+
+def configure_compile_cache(runtime=None) -> Optional[str]:
+    """Point jax's persistent compilation cache at the resolved
+    directory (idempotent; best-effort — a broken cache must never take
+    the pipeline down). Returns the directory, or None on failure."""
+    global _configured_dir
+    try:
+        import jax
+
+        cache_dir = resolve_cache_dir(runtime)
+        if _configured_dir == cache_dir:
+            return cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        if _configured_dir is not None:
+            # jax binds its persistent-cache backend to the FIRST dir it
+            # touches; switching dirs mid-process (config-driven
+            # reconfiguration, tests) needs an explicit reset or writes
+            # keep landing in the old directory. Best-effort private
+            # API — absent on older jax, where the first dir wins.
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # pragma: no cover - jax-version dependent
+                pass
+        for knob, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ):
+            try:
+                jax.config.update(knob, value)
+            except AttributeError:  # older jax without the knob
+                pass
+        _configured_dir = cache_dir
+        return cache_dir
+    except Exception as exc:  # pragma: no cover - cache is best-effort
+        log.warning("compile cache unavailable (%s); compiling cold", exc)
+        return None
+
+
+class CompileCacheProbe:
+    """Hit/miss accounting over the persistent cache directory.
+
+    jax exposes no stable cache-hit API, but the cache's on-disk entry
+    count is ground truth: ``observe()`` after a (possible) compile
+    reports "miss" when entries appeared since the last scan and "hit"
+    otherwise, recording both into the metrics registry.
+    """
+
+    def __init__(self, cache_dir: Optional[str]):
+        self.cache_dir = Path(cache_dir) if cache_dir else None
+        self._entries = self._scan()
+        self.hits = 0
+        self.misses = 0
+
+    def _scan(self) -> int:
+        if self.cache_dir is None or not self.cache_dir.exists():
+            return 0
+        return sum(1 for p in self.cache_dir.rglob("*") if p.is_file())
+
+    def observe(self) -> Optional[str]:
+        """Classify the compile(s) since the last observation."""
+        if self.cache_dir is None:
+            return None
+        from ..obs.metrics import record_compile_cache
+
+        now = self._scan()
+        event = "miss" if now > self._entries else "hit"
+        self._entries = now
+        if event == "hit":
+            self.hits += 1
+        else:
+            self.misses += 1
+        record_compile_cache(event)
+        return event
+
+
+# --------------------------------------------------------------- manifest
+
+
+def _manifest_path(cache_dir) -> Path:
+    return Path(cache_dir) / WARMUP_MANIFEST_NAME
+
+
+def load_manifest(cache_dir: Optional[str]) -> List[dict]:
+    """Entries recorded by previous processes ([] when absent/corrupt)."""
+    if not cache_dir:
+        return []
+    path = _manifest_path(cache_dir)
+    if not path.exists():
+        return []
+    try:
+        data = json.loads(path.read_text())
+        return list(data.get("programs", []))
+    except (ValueError, OSError) as exc:
+        log.warning("warmup manifest unreadable (%s); ignoring", exc)
+        return []
+
+
+def record_manifest_entry(
+    cache_dir: Optional[str],
+    pipeline: str,
+    kernel: str,
+    occupancies,
+) -> None:
+    """Merge one warmed program shape into the manifest (occupancies
+    union per (pipeline, kernel) key); best-effort."""
+    if not cache_dir:
+        return
+    try:
+        entries = load_manifest(cache_dir)
+        occs = sorted({int(o) for o in occupancies})
+        for e in entries:
+            if e.get("pipeline") == pipeline and e.get("kernel") == kernel:
+                merged = sorted(set(e.get("occupancies", [])) | set(occs))
+                if merged == e.get("occupancies"):
+                    return  # nothing new — skip the write
+                e["occupancies"] = merged
+                break
+        else:
+            entries.append(
+                {
+                    "pipeline": pipeline,
+                    "kernel": kernel,
+                    "occupancies": occs,
+                }
+            )
+        path = _manifest_path(cache_dir)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"programs": entries}, indent=2))
+        os.replace(tmp, path)
+        from ..obs.metrics import record_compile_cache
+
+        record_compile_cache("manifest_write")
+    except OSError as exc:
+        log.warning("warmup manifest write failed (%s)", exc)
+
+
+def manifest_occupancies(
+    cache_dir: Optional[str], pipeline: str
+) -> List[int]:
+    """Occupancies a previous ``pipeline`` process recorded (any
+    kernel) — the set a warm restart should re-trace."""
+    occs = set()
+    for e in load_manifest(cache_dir):
+        if e.get("pipeline") == pipeline:
+            occs.update(int(o) for o in e.get("occupancies", []))
+    return sorted(occs)

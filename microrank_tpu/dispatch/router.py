@@ -1,0 +1,304 @@
+"""The adaptive dispatch router: one device seam for serve and stream.
+
+Before PR 5 the serve batcher and the stream engine each owned a
+private single-path dispatch: stack the bucket, run the vmapped
+single-device program, fetch. The proven mesh path
+(``parallel.rank_windows_sharded``) was only reachable from the batch
+pipelines, staging serialized with ranking, and every process paid the
+~1.7 s first-call compile. The router centralizes the device half:
+
+* **size-aware routing** — a batch whose staged footprint (post
+  ``device_subset``) crosses ``DispatchConfig.sharded_bytes_threshold``,
+  or whose occupancy fills the mesh's windows axis, dispatches through
+  ``rank_windows_sharded`` on the configured mesh; everything else
+  keeps the vmapped single-device program. Kernel resolution on the
+  sharded route is the table lane's own policy
+  (``parallel.sharded_rank.resolve_shard_kernel``), so the two callers
+  and the batch pipeline cannot disagree. Parity between the two
+  routes is tie-aware by construction (both end in the same two-key
+  sort) and pinned by tests/test_dispatch.py.
+
+* **double-buffered staging** — ``rank_batch(next_batch=...)`` stages
+  the NEXT batch (host blob pack + H2D transfer, both asynchronous
+  with respect to device execution) after dispatching the current
+  program and before fetching its results, so staging overlaps the
+  rank and leaves the critical path; the staged handle is cached one
+  slot deep and consumed by the next call. ``jax.block_until_ready``
+  semantics live only at the consumer edge (the one batched
+  ``jax.device_get`` of the tiny top-k outputs). Staged blob buffers
+  are donated to the program on backends that support donation, so
+  double-buffering holds at most one idle blob in HBM.
+
+* **burst coalescing** — same-pad-bucket windows queued behind an
+  in-flight dispatch coalesce into ONE vmapped program (the serve
+  batcher's trick, now shared): ``bucket_key`` lives here and the
+  stream engine groups its pending builds with it before calling
+  ``rank_batch``.
+
+Threading: the router has no thread of its own — every method runs on
+the caller's device thread (scheduler thread in serve, engine thread in
+stream), preserving the one-thread-owns-the-device program-order rule.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import MicroRankConfig
+from ..utils.logging import get_logger
+
+
+def bucket_key(graph, kernel: str) -> Tuple:
+    """Shape signature of a (kernel-stripped) window graph: the jit
+    cache key modulo config. Two graphs with equal keys stack into one
+    batch whose compiled program is shared across every batch of the
+    same occupancy. Shared by the serve batcher's shape buckets and the
+    stream engine's burst coalescing."""
+    import jax
+
+    return (kernel,) + tuple(
+        tuple(np.asarray(leaf).shape) for leaf in jax.tree.leaves(graph)
+    )
+
+
+@dataclass
+class RouteInfo:
+    """What one router dispatch did (journals + bench artifact)."""
+
+    route: str                  # "vmapped" | "sharded"
+    kernel: str                 # kernel actually dispatched
+    windows: int                # batch occupancy
+    footprint_bytes: int        # staged bytes that drove the decision
+    dispatch_ms: float = 0.0    # issue -> results on host
+    overlap_ms: float = 0.0     # next-batch staging hidden behind this rank
+    prestaged: bool = False     # this batch's staging was itself hidden
+
+
+class _Staged:
+    __slots__ = ("key", "route", "kernel", "handle", "n_pad", "footprint")
+
+    def __init__(self, key, route, kernel, handle, n_pad=0, footprint=0):
+        self.key = key
+        self.route = route
+        self.kernel = kernel
+        self.handle = handle
+        self.n_pad = n_pad
+        self.footprint = footprint
+
+
+class DispatchRouter:
+    """Route prepared window graphs to the right device program.
+
+    ``graphs`` passed to :meth:`rank_batch` must share one pad bucket
+    (equal :func:`bucket_key`) — callers coalesce before routing.
+    """
+
+    def __init__(self, config: MicroRankConfig, mesh=None):
+        self.config = config
+        self.cfg = config.dispatch
+        self.log = get_logger("microrank_tpu.dispatch")
+        self._mesh = mesh if mesh is not None else self._build_mesh()
+        self._prestaged: Optional[_Staged] = None
+        self.dispatches = 0
+
+    # ------------------------------------------------------------- mesh
+    def _build_mesh(self):
+        shape = self.config.runtime.mesh_shape
+        if shape is None:
+            return None
+        shape = tuple(shape)
+        if len(shape) == 1:  # pure graph parallelism
+            shape = (1, shape[0])
+        try:
+            from ..parallel.mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh
+
+            mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
+        except ValueError as exc:
+            self.log.warning(
+                "mesh %s unavailable (%s); routing everything to the "
+                "single-device path", shape, exc,
+            )
+            return None
+        self.log.info(
+            "dispatch router: mesh %s available for sharded routing",
+            mesh.devices.shape,
+        )
+        return mesh
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    # ------------------------------------------------------------- plan
+    def plan(self, graphs, kernel: str) -> Tuple[str, str, int]:
+        """(route, resolved_kernel, footprint_bytes) for one batch.
+
+        Decision table (see DESIGN.md "Dispatch router"):
+
+        * no mesh configured                          -> vmapped
+        * footprint >= sharded_bytes_threshold        -> sharded
+        * occupancy >= mesh windows axis (axis > 1)   -> sharded
+        * otherwise                                   -> vmapped
+        """
+        from ..rank_backends.jax_tpu import graph_device_bytes
+
+        footprint = sum(graph_device_bytes(g) for g in graphs)
+        if self._mesh is None:
+            return "vmapped", kernel, footprint
+        by_size = footprint >= max(0, int(self.cfg.sharded_bytes_threshold))
+        w_n = int(self._mesh.devices.shape[0])
+        by_occupancy = (
+            self.cfg.shard_on_full_occupancy
+            and w_n > 1
+            and len(graphs) >= w_n
+        )
+        if not (by_size or by_occupancy):
+            return "vmapped", kernel, footprint
+        from ..parallel.sharded_rank import resolve_shard_kernel
+
+        shard_kernel = resolve_shard_kernel(
+            graphs, self._mesh, self.config.runtime, self.log
+        )
+        return "sharded", shard_kernel, footprint
+
+    # ------------------------------------------------------------ stage
+    def _stage(self, graphs, kernel: str) -> _Staged:
+        key = self._key(graphs, kernel)
+        route, resolved, footprint = self.plan(graphs, kernel)
+        if route == "sharded":
+            from ..parallel.sharded_rank import stage_sharded
+
+            w_n = int(self._mesh.devices.shape[0])
+            # The batch must divide the windows axis: pad by repeating
+            # the last window and drop the tail rows after the fetch.
+            n_pad = (-len(graphs)) % w_n
+            handle = stage_sharded(
+                list(graphs) + [graphs[-1]] * n_pad, self._mesh, resolved
+            )
+            return _Staged(key, route, resolved, handle, n_pad, footprint)
+        from ..parallel.sharded_rank import stack_window_graphs
+        from ..rank_backends.blob import stage_windows_batched
+        from ..rank_backends.jax_tpu import device_subset
+
+        stacked = device_subset(stack_window_graphs(graphs), resolved)
+        handle = stage_windows_batched(
+            stacked, self.config.runtime.blob_staging
+        )
+        return _Staged(key, route, resolved, handle, 0, footprint)
+
+    @staticmethod
+    def _key(graphs, kernel: str) -> Tuple:
+        return (kernel,) + tuple(id(g) for g in graphs)
+
+    def _take_prestaged(self, graphs, kernel: str) -> Optional[_Staged]:
+        staged = self._prestaged
+        self._prestaged = None
+        if staged is not None and staged.key == self._key(graphs, kernel):
+            return staged
+        return None  # mismatch: the cached staging is dropped unused
+
+    # --------------------------------------------------------- dispatch
+    def _dispatch_program(self, staged: _Staged, conv_trace: bool):
+        cfg = self.config
+        if staged.route == "sharded":
+            from ..parallel.sharded_rank import (
+                rank_windows_sharded,
+                rank_windows_sharded_traced,
+            )
+
+            fn = (
+                rank_windows_sharded_traced
+                if conv_trace
+                else rank_windows_sharded
+            )
+            return fn(
+                staged.handle, cfg.pagerank, cfg.spectrum, self._mesh,
+                staged.kernel,
+            )
+        from ..rank_backends.blob import dispatch_windows_staged
+
+        return dispatch_windows_staged(
+            staged.handle,
+            cfg.pagerank,
+            cfg.spectrum,
+            staged.kernel,
+            conv_trace=conv_trace,
+            donate=self._donate(),
+        )
+
+    def _donate(self) -> bool:
+        if not self.cfg.donate_staging:
+            return False
+        import jax
+
+        # CPU (and some plugin) backends warn per call on unusable
+        # donations; donate only where it buys the HBM back.
+        return jax.default_backend() not in ("cpu",)
+
+    # -------------------------------------------------------------- API
+    def rank_batch(
+        self,
+        graphs,
+        kernel: str,
+        conv_trace: bool = False,
+        next_batch: Optional[Tuple[List, str]] = None,
+        record: bool = True,
+    ):
+        """Rank one same-bucket batch; returns ``(outs, RouteInfo)``.
+
+        ``outs`` are HOST arrays — ``(top_idx [B,k], top_scores [B,k],
+        n_valid [B])`` plus ``(residuals [B,2,I], n_iters [B])`` when
+        ``conv_trace``. ``next_batch=(graphs, kernel)`` double-buffers:
+        the next batch's staging is issued after this batch's program
+        and before its fetch, so the H2D transfer overlaps device
+        execution; the staged handle is consumed by the next
+        ``rank_batch`` call with the same graphs. ``record=False``
+        (warmup) skips the route metrics.
+        """
+        import jax
+
+        t0 = time.monotonic()
+        staged = self._take_prestaged(graphs, kernel)
+        prestaged = staged is not None
+        if staged is None:
+            staged = self._stage(graphs, kernel)
+        dev_outs = self._dispatch_program(staged, conv_trace)
+        overlap_s = 0.0
+        if next_batch is not None and self.cfg.double_buffer:
+            t_stage = time.monotonic()
+            try:
+                self._prestaged = self._stage(*next_batch)
+                overlap_s = time.monotonic() - t_stage
+            except Exception as exc:  # noqa: BLE001 - a broken NEXT
+                # batch must not fail THIS one; it will surface on its
+                # own dispatch turn.
+                self.log.warning("double-buffer prestage failed: %s", exc)
+        # Consumer edge: the one blocking fetch of the tiny top-k
+        # outputs (block_until_ready is not a sound fence on tunneled
+        # runtimes; a value transfer is).
+        outs = jax.device_get(dev_outs)
+        if staged.n_pad:
+            outs = tuple(o[: len(graphs)] for o in outs)
+        self.dispatches += 1
+        info = RouteInfo(
+            route=staged.route,
+            kernel=staged.kernel,
+            windows=len(graphs),
+            footprint_bytes=staged.footprint,
+            dispatch_ms=round((time.monotonic() - t0) * 1e3, 3),
+            overlap_ms=round(overlap_s * 1e3, 3),
+            prestaged=prestaged,
+        )
+        if record:
+            from ..obs.metrics import record_dispatch_route
+
+            record_dispatch_route(info.route, info.windows, overlap_s)
+        return outs, info
+
+    def drop_prestaged(self) -> None:
+        """Discard the cached prestaged batch (caller aborted it)."""
+        self._prestaged = None

@@ -1,0 +1,66 @@
+"""Warmup program replay: trace the programs a process will need
+before traffic arrives.
+
+The jit cache keys on (padded shapes, occupancy, config); the
+persistent compile cache (dispatch.cache) turns each compile into a
+disk reload — but only once the program is *requested*. This module is
+the requester: build one small synthetic abnormal window through the
+normal ``prepare_window_graph`` seam and dispatch it through the router
+at each target occupancy. Serve runs it at startup (its configured
+occupancies plus whatever the warmup manifest recorded last run);
+stream replays the manifest's occupancies on restart so an abnormal
+burst right after a redeploy doesn't pay the ~1.7 s first-call compile.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.dispatch.warmup")
+
+
+def synthetic_prepared(config) -> Optional[Tuple[object, list, str]]:
+    """(graph, op_names, kernel) of a small synthetic abnormal window
+    prepared through the production seam, or None when the fixed-seed
+    case fails to partition (never observed; guarded anyway)."""
+    from ..detect import compute_slo, detect_partition
+    from ..rank_backends.jax_tpu import prepare_window_graph
+    from ..testing import SyntheticConfig, generate_case
+
+    case = generate_case(
+        SyntheticConfig(n_operations=12, n_traces=60, seed=0)
+    )
+    vocab, baseline = compute_slo(case.normal)
+    flag, nrm, abn = detect_partition(config, vocab, baseline, case.abnormal)
+    if not flag or not nrm or not abn:  # pragma: no cover - fixed seed
+        log.warning("warmup case did not partition; skipping warmup")
+        return None
+    return prepare_window_graph(case.abnormal, nrm, abn, config)
+
+
+def warm_occupancies(
+    router,
+    config,
+    occupancies: Iterable[int],
+    probe=None,
+) -> Optional[str]:
+    """Dispatch the batched rank program at each occupancy through the
+    router (metrics suppressed — warmup must not pollute route/
+    occupancy telemetry). ``probe`` (dispatch.cache.CompileCacheProbe)
+    classifies each compile as a persistent-cache hit or miss. Returns
+    the kernel warmed, or None when nothing ran."""
+    prepared = synthetic_prepared(config)
+    if prepared is None:
+        return None
+    graph, _, kernel = prepared
+    conv = bool(config.runtime.convergence_trace)
+    for occ in occupancies:
+        occ = max(1, int(occ))
+        router.rank_batch(
+            [graph] * occ, kernel, conv_trace=conv, record=False
+        )
+        if probe is not None:
+            probe.observe()
+    return kernel

@@ -219,6 +219,47 @@ def stream_open_incidents() -> Gauge:
     )
 
 
+def dispatch_routes() -> Counter:
+    return get_registry().counter(
+        "microrank_dispatch_route_total",
+        "Device dispatches issued by the adaptive router, by route "
+        "(vmapped = single-device batched program, sharded = mesh "
+        "shard_map program)",
+        labelnames=("route",),  # vmapped | sharded
+    )
+
+
+def dispatch_windows() -> Histogram:
+    return get_registry().histogram(
+        "microrank_dispatch_windows",
+        "Windows per router dispatch, by route (stream burst coalescing "
+        "and serve micro-batching both land here; mass at 1 under "
+        "bursty load means buckets never match)",
+        labelnames=("route",),
+        buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+    )
+
+
+def dispatch_overlap_seconds() -> Counter:
+    return get_registry().counter(
+        "microrank_dispatch_overlap_seconds_total",
+        "Staging seconds (host blob pack + H2D transfer) the router "
+        "overlapped with an in-flight device dispatch — staging time "
+        "taken OFF the critical path by double-buffering",
+    )
+
+
+def compile_cache_events() -> Counter:
+    return get_registry().counter(
+        "microrank_compile_cache_events_total",
+        "Persistent-compile-cache events: hit/miss per observed "
+        "compile (cache dir entry count unchanged/grew), warm_start "
+        "when a warmup manifest from a previous process was found and "
+        "replayed, manifest_write per manifest update",
+        labelnames=("event",),  # hit | miss | warm_start | manifest_write
+    )
+
+
 def build_pool_inflight() -> Gauge:
     return get_registry().gauge(
         "microrank_build_pool_inflight",
@@ -262,6 +303,8 @@ def ensure_catalog() -> None:
         serve_last_batch_gauge, serve_degraded, serve_stage_seconds,
         stream_windows, stream_dispatches, stream_late_spans,
         stream_incidents, stream_open_incidents,
+        dispatch_routes, dispatch_windows, dispatch_overlap_seconds,
+        compile_cache_events,
         build_pool_inflight, build_pool_builds,
         host_load_gauge, host_steal_gauge,
     ):
@@ -310,6 +353,22 @@ def record_incident(transition: str, open_now: int = None) -> None:
     stream_incidents().inc(transition=transition)
     if open_now is not None:
         stream_open_incidents().set(float(open_now))
+
+
+def record_dispatch_route(
+    route: str, windows: int, overlap_seconds: float = 0.0
+) -> None:
+    """One router dispatch: route taken, windows it carried, staging
+    seconds double-buffered behind it."""
+    dispatch_routes().inc(route=route)
+    dispatch_windows().observe(float(windows), route=route)
+    if overlap_seconds > 0:
+        dispatch_overlap_seconds().inc(float(overlap_seconds))
+
+
+def record_compile_cache(event: str, n: int = 1) -> None:
+    if n > 0:
+        compile_cache_events().inc(float(n), event=event)
 
 
 def record_build_pool(
@@ -487,4 +546,13 @@ def snapshot_to_result_fields(registry=None) -> Dict[str, float]:
     staged = reg.get("microrank_staged_bytes_total")
     if staged is not None:
         out["staged_bytes"] = sum(s["value"] for s in staged.samples())
+    routes = reg.get("microrank_dispatch_route_total")
+    if routes is not None:
+        for s in routes.samples():
+            out[f"route_{s['labels'].get('route', '?')}"] = s["value"]
+    overlap = reg.get("microrank_dispatch_overlap_seconds_total")
+    if overlap is not None:
+        total = sum(s["value"] for s in overlap.samples())
+        if total:
+            out["overlap_ms"] = round(total * 1e3, 1)
     return out

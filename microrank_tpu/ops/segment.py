@@ -59,6 +59,32 @@ def compensated_cumsum(x):
     return hi, lo
 
 
+def compensated_psum(x, axis_name: str):
+    """Cross-shard sum with a compensated, position-independent combine:
+    ``all_gather`` the per-shard partials and fold them with TwoSum in
+    shard order, so every element's cross-shard tree is identical and
+    the recovered sum stays within ~1 ulp of the exact value.
+
+    Why: entry-axis sharding (coo/csr) splits each row's entry list at
+    fixed block boundaries, so a row straddling a shard boundary gets a
+    DIFFERENT summation tree than a value-identical row that landed
+    inside one shard — the same position-dependent rounding shape as
+    the plain-cumsum csr bug ``compensated_cumsum`` fixed, now across
+    shards instead of along the prefix. A plain ``psum`` bakes that
+    reassociation in; compensating the fold bounds it below tie-flip
+    scale. Cost: S× the collective bytes of a psum (S = shard count, a
+    [S, V]/[S, T] gather of vectors that are small by design) plus 7
+    adds per element per shard — noise next to the SpMV gathers.
+    """
+    parts = lax.all_gather(x, axis_name)  # [S, ...]; S static at trace
+    hi = parts[0]
+    lo = jnp.zeros_like(hi)
+    for i in range(1, parts.shape[0]):
+        hi, e = _two_sum(hi, parts[i])
+        lo = lo + e
+    return hi + lo
+
+
 def segment_count(ids, n_segments: int, live=None):
     ones = jnp.ones(ids.shape, dtype=jnp.int32)
     if live is not None:

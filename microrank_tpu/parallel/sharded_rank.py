@@ -189,6 +189,100 @@ def stack_window_graphs(
     )
 
 
+def resolve_shard_kernel(graphs, mesh: Mesh, runtime, log=None) -> str:
+    """Kernel for a sharded dispatch over ``graphs`` (shared by the
+    table runner's batch mode and the dispatch router): an explicit
+    shard-capable config wins; otherwise resolve by the views EVERY
+    graph in the batch carries (stacking degrades mixed-aux batches to
+    the common denominator, so the choice must agree with that: all
+    packed -> packed, all csr -> csr, mixed -> coo)."""
+    from ..rank_backends.jax_tpu import choose_kernel
+
+    if log is None:
+        from ..utils.logging import get_logger
+
+        log = get_logger("microrank_tpu.parallel")
+    k = runtime.kernel
+    if k in SHARD_KERNELS:
+        return k
+    if all(
+        int(p.cov_bits.shape[-1]) > 0
+        for g in graphs
+        for p in (g.normal, g.abnormal)
+    ):
+        # Trace-sharded packed unpacks [V, T/S] coverage blocks plus
+        # the replicated [V, V] call bitmap per device — budget-check
+        # THAT footprint, not the single-device one. The footprint uses
+        # the POST-STACK shapes: stage_sharded re-pads every trace axis
+        # to the batch max rounded to 8*S, so the per-device block is
+        # that rounded max / S, not each graph's own pad / S.
+        from ..graph.build import packed_unpacked_bytes
+
+        s = int(mesh.devices.shape[1])
+        budget = runtime.dense_budget_bytes
+        t_per_dev = tuple(
+            -(-max(int(getattr(g, side).kind.shape[-1]) for g in graphs)
+              // (8 * s)) * 8
+            for side in ("normal", "abnormal")
+        )
+        v_max = max(int(g.normal.cov_unique.shape[-1]) for g in graphs)
+        fits = packed_unpacked_bytes(v_max, t_per_dev) <= budget
+        has_csr = all(
+            int(p.inc_indptr_op.shape[-1]) > 0
+            for g in graphs
+            for p in (g.normal, g.abnormal)
+        )
+        if fits or not has_csr:
+            # Bitmap-only builds (aux="packed") carry no CSR views, so
+            # past-budget batches must still take the packed path
+            # rather than crash at rank time.
+            if not fits:
+                log.warning(
+                    "sharded packed footprint exceeds dense_budget_bytes "
+                    "and no CSR views were built; proceeding with the "
+                    "packed family — build with aux='all' to enable the "
+                    "csr fallback"
+                )
+            return "packed_bf16" if runtime.prefer_bf16 else "packed"
+        return "csr"
+    kernels = {
+        choose_kernel(
+            g, runtime.dense_budget_bytes, runtime.prefer_bf16
+        )
+        for g in graphs
+    }
+    # Without bitmaps choose_kernel only returns csr/coo here.
+    return kernels.pop() if len(kernels) == 1 else "coo"
+
+
+def stage_sharded(graphs, mesh: Mesh, kernel: str):
+    """The one staging recipe for every sharded path: strip the arrays
+    ``kernel`` never reads, stack with the mesh's shard (and, for
+    packed, 8*S trace) alignment, and form global arrays with
+    kernel-correct partition specs — global_put handles both
+    single-process meshes (a sharded device_put) and multi-host ones
+    (each process contributes its addressable shards)."""
+    from ..parallel.distributed import global_put
+    from ..rank_backends.jax_tpu import device_subset
+
+    shard_n = int(mesh.devices.shape[1])
+    stacked = stack_window_graphs(
+        [device_subset(g, kernel) for g in graphs],
+        shard_multiple=shard_n,
+        trace_multiple=(
+            8 * shard_n if kernel in ("packed", "packed_bf16") else 1
+        ),
+    )
+    from ..obs.metrics import graph_staging_stats, record_staging
+
+    total, pad = graph_staging_stats(stacked)
+    record_staging("sharded", total, len(graphs), pad)
+    pspecs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
+    return global_put(
+        stacked, mesh, WindowGraph(normal=pspecs, abnormal=pspecs)
+    )
+
+
 def _partition_specs(
     window_axis, shard_axis, kernel: str = "coo"
 ) -> PartitionGraph:

@@ -16,14 +16,20 @@ cursor (pipeline.checkpoint) persists the NEXT window start across
 polls AND process restarts, so a crashed follower resumes exactly where
 it stopped — the same at-least-once semantics as batch resume.
 
-Ingest cost per poll: ``load_span_table`` re-parses the grown file WITH
-THE SIDECAR CACHE OFF — a write racing the parse could pin a sidecar
-whose recorded (mtime, size) matches the appended file but whose
-content predates the append, silently dropping the tail forever; and
-rewriting a full-table .npz every poll would be a second O(file) cost.
-A full re-parse per poll is O(file) — fine at the minutes-scale windows
-this mode targets; a byte-offset incremental parser is the known
-optimization if sub-second polls over multi-GB files are ever needed.
+Ingest cost per poll: the batch loop's ``load_span_table`` re-parses
+the grown file WITH THE SIDECAR CACHE OFF — a write racing the parse
+could pin a sidecar whose recorded (mtime, size) matches the appended
+file but whose content predates the append, silently dropping the tail
+forever; and rewriting a full-table .npz every poll would be a second
+O(file) cost. The full re-parse is unavoidable HERE (the window cursor
+re-ranks windows whose spans straddle polls, so the whole table must
+exist), and fine at the minutes-scale windows this mode targets. The
+STREAMING tail (stream.sources.FileTailSource), which only ever needs
+the newly appended rows, uses ``TailTracker.read_appended`` instead:
+a byte-offset cursor feeds the CSV parser only the header plus the
+complete lines appended since the last successful parse (PR 5) —
+O(appended) per poll, with rotation/truncation falling back to a full
+re-read.
 """
 
 from __future__ import annotations
@@ -47,13 +53,20 @@ class TailTracker:
     * growth detection (``size == last`` counts idle);
     * rotation/truncation (``size < last``): counted
       (``follow_rotations``), ``rotated`` flagged so callers reset
-      their cursors, and the file re-reads from scratch;
+      their cursors, and the file re-reads from scratch — including the
+      incremental byte cursor below;
     * parse failures (torn final line): counted
       (``follow_parse_failures``) AND counted toward ``idle_exit`` — a
       permanently corrupt tail must not starve the exit condition
       (advisor round 5);
     * ``idle_exit`` consecutive no-progress polls stop the loop
-      (0 = follow forever).
+      (0 = follow forever);
+    * **byte-offset incremental parse** (PR 5): ``read_appended``
+      remembers the last byte offset handed to the CSV parser and
+      returns only the header plus the complete lines appended since —
+      each poll costs O(appended), not O(file). Rotation/truncation
+      resets the cursor, so those polls still fall back to a full
+      re-parse.
     """
 
     def __init__(self, idle_exit: int = 0):
@@ -61,6 +74,12 @@ class TailTracker:
         self.last_size = -1
         self.idle = 0
         self.rotated = False
+        # Incremental-parse cursor: absolute byte offset already fed to
+        # the parser (0 = nothing, header included), plus the cached
+        # header line prepended to each appended slice.
+        self.parsed_offset = 0
+        self._header: Optional[bytes] = None
+        self.bytes_parsed = 0   # cumulative bytes handed to the parser
 
     def _idle_tick(self) -> str:
         self.idle += 1
@@ -82,6 +101,9 @@ class TailTracker:
             follow_rotations().inc()
             self.last_size = -1
             self.rotated = True
+            # Incremental cursor falls back to a full re-parse.
+            self.parsed_offset = 0
+            self._header = None
         if size == self.last_size or size < 0:
             return self._idle_tick()
         return "grew"
@@ -96,10 +118,47 @@ class TailTracker:
         follow_parse_failures().inc()
         return "exit" if self._idle_tick() == "exit" else "retry"
 
-    def parsed(self, size: int) -> None:
-        """One successful parse at ``size`` bytes resets the idle run."""
+    def parsed(self, size: int, offset: Optional[int] = None) -> None:
+        """One successful parse at ``size`` bytes resets the idle run;
+        ``offset`` (incremental mode) advances the byte cursor to the
+        end of the last line actually parsed."""
         self.idle = 0
         self.last_size = size
+        if offset is not None:
+            self.parsed_offset = int(offset)
+
+    def read_appended(self, path, size: int):
+        """Incremental slice for the CSV parser: ``(payload, offset)``
+        where ``payload`` is the header line plus every COMPLETE line
+        appended since ``parsed_offset`` and ``offset`` is the absolute
+        byte position the cursor should advance to once the parse
+        succeeds (pass it to :meth:`parsed`). Returns ``None`` when
+        only a torn partial line has been appended — the caller should
+        treat the poll as no-progress and retry; the cursor does not
+        move, so the bytes re-read next poll. A parse FAILURE likewise
+        leaves the cursor in place (``parse_failed`` semantics are
+        unchanged), re-feeding the same slice until it parses or
+        idle_exit fires."""
+        with open(path, "rb") as f:
+            if self.parsed_offset <= 0:
+                # Full (re-)read: the header is the first line.
+                chunk = f.read(size)
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    return None
+                head_end = chunk.find(b"\n")
+                self._header = chunk[: head_end + 1]
+                payload = chunk[: cut + 1]
+                self.bytes_parsed += len(payload)
+                return payload, cut + 1
+            f.seek(self.parsed_offset)
+            chunk = f.read(max(0, size - self.parsed_offset))
+        cut = chunk.rfind(b"\n")
+        if cut < 0 or self._header is None:
+            return None
+        payload = self._header + chunk[: cut + 1]
+        self.bytes_parsed += len(payload)
+        return payload, self.parsed_offset + cut + 1
 
 
 def follow_table(

@@ -39,6 +39,9 @@ class WindowResult:
     rank_residual: Optional[float] = None
     kernel: Optional[str] = None
     queue_depth: Optional[int] = None
+    # Dispatch route the window's device program took ("vmapped" |
+    # "sharded", dispatch router); None off the router paths.
+    route: Optional[str] = None
     # Request-scoped fields (serve/ subsystem): the caller-supplied
     # request id and tenant, whether the response came from the
     # numpy_ref fallback after a failed device dispatch, and how many

@@ -307,6 +307,115 @@ def stage_rank_window(
     return out
 
 
+# Donated twins of the batched blob jits (built lazily once, module
+# cached): the staged blob buffer is marked donated so XLA may reuse
+# its HBM for outputs — under the dispatch router's double-buffering
+# two staged batches are alive at once, and donation caps that at one
+# blob plus the in-flight program's working set.
+_DONATED_BLOB_JIT = None
+_DONATED_TRACED_BLOB_JIT = None
+
+
+def _donated_blob_jit():
+    global _DONATED_BLOB_JIT
+    if _DONATED_BLOB_JIT is None:
+        _DONATED_BLOB_JIT = jax.jit(
+            rank_windows_batched_blob_core,
+            static_argnums=(1, 2, 3, 4),
+            donate_argnums=(0,),
+        )
+    return _DONATED_BLOB_JIT
+
+
+def _donated_traced_blob_jit():
+    global _DONATED_TRACED_BLOB_JIT
+    if _DONATED_TRACED_BLOB_JIT is None:
+        _DONATED_TRACED_BLOB_JIT = jax.jit(
+            rank_windows_traced_batched_blob_core,
+            static_argnums=(1, 2, 3, 4),
+            donate_argnums=(0,),
+        )
+    return _DONATED_TRACED_BLOB_JIT
+
+
+def batched_blob_entry(conv_trace: bool, donate: bool):
+    """The batched blob program jit for (conv_trace, donate) — the
+    non-donated keys alias the module-level jits above (shared cache)."""
+    if donate:
+        return (
+            _donated_traced_blob_jit()
+            if conv_trace
+            else _donated_blob_jit()
+        )
+    return (
+        rank_windows_traced_batched_blob_device
+        if conv_trace
+        else rank_windows_batched_blob_device
+    )
+
+
+def stage_windows_batched(batched: WindowGraph, blob: bool):
+    """Staging HALF of ``stage_rank_windows_batched``: pack (blob mode)
+    and issue the H2D transfer — which proceeds asynchronously — and
+    return an opaque staged handle for ``dispatch_windows_staged``.
+    Splitting stage from dispatch is what lets the dispatch router
+    double-buffer: batch N+1 stages through here while batch N's
+    program is still executing, and nothing blocks until the consumer
+    fetches results. The stacked graph should already be
+    device_subset-stripped for its kernel.
+    """
+    if blob:
+        blob_arr, layout = pack_graph_blob(batched)
+        _account_staging(batched, "blob", 1)
+        return ("blob", jax.device_put(blob_arr), layout)
+    _account_staging(batched, "tree", len(jax.tree.leaves(batched)))
+    return ("tree", jax.device_put(batched), None)
+
+
+def dispatch_windows_staged(
+    staged,
+    pagerank_cfg,
+    spectrum_cfg,
+    kernel,
+    conv_trace: bool = False,
+    donate: bool = False,
+):
+    """Dispatch HALF: issue the vmapped batched rank program over an
+    already-staged handle. Returns device output handles (dispatch is
+    async — the caller's ``jax.device_get`` is the consumer edge).
+    ``donate`` releases the staged blob's device buffer to the program
+    (ignored in tree mode and on backends without donation)."""
+    from ..obs.metrics import record_retrace
+
+    if staged[0] == "blob":
+        _, blob_dev, layout = staged
+        fn = batched_blob_entry(conv_trace, donate)
+        # blob_dev is not read again after a donating call — the buffer
+        # belongs to XLA from here.
+        out = fn(blob_dev, layout, pagerank_cfg, spectrum_cfg, kernel)
+        record_retrace(
+            "rank_windows_batched_blob_traced"
+            if conv_trace
+            else "rank_windows_batched_blob",
+            fn,
+        )
+        return out
+    # Tree mode: the batched jits divide the packed-block budget by the
+    # resident window count themselves.
+    from ..parallel.sharded_rank import (
+        _rank_windows_batched_jit,
+        _rank_windows_batched_traced_jit,
+    )
+
+    _, tree_dev, _ = staged
+    fn = (
+        _rank_windows_batched_traced_jit
+        if conv_trace
+        else _rank_windows_batched_jit
+    )
+    return fn(tree_dev, pagerank_cfg, spectrum_cfg, kernel)
+
+
 def stage_rank_windows_batched(
     batched: WindowGraph,
     pagerank_cfg,

@@ -305,8 +305,25 @@ def _partition_setup(
     d = jnp.float32(cfg.damping)
     alpha = jnp.float32(cfg.call_weight)
 
+    # Entry-sharded kernels optionally combine their dense partials
+    # with the compensated fold (PageRankConfig.compensated_psum,
+    # default off — see the config comment: the per-shard partials'
+    # own rounding dominates, so the compensated combine measured no
+    # material parity gain for coo; kept as the opt-in evaluation
+    # artifact of the ROADMAP compensated-scan item).
+    compensate = bool(
+        getattr(cfg, "compensated_psum", False)
+        and kernel in ("coo", "csr", "pallas")
+    )
+
     def reduce_shards(x):
-        return lax.psum(x, psum_axis) if psum_axis is not None else x
+        if psum_axis is None:
+            return x
+        if compensate:
+            from ..ops.segment import compensated_psum
+
+            return compensated_psum(x, psum_axis)
+        return lax.psum(x, psum_axis)
 
     sv = jnp.where(g.op_present, 1.0 / n_total, 0.0).astype(jnp.float32)
     rv = jnp.where(trace_live, 1.0 / n_total, 0.0).astype(jnp.float32)
@@ -1181,6 +1198,22 @@ def device_subset(
     return WindowGraph(
         normal=strip(graph.normal), abnormal=strip(graph.abnormal)
     )
+
+
+def graph_device_bytes(graph: WindowGraph) -> int:
+    """Host->device bytes this graph ships when staged as-is (sum of
+    leaf nbytes — call AFTER device_subset so stripped fields count 0).
+    The dispatch router's size signal: batches whose summed footprint
+    crosses DispatchConfig.sharded_bytes_threshold route to the mesh.
+    Shape/dtype arithmetic only — no np.asarray, which would round-trip
+    device-resident arrays through the host."""
+    total = 0
+    for leaf in jax.tree.leaves(graph):
+        n = 1
+        for d in leaf.shape:
+            n *= int(d)
+        total += n * int(np.dtype(leaf.dtype).itemsize)
+    return total
 
 
 def choose_kernel(

@@ -33,16 +33,10 @@ from ..pipeline.results import WindowResult
 from .protocol import RankRequest
 
 
-def bucket_key(graph, kernel: str) -> Tuple:
-    """Shape signature of a (kernel-stripped) window graph: the jit
-    cache key modulo config. Two graphs with equal keys stack into one
-    batch whose compiled program is shared across every batch of the
-    same occupancy."""
-    import jax
-
-    return (kernel,) + tuple(
-        tuple(np.asarray(leaf).shape) for leaf in jax.tree.leaves(graph)
-    )
+# The shape-bucket key now lives in the dispatch router (PR 5) — the
+# stream engine's burst coalescing uses the same buckets; re-exported
+# here for existing importers.
+from ..dispatch import bucket_key  # noqa: E402,F401
 
 
 @dataclass
@@ -99,10 +93,17 @@ class MicroBatcher:
     scheduler thread is the device's program-order guarantee.
     """
 
-    def __init__(self, config: MicroRankConfig, journal=None):
+    def __init__(self, config: MicroRankConfig, journal=None, router=None):
+        from ..dispatch import DispatchRouter
+
         self.config = config
         self.serve = config.serve
         self.journal = journal
+        # The shared dispatch seam (PR 5): size-aware sharded/vmapped
+        # routing + double-buffered staging live there, not here.
+        self.router = (
+            router if router is not None else DispatchRouter(config)
+        )
         self._lock = threading.Lock()
         # bucket key -> FIFO of PendingWindow (insertion order = age).
         self._buckets: Dict[Tuple, List[PendingWindow]] = {}
@@ -152,64 +153,85 @@ class MicroBatcher:
         return out
 
     # ---------------------------------------------------------- dispatch
-    def dispatch(self, items: List[PendingWindow], warmup=False) -> None:
+    def dispatch_ready(self, batches: List[List[PendingWindow]]) -> None:
+        """Dispatch every ready batch, double-buffered: batch i+1's
+        staging is handed to the router as ``next_batch`` so its H2D
+        transfer overlaps batch i's device execution. Per-batch failure
+        isolation is unchanged — a failed batch retries then degrades
+        without touching its neighbors (the router drops a prestaged
+        handle whose batch never dispatches)."""
+        for i, batch in enumerate(batches):
+            nxt = batches[i + 1] if i + 1 < len(batches) else None
+            self.dispatch(batch, next_items=nxt)
+
+    def dispatch(
+        self,
+        items: List[PendingWindow],
+        warmup=False,
+        next_items: Optional[List[PendingWindow]] = None,
+    ) -> None:
         """Rank one coalesced batch; resolves every member's future."""
         t0 = time.monotonic()
+        route_info = None
         try:
-            outs = self._device_dispatch(items)
+            outs, route_info = self._device_dispatch(items, next_items)
         except Exception as first:
             self._log().warning(
                 "batch dispatch failed (%d windows): %s; retrying once",
                 len(items), first,
             )
             try:
-                outs = self._device_dispatch(items)
+                outs, route_info = self._device_dispatch(items, next_items)
             except Exception as second:
                 self._degrade(items, second, warmup=warmup)
                 return
         batch_ms = (time.monotonic() - t0) * 1e3
-        self._assign(items, outs, batch_ms)
+        self._assign(items, outs, batch_ms, route_info)
         if not warmup:
             from ..obs.metrics import record_serve_batch
 
             record_serve_batch(len(items))
         self.dispatches += 1
         self._journal_batch(
-            items, batch_ms, degraded=0, warmup=warmup
+            items, batch_ms, degraded=0, warmup=warmup,
+            route_info=route_info,
         )
         for pw in items:
             pw.finish()
 
-    def _device_dispatch(self, items: List[PendingWindow]):
+    def _device_dispatch(
+        self,
+        items: List[PendingWindow],
+        next_items: Optional[List[PendingWindow]] = None,
+    ):
         if self._inject_failures > 0:
             self._inject_failures -= 1
             raise RuntimeError(
                 "injected device dispatch failure "
                 "(ServeConfig.inject_dispatch_failures)"
             )
-        import jax
-
-        from ..parallel.sharded_rank import stack_window_graphs
-        from ..rank_backends.blob import stage_rank_windows_batched
         from ..utils.guards import contract_checks
 
         rt = self.config.runtime
-        stacked = stack_window_graphs([pw.graph for pw in items])
         kernel = items[0].kernel
-        with contract_checks(rt.validate_numerics):
-            handles = stage_rank_windows_batched(
-                stacked,
-                self.config.pagerank,
-                self.config.spectrum,
-                kernel,
-                rt.blob_staging,
-                conv_trace=bool(rt.convergence_trace),
+        next_batch = None
+        if next_items:
+            next_batch = (
+                [pw.graph for pw in next_items], next_items[0].kernel
             )
-        return jax.device_get(handles)
+        with contract_checks(rt.validate_numerics):
+            outs, info = self.router.rank_batch(
+                [pw.graph for pw in items],
+                kernel,
+                conv_trace=bool(rt.convergence_trace),
+                next_batch=next_batch,
+            )
+        return outs, info
 
-    def _assign(self, items, outs, batch_ms: float) -> None:
+    def _assign(self, items, outs, batch_ms: float, route_info=None) -> None:
         ti, ts, nv = outs[:3]
         per_window_ms = batch_ms / max(1, len(items))
+        kernel = route_info.kernel if route_info else items[0].kernel
         for b, pw in enumerate(items):
             n = int(nv[b])
             names = [pw.op_names[int(i)] for i in ti[b][:n]]
@@ -221,13 +243,18 @@ class MicroBatcher:
             pw.result.ranking = list(zip(names, scores))
             pw.result.batch_windows = len(items)
             pw.result.timings["rank_ms"] = round(per_window_ms, 3)
+            if route_info is not None:
+                # The sharded route may have resolved a different
+                # (shard-capable) kernel than the per-window choice.
+                pw.result.kernel = kernel
+                pw.result.route = route_info.route
             if len(outs) > 3:
                 conv = _conv_summary(outs[3][b], outs[4][b])
                 pw.result.apply_convergence(conv)
                 from ..obs.metrics import record_convergence
 
                 record_convergence(
-                    pw.kernel,
+                    kernel,
                     conv["iterations"],
                     conv["final_residual"]
                     if conv["final_residual"] is not None
@@ -281,13 +308,21 @@ class MicroBatcher:
             pw.finish(error=err)
 
     # ------------------------------------------------------------- misc
-    def _journal_batch(self, items, batch_ms, degraded, warmup) -> None:
+    def _journal_batch(
+        self, items, batch_ms, degraded, warmup, route_info=None
+    ) -> None:
         if self.journal is None:
             return
         self.journal.emit(
             "serve_batch",
             occupancy=len(items),
-            kernel=items[0].kernel if items else None,
+            kernel=(
+                route_info.kernel
+                if route_info
+                else (items[0].kernel if items else None)
+            ),
+            route=route_info.route if route_info else None,
+            overlap_ms=route_info.overlap_ms if route_info else 0.0,
             dispatch_ms=round(batch_ms, 3),
             degraded=degraded,
             warmup=bool(warmup),

@@ -41,10 +41,12 @@ class ShutdownError(RuntimeError):
 
 
 class BatchScheduler(threading.Thread):
-    def __init__(self, service, journal=None, build_pool=None):
+    def __init__(self, service, journal=None, build_pool=None, router=None):
         super().__init__(name="mr-serve-sched", daemon=True)
         self.service = service
-        self.batcher = MicroBatcher(service.config, journal=journal)
+        self.batcher = MicroBatcher(
+            service.config, journal=journal, router=router
+        )
         self.build_pool = build_pool
         self._cond = threading.Condition()
         self._tenants: "OrderedDict[str, deque]" = OrderedDict()
@@ -114,8 +116,12 @@ class BatchScheduler(threading.Thread):
                 and self.queued() == 0
                 and self.builds_inflight() == 0
             )
-            for batch in self.batcher.take_ready(force=force):
-                self.batcher.dispatch(batch)
+            # All ready batches dispatch through the router pipelined:
+            # batch i+1's staging (host pack + H2D) overlaps batch i's
+            # device execution (dispatch router double-buffering).
+            self.batcher.dispatch_ready(
+                self.batcher.take_ready(force=force)
+            )
             with self._cond:
                 if (
                     self._stopping

@@ -80,8 +80,24 @@ class ServeService:
             self.build_pool = BuildWorkerPool(
                 self.serve.build_workers, name="mr-serve-build"
             )
+        # Persistent compile cache + the shared dispatch router (size-
+        # aware sharded/vmapped routing, double-buffered staging). The
+        # cache dir is wired before any jit so warmup compiles land on
+        # disk and a restart reloads them.
+        from ..dispatch import (
+            CompileCacheProbe,
+            DispatchRouter,
+            configure_compile_cache,
+        )
+
+        self.cache_dir = configure_compile_cache(config.runtime)
+        self.cache_probe = CompileCacheProbe(self.cache_dir)
+        self.router = DispatchRouter(config)
         self.scheduler = BatchScheduler(
-            self, journal=self.journal, build_pool=self.build_pool
+            self,
+            journal=self.journal,
+            build_pool=self.build_pool,
+            router=self.router,
         )
         self.datasets: Dict[str, object] = {}
         self.slo_vocab = None
@@ -137,62 +153,49 @@ class ServeService:
         self.scheduler.start()
 
     def warmup(self) -> None:
-        """Trace+compile the batched rank program before traffic: one
-        dispatch per configured occupancy
-        (ServeConfig.warmup_occupancies) over a small synthetic window
-        (the persistent jit cache makes repeats near-instant) — a full
-        batch at an uncompiled occupancy would otherwise pay a first-hit
-        compile under traffic. Runs before the scheduler thread starts —
-        exclusive device use. Warmup dispatches don't pollute the
-        occupancy metrics."""
-        import pandas as pd
-
-        from ..rank_backends.jax_tpu import prepare_window_graph
-        from ..testing import SyntheticConfig, generate_case
-        from .batcher import PendingWindow
+        """Trace the batched rank program before traffic: one dispatch
+        per configured occupancy (ServeConfig.warmup_occupancies) over
+        a small synthetic window — a full batch at an uncompiled
+        occupancy would otherwise pay a first-hit compile under
+        traffic. The persistent compile cache (dispatch.cache) turns
+        each compile into a disk reload on restart, and the warmup
+        MANIFEST extends the set: occupancies a previous process warmed
+        (or served) replay too, so a redeploy re-traces everything it
+        will need while every compile hits the cache. Runs before the
+        scheduler thread starts — exclusive device use; warmup
+        dispatches don't pollute the occupancy/route metrics."""
+        from ..dispatch import (
+            manifest_occupancies,
+            record_manifest_entry,
+            warm_occupancies,
+        )
+        from ..obs.metrics import record_compile_cache
 
         t0 = time.monotonic()
-        case = generate_case(
-            SyntheticConfig(n_operations=12, n_traces=60, seed=0)
+        occupancies = sorted(
+            {int(o) for o in self.serve.warmup_occupancies}
         )
-        flag, nrm, abn = _detect_partition(
-            self.config, *_case_slo(case), case.abnormal
+        recorded = [
+            o
+            for o in manifest_occupancies(self.cache_dir, "serve")
+            if 1 <= o <= self.serve.max_batch_windows
+        ]
+        if recorded:
+            # Warm restart: a previous serve process left its program
+            # manifest — replay it (compiles are cache reloads).
+            record_compile_cache("warm_start")
+            occupancies = sorted(set(occupancies) | set(recorded))
+        kernel = warm_occupancies(
+            self.router, self.config, occupancies, probe=self.cache_probe
         )
-        if not flag or not nrm or not abn:  # pragma: no cover - fixed seed
-            self.log.warning("warmup case did not partition; skipping")
+        if kernel is None:
             return
-        graph, names, kernel = prepare_window_graph(
-            case.abnormal, nrm, abn, self.config
-        )
-
-        def _pw():
-            from concurrent.futures import Future
-
-            return PendingWindow(
-                request=RankRequest(request_id="warmup", tenant="warmup"),
-                result=WindowResult(start="", end="", anomaly=True),
-                span_df=case.abnormal,
-                normal_ids=nrm,
-                abnormal_ids=abn,
-                graph=graph,
-                op_names=names,
-                kernel=kernel,
-                future=Future(),
-                enqueued=time.monotonic(),
-                built=time.monotonic(),
-            )
-
-        occupancies = tuple(
-            int(o) for o in self.serve.warmup_occupancies
-        )
-        for occupancy in occupancies:
-            self.scheduler.batcher.dispatch(
-                [_pw() for _ in range(occupancy)], warmup=True
-            )
+        record_manifest_entry(self.cache_dir, "serve", kernel, occupancies)
         self.log.info(
-            "warmup: compiled batched rank program (occupancies %s, "
-            "kernel %s) in %.1fs",
-            list(occupancies), kernel, time.monotonic() - t0,
+            "warmup: batched rank program ready (occupancies %s, kernel "
+            "%s, compile cache %d hit / %d miss) in %.1fs",
+            occupancies, kernel, self.cache_probe.hits,
+            self.cache_probe.misses, time.monotonic() - t0,
         )
 
     # ----------------------------------------------------------- request
@@ -334,8 +337,9 @@ class ServeService:
         elif not self.scheduler.is_alive():
             # never started (direct-drive tests): flush parked work
             self.scheduler._stopping = True
-            for batch in self.scheduler.batcher.take_ready(force=True):
-                self.scheduler.batcher.dispatch(batch)
+            self.scheduler.batcher.dispatch_ready(
+                self.scheduler.batcher.take_ready(force=True)
+            )
         if self.build_pool is not None:
             self.build_pool.shutdown()
         if self.journal is not None:

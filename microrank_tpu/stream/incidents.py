@@ -9,7 +9,12 @@ an incident); consecutive windows whose fingerprints match — exactly or
 by Jaccard overlap >= ``fingerprint_jaccard``, absorbing top-k tail
 wobble across windows of the same fault — dedup into one OPEN incident
 that UPDATEs per window and RESOLVEs after ``resolve_after_windows``
-consecutive healthy windows. A resolved fingerprint enters a cooldown:
+consecutive healthy windows. Dedup is DRIFT-AWARE (PR 5): fingerprints
+carry the suspects' max-normalized score vector, and an update whose
+vector moved by more than ``score_drift`` (L-inf) flags
+``drifted: true`` — the suspect set looks the same but the fault is
+evolving (dominant suspect changing, a second cause joining), which an
+operator wants to see rather than have silently absorbed. A resolved fingerprint enters a cooldown:
 re-flagging within ``cooldown_windows`` windows is suppressed (counted,
 not alerted) — flap damping for faults straddling the detector's edge.
 
@@ -58,6 +63,31 @@ def _jaccard(a: FrozenSet[str], b: FrozenSet[str]) -> float:
     return len(a & b) / len(a | b)
 
 
+def suspect_scores(
+    ranking: Sequence[Tuple[str, float]], fingerprint: FrozenSet[str]
+) -> Dict[str, float]:
+    """The fingerprint members' scores, max-normalized so drift compares
+    score SHAPE (which suspect dominates) rather than absolute scale —
+    spectrum scores are only meaningful relative to the window."""
+    scores = {
+        str(n): float(s) for n, s in ranking if str(n) in fingerprint
+    }
+    peak = max((abs(s) for s in scores.values()), default=0.0)
+    if peak <= 0:
+        return {n: 0.0 for n in scores}
+    return {n: s / peak for n, s in scores.items()}
+
+
+def score_drift(a: Dict[str, float], b: Dict[str, float]) -> float:
+    """L-inf distance between two normalized suspect-score vectors over
+    the union of their supports (a missing suspect scores 0)."""
+    if not a and not b:
+        return 0.0
+    return max(
+        abs(a.get(n, 0.0) - b.get(n, 0.0)) for n in set(a) | set(b)
+    )
+
+
 @dataclass
 class Incident:
     incident_id: str
@@ -68,6 +98,11 @@ class Incident:
     healthy_streak: int = 0
     top: List[Tuple[str, float]] = field(default_factory=list)
     status: str = "open"           # open | resolved
+    # Normalized suspect-score vector at the last observation: the
+    # drift-aware dedup baseline (same top-k SET but a moved score
+    # vector -> update carries drifted:true instead of silent dedup).
+    scores: Dict[str, float] = field(default_factory=dict)
+    drift_events: int = 0
 
     def to_event(self, transition: str, **extra) -> dict:
         return {
@@ -139,12 +174,16 @@ class IncidentTracker:
         resolve_after: int = 2,
         cooldown_windows: int = 2,
         jaccard: float = 0.5,
+        score_drift: float = 0.25,
         sinks: Optional[List] = None,
     ):
         self.top_k = int(top_k)
         self.resolve_after = max(1, int(resolve_after))
         self.cooldown_windows = max(0, int(cooldown_windows))
         self.jaccard = float(jaccard)
+        # Drift-aware dedup threshold (L-inf over normalized suspect
+        # scores); <= 0 disables drift flagging.
+        self.score_drift = float(score_drift)
         self.sinks = list(sinks or [])
         self._open: Dict[FrozenSet[str], Incident] = {}
         self._cooldown: Dict[FrozenSet[str], int] = {}  # fp -> window#
@@ -182,12 +221,29 @@ class IncidentTracker:
             if _jaccard(fp, best.fingerprint) >= self.jaccard:
                 match = best
         if match is not None:
+            # Drift-aware dedup: same (or overlapping) suspect SET, but
+            # the normalized score vector moved past the threshold —
+            # the fault is evolving (a second root cause joining, the
+            # dominant suspect changing); the update says so instead of
+            # silently absorbing the window.
+            new_scores = suspect_scores(ranking, match.fingerprint | fp)
+            drift = score_drift(match.scores, new_scores)
+            drifted = bool(
+                self.score_drift > 0 and drift >= self.score_drift
+            )
             match.windows += 1
             match.healthy_streak = 0
             match.last_seen = window_start
             match.top = list(ranking)
+            match.scores = new_scores
+            if drifted:
+                match.drift_events += 1
             record_incident("update")
-            self._emit(match.to_event("update"))
+            self._emit(
+                match.to_event(
+                    "update", drifted=drifted, score_drift=round(drift, 4)
+                )
+            )
             return match
         # Cooldown: the same (or overlapping) fingerprint resolved
         # within the last cooldown_windows windows — suppress, count.
@@ -208,6 +264,7 @@ class IncidentTracker:
             opened_at=window_start,
             last_seen=window_start,
             top=list(ranking),
+            scores=suspect_scores(ranking, fp),
         )
         self._open[fp] = inc
         self.opened += 1

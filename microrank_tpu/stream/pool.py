@@ -84,5 +84,14 @@ class BuildWorkerPool:
         with self._lock:
             return self._inflight
 
-    def shutdown(self, wait: bool = True) -> None:
-        self._ex.shutdown(wait=wait)
+    def shutdown(self, wait: bool = True, cancel: bool = False) -> None:
+        """Stop the pool. ``cancel`` drops builds still QUEUED (never a
+        running one) — the fast path for an engine abort, where ranking
+        the remaining windows is pointless; callers that coalesce
+        pending builds (the dispatch router's burst grouping waits on
+        ``Future.result()``) must NOT cancel, or the waiters would see
+        CancelledError instead of a graph."""
+        try:
+            self._ex.shutdown(wait=wait, cancel_futures=cancel)
+        except TypeError:  # pragma: no cover - py<3.9 signature
+            self._ex.shutdown(wait=wait)

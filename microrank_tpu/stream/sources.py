@@ -141,11 +141,12 @@ class FileTailSource:
         self.sleep = sleep
 
     def __iter__(self) -> Iterator[pd.DataFrame]:
+        import io as _io
+
         from ..io import load_traces_csv
         from ..pipeline.follow import TailTracker
 
         tracker = TailTracker(idle_exit=self.idle_exit)
-        last_rows = 0
         polls = 0
         while True:
             polls += 1
@@ -153,10 +154,6 @@ class FileTailSource:
                 os.path.getsize(self.path) if self.path.exists() else -1
             )
             status = tracker.observe_size(size)
-            if tracker.rotated:
-                # The collector replaced the file: restart the row
-                # cursor with the re-read.
-                last_rows = 0
             if status != "grew":
                 if status == "exit":
                     log.info(
@@ -168,21 +165,34 @@ class FileTailSource:
                     return
                 self.sleep(self.poll_seconds)
                 continue
+            # Byte-offset incremental parse (TailTracker.read_appended):
+            # only the header + complete lines appended since the last
+            # successful parse reach pandas — O(appended) per poll, not
+            # O(file); rotation resets the cursor to a full re-read.
             try:
-                df = load_traces_csv(self.path)
+                appended = tracker.read_appended(self.path, size)
+                if appended is None:
+                    # Only a torn partial line so far: no-progress poll;
+                    # the cursor stays put and the bytes re-read later.
+                    if self.max_polls and polls >= self.max_polls:
+                        return
+                    self.sleep(self.poll_seconds)
+                    continue
+                payload, offset = appended
+                df = load_traces_csv(_io.BytesIO(payload))
             except (ValueError, OSError) as exc:
-                # Torn final line: error this poll, valid data the next
-                # (the tracker counts it toward idle_exit).
+                # Torn/corrupt tail: error this poll, valid data the
+                # next (the tracker counts it toward idle_exit; the
+                # cursor did not advance, so the slice re-feeds).
                 if tracker.parse_failed(exc) == "exit":
                     return
                 if self.max_polls and polls >= self.max_polls:
                     return
                 self.sleep(self.poll_seconds)
                 continue
-            tracker.parsed(size)
-            if len(df) > last_rows:
-                yield df.iloc[last_rows:]
-                last_rows = len(df)
+            tracker.parsed(size, offset=offset)
+            if len(df):
+                yield df
             if self.max_polls and polls >= self.max_polls:
                 return
             self.sleep(self.poll_seconds)

@@ -1,0 +1,465 @@
+"""Adaptive dispatch router (dispatch/): size-threshold + occupancy
+routing with tie-aware parity between the sharded and vmapped routes,
+burst coalescing (stream dispatches < abnormal windows under a
+same-bucket burst), double-buffered staging (prestage consumed by the
+next dispatch; correctness under an injected dispatch failure — the
+serve degrade path stays per-member), and the persistent compile cache
++ warmup manifest (warm restart replays recorded occupancies and
+observes cache hits). All on the 8-device virtual CPU mesh.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import (
+    DispatchConfig,
+    MicroRankConfig,
+    StreamConfig,
+)
+from microrank_tpu.dispatch import (
+    CompileCacheProbe,
+    DispatchRouter,
+    bucket_key,
+    load_manifest,
+    manifest_occupancies,
+    record_manifest_entry,
+    warm_occupancies,
+)
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.rank_backends.jax_tpu import (
+    graph_device_bytes,
+    prepare_window_graph,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture(scope="module")
+def prepared():
+    """One prepared abnormal window (graph already kernel-stripped)."""
+    cfg = MicroRankConfig()
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    nrm, abn = partition_case(case)
+    graph, names, kernel = prepare_window_graph(
+        case.abnormal, nrm, abn, cfg
+    )
+    return cfg, graph, names, kernel
+
+
+def _mesh_config(cfg, threshold=0, **dispatch_kw):
+    return cfg.replace(
+        runtime=dataclasses.replace(cfg.runtime, mesh_shape=(2, 4)),
+        dispatch=DispatchConfig(
+            sharded_bytes_threshold=threshold, **dispatch_kw
+        ),
+    )
+
+
+# ------------------------------------------------------------------ plan
+
+
+def test_plan_decision_table(prepared, registry):
+    cfg, graph, _, kernel = prepared
+    footprint = graph_device_bytes(graph)
+    assert footprint > 0
+
+    # No mesh: always vmapped, threshold irrelevant.
+    r = DispatchRouter(cfg.replace(dispatch=DispatchConfig(
+        sharded_bytes_threshold=0)))
+    assert r.plan([graph], kernel)[0] == "vmapped"
+
+    # Mesh + footprint below threshold + occupancy below windows axis.
+    r = DispatchRouter(_mesh_config(cfg, threshold=footprint * 10))
+    route, _, fp = r.plan([graph], kernel)
+    assert route == "vmapped" and fp == footprint
+
+    # Size trigger: batch footprint crosses the threshold.
+    route, shard_kernel, _ = r.plan([graph] * 20, kernel)
+    assert route == "sharded"
+    from microrank_tpu.parallel.sharded_rank import SHARD_KERNELS
+
+    assert shard_kernel in SHARD_KERNELS
+
+    # Occupancy trigger: windows axis (2) fills even under threshold.
+    assert r.plan([graph, graph], kernel)[0] == "sharded"
+    r_no_occ = DispatchRouter(
+        _mesh_config(
+            cfg,
+            threshold=footprint * 10,
+            shard_on_full_occupancy=False,
+        )
+    )
+    assert r_no_occ.plan([graph, graph], kernel)[0] == "vmapped"
+
+    # Zero threshold: everything a mesh can take shards.
+    r0 = DispatchRouter(_mesh_config(cfg, threshold=0))
+    assert r0.plan([graph], kernel)[0] == "sharded"
+
+
+# ---------------------------------------------------------- route parity
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+def test_route_parity_tie_aware(prepared, registry):
+    """The acceptance pin: sharded-route windows must match the vmapped
+    route's FULL ranked list, tie-aware (exact ties may legally permute
+    across summation trees; everything else is positional)."""
+    from microrank_tpu.utils.ranking_compare import (
+        tie_aware_topk_agreement,
+    )
+
+    cfg, graph, names, kernel = prepared
+    vm = DispatchRouter(cfg)
+    sh = DispatchRouter(_mesh_config(cfg, threshold=0))
+    outs_v, info_v = vm.rank_batch([graph] * 3, kernel)
+    outs_s, info_s = sh.rank_batch([graph] * 3, kernel)
+    assert info_v.route == "vmapped" and info_s.route == "sharded"
+    for b in range(3):
+        nv, ns = int(outs_v[2][b]), int(outs_s[2][b])
+        assert nv == ns
+        names_v = [names[int(i)] for i in outs_v[0][b][:nv]]
+        names_s = [names[int(i)] for i in outs_s[0][b][:ns]]
+        scores_v = [float(s) for s in outs_v[1][b][:nv]]
+        scores_s = [float(s) for s in outs_s[1][b][:ns]]
+        ok, detail = tie_aware_topk_agreement(
+            names_v, scores_v, names_s, scores_s, k=nv, rtol=1e-3
+        )
+        assert ok, detail
+    # Both routes recorded.
+    assert registry.get(
+        "microrank_dispatch_route_total"
+    ).value(route="vmapped") == 1
+    assert registry.get(
+        "microrank_dispatch_route_total"
+    ).value(route="sharded") == 1
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+def test_sharded_route_pads_batch_to_windows_axis(prepared, registry):
+    # 3 windows on a (2, 4) mesh: padded to 4 internally, sliced back.
+    cfg, graph, _, kernel = prepared
+    r = DispatchRouter(_mesh_config(cfg, threshold=0))
+    outs, info = r.rank_batch([graph] * 3, kernel)
+    assert info.route == "sharded" and info.windows == 3
+    assert all(np.asarray(o).shape[0] == 3 for o in outs)
+
+
+# -------------------------------------------------------- double buffer
+
+
+def test_double_buffer_prestage_consumed(prepared, registry):
+    cfg, graph, _, kernel = prepared
+    r = DispatchRouter(cfg)
+    b1, b2 = [graph], [graph, graph]
+    _, info1 = r.rank_batch(b1, kernel, next_batch=(b2, kernel))
+    assert not info1.prestaged
+    assert r._prestaged is not None
+    _, info2 = r.rank_batch(b2, kernel)
+    assert info2.prestaged          # staging happened behind batch 1
+    assert r._prestaged is None
+    # Overlapped staging seconds landed in the metric.
+    assert (
+        registry.get(
+            "microrank_dispatch_overlap_seconds_total"
+        ).value()
+        > 0
+    )
+    # A mismatched prestage is dropped, not misused.
+    _, info3 = r.rank_batch(b1, kernel, next_batch=(b2, kernel))
+    _, info4 = r.rank_batch(b1, kernel)     # NOT the prestaged batch
+    assert not info4.prestaged
+
+
+def test_double_buffer_survives_dispatch_failure(prepared, registry):
+    """Injected dispatch failure between prestage and consume: the
+    failing batch raises to its caller (serve retries then degrades
+    per-member), the prestaged NEXT batch still dispatches correctly,
+    and a retry of the failed batch restages cleanly."""
+    cfg, graph, _, kernel = prepared
+    r = DispatchRouter(cfg)
+    orig = r._dispatch_program
+    fail = {"n": 0}
+
+    def flaky(staged, conv):
+        if fail["n"] > 0:
+            fail["n"] -= 1
+            raise RuntimeError("injected dispatch failure")
+        return orig(staged, conv)
+
+    r._dispatch_program = flaky
+    b1, b2 = [graph], [graph, graph]
+    r.rank_batch(b1, kernel, next_batch=(b2, kernel))  # prestages b2
+    fail["n"] = 1
+    with pytest.raises(RuntimeError, match="injected"):
+        r.rank_batch(b2, kernel)       # consumed prestage, then failed
+    # Retry restages from scratch and succeeds.
+    outs, info = r.rank_batch(b2, kernel)
+    assert not info.prestaged and int(outs[2][0]) > 0
+
+
+def test_serve_degrade_stays_per_member_with_double_buffer(registry):
+    """Two ready batches through the serve batcher's pipelined
+    dispatch_ready: the first batch's dispatch fails twice (injected)
+    and degrades to numpy_ref PER MEMBER; the second batch — whose
+    staging was already double-buffered behind the failing dispatch —
+    still ranks on device."""
+    from concurrent.futures import Future
+
+    from microrank_tpu.config import ServeConfig
+    from microrank_tpu.pipeline.results import WindowResult
+    from microrank_tpu.serve import RankRequest, ServeService
+    from microrank_tpu.serve.batcher import PendingWindow
+
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    cfg = MicroRankConfig(
+        serve=ServeConfig(warmup=False, inject_dispatch_failures=2)
+    )
+    svc = ServeService(cfg)
+    svc.fit_baseline(case.normal)
+    nrm, abn = partition_case(case)
+    graph, names, kernel = prepare_window_graph(
+        case.abnormal, nrm, abn, cfg
+    )
+
+    import time as _t
+
+    def _pw(rid):
+        return PendingWindow(
+            request=RankRequest(request_id=rid, tenant="t"),
+            result=WindowResult(start="", end="", anomaly=True),
+            span_df=case.abnormal,
+            normal_ids=nrm,
+            abnormal_ids=abn,
+            graph=graph,
+            op_names=names,
+            kernel=kernel,
+            future=Future(),
+            enqueued=_t.monotonic(),
+            built=_t.monotonic(),
+        )
+
+    batch1 = [_pw("a1"), _pw("a2")]
+    batch2 = [_pw("b1")]
+    svc.scheduler.batcher.dispatch_ready([batch1, batch2])
+    # Batch 1: both members answered by the numpy_ref fallback.
+    for pw in batch1:
+        res = pw.future.result(timeout=60)
+        assert res.degraded and res.ranking
+        assert res.kernel == "numpy_ref"
+    # Batch 2: device path, not degraded.
+    res2 = batch2[0].future.result(timeout=60)
+    assert not res2.degraded and res2.ranking
+    assert res2.route == "vmapped"
+
+
+# ------------------------------------------------------ burst coalescing
+
+
+def test_stream_burst_coalesces_dispatches(registry, tmp_path):
+    """The acceptance invariant: a same-bucket abnormal burst produces
+    FEWER device dispatches than ranked windows — pending windows
+    coalesce into the head's vmapped dispatch."""
+    from microrank_tpu.stream import StreamEngine, SyntheticSource
+
+    src = SyntheticSource(
+        n_windows=8,
+        faulted=[3, 4, 5],
+        synth_config=SyntheticConfig(
+            n_operations=24, n_traces=200, n_kinds=16, seed=5
+        ),
+        pace_seconds=0.0,
+        sleep=lambda s: None,
+    )
+    cfg = MicroRankConfig(
+        stream=StreamConfig(
+            allowed_lateness_seconds=5.0, pipeline_windows=3
+        ),
+        # Session-local cache dir so the manifest test below is isolated.
+        dispatch=DispatchConfig(),
+    )
+    import os
+
+    os.environ["MICRORANK_JIT_CACHE"] = str(tmp_path / "jit")
+    try:
+        eng = StreamEngine(cfg, src, out_dir=tmp_path)
+        s = eng.run()
+    finally:
+        os.environ.pop("MICRORANK_JIT_CACHE", None)
+    assert s.ranked == 3
+    assert s.dispatches < s.ranked, (s.dispatches, s.ranked)
+    disp_metric = registry.get(
+        "microrank_stream_dispatches_total"
+    ).value()
+    assert disp_metric == s.dispatches
+    # Coalesced windows carry their shared occupancy + route.
+    ranked = [r for r in s.results if r.ranking]
+    assert any((r.batch_windows or 1) > 1 for r in ranked)
+    assert all(r.route == "vmapped" for r in ranked)
+    # Window order was preserved through the group dispatch.
+    assert [r.start for r in s.results] == sorted(
+        r.start for r in s.results
+    )
+    # One deduped incident for the whole burst, resolved after recovery.
+    assert s.incidents_opened == 1 and s.incidents_resolved == 1
+    # The engine's manifest recorded the warmed occupancies for restart.
+    occs = manifest_occupancies(str(tmp_path / "jit"), "stream")
+    assert occs and max(occs) >= 2
+
+
+def test_coalesce_respects_cap_and_bucket(prepared, registry):
+    """coalesce_windows=1 disables coalescing entirely."""
+    from microrank_tpu.stream import StreamEngine, SyntheticSource
+
+    src = SyntheticSource(
+        n_windows=8,
+        faulted=[3, 4, 5],
+        synth_config=SyntheticConfig(
+            n_operations=24, n_traces=200, n_kinds=16, seed=5
+        ),
+        pace_seconds=0.0,
+        sleep=lambda s: None,
+    )
+    cfg = MicroRankConfig(
+        stream=StreamConfig(
+            allowed_lateness_seconds=5.0, pipeline_windows=3
+        ),
+        dispatch=DispatchConfig(coalesce_windows=1, warmup_manifest=False),
+    )
+    eng = StreamEngine(cfg, src)
+    s = eng.run()
+    assert s.ranked == 3 and s.dispatches == 3
+
+
+# ------------------------------------------------- compile cache/manifest
+
+
+def test_manifest_merge_round_trip(tmp_path, registry):
+    cache = str(tmp_path / "jit")
+    assert load_manifest(cache) == []
+    record_manifest_entry(cache, "serve", "packed_bf16", [1, 2])
+    record_manifest_entry(cache, "serve", "packed_bf16", [2, 4])
+    record_manifest_entry(cache, "stream", "csr", [1])
+    entries = load_manifest(cache)
+    assert len(entries) == 2
+    assert manifest_occupancies(cache, "serve") == [1, 2, 4]
+    assert manifest_occupancies(cache, "stream") == [1]
+    assert manifest_occupancies(None, "serve") == []
+    # Corrupt manifest is ignored, not fatal.
+    (tmp_path / "jit" / "warmup_manifest.json").write_text("{nope")
+    assert load_manifest(cache) == []
+    assert (
+        registry.get("microrank_compile_cache_events_total").value(
+            event="manifest_write"
+        )
+        == 3
+    )
+
+
+def test_warmup_probe_classifies_hits(prepared, registry, tmp_path):
+    """Warm restart shape, in-process: with the jit tracing caches
+    cleared (= a fresh process), the first warmup pass over a fresh
+    persistent cache dir compiles for real (misses land entries on
+    disk); clearing again and re-warming observes no entry growth —
+    every compile reloaded from the persistent cache (hits)."""
+    import os
+
+    import jax as _jax
+
+    from microrank_tpu.dispatch import configure_compile_cache
+
+    cfg, _, _, _ = prepared
+    cache = tmp_path / "jit"
+    os.environ["MICRORANK_JIT_CACHE"] = str(cache)
+    try:
+        assert configure_compile_cache(None) == str(cache)
+        router = DispatchRouter(cfg)
+        _jax.clear_caches()                # simulate a fresh process
+        probe = CompileCacheProbe(str(cache))
+        warm_occupancies(router, cfg, [1, 2], probe=probe)
+        first_misses = probe.misses
+        assert first_misses >= 1           # cold: programs persisted
+        _jax.clear_caches()                # second "process"
+        probe2 = CompileCacheProbe(str(cache))
+        warm_occupancies(router, cfg, [1, 2], probe=probe2)
+        assert probe2.misses == 0 and probe2.hits == 2
+        reg = registry.get("microrank_compile_cache_events_total")
+        assert reg.value(event="hit") >= 2
+        assert reg.value(event="miss") == first_misses
+    finally:
+        os.environ.pop("MICRORANK_JIT_CACHE", None)
+        _jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_stream_warm_restart_replays_manifest(registry, tmp_path):
+    """A second engine over the same cache dir finds the first run's
+    manifest, replays its occupancies at startup (warm_start event),
+    and the replayed compiles hit the persistent cache."""
+    import os
+
+    from microrank_tpu.stream import StreamEngine, SyntheticSource
+
+    def _run():
+        src = SyntheticSource(
+            n_windows=6,
+            faulted=[2],
+            synth_config=SyntheticConfig(
+                n_operations=16, n_traces=120, n_kinds=12, seed=9
+            ),
+            pace_seconds=0.0,
+            sleep=lambda s: None,
+        )
+        cfg = MicroRankConfig(
+            stream=StreamConfig(allowed_lateness_seconds=5.0)
+        )
+        return StreamEngine(cfg, src).run()
+
+    os.environ["MICRORANK_JIT_CACHE"] = str(tmp_path / "jit")
+    try:
+        s1 = _run()
+        assert s1.ranked == 1
+        assert manifest_occupancies(str(tmp_path / "jit"), "stream")
+        reg1 = get_registry().get("microrank_compile_cache_events_total")
+        assert reg1.value(event="warm_start") == 0
+        s2 = _run()
+        assert s2.ranked == 1
+        reg = get_registry().get("microrank_compile_cache_events_total")
+        assert reg.value(event="warm_start") == 1
+        assert reg.value(event="hit") >= 1
+    finally:
+        os.environ.pop("MICRORANK_JIT_CACHE", None)
+
+
+# ------------------------------------------------------------ bucket key
+
+
+def test_bucket_key_separates_shapes_and_kernels(prepared):
+    cfg, graph, _, kernel = prepared
+    assert bucket_key(graph, kernel) == bucket_key(graph, kernel)
+    assert bucket_key(graph, kernel) != bucket_key(graph, "coo")
+    other = generate_case(
+        SyntheticConfig(n_operations=48, n_traces=300, seed=3)
+    )
+    nrm, abn = partition_case(other)
+    g2, _, k2 = prepare_window_graph(other.abnormal, nrm, abn, cfg)
+    assert bucket_key(g2, kernel) != bucket_key(graph, kernel)

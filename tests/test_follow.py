@@ -124,6 +124,86 @@ def test_follow_restart_resumes_from_cursor(timeline, tmp_path):
     assert ranked == sorted(ranked)
 
 
+def test_tail_tracker_incremental_read(tmp_path):
+    """Byte-offset incremental parse (PR 5): read_appended feeds the
+    parser only the header + complete lines appended since the last
+    successful parse; a torn trailing line holds the cursor; rotation
+    falls back to a full re-read."""
+    import os
+
+    from microrank_tpu.pipeline.follow import TailTracker
+
+    path = tmp_path / "grow.csv"
+    header = b"a,b\n"
+    path.write_bytes(header + b"1,2\n3,4\n")
+    tr = TailTracker()
+    size = os.path.getsize(path)
+    assert tr.observe_size(size) == "grew"
+    payload, off = tr.read_appended(path, size)
+    assert payload == header + b"1,2\n3,4\n" and off == size
+    tr.parsed(size, offset=off)
+
+    # Append two rows + a TORN third: only the complete rows return,
+    # prefixed by the cached header; the cursor stops at the newline.
+    with open(path, "ab") as f:
+        f.write(b"5,6\n7,8\n9,")
+    size = os.path.getsize(path)
+    assert tr.observe_size(size) == "grew"
+    payload, off = tr.read_appended(path, size)
+    assert payload == header + b"5,6\n7,8\n"
+    assert off == size - len(b"9,")
+    tr.parsed(size, offset=off)
+
+    # Nothing but the torn tail: no complete line -> None, cursor holds.
+    assert tr.read_appended(path, size) is None
+
+    # The torn line completes: exactly it returns.
+    with open(path, "ab") as f:
+        f.write(b"10\n")
+    size = os.path.getsize(path)
+    payload, off = tr.read_appended(path, size)
+    assert payload == header + b"9,10\n" and off == size
+    tr.parsed(size, offset=off)
+
+    # Rotation: file replaced smaller -> cursor resets, full re-read.
+    path.write_bytes(header + b"x,y\n")
+    size = os.path.getsize(path)
+    assert tr.observe_size(size) == "grew"  # shrank then counted grown
+    assert tr.rotated and tr.parsed_offset == 0
+    payload, off = tr.read_appended(path, size)
+    assert payload == header + b"x,y\n" and off == size
+
+
+def test_file_tail_source_parses_only_appended_bytes(tmp_path):
+    """The streaming tail's ingest cost is O(appended), not O(file):
+    the bytes handed to the parser across all polls stay close to
+    file-size + per-poll headers, nowhere near the quadratic total a
+    whole-file re-parse per poll pays."""
+    from microrank_tpu.stream.sources import FileTailSource
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+
+    case = generate_case(
+        SyntheticConfig(n_operations=10, n_traces=60, seed=2)
+    )
+    df = case.normal
+    csv = tmp_path / "grow.csv"
+    n_chunks = 5
+    chunk = len(df) // n_chunks
+    df.iloc[:chunk].to_csv(csv, index=False)
+    src = FileTailSource(
+        csv, poll_seconds=0, max_polls=n_chunks + 1, sleep=lambda s: None
+    )
+    it = iter(src)
+    got = [next(it)]
+    for i in range(1, n_chunks):
+        lo, hi = i * chunk, (i + 1) * chunk if i < n_chunks - 1 else len(df)
+        df.iloc[lo:hi].to_csv(csv, mode="a", header=False, index=False)
+        got.append(next(it))
+    assert sum(len(g) for g in got) == len(df)
+    # Each poll yielded exactly the appended rows (no re-yields).
+    assert [len(g) for g in got][:-1] == [chunk] * (n_chunks - 1)
+
+
 def test_follow_requires_out_dir(timeline, tmp_path):
     tl = timeline
     csv = tmp_path / "stream.csv"

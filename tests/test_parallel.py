@@ -120,6 +120,62 @@ def test_sharded_csr_matches_coo():
         )
 
 
+def test_compensated_psum_cross_shard_parity():
+    """The ROADMAP compensated-scan item, evaluated for the coo path
+    (PR 5). Entry-axis sharding splits a row's entries at fixed block
+    boundaries, so the cross-shard combine reassociates vs the
+    single-device segment sum — superficially the csr prefix-scan bug's
+    shape. The evaluation's conclusion (pinned here): the combine order
+    is NOT the dominant rounding source — the per-shard partials carry
+    their own f32 rounding that no combine fix recovers — so the
+    compensated all-gather TwoSum fold (opt-in,
+    PageRankConfig.compensated_psum) and the plain psum must BOTH match
+    the single-device coo ranking within the same small tolerance,
+    across two shard counts. Measured drift ~1.7e-6 either way; the
+    regression bound leaves ~30x headroom."""
+    import dataclasses
+
+    cfg = MicroRankConfig()
+    assert not cfg.pagerank.compensated_psum  # evaluated, default off
+    graphs = []
+    for seed in (5, 6, 7, 8):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        graph, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+        graphs.append(graph)
+    single = rank_windows_batched(
+        stack_window_graphs(graphs), cfg.pagerank, cfg.spectrum, "coo"
+    )
+    for compensated in (False, True):
+        pk = dataclasses.replace(
+            cfg.pagerank, compensated_psum=compensated
+        )
+        for shards in (4, 8):
+            mesh = make_mesh((1, shards))
+            stacked = stack_window_graphs(graphs, shard_multiple=shards)
+            sti, sts, _ = rank_windows_sharded(
+                jax.tree.map(jnp.asarray, stacked),
+                pk,
+                cfg.spectrum,
+                mesh,
+                "coo",
+            )
+            for b in range(len(graphs)):
+                n = int(single[2][b])
+                a = np.asarray(single[1][b][:n], np.float64)
+                s = np.asarray(sts[b][:n], np.float64)
+                fin = np.isfinite(a) & np.isfinite(s)
+                rel = np.abs(a[fin] - s[fin]) / np.maximum(
+                    np.abs(a[fin]), 1e-12
+                )
+                assert rel.max() < 5e-5, (compensated, shards, b, rel.max())
+                _assert_rank_equal_tieaware(
+                    single[0][b], single[1][b], sti[b], sts[b], rtol=5e-5
+                )
+
+
 def test_shard_only_mesh(window_batch):
     # Pure graph-parallelism: 1 window across all 8 devices.
     graphs, namelists = window_batch

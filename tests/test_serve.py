@@ -518,8 +518,10 @@ def test_warmup_occupancies_configurable(case, registry):
     svc.start()
     try:
         # Exactly one warmup dispatch (occupancy 1) instead of the old
-        # hardcoded {1, 2}.
-        assert svc.scheduler.batcher.dispatches == 1
+        # hardcoded {1, 2}. Warmup goes through the router directly
+        # (PR 5), so the router counts it; the batcher never sees it.
+        assert svc.router.dispatches == 1
+        assert svc.scheduler.batcher.dispatches == 0
     finally:
         svc.shutdown()
 

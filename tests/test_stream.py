@@ -140,6 +140,65 @@ def test_p2_quantile_tracks_numpy():
     assert abs(p2.value() - exact) / exact < 0.05
 
 
+def test_p2_batch_update_matches_scalar():
+    """The vectorized marker update (PR 5, the ROADMAP stream
+    follow-up): window-shaped batches through update_batch must land on
+    the same quantile as the per-sample scalar path — exactly through
+    the 5-sample seed phase, and within a few percent of both the
+    scalar estimator and the true quantile thereafter (the chunked
+    batch form freezes marker heights within a chunk, so trajectories
+    differ; destinations must not)."""
+    rng = np.random.default_rng(3)
+    # Seed-phase exactness: fewer than five samples is bit-identical.
+    for n in (1, 3, 5):
+        xs = rng.lognormal(size=n)
+        a, b = P2Quantile(0.9), P2Quantile(0.9)
+        for x in xs:
+            a.update(x)
+        b.update_batch(xs)
+        assert a.value() == b.value()
+        assert a.heights == b.heights and a.n == b.n
+    for q in (0.5, 0.9, 0.99):
+        xs = rng.lognormal(mean=1.0, sigma=0.6, size=6000)
+        scalar, batch = P2Quantile(q), P2Quantile(q)
+        for x in xs:
+            scalar.update(x)
+        # Feed window-sized batches — the engine's actual call shape.
+        for lo in range(0, len(xs), 400):
+            batch.update_batch(xs[lo : lo + 400])
+        exact = float(np.quantile(xs, q))
+        assert batch.n == scalar.n == len(xs)
+        assert abs(batch.value() - exact) / exact < 0.08, q
+        assert (
+            abs(batch.value() - scalar.value())
+            / max(abs(scalar.value()), 1e-12)
+            < 0.08
+        ), q
+
+
+def test_online_baseline_batch_percentile_matches_scalar_loop():
+    """OnlineBaseline.update now feeds P^2 via update_batch; the
+    resulting percentile baseline must match a scalar-fed twin."""
+    rng = np.random.default_rng(11)
+    n = 500
+    frame = _op_frame(1.0, n=n)
+    frame["duration"] = (
+        rng.lognormal(mean=2.0, sigma=0.5, size=n) * 1000
+    ).astype(int)
+    ob = OnlineBaseline(decay=0.5, slo_stat="p90")
+    ob.update(frame)
+    scalar = P2Quantile(0.9)
+    for x in np.sort(frame["duration"].to_numpy()) / 1000.0:
+        # any order works for the reference; use sorted for determinism
+        scalar.update(x)
+    _, base = ob.snapshot()
+    assert (
+        abs(base.mean_ms[0] - scalar.value())
+        / max(abs(scalar.value()), 1e-12)
+        < 0.15
+    )
+
+
 def _op_frame(dur_ms, op="opA", n=20, tag="t"):
     return pd.DataFrame(
         {
@@ -270,6 +329,40 @@ def test_incident_tracker_jaccard_dedups_tail_wobble(registry):
     )
     assert other.incident_id != inc.incident_id
     assert tr.opened == 2
+
+
+def test_incident_update_flags_score_drift(registry):
+    """Drift-aware dedup (PR 5): same top-k suspect SET but a moved
+    score vector -> the update event carries drifted:true instead of a
+    silent dedup; a stable vector stays drifted:false."""
+    events = []
+
+    class Sink:
+        def emit(self, e):
+            events.append(e)
+
+    tr = IncidentTracker(
+        top_k=3, resolve_after=2, score_drift=0.25, sinks=[Sink()]
+    )
+    tr.observe_ranked("w1", [("a", 1.0), ("b", 0.8), ("c", 0.6)])
+    # Same set, same shape: plain update.
+    tr.observe_ranked("w2", [("a", 1.0), ("b", 0.81), ("c", 0.6)])
+    # Same set, dominant suspect flipped: drifted update.
+    inc = tr.observe_ranked("w3", [("b", 1.0), ("a", 0.4), ("c", 0.35)])
+    assert tr.opened == 1 and inc.windows == 3
+    assert inc.drift_events == 1
+    kinds = [(e["event"], e.get("drifted")) for e in events]
+    assert kinds == [
+        ("incident_open", None),
+        ("incident_update", False),
+        ("incident_update", True),
+    ]
+    assert events[2]["score_drift"] >= 0.25
+    # score_drift <= 0 disables flagging entirely.
+    tr2 = IncidentTracker(top_k=3, score_drift=0.0, sinks=[])
+    tr2.observe_ranked("w1", [("a", 1.0), ("b", 0.8)])
+    inc2 = tr2.observe_ranked("w2", [("b", 1.0), ("a", 0.1)])
+    assert inc2.drift_events == 0
 
 
 def test_webhook_sink_counts_failures_without_raising():
