@@ -37,7 +37,13 @@ single-buffer blob staging (one transfer) with per-leaf device_put
 the adaptive-router replay (PR 5; BENCH_ROUTER=0 skips): group-wise
 dispatch through dispatch.DispatchRouter with double-buffered staging —
 the artifact gains "route" (vmapped/sharded), "overlap_ms" (staging
-hidden behind rank) and a "router" block with ms/window.
+hidden behind rank) and a "router" block with ms/window. Every run also
+benches the GIANT-WINDOW tier (PR 6; BENCH_GIANT=0 skips,
+BENCH_GIANT_SPANS/BENCH_GIANT_OPS size it): a ~10M-span synthetic
+window past the DEFAULT bitmap budget, ranked by the partition-centric
+pcsr kernel AND the legacy csr fallback — the artifact's "giant" block
+records per-kernel ms_per_iter, staged HBM footprints, the would-be
+bitmap bytes, tie-aware oracle parity, and speedup_pcsr_vs_csr.
 Details go to stderr; stdout carries only the JSON line.
 
 Reference baseline context: the reference's PageRank Scorer takes 5.5 s
@@ -358,6 +364,20 @@ def _analytic_iter_cost(graph, kernel):
             c = int(p.ss_child.shape[-1])
             flops += 4.0 * (2.0 * e + c)
             bytes_ += 20.0 * (2.0 * e + c)
+        elif kernel == "pcsr":
+            # Partition-centric streaming: each binned entry is visited
+            # once per direction (indices + vals + small-range gathered
+            # operand + segment-sum write ≈ 20 B, ~4 flops, like csr —
+            # the win is that the operand reads are CONTIGUOUS slices /
+            # small ranges instead of T-range random gathers), plus one
+            # streamed pass over the trace-axis slabs per direction.
+            e = int(
+                p.pc_trace.shape[-2] * p.pc_trace.shape[-1]
+                + p.pc_ell_op.shape[-2] * p.pc_ell_op.shape[-1]
+            )
+            c = int(p.ss_child.shape[-1])
+            flops += 4.0 * (e + c)
+            bytes_ += 16.0 * (e + c) + 8.0 * tp
         else:
             raise ValueError(f"no analytic model for kernel {kernel!r}")
     return flops, bytes_
@@ -393,7 +413,7 @@ def _time_median(fn, repeats: int) -> float:
 
 def _profile_device_time(
     run_at_iters, base_iters: int, t_lo: float, graph, kernel: str,
-    repeats: int,
+    repeats: int, extra: int | None = None,
 ):
     """Isolate device compute from the ~100 ms tunnel RPC floor: time
     the same program with (base + BENCH_PROFILE_EXTRA) loop iterations
@@ -406,7 +426,8 @@ def _profile_device_time(
     ``run_at_iters(n)`` runs + fetches the program with an n-step loop;
     ``t_lo`` is the already-measured median at ``base_iters``.
     """
-    extra = int(os.environ.get("BENCH_PROFILE_EXTRA", 250))
+    if extra is None:
+        extra = int(os.environ.get("BENCH_PROFILE_EXTRA", 250))
     # The difference must clear the host/RPC timing noise (~±10 ms on
     # the tunnel) or the slope is garbage — keep raising the extra trip
     # count until the delta is comfortably above it.
@@ -874,6 +895,176 @@ def _run_router(cfg, spans_per_window, n_ops, fault_ms, n_windows):
     }
 
 
+def _synthesize_giant_partition(rng, n_ops_v, n_traces, spans_per_trace):
+    """Span-level int arrays for one giant partition: every trace draws
+    ``spans_per_trace`` ops uniformly from the vocab (nearly every trace
+    is a distinct kind, so trace-kind collapse CANNOT shrink this window
+    — the whole point is the raw trace axis), plus a small random call
+    edge set."""
+    import numpy as np
+
+    spans = n_traces * spans_per_trace
+    g_trace = np.repeat(
+        np.arange(n_traces, dtype=np.int64), spans_per_trace
+    )
+    op_codes = rng.integers(0, n_ops_v, size=spans, dtype=np.int64)
+    n_edges = n_ops_v * 4
+    child = rng.integers(0, n_ops_v, size=n_edges, dtype=np.int64)
+    parent = rng.integers(0, n_ops_v, size=n_edges, dtype=np.int64)
+    return op_codes, g_trace, child, parent
+
+
+def _run_giant(cfg, repeats: int) -> dict:
+    """The 10M-span giant-window tier (ROADMAP item 2): a synthetic
+    window whose per-trace bitmap blows the DEFAULT bitmap budget —
+    packed/packed_blocked cannot even be built — so the memory-bounded
+    fallback IS the path, and the artifact records the csr -> pcsr
+    delta (per-kernel ms_per_iter via the trip-count-differencing
+    profile, staged HBM footprints, tie-aware rank parity vs the
+    float64 sparse oracle on the full window).
+
+    Sizes via env: BENCH_GIANT_SPANS (default 10_485_760),
+    BENCH_GIANT_OPS (2048). No CSV/pandas anywhere — the case is about
+    kernel time, so the span-level int arrays feed the real graph build
+    (graph.build._build_partition) directly.
+    """
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+
+    from microrank_tpu.graph.build import (
+        DEFAULT_DENSE_BUDGET_BYTES,
+        _build_partition,
+        packed_bits_bytes,
+        resolve_aux,
+    )
+    from microrank_tpu.graph.structures import WindowGraph, pad_to
+    from microrank_tpu.rank_backends.jax_tpu import (
+        device_subset,
+        graph_device_bytes,
+    )
+    from microrank_tpu.rank_backends.sparse_oracle import rank_window_sparse
+
+    spans_target = int(os.environ.get("BENCH_GIANT_SPANS", 10_485_760))
+    n_ops_v = int(os.environ.get("BENCH_GIANT_OPS", 2048))
+    spans_per_trace = 4
+    n_traces = spans_target // (2 * spans_per_trace)  # per partition
+    v_pad = pad_to(n_ops_v, "pow2q", 8)
+    rng = np.random.default_rng(12)
+
+    t0 = time.perf_counter()
+    parts = []
+    for _ in range(2):
+        op_codes, g_trace, child, parent = _synthesize_giant_partition(
+            rng, n_ops_v, n_traces, spans_per_trace
+        )
+        # aux="all" builds every view family once; each kernel's staging
+        # strips to what it reads (device_subset), so footprints stay
+        # honest. The POLICY decision is asserted below instead.
+        part, _ = _build_partition(
+            op_codes, g_trace, child, parent, n_ops_v, v_pad,
+            "pow2q", 8, aux="all",
+        )
+        parts.append(part)
+    graph = WindowGraph(normal=parts[0], abnormal=parts[1])
+    t_pads = tuple(
+        int(p.kind.shape[0]) for p in (graph.normal, graph.abnormal)
+    )
+    bits_bytes = packed_bits_bytes(v_pad, t_pads)
+    assert (
+        resolve_aux("auto", v_pad, t_pads, DEFAULT_DENSE_BUDGET_BYTES)
+        == "pcsr"
+    ), "giant case must sit past the bitmap budget; grow BENCH_GIANT_SPANS"
+    entries = sum(
+        int(p.n_inc) for p in (graph.normal, graph.abnormal)
+    )
+    log(
+        f"giant window: {2 * n_traces * spans_per_trace} spans, "
+        f"{entries} incidence entries, t_pads {t_pads}, would-be bitmap "
+        f"{bits_bytes / 1e6:.0f} MB (budget quarter "
+        f"{DEFAULT_DENSE_BUDGET_BYTES // 4 / 1e6:.0f} MB) — past the "
+        f"bitmap budget; built in {time.perf_counter() - t0:.1f}s"
+    )
+
+    names = [f"op{i:05d}" for i in range(n_ops_v)]
+    t0 = time.perf_counter()
+    top_o, sc_o = rank_window_sparse(
+        graph, names, cfg.pagerank, cfg.spectrum
+    )
+    oracle_s = time.perf_counter() - t0
+    log(f"float64 sparse oracle on the giant window: {oracle_s:.1f}s")
+
+    out = {
+        "case": {
+            "spans": 2 * n_traces * spans_per_trace,
+            "entries": entries,
+            "v_pad": v_pad,
+            "t_pads": list(t_pads),
+            "bitmap_bytes_would_be": bits_bytes,
+            "past_bitmap_budget": True,
+        },
+        "oracle_s": round(oracle_s, 1),
+        "kernels": {},
+    }
+    base_iters = 2
+    for kernel in ("pcsr", "csr"):
+        handle, n_bytes, stage_s = _stage_once(graph, kernel)
+
+        def run_iters(n, h=handle, kern=kernel):
+            return jax.device_get(
+                _rank_call(
+                    h,
+                    _dc.replace(cfg.pagerank, iterations=n),
+                    cfg.spectrum,
+                    kern,
+                )
+            )
+
+        # Full-iteration run once: tie-aware top-5 parity vs the oracle.
+        t0 = time.perf_counter()
+        ti, ts, nv = run_iters(cfg.pagerank.iterations)
+        full_s = time.perf_counter() - t0
+        n = int(nv)
+        parity = _tie_aware_topk_parity(
+            [names[int(i)] for i in np.asarray(ti)[:n]],
+            [float(s) for s in np.asarray(ts)[:n]],
+            top_o,
+            sc_o,
+            k=5,
+        )
+        log(
+            f"[giant {kernel}] full {cfg.pagerank.iterations}-iter rank: "
+            f"{full_s:.1f}s (compile incl.); top-5 tie-aware parity vs "
+            f"oracle: {parity}"
+        )
+        t_lo = _time_median(lambda: run_iters(base_iters), repeats)
+        prof = _profile_device_time(
+            run_iters, base_iters, t_lo, graph, kernel, repeats,
+            extra=int(os.environ.get("BENCH_GIANT_EXTRA", 4)),
+        )
+        out["kernels"][kernel] = {
+            **prof,
+            "ms_per_iter": round(prof["per_iter_us"] / 1e3, 3),
+            "hbm_footprint_bytes": graph_device_bytes(
+                device_subset(graph, kernel)
+            ),
+            "staged_bytes": n_bytes,
+            "staging_s": round(stage_s, 2),
+            "parity_top5_vs_oracle": parity,
+        }
+        del handle
+    pc = out["kernels"]["pcsr"]["per_iter_us"]
+    cs = out["kernels"]["csr"]["per_iter_us"]
+    out["speedup_pcsr_vs_csr"] = round(cs / pc, 2) if pc else None
+    log(
+        f"giant-window csr->pcsr per-iter speedup: "
+        f"{out['speedup_pcsr_vs_csr']}x "
+        f"({cs:.0f} -> {pc:.0f} us/iter)"
+    )
+    return out
+
+
 def main() -> int:
     config_key = os.environ.get("BENCH_CONFIG", "5")
     preset = CONFIG_PRESETS.get(config_key)
@@ -1048,12 +1239,14 @@ def main() -> int:
             )
 
         try:
-            if kernel in ("packed", "packed_bf16", "packed_blocked", "csr"):
+            if kernel in (
+                "packed", "packed_bf16", "packed_blocked", "csr", "pcsr",
+            ):
                 device_profile[kernel] = _profile_device_time(
                     run_iters, cfg.pagerank.iterations, rank_s, graph,
                     kernel, repeats,
                 )
-            for other in ("csr", "packed_bf16", "packed_blocked"):
+            for other in ("pcsr", "csr", "packed_bf16", "packed_blocked"):
                 if other == kernel or other in device_profile:
                     continue
                 # Forced aux builds ignore the budgets the auto policy
@@ -1235,6 +1428,16 @@ def main() -> int:
                 routed = None
             if routed is not None:
                 result.update(routed)
+
+    # Giant-window tier (ROADMAP item 2): a 10M-span synthetic window
+    # past the DEFAULT bitmap budget — the memory-bounded fallback's
+    # home turf — recording the csr -> pcsr per-iteration delta and
+    # per-kernel staged footprints. BENCH_GIANT=0 skips.
+    if os.environ.get("BENCH_GIANT", "1") != "0":
+        try:
+            result["giant"] = _run_giant(cfg, repeats)
+        except Exception as exc:  # diagnostics must not eat the metric
+            log(f"giant-window case failed ({exc!r}); continuing")
 
     print(json.dumps(result))
     return 0

@@ -74,6 +74,11 @@ PARTITION_FIELD_DTYPES: Dict[str, str] = {
     "n_inc": "int32",
     "n_ss": "int32",
     "n_cols": "int32",
+    "pc_trace": "int32",
+    "pc_sr_val": "float32",
+    "pc_blk_indptr": "int32",
+    "pc_ell_op": "int32",
+    "pc_ell_rs": "float32",
 }
 
 

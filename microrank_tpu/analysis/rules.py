@@ -35,7 +35,10 @@ class HostSyncRule(Rule):
     serializes dispatch with a device->host round trip per call (~90 ms
     on tunneled-TPU runtimes). The traced-call-graph analysis in
     analysis/traced.py decides what is traced; ``.shape``/``.dtype``
-    reads are static and exempt.
+    reads are static and exempt. Laundering is caught too: bound-method
+    aliases (``f = x.item; f()``), ``getattr(x, "item")()``, and taint
+    carried through nominally-static wrappers (``functools.reduce``/
+    ``math.*``/``dataclasses.*`` over a tracer).
     """
 
     name = "R1"

@@ -15,7 +15,12 @@ Within a traced function a simple forward taint walk tracks locals:
   (static under tracing — branching or ``int()`` on them is fine);
 * ``len``/``isinstance``/``type``/``range``/``min``/``max`` of static
   operands stay static; any expression over a tainted operand is
-  tainted;
+  tainted — including ``functools``/``math``/``dataclasses`` calls,
+  which are static only over static operands (a ``functools.reduce``
+  over a tracer must not launder its taint);
+* a local bound to a SYNC METHOD of a tainted value (``f = x.item``,
+  ``f = getattr(x, "tolist")``) is a sync thunk: calling it anywhere in
+  the function is the laundered host sync and fires R1;
 * nested ``def``/``lambda`` parameters are treated as tainted when the
   enclosing function is traced (they are the loop/vmap bodies of the
   kernels — their arguments are device values by construction).
@@ -338,6 +343,33 @@ class _TaintWalker:
         self.emit = emit
         self.events: List[Event] = []
         self.calls: List[Tuple[FuncDef, Set[str]]] = []
+        # Locals bound to a sync-forcing bound method of a tainted value
+        # (``f = x.item`` / ``f = getattr(x, "tolist")``) — calling one
+        # later is the SAME host sync, laundered through a name (the
+        # method-call R1 gap, round 6).
+        self.sync_thunks: Set[str] = set()
+
+    def _sync_thunk_expr(self, node) -> Optional[str]:
+        """The sync-method name an expression launders, or None: a bound
+        sync method of a tainted receiver (``x.item``) or the getattr
+        spelling of one (``getattr(x, "item")``)."""
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _SYNC_METHODS
+            and self.is_tainted(node.value)
+        ):
+            return node.attr
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr"
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and node.args[1].value in _SYNC_METHODS
+            and self.is_tainted(node.args[0])
+        ):
+            return node.args[1].value
+        return None
 
     def run(self) -> None:
         for stmt in self.fd.node.body:
@@ -364,13 +396,17 @@ class _TaintWalker:
                 _STATIC_BUILTINS | _SCALARIZERS
             ):
                 return False
-            if dotted is not None and dotted.split(".")[0] in (
-                "math", "dataclasses", "functools"
-            ):
-                return False
             args_tainted = any(self.is_tainted(a) for a in node.args) or any(
                 self.is_tainted(k.value) for k in node.keywords
             )
+            if dotted is not None and dotted.split(".")[0] in (
+                "math", "dataclasses", "functools"
+            ):
+                # Static ONLY over static operands: functools.reduce /
+                # dataclasses.replace over a tracer launders the taint
+                # right past the scalarizer check otherwise (the
+                # stop_gradient-style R1 gap, round 6).
+                return args_tainted
             # Method on a tainted object (x.astype(...), x.sum()).
             if isinstance(node.func, ast.Attribute) and self.is_tainted(
                 node.func.value
@@ -458,8 +494,14 @@ class _TaintWalker:
         if isinstance(stmt, ast.Assign):
             self._scan_expr(stmt.value)
             t = self.is_tainted(stmt.value)
+            thunk = self._sync_thunk_expr(stmt.value)
             for target in stmt.targets:
                 self._assign_target(target, t)
+                if isinstance(target, ast.Name):
+                    if thunk is not None:
+                        self.sync_thunks.add(target.id)
+                    else:
+                        self.sync_thunks.discard(target.id)
             return
         if isinstance(stmt, ast.AugAssign):
             self._scan_expr(stmt.value)
@@ -583,6 +625,32 @@ class _TaintWalker:
 
     def _check_call(self, call: ast.Call) -> None:
         args_tainted = any(self.is_tainted(a) for a in call.args)
+        # Laundered sync: calling a local bound to a sync method of a
+        # traced value (``f = x.item; f()``), or the inline getattr
+        # spelling (``getattr(x, "item")()``).
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in self.sync_thunks
+        ):
+            self._event_sync(
+                call,
+                f"`{call.func.id}()` calls a bound sync method of a "
+                "traced value (assigned from `.item`/`.tolist`-style "
+                "laundering) — the host sync happens here, inside the "
+                "jit region",
+            )
+            return
+        laundered = self._sync_thunk_expr(call.func)
+        if laundered is not None and not isinstance(
+            call.func, ast.Attribute
+        ):  # direct x.item() is reported by the branch below
+            self._event_sync(
+                call,
+                f"`getattr(..., '{laundered}')()` on a traced value "
+                "forces a host sync inside a jit region — getattr does "
+                "not launder the sync away",
+            )
+            return
         # float(x)/int(x)/bool(x) on a traced value.
         if (
             isinstance(call.func, ast.Name)
@@ -648,6 +716,14 @@ class _TaintWalker:
                 "— numpy concretizes tracers (TracerArrayConversionError "
                 "under jit, a silent device->host sync outside); use the "
                 "jnp equivalent",
+            )
+            return
+        if root == "math" and args_tainted:
+            self._event_sync(
+                call,
+                f"`{dotted}` on a traced value — the math module calls "
+                "float() on its argument (host sync / TracerError under "
+                "jit); use the jnp equivalent",
             )
 
     def _event_sync(self, call: ast.Call, message: str) -> None:

@@ -837,8 +837,8 @@ def main(argv=None) -> int:
         "--kernel",
         default="auto",
         choices=[
-            "auto", "packed", "packed_bf16", "packed_blocked", "csr", "coo",
-            "dense", "dense_bf16", "pallas",
+            "auto", "packed", "packed_bf16", "packed_blocked", "pcsr",
+            "csr", "coo", "dense", "dense_bf16", "pallas",
         ],
         help="power-iteration kernel",
     )

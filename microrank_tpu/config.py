@@ -156,8 +156,15 @@ class RuntimeConfig:
     #       streamed in blocks through a lax.scan (pagerank.
     #       packed_block_bytes caps the unpacked f32 intermediate) — the
     #       at-scale path past the dense budget;
+    #   "pcsr" — partition-centric SpMV (Partition-Centric PageRank,
+    #       arxiv 1709.07122): the build bins entries into
+    #       source-trace partitions so each SpMV streams contiguous
+    #       trace-vector slices + small-range segment sums instead of
+    #       T-range random gathers — the memory-bounded at-scale
+    #       fallback (entry-linear memory, no bitmap ever exists);
     #   "csr" — cumsum-difference SpMV, scatter-free and entry-linear in
-    #       memory (the at-scale fallback);
+    #       memory (the legacy fallback pcsr replaces; kept for forced
+    #       runs and cross-kernel parity);
     #   "dense" / "dense_bf16" — scatter densify + MXU matvecs;
     #   "coo" — segment-sum SpMV (entry-shardable under shard_map, like
     #       csr; packed shards the trace axis instead — see parallel/);
@@ -167,7 +174,7 @@ class RuntimeConfig:
     #   "auto" — packed when both partitions' unpacked matrices fit
     #       dense_budget_bytes, packed_blocked when only the bitmaps fit
     #       a quarter of it (graph build constructs the matching
-    #       auxiliary view), else csr.
+    #       auxiliary view), else pcsr.
     kernel: str = "auto"
     # Budget for the packed kernel's unpacked f32 matrices, summed over
     # both partitions (graph.build.resolve_aux applies it at build time).

@@ -14,7 +14,11 @@ pipelines, staging serialized with ranking, and every process paid the
   keeps the vmapped single-device program. Kernel resolution on the
   sharded route is the table lane's own policy
   (``parallel.sharded_rank.resolve_shard_kernel``), so the two callers
-  and the batch pipeline cannot disagree. Parity between the two
+  and the batch pipeline cannot disagree — including the round-6
+  partition-centric fallback: past the per-shard packed budget the
+  policy lands on ``pcsr`` (per-shard partition tables; stage_sharded
+  tiles the trace axis to PCSR_PART_TRACES * shards), and giant
+  windows that no bitmap fits route through the same seam. Parity between the two
   routes is tie-aware by construction (both end in the same two-key
   sort) and pinned by tests/test_dispatch.py.
 

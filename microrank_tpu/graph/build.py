@@ -154,6 +154,106 @@ def _trace_kinds(
     return kind
 
 
+# Source-partition width (traces per partition) of the partition-centric
+# kernel's binned views (kernel="pcsr"). One module constant shared by the
+# host binning below and the device kernel (rank_backends.jax_tpu imports
+# it), so the two sides can never disagree about the slab tiling.
+# 4096 f32 trace entries = 16 KB per contiguous rv slice — comfortably
+# cache/VMEM-sized while keeping the partition count low (T/4096).
+PCSR_PART_TRACES = 4096
+
+# Entries per reduction block in the forward tables: every
+# (partition, op) range pads to whole blocks, so per-op sums become
+# block row-sums + a prefix over block sums differenced at the dense
+# offset table — no scatter. Small, because the expected pad waste is
+# ~B/2 entries per populated (partition, op) pair.
+PCSR_BLOCK = 8
+
+
+def pcsr_partitions(t_pad: int) -> int:
+    """Number of source partitions the pcsr views bin a t_pad-trace axis
+    into (ceil division; >= 1 even for empty partitions)."""
+    return max(1, -(-int(t_pad) // PCSR_PART_TRACES))
+
+
+def pcsr_auxiliary(
+    inc_op: np.ndarray,
+    inc_trace: np.ndarray,
+    sr_val: np.ndarray,
+    rs_val: np.ndarray,
+    n_inc: int,
+    v_pad: int,
+    t_pad: int,
+):
+    """Partition-centric binning of the (trace, op)-sorted incidence
+    entries (Partition-Centric PageRank, arxiv 1709.07122, adapted to
+    the bipartite coverage SpMV pair). See the field comments in
+    graph.structures.PartitionGraph for the device-side reading.
+
+    Forward tables: entries re-sorted (stable int-key argsort — numpy
+    radix, O(E)) to (trace-partition, op, trace) order, every
+    (partition, op) run padded to whole PCSR_BLOCK-entry blocks;
+    ``pc_blk_indptr[p, o]`` is the BLOCK offset of op ``o``'s run inside
+    partition ``p`` — the per-partition dense offset ranges. Trace ids
+    are stored partition-LOCAL (trace - p*PCSR_PART_TRACES). Backward
+    slab: each trace's entries as a fixed-width [t_pad, W] row (W = max
+    unique ops per trace, pow2-bucketed). All padding carries value 0 /
+    index 0 and is inert.
+
+    Returns (pc_trace[P, Epb], pc_sr_val[P, Epb],
+    pc_blk_indptr[P, v_pad+1], pc_ell_op[t_pad, W],
+    pc_ell_rs[t_pad, W]).
+    """
+    s = PCSR_PART_TRACES
+    bsz = PCSR_BLOCK
+    n_parts = pcsr_partitions(t_pad)
+    tr = np.asarray(inc_trace[:n_inc]).astype(np.int64)
+    op = np.asarray(inc_op[:n_inc]).astype(np.int64)
+
+    # Backward ELL slab (trace-major storage order: per-trace runs are
+    # contiguous already).
+    cnt_t = np.bincount(tr, minlength=t_pad).astype(np.int64)
+    w = pad_to(int(cnt_t.max()) if n_inc else 1, "pow2", 1)
+    ell_op = np.zeros((t_pad, w), np.int32)
+    ell_rs = np.zeros((t_pad, w), np.float32)
+    if n_inc:
+        starts_t = np.concatenate(([0], np.cumsum(cnt_t)[:-1]))
+        pos_t = np.arange(n_inc, dtype=np.int64) - starts_t[tr]
+        ell_op[tr, pos_t] = op
+        ell_rs[tr, pos_t] = np.asarray(rs_val[:n_inc])
+
+    # Forward block tables.
+    part = tr // s
+    pair = part * v_pad + op
+    order = np.argsort(pair, kind="stable")  # radix; trace stays ascending
+    pair_s = pair[order]
+    cnt_pair = np.bincount(pair_s, minlength=n_parts * v_pad).astype(
+        np.int64
+    )
+    blocks_pair = -(-cnt_pair // bsz)        # ceil; empty pairs -> 0
+    blocks_2d = blocks_pair.reshape(n_parts, v_pad)
+    blk_indptr = np.zeros((n_parts, v_pad + 1), np.int32)
+    blk_indptr[:, 1:] = np.cumsum(blocks_2d, axis=1).astype(np.int32)
+    blocks_per_part = blocks_2d.sum(axis=1)
+    e_blk = pad_to(
+        int(blocks_per_part.max()) * bsz if n_inc else bsz, "pow2", bsz
+    )
+    pc_trace = np.zeros((n_parts, e_blk), np.int32)
+    pc_sr = np.zeros((n_parts, e_blk), np.float32)
+    if n_inc:
+        # Destination column: the pair's block offset * bsz + position
+        # within the pair's (sorted, contiguous) run.
+        starts_pair = np.zeros(n_parts * v_pad + 1, dtype=np.int64)
+        np.cumsum(cnt_pair, out=starts_pair[1:])
+        pos_in_pair = np.arange(n_inc, dtype=np.int64) - starts_pair[pair_s]
+        dest = blk_indptr[:, :-1].reshape(-1)[pair_s].astype(np.int64) * bsz
+        dest += pos_in_pair
+        part_s = pair_s // v_pad
+        pc_trace[part_s, dest] = (tr[order] - part_s * s).astype(np.int32)
+        pc_sr[part_s, dest] = np.asarray(sr_val[:n_inc])[order]
+    return pc_trace, pc_sr, blk_indptr, ell_op, ell_rs
+
+
 def csr_auxiliary(
     inc_op: np.ndarray,
     inc_trace: np.ndarray,
@@ -236,23 +336,26 @@ def resolve_aux(
     quarter of the budget (the unpacked-f32 budget itself is applied at
     kernel-choice time: within it the kernel is "packed", past it
     "packed_blocked" streams column blocks so only the bitmap must be
-    resident) -> "csr" when even the bitmaps blow that.
+    resident) -> "pcsr" when even the bitmaps blow that (the
+    partition-centric fallback — no per-trace bitmap needs to exist at
+    any point, and the kernel never issues a T-range random gather).
 
     "auto_all" (the sharded path's mode) -> "all" inside the bitmap
-    budget, "csr" past it: the mesh kernel choice depends on the
+    budget, "pcsr" past it: the mesh kernel choice depends on the
     PER-SHARD packed footprint, which this window-level policy can't
-    anticipate, so both view families are built and
-    _resolve_shard_kernel picks — keeping the csr fallback available
-    where the single-device "auto" would have built bitmaps only.
+    anticipate, so every view family is built and
+    resolve_shard_kernel picks — keeping the memory-bounded fallback
+    available where the single-device "auto" would have built bitmaps
+    only.
 
-    Explicit modes ("packed" | "csr" | "all" | "none") pass through for
-    forced-kernel runs.
+    Explicit modes ("packed" | "csr" | "pcsr" | "all" | "none") pass
+    through for forced-kernel runs.
     """
     if aux not in ("auto", "auto_all"):
         return aux
     bits_total = packed_bits_bytes(v_pad, t_pads)
     if bits_total > dense_budget_bytes // 4:
-        return "csr"
+        return "pcsr"
     return "all" if aux == "auto_all" else "packed"
 
 
@@ -261,6 +364,7 @@ def aux_for_kernel(kernel: str, sharded: bool = False) -> str:
     mode = {
         "auto": "auto",
         "csr": "csr",
+        "pcsr": "pcsr",
         "packed": "packed",
         "packed_bf16": "packed",
         "packed_blocked": "packed",
@@ -349,16 +453,18 @@ def build_aux_views(
 ):
     """The shared (numpy-lane + native-lane) auxiliary-view constructor.
 
-    ``mode`` is a RESOLVED aux mode ("packed" | "csr" | "all" | "none" —
-    run resolve_aux first; "auto" is rejected here so the two build lanes
-    can't silently apply different policies). Unbuilt views are [0]-shaped
-    ([x, 0] for bitmaps) placeholders; the kernels raise loudly on them.
+    ``mode`` is a RESOLVED aux mode ("packed" | "csr" | "pcsr" | "all" |
+    "none" — run resolve_aux first; "auto" is rejected here so the two
+    build lanes can't silently apply different policies). Unbuilt views
+    are [0]-shaped ([x, 0] for bitmaps and partition tables)
+    placeholders; the kernels raise loudly on them.
 
-    Returns the 10 PartitionGraph aux fields: (inc_trace_opmajor,
+    Returns the 15 PartitionGraph aux fields: (inc_trace_opmajor,
     sr_val_opmajor, inc_indptr_op, inc_indptr_trace, ss_indptr, cov_bits,
-    ss_bits, inv_tracelen, inv_cov_dup, inv_outdeg).
+    ss_bits, inv_tracelen, inv_cov_dup, inv_outdeg, pc_trace, pc_sr_val,
+    pc_blk_indptr, pc_ell_op, pc_ell_rs).
     """
-    if mode not in ("packed", "csr", "all", "none"):
+    if mode not in ("packed", "csr", "pcsr", "all", "none"):
         raise ValueError(f"unresolved aux mode {mode!r}")
     if mode in ("csr", "all"):
         csr = csr_auxiliary(
@@ -377,7 +483,19 @@ def build_aux_views(
         n_inc, n_ss, v_pad, t_pad,
         with_bitmaps=mode in ("packed", "all"),
     )
-    return csr + packed
+    if mode in ("pcsr", "all"):
+        pc = pcsr_auxiliary(
+            inc_op, inc_trace, sr_val, rs_val, n_inc, v_pad, t_pad
+        )
+    else:
+        pc = (
+            np.zeros((1, 0), np.int32),
+            np.zeros((1, 0), np.float32),
+            np.zeros((1, 0), np.int32),
+            np.zeros((1, 0), np.int32),
+            np.zeros((1, 0), np.float32),
+        )
+    return csr + packed + pc
 
 
 def _build_partition(
@@ -457,6 +575,7 @@ def _build_partition(
     (
         tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
         cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs,
     ) = build_aux_views(
         p_inc_op, p_inc_trace, p_sr_val, p_rs_val,
         p_ss_child, p_ss_parent, p_ss_val,
@@ -488,6 +607,11 @@ def _build_partition(
         n_traces=np.int32(n_traces),
         n_inc=np.int32(len(u_op)),
         n_ss=np.int32(len(e_child)),
+        pc_trace=pc_trace,
+        pc_sr_val=pc_sr,
+        pc_blk_indptr=pc_blk,
+        pc_ell_op=pc_ell_op,
+        pc_ell_rs=pc_ell_rs,
     )
     return graph, local_uniques
 
@@ -665,6 +789,7 @@ def _collapse_partition(
     (
         tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
         cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs,
     ) = build_aux_views(
         p_inc_op, p_inc_trace, p_sr_val, p_rs_val,
         part.ss_child, part.ss_parent, part.ss_val,
@@ -689,6 +814,11 @@ def _collapse_partition(
         tracelen=pad1d(c_len.astype(np.int32), t_pad, fill=1),
         n_inc=np.int32(len(c_op)),
         n_cols=np.int32(n_kinds),
+        pc_trace=pc_trace,
+        pc_sr_val=pc_sr,
+        pc_blk_indptr=pc_blk,
+        pc_ell_op=pc_ell_op,
+        pc_ell_rs=pc_ell_rs,
     )
 
 
@@ -701,6 +831,7 @@ def _rebuild_aux(part: PartitionGraph, mode: str) -> PartitionGraph:
     (
         tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
         cov_bits, ss_bits, inv_len, inv_cov, inv_out,
+        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs,
     ) = build_aux_views(
         part.inc_op, part.inc_trace, part.sr_val, part.rs_val,
         part.ss_child, part.ss_parent, part.ss_val,
@@ -717,6 +848,11 @@ def _rebuild_aux(part: PartitionGraph, mode: str) -> PartitionGraph:
         inv_tracelen=inv_len,
         inv_cov_dup=inv_cov,
         inv_outdeg=inv_out,
+        pc_trace=pc_trace,
+        pc_sr_val=pc_sr,
+        pc_blk_indptr=pc_blk,
+        pc_ell_op=pc_ell_op,
+        pc_ell_rs=pc_ell_rs,
     )
 
 

@@ -84,6 +84,35 @@ class PartitionGraph(NamedTuple):
     # (m/len), and ``n_traces`` still counts TRUE traces (the spectrum
     # and the iteration's initial value need the real count).
     n_cols: np.ndarray = np.int32(-1)
+    # Partition-centric views for the at-scale fallback (kernel="pcsr",
+    # after Partition-Centric PageRank, arxiv 1709.07122): entries
+    # binned by SOURCE-trace range into P partitions of
+    # graph.build.PCSR_PART_TRACES traces each, so neither direction of
+    # the coverage SpMV pair ever issues a T-range random gather OR a
+    # scatter (the two ops that serialize at scale — scatter measured
+    # ~30x a vectorized pass on the bench host, and the whole csr
+    # gather story on TPU).
+    #
+    # Forward (op-output) direction: ``pc_trace``/``pc_sr_val`` hold the
+    # entries op-major WITHIN each partition, every (partition, op)
+    # range padded to whole PCSR_BLOCK-entry blocks, with
+    # ``pc_blk_indptr`` the per-partition dense BLOCK-offset table. The
+    # kernel reshapes rv into contiguous [P, S] slices (the streaming
+    # load), gathers only LOCAL trace ids (bounded small range),
+    # block-sums, prefix-scans the per-partition block sums, and
+    # differences at the offset table — a bounded dense [P, V] slab
+    # summed over partitions, no scatter anywhere.
+    #
+    # Backward (trace-output) direction: ``pc_ell_op``/``pc_ell_rs``
+    # hold each trace's entries as a fixed-width slab ([T, W], W = max
+    # unique ops per trace, zero padding inert) — the output axis is
+    # DENSE, so y_rs is a gather from the small [V] vector plus a row
+    # sum. [x, 0] placeholders mean "not built".
+    pc_trace: np.ndarray = np.zeros((1, 0), np.int32)     # int32[P, Epb] local
+    pc_sr_val: np.ndarray = np.zeros((1, 0), np.float32)  # float32[P, Epb]
+    pc_blk_indptr: np.ndarray = np.zeros((1, 0), np.int32)  # int32[P, V+1]
+    pc_ell_op: np.ndarray = np.zeros((1, 0), np.int32)    # int32[T, W]
+    pc_ell_rs: np.ndarray = np.zeros((1, 0), np.float32)  # float32[T, W]
 
 
 class WindowGraph(NamedTuple):

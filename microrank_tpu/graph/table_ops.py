@@ -269,6 +269,11 @@ def _graph_from_padded(p):
         n_inc=np.int32(p.n_inc),
         n_ss=np.int32(p.n_ss),
         n_cols=np.int32(p.n_cols),
+        pc_trace=p.pc_trace,
+        pc_sr_val=p.pc_sr_val,
+        pc_blk_indptr=p.pc_blk_indptr,
+        pc_ell_op=p.pc_ell_op,
+        pc_ell_rs=p.pc_ell_rs,
     )
 
 

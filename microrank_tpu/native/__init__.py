@@ -441,6 +441,15 @@ class PaddedPartition(NamedTuple):
     # n_traces still counts TRUE traces (graph.structures.PartitionGraph
     # n_cols semantics).
     n_cols: int = -1
+    # Partition-centric binned views (kernel="pcsr"; see
+    # graph.structures.PartitionGraph). Built by a vectorized binning
+    # pass over the C++-exported trace-major entries — the export is
+    # already (trace, op) sorted, so the binning is a contiguous split.
+    pc_trace: np.ndarray = np.zeros((1, 0), np.int32)
+    pc_sr_val: np.ndarray = np.zeros((1, 0), np.float32)
+    pc_blk_indptr: np.ndarray = np.zeros((1, 0), np.int32)
+    pc_ell_op: np.ndarray = np.zeros((1, 0), np.int32)
+    pc_ell_rs: np.ndarray = np.zeros((1, 0), np.float32)
 
 
 def build_window_padded(
@@ -482,7 +491,9 @@ def build_window_padded(
     np.where cost more than the whole build). Out-of-range parents drop
     their edge, same as -1.
     """
-    if mode not in ("packed", "csr", "all", "none", "auto", "auto_all"):
+    if mode not in (
+        "packed", "csr", "pcsr", "all", "none", "auto", "auto_all"
+    ):
         raise ValueError(f"unknown aux mode {mode!r}")
     if mode in ("auto", "auto_all") and collapse == "off":
         raise ValueError(
@@ -553,6 +564,7 @@ def build_window_padded(
         out = []
         want_bits = mode in ("packed", "all")
         want_csr = mode in ("csr", "all")
+        want_pc = mode in ("pcsr", "all")
         for idx in range(2):
             n_inc, n_ss, n_tr, n_ops = (int(x) for x in sizes[4 * idx: 4 * idx + 4])
             true_tr = true_traces[idx] if true_traces is not None else n_tr
@@ -632,6 +644,23 @@ def build_window_padded(
                     p.inc_indptr_op.ctypes.data_as(i32p),
                     p.inc_indptr_trace.ctypes.data_as(i32p),
                     p.ss_indptr.ctypes.data_as(i32p),
+                )
+            if want_pc:
+                # Partition-centric binning over the exported trace-major
+                # entries (the C++ counting sort guarantees the order; a
+                # contiguous searchsorted split, numpy-vectorized —
+                # shared with the pandas lane so the two builders cannot
+                # diverge).
+                from ..graph.build import pcsr_auxiliary
+
+                pc_trace, pc_sr, pc_blk, pc_eop, pc_ers = pcsr_auxiliary(
+                    p.inc_op, p.inc_trace, p.sr_val, p.rs_val,
+                    n_inc, v_pad, t_pad,
+                )
+                p = p._replace(
+                    pc_trace=pc_trace, pc_sr_val=pc_sr,
+                    pc_blk_indptr=pc_blk, pc_ell_op=pc_eop,
+                    pc_ell_rs=pc_ers,
                 )
             out.append(p)
         return out[0], out[1]

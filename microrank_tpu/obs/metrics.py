@@ -422,9 +422,21 @@ def graph_staging_stats(graph) -> Tuple[int, int]:
             "inc_indptr_op": n_ops, "inc_indptr_trace": t_live,
             "ss_indptr": n_ops,
         }
+        pc_entry = {"pc_trace", "pc_sr_val", "pc_ell_op", "pc_ell_rs"}
         for f in part._fields:
             arr = np.asarray(getattr(part, f))
             total += arr.nbytes
+            if f in pc_entry:
+                # Binned tables / ELL slabs: n_inc live cells over the
+                # whole 2-D table (each incidence entry appears once per
+                # view); the rest is bin-skew padding.
+                if arr.ndim >= 2 and arr.shape[-1] > 0:
+                    cells = arr.shape[-2] * arr.shape[-1]
+                    frac = float(
+                        np.clip(1.0 - np.mean(n_inc) / cells, 0.0, 1.0)
+                    )
+                    pad += int(arr.nbytes * frac)
+                continue
             live = live_of.get(f)
             if live is None or arr.ndim == 0 or arr.shape[-1] == 0:
                 continue
@@ -476,10 +488,27 @@ def graph_staging_audit(graph) -> Tuple[int, int]:
             "cov_bits": (n_ops, -(-t_live // 8)),
             "ss_bits": (n_ops, -(-n_ops // 8)),
         }
+        pc_fields = {"pc_trace", "pc_sr_val", "pc_ell_op", "pc_ell_rs"}
         for f in part._fields:
             arr = np.asarray(getattr(part, f))
             total += arr.nbytes
             if f in scalars or arr.nbytes == 0:
+                continue
+            if f == "pc_blk_indptr":
+                continue  # small dense offset table: all live
+            if f in pc_fields:
+                # Binned tables / ELL slabs: every live incidence entry
+                # appears exactly once per view, so the live cell count
+                # per window is n_inc; the rest is bin-skew padding.
+                per_win = arr.shape[-2] * arr.shape[-1]
+                b = arr.size // per_win
+                if len(n_inc) in (1, b):
+                    live_tot = int(
+                        np.clip(
+                            np.broadcast_to(n_inc, (b,)), 0, per_win
+                        ).sum()
+                    )
+                    pad += arr.nbytes - live_tot * arr.itemsize
                 continue
             if f in bit_live:
                 rows_live, cols_live = bit_live[f]

@@ -35,9 +35,9 @@ def _two_sum(a, b):
     return s, e
 
 
-def compensated_cumsum(x):
-    """Double-f32 inclusive prefix sum: returns (hi, lo) with
-    ``hi[i] + lo[i]`` carrying the prefix sum to ~2x f32 precision.
+def compensated_cumsum(x, axis: int = 0):
+    """Double-f32 inclusive prefix sum along ``axis``: returns (hi, lo)
+    with ``hi[i] + lo[i]`` carrying the prefix sum to ~2x f32 precision.
 
     A plain f32 ``jnp.cumsum`` makes each element's rounding depend on
     its global prefix position — two value-identical rows of a CSR
@@ -55,7 +55,7 @@ def compensated_cumsum(x):
         hi, e = _two_sum(a[0], b[0])
         return hi, e + a[1] + b[1]
 
-    hi, lo = lax.associative_scan(comb, (x, zeros))
+    hi, lo = lax.associative_scan(comb, (x, zeros), axis=axis)
     return hi, lo
 
 

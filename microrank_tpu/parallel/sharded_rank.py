@@ -33,8 +33,11 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 # The kernels rank_windows_sharded accepts (one source of truth — the
-# pipeline's kernel selection imports this).
-SHARD_KERNELS = ("coo", "csr", "packed", "packed_bf16")
+# pipeline's kernel selection imports this). coo/csr shard the ENTRY
+# axes, pcsr shards the PARTITION axis of its binned tables (each device
+# scans a contiguous block of source partitions), packed shards the
+# TRACE axis.
+SHARD_KERNELS = ("coo", "csr", "pcsr", "packed", "packed_bf16")
 
 
 def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
@@ -94,6 +97,7 @@ def stack_window_graphs(
         # placeholders (all-or-none per view family; the batched kernel
         # chooser treats 0-sized views as "not available").
         have_csr = all(p.inc_indptr_op.shape[0] for p in parts)
+        have_pc = all(p.pc_trace.shape[-1] for p in parts)
         # The two bitmap families degrade independently: the default
         # staging profile strips ss_bits (device rebuilds it from the
         # edge list) while cov_bits stays host-packed.
@@ -109,6 +113,67 @@ def stack_window_graphs(
                 return arr
             return np.concatenate(
                 [arr, np.full(size + 1 - arr.shape[0], arr[-1], arr.dtype)]
+            )
+
+        def _pc_targets():
+            """(P_target, Epb_target, W_target): the P axis re-tiles the
+            (possibly re-padded) trace axis — appended partitions are
+            empty (all-padding blocks, inert) — and divides the shard
+            count for the sharded pcsr path, which the trace_multiple =
+            PCSR_PART_TRACES * shards contract guarantees (t then tiles
+            exactly into P partitions). The block/slab widths pad to
+            the batch max (zero entries are inert)."""
+            from ..graph.build import pcsr_partitions
+
+            return (
+                max(
+                    pcsr_partitions(t),
+                    max(p.pc_trace.shape[0] for p in parts),
+                ),
+                max(p.pc_trace.shape[1] for p in parts),
+                max(p.pc_ell_op.shape[1] for p in parts),
+            )
+
+        def stack_pc_tab(getter, dtype):
+            if not have_pc:
+                return np.zeros((len(parts), 1, 0), dtype)
+            p_target, e_target, _ = _pc_targets()
+            return np.stack(
+                [_pad2d(getter(p).astype(dtype), p_target, e_target)
+                 for p in parts]
+            )
+
+        def stack_pc_indptr():
+            """[P, V+1] block-offset tables: appended partitions are
+            all-zero rows (every op an empty [0, 0) range); the op axis
+            pads with each row's edge value (appended ops get empty
+            ranges at the row's end)."""
+            if not have_pc:
+                return np.zeros((len(parts), 1, 0), np.int32)
+            p_target, _, _ = _pc_targets()
+            out = []
+            for p in parts:
+                arr = np.asarray(p.pc_blk_indptr, dtype=np.int32)
+                if arr.shape[1] < v + 1:
+                    arr = np.concatenate(
+                        [
+                            arr,
+                            np.repeat(
+                                arr[:, -1:], v + 1 - arr.shape[1], axis=1
+                            ),
+                        ],
+                        axis=1,
+                    )
+                out.append(_pad2d(arr, p_target, v + 1))
+            return np.stack(out)
+
+        def stack_pc_ell(getter, dtype):
+            if not have_pc:
+                return np.zeros((len(parts), 1, 0), dtype)
+            _, _, w_target = _pc_targets()
+            return np.stack(
+                [_pad2d(getter(p).astype(dtype), t, w_target)
+                 for p in parts]
             )
 
         return PartitionGraph(
@@ -181,6 +246,11 @@ def stack_window_graphs(
             n_inc=np.stack([p.n_inc for p in parts]),
             n_ss=np.stack([p.n_ss for p in parts]),
             n_cols=np.stack([np.int32(p.n_cols) for p in parts]),
+            pc_trace=stack_pc_tab(lambda p: p.pc_trace, np.int32),
+            pc_sr_val=stack_pc_tab(lambda p: p.pc_sr_val, np.float32),
+            pc_blk_indptr=stack_pc_indptr(),
+            pc_ell_op=stack_pc_ell(lambda p: p.pc_ell_op, np.int32),
+            pc_ell_rs=stack_pc_ell(lambda p: p.pc_ell_rs, np.float32),
         )
 
     return WindowGraph(
@@ -227,24 +297,32 @@ def resolve_shard_kernel(graphs, mesh: Mesh, runtime, log=None) -> str:
         )
         v_max = max(int(g.normal.cov_unique.shape[-1]) for g in graphs)
         fits = packed_unpacked_bytes(v_max, t_per_dev) <= budget
+        has_pc = all(
+            int(p.pc_trace.shape[-1]) > 0
+            for g in graphs
+            for p in (g.normal, g.abnormal)
+        )
         has_csr = all(
             int(p.inc_indptr_op.shape[-1]) > 0
             for g in graphs
             for p in (g.normal, g.abnormal)
         )
-        if fits or not has_csr:
-            # Bitmap-only builds (aux="packed") carry no CSR views, so
-            # past-budget batches must still take the packed path
+        if fits or not (has_pc or has_csr):
+            # Bitmap-only builds (aux="packed") carry no fallback views,
+            # so past-budget batches must still take the packed path
             # rather than crash at rank time.
             if not fits:
                 log.warning(
                     "sharded packed footprint exceeds dense_budget_bytes "
-                    "and no CSR views were built; proceeding with the "
-                    "packed family — build with aux='all' to enable the "
-                    "csr fallback"
+                    "and no pcsr/CSR views were built; proceeding with "
+                    "the packed family — build with aux='all' to enable "
+                    "the memory-bounded fallback"
                 )
             return "packed_bf16" if runtime.prefer_bf16 else "packed"
-        return "csr"
+        # Past the per-shard packed budget: the partition-centric kernel
+        # is the memory-bounded fallback of choice (entry-linear memory,
+        # no T-range gathers); legacy csr only when pcsr wasn't built.
+        return "pcsr" if has_pc else "csr"
     kernels = {
         choose_kernel(
             g, runtime.dense_budget_bytes, runtime.prefer_bf16
@@ -265,13 +343,22 @@ def stage_sharded(graphs, mesh: Mesh, kernel: str):
     from ..parallel.distributed import global_put
     from ..rank_backends.jax_tpu import device_subset
 
+    from ..graph.build import PCSR_PART_TRACES
+
     shard_n = int(mesh.devices.shape[1])
+    if kernel in ("packed", "packed_bf16"):
+        trace_multiple = 8 * shard_n  # whole bitmap BYTES per shard
+    elif kernel == "pcsr":
+        # The trace axis must tile exactly into whole source partitions
+        # AND whole per-shard partition blocks, so each device's y_r
+        # slabs land at its exact global trace offset.
+        trace_multiple = PCSR_PART_TRACES * shard_n
+    else:
+        trace_multiple = 1
     stacked = stack_window_graphs(
         [device_subset(g, kernel) for g in graphs],
         shard_multiple=shard_n,
-        trace_multiple=(
-            8 * shard_n if kernel in ("packed", "packed_bf16") else 1
-        ),
+        trace_multiple=trace_multiple,
     )
     from ..obs.metrics import graph_staging_stats, record_staging
 
@@ -326,6 +413,50 @@ def _partition_specs(
             n_inc=per_window,
             n_ss=per_window,
             n_cols=per_window,
+            pc_trace=per_window,
+            pc_sr_val=per_window,
+            pc_blk_indptr=per_window,
+            pc_ell_op=per_window,
+            pc_ell_rs=per_window,
+        )
+    if kernel == "pcsr":
+        # Partition-axis sharding: each device holds a contiguous block
+        # of the [P, Ep] binned tables (its per-shard partition table)
+        # plus the replicated trace/op vectors; the call-edge list
+        # entry-shards like the coo path. Dense [V]/[T] partials psum.
+        pc = P(window_axis, shard_axis)
+        return PartitionGraph(
+            inc_op=entry,
+            inc_trace=entry,
+            sr_val=entry,
+            rs_val=entry,
+            ss_child=entry,
+            ss_parent=entry,
+            ss_val=entry,
+            inc_trace_opmajor=entry,
+            sr_val_opmajor=entry,
+            inc_indptr_op=per_window,
+            inc_indptr_trace=per_window,
+            ss_indptr=per_window,
+            cov_bits=per_window,
+            ss_bits=per_window,
+            inv_tracelen=per_window,
+            inv_cov_dup=per_window,
+            inv_outdeg=per_window,
+            kind=per_window,
+            tracelen=per_window,
+            cov_unique=per_window,
+            op_present=per_window,
+            n_ops=per_window,
+            n_traces=per_window,
+            n_inc=per_window,
+            n_ss=per_window,
+            n_cols=per_window,
+            pc_trace=pc,
+            pc_sr_val=pc,
+            pc_blk_indptr=pc,
+            pc_ell_op=pc,
+            pc_ell_rs=pc,
         )
     return PartitionGraph(
         inc_op=entry,
@@ -359,7 +490,46 @@ def _partition_specs(
         n_inc=per_window,
         n_ss=per_window,
         n_cols=per_window,
+        pc_trace=per_window,
+        pc_sr_val=per_window,
+        pc_blk_indptr=per_window,
+        pc_ell_op=per_window,
+        pc_ell_rs=per_window,
     )
+
+
+def _validate_sharded_pcsr(batched: WindowGraph, mesh: Mesh) -> None:
+    """Static-shape checks for the partition-sharded pcsr dispatch: the
+    binned tables must be present, their partition axis must divide the
+    shard count, and the trace axis must tile EXACTLY into the
+    partitions (a ragged last partition would shift every later shard's
+    slab offset) — all guaranteed by stage_sharded's trace_multiple."""
+    from ..graph.build import PCSR_PART_TRACES
+
+    shard_n = int(dict(zip(mesh.axis_names, mesh.devices.shape))[SHARD_AXIS])
+    for side in ("normal", "abnormal"):
+        part = getattr(batched, side)
+        if int(part.pc_trace.shape[-1]) == 0:
+            raise ValueError(
+                "sharded pcsr kernel needs partition-centric graphs — "
+                "build with aux='pcsr'/'all'"
+            )
+        n_parts = int(part.pc_trace.shape[-2])
+        t_pad = int(part.kind.shape[-1])
+        t_ell = int(part.pc_ell_op.shape[-2])
+        if (
+            n_parts % shard_n
+            or n_parts * PCSR_PART_TRACES != t_pad
+            or t_ell != t_pad
+            or t_pad % shard_n
+        ):
+            raise ValueError(
+                f"sharded pcsr kernel needs the trace axis tiled by "
+                f"whole per-shard partition blocks (t_pad {t_pad}, "
+                f"{n_parts} partitions x {PCSR_PART_TRACES}, "
+                f"{shard_n} shards); stack with "
+                f"trace_multiple={PCSR_PART_TRACES * shard_n}"
+            )
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
@@ -385,6 +555,10 @@ def rank_windows_sharded(
       per iteration;
     * "csr" — local-block prefix sums with clamped row ranges (needs
       graphs built with the CSR views, aux="csr"/"all"), two psums;
+    * "pcsr" — the partition-centric kernel with its PARTITION axis
+      sharded (per-shard partition tables; needs aux="pcsr"/"all"
+      graphs stacked with ``trace_multiple = PCSR_PART_TRACES *
+      mesh.shape['shard']`` — stage_sharded's recipe), two psums;
     * "packed" / "packed_bf16" — the MXU bitmap kernel with the TRACE
       axis sharded (bitmap column blocks; rv stays distributed), ONE
       psum per iteration. Needs aux="packed"/"all" graphs stacked with
@@ -397,6 +571,8 @@ def rank_windows_sharded(
             f"kernel {kernel!r} is not shard-capable; use one of "
             f"{SHARD_KERNELS}"
         )
+    if kernel == "pcsr":
+        _validate_sharded_pcsr(batched, mesh)
     if kernel in ("packed", "packed_bf16"):
         shard_n = int(dict(zip(mesh.axis_names, mesh.devices.shape))[SHARD_AXIS])
         t_pad = int(batched.normal.kind.shape[-1])
@@ -467,6 +643,8 @@ def rank_windows_sharded_traced(
             f"kernel {kernel!r} is not shard-capable; use one of "
             f"{SHARD_KERNELS}"
         )
+    if kernel == "pcsr":
+        _validate_sharded_pcsr(batched, mesh)
     specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
     in_specs = (WindowGraph(normal=specs, abnormal=specs),)
     out_specs = tuple(P(WINDOW_AXIS) for _ in range(5))

@@ -49,16 +49,6 @@ class TableRCA:
         self.config = config
         self.log = get_logger("microrank_tpu.pipeline.table")
         validate_tiebreak(config.spectrum)
-        if config.runtime.device_checks and config.runtime.convergence_trace:
-            from ..utils.logging import warn_once
-
-            warn_once(
-                self.log,
-                "conv-trace-device-checks",
-                "convergence_trace is disabled under device_checks (the "
-                "checkify program has no residual-traced twin); windows "
-                "will journal without iteration/residual telemetry",
-            )
         self.slo_vocab = None
         self.baseline = None
         self._thresh = None       # mu + k*sigma f32, set by fit_baseline
@@ -217,10 +207,11 @@ class TableRCA:
         return graph, op_names, shard_kernel
 
     def _conv_enabled(self) -> bool:
-        """Whether dispatches carry the device convergence trace (the
-        checkify program has no traced twin — device_checks wins)."""
-        rt = self.config.runtime
-        return bool(rt.convergence_trace) and not rt.device_checks
+        """Whether dispatches carry the device convergence trace. The
+        checkify program has a residual-traced twin
+        (rank_window_checked_traced), so device_checks no longer
+        disables it."""
+        return bool(self.config.runtime.convergence_trace)
 
     def _apply_conv(self, result, conv) -> None:
         """Fold a fetched convergence summary into the WindowResult and

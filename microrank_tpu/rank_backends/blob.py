@@ -199,7 +199,22 @@ def _rank_window_blob_checked_core(
     )
 
 
+def _rank_window_blob_checked_traced_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
+):
+    """Blob twin of jax_tpu.rank_window_checked_traced_core: checkify
+    assertions AND the convergence trace in one blob-staged program, so
+    device_checks stops dropping residual telemetry on this path."""
+    from .jax_tpu import rank_window_checked_traced_core
+
+    graph = unpack_graph_blob(blob, layout)
+    return rank_window_checked_traced_core(
+        graph, pagerank_cfg, spectrum_cfg, kernel
+    )
+
+
 _BLOB_CHECKED_JIT = None
+_BLOB_CHECKED_TRACED_JIT = None
 
 
 def _blob_checked_jit():
@@ -214,6 +229,21 @@ def _blob_checked_jit():
             static_argnums=(1, 2, 3, 4),
         )
     return _BLOB_CHECKED_JIT
+
+
+def _blob_checked_traced_jit():
+    global _BLOB_CHECKED_TRACED_JIT
+    if _BLOB_CHECKED_TRACED_JIT is None:
+        from jax.experimental import checkify
+
+        _BLOB_CHECKED_TRACED_JIT = jax.jit(
+            checkify.checkify(
+                _rank_window_blob_checked_traced_core,
+                errors=checkify.user_checks,
+            ),
+            static_argnums=(1, 2, 3, 4),
+        )
+    return _BLOB_CHECKED_TRACED_JIT
 
 
 def _account_staging(graph: WindowGraph, path: str, n_transfers: int):
@@ -251,8 +281,10 @@ def stage_rank_window(
     ``conv_trace`` (RuntimeConfig.convergence_trace) dispatches the
     residual-traced program: the return grows to a 5-tuple whose last
     two entries are (residuals float32[2, I], n_iters int32), still all
-    device values. The checkify variant has no traced twin — ``checked``
-    wins and the caller gets the plain 3-tuple.
+    device values. ``checked`` composes with it: the checkify program
+    has a residual-traced twin (rank_window_checked_traced), so
+    device_checks + conv_trace yields the checked 5-tuple instead of
+    silently dropping telemetry.
     """
     from ..obs.metrics import record_retrace
 
@@ -262,7 +294,12 @@ def stage_rank_window(
 
             blob_arr, layout = pack_graph_blob(graph)
             _account_staging(graph, "blob", 1)
-            err, out = _blob_checked_jit()(
+            fn = (
+                _blob_checked_traced_jit()
+                if conv_trace
+                else _blob_checked_jit()
+            )
+            err, out = fn(
                 jax.device_put(blob_arr),
                 layout,
                 pagerank_cfg,
@@ -271,10 +308,11 @@ def stage_rank_window(
             )
             checkify.check_error(err)
             return out
-        from .jax_tpu import rank_window_checked
+        from .jax_tpu import rank_window_checked, rank_window_checked_traced
 
         _account_staging(graph, "tree", len(jax.tree.leaves(graph)))
-        return rank_window_checked(
+        fn = rank_window_checked_traced if conv_trace else rank_window_checked
+        return fn(
             jax.device_put(graph), pagerank_cfg, spectrum_cfg, kernel
         )
     if blob:

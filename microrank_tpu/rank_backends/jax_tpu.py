@@ -313,7 +313,7 @@ def _partition_setup(
     # artifact of the ROADMAP compensated-scan item).
     compensate = bool(
         getattr(cfg, "compensated_psum", False)
-        and kernel in ("coo", "csr", "pallas")
+        and kernel in ("coo", "csr", "pcsr", "pallas")
     )
 
     def reduce_shards(x):
@@ -558,6 +558,117 @@ def _partition_setup(
             )
             # Two collectives per iteration (like the coo path), not three.
             return reduce_shards(y_sr + alpha * y_ss), reduce_shards(y_rs)
+
+    elif kernel == "pcsr":
+        # Partition-centric SpMV (Partition-Centric PageRank, arxiv
+        # 1709.07122, adapted to the bipartite coverage SpMV pair; the
+        # spectrum + tie-aware top-k epilogue stays fused in the same
+        # program like every kernel here — the FUSED-PAGERANK shape,
+        # arxiv 2203.09284). The csr kernel is gather/scatter-bound at
+        # scale: each SpMV issues an E-entry random gather over the FULL
+        # [T] trace vector (~0% HBM utilization measured — DESIGN.md),
+        # and the coo path's scatter-add measures ~30x a vectorized pass
+        # per entry on CPU. Here NEITHER appears:
+        #
+        #   * y_s (op axis): rv is reshaped into contiguous
+        #     [P, PCSR_PART_TRACES] partition slices (the streaming
+        #     load); the block tables gather only partition-LOCAL trace
+        #     ids (a bounded small range), block row-sums reduce
+        #     PCSR_BLOCK entries at a time, a compensated prefix over
+        #     the per-partition BLOCK sums (ops.segment.
+        #     compensated_cumsum — the same position-independent-
+        #     rounding guarantee as the csr kernel's scan) is
+        #     differenced at the dense per-partition offset table, and
+        #     the [P, V] slab sums over partitions — a bounded dense
+        #     accumulation into the output slab, no scatter;
+        #   * y_r (trace axis): the output axis is DENSE, so the
+        #     fixed-width ELL slab turns it into a gather from the
+        #     small [V] vector plus a row sum — again no scatter.
+        #
+        # Sharded (psum_axis set): per-shard partition tables — the
+        # PARTITION axis (and the ELL slab's trace axis) distribute;
+        # each device produces dense [V]/[T] partials (its y_r rows at
+        # their global trace offset, zeros elsewhere) and the same two
+        # psums as the entry-sharded csr/coo path combine them.
+        # stage_sharded re-pads the trace axis to S*shards so the slab
+        # tiling is exact.
+        if g.pc_trace.shape[-1] == 0:
+            raise ValueError(
+                "kernel='pcsr' needs the partition-centric views, but "
+                "this window was built without them — build with "
+                "aux='pcsr'/'all' (or let aux='auto' resolve past the "
+                "bitmap budget)"
+            )
+        from ..graph.build import PCSR_BLOCK, PCSR_PART_TRACES
+        from ..ops.segment import compensated_cumsum
+
+        s_part = PCSR_PART_TRACES
+        n_parts, e_blk = g.pc_trace.shape
+        nb = e_blk // PCSR_BLOCK
+        t_local = g.pc_ell_op.shape[0]
+        if psum_axis is not None and (
+            n_parts * s_part > t_pad or t_local > t_pad
+        ):
+            raise ValueError(
+                "sharded pcsr needs the trace axis tiled exactly by the "
+                f"partition tables (local {n_parts} partitions x "
+                f"{s_part} traces, ell rows {t_local}, t_pad {t_pad}); "
+                f"stack with trace_multiple={s_part} * shard count "
+                "(parallel.stage_sharded does this)"
+            )
+
+        def matvecs(sv, rv):
+            if psum_axis is None:
+                rv2d = _pad_cols(rv, n_parts * s_part).reshape(
+                    n_parts, s_part
+                )
+                t_base = 0
+            else:
+                t_base = lax.axis_index(psum_axis) * (n_parts * s_part)
+                rv2d = lax.dynamic_slice(
+                    rv, (t_base,), (n_parts * s_part,)
+                ).reshape(n_parts, s_part)
+            # Forward: contiguous slice load -> local small-range gather
+            # -> block row-sums -> compensated prefix over block sums ->
+            # offset-table difference -> bounded [P, V] slab.
+            prod = g.pc_sr_val * jnp.take_along_axis(
+                rv2d, g.pc_trace, axis=1
+            )
+            bs = prod.reshape(n_parts, nb, PCSR_BLOCK).sum(axis=-1)
+            hi, lo = compensated_cumsum(bs, axis=-1)
+            z = jnp.zeros((n_parts, 1), jnp.float32)
+            hi = jnp.concatenate([z, hi], axis=1)
+            lo = jnp.concatenate([z, lo], axis=1)
+            a = g.pc_blk_indptr[:, :-1]
+            b = g.pc_blk_indptr[:, 1:]
+            y_parts = (
+                jnp.take_along_axis(hi, b, axis=1)
+                - jnp.take_along_axis(hi, a, axis=1)
+            ) + (
+                jnp.take_along_axis(lo, b, axis=1)
+                - jnp.take_along_axis(lo, a, axis=1)
+            )
+            y_s = y_parts.sum(axis=0)
+            # Backward: dense output axis — [T, W] slab gather from the
+            # small sv vector + row sum.
+            y_blk = (
+                g.pc_ell_rs
+                * jnp.take(sv, g.pc_ell_op, mode="clip")
+            ).sum(axis=-1)
+            if psum_axis is None:
+                y_r = y_blk[:t_pad]
+            else:
+                # This shard's rows at their global trace offset; the
+                # psum of the zero-elsewhere dense partials reassembles
+                # the replicated [T] vector (same combine as csr/coo).
+                y_r = lax.dynamic_update_slice(
+                    jnp.zeros((t_pad,), jnp.float32), y_blk, (t_base,)
+                )
+            # Call edges stay a plain segment-sum: V is the small axis,
+            # so both sides are already cache-range. Entry-sharded like
+            # the coo path (per-shard partials, same psum).
+            y_ss = coo_matvec(g.ss_child, g.ss_parent, g.ss_val, sv, v)
+            return reduce_shards(y_s + alpha * y_ss), reduce_shards(y_r)
 
     elif kernel == "pallas":
         # One-hot MXU segment sums (ops/pallas_spmv.py): the scatter side
@@ -1088,6 +1199,52 @@ def rank_window_checked_core(
     return top_idx, top_scores, n_valid
 
 
+@contract(
+    graph="windowgraph",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]"
+    ),
+)
+def rank_window_checked_traced_core(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str = "coo",
+):
+    """The residual-traced twin of rank_window_checked_core: the same
+    in-program checkify assertions AND the convergence trace in one
+    program, so ``device_checks`` no longer silently drops the
+    per-window iteration/residual telemetry (the carried-over PR 2 gap).
+    Extra finite-residual check: a NaN residual means the iteration
+    itself diverged before the spectrum could mask it."""
+    from jax.experimental import checkify
+
+    top_idx, top_scores, n_valid, residuals, n_iters = (
+        rank_window_traced_core(
+            graph, pagerank_cfg, spectrum_cfg, None, kernel
+        )
+    )
+    live = jnp.arange(top_scores.shape[0]) < n_valid
+    checkify.check(
+        jnp.all(jnp.where(live, jnp.isfinite(top_scores), True)),
+        "non-finite ranked score inside the device program "
+        "(preference vector or spectrum formula produced NaN/inf)",
+    )
+    checkify.check(
+        jnp.logical_and(n_valid >= 0, n_valid <= top_scores.shape[0]),
+        "n_valid outside [0, k]",
+    )
+    live_it = jnp.arange(residuals.shape[1]) < n_iters
+    checkify.check(
+        jnp.all(
+            jnp.where(live_it[None, :], jnp.isfinite(residuals), True)
+        ),
+        "non-finite power-iteration residual inside the device program "
+        "(the ranking vectors diverged)",
+    )
+    return top_idx, top_scores, n_valid, residuals, n_iters
+
+
 def _checked_jit():
     # Module-level cached jit (built lazily once): a per-call
     # jax.jit(checkify.checkify(lambda ...)) would retrace and recompile
@@ -1105,7 +1262,22 @@ def _checked_jit():
     return _CHECKED_JIT
 
 
+def _checked_traced_jit():
+    global _CHECKED_TRACED_JIT
+    if _CHECKED_TRACED_JIT is None:
+        from jax.experimental import checkify
+
+        _CHECKED_TRACED_JIT = jax.jit(
+            checkify.checkify(
+                rank_window_checked_traced_core, errors=checkify.user_checks
+            ),
+            static_argnums=(1, 2, 3),
+        )
+    return _CHECKED_TRACED_JIT
+
+
 _CHECKED_JIT = None
+_CHECKED_TRACED_JIT = None
 
 
 @contract(
@@ -1130,6 +1302,30 @@ def rank_window_checked(
     return out
 
 
+@contract(
+    graph="windowgraph",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]"
+    ),
+)
+def rank_window_checked_traced(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str = "coo",
+):
+    """rank_window_checked plus the device convergence trace — the
+    program ``device_checks`` + ``convergence_trace`` dispatches, so
+    telemetry keeps flowing under checkify instrumentation."""
+    from jax.experimental import checkify
+
+    err, out = _checked_traced_jit()(
+        graph, pagerank_cfg, spectrum_cfg, kernel
+    )
+    checkify.check_error(err)
+    return out
+
+
 rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
 rank_window_traced_device = jax.jit(
     rank_window_traced_core, static_argnums=(1, 2, 3, 4)
@@ -1143,9 +1339,23 @@ _PACKED_UNUSED = (
     # The packed kernel reads only the bitmaps/edge list, inverse vectors,
     # and the per-axis stats; the COO incidence arrays (the big ones —
     # ~19 of 28 MB at the 1M-span scale) never reach the traced branch.
+    # Partition-centric tables (aux="all" builds) are pcsr-only.
     "inc_op", "inc_trace", "sr_val", "rs_val", "ss_val",
     "inc_trace_opmajor", "sr_val_opmajor",
+    "pc_trace", "pc_sr_val", "pc_blk_indptr", "pc_ell_op", "pc_ell_rs",
 )
+# The pcsr kernel reads the partition tables, the call-edge list and the
+# per-axis stats; the flat incidence copies (values live in the binned
+# tables), CSR views, bitmaps and inverse vectors never reach its traced
+# branch — at the 10M-span scale the inverse trace vector alone is an
+# [T] array worth stripping.
+_PCSR_UNUSED = (
+    "inc_op", "inc_trace", "sr_val", "rs_val",
+    "inc_trace_opmajor", "sr_val_opmajor",
+    "inc_indptr_op", "inc_indptr_trace", "ss_indptr",
+    "cov_bits", "ss_bits", "inv_tracelen", "inv_cov_dup", "inv_outdeg",
+)
+_PC_FIELDS = ("pc_trace", "pc_sr_val", "pc_blk_indptr", "pc_ell_op", "pc_ell_rs")
 _KERNEL_UNUSED_FIELDS = {
     # Default ss_stage="edges": the V*V/8-byte call-edge bitmap stays on
     # the host too — the kernel rebuilds it on device from the (much
@@ -1161,8 +1371,12 @@ _KERNEL_UNUSED_FIELDS = {
     # and the CSR views — not inc_trace/ss_child/sr_val (their information
     # lives in the indptrs and the op-major copies) or the bitmaps
     # (already empty under the aux policy).
-    ("csr", "edges"): ("inc_trace", "ss_child", "sr_val", "cov_bits", "ss_bits"),
-    ("csr", "bits"): ("inc_trace", "ss_child", "sr_val", "cov_bits", "ss_bits"),
+    ("csr", "edges"): ("inc_trace", "ss_child", "sr_val", "cov_bits",
+                       "ss_bits") + _PC_FIELDS,
+    ("csr", "bits"): ("inc_trace", "ss_child", "sr_val", "cov_bits",
+                      "ss_bits") + _PC_FIELDS,
+    ("pcsr", "edges"): _PCSR_UNUSED,
+    ("pcsr", "bits"): _PCSR_UNUSED,
 }
 
 
@@ -1228,9 +1442,10 @@ def choose_kernel(
     *per iteration*, dense matvec sub-ms): "packed" bitmap-expanded MXU
     matvecs when the full unpacked f32 matrices fit ``dense_budget_bytes``,
     "packed_blocked" (column-blocked unpack, bounded intermediate) when
-    only the bitmaps fit, "csr" cumsum-difference SpMV (scatter-free,
-    entry-linear memory) past both, "coo" as the last resort (e.g. a
-    stacked batch that mixed aux modes).
+    only the bitmaps fit, "pcsr" partition-centric streaming SpMV
+    (gather-free over the big trace axis, entry-linear memory) past
+    both, "csr" when only the legacy CSR views were built, "coo" as the
+    last resort (e.g. a stacked batch that mixed aux modes).
 
     ``prefer_bf16`` (RuntimeConfig.prefer_bf16 on the pipeline paths):
     resolve the in-budget bitmap path to "packed_bf16" — measured 1.55x
@@ -1251,6 +1466,8 @@ def choose_kernel(
         if unpacked <= dense_budget_bytes:
             return "packed_bf16" if prefer_bf16 else "packed"
         return "packed_blocked"
+    if all(int(g.pc_trace.shape[-1]) > 0 for g in parts):
+        return "pcsr"
     if all(int(g.inc_indptr_op.shape[-1]) > 0 for g in parts):
         return "csr"
     return "coo"
@@ -1314,7 +1531,10 @@ class JaxBackend:
         from ..utils.guards import contract_checks
         from .blob import stage_rank_window
 
-        conv = bool(rt.convergence_trace) and not rt.device_checks
+        # The checkify program has a residual-traced twin
+        # (rank_window_checked_traced), so device_checks no longer
+        # disables the convergence trace.
+        conv = bool(rt.convergence_trace)
         # validate_numerics also arms the trace-time @contract checks on
         # the rank entry points (analysis.contracts) — one knob, both
         # the host-side score validation and the signature contracts.

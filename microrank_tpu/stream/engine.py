@@ -472,7 +472,9 @@ class StreamEngine:
         from ..utils.guards import contract_checks
 
         rt = self.config.runtime
-        conv = bool(rt.convergence_trace) and not rt.device_checks
+        # device_checks composes with the convergence trace since the
+        # checkify program gained its residual-traced twin.
+        conv = bool(rt.convergence_trace)
         t0 = time.monotonic()
         with contract_checks(rt.validate_numerics):
             out = stage_rank_window(

@@ -1,0 +1,12 @@
+"""R1 true positive (method-call laundering): a local bound to a sync
+method of a traced value syncs when CALLED, not where it was bound."""
+import jax
+
+
+def f(x):
+    grab = x.item  # binds the sync; no sync yet
+    limit = grab()  # the laundered host sync happens here
+    return x * limit
+
+
+f_jit = jax.jit(f)

@@ -1,0 +1,11 @@
+"""R1 true positive (getattr laundering): getattr(x, "tolist")() is the
+same host sync as x.tolist() — the string spelling must not hide it."""
+import jax
+
+
+def f(x):
+    vals = getattr(x, "tolist")()
+    return len(vals) * x
+
+
+f_jit = jax.jit(f)

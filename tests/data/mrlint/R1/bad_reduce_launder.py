@@ -1,0 +1,16 @@
+"""R1 true positive (stop_gradient-style laundering through a "static"
+module): functools.reduce over a traced value yields a traced value —
+the walker once treated every functools/math/dataclasses call as
+host-static, so the float() below escaped the scalarizer check."""
+import functools
+import operator
+
+import jax
+
+
+def f(x):
+    total = functools.reduce(operator.add, x)
+    return x * float(total)  # host sync on the laundered tracer
+
+
+f_jit = jax.jit(f)

@@ -1,0 +1,20 @@
+"""R1 true negative: the laundering shapes over STATIC operands — a
+functools.reduce over shape dims, a bound method of a host list, and
+math on a static size — are ordinary host code inside a traced fn."""
+import functools
+import math
+import operator
+
+import jax
+import jax.numpy as jnp
+
+
+def f(x, dims):
+    n = functools.reduce(operator.mul, x.shape)  # shapes are static
+    grab = [1, 2, 3].count  # bound method of a host value
+    k = grab(2)
+    m = math.sqrt(float(n))  # static operand: fine
+    return jnp.sum(x) / (n + k + m)
+
+
+f_jit = jax.jit(f, static_argnames=("dims",))

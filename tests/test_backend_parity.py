@@ -317,10 +317,11 @@ def test_forced_csr_kernel_via_config(small_case):
 
 
 def test_auto_policy_past_budget_is_coherent(small_case):
-    # A dense budget too small for the bitmaps must yield a csr-view build
-    # AND a csr kernel choice — build policy and kernel choice cannot
-    # disagree (regression: choose_kernel could pick csr for a bitmap-only
-    # build and crash).
+    # A dense budget too small for the bitmaps must yield a
+    # partition-centric-view build AND a pcsr kernel choice — build
+    # policy and kernel choice cannot disagree (regression:
+    # choose_kernel could pick a kernel for views that weren't built
+    # and crash).
     import jax
     import jax.numpy as jnp
 
@@ -336,9 +337,9 @@ def test_auto_policy_past_budget_is_coherent(small_case):
         small_case.abnormal, nrm, abn, dense_budget_bytes=1
     )
     assert graph.normal.cov_bits.shape[1] == 0
-    assert graph.normal.inc_indptr_op.shape[0] > 0
+    assert graph.normal.pc_trace.shape[-1] > 0
     kernel = choose_kernel(graph)
-    assert kernel == "csr"
+    assert kernel == "pcsr"
     ti, _, _ = rank_window_device(
         jax.tree.map(jnp.asarray, graph),
         cfg.pagerank,

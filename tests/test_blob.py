@@ -65,7 +65,7 @@ def test_blob_roundtrip_stripped_fields(small_case):
     )
 
 
-@pytest.mark.parametrize("kernel", ["packed", "csr", "coo"])
+@pytest.mark.parametrize("kernel", ["packed", "csr", "pcsr", "coo"])
 def test_blob_rank_matches_per_leaf_staging(small_case, kernel):
     cfg = MicroRankConfig()
     graph, _ = _graph_for(small_case, kernel=kernel)

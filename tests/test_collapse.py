@@ -83,7 +83,7 @@ def test_collapse_shrinks_and_marks(graphs):
 
 @pytest.mark.parametrize(
     "kernel", ["packed", "packed_bf16", "packed_blocked", "coo", "csr",
-               "dense"]
+               "pcsr", "dense"]
 )
 def test_collapse_rank_parity_per_kernel(graphs, kernel):
     """Collapse must be score-exact up to f32 reassociation, not merely
@@ -101,7 +101,7 @@ def test_collapse_rank_parity_per_kernel(graphs, kernel):
 
 
 @pytest.mark.parametrize(
-    "kernel", ["coo", "csr", "dense", "packed", "packed_blocked"]
+    "kernel", ["coo", "csr", "pcsr", "dense", "packed", "packed_blocked"]
 )
 def test_collapse_cross_kernel_parity(graphs, kernel):
     """Regression pin for the csr collapse-parity failure: the synthetic

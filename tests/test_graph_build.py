@@ -152,15 +152,16 @@ def test_resolve_aux_modes():
     bits = packed_bits_bytes(v, t_pads)
     big = bits * 4 + 1  # budget whose quarter fits the bitmaps
     small = bits * 4 - 1  # quarter just misses
-    # Single-device auto: packed inside the bitmap budget, csr past it.
+    # Single-device auto: packed inside the bitmap budget, the
+    # partition-centric fallback past it.
     assert resolve_aux("auto", v, t_pads, big) == "packed"
-    assert resolve_aux("auto", v, t_pads, small) == "csr"
-    # Sharded auto_all: BOTH families inside the budget (so the
-    # per-shard kernel choice can fall back to csr), csr past it.
+    assert resolve_aux("auto", v, t_pads, small) == "pcsr"
+    # Sharded auto_all: EVERY family inside the budget (so the
+    # per-shard kernel choice can fall back), pcsr past it.
     assert resolve_aux("auto_all", v, t_pads, big) == "all"
-    assert resolve_aux("auto_all", v, t_pads, small) == "csr"
+    assert resolve_aux("auto_all", v, t_pads, small) == "pcsr"
     # Explicit modes pass through.
-    for mode in ("packed", "csr", "all", "none"):
+    for mode in ("packed", "csr", "pcsr", "all", "none"):
         assert resolve_aux(mode, v, t_pads, small) == mode
 
 

@@ -215,7 +215,7 @@ def _halves(df):
     return tids[: len(tids) // 2], tids[len(tids) // 2 :]
 
 
-@pytest.mark.parametrize("kernel", ["coo", "csr", "packed", "dense"])
+@pytest.mark.parametrize("kernel", ["coo", "csr", "pcsr", "packed", "dense"])
 def test_convergence_trace_parity_oracle_vs_device(kernel, registry):
     """The device residual trace matches the numpy oracle's (same
     definition: post-normalization L-inf change per partition) within
@@ -272,6 +272,57 @@ def test_convergence_trace_tol_iterations_parity(registry):
     assert it_j < 60, "tol should stop the loop early"
     assert abs(it_j - it_o) <= 1
     assert jb.last_convergence["final_residual"] <= 1e-3 * 1.05
+
+
+def test_convergence_trace_survives_device_checks(registry, tmp_path):
+    """Regression for the carried-over PR 2 gap: the checkify program
+    now has a residual-traced twin (rank_window_checked_traced), so
+    convergence telemetry must flow — not silently drop — under
+    ``device_checks=True``: the backend's last_convergence populates and
+    the pipeline's WindowResult carries rank_residual."""
+    from microrank_tpu.rank_backends.jax_tpu import JaxBackend
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_kinds=6, n_traces=80, seed=7)
+    )
+    nrm, abn = _halves(case.abnormal)
+    for blob in (True, False):
+        cfg = MicroRankConfig(
+            runtime=RuntimeConfig(
+                device_checks=True,
+                convergence_trace=True,
+                blob_staging=blob,
+                prefer_bf16=False,
+            )
+        )
+        jb = JaxBackend(cfg)
+        jb.rank_window(case.abnormal, nrm, abn)
+        conv = jb.last_convergence
+        assert conv is not None, "conv trace dropped under device_checks"
+        assert conv["iterations"] == cfg.pagerank.iterations
+        assert conv["final_residual"] is not None
+        assert len(conv["residuals"]["normal"]) == conv["iterations"]
+
+    # Pipeline level: a ranked WindowResult carries the residual fields.
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.pipeline.table_runner import TableRCA
+
+    case.normal.to_csv(tmp_path / "n.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "a.csv", index=False)
+    rca = TableRCA(
+        MicroRankConfig(
+            runtime=RuntimeConfig(device_checks=True, prefer_bf16=False)
+        )
+    )
+    rca.fit_baseline(native.load_span_table(tmp_path / "n.csv"))
+    results = rca.run(native.load_span_table(tmp_path / "a.csv"))
+    ranked = [r for r in results if r.ranking]
+    assert ranked, "no window ranked — fixture drifted"
+    for r in ranked:
+        assert r.rank_residual is not None
+        assert r.rank_iterations is not None
 
 
 def test_batched_traced_matches_per_window(registry):

@@ -1,0 +1,340 @@
+"""Partition-centric kernel (kernel="pcsr") — build views, parity, and
+dispatch paths.
+
+The pcsr kernel is the memory-bounded fallback for windows whose
+per-trace bitmaps blow the dense budget (resolve_aux past the bitmap
+budget; Partition-Centric PageRank, arxiv 1709.07122). These tests pin:
+
+* the binned views reconstruct the incidence exactly (build unit test,
+  numpy lane and native lane array-identical);
+* SCORES and tie-aware top-k against the coo kernel and the float64
+  sparse / dense numpy_ref oracles, at the same tolerance ladder as the
+  csr collapse-parity suite (f32 at 2e-5, the bf16 rung at 5e-3),
+  including the collapsed-duplicate-trace path;
+* the vmapped-batch, blob-staged and 2D-mesh sharded dispatches match
+  the single-device ranking.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from microrank_tpu.config import MicroRankConfig
+from microrank_tpu.graph.build import (
+    PCSR_BLOCK,
+    PCSR_PART_TRACES,
+    build_window_graph,
+    pcsr_auxiliary,
+    pcsr_partitions,
+    resolve_aux,
+)
+from microrank_tpu.rank_backends.jax_tpu import (
+    choose_kernel,
+    device_subset,
+    rank_window_device,
+)
+from microrank_tpu.rank_backends.sparse_oracle import rank_window_sparse
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+from conftest import partition_case
+
+CFG = MicroRankConfig()
+
+
+@pytest.fixture(scope="module")
+def kind_case():
+    """Strong kind structure — the collapsed-duplicate-trace path."""
+    return generate_case(
+        SyntheticConfig(n_operations=60, n_kinds=6, n_traces=400, seed=3)
+    )
+
+
+@pytest.fixture(scope="module")
+def graphs(kind_case):
+    nrm, abn = partition_case(kind_case)
+    g0, names, _, _ = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="all", collapse="off"
+    )
+    g1, _, _, _ = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="all", collapse="on"
+    )
+    return g0, g1, names
+
+
+def _ranked(graph, names, kernel):
+    ti, ts, nv = jax.device_get(
+        rank_window_device(graph, CFG.pagerank, CFG.spectrum, None, kernel)
+    )
+    n = int(nv)
+    return (
+        [names[int(i)] for i in ti[:n]],
+        np.asarray(ts[:n], dtype=np.float64),
+    )
+
+
+def test_pcsr_views_reconstruct_incidence(graphs):
+    """Scatter the binned forward tables and the ELL slab back into
+    (op, trace, value) triples: both must reproduce the live incidence
+    exactly (same multiset of entries, values bit-identical)."""
+    g0, _, _ = graphs
+    for part in (g0.normal, g0.abnormal):
+        n_inc = int(part.n_inc)
+        v_pad = part.cov_unique.shape[0]
+        t_pad = part.kind.shape[0]
+        truth = {
+            (int(o), int(t)): (float(sv), float(rv))
+            for o, t, sv, rv in zip(
+                part.inc_op[:n_inc],
+                part.inc_trace[:n_inc],
+                part.sr_val[:n_inc],
+                part.rs_val[:n_inc],
+            )
+        }
+        # Forward tables: walk each (partition, op) block range.
+        n_parts, e_blk = part.pc_trace.shape
+        assert n_parts == pcsr_partitions(t_pad)
+        assert e_blk % PCSR_BLOCK == 0
+        seen_fwd = {}
+        for p in range(n_parts):
+            indptr = part.pc_blk_indptr[p]
+            for o in range(v_pad):
+                lo, hi = int(indptr[o]) * PCSR_BLOCK, int(
+                    indptr[o + 1]
+                ) * PCSR_BLOCK
+                for e in range(lo, hi):
+                    val = float(part.pc_sr_val[p, e])
+                    if val == 0.0:
+                        continue  # block padding
+                    tr = int(part.pc_trace[p, e]) + p * PCSR_PART_TRACES
+                    seen_fwd[(o, tr)] = val
+        assert seen_fwd == {k: v[0] for k, v in truth.items()}
+        # ELL slab.
+        seen_bwd = {}
+        for t in range(t_pad):
+            for w in range(part.pc_ell_op.shape[1]):
+                val = float(part.pc_ell_rs[t, w])
+                if val == 0.0:
+                    continue
+                seen_bwd[(int(part.pc_ell_op[t, w]), t)] = val
+        assert seen_bwd == {k: v[1] for k, v in truth.items()}
+
+
+def test_pcsr_empty_partition_build():
+    """A partition with zero entries still builds valid (inert) views."""
+    out = pcsr_auxiliary(
+        np.zeros(0, np.int32),
+        np.zeros(0, np.int32),
+        np.zeros(0, np.float32),
+        np.zeros(0, np.float32),
+        0,
+        8,
+        16,
+    )
+    pc_trace, pc_sr, blk, ell_op, ell_rs = out
+    assert pc_trace.shape[0] == pcsr_partitions(16)
+    assert not blk[:, -1].any()
+    assert not ell_rs.any()
+
+
+@pytest.mark.parametrize("oracle", ["coo", "sparse_f64", "numpy_ref", "bf16"])
+def test_pcsr_parity_ladder(graphs, kind_case, oracle):
+    """pcsr SCORES + tie-aware top-k against the oracle ladder: exact
+    f32 kernel (coo) at reassociation tolerance, the float64 sparse
+    oracle and the dense numpy_ref reference on names, and the bf16
+    rung at bf16 tolerance — on both the uncollapsed and the
+    kind-collapsed build (same ladder as the csr collapse-parity
+    suite)."""
+    g0, g1, names = graphs
+    for g in (g0, g1):
+        ranked, scores = _ranked(g, names, "pcsr")
+        if oracle == "coo":
+            base, base_scores = _ranked(g0, names, "coo")
+            assert ranked == base
+            np.testing.assert_allclose(
+                scores, base_scores, rtol=2e-5, atol=1e-5
+            )
+        elif oracle == "sparse_f64":
+            top_o, _ = rank_window_sparse(
+                g0, names, CFG.pagerank, CFG.spectrum
+            )
+            assert ranked[:5] == top_o[:5]
+        elif oracle == "numpy_ref":
+            from microrank_tpu.rank_backends import NumpyRefBackend
+
+            nrm, abn = partition_case(kind_case)
+            top_r, _ = NumpyRefBackend(CFG).rank_window(
+                kind_case.abnormal, nrm, abn
+            )
+            assert ranked[: len(top_r[:5])] == top_r[:5]
+        else:  # bf16 rung: packed_bf16 on the same build
+            b_names, b_scores = _ranked(g, names, "packed_bf16")
+            assert ranked[:5] == b_names[:5]
+            np.testing.assert_allclose(
+                scores, b_scores, rtol=5e-3, atol=1e-4
+            )
+
+
+def test_resolve_past_budget_builds_pcsr_and_ranks(kind_case):
+    """aux='auto' past the bitmap budget builds ONLY the pcsr views, and
+    choose_kernel picks pcsr — policy and presence stay coherent."""
+    nrm, abn = partition_case(kind_case)
+    graph, names, _, _ = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="auto", dense_budget_bytes=64
+    )
+    assert graph.normal.cov_bits.shape[-1] == 0
+    assert graph.normal.inc_indptr_op.shape[-1] == 0
+    assert graph.normal.pc_trace.shape[-1] > 0
+    assert choose_kernel(graph, dense_budget_bytes=64) == "pcsr"
+    ranked, _ = _ranked(graph, names, "pcsr")
+    base, _ = _ranked(graph, names, "coo")
+    assert ranked == base
+
+
+def test_pcsr_device_subset_strips_everything_else(graphs):
+    g0, _, _ = graphs
+    sub = device_subset(g0, "pcsr")
+    for part in (sub.normal, sub.abnormal):
+        assert part.inc_op.shape[-1] == 0
+        assert part.cov_bits.shape[-1] == 0
+        assert part.inc_indptr_op.shape[-1] == 0
+        assert part.inv_tracelen.shape[-1] == 0
+        assert part.pc_trace.shape[-1] > 0
+        assert part.ss_child.shape[-1] > 0  # call edges still needed
+
+
+def test_pcsr_batched_blob_and_sharded(graphs):
+    """Stacked vmap, blob staging and the 2D-mesh sharded dispatch all
+    reproduce the single-device pcsr ranking."""
+    from microrank_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        WINDOW_AXIS,
+        make_mesh,
+    )
+    from microrank_tpu.parallel.sharded_rank import (
+        rank_windows_batched,
+        rank_windows_sharded,
+        stack_window_graphs,
+        stage_sharded,
+    )
+    from microrank_tpu.rank_backends.blob import stage_rank_window
+
+    g0, _, names = graphs
+    base, base_scores = _ranked(g0, names, "pcsr")
+
+    stacked = stack_window_graphs([device_subset(g0, "pcsr")] * 2)
+    ti, ts, nv = jax.device_get(
+        rank_windows_batched(stacked, CFG.pagerank, CFG.spectrum, "pcsr")
+    )
+    for b in range(2):
+        assert [names[int(i)] for i in ti[b][: int(nv[b])]] == base
+
+    out = jax.device_get(
+        stage_rank_window(
+            device_subset(g0, "pcsr"),
+            CFG.pagerank,
+            CFG.spectrum,
+            "pcsr",
+            blob=True,
+            conv_trace=True,
+        )
+    )
+    assert [names[int(i)] for i in out[0][: int(out[2])]] == base
+    assert int(out[4]) == CFG.pagerank.iterations  # conv trace rode along
+
+    if len(jax.devices()) >= 4:
+        mesh = make_mesh((2, 2), (WINDOW_AXIS, SHARD_AXIS))
+        batched = stage_sharded([g0, g0], mesh, "pcsr")
+        # stage_sharded's recipe tiles the trace axis exactly.
+        assert (
+            batched.normal.pc_trace.shape[-2] * PCSR_PART_TRACES
+            == batched.normal.kind.shape[-1]
+        )
+        ti, ts, nv = jax.device_get(
+            rank_windows_sharded(
+                batched, CFG.pagerank, CFG.spectrum, mesh, "pcsr"
+            )
+        )
+        for b in range(2):
+            n = int(nv[b])
+            assert [names[int(i)] for i in ti[b][:n]] == base
+            np.testing.assert_allclose(
+                np.asarray(ts[b][:n], np.float64),
+                base_scores,
+                rtol=2e-5,
+                atol=1e-5,
+            )
+
+
+def test_sharded_pcsr_rejects_untiled_stack(graphs):
+    """A stack without the pcsr trace alignment must be rejected loudly,
+    not silently mis-slab."""
+    from microrank_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        WINDOW_AXIS,
+        make_mesh,
+    )
+    from microrank_tpu.parallel.sharded_rank import (
+        rank_windows_sharded,
+        stack_window_graphs,
+    )
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 host devices")
+    g0, _, _ = graphs
+    mesh = make_mesh((2, 2), (WINDOW_AXIS, SHARD_AXIS))
+    stacked = stack_window_graphs(
+        [device_subset(g0, "pcsr")] * 2, shard_multiple=2
+    )
+    with pytest.raises(ValueError, match="tiled"):
+        rank_windows_sharded(
+            jax.device_put(stacked), CFG.pagerank, CFG.spectrum, mesh,
+            "pcsr",
+        )
+
+
+def test_resolve_shard_kernel_prefers_pcsr_past_budget(graphs):
+    """Past the per-shard packed budget, the shared shard-kernel policy
+    lands on pcsr (the memory-bounded fallback) when the views exist."""
+    import dataclasses
+
+    from microrank_tpu.config import RuntimeConfig
+    from microrank_tpu.parallel.mesh import (
+        SHARD_AXIS,
+        WINDOW_AXIS,
+        make_mesh,
+    )
+    from microrank_tpu.parallel.sharded_rank import resolve_shard_kernel
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 host devices")
+    g0, _, _ = graphs
+    mesh = make_mesh((2, 2), (WINDOW_AXIS, SHARD_AXIS))
+    rt = dataclasses.replace(RuntimeConfig(), dense_budget_bytes=64)
+    assert resolve_shard_kernel([g0], mesh, rt) == "pcsr"
+
+
+def test_pcsr_convergence_trace_and_all_methods(graphs):
+    """The telemetry twins run on pcsr: the residual-traced program and
+    the all-methods program both dispatch and agree on top-1."""
+    from microrank_tpu.rank_backends.jax_tpu import (
+        rank_window_all_methods_device,
+        rank_window_traced_device,
+    )
+
+    g0, _, names = graphs
+    base, _ = _ranked(g0, names, "pcsr")
+    ti, ts, nv, res, n_it = jax.device_get(
+        rank_window_traced_device(
+            g0, CFG.pagerank, CFG.spectrum, None, "pcsr"
+        )
+    )
+    assert [names[int(i)] for i in ti[: int(nv)]] == base
+    assert int(n_it) == CFG.pagerank.iterations
+    assert np.all(np.isfinite(res))
+    mi, ms, mv = jax.device_get(
+        rank_window_all_methods_device(
+            g0, CFG.pagerank, CFG.spectrum, None, "pcsr"
+        )
+    )
+    assert mi.shape[0] > 1 and int(mv) > 0
