@@ -455,6 +455,12 @@ def _profile_device_time(
     flops, bytes_ = _analytic_iter_cost(graph, kernel)
     device_s = per_iter_s * base_iters
     bw = bytes_ / per_iter_s
+    from microrank_tpu.obs.metrics import record_kernel_ms_per_iter
+
+    # Wire the differenced per-iter device time into the registry gauge
+    # (microrank_kernel_ms_per_iter{kernel=...}) so a bench run leaves
+    # the measurement scrapeable next to the pipeline counters.
+    record_kernel_ms_per_iter(kernel, per_iter_s * 1e3)
     prof = {
         "device_ms": round(device_s * 1e3, 2),
         "per_iter_us": round(per_iter_s * 1e6, 1),
@@ -688,6 +694,10 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
                 os.environ.get("BENCH_DISPATCH_BATCH", 4)
             ),
         ),
+        # Headline passes run spans-disabled; a second spans-enabled
+        # measurement below reports the tracer's cost as the
+        # ``trace_overhead`` artifact field (acceptance: within 5%).
+        obs=dataclasses.replace(cfg.obs, spans=False),
     )
     rca = TableRCA(cfg)
     rca.fit_baseline(normal_table)
@@ -722,6 +732,34 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
         f"aggregate; fault top-1 in {hits}/{len(ranked)} windows; "
         f"{replay_s * 1e3 / len(ranked):.0f}ms/window"
     )
+    # Tracer overhead: the SAME replay with the self-tracing span ring
+    # armed (obs.spans) — every window emits its detect/dispatch/fetch
+    # spans into the bounded ring. The artifact records both rates; the
+    # acceptance bound is spans-on within 5% of spans-off.
+    trace_overhead = None
+    if os.environ.get("BENCH_TRACE_OVERHEAD", "1") != "0":
+        from microrank_tpu.obs import get_tracer
+
+        cfg_on = cfg.replace(obs=dataclasses.replace(cfg.obs, spans=True))
+        rca_on = TableRCA(cfg_on)
+        rca_on.fit_baseline(normal_table)
+        times_on = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rca_on.run(table)
+            times_on.append(time.perf_counter() - t0)
+        sps_on = spans_ranked / float(np.median(times_on))
+        trace_overhead = {
+            "spans_per_sec_on": round(sps_on, 1),
+            "spans_per_sec_off": round(sps, 1),
+            "overhead_pct": round((1.0 - sps_on / sps) * 100.0, 2),
+            "ring_spans": len(get_tracer()),
+        }
+        log(
+            f"trace overhead: spans-on {sps_on:,.0f} vs spans-off "
+            f"{sps:,.0f} spans/s "
+            f"({trace_overhead['overhead_pct']:+.2f}%)"
+        )
     from microrank_tpu.obs.metrics import snapshot_to_result_fields
 
     # One more (untimed) pass with an output dir when asked: produces
@@ -772,6 +810,9 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
 
     return {
         **journal_fields,
+        **(
+            {"trace_overhead": trace_overhead} if trace_overhead else {}
+        ),
         "replay_spans_per_sec": round(sps, 1),
         "replay_windows": len(ranked),
         "replay_ms": round(replay_s * 1e3, 1),

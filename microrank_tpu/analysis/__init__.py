@@ -21,6 +21,10 @@ in SURVEY.md §5):
                    shape/dtype annotations (analysis.contracts)
   R6 device-put    no jax.device_put inside traced code — staging
                    happens at the dispatch boundary, not under a trace
+  R7 telemetry-taint  no traced arrays in span attributes, metric
+                   samples/labels, or journal fields — telemetry sinks
+                   are host values (a sync laundered through the
+                   telemetry layer); record after the fetch
 
 Run it::
 

@@ -8,13 +8,15 @@ from typing import List
 def add_lint_parser(sub) -> None:
     p = sub.add_parser(
         "lint",
-        help="TPU-correctness static analysis (mrlint rules R1-R5)",
+        help="TPU-correctness static analysis (mrlint rules R1-R7)",
         description=(
             "AST lint of the repo's TPU invariants: host syncs inside "
             "jit graphs (R1), float64 drift on the bf16 ranking path "
             "(R2), recompilation hazards (R3), donated-buffer reuse "
             "(R4), missing shape/dtype contracts on rank/spectrum "
-            "entry points (R5). Suppress a finding in place with "
+            "entry points (R5), device_put inside traced code (R6), "
+            "traced arrays flowing into telemetry sinks (R7). "
+            "Suppress a finding in place with "
             "`# mrlint: disable=RN(reason)` — the reason is mandatory."
         ),
     )

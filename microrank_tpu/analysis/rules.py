@@ -1,4 +1,4 @@
-"""The mrlint rule set (R1-R5). See analysis/__init__ for the catalog.
+"""The mrlint rule set (R1-R7). See analysis/__init__ for the catalog.
 
 Each rule is intentionally heuristic — it encodes THIS repo's TPU
 invariants, not general Python semantics — and every finding can be
@@ -356,6 +356,35 @@ class DevicePutRule(Rule):
     def check(self, module: ModuleInfo, project: Project):
         for ev in project.traced.events:
             if ev.kind == "device-put" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class TelemetryTaintRule(Rule):
+    """R7: no traced arrays flowing into the telemetry layer.
+
+    Metric samples and labels (``Counter.inc``/``Gauge.set``/
+    ``Histogram.observe`` and the ``obs.metrics.record_*`` helpers),
+    journal fields (``RunJournal.emit``) and span attributes
+    (``SpanTracer.span``/``record_span``) are HOST values — the sink
+    immediately calls ``float()``/``str()``/``json.dumps`` on them. A
+    traced value passed there is the same implicit host sync R1 exists
+    to catch, just laundered through the telemetry layer (and under
+    jit it crashes at trace time). Record after the fetch, outside the
+    jit boundary. The jax ``x.at[i].set(v)`` indexed-update idiom is
+    exempt despite sharing the ``set`` method name.
+    """
+
+    name = "R7"
+    slug = "telemetry-taint"
+    summary = (
+        "traced value in a span attribute, metric sample/label, or "
+        "journal field"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.traced.events:
+            if ev.kind == "telemetry-taint" and ev.module is module:
                 yield _v(module, ev, self.name, ev.message)
 
 

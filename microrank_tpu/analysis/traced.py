@@ -55,6 +55,16 @@ _STATIC_BUILTINS = {"len", "isinstance", "type", "range", "hasattr"}
 # Method names that force a host sync on a traced value.
 _SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready"}
 _SYNC_EXTERNALS = {"jax.device_get"}
+# Telemetry-sink method names (R7): metric samples (Counter.inc /
+# Gauge.set|inc|dec / Histogram.observe), journal events (.emit), span
+# attributes (.span / .record_span). A traced array flowing into any of
+# them is a host sync laundered through the telemetry layer — the
+# metric/journal/span code calls float()/json.dumps on it. The jax
+# ``x.at[i].set(v)`` indexed-update idiom shares the ``set`` name and
+# is explicitly exempted.
+_TELEMETRY_METHODS = {
+    "observe", "inc", "dec", "set", "emit", "span", "record_span",
+}
 
 
 @dataclass
@@ -90,7 +100,8 @@ class JitWrapper:
 
 @dataclass
 class Event:
-    kind: str                    # "host-sync" | "tracer-branch"
+    # "host-sync" | "tracer-branch" | "device-put" | "telemetry-taint"
+    kind: str
     module: object
     line: int
     col: int
@@ -623,8 +634,64 @@ class _TaintWalker:
                 tainted_params.add(k.arg)
         self.calls.append((target, tainted_params))
 
+    @staticmethod
+    def _is_at_set(call: ast.Call) -> bool:
+        """``x.at[i].set(v)`` — jax's indexed update, not a telemetry
+        sink despite the ``set`` method name."""
+        f = call.func
+        return (
+            isinstance(f, ast.Attribute)
+            and f.attr == "set"
+            and isinstance(f.value, ast.Subscript)
+            and isinstance(f.value.value, ast.Attribute)
+            and f.value.value.attr == "at"
+        )
+
+    def _check_telemetry(self, call: ast.Call, any_tainted: bool) -> bool:
+        """R7 (telemetry taint): a traced value flowing into a metric
+        sample, metric label, journal field, or span attribute. Returns
+        True when an event was emitted."""
+        if not any_tainted:
+            return False
+        sink = None
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _TELEMETRY_METHODS
+            and not self._is_at_set(call)
+        ):
+            sink = f".{call.func.attr}()"
+        elif isinstance(call.func, ast.Name) and call.func.id.startswith(
+            "record_"
+        ):
+            sink = f"{call.func.id}()"  # obs.metrics recording helpers
+        if sink is None:
+            return False
+        self.events.append(
+            Event(
+                kind="telemetry-taint",
+                module=self.module,
+                line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    f"traced value flows into telemetry sink `{sink}` "
+                    "inside a jit region — metric samples/labels, "
+                    "journal fields and span attributes are host "
+                    "values (the sink calls float()/str() on them: a "
+                    "host sync laundered through the telemetry "
+                    "layer); record AFTER the fetch, outside the jit "
+                    "boundary"
+                ),
+            )
+        )
+        return True
+
     def _check_call(self, call: ast.Call) -> None:
         args_tainted = any(self.is_tainted(a) for a in call.args)
+        kwargs_tainted = any(
+            self.is_tainted(k.value) for k in call.keywords
+        )
+        if self._check_telemetry(call, args_tainted or kwargs_tainted):
+            return
         # Laundered sync: calling a local bound to a sync method of a
         # traced value (``f = x.item; f()``), or the inline getattr
         # spelling (``getattr(x, "item")()``).

@@ -69,6 +69,33 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "windows queued behind an in-flight dispatch coalesce into one "
         "vmapped program, up to this many (1 disables; default 8)",
     )
+    p.add_argument(
+        "--no-span-trace", action="store_true",
+        help="disable the self-tracing span ring (obs.spans; on by "
+        "default — every pipeline stage emits a parent-linked span "
+        "the flight recorder can dump)",
+    )
+    p.add_argument(
+        "--span-ring", type=_positive_int, default=None,
+        help="span ring capacity (spans; default 8192 — oldest spans "
+        "fall off, the flight manifest counts drops)",
+    )
+    p.add_argument(
+        "--profile-every-n", type=_positive_int, default=None,
+        help="wrap every N-th router dispatch in a jax.profiler.trace "
+        "session (sampled device profiling; sessions land under the "
+        "out dir's profiles/; default off)",
+    )
+    p.add_argument(
+        "--inject-stage-sleep-ms", type=float, default=None,
+        help="chaos/test knob: sleep this long inside every matching "
+        "--inject-stage span (drives the flight-recorder dogfood "
+        "path: slow one pipeline stage, dump, self-rank)",
+    )
+    p.add_argument(
+        "--inject-stage", default=None,
+        help='stage name --inject-stage-sleep-ms slows (default "build")',
+    )
     p.add_argument("--config-json", help="load a full MicroRankConfig dict")
 
 
@@ -98,6 +125,7 @@ def _config_from_args(args) -> "MicroRankConfig":
         DetectorConfig,
         DispatchConfig,
         MicroRankConfig,
+        ObsConfig,
         PageRankConfig,
         RuntimeConfig,
         SpectrumConfig,
@@ -107,6 +135,27 @@ def _config_from_args(args) -> "MicroRankConfig":
     if args.config_json:
         with open(args.config_json) as f:
             return MicroRankConfig.from_dict(json.load(f))
+    obs_overrides = {
+        k: v
+        for k, v in {
+            "spans": (
+                False if getattr(args, "no_span_trace", False) else None
+            ),
+            "span_ring": getattr(args, "span_ring", None),
+            "profile_every_n": getattr(args, "profile_every_n", None),
+            "profile_dir": (
+                str(Path(args.output) / "profiles")
+                if getattr(args, "profile_every_n", None)
+                and getattr(args, "output", None)
+                else None
+            ),
+            "inject_stage_sleep_ms": getattr(
+                args, "inject_stage_sleep_ms", None
+            ),
+            "inject_stage": getattr(args, "inject_stage", None),
+        }.items()
+        if v is not None
+    }
     dispatch_overrides = {
         k: v
         for k, v in {
@@ -120,6 +169,7 @@ def _config_from_args(args) -> "MicroRankConfig":
         if v is not None
     }
     cfg = MicroRankConfig(
+        obs=ObsConfig(**obs_overrides),
         dispatch=DispatchConfig(**dispatch_overrides),
         detector=DetectorConfig(
             k_sigma=args.k_sigma,
@@ -314,10 +364,15 @@ def cmd_run(args) -> int:
     if getattr(args, "metrics_port", None) is not None and primary:
         from ..obs.server import start_metrics_server
 
-        server = start_metrics_server(args.metrics_port)
+        server = start_metrics_server(
+            args.metrics_port,
+            profile_dir=(
+                str(Path(args.output) / "profiles") if args.output else None
+            ),
+        )
         log.info(
             "metrics endpoint: http://127.0.0.1:%d/metrics (+ "
-            "/metrics.json, /healthz)",
+            "/metrics.json, /healthz, /profilez)",
             server.port,
         )
 
@@ -654,9 +709,15 @@ def cmd_stream(args) -> int:
     if getattr(args, "metrics_port", None) is not None:
         from ..obs.server import start_metrics_server
 
-        server = start_metrics_server(args.metrics_port)
+        server = start_metrics_server(
+            args.metrics_port,
+            profile_dir=(
+                str(Path(args.output) / "profiles") if args.output else None
+            ),
+        )
         log.info(
-            "metrics endpoint: http://127.0.0.1:%d/metrics", server.port
+            "metrics endpoint: http://127.0.0.1:%d/metrics (+ /profilez)",
+            server.port,
         )
     engine = StreamEngine(
         cfg,

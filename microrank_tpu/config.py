@@ -327,6 +327,54 @@ class DispatchConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Self-tracing, flight recorder and device-profiler knobs
+    (``obs/spans.py`` / ``obs/flight.py`` / ``obs/profiler.py``).
+
+    The pipeline applies MicroRank's own premise to itself: every stage
+    at the journal's choke points (ingest/parse, detect, graph build on
+    the worker pool, staging, device dispatch, result fetch, incident
+    lifecycle) emits a parent-linked span under a per-window /
+    per-request trace id, recorded into a bounded in-memory ring. On
+    incident open, degraded dispatch, or SIGTERM drain, the flight
+    recorder dumps the ring to ``out_dir/flight/`` as Perfetto/Chrome
+    trace-event JSON AND MicroRank's own span CSV schema — so
+    ``cli run`` over a flight dump ranks the pipeline's own slowest
+    stage (the dogfood path).
+    """
+
+    # Span tracer on/off. The per-span cost is a contextvar read plus a
+    # locked deque append (~2 us) at millisecond-scale stages; bench.py
+    # measures the pipelined-replay overhead as the ``trace_overhead``
+    # artifact field (acceptance: within 5% of spans-disabled).
+    spans: bool = True
+    # Bounded span ring capacity (spans, not bytes — a Span is ~300 B of
+    # host memory, so the default holds ~2.5 MB and many minutes of
+    # window traffic). Oldest spans fall off; the flight manifest
+    # records how many were dropped.
+    span_ring: int = 8192
+    # Flight recorder: dump the ring (+ correlated journal events + a
+    # metrics snapshot) to out_dir/flight/<stamp>-<reason>/ on incident
+    # open, degraded dispatch, or SIGTERM drain. Dumps within
+    # ``flight_min_interval_seconds`` of the previous one are suppressed
+    # (counted) so an incident storm cannot fill the disk.
+    flight: bool = True
+    flight_min_interval_seconds: float = 30.0
+    # Device profiler: wrap every N-th router dispatch in a
+    # ``jax.profiler.trace`` session written under ``profile_dir``
+    # (0 disables). The obs HTTP server additionally exposes
+    # ``GET /profilez?seconds=S`` for on-demand sessions.
+    profile_every_n: int = 0
+    profile_dir: Optional[str] = None
+    # Chaos/test knobs: sleep this long inside every ``inject_every``-th
+    # span named ``inject_stage`` (the dogfood test slows the build pool
+    # and asserts the self-rank blames it; 0 disables).
+    inject_stage: str = "build"
+    inject_stage_sleep_ms: float = 0.0
+    inject_every: int = 1
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online RCA service knobs (``cli serve`` — serve/ subsystem).
 
@@ -447,6 +495,7 @@ class MicroRankConfig:
     serve: ServeConfig = field(default_factory=ServeConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
     dispatch: DispatchConfig = field(default_factory=DispatchConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -485,4 +534,5 @@ class MicroRankConfig:
             serve=_mk(ServeConfig, d.get("serve", {})),
             stream=_mk(StreamConfig, d.get("stream", {})),
             dispatch=_mk(DispatchConfig, d.get("dispatch", {})),
+            obs=_mk(ObsConfig, d.get("obs", {})),
         )

@@ -108,6 +108,19 @@ class DispatchRouter:
         self._mesh = mesh if mesh is not None else self._build_mesh()
         self._prestaged: Optional[_Staged] = None
         self.dispatches = 0
+        # Sampled device profiling: every N-th dispatch runs inside a
+        # jax.profiler.trace session (ObsConfig.profile_every_n; 0 off).
+        self._profiler = None
+        obs = getattr(config, "obs", None)
+        if obs is not None and obs.profile_every_n > 0:
+            from pathlib import Path
+
+            from ..obs.profiler import DeviceProfiler
+
+            profile_dir = obs.profile_dir or str(
+                Path.home() / ".cache" / "microrank_tpu" / "profiles"
+            )
+            self._profiler = DeviceProfiler(obs.profile_every_n, profile_dir)
 
     # ------------------------------------------------------------- mesh
     def _build_mesh(self):
@@ -209,15 +222,10 @@ class DispatchRouter:
     def _dispatch_program(self, staged: _Staged, conv_trace: bool):
         cfg = self.config
         if staged.route == "sharded":
-            from ..parallel.sharded_rank import (
-                rank_windows_sharded,
-                rank_windows_sharded_traced,
-            )
+            from ..parallel.sharded_rank import resolve_sharded_rank_fn
 
-            fn = (
-                rank_windows_sharded_traced
-                if conv_trace
-                else rank_windows_sharded
+            fn = resolve_sharded_rank_fn(
+                conv_trace, cfg.runtime.device_checks
             )
             return fn(
                 staged.handle, cfg.pagerank, cfg.spectrum, self._mesh,
@@ -263,28 +271,60 @@ class DispatchRouter:
         ``rank_batch`` call with the same graphs. ``record=False``
         (warmup) skips the route metrics.
         """
+        import contextlib
+
         import jax
 
+        from ..obs.spans import get_tracer
+
+        tracer = get_tracer()
         t0 = time.monotonic()
         staged = self._take_prestaged(graphs, kernel)
         prestaged = staged is not None
         if staged is None:
-            staged = self._stage(graphs, kernel)
-        dev_outs = self._dispatch_program(staged, conv_trace)
-        overlap_s = 0.0
-        if next_batch is not None and self.cfg.double_buffer:
-            t_stage = time.monotonic()
-            try:
-                self._prestaged = self._stage(*next_batch)
-                overlap_s = time.monotonic() - t_stage
-            except Exception as exc:  # noqa: BLE001 - a broken NEXT
-                # batch must not fail THIS one; it will surface on its
-                # own dispatch turn.
-                self.log.warning("double-buffer prestage failed: %s", exc)
-        # Consumer edge: the one blocking fetch of the tiny top-k
-        # outputs (block_until_ready is not a sound fence on tunneled
-        # runtimes; a value transfer is).
-        outs = jax.device_get(dev_outs)
+            with tracer.span(
+                "staging", service="dispatch", kernel=kernel,
+                windows=len(graphs),
+            ):
+                staged = self._stage(graphs, kernel)
+        profile_cm = (
+            self._profiler.session()
+            if self._profiler is not None
+            else contextlib.nullcontext()
+        )
+        with profile_cm:
+            with tracer.span(
+                "device_dispatch", service="dispatch",
+                kernel=staged.kernel, route=staged.route,
+                windows=len(graphs),
+            ):
+                dev_outs = self._dispatch_program(staged, conv_trace)
+            overlap_s = 0.0
+            if next_batch is not None and self.cfg.double_buffer:
+                t_stage = time.monotonic()
+                try:
+                    # The prestage span attributes to the CURRENT trace
+                    # (whose rank hides it) — the overlap is this
+                    # window's contribution to the pipeline.
+                    with tracer.span("prestage", service="dispatch"):
+                        self._prestaged = self._stage(*next_batch)
+                    overlap_s = time.monotonic() - t_stage
+                except Exception as exc:  # noqa: BLE001 - a broken NEXT
+                    # batch must not fail THIS one; it will surface on
+                    # its own dispatch turn.
+                    self.log.warning(
+                        "double-buffer prestage failed: %s", exc
+                    )
+            # Consumer edge: the one blocking fetch of the tiny top-k
+            # outputs (block_until_ready is not a sound fence on
+            # tunneled runtimes; a value transfer is).
+            with tracer.span(
+                "result_fetch", service="dispatch", route=staged.route
+            ):
+                outs = jax.device_get(dev_outs)
+        from ..obs.profiler import record_device_memory
+
+        record_device_memory()
         if staged.n_pad:
             outs = tuple(o[: len(graphs)] for o in outs)
         self.dispatches += 1

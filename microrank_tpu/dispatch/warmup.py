@@ -48,19 +48,29 @@ def warm_occupancies(
 ) -> Optional[str]:
     """Dispatch the batched rank program at each occupancy through the
     router (metrics suppressed — warmup must not pollute route/
-    occupancy telemetry). ``probe`` (dispatch.cache.CompileCacheProbe)
-    classifies each compile as a persistent-cache hit or miss. Returns
-    the kernel warmed, or None when nothing ran."""
-    prepared = synthetic_prepared(config)
-    if prepared is None:
-        return None
-    graph, _, kernel = prepared
-    conv = bool(config.runtime.convergence_trace)
-    for occ in occupancies:
-        occ = max(1, int(occ))
-        router.rank_batch(
-            [graph] * occ, kernel, conv_trace=conv, record=False
-        )
-        if probe is not None:
-            probe.observe()
-    return kernel
+    occupancy telemetry, and the span tracer is paused so synthetic
+    warmup traces never reach a flight dump). ``probe``
+    (dispatch.cache.CompileCacheProbe) classifies each compile as a
+    persistent-cache hit or miss. Returns the kernel warmed, or None
+    when nothing ran."""
+    from ..obs.spans import get_tracer
+
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.enabled = False
+    try:
+        prepared = synthetic_prepared(config)
+        if prepared is None:
+            return None
+        graph, _, kernel = prepared
+        conv = bool(config.runtime.convergence_trace)
+        for occ in occupancies:
+            occ = max(1, int(occ))
+            router.rank_batch(
+                [graph] * occ, kernel, conv_trace=conv, record=False
+            )
+            if probe is not None:
+                probe.observe()
+        return kernel
+    finally:
+        tracer.enabled = was_enabled

@@ -3,11 +3,15 @@
 The observability seam the rest of the framework records into —
 see ``registry`` (Counter/Gauge/Histogram + Prometheus/JSON exposition),
 ``metrics`` (the canonical metric set + recording helpers), ``journal``
-(per-run JSONL event log), ``host`` (contention sentinel) and ``server``
-(the ``--metrics-port`` HTTP endpoint). ``cli stats`` re-exposes a
-finished run's snapshot offline.
+(per-run JSONL event log), ``host`` (contention sentinel), ``server``
+(the ``--metrics-port`` HTTP endpoint, incl. ``/profilez``),
+``spans`` (the self-tracing span ring + trace-context propagation),
+``flight`` (the incident flight recorder) and ``profiler``
+(sampled jax.profiler sessions + HBM gauges). ``cli stats`` re-exposes
+a finished run's snapshot offline.
 """
 
+from .flight import FLIGHT_DIR, FlightRecorder
 from .host import ContentionSentinel
 from .journal import JOURNAL_NAME, RunJournal, read_journal
 from .registry import (
@@ -20,18 +24,34 @@ from .registry import (
     registry_from_json,
     set_registry,
 )
+from .spans import (
+    Span,
+    SpanContext,
+    SpanTracer,
+    configure_tracer,
+    get_tracer,
+    set_tracer,
+)
 
 __all__ = [
     "ContentionSentinel",
     "Counter",
+    "FLIGHT_DIR",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JOURNAL_NAME",
     "MetricsRegistry",
     "RunJournal",
+    "Span",
+    "SpanContext",
+    "SpanTracer",
+    "configure_tracer",
     "diff_registries",
     "get_registry",
+    "get_tracer",
     "read_journal",
     "registry_from_json",
     "set_registry",
+    "set_tracer",
 ]

@@ -1,0 +1,235 @@
+"""Incident flight recorder: dump the span ring when something breaks.
+
+Three triggers, all "the moment an operator will want a causal trace":
+incident open (stream), degraded dispatch (serve's numpy_ref fallback),
+and SIGTERM drain. A dump is one directory under ``out_dir/flight/``:
+
+* ``trace.json``  — Chrome/Perfetto trace-event JSON (load in
+  ``ui.perfetto.dev`` — threads are tracks, spans are slices);
+* ``spans.csv``   — the SAME spans in MicroRank's OWN input schema
+  (io.schema canonical columns: stage name -> operationName, subsystem
+  -> serviceName/podName, trace context -> traceID/spanID/ParentSpanId)
+  so ``cli run --normal <healthy dump> --abnormal <this dump>`` ranks
+  the pipeline's own slowest stage — the dogfood path that proves the
+  RCA math on ourselves;
+* ``events.jsonl`` — journal events correlated to the ring's time range
+  (the journal is fsync'd first — a crash right after the dump cannot
+  truncate the incident's events);
+* ``metrics.json`` / ``metrics.prom`` — the registry snapshot;
+* ``manifest.json`` — reason, time range, span/trace counts, drops.
+
+Dumps are rate-limited (``ObsConfig.flight_min_interval_seconds``) so
+an incident storm cannot fill the disk; suppressed dumps are counted.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .spans import Span, get_tracer
+
+log = get_logger("microrank_tpu.obs.flight")
+
+FLIGHT_DIR = "flight"
+
+
+def _iso_us(us: int) -> str:
+    return str(np.datetime64(int(us), "us"))
+
+
+def spans_to_rows(spans: List[Span]) -> List[dict]:
+    """Render ring spans as rows of the canonical span schema.
+
+    ``startTime``/``endTime`` are TRACE-level bounds (the loader's
+    contract — io.schema documents them as trace start/end), computed
+    per trace id over the dump; ``duration`` stays per-span (µs), which
+    is what the SLO detector compares. ``podName`` mirrors the
+    subsystem so pod-level ranking names read ``<service>_<stage>``.
+    """
+    bounds = {}
+    for s in spans:
+        lo, hi = bounds.get(s.trace_id, (s.start_us, s.start_us + s.dur_us))
+        bounds[s.trace_id] = (
+            min(lo, s.start_us), max(hi, s.start_us + s.dur_us)
+        )
+    rows = []
+    for s in spans:
+        lo, hi = bounds[s.trace_id]
+        rows.append(
+            {
+                "traceID": s.trace_id,
+                "spanID": s.span_id,
+                "ParentSpanId": s.parent_id or "",
+                "operationName": s.name,
+                "serviceName": s.service,
+                "podName": s.service,
+                "duration": int(s.dur_us),
+                "startTime": _iso_us(lo),
+                "endTime": _iso_us(hi),
+            }
+        )
+    return rows
+
+
+def write_spans_csv(spans: List[Span], path) -> None:
+    import csv
+
+    cols = [
+        "traceID", "spanID", "ParentSpanId", "operationName",
+        "serviceName", "podName", "duration", "startTime", "endTime",
+    ]
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        for row in spans_to_rows(spans):
+            w.writerow(row)
+
+
+def write_chrome_trace(spans: List[Span], path) -> None:
+    """Chrome trace-event JSON ("X" complete events; one tid per
+    recording thread, named via "M" metadata events)."""
+    tids = {}
+    events = []
+    for s in spans:
+        tid = tids.setdefault(s.thread, len(tids) + 1)
+        events.append(
+            {
+                "name": s.name,
+                "cat": s.service,
+                "ph": "X",
+                "ts": s.start_us,
+                "dur": max(1, s.dur_us),
+                "pid": 1,
+                "tid": tid,
+                "args": {
+                    "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                    "parent_id": s.parent_id,
+                    **s.attrs,
+                },
+            }
+        )
+    meta = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": tid,
+            "args": {"name": thread},
+        }
+        for thread, tid in tids.items()
+    ]
+    Path(path).write_text(
+        json.dumps(
+            {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        )
+    )
+
+
+class FlightRecorder:
+    """Owns the dump directory, the rate limit, and the journal handle
+    to fsync+correlate. One per run (serve service / stream engine)."""
+
+    def __init__(
+        self,
+        out_dir,
+        obs_config,
+        journal=None,
+        tracer=None,
+    ):
+        self.base = Path(out_dir) / FLIGHT_DIR
+        self.cfg = obs_config
+        self.journal = journal
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._last_mono: Optional[float] = None
+        self.dumps = 0
+
+    @property
+    def tracer(self):
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    def dump(self, reason: str) -> Optional[Path]:
+        """Write one flight dump; returns its directory, or None when
+        the recorder is disabled or the rate limit suppressed it."""
+        from .metrics import record_flight_dump
+
+        if not self.cfg.flight:
+            return None
+        with self._lock:
+            now = time.monotonic()
+            if (
+                self._last_mono is not None
+                and now - self._last_mono
+                < max(0.0, float(self.cfg.flight_min_interval_seconds))
+            ):
+                record_flight_dump("suppressed")
+                return None
+            self._last_mono = now
+            self.dumps += 1
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+            dump_dir = self.base / f"{stamp}-{self.dumps:02d}-{reason}"
+        dump_dir.mkdir(parents=True, exist_ok=True)
+        tracer = self.tracer
+        spans = tracer.snapshot()
+        write_spans_csv(spans, dump_dir / "spans.csv")
+        write_chrome_trace(spans, dump_dir / "trace.json")
+        n_events = self._dump_journal(spans, dump_dir)
+        from . import get_registry
+        from .metrics import ensure_catalog
+
+        ensure_catalog()
+        get_registry().write_snapshot(dump_dir)
+        t_lo = min((s.start_us for s in spans), default=0)
+        t_hi = max((s.start_us + s.dur_us for s in spans), default=0)
+        (dump_dir / "manifest.json").write_text(
+            json.dumps(
+                {
+                    "reason": reason,
+                    "ts": time.time(),
+                    "spans": len(spans),
+                    "traces": len({s.trace_id for s in spans}),
+                    "spans_dropped": tracer.dropped,
+                    "ring_capacity": tracer.capacity,
+                    "t_min_us": t_lo,
+                    "t_max_us": t_hi,
+                    "journal_events": n_events,
+                },
+                indent=2,
+            )
+        )
+        record_flight_dump(reason)
+        log.info(
+            "flight dump (%s): %d spans / %d traces -> %s",
+            reason, len(spans), len({s.trace_id for s in spans}), dump_dir,
+        )
+        return dump_dir
+
+    def _dump_journal(self, spans: List[Span], dump_dir: Path) -> int:
+        """fsync the run journal, then copy the events overlapping the
+        ring's time range (±2 s slack) next to the spans."""
+        if self.journal is None:
+            return 0
+        from .journal import read_journal
+
+        self.journal.sync()
+        if not spans:
+            return 0
+        t_lo = min(s.start_us for s in spans) / 1e6 - 2.0
+        t_hi = max(s.start_us + s.dur_us for s in spans) / 1e6 + 2.0
+        events = [
+            e
+            for e in read_journal(self.journal.path)
+            if t_lo <= float(e.get("ts", 0.0)) <= t_hi
+        ]
+        with open(dump_dir / "events.jsonl", "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return len(events)

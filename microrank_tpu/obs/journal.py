@@ -24,6 +24,7 @@ carries ``ts`` (epoch seconds) and ``schema``.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from pathlib import Path
@@ -95,6 +96,26 @@ class RunJournal:
             telemetry=snapshot_to_result_fields(),
             **fields,
         )
+        # Durability edge: run_end is the record a post-mortem reads
+        # first — force it (and everything before it) to disk so a
+        # crash right after the drain cannot truncate the journal.
+        self.sync()
+
+    def sync(self) -> None:
+        """flush+fsync the journal file (run_end, SIGTERM drain, and
+        every flight-recorder dump call this): ``emit`` leaves each
+        line in the page cache when its handle closes; only an fsync
+        guarantees a crash never truncates the last incident's
+        events."""
+        with self._lock:
+            if not self.path.exists():
+                return
+            try:
+                with open(self.path, "a") as f:
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
 
 
 def read_journal(path) -> list:

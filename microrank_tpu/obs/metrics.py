@@ -275,6 +275,53 @@ def build_pool_builds() -> Counter:
     )
 
 
+def spans_recorded() -> Counter:
+    return get_registry().counter(
+        "microrank_spans_recorded_total",
+        "Pipeline self-tracing spans recorded into the bounded ring "
+        "(obs.spans; the flight recorder dumps the ring on incident "
+        "open / degraded dispatch / SIGTERM)",
+    )
+
+
+def flight_dumps() -> Counter:
+    return get_registry().counter(
+        "microrank_flight_dumps_total",
+        "Flight-recorder dumps written to out_dir/flight/, by trigger "
+        '(reason="suppressed" counts dumps the min-interval rate limit '
+        "swallowed)",
+        labelnames=("reason",),  # incident | degraded | sigterm | ...
+    )
+
+
+def device_hbm_bytes() -> Gauge:
+    return get_registry().gauge(
+        "microrank_device_hbm_bytes",
+        "Device memory at the last sampled dispatch "
+        "(Device.memory_stats; unset on backends without stats)",
+        labelnames=("kind",),  # live | peak
+    )
+
+
+def kernel_ms_per_iter() -> Gauge:
+    return get_registry().gauge(
+        "microrank_kernel_ms_per_iter",
+        "Per-iteration device time of the power-iteration kernel, "
+        "measured by trip-count differencing (bench.py "
+        "_profile_device_time — the loop body isolated from the RPC "
+        "floor)",
+        labelnames=("kernel",),
+    )
+
+
+def profile_sessions() -> Counter:
+    return get_registry().counter(
+        "microrank_profile_sessions_total",
+        "jax.profiler trace sessions captured, by trigger",
+        labelnames=("trigger",),  # endpoint | every_n
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -306,6 +353,8 @@ def ensure_catalog() -> None:
         dispatch_routes, dispatch_windows, dispatch_overlap_seconds,
         compile_cache_events,
         build_pool_inflight, build_pool_builds,
+        spans_recorded, flight_dumps, device_hbm_bytes,
+        kernel_ms_per_iter, profile_sessions,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -379,6 +428,21 @@ def record_build_pool(
     if build_seconds is not None:
         build_pool_builds().inc()
         stage_seconds().observe(float(build_seconds), stage="build_pool")
+
+
+def record_flight_dump(reason: str) -> None:
+    flight_dumps().inc(reason=reason)
+
+
+def record_profile_session(trigger: str) -> None:
+    profile_sessions().inc(trigger=trigger)
+
+
+def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:
+    """Wire a trip-count-differencing profile (bench.py
+    _profile_device_time) into the registry, so the measured per-iter
+    device time of each kernel is scrapeable next to the counters."""
+    kernel_ms_per_iter().set(float(ms), kernel=kernel)
 
 
 def record_staging(

@@ -1,0 +1,144 @@
+"""Device-profiler hooks: sampled ``jax.profiler`` sessions + HBM gauges.
+
+Three probes, all opt-in or free:
+
+* ``DeviceProfiler`` — wrap every N-th router dispatch in a
+  ``jax.profiler.trace`` session (``ObsConfig.profile_every_n``), so a
+  long-running serve/stream process periodically leaves a real XLA
+  profile on disk without anyone attaching a debugger;
+* ``capture_profile`` — the ``GET /profilez?seconds=S`` handler's
+  worker (obs.server): one on-demand session, serialized by a module
+  lock (jax supports one active trace per process);
+* ``record_device_memory`` — per-dispatch HBM live/peak byte gauges
+  from ``Device.memory_stats()`` (present on TPU; None on CPU — the
+  gauges just stay unset there).
+
+Everything imports jax lazily and swallows platform gaps: a CPU test
+run must never fail because its backend has no memory stats.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.obs.profiler")
+
+_profile_lock = threading.Lock()
+
+
+def capture_profile(out_dir, seconds: float = 1.0) -> Optional[str]:
+    """One on-demand ``jax.profiler`` session of ``seconds`` wall-clock,
+    written under ``out_dir``. Returns the session directory, or None
+    when another session is active or the profiler is unavailable."""
+    from .metrics import record_profile_session
+
+    if not _profile_lock.acquire(blocking=False):
+        return None
+    try:
+        import jax
+
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        session = Path(out_dir) / f"profilez-{stamp}"
+        session.mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(str(session))
+        try:
+            time.sleep(max(0.05, min(float(seconds), 30.0)))
+        finally:
+            jax.profiler.stop_trace()
+        record_profile_session("endpoint")
+        log.info("profilez: %.2fs session -> %s", seconds, session)
+        return str(session)
+    except Exception as exc:  # noqa: BLE001 - a broken profiler must
+        # never take down the metrics server answering the request.
+        log.warning("profilez capture failed: %s", exc)
+        return None
+    finally:
+        _profile_lock.release()
+
+
+class DeviceProfiler:
+    """Every-N-dispatches sampling: the router asks ``session()`` around
+    each dispatch; every ``every_n``-th call wraps the dispatch in a
+    ``jax.profiler.trace`` session under ``out_dir``."""
+
+    def __init__(self, every_n: int, out_dir):
+        self.every_n = max(0, int(every_n))
+        self.out_dir = Path(out_dir)
+        self._count = 0
+        self.sessions = 0
+
+    def session(self):
+        """Context manager for one dispatch (no-op unless sampled)."""
+        import contextlib
+
+        self._count += 1
+        if not self.every_n or self._count % self.every_n:
+            return contextlib.nullcontext()
+        return self._traced_session()
+
+    def _traced_session(self):
+        import contextlib
+
+        profiler = self
+
+        @contextlib.contextmanager
+        def _cm():
+            from .metrics import record_profile_session
+
+            if not _profile_lock.acquire(blocking=False):
+                yield  # a /profilez session is running; skip this sample
+                return
+            started = False
+            try:
+                import jax
+
+                session = profiler.out_dir / f"dispatch-{profiler._count}"
+                session.mkdir(parents=True, exist_ok=True)
+                jax.profiler.start_trace(str(session))
+                started = True
+                profiler.sessions += 1
+                record_profile_session("every_n")
+                yield
+            except Exception as exc:  # noqa: BLE001 - sampling must not
+                # fail the dispatch it wraps.
+                log.warning("dispatch profile session failed: %s", exc)
+                if not started:
+                    yield
+            finally:
+                if started:
+                    try:
+                        import jax
+
+                        jax.profiler.stop_trace()
+                    except Exception:  # noqa: BLE001 - already logged
+                        pass
+                _profile_lock.release()
+
+        return _cm()
+
+
+def record_device_memory() -> None:
+    """Sample HBM live/peak bytes into the registry gauges (first
+    addressable device — the one every single-device dispatch uses).
+    A backend without memory stats (CPU) leaves the gauges unset."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 - platform probe, never fatal
+        return
+    if not stats:
+        return
+    from .metrics import device_hbm_bytes
+
+    live = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if live is not None:
+        device_hbm_bytes().set(float(live), kind="live")
+    if peak is not None:
+        device_hbm_bytes().set(float(peak), kind="peak")

@@ -5,7 +5,12 @@ dependencies, nothing listening unless asked. Routes:
 
 * ``/metrics``      — Prometheus text exposition (scrape target);
 * ``/metrics.json`` — the JSON snapshot form;
-* ``/healthz``      — liveness probe.
+* ``/healthz``      — liveness probe;
+* ``/profilez``     — on-demand ``jax.profiler`` session
+  (``?seconds=S``, default 1, capped at 30): captures a device profile
+  under the server's profile directory and returns its path as JSON.
+  One session at a time (409 while another runs); the capture blocks
+  only the requesting handler thread, never the pipeline.
 """
 
 from __future__ import annotations
@@ -20,26 +25,57 @@ from .registry import MetricsRegistry, get_registry
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _make_handler(registry: MetricsRegistry):
+def _make_handler(registry: MetricsRegistry, profile_dir=None):
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API name)
-            if self.path.split("?")[0] == "/metrics":
+            route, _, query = self.path.partition("?")
+            status = 200
+            if route == "/metrics":
                 body = registry.to_prometheus().encode()
                 ctype = PROM_CONTENT_TYPE
-            elif self.path.split("?")[0] == "/metrics.json":
+            elif route == "/metrics.json":
                 body = json.dumps(registry.to_json()).encode()
                 ctype = "application/json"
-            elif self.path.split("?")[0] == "/healthz":
+            elif route == "/healthz":
                 body = b"ok\n"
                 ctype = "text/plain"
+            elif route == "/profilez":
+                status, body = self._profilez(query)
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return
-            self.send_response(200)
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        @staticmethod
+        def _profilez(query: str):
+            from urllib.parse import parse_qs
+
+            from .profiler import capture_profile
+
+            if profile_dir is None:
+                return 404, json.dumps(
+                    {"error": "no profile directory configured"}
+                ).encode()
+            try:
+                seconds = float(
+                    parse_qs(query).get("seconds", ["1.0"])[0]
+                )
+            except ValueError:
+                return 400, b'{"error": "seconds must be a number"}'
+            session = capture_profile(profile_dir, seconds)
+            if session is None:
+                return 409, json.dumps(
+                    {"error": "another profile session is active "
+                     "(or the profiler is unavailable)"}
+                ).encode()
+            return 200, json.dumps(
+                {"session": session, "seconds": seconds}
+            ).encode()
 
         def log_message(self, fmt, *args):  # scrapes are not log events
             pass
@@ -51,14 +87,14 @@ class MetricsServer:
     """Owns the listening socket + serving thread; ``close()`` to stop."""
 
     def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", profile_dir=None):
         if registry is None:
             from .metrics import ensure_catalog
 
             ensure_catalog()  # scrapes see the full catalog from poll 1
             registry = get_registry()
         self.httpd = ThreadingHTTPServer(
-            (host, int(port)), _make_handler(registry)
+            (host, int(port)), _make_handler(registry, profile_dir)
         )
         self.port = self.httpd.server_address[1]  # resolved (port 0 = any)
         self._thread = threading.Thread(
@@ -74,7 +110,11 @@ class MetricsServer:
 
 
 def start_metrics_server(
-    port: int, registry: Optional[MetricsRegistry] = None
+    port: int,
+    registry: Optional[MetricsRegistry] = None,
+    profile_dir=None,
 ) -> MetricsServer:
-    """Start serving the registry on ``port`` (0 picks a free port)."""
-    return MetricsServer(port, registry)
+    """Start serving the registry on ``port`` (0 picks a free port).
+    ``profile_dir`` arms the ``/profilez`` on-demand device-profiler
+    endpoint (sessions land under it)."""
+    return MetricsServer(port, registry, profile_dir=profile_dir)

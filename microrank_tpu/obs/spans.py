@@ -1,0 +1,283 @@
+"""Span-level self-tracing: the pipeline's own distributed trace.
+
+MicroRank's premise is that parent-linked spans localize latency root
+causes — yet until this module the serve/stream/dispatch pipeline (a
+multi-threaded system: scheduler thread, build worker pool, engine
+thread, double-buffered staging) emitted only aggregate metrics and
+per-window journal lines, so a degraded dispatch or a slow stage was
+invisible as a causal chain. Here every stage at the journal's existing
+choke points emits a span:
+
+* a **trace** is one unit of pipeline work — a streaming window
+  (``trace_id = "win-<start>"``), a serve request (``trace_id =
+  request_id``), or an offline replay window;
+* a **span** is one stage of that trace: ingest/parse, detect, graph
+  ``build`` (on the worker pool), ``staging``, ``device_dispatch``,
+  ``result_fetch``, ``incident`` lifecycle — parent-linked through a
+  ``contextvars`` trace context that callers explicitly carry across
+  threads (``current_context()`` at submit, ``attach()`` on the
+  worker);
+* completed spans land in a bounded in-memory **ring** (a locked
+  deque), cheap enough to stay on in production: the per-span cost is
+  a contextvar read plus the deque append (~2 us next to
+  millisecond-scale stages; ``bench.py`` reports the replay overhead
+  as ``trace_overhead``).
+
+The flight recorder (``obs.flight``) dumps the ring as Perfetto JSON
+and as MicroRank's OWN span CSV schema, so ``cli run`` over a dump
+ranks the pipeline's slowest stage — the dogfood path.
+
+Chaos hook: ``ObsConfig.inject_stage_sleep_ms`` sleeps inside every
+``inject_every``-th span named ``inject_stage`` — the dogfood test
+slows the build pool this way and asserts the self-rank blames it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+# The ambient trace context of the current thread of execution. Worker
+# threads do NOT inherit it implicitly — the pool/scheduler seams
+# capture it at submit time and attach it on the worker (that explicit
+# hand-off IS the cross-thread propagation this module exists to test).
+_CTX: "contextvars.ContextVar[Optional[SpanContext]]" = (
+    contextvars.ContextVar("microrank_span_ctx", default=None)
+)
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """What a child span needs from its parent: the trace it belongs to
+    and the span id to parent-link against."""
+
+    trace_id: str
+    span_id: str
+
+
+@dataclass
+class Span:
+    """One completed pipeline stage."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str                    # stage name (the journal's vocabulary)
+    service: str                 # subsystem: pipeline|stream|serve|dispatch
+    thread: str                  # recording thread's name
+    start_us: int                # epoch microseconds
+    dur_us: int
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+
+class SpanTracer:
+    """Bounded-ring span recorder with contextvar trace propagation.
+
+    Thread-safe: spans complete on whichever thread ran the stage; the
+    ring append holds one lock for a deque push. ``enabled=False``
+    makes every API a near-no-op (one attribute read) so the tracer can
+    stay wired unconditionally.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 8192,
+        enabled: bool = True,
+        inject_stage: str = "",
+        inject_sleep_ms: float = 0.0,
+        inject_every: int = 1,
+    ):
+        self.enabled = bool(enabled)
+        self.capacity = max(16, int(capacity))
+        self._ring: "deque[Span]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.recorded = 0            # lifetime spans (ring may have fewer)
+        self.inject_stage = inject_stage
+        self.inject_sleep_ms = float(inject_sleep_ms)
+        self.inject_every = max(1, int(inject_every))
+        self._inject_seen = 0
+
+    # ------------------------------------------------------------ context
+    def new_trace(self, trace_id: str) -> SpanContext:
+        """Root context for one unit of pipeline work (window/request).
+        Children parent-link to the root span id; the root span itself
+        is recorded explicitly by the owner via :meth:`record_span`."""
+        return SpanContext(str(trace_id), f"s{next(self._ids):08x}")
+
+    @staticmethod
+    def current_context() -> Optional[SpanContext]:
+        """The ambient context on THIS thread (capture before handing
+        work to a pool; attach it on the worker)."""
+        return _CTX.get()
+
+    @contextlib.contextmanager
+    def attach(self, ctx: Optional[SpanContext]) -> Iterator[None]:
+        """Install ``ctx`` as the ambient context for the block — the
+        explicit cross-thread hand-off. ``None`` is a no-op (spans in
+        the block start fresh traces)."""
+        if ctx is None:
+            yield
+            return
+        token = _CTX.set(ctx)
+        try:
+            yield
+        finally:
+            _CTX.reset(token)
+
+    # -------------------------------------------------------------- spans
+    @contextlib.contextmanager
+    def span(
+        self,
+        name: str,
+        service: str = "pipeline",
+        ctx: Optional[SpanContext] = None,
+        **attrs,
+    ) -> Iterator[Optional[SpanContext]]:
+        """Record one stage span around the block.
+
+        Parentage: ``ctx`` when given, else the ambient context; with
+        neither, the span roots a fresh anonymous trace. The span's own
+        context is ambient inside the block, so nested stages (the
+        router's staging/dispatch/fetch under a window's rank) link up
+        without threading anything through signatures.
+        """
+        if not self.enabled:
+            yield None
+            return
+        parent = ctx if ctx is not None else _CTX.get()
+        trace_id = (
+            parent.trace_id if parent else f"trace-{next(self._ids):08x}"
+        )
+        own = SpanContext(trace_id, f"s{next(self._ids):08x}")
+        token = _CTX.set(own)
+        start_us = int(time.time() * 1e6)
+        p0 = time.perf_counter()
+        try:
+            yield own
+        finally:
+            self._maybe_inject(name)
+            dur_us = int((time.perf_counter() - p0) * 1e6)
+            _CTX.reset(token)
+            self._record(
+                Span(
+                    trace_id=trace_id,
+                    span_id=own.span_id,
+                    parent_id=parent.span_id if parent else None,
+                    name=str(name),
+                    service=str(service),
+                    thread=threading.current_thread().name,
+                    start_us=start_us,
+                    dur_us=dur_us,
+                    attrs=dict(attrs) if attrs else {},
+                )
+            )
+
+    def record_span(
+        self,
+        name: str,
+        ctx: SpanContext,
+        start_us: int,
+        dur_us: int,
+        service: str = "pipeline",
+        parent_id: Optional[str] = None,
+        **attrs,
+    ) -> None:
+        """Record a span whose lifetime was tracked externally — the
+        per-window/per-request ROOT span, whose start and end straddle
+        async hand-offs no single ``with`` block can wrap. ``ctx`` is
+        the root context children already parent-linked against."""
+        if not self.enabled:
+            return
+        self._record(
+            Span(
+                trace_id=ctx.trace_id,
+                span_id=ctx.span_id,
+                parent_id=parent_id,
+                name=str(name),
+                service=str(service),
+                thread=threading.current_thread().name,
+                start_us=int(start_us),
+                dur_us=max(0, int(dur_us)),
+                attrs=dict(attrs) if attrs else {},
+            )
+        )
+
+    def _maybe_inject(self, name: str) -> None:
+        """The chaos hook: sleep inside every ``inject_every``-th span
+        named ``inject_stage`` (still inside the span's timed region,
+        so the recorded duration carries the fault — exactly what a
+        genuinely slow stage would look like)."""
+        if self.inject_sleep_ms <= 0 or name != self.inject_stage:
+            return
+        self._inject_seen += 1
+        if (self._inject_seen - 1) % self.inject_every == 0:
+            time.sleep(self.inject_sleep_ms / 1e3)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+            self.recorded += 1
+        from .metrics import spans_recorded
+
+        spans_recorded().inc()
+
+    # ------------------------------------------------------------ reading
+    def snapshot(self) -> List[Span]:
+        """Stable copy of the ring, oldest first (the flight recorder's
+        read path)."""
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        """Lifetime spans that fell off the ring."""
+        with self._lock:
+            return self.recorded - len(self._ring)
+
+
+_tracer_lock = threading.Lock()
+_tracer: Optional[SpanTracer] = None
+
+
+def get_tracer() -> SpanTracer:
+    """The process tracer every instrumentation point records into.
+    Starts DISABLED — pipelines arm it from their config at run start
+    (``configure_tracer``), so library imports and unit tests pay
+    nothing."""
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            _tracer = SpanTracer(enabled=False)
+        return _tracer
+
+
+def set_tracer(tracer: Optional[SpanTracer]) -> None:
+    global _tracer
+    with _tracer_lock:
+        _tracer = tracer
+
+
+def configure_tracer(obs_config) -> SpanTracer:
+    """Install a fresh tracer per ObsConfig (run entry points call this:
+    TableRCA.run, StreamEngine.run, ServeService.start). A fresh ring
+    per run means a flight dump never mixes two runs' spans."""
+    tracer = SpanTracer(
+        capacity=obs_config.span_ring,
+        enabled=obs_config.spans,
+        inject_stage=obs_config.inject_stage,
+        inject_sleep_ms=obs_config.inject_stage_sleep_ms,
+        inject_every=obs_config.inject_every,
+    )
+    set_tracer(tracer)
+    return tracer

@@ -8,6 +8,9 @@ from .mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh, single_axis_mesh
 from .sharded_rank import (
     rank_windows_batched,
     rank_windows_sharded,
+    rank_windows_sharded_checked,
+    rank_windows_sharded_checked_traced,
+    resolve_sharded_rank_fn,
     stack_window_graphs,
 )
 
@@ -18,6 +21,9 @@ __all__ = [
     "single_axis_mesh",
     "rank_windows_batched",
     "rank_windows_sharded",
+    "rank_windows_sharded_checked",
+    "rank_windows_sharded_checked_traced",
+    "resolve_sharded_rank_fn",
     "stack_window_graphs",
     "initialize_distributed",
     "is_primary",

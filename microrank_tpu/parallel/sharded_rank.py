@@ -665,6 +665,154 @@ def rank_windows_sharded_traced(
     )(batched)
 
 
+# ---------------------------------------------------------------------------
+# checkify instrumentation for the sharded path (PR 7). The single-device
+# checked programs thread checkify's error state through the whole rank;
+# composing checkify directly with shard_map's replication machinery is
+# version-fragile, so the sharded checks run as a separate tiny jitted
+# EPILOGUE program over the sharded outputs — still device-side, still
+# before any host fetch, same invariants as rank_window_checked_traced_core
+# (finite live scores, n_valid in [0,k], finite live residuals), just
+# per-batch instead of inlined into the iteration program.
+
+
+def _sharded_checked_core(top_idx, top_scores, n_valid):
+    from jax.experimental import checkify
+
+    live = (
+        jnp.arange(top_scores.shape[-1])[None, :] < n_valid[:, None]
+    )
+    checkify.check(
+        jnp.all(jnp.where(live, jnp.isfinite(top_scores), True)),
+        "non-finite ranked score in a sharded batch "
+        "(preference vector or spectrum formula produced NaN/inf)",
+    )
+    checkify.check(
+        jnp.all(
+            jnp.logical_and(
+                n_valid >= 0, n_valid <= top_scores.shape[-1]
+            )
+        ),
+        "n_valid outside [0, k] in a sharded batch",
+    )
+    return top_idx, top_scores, n_valid
+
+
+def _sharded_checked_traced_core(
+    top_idx, top_scores, n_valid, residuals, n_iters
+):
+    from jax.experimental import checkify
+
+    _sharded_checked_core(top_idx, top_scores, n_valid)
+    live_it = (
+        jnp.arange(residuals.shape[-1])[None, None, :]
+        < n_iters[:, None, None]
+    )
+    checkify.check(
+        jnp.all(jnp.where(live_it, jnp.isfinite(residuals), True)),
+        "non-finite power-iteration residual in a sharded batch "
+        "(the ranking vectors diverged)",
+    )
+    return top_idx, top_scores, n_valid, residuals, n_iters
+
+
+_SHARDED_CHECKED_JIT = None
+_SHARDED_CHECKED_TRACED_JIT = None
+
+
+def _sharded_checked_jit():
+    global _SHARDED_CHECKED_JIT
+    if _SHARDED_CHECKED_JIT is None:
+        from jax.experimental import checkify
+
+        _SHARDED_CHECKED_JIT = jax.jit(
+            checkify.checkify(
+                _sharded_checked_core, errors=checkify.user_checks
+            )
+        )
+    return _SHARDED_CHECKED_JIT
+
+
+def _sharded_checked_traced_jit():
+    global _SHARDED_CHECKED_TRACED_JIT
+    if _SHARDED_CHECKED_TRACED_JIT is None:
+        from jax.experimental import checkify
+
+        _SHARDED_CHECKED_TRACED_JIT = jax.jit(
+            checkify.checkify(
+                _sharded_checked_traced_core, errors=checkify.user_checks
+            )
+        )
+    return _SHARDED_CHECKED_TRACED_JIT
+
+
+@contract(
+    batched="windowgraph",
+    returns=("int32[B,K]", "float32[B,K]", "int32[B]"),
+)
+def rank_windows_sharded_checked(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    mesh: Mesh,
+    kernel: str = "coo",
+):
+    """rank_windows_sharded plus device-side checkify assertions —
+    the sharded twin of ``rank_window_checked`` (RuntimeConfig.
+    device_checks finally covers the mesh path). Raises
+    ``checkify.JaxRuntimeError`` naming the failed check."""
+    from jax.experimental import checkify
+
+    outs = rank_windows_sharded(
+        batched, pagerank_cfg, spectrum_cfg, mesh, kernel
+    )
+    err, outs = _sharded_checked_jit()(*outs)
+    checkify.check_error(err)
+    return outs
+
+
+@contract(
+    batched="windowgraph",
+    returns=(
+        "int32[B,K]", "float32[B,K]", "int32[B]", "float32[B,2,I]",
+        "int32[B]",
+    ),
+)
+def rank_windows_sharded_checked_traced(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    mesh: Mesh,
+    kernel: str = "coo",
+):
+    """rank_windows_sharded_traced plus device-side checkify assertions
+    — device_checks AND the convergence trace on the mesh path in one
+    dispatch, mirroring rank_window_checked_traced (the PR 6 regression
+    test's single-device program)."""
+    from jax.experimental import checkify
+
+    outs = rank_windows_sharded_traced(
+        batched, pagerank_cfg, spectrum_cfg, mesh, kernel
+    )
+    err, outs = _sharded_checked_traced_jit()(*outs)
+    checkify.check_error(err)
+    return outs
+
+
+def resolve_sharded_rank_fn(conv_trace: bool, device_checks: bool):
+    """The one (conv, checks) -> sharded-program policy, shared by the
+    table lane and the dispatch router so they cannot disagree."""
+    if device_checks:
+        return (
+            rank_windows_sharded_checked_traced
+            if conv_trace
+            else rank_windows_sharded_checked
+        )
+    return (
+        rank_windows_sharded_traced if conv_trace else rank_windows_sharded
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _rank_windows_batched_jit(
     batched: WindowGraph,

@@ -65,13 +65,10 @@ class TableRCA:
             # dispatch; per-window dispatch checks this at rank time.
             self._mesh = make_mesh(shape, (WINDOW_AXIS, SHARD_AXIS))
             self.log.info("ranking on a %s mesh", self._mesh.devices.shape)
-            if config.runtime.device_checks:
-                self.log.warning(
-                    "device_checks applies to single-device dispatch "
-                    "only; the sharded path runs without checkify "
-                    "instrumentation (host-side validate_numerics still "
-                    "applies)"
-                )
+            # device_checks now covers the mesh path too: sharded
+            # dispatches route through rank_windows_sharded_checked[_
+            # traced] (parallel.sharded_rank), the checkify epilogue
+            # over the sharded outputs.
             if config.runtime.kernel not in ("auto",) + SHARD_KERNELS:
                 self.log.warning(
                     "kernel=%r is not shard-capable; the sharded path "
@@ -260,16 +257,11 @@ class TableRCA:
         # the rank entry points (analysis.contracts).
         with contract_checks(cfg.runtime.validate_numerics):
             if self._mesh is not None:
-                from ..parallel.sharded_rank import (
-                    rank_windows_sharded,
-                    rank_windows_sharded_traced,
-                )
+                from ..parallel.sharded_rank import resolve_sharded_rank_fn
 
                 batched = self._stage_sharded([graph], kernel)
-                fn = (
-                    rank_windows_sharded_traced
-                    if conv
-                    else rank_windows_sharded
+                fn = resolve_sharded_rank_fn(
+                    conv, cfg.runtime.device_checks
                 )
                 batch_outs = fn(
                     batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel
@@ -406,6 +398,9 @@ class TableRCA:
         cfg = self.config
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before run()")
+        from ..obs.spans import configure_tracer
+
+        configure_tracer(cfg.obs)  # fresh span ring per run
         if sink is None and out_dir is not None:
             sink = ResultSink(
                 out_dir, overwrite_csv=cfg.compat.overwrite_results
@@ -458,15 +453,17 @@ class TableRCA:
         # collectives in program order on every rank, which worker
         # threads cannot guarantee — force synchronous there.
         async_mode = bool(cfg.runtime.async_dispatch) and not batch_windows
-        if batch_windows and cfg.runtime.device_checks:
-            # ADVICE r4: _rank_pending dispatches the batched program,
-            # which has no checkify variant — say so instead of silently
-            # dropping the user's in-program checks (host-side
-            # validate_numerics still applies to every window).
+        if batch_windows and cfg.runtime.device_checks and self._mesh is None:
+            # ADVICE r4: the single-device batched program has no
+            # checkify variant — say so instead of silently dropping
+            # the user's in-program checks (host-side validate_numerics
+            # still applies to every window). On a mesh, batch mode DOES
+            # check: _rank_pending routes through the sharded checked
+            # programs.
             self.log.warning(
                 "device_checks applies to per-window dispatch only; "
-                "run(batch_windows=True) ranks without checkify "
-                "instrumentation"
+                "run(batch_windows=True) without a mesh ranks without "
+                "checkify instrumentation"
             )
         if async_mode and jax.process_count() > 1:
             self.log.warning(
@@ -813,13 +810,18 @@ class TableRCA:
         or WINDOWS in flight (``chunk_bulk``, where depth is
         bulk_fetch_windows and the join is one fetch of everything)."""
         from ..obs.metrics import record_window_outcome
+        from ..obs.spans import get_tracer
 
+        tracer = get_tracer()
         cfg = self.config
         while (
             current + detect_us <= end if complete_only else current < end
         ):
             w0, w1 = current, current + detect_us
-            timings = StageTimings()
+            # One trace per window (trace_id = the window start): the
+            # StageTimings ctx pins every stage span — including ones
+            # completing later on the async fetch workers — to it.
+            timings = StageTimings(ctx=tracer.new_trace(f"win-{_iso(w0)}"))
             result = WindowResult(start=_iso(w0), end=_iso(w1), anomaly=False)
             ranked = False
 
@@ -907,10 +909,7 @@ class TableRCA:
         sharded over the full (windows, shard) mesh when one is
         configured (the windows axis splits the batch, the shard axis
         splits each window's graph), vmapped single-device otherwise."""
-        from ..parallel.sharded_rank import (
-            rank_windows_sharded,
-            stack_window_graphs,
-        )
+        from ..parallel.sharded_rank import stack_window_graphs
 
         from ..graph.build import aux_for_kernel
         from ..parallel.distributed import fetch_replicated
@@ -956,13 +955,11 @@ class TableRCA:
                     graphs + [graphs[-1]] * n_pad, kernel
                 )
                 from ..parallel.sharded_rank import (
-                    rank_windows_sharded_traced,
+                    resolve_sharded_rank_fn,
                 )
 
-                fn = (
-                    rank_windows_sharded_traced
-                    if conv
-                    else rank_windows_sharded
+                fn = resolve_sharded_rank_fn(
+                    conv, cfg.runtime.device_checks
                 )
                 outs = fn(
                     batched, cfg.pagerank, cfg.spectrum, self._mesh, kernel

@@ -1479,8 +1479,15 @@ def prepare_window_graph(span_df, normal_ids, abnormal_ids, config):
     policy, resolve kernel="auto", and strip the fields the kernel
     never reads. Returns ``(graph, op_names, kernel)`` with the graph
     already ``device_subset``-stripped for ``kernel``.
+
+    Self-tracing: the whole host build is one ``build`` span under the
+    caller's ambient trace context — on the serve/stream paths that
+    context was attached by the build worker pool, so the span records
+    the build's true thread and its causal parent (the window/request
+    root).
     """
     from ..graph.build import aux_for_kernel, build_window_graph
+    from ..obs.spans import get_tracer
     from .base import validate_partitions
 
     normal_ids = list(normal_ids)
@@ -1488,19 +1495,22 @@ def prepare_window_graph(span_df, normal_ids, abnormal_ids, config):
     validate_partitions(normal_ids, abnormal_ids)
     validate_tiebreak(config.spectrum)
     rt = config.runtime
-    graph, op_names, _, _ = build_window_graph(
-        span_df,
-        normal_ids,
-        abnormal_ids,
-        pad_policy=rt.pad_policy,
-        min_pad=rt.min_pad,
-        aux=aux_for_kernel(rt.kernel),
-        dense_budget_bytes=rt.dense_budget_bytes,
-        collapse=rt.collapse_kinds,
-    )
-    kernel = rt.kernel
-    if kernel == "auto":
-        kernel = choose_kernel(graph, rt.dense_budget_bytes, rt.prefer_bf16)
+    with get_tracer().span("build", service="pipeline"):
+        graph, op_names, _, _ = build_window_graph(
+            span_df,
+            normal_ids,
+            abnormal_ids,
+            pad_policy=rt.pad_policy,
+            min_pad=rt.min_pad,
+            aux=aux_for_kernel(rt.kernel),
+            dense_budget_bytes=rt.dense_budget_bytes,
+            collapse=rt.collapse_kinds,
+        )
+        kernel = rt.kernel
+        if kernel == "auto":
+            kernel = choose_kernel(
+                graph, rt.dense_budget_bytes, rt.prefer_bf16
+            )
     return device_subset(graph, kernel), op_names, kernel
 
 

@@ -55,6 +55,11 @@ class PendingWindow:
     enqueued: float                  # monotonic, at admission
     built: float = 0.0               # monotonic, graph build done
     on_done: Optional[Callable] = None
+    # Self-tracing: the request's root span context (obs.spans) and the
+    # epoch-µs the request entered build — finish() records the root
+    # ``request`` span from these once the response resolves.
+    ctx: object = None
+    t0_us: int = 0
     _finished: bool = field(default=False, repr=False)
 
     def finish(self, error: Optional[BaseException] = None) -> None:
@@ -67,6 +72,19 @@ class PendingWindow:
             self.future.set_result(self.result)
         if self.on_done is not None:
             self.on_done(self, error)
+        if self.ctx is not None and self.t0_us:
+            from ..obs.spans import get_tracer
+
+            get_tracer().record_span(
+                "request",
+                ctx=self.ctx,
+                start_us=self.t0_us,
+                dur_us=int(time.time() * 1e6) - self.t0_us,
+                service="serve",
+                tenant=self.request.tenant,
+                degraded=bool(self.result.degraded),
+                error=type(error).__name__ if error else None,
+            )
 
 
 def _conv_summary(residuals, n_iters) -> dict:
@@ -93,12 +111,18 @@ class MicroBatcher:
     scheduler thread is the device's program-order guarantee.
     """
 
-    def __init__(self, config: MicroRankConfig, journal=None, router=None):
+    def __init__(
+        self, config: MicroRankConfig, journal=None, router=None,
+        flight=None,
+    ):
         from ..dispatch import DispatchRouter
 
         self.config = config
         self.serve = config.serve
         self.journal = journal
+        # Flight recorder (obs.flight): a degraded batch dumps the span
+        # ring — the causal record of the dispatch that just failed.
+        self.flight = flight
         # The shared dispatch seam (PR 5): size-aware sharded/vmapped
         # routing + double-buffered staging live there, not here.
         self.router = (
@@ -219,13 +243,20 @@ class MicroBatcher:
             next_batch = (
                 [pw.graph for pw in next_items], next_items[0].kernel
             )
-        with contract_checks(rt.validate_numerics):
-            outs, info = self.router.rank_batch(
-                [pw.graph for pw in items],
-                kernel,
-                conv_trace=bool(rt.convergence_trace),
-                next_batch=next_batch,
-            )
+        from ..obs.spans import get_tracer
+
+        # The router's staging/dispatch/fetch spans attribute to the
+        # batch HEAD's request trace (one device program answers the
+        # whole micro-batch); each member's span still records the
+        # occupancy it rode in.
+        with get_tracer().attach(items[0].ctx):
+            with contract_checks(rt.validate_numerics):
+                outs, info = self.router.rank_batch(
+                    [pw.graph for pw in items],
+                    kernel,
+                    conv_trace=bool(rt.convergence_trace),
+                    next_batch=next_batch,
+                )
         return outs, info
 
     def _assign(self, items, outs, batch_ms: float, route_info=None) -> None:
@@ -264,7 +295,12 @@ class MicroBatcher:
     # -------------------------------------------------------- degradation
     def _degrade(self, items, error, warmup=False) -> None:
         """Device path is down for this batch: answer from the numpy_ref
-        oracle per request (``fallback``), or fail the batch."""
+        oracle per request (``fallback``), or fail the batch. Either
+        way the flight recorder dumps the span ring first — the causal
+        record of the dispatch that just died is exactly what the
+        post-mortem needs, and the ring is still hot."""
+        if self.flight is not None:
+            self.flight.dump("degraded")
         if not self.serve.fallback:
             for pw in items:
                 pw.finish(error=error)

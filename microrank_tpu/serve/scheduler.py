@@ -41,11 +41,14 @@ class ShutdownError(RuntimeError):
 
 
 class BatchScheduler(threading.Thread):
-    def __init__(self, service, journal=None, build_pool=None, router=None):
+    def __init__(
+        self, service, journal=None, build_pool=None, router=None,
+        flight=None,
+    ):
         super().__init__(name="mr-serve-sched", daemon=True)
         self.service = service
         self.batcher = MicroBatcher(
-            service.config, journal=journal, router=router
+            service.config, journal=journal, router=router, flight=flight
         )
         self.build_pool = build_pool
         self._cond = threading.Condition()
@@ -61,9 +64,14 @@ class BatchScheduler(threading.Thread):
         request: RankRequest,
         on_done: Optional[Callable] = None,
     ) -> Future:
-        """Enqueue one admitted request; returns its response future."""
+        """Enqueue one admitted request; returns its response future.
+        The request's trace root (trace_id = request_id) is minted here
+        — at admission — so queue time is inside the ``request`` span."""
+        from ..obs.spans import get_tracer
+
         fut: Future = Future()
-        entry = (request, fut, time.monotonic(), on_done)
+        ctx = get_tracer().new_trace(request.request_id)
+        entry = (request, fut, time.monotonic(), on_done, ctx)
         with self._cond:
             if self._stopping:
                 fut.set_exception(ShutdownError("service shutting down"))
@@ -136,11 +144,15 @@ class BatchScheduler(threading.Thread):
             return self._builds
 
     def _process(self, entry) -> None:
-        request, fut, enqueued, on_done = entry
+        from ..obs.spans import get_tracer
+
+        request, fut, enqueued, on_done, ctx = entry
+        tracer = get_tracer()
         if self.build_pool is None:
-            pw = self.service.build_pending(
-                request, fut, enqueued, on_done
-            )
+            with tracer.attach(ctx):
+                pw = self.service.build_pending(
+                    request, fut, enqueued, on_done
+                )
             if pw is not None:
                 self.batcher.submit(pw)
             return
@@ -168,11 +180,14 @@ class BatchScheduler(threading.Thread):
                 self._builds -= 1
                 self._cond.notify()
 
-        self.build_pool.submit(
-            self.service.build_pending,
-            request, fut, enqueued, on_done,
-            on_done=_done,
-        )
+        # attach: the pool captures the scheduler thread's ambient
+        # context at submit, carrying the request trace onto the worker.
+        with tracer.attach(ctx):
+            self.build_pool.submit(
+                self.service.build_pending,
+                request, fut, enqueued, on_done,
+                on_done=_done,
+            )
 
     # -------------------------------------------------------------- stop
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
@@ -182,7 +197,7 @@ class BatchScheduler(threading.Thread):
             self._draining = drain
             if not drain:
                 for q in self._tenants.values():
-                    for request, fut, _, on_done in q:
+                    for request, fut, _, on_done, _ctx in q:
                         err = ShutdownError("service shutting down")
                         fut.set_exception(err)
                         if on_done is not None:

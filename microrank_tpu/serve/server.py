@@ -93,11 +93,21 @@ class ServeService:
         self.cache_dir = configure_compile_cache(config.runtime)
         self.cache_probe = CompileCacheProbe(self.cache_dir)
         self.router = DispatchRouter(config)
+        # Flight recorder: degraded dispatches and the SIGTERM drain
+        # dump the span ring + journal + metrics to out_dir/flight/.
+        self.flight = None
+        if self.out_dir is not None:
+            from ..obs import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self.out_dir, config.obs, journal=self.journal
+            )
         self.scheduler = BatchScheduler(
             self,
             journal=self.journal,
             build_pool=self.build_pool,
             router=self.router,
+            flight=self.flight,
         )
         self.datasets: Dict[str, object] = {}
         self.slo_vocab = None
@@ -122,11 +132,13 @@ class ServeService:
         self.log.info("staged dataset %r: %d spans", name, len(span_df))
 
     def start(self) -> None:
+        from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
 
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before start()")
         ensure_catalog()
+        configure_tracer(self.config.obs)  # fresh span ring per service
         if self.journal is not None:
             self.journal.run_start(
                 pipeline="serve",
@@ -244,8 +256,10 @@ class ServeService:
         or None when the request resolved immediately (clean window,
         degenerate partition, bad payload)."""
         from ..obs.metrics import serve_stage_seconds
+        from ..obs.spans import get_tracer
         from .batcher import PendingWindow
 
+        tracer = get_tracer()
         queue_s = time.monotonic() - enqueued
         serve_stage_seconds().observe(queue_s, stage="queue")
         result = WindowResult(
@@ -257,15 +271,22 @@ class ServeService:
             request=request, result=result, span_df=None,
             normal_ids=[], abnormal_ids=[], graph=None, op_names=[],
             kernel="", future=fut, enqueued=enqueued, on_done=on_done,
+            # Root span bookkeeping: the ambient context is the request
+            # trace the scheduler attached (queue time backdated into
+            # the root span's start).
+            ctx=tracer.current_context(),
+            t0_us=int((time.time() - queue_s) * 1e6),
         )
         t0 = time.monotonic()
         try:
-            window_df = self._window_frame(request)
+            with tracer.span("parse", service="serve"):
+                window_df = self._window_frame(request)
             result.start = str(window_df["startTime"].min())
             result.end = str(window_df["endTime"].max())
-            flag, nrm, abn = _detect_partition(
-                self.config, self.slo_vocab, self.baseline, window_df
-            )
+            with tracer.span("detect", service="serve"):
+                flag, nrm, abn = _detect_partition(
+                    self.config, self.slo_vocab, self.baseline, window_df
+                )
             result.anomaly = bool(flag)
             result.n_normal, result.n_abnormal = len(nrm), len(abn)
             result.n_traces = len(nrm) + len(abn)
@@ -344,6 +365,10 @@ class ServeService:
             self.build_pool.shutdown()
         if self.journal is not None:
             self.journal.run_end(dispatches=self.scheduler.batcher.dispatches)
+        if self.flight is not None:
+            # SIGTERM drain: the last flight dump is the shutdown's
+            # black box — ring + fsync'd journal + final metrics.
+            self.flight.dump("sigterm")
         if self.out_dir is not None and self.config.runtime.telemetry:
             from ..obs import get_registry
             from ..obs.metrics import ensure_catalog

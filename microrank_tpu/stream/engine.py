@@ -66,6 +66,18 @@ class _PendingRank:
     closed: ClosedWindow
     result: WindowResult
     future: object              # -> (graph, op_names, kernel)
+    trace: object = None        # _WindowTrace (span context + start)
+
+
+@dataclass
+class _WindowTrace:
+    """The self-tracing handle of one window: the root span context its
+    stages parent-link against, plus the processing start times the
+    root ``window`` span is recorded from at finalize."""
+
+    ctx: object
+    start_us: int
+    perf0: float
 
 
 @dataclass
@@ -168,12 +180,23 @@ class StreamEngine:
         self._cache_dir = None
         self._cache_probe = None
         self.summary = StreamSummary()
+        # Flight recorder: dumps the span ring + correlated journal
+        # events + metrics snapshot on incident open (rate-limited).
+        self.flight = None
+        if self.out_dir is not None:
+            from ..obs import FlightRecorder
+
+            self.flight = FlightRecorder(
+                self.out_dir, config.obs, journal=self.journal
+            )
 
     # ------------------------------------------------------------------ run
     def run(self) -> StreamSummary:
+        from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
 
         ensure_catalog()
+        configure_tracer(self.config.obs)  # fresh span ring per run
         self._warm_start()
         sc = self.config.stream
         if self.journal is not None:
@@ -278,6 +301,14 @@ class StreamEngine:
 
     # -------------------------------------------------------- per window
     def _process(self, closed: ClosedWindow) -> None:
+        from ..obs.spans import get_tracer
+
+        tracer = get_tracer()
+        trace = _WindowTrace(
+            ctx=tracer.new_trace(f"win-{closed.start}"),
+            start_us=int(time.time() * 1e6),
+            perf0=time.monotonic(),
+        )
         self.summary.windows += 1
         result = WindowResult(
             start=closed.start, end=closed.end, anomaly=False
@@ -285,7 +316,7 @@ class StreamEngine:
         if closed.n_spans == 0:
             self._drain_all()
             result.skipped_reason = "empty_window"
-            self._finalize(result, "empty")
+            self._finalize(result, "empty", trace=trace)
             return
         if not self.baseline.ready:
             # Cold start: feed the baseline, don't detect yet.
@@ -293,11 +324,11 @@ class StreamEngine:
             self.baseline.update(closed.frame)
             result.n_traces = int(closed.frame["traceID"].nunique())
             result.skipped_reason = "baseline_warmup"
-            self._finalize(result, "warmup")
+            self._finalize(result, "warmup", trace=trace)
             return
         from ..detect import detect_partition
 
-        timings = StageTimings()
+        timings = StageTimings(ctx=trace.ctx)
         with timings.stage("detect"):
             vocab, slo = self.baseline.snapshot()
             flag, nrm, abn = detect_partition(
@@ -309,12 +340,14 @@ class StreamEngine:
         result.n_traces = len(nrm) + len(abn)
         if not flag:
             self._drain_all()
-            self._finalize(result, "clean", frame=closed.frame)
+            self._finalize(
+                result, "clean", frame=closed.frame, trace=trace
+            )
             return
         if not nrm or not abn:
             self._drain_all()
             result.skipped_reason = "degenerate_partition"
-            self._finalize(result, "skipped")
+            self._finalize(result, "skipped", trace=trace)
             return
         # Gate open: host build on the pool; rank on THIS thread when it
         # lands — consecutive abnormal windows overlap build(N+1) with
@@ -322,10 +355,13 @@ class StreamEngine:
         # observation order == window order.
         from ..rank_backends.jax_tpu import prepare_window_graph
 
-        fut = self.pool.submit(
-            prepare_window_graph, closed.frame, nrm, abn, self.config
-        )
-        self._pending.append(_PendingRank(closed, result, fut))
+        # attach: the pool captures the submitter's ambient context, so
+        # the off-thread build parent-links to THIS window's trace.
+        with tracer.attach(trace.ctx):
+            fut = self.pool.submit(
+                prepare_window_graph, closed.frame, nrm, abn, self.config
+            )
+        self._pending.append(_PendingRank(closed, result, fut, trace))
         while len(self._pending) >= max(
             1, self.config.stream.pipeline_windows
         ):
@@ -347,7 +383,7 @@ class StreamEngine:
                 "window %s: graph build failed: %s", head.result.start, e
             )
             head.result.skipped_reason = f"build_failed: {e}"
-            self._finalize(head.result, "skipped")
+            self._finalize(head.result, "skipped", trace=head.trace)
             return
         group = [(head, graph, op_names)]
         if not self.config.runtime.device_checks:
@@ -358,7 +394,10 @@ class StreamEngine:
             if self.config.runtime.device_checks and len(group) == 1:
                 # checkify programs have no batched twin: the checked
                 # path keeps the single-window dispatch.
-                self._dispatch_rank(head.result, graph, op_names, kernel)
+                self._dispatch_rank(
+                    head.result, graph, op_names, kernel,
+                    trace=head.trace,
+                )
             else:
                 self._dispatch_group(group, kernel)
         except Exception as e:  # noqa: BLE001 - same containment rule
@@ -368,10 +407,10 @@ class StreamEngine:
                 )
                 p.result.skipped_reason = f"rank_failed: {e}"
                 p.result.ranking = []
-                self._finalize(p.result, "skipped")
+                self._finalize(p.result, "skipped", trace=p.trace)
             return
         for p, _, _ in group:
-            self._finalize(p.result, "ranked")
+            self._finalize(p.result, "ranked", trace=p.trace)
 
     def _coalesce_burst(self, head_graph, kernel: str):
         """Abnormal-burst micro-batching: pending windows whose builds
@@ -401,8 +440,13 @@ class StreamEngine:
 
     def _dispatch_group(self, group, kernel: str) -> None:
         """One router dispatch for a coalesced same-bucket group; the
-        next pending window's staging double-buffers behind it."""
+        next pending window's staging double-buffers behind it. The
+        router's staging/dispatch/fetch spans attribute to the HEAD
+        window's trace (one dispatch serves the whole burst — the
+        coalesced members' traces show build-but-no-dispatch, which is
+        exactly what happened to them)."""
         from ..obs.metrics import record_stream_dispatch
+        from ..obs.spans import get_tracer
         from ..utils.guards import contract_checks
 
         rt = self.config.runtime
@@ -417,11 +461,15 @@ class StreamEngine:
                     next_batch = ([g2], k2)
                 except Exception:  # noqa: BLE001 - handled on its turn
                     pass
+        head_trace = group[0][0].trace
         t0 = time.monotonic()
-        with contract_checks(rt.validate_numerics):
-            outs, info = self.router.rank_batch(
-                graphs, kernel, conv_trace=conv, next_batch=next_batch
-            )
+        with get_tracer().attach(
+            head_trace.ctx if head_trace is not None else None
+        ):
+            with contract_checks(rt.validate_numerics):
+                outs, info = self.router.rank_batch(
+                    graphs, kernel, conv_trace=conv, next_batch=next_batch
+                )
         record_stream_dispatch()
         self.summary.dispatches += 1
         occs = self._warmed.setdefault(info.kernel, set())
@@ -462,31 +510,41 @@ class StreamEngine:
                     {"iterations": n_it, "final_residual": final}
                 )
 
-    def _dispatch_rank(self, result, graph, op_names, kernel) -> None:
+    def _dispatch_rank(
+        self, result, graph, op_names, kernel, trace=None
+    ) -> None:
         """Single-window checked dispatch (RuntimeConfig.device_checks
         — the checkify program has no batched/router twin)."""
         import jax
 
         from ..obs.metrics import record_stream_dispatch
+        from ..obs.spans import get_tracer
         from ..rank_backends.blob import stage_rank_window
         from ..utils.guards import contract_checks
 
+        tracer = get_tracer()
         rt = self.config.runtime
         # device_checks composes with the convergence trace since the
         # checkify program gained its residual-traced twin.
         conv = bool(rt.convergence_trace)
         t0 = time.monotonic()
-        with contract_checks(rt.validate_numerics):
-            out = stage_rank_window(
-                graph,
-                self.config.pagerank,
-                self.config.spectrum,
-                kernel,
-                rt.blob_staging,
-                checked=rt.device_checks,
-                conv_trace=conv,
-            )
-        out = jax.device_get(out)
+        with tracer.attach(trace.ctx if trace is not None else None):
+            with tracer.span(
+                "device_dispatch", service="stream", kernel=kernel,
+                checked=True,
+            ):
+                with contract_checks(rt.validate_numerics):
+                    out = stage_rank_window(
+                        graph,
+                        self.config.pagerank,
+                        self.config.spectrum,
+                        kernel,
+                        rt.blob_staging,
+                        checked=rt.device_checks,
+                        conv_trace=conv,
+                    )
+            with tracer.span("result_fetch", service="stream"):
+                out = jax.device_get(out)
         record_stream_dispatch()
         self.summary.dispatches += 1
         top_idx, top_scores, n_valid = out[:3]
@@ -519,17 +577,22 @@ class StreamEngine:
             )
 
     # ------------------------------------------------------ finalization
-    def _finalize(self, result, outcome: str, frame=None) -> None:
+    def _finalize(self, result, outcome: str, frame=None, trace=None) -> None:
         from ..obs.metrics import record_stream_window
+        from ..obs.spans import get_tracer
 
+        tracer = get_tracer()
+        ctx = trace.ctx if trace is not None else None
         record_stream_window(outcome)
         setattr(
             self.summary, outcome, getattr(self.summary, outcome) + 1
         )
+        opened_before = self.tracker.opened
         if outcome == "ranked":
-            inc = self.tracker.observe_ranked(
-                result.start, result.ranking
-            )
+            with tracer.span("incident", service="stream", ctx=ctx):
+                inc = self.tracker.observe_ranked(
+                    result.start, result.ranking
+                )
             if inc is not None:
                 self.summary.incidents_opened = self.tracker.opened
                 self.log.info(
@@ -537,8 +600,14 @@ class StreamEngine:
                     result.start, inc.incident_id, inc.windows,
                     result.ranking[0][0] if result.ranking else "-",
                 )
+            if self.tracker.opened > opened_before and self.flight:
+                # A NEW incident just opened: dump the causal record of
+                # how the pipeline got here while the ring still holds
+                # it (rate-limited inside the recorder).
+                self.flight.dump("incident")
         elif outcome != "warmup":
-            resolved = self.tracker.observe_healthy(result.start)
+            with tracer.span("incident", service="stream", ctx=ctx):
+                resolved = self.tracker.observe_healthy(result.start)
             self.summary.incidents_resolved = self.tracker.resolved
             for inc in resolved:
                 self.log.info(
@@ -558,6 +627,18 @@ class StreamEngine:
             self.sink.emit(result)
         if self.journal is not None:
             self.journal.window(result)
+        if trace is not None:
+            # The per-window ROOT span: children (detect/build/dispatch/
+            # fetch/incident) already parent-linked against its context;
+            # its lifetime spans processing start -> emission.
+            tracer.record_span(
+                "window",
+                ctx=trace.ctx,
+                start_us=trace.start_us,
+                dur_us=int((time.monotonic() - trace.perf0) * 1e6),
+                service="stream",
+                outcome=outcome,
+            )
 
 
 def run_stream(

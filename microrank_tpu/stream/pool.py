@@ -53,9 +53,19 @@ class BuildWorkerPool:
     ) -> Future:
         """Run ``fn(*args, **kwargs)`` on a worker; ``on_done(future)``
         (when given) fires on the worker thread after completion —
-        exceptions from ``fn`` live in the future, not the worker."""
-        from ..obs.metrics import record_build_pool
+        exceptions from ``fn`` live in the future, not the worker.
 
+        Trace propagation: the submitter's ambient span context is
+        captured HERE (contextvars are per-thread, so the worker would
+        otherwise start blank) and re-attached around the build — the
+        window/request trace keeps its causal chain across the pool
+        hop, which is exactly what the self-tracing layer exists to
+        show."""
+        from ..obs.metrics import record_build_pool
+        from ..obs.spans import get_tracer
+
+        tracer = get_tracer()
+        ctx = tracer.current_context()
         with self._lock:
             self._inflight += 1
             record_build_pool(inflight=self._inflight)
@@ -63,7 +73,8 @@ class BuildWorkerPool:
         def _run():
             t0 = time.monotonic()
             try:
-                return fn(*args, **kwargs)
+                with tracer.attach(ctx):
+                    return fn(*args, **kwargs)
             finally:
                 with self._lock:
                     self._inflight -= 1

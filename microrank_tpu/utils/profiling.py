@@ -20,26 +20,40 @@ from typing import Dict, Iterator, Optional
 
 
 class StageTimings:
-    """Accumulates named stage durations (seconds)."""
+    """Accumulates named stage durations (seconds).
 
-    def __init__(self) -> None:
+    ``ctx`` (an ``obs.spans.SpanContext``) pins every stage recorded
+    through this instance to ONE trace — the per-window/per-request
+    seam sets it once, and stages that complete later on other threads
+    (async fetch workers, bulk joins) still attribute to the right
+    trace instead of whatever window the ambient context points at by
+    then.
+    """
+
+    def __init__(self, ctx=None) -> None:
         self._acc: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
+        self.ctx = ctx
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self._acc[name] += dt
-            self._counts[name] += 1
-            # Mirror into the registry histogram (a locked list update;
-            # ~1 us — noise next to any stage worth timing).
-            from ..obs.metrics import stage_seconds
+        from ..obs.spans import get_tracer
 
-            stage_seconds().observe(dt, stage=name)
+        t0 = time.perf_counter()
+        # The span wraps the same region the timer measures — one
+        # choke-point seam, two outputs (histogram + span ring).
+        with get_tracer().span(name, ctx=self.ctx):
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                self._acc[name] += dt
+                self._counts[name] += 1
+                # Mirror into the registry histogram (a locked list
+                # update; ~1 us — noise next to any stage worth timing).
+                from ..obs.metrics import stage_seconds
+
+                stage_seconds().observe(dt, stage=name)
 
     def as_dict(self) -> Dict[str, float]:
         return {k: round(v, 6) for k, v in self._acc.items()}

@@ -1,0 +1,18 @@
+"""R7 positive: a traced value used as a metric LABEL (keyword) via a
+``record_*`` helper inside a jit region — labels are strings on the
+host; the helper str()s the tracer."""
+
+import jax
+
+
+def record_window_outcome(outcome):
+    return str(outcome)
+
+
+def detect_step(flags):
+    n_abnormal = flags.sum()
+    record_window_outcome(outcome=n_abnormal)
+    return flags
+
+
+detect_jit = jax.jit(detect_step)

@@ -1,0 +1,22 @@
+"""R7 positive: a traced array observed into a histogram inside a jit
+region — the sink float()s it, a host sync laundered through the
+telemetry layer."""
+
+import jax
+
+
+def _residual_hist():
+    class _H:
+        def observe(self, v, **labels):
+            return float(v)
+
+    return _H()
+
+
+def rank_step(x):
+    residual = x.sum()
+    _residual_hist().observe(residual, stage="rank")
+    return x * 2
+
+
+rank_step_jit = jax.jit(rank_step)

@@ -1,0 +1,21 @@
+"""R7 positive: a traced value as a span attribute inside a jit region
+— span attrs are host values the flight recorder json-serializes."""
+
+import jax
+
+
+class _Tracer:
+    def span(self, name, **attrs):
+        return name, attrs
+
+
+_TRACER = _Tracer()
+
+
+def dispatch_step(x):
+    weight = x.mean()
+    _TRACER.span("device_dispatch", weight=weight)
+    return x * weight
+
+
+dispatch_jit = jax.jit(dispatch_step)

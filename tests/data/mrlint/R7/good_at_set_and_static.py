@@ -1,0 +1,23 @@
+"""R7 negatives inside a jit region: the jax ``x.at[i].set(v)``
+indexed-update idiom shares the ``set`` method name but is not a
+telemetry sink (even with a traced operand), and static (shape-derived)
+telemetry values never carry taint."""
+
+import jax
+
+
+class _Gauge:
+    def set(self, v, **labels):
+        return float(v)
+
+
+_GAUGE = _Gauge()
+
+
+def kernel(x, i):
+    y = x.at[i].set(x[0] * 2.0)   # indexed update, not a sink
+    _GAUGE.set(x.shape[0], axis="traces")  # static shape: no taint
+    return y
+
+
+kernel_jit = jax.jit(kernel)

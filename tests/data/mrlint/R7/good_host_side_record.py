@@ -1,0 +1,25 @@
+"""R7 negative: telemetry recorded on the HOST side, after the fetch —
+the value entering the sink is a fetched numpy scalar, outside any
+traced call graph."""
+
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x):
+    return jnp.sum(x * 2)
+
+
+kernel_jit = jax.jit(kernel)
+
+
+class _Hist:
+    def observe(self, v, **labels):
+        return float(v)
+
+
+def rank_and_record(host_array):
+    out = kernel_jit(host_array)
+    fetched = jax.device_get(out)
+    _Hist().observe(float(fetched), stage="rank")
+    return fetched

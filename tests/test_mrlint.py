@@ -29,7 +29,7 @@ def _rules_fired(path: Path):
 
 
 def test_rule_catalog_complete():
-    assert set(RULES) == {"R1", "R2", "R3", "R4", "R5", "R6"}
+    assert set(RULES) == {"R1", "R2", "R3", "R4", "R5", "R6", "R7"}
     for rule in RULES.values():
         assert rule.slug and rule.summary
 

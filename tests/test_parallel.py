@@ -378,3 +378,99 @@ def test_table_rca_batched_on_2d_mesh(tmp_path):
     # Per-window dispatch on a windows-axis>1 mesh still fails clearly.
     with pytest.raises(ValueError, match="batch_windows"):
         meshed.run(timeline)
+
+
+def test_sharded_checked_matches_unchecked(window_batch):
+    """device_checks on the mesh path (PR 7): the checkify epilogue
+    returns the untouched sharded outputs, and a poisoned batch raises
+    JaxRuntimeError naming the failed check."""
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+
+    from microrank_tpu.parallel import (
+        rank_windows_sharded_checked,
+        rank_windows_sharded_checked_traced,
+    )
+    from microrank_tpu.parallel.sharded_rank import (
+        _sharded_checked_traced_jit,
+        rank_windows_sharded,
+        rank_windows_sharded_traced,
+        stage_sharded,
+    )
+
+    graphs, _ = window_batch
+    cfg = MicroRankConfig()
+    mesh = make_mesh((2, 4))
+    batched = stage_sharded(graphs, mesh, "coo")
+    for checked_fn, plain_fn in (
+        (rank_windows_sharded_checked, rank_windows_sharded),
+        (rank_windows_sharded_checked_traced, rank_windows_sharded_traced),
+    ):
+        outs_c = checked_fn(
+            batched, cfg.pagerank, cfg.spectrum, mesh, "coo"
+        )
+        outs_p = plain_fn(
+            batched, cfg.pagerank, cfg.spectrum, mesh, "coo"
+        )
+        for a, b in zip(outs_c, outs_p):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    # Poisoned scores trip the device-side check.
+    outs = rank_windows_sharded_traced(
+        batched, cfg.pagerank, cfg.spectrum, mesh, "coo"
+    )
+    bad = (
+        outs[0],
+        jnp.full_like(jnp.asarray(outs[1]), jnp.nan),
+        outs[2], outs[3], outs[4],
+    )
+    err, _ = _sharded_checked_traced_jit()(*bad)
+    with pytest.raises(checkify.JaxRuntimeError, match="non-finite"):
+        checkify.check_error(err)
+
+
+def test_table_rca_sharded_device_checks_keeps_convergence(tmp_path):
+    """Mirror of test_convergence_trace_survives_device_checks (PR 6)
+    for the SHARDED path: device_checks + convergence_trace on a mesh
+    ranks through rank_windows_sharded_checked_traced — telemetry must
+    flow, not silently drop, and the ranking must match the unchecked
+    mesh run."""
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.config import RuntimeConfig
+    from microrank_tpu.pipeline import TableRCA
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_traces=120, seed=5,
+                        n_kinds=24, child_keep_prob=0.6)
+    )
+    case.normal.to_csv(tmp_path / "n.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "a.csv", index=False)
+    normal = native.load_span_table(tmp_path / "n.csv")
+    abnormal = native.load_span_table(tmp_path / "a.csv")
+
+    plain = TableRCA(
+        MicroRankConfig(runtime=RuntimeConfig(mesh_shape=(8,)))
+    )
+    plain.fit_baseline(normal)
+    r_plain = plain.run(abnormal)
+    a = next(r for r in r_plain if r.ranking)
+
+    checked = TableRCA(
+        MicroRankConfig(
+            runtime=RuntimeConfig(
+                mesh_shape=(8,),
+                device_checks=True,
+                convergence_trace=True,
+            )
+        )
+    )
+    checked.fit_baseline(normal)
+    r_checked = checked.run(abnormal)
+    ranked = [r for r in r_checked if r.ranking]
+    assert ranked, "no window ranked — fixture drifted"
+    b = ranked[0]
+    assert [n for n, _ in a.ranking] == [n for n, _ in b.ranking]
+    for r in ranked:
+        assert r.rank_iterations is not None
+        assert r.rank_residual is not None

@@ -1,0 +1,487 @@
+"""Self-tracing pipeline (obs.spans / obs.flight / obs.profiler):
+tracer semantics, trace-context propagation across the scheduler
+thread, the build worker pool and the stream engine thread, the flight
+recorder's dump triggers and formats, journal fsync durability, the
+/profilez endpoint — and the DOGFOOD acceptance: with one pipeline
+stage artificially slowed (injected sleep in the build pool), ``cli
+run`` over the flight dump ranks that stage top-1.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from microrank_tpu.config import (
+    MicroRankConfig,
+    ObsConfig,
+    RuntimeConfig,
+    ServeConfig,
+    StreamConfig,
+)
+from microrank_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    SpanTracer,
+    configure_tracer,
+    get_registry,
+    get_tracer,
+    set_registry,
+    set_tracer,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture
+def tracer_reset():
+    """Engines install fresh process tracers; restore the default
+    (disabled) one afterwards so tests stay isolated."""
+    yield
+    set_tracer(None)
+
+
+def _stream_cfg(**obs_kw):
+    return MicroRankConfig(
+        stream=StreamConfig(
+            window_minutes=5.0,
+            allowed_lateness_seconds=5.0,
+            pipeline_windows=3,
+            build_workers=2,
+        ),
+        runtime=RuntimeConfig(prefer_bf16=False),
+        obs=ObsConfig(flight_min_interval_seconds=0.0, **obs_kw),
+    )
+
+
+def _stream_source():
+    from microrank_tpu.stream import SyntheticSource
+
+    return SyntheticSource(
+        8,
+        [3, 4, 5],
+        synth_config=SyntheticConfig(
+            n_operations=20, n_kinds=16, n_traces=150, seed=5,
+            window_minutes=5.0,
+        ),
+    )
+
+
+# ----------------------------------------------------------------- tracer
+
+
+def test_tracer_nesting_and_parent_links(tracer_reset):
+    tr = SpanTracer(enabled=True)
+    ctx = tr.new_trace("win-1")
+    with tr.attach(ctx):
+        with tr.span("detect") as detect_ctx:
+            with tr.span("inner"):
+                pass
+    spans = {s.name: s for s in tr.snapshot()}
+    assert set(spans) == {"detect", "inner"}
+    assert spans["detect"].trace_id == "win-1"
+    assert spans["detect"].parent_id == ctx.span_id
+    assert spans["inner"].parent_id == detect_ctx.span_id
+    assert spans["inner"].trace_id == "win-1"
+    # context restored after the blocks
+    assert tr.current_context() is ctx or tr.current_context() is None
+
+
+def test_tracer_ring_bounded_and_counts_drops(tracer_reset):
+    tr = SpanTracer(enabled=True, capacity=16)
+    for i in range(50):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr) == 16
+    assert tr.recorded == 50
+    assert tr.dropped == 34
+    # Oldest fell off: the ring holds the newest 16.
+    assert [s.name for s in tr.snapshot()][0] == "s34"
+
+
+def test_tracer_disabled_records_nothing(tracer_reset):
+    tr = SpanTracer(enabled=False)
+    with tr.span("detect"):
+        pass
+    tr.record_span(
+        "window", ctx=tr.new_trace("t"), start_us=0, dur_us=1
+    )
+    assert len(tr) == 0
+
+
+def test_tracer_injected_sleep_lands_in_span_duration(tracer_reset):
+    tr = SpanTracer(
+        enabled=True, inject_stage="build", inject_sleep_ms=50.0,
+        inject_every=2,
+    )
+    for _ in range(4):
+        with tr.span("build"):
+            pass
+    with tr.span("detect"):
+        pass
+    builds = [s for s in tr.snapshot() if s.name == "build"]
+    slow = [s for s in builds if s.dur_us >= 45_000]
+    fast = [s for s in builds if s.dur_us < 45_000]
+    assert len(slow) == 2 and len(fast) == 2  # every 2nd injected
+    detect = [s for s in tr.snapshot() if s.name == "detect"]
+    assert detect[0].dur_us < 45_000  # only the named stage sleeps
+
+
+def test_stage_timings_emit_spans_under_pinned_ctx(
+    registry, tracer_reset
+):
+    from microrank_tpu.utils.profiling import StageTimings
+
+    tr = configure_tracer(ObsConfig())
+    ctx = tr.new_trace("win-7")
+    timings = StageTimings(ctx=ctx)
+
+    def off_thread():
+        with timings.stage("rank_wait"):
+            pass
+
+    t = threading.Thread(target=off_thread)
+    t.start()
+    t.join()
+    spans = tr.snapshot()
+    assert [s.name for s in spans] == ["rank_wait"]
+    # The pinned ctx wins even though the recording thread had no
+    # ambient context — late async stages attribute correctly.
+    assert spans[0].trace_id == "win-7"
+    assert spans[0].parent_id == ctx.span_id
+    assert timings.as_dict()["rank_wait"] >= 0.0
+
+
+# ---------------------------------------------------- flight record formats
+
+
+def test_flight_dump_formats_and_rate_limit(
+    registry, tracer_reset, tmp_path
+):
+    cfg = ObsConfig(flight_min_interval_seconds=60.0)
+    tr = configure_tracer(cfg)
+    ctx = tr.new_trace("win-1")
+    with tr.attach(ctx):
+        with tr.span("detect", service="stream"):
+            with tr.span("build", service="pipeline"):
+                pass
+    fr = FlightRecorder(tmp_path, cfg)
+    d = fr.dump("incident")
+    assert d is not None and d.parent.name == "flight"
+    # Perfetto/Chrome form: X events + thread_name metadata.
+    trace = json.loads((d / "trace.json").read_text())
+    phs = {e["ph"] for e in trace["traceEvents"]}
+    assert phs == {"X", "M"}
+    named = {
+        e["name"] for e in trace["traceEvents"] if e["ph"] == "X"
+    }
+    assert named == {"detect", "build"}
+    # MicroRank's own schema: loadable by the pipeline's own ingest.
+    from microrank_tpu.io import load_traces_csv
+
+    df = load_traces_csv(d / "spans.csv")
+    assert len(df) == 2
+    assert set(df["traceID"]) == {"win-1"}
+    assert set(df["operationName"]) == {"detect", "build"}
+    # Parent links survive the CSV round trip.
+    by_op = df.set_index("operationName")
+    assert by_op.loc["build", "ParentSpanId"] in set(df["spanID"])
+    man = json.loads((d / "manifest.json").read_text())
+    assert man["spans"] == 2 and man["traces"] == 1
+    assert (d / "metrics.json").exists() and (d / "metrics.prom").exists()
+    # Rate limit: a second dump within the interval is suppressed.
+    assert fr.dump("incident") is None
+    from microrank_tpu.obs.metrics import flight_dumps
+
+    assert flight_dumps().value(reason="incident") == 1
+    assert flight_dumps().value(reason="suppressed") == 1
+
+
+def test_journal_run_end_and_flight_dump_fsync(
+    registry, tracer_reset, tmp_path, monkeypatch
+):
+    """Durability satellite: run_end and every flight dump flush+fsync
+    the journal, so a crash never truncates the last incident's
+    events."""
+    import microrank_tpu.obs.journal as journal_mod
+    from microrank_tpu.obs.journal import RunJournal
+
+    synced = []
+    real_fsync = journal_mod.os.fsync
+    monkeypatch.setattr(
+        journal_mod.os, "fsync",
+        lambda fd: (synced.append(fd), real_fsync(fd)),
+    )
+    j = RunJournal(tmp_path / "journal.jsonl")
+    j.emit("window", start="w0")
+    assert synced == []          # plain emits stay cheap
+    j.run_end(windows=1)
+    assert len(synced) == 1      # run_end fsyncs
+    cfg = ObsConfig(flight_min_interval_seconds=0.0)
+    tr = configure_tracer(cfg)
+    with tr.span("detect"):
+        pass
+    fr = FlightRecorder(tmp_path, cfg, journal=j)
+    d = fr.dump("incident")
+    assert len(synced) == 2      # the dump fsyncs before correlating
+    events = (d / "events.jsonl").read_text().splitlines()
+    assert any('"window"' in e for e in events)
+
+
+# ------------------------------------------------- propagation: stream
+
+
+def test_stream_engine_propagates_trace_across_threads(
+    registry, tracer_reset, tmp_path
+):
+    """Satellite: trace context flows engine thread -> build worker
+    pool -> dispatch; the flight dump on incident open exists."""
+    from microrank_tpu.stream import StreamEngine
+
+    cfg = _stream_cfg()
+    engine = StreamEngine(cfg, _stream_source(), out_dir=tmp_path)
+    s = engine.run()
+    assert s.ranked >= 2 and s.incidents_opened == 1
+    tr = get_tracer()
+    spans = tr.snapshot()
+    by_name = {}
+    for sp in spans:
+        by_name.setdefault(sp.name, []).append(sp)
+    # Builds ran on pool workers, under their windows' traces.
+    builds = by_name["build"]
+    assert all(b.trace_id.startswith("win-") for b in builds)
+    assert any("build" in b.thread for b in builds), (
+        "no build recorded on a pool worker thread"
+    )
+    # The dispatch spans share a ranked window's trace (the burst
+    # head), and parent-link transitively to that window's root.
+    roots = {
+        sp.trace_id: sp.span_id for sp in by_name["window"]
+    }
+    disp = by_name["device_dispatch"]
+    assert disp and all(d.trace_id in roots for d in disp)
+    ids = {sp.span_id: sp for sp in spans}
+    for d in disp:
+        hop, seen = d, set()
+        while hop.parent_id in ids and hop.span_id not in seen:
+            seen.add(hop.span_id)
+            hop = ids[hop.parent_id]
+        # The chain must terminate AT the window's root span.
+        assert hop.span_id == roots[d.trace_id]
+    # Incident lifecycle spans exist for ranked AND healthy windows.
+    assert len(by_name["incident"]) >= 4
+    # Flight dump triggered by the incident opening.
+    dumps = list((tmp_path / "flight").iterdir())
+    assert len(dumps) == 1 and "incident" in dumps[0].name
+
+
+# -------------------------------------------------- propagation: serve
+
+
+def _serve_service(case, tmp_path=None, **serve_kw):
+    from microrank_tpu.serve import ServeService
+
+    serve_kw.setdefault("warmup", False)
+    serve_kw.setdefault("max_wait_ms", 200.0)
+    cfg = MicroRankConfig(
+        serve=ServeConfig(**serve_kw),
+        obs=ObsConfig(flight_min_interval_seconds=0.0),
+        runtime=RuntimeConfig(prefer_bf16=False),
+    )
+    svc = ServeService(cfg, out_dir=tmp_path)
+    svc.fit_baseline(case.normal)
+    return svc
+
+
+def test_serve_scheduler_and_pool_propagate_request_trace(
+    registry, tracer_reset,
+):
+    """Satellite: the request trace (trace_id = request_id) crosses the
+    scheduler thread AND the serve build pool."""
+    from microrank_tpu.serve import RankRequest
+
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    svc = _serve_service(case, build_workers=2)
+    svc.add_dataset("case", case.abnormal)
+    svc.start()
+    try:
+        fut = svc.submit(
+            RankRequest(request_id="req-traced", dataset="case")
+        )
+        result = fut.result(timeout=120)
+        assert result.ranking
+    finally:
+        svc.shutdown()
+    spans = [
+        s for s in get_tracer().snapshot()
+        if s.trace_id == "req-traced"
+    ]
+    names = {s.name for s in spans}
+    assert {"parse", "detect", "build", "request"} <= names
+    assert "device_dispatch" in names  # batch head == this request
+    build = next(s for s in spans if s.name == "build")
+    assert "serve-build" in build.thread  # built on the pool, not the
+    # scheduler thread — the context crossed both hops
+    root = next(s for s in spans if s.name == "request")
+    parse = next(s for s in spans if s.name == "parse")
+    assert parse.parent_id == root.span_id
+
+
+def test_flight_dump_on_injected_degraded_dispatch(
+    registry, tracer_reset, tmp_path
+):
+    """Satellite: ServeConfig.inject_dispatch_failures drives the
+    degradation path; the flight recorder dumps on it."""
+    from microrank_tpu.serve import RankRequest
+
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    svc = _serve_service(
+        case, tmp_path=tmp_path, inject_dispatch_failures=2,
+        fallback=True, build_workers=0,
+    )
+    svc.add_dataset("case", case.abnormal)
+    svc.start()
+    try:
+        fut = svc.submit(RankRequest(request_id="r1", dataset="case"))
+        result = fut.result(timeout=120)
+        assert result.degraded and result.ranking
+    finally:
+        svc.shutdown()
+    dumps = sorted((tmp_path / "flight").iterdir())
+    reasons = {d.name.rsplit("-", 1)[-1] for d in dumps}
+    assert "degraded" in reasons
+    degraded = next(d for d in dumps if d.name.endswith("degraded"))
+    assert (degraded / "spans.csv").exists()
+    assert (degraded / "trace.json").exists()
+    # SIGTERM-drain dump also fires at shutdown (same recorder).
+    assert "sigterm" in reasons
+
+
+# ----------------------------------------------------------- /profilez
+
+
+def test_profilez_endpoint_captures_session(registry, tmp_path):
+    from microrank_tpu.obs.server import start_metrics_server
+
+    server = start_metrics_server(0, profile_dir=tmp_path / "profiles")
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/profilez?seconds=0.1",
+            timeout=60,
+        ) as r:
+            body = json.loads(r.read())
+        assert body["seconds"] == 0.1
+        session = Path(body["session"])
+        assert session.exists()
+        assert list(session.rglob("*")), "empty profiler session"
+    finally:
+        server.close()
+    from microrank_tpu.obs.metrics import profile_sessions
+
+    assert profile_sessions().value(trigger="endpoint") == 1
+
+
+# ------------------------------------------------------------- dogfood
+
+
+def _flight_spans_csv(tmp_path, tag, inject_ms):
+    from microrank_tpu.stream import StreamEngine
+
+    out = tmp_path / tag
+    cfg = _stream_cfg(
+        inject_stage="build", inject_stage_sleep_ms=inject_ms
+    )
+    engine = StreamEngine(cfg, _stream_source(), out_dir=out)
+    s = engine.run()
+    assert s.ranked >= 2, "fixture drifted: no ranked windows"
+    dump = engine.flight.dump("run_end")  # complete-ring dump
+    return dump / "spans.csv"
+
+
+def test_dogfood_flight_selfrank_blames_slowed_stage(
+    registry, tracer_reset, tmp_path
+):
+    """THE acceptance test: slow the build pool by an injected sleep,
+    flight-dump both a healthy and the degraded run, and run the full
+    MicroRank CLI over the two dumps — the pipeline must rank its own
+    slowed stage top-1, with tie-aware scoring."""
+    normal_csv = _flight_spans_csv(tmp_path, "healthy", 0.0)
+    abnormal_csv = _flight_spans_csv(tmp_path, "slowed", 250.0)
+
+    from microrank_tpu.cli.main import main
+
+    out = tmp_path / "selfrank"
+    rc = main(
+        [
+            "run",
+            "--normal", str(normal_csv),
+            "--abnormal", str(abnormal_csv),
+            "-o", str(out),
+            "--engine", "pandas",
+        ]
+    )
+    assert rc == 0
+    windows = [
+        json.loads(line)
+        for line in (out / "windows.jsonl").read_text().splitlines()
+    ]
+    ranked = [w for w in windows if w["ranking"]]
+    assert ranked, "self-rank produced no ranked window"
+    ranking = ranked[-1]["ranking"]
+    # Tie-aware top-1: the group tied with the best score must be
+    # exactly the slowed stage (pod-level name <service>_<stage>).
+    top_score = ranking[0][1]
+    tied = {
+        name
+        for name, score in ranking
+        if score >= top_score - 1e-6 * max(abs(top_score), 1e-12)
+    }
+    assert tied == {"pipeline_build"}, ranking[:5]
+
+
+# ---------------------------------------------------------- table lane
+
+
+def test_table_lane_windows_carry_trace_ids(
+    registry, tracer_reset, tmp_path
+):
+    """Offline runs trace identically: each window's stages share one
+    win-<start> trace (the StageTimings ctx pin)."""
+    native = pytest.importorskip("microrank_tpu.native")
+    if not native.native_available():
+        pytest.skip("native loader unavailable")
+    from microrank_tpu.pipeline.table_runner import TableRCA
+
+    case = generate_case(
+        SyntheticConfig(n_operations=20, n_kinds=6, n_traces=80, seed=7)
+    )
+    case.normal.to_csv(tmp_path / "n.csv", index=False)
+    case.abnormal.to_csv(tmp_path / "a.csv", index=False)
+    rca = TableRCA(
+        MicroRankConfig(runtime=RuntimeConfig(prefer_bf16=False))
+    )
+    rca.fit_baseline(native.load_span_table(tmp_path / "n.csv"))
+    results = rca.run(native.load_span_table(tmp_path / "a.csv"))
+    assert any(r.ranking for r in results)
+    spans = get_tracer().snapshot()
+    win_traces = {
+        s.trace_id for s in spans if s.trace_id.startswith("win-")
+    }
+    assert win_traces, "table lane recorded no window traces"
+    names = {s.name for s in spans}
+    assert "detect" in names and "rank_dispatch" in names
