@@ -1257,6 +1257,58 @@ def main() -> int:
         build_times.append(time.perf_counter() - t0)
     build_s = float(np.median(build_times))
 
+    # --- explain overhead (rank provenance, ISSUE 8) --------------------
+    # The headline above runs the PLAIN programs (explain off — the
+    # default costs nothing by construction); this measures what the
+    # explained twin costs when asked: the same window through
+    # stage_rank_window with conv_trace vs with the explain epilogue
+    # (attribution tensors riding the fetch). BENCH_EXPLAIN_OVERHEAD=0
+    # skips.
+    explain_overhead = None
+    if os.environ.get("BENCH_EXPLAIN_OVERHEAD", "1") != "0":
+        try:
+            from microrank_tpu.config import ExplainConfig
+            from microrank_tpu.rank_backends.blob import stage_rank_window
+            from microrank_tpu.rank_backends.jax_tpu import device_subset
+
+            ex_cfg = ExplainConfig(enabled=True)
+            g_sub = device_subset(graph, kernel)
+
+            def run_explained():
+                return jax.device_get(
+                    stage_rank_window(
+                        g_sub, cfg.pagerank, cfg.spectrum, kernel,
+                        _use_blob(), explain=ex_cfg,
+                    )
+                )
+
+            def run_plain():
+                return jax.device_get(
+                    stage_rank_window(
+                        g_sub, cfg.pagerank, cfg.spectrum, kernel,
+                        _use_blob(), conv_trace=True,
+                    )
+                )
+
+            run_explained()
+            run_plain()  # both compiled before timing
+            n_rep = max(3, min(repeats, 5))
+            ms_on = _time_median(run_explained, n_rep) * 1e3
+            ms_off = _time_median(run_plain, n_rep) * 1e3
+            explain_overhead = {
+                "ms_explained": round(ms_on, 1),
+                "ms_plain": round(ms_off, 1),
+                "overhead_pct": round((ms_on / ms_off - 1.0) * 100.0, 2),
+                "kernel": kernel,
+            }
+            log(
+                f"explain overhead: explained {ms_on:.0f}ms vs plain "
+                f"{ms_off:.0f}ms per window "
+                f"({explain_overhead['overhead_pct']:+.1f}%)"
+            )
+        except Exception as exc:  # diagnostics must not eat the metric
+            log(f"explain overhead measurement failed ({exc!r}); continuing")
+
     # --- device-time isolation + utilization (VERDICT r2 #1) -----------
     # Differencing loop trip counts cancels the RPC floor; analytic
     # per-iteration traffic turns the slope into HBM/MXU utilization.
@@ -1431,6 +1483,11 @@ def main() -> int:
                 "full_oracle_s": round(full_oracle_s, 2),
             }
             if full_parity is not None
+            else {}
+        ),
+        **(
+            {"explain_overhead": explain_overhead}
+            if explain_overhead
             else {}
         ),
         **({"device": device_profile} if device_profile else {}),

@@ -96,6 +96,18 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "--inject-stage", default=None,
         help='stage name --inject-stage-sleep-ms slows (default "build")',
     )
+    p.add_argument(
+        "--explain", action="store_true",
+        help="arm the rank-provenance subsystem (explain/): stream "
+        "builds an explain bundle automatically when an incident "
+        "opens (written next to the flight dump, served at "
+        "/explainz); off by default — the hot path pays nothing",
+    )
+    p.add_argument(
+        "--explain-top-traces", type=_positive_int, default=None,
+        help="contributing coverage columns (traces) kept per suspect "
+        "in explain bundles (default 5)",
+    )
     p.add_argument("--config-json", help="load a full MicroRankConfig dict")
 
 
@@ -124,6 +136,7 @@ def _config_from_args(args) -> "MicroRankConfig":
         CompatConfig,
         DetectorConfig,
         DispatchConfig,
+        ExplainConfig,
         MicroRankConfig,
         ObsConfig,
         PageRankConfig,
@@ -156,6 +169,16 @@ def _config_from_args(args) -> "MicroRankConfig":
         }.items()
         if v is not None
     }
+    explain_overrides = {
+        k: v
+        for k, v in {
+            "enabled": (
+                True if getattr(args, "explain", False) else None
+            ),
+            "top_traces": getattr(args, "explain_top_traces", None),
+        }.items()
+        if v is not None
+    }
     dispatch_overrides = {
         k: v
         for k, v in {
@@ -170,6 +193,7 @@ def _config_from_args(args) -> "MicroRankConfig":
     }
     cfg = MicroRankConfig(
         obs=ObsConfig(**obs_overrides),
+        explain=ExplainConfig(**explain_overrides),
         dispatch=DispatchConfig(**dispatch_overrides),
         detector=DetectorConfig(
             k_sigma=args.k_sigma,
@@ -743,6 +767,94 @@ def cmd_stream(args) -> int:
     return 0
 
 
+def _find_bundles(target: Path):
+    """Resolve an explain target (bundle .json, run output dir, flight
+    dump dir, or journal.jsonl) to a list of bundle dicts, searching:
+    the file itself -> explain_bundle.json -> explain/*/ bundle dirs ->
+    journal/events ``explain`` records (compact journal mirrors)."""
+    from ..explain.bundle import BUNDLE_JSON, ExplainBundle
+
+    bundles = []
+    if target.is_file():
+        if target.name.endswith(".jsonl"):
+            from ..obs import read_journal
+
+            for e in read_journal(target):
+                if e.get("event") == "explain":
+                    bpath = e.get("bundle")
+                    if bpath and Path(bpath).exists():
+                        bundles.append(
+                            ExplainBundle.load(bpath).data
+                        )
+                    else:
+                        bundles.append({"journal_record": e})
+            return bundles
+        return [ExplainBundle.load(target).data]
+    if (target / BUNDLE_JSON).exists():
+        return [ExplainBundle.load(target / BUNDLE_JSON).data]
+    exp_dir = target / "explain"
+    if exp_dir.is_dir():
+        for sub in sorted(exp_dir.iterdir()):
+            if (sub / BUNDLE_JSON).exists():
+                bundles.append(ExplainBundle.load(sub / BUNDLE_JSON).data)
+        if bundles:
+            return bundles
+    for journal_name in ("journal.jsonl", "events.jsonl"):
+        if (target / journal_name).exists():
+            bundles.extend(_find_bundles(target / journal_name))
+            if bundles:
+                return bundles
+    return bundles
+
+
+def cmd_explain(args) -> int:
+    """Render rank provenance from run artifacts: explain bundles
+    written by the stream engine (incident opens), journal ``explain``
+    events, or a flight dump's cross-linked bundle — the offline twin
+    of ``GET /explainz``."""
+    from ..explain.bundle import ExplainBundle
+
+    target = Path(args.target)
+    if not target.exists():
+        print(f"no such explain target: {target}", file=sys.stderr)
+        return 2
+    bundles = _find_bundles(target)
+    if not bundles:
+        print(
+            f"no explain bundles under {target} (run with --explain, "
+            "or ask serve for explain:true)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.window is not None:
+        bundles = [
+            b
+            for b in bundles
+            if str(
+                (b.get("window") or {}).get("start")
+                or (b.get("journal_record") or {}).get("start")
+            )
+            == str(args.window)
+        ]
+        if not bundles:
+            print(
+                f"no bundle for window {args.window!r}", file=sys.stderr
+            )
+            return 2
+    data = bundles[-1]
+    if "journal_record" in data:
+        # Compact journal mirror only (bundle file gone): show it raw.
+        print(json.dumps(data["journal_record"], indent=2))
+        return 0
+    if args.json:
+        Path(args.json).write_text(json.dumps(data, indent=2))
+    if args.format == "json":
+        print(json.dumps(data, indent=2))
+    else:
+        print(ExplainBundle(data).to_table(), end="")
+    return 0
+
+
 def cmd_synth(args) -> int:
     from ..testing import SyntheticConfig, generate_case
 
@@ -1201,6 +1313,32 @@ def main(argv=None) -> int:
     )
     _add_config_flags(p_stream)
     p_stream.set_defaults(fn=cmd_stream)
+
+    p_exp = sub.add_parser(
+        "explain",
+        help="render rank provenance from run artifacts (explain "
+        "bundles, journal explain events, flight-dump bundles)",
+    )
+    p_exp.add_argument(
+        "target",
+        help="an explain bundle .json, a run output dir (reads "
+        "explain/*/ bundles or journal.jsonl), a flight dump dir "
+        "(reads its cross-linked bundle), or a journal.jsonl path",
+    )
+    p_exp.add_argument(
+        "--window", default=None,
+        help="select the bundle for this window start (default: the "
+        "latest bundle found)",
+    )
+    p_exp.add_argument(
+        "--format", choices=["table", "json"], default="table",
+        help="human-readable table (default) or the raw bundle JSON",
+    )
+    p_exp.add_argument(
+        "--json", default=None,
+        help="also write the selected bundle JSON to this path",
+    )
+    p_exp.set_defaults(fn=cmd_explain)
 
     p_synth = sub.add_parser("synth", help="generate a synthetic chaos case")
     p_synth.add_argument("-o", "--output", required=True)

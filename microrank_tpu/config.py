@@ -375,6 +375,47 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ExplainConfig:
+    """Rank provenance / explainability knobs (``explain/`` subsystem).
+
+    Every ranked score decomposes into the four spectrum counters
+    (ef/nf/ep/np), the per-formula term values, the normal-vs-abnormal
+    PPR mass split, and the coverage columns (traces) that fed the
+    suspect's PageRank mass. The explain twins of the rank programs
+    carry those attribution tensors out of the jitted program in the
+    SAME result fetch (mirroring the convergence traces), and the host
+    materializes them as an ``ExplainBundle`` (JSON + human table).
+
+    Off by default: with ``enabled=False`` the normal rank programs
+    dispatch unchanged and the hot path pays nothing (bench.py's
+    ``explain_overhead`` artifact field pins the on-cost; the spans-off
+    headline is measured explain-off).
+    """
+
+    # Master switch: arm the explain twins on the pipelines (stream
+    # builds bundles on incident open; serve honors explain:true
+    # requests even when this is off — the request flag is the opt-in).
+    enabled: bool = False
+    # J: contributing coverage columns (traces) kept per suspect, per
+    # partition — recovered on device from the kernel's own coverage
+    # representation (bitmap rows / COO entries / CSR rows / ELL slab).
+    top_traces: int = 5
+    # Suspects explained per window: 0 = every returned rank row
+    # (spectrum top_max + extra_rows), else min(this, rank rows).
+    top_suspects: int = 0
+    # Stream engine: build + persist a bundle automatically when a NEW
+    # incident opens (written next to the flight dump and cross-linked
+    # in its manifest; the incident_open event carries the path).
+    on_incident: bool = True
+    # Recent bundles kept in the in-process store the obs server's
+    # ``GET /explainz?window=...`` endpoint serves from.
+    store_windows: int = 32
+    # Mirror a compact explain record into the run journal (the CI
+    # smoke cross-checks bundle top-1/ef against it).
+    journal: bool = True
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online RCA service knobs (``cli serve`` — serve/ subsystem).
 
@@ -477,8 +518,12 @@ class StreamConfig:
     build_workers: int = 2
     pipeline_windows: int = 2
     # Optional incident webhook: every lifecycle transition POSTs its
-    # JSON event here (best-effort, 2 s timeout, failures counted).
+    # JSON event here (best-effort, failures counted). The POST is
+    # bounded by an EXPLICIT timeout — the sink runs on the engine
+    # thread, so a hung endpoint must never stall windowing/ranking
+    # longer than this.
     webhook_url: Optional[str] = None
+    webhook_timeout_seconds: float = 2.0
     # Stop after this many CLOSED windows (0 = run until the source
     # ends) — the CI/smoke bound.
     max_windows: int = 0
@@ -496,6 +541,7 @@ class MicroRankConfig:
     stream: StreamConfig = field(default_factory=StreamConfig)
     dispatch: DispatchConfig = field(default_factory=DispatchConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    explain: ExplainConfig = field(default_factory=ExplainConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -535,4 +581,5 @@ class MicroRankConfig:
             stream=_mk(StreamConfig, d.get("stream", {})),
             dispatch=_mk(DispatchConfig, d.get("dispatch", {})),
             obs=_mk(ObsConfig, d.get("obs", {})),
+            explain=_mk(ExplainConfig, d.get("explain", {})),
         )

@@ -1,0 +1,41 @@
+"""Rank provenance: device-side attribution traces + explain bundles.
+
+MicroRank's output is a ranked suspect list, but every score is opaque:
+the weighted-spectrum formulas decompose into the four counters
+ef/nf/ep/np and the two PPR weight vectors, yet none of that survives
+the jitted program. This subsystem makes the *verdicts* observable:
+
+* ``extract`` — explained twins of the rank programs: the attribution
+  tensors (per-suspect counter decomposition, per-formula term values,
+  normal-vs-abnormal PPR mass, top-k contributing coverage columns)
+  ride the existing result fetch, folded into the kernels' epilogue the
+  way FUSED-PAGERANK folds post-passes into the iteration — for every
+  kernel family (coo/csr/packed/pcsr) and the sharded path;
+* ``bundle`` — the host materialization: ``ExplainBundle`` (JSON +
+  human-readable table), written on demand and automatically on
+  incident open (next to the flight dump, cross-linked in its
+  manifest);
+* ``oracle`` — the float64 numpy twin the parity suite pins the device
+  attributions against, tie-aware;
+* ``store`` — bounded in-process ring of recent bundles, served by the
+  obs server's ``GET /explainz?window=...`` endpoint.
+
+Gated by ``ExplainConfig``: off (the default) dispatches the unchanged
+rank programs, so the hot path pays nothing.
+"""
+
+from .bundle import ExplainBundle, ExplainContext, build_bundle
+from .extract import (
+    rank_window_explained_blob_core,
+    rank_window_explained_core,
+)
+from .store import get_explain_store
+
+__all__ = [
+    "ExplainBundle",
+    "ExplainContext",
+    "build_bundle",
+    "get_explain_store",
+    "rank_window_explained_core",
+    "rank_window_explained_blob_core",
+]

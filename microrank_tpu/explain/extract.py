@@ -1,0 +1,292 @@
+"""Device-side attribution extraction: the explained rank twins.
+
+``rank_window_explained_core`` is ``rank_window_traced_core`` plus a
+provenance epilogue fused into the same program (the FUSED-PAGERANK
+shape — post-passes ride the iteration's program, arxiv 2203.09284):
+
+* **counters** float32[4, Ke] — the method-independent spectrum
+  counters (ef, nf, ep, np) gathered at the explained suspects;
+* **terms** float32[M, Ke] — the score every one of the 13 formulas
+  assigns those counters (METHODS order) — how the configured formula's
+  verdict compares across the whole family;
+* **mass** float32[2, Ke] — the normal/abnormal PPR weight split
+  (row 0 normal, row 1 abnormal) the counters multiply;
+* **trace_idx/trace_val** int32/float32[2, Ke, J] — per partition, the
+  top-J contributing coverage columns of each suspect and their
+  contributions ``p_sr[v, t] * rv[t]`` (the forward coverage term at
+  convergence), recovered from whatever coverage representation the
+  kernel actually staged: bitmap rows (packed family), COO entries
+  (coo/dense/pallas), CSR row ranges (csr), or the ELL slab (pcsr) —
+  ``device_subset`` stripping never blocks the epilogue. Entries are
+  -inf-padded past each partition's live columns; hosts map indices
+  back to trace ids via the build's coverage-column retention map.
+
+Everything is carried in the program's output tuple — one fetch, no
+host sync — and the epilogue only exists in the explained twins:
+``ExplainConfig.enabled=False`` dispatches the unchanged programs.
+
+Sharded: the same epilogue runs under ``shard_map`` (psum_axis set) —
+entry-sharded kernels psum their scatter partials into the replicated
+[Ke, T] contribution matrix; the trace-sharded packed kernels
+all-gather their local column blocks — so the attribution outputs are
+replicated exactly like the rank outputs
+(``parallel.sharded_rank.rank_windows_explained_sharded``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..analysis.contracts import contract
+from ..config import ExplainConfig, PageRankConfig, SpectrumConfig
+from ..graph.structures import PartitionGraph, WindowGraph
+from ..rank_backends.jax_tpu import (
+    spectrum_counters,
+    top_k_tiebroken,
+    unpack_bits,
+    window_weights_full,
+)
+from ..spectrum.formulas import METHODS, spectrum_scores
+
+# Explain output tuple layout, after the 5 traced-rank outputs:
+# (counters[4,Ke], terms[M,Ke], mass[2,Ke], trace_idx[2,Ke,J],
+#  trace_val[2,Ke,J]).
+N_EXPLAIN_OUTS = 5
+
+
+def _slot_map(top_idx, v_pad: int):
+    """int32[v_pad + 1] mapping op index -> suspect slot (K = scrap).
+
+    top_idx rows are distinct by construction (top_k_tiebroken sorts a
+    permutation), so the scatter never collides. The +1 row absorbs the
+    csr path's past-the-end searchsorted result.
+    """
+    k = top_idx.shape[0]
+    return (
+        jnp.full((v_pad + 1,), k, jnp.int32)
+        .at[top_idx]
+        .set(jnp.arange(k, dtype=jnp.int32))
+    )
+
+
+def _contrib_rows(
+    g: PartitionGraph,
+    top_idx,
+    rv,
+    kernel: str,
+    psum_axis: str | None,
+):
+    """float32[K, T] replicated contribution matrix of one partition:
+    ``out[k, t] = p_sr[top_idx[k], t] * rv[t]`` over the padded trace
+    (column) axis, from the kernel's own staged coverage view."""
+    k = top_idx.shape[0]
+    v_pad = g.cov_unique.shape[0]
+    t_pad = g.kind.shape[0]  # LOCAL under the trace-sharded packed path
+
+    if kernel in ("packed", "packed_bf16", "packed_blocked"):
+        # Bitmap rows: K gathered rows unpacked to the (local) trace
+        # axis; inv_tracelen is the per-column p_sr value (multiplicity
+        # folded in on collapsed builds).
+        rows = unpack_bits(
+            jnp.take(g.cov_bits, top_idx, axis=0), t_pad
+        )
+        local = rows * (rv * g.inv_tracelen)[None, :]
+        if psum_axis is None:
+            return local
+        # Trace-sharded: concatenate the column blocks (tiled
+        # all_gather keeps the result shape static).
+        return lax.all_gather(local, psum_axis, axis=1, tiled=True)
+
+    if kernel == "pcsr":
+        # ELL slab [T_local, W]: a column covers suspect k iff any slab
+        # cell names the op (pc_ell_rs > 0 masks slab padding). The
+        # p_sr value is multiplicity/tracelen (kind holds the column
+        # multiplicity on collapsed builds, 1-equivalent otherwise).
+        t_local = g.pc_ell_op.shape[0]
+        t_base = (
+            0
+            if psum_axis is None
+            else lax.axis_index(psum_axis) * t_local
+        )
+        live_cell = g.pc_ell_rs > 0
+        match = jnp.any(
+            live_cell[None, :, :]
+            & (g.pc_ell_op[None, :, :] == top_idx[:, None, None]),
+            axis=-1,
+        ).astype(jnp.float32)
+        mult = jnp.where(
+            g.n_cols < 0, 1.0, g.kind.astype(jnp.float32)
+        )
+        w_col = rv * mult / g.tracelen.astype(jnp.float32)
+        local = match * lax.dynamic_slice(w_col, (t_base,), (t_local,))
+        if psum_axis is None:
+            return local
+        full = lax.dynamic_update_slice(
+            jnp.zeros((k, t_pad), jnp.float32), local, (0, t_base)
+        )
+        return lax.psum(full, psum_axis)
+
+    if kernel == "csr":
+        # Op-major entries: entry e belongs to the op whose indptr
+        # range brackets its GLOBAL position (entry axes block-split
+        # under sharding, indptrs replicated global).
+        e_local = g.sr_val_opmajor.shape[0]
+        base = (
+            0
+            if psum_axis is None
+            else lax.axis_index(psum_axis) * e_local
+        )
+        e_idx = base + jnp.arange(e_local, dtype=jnp.int32)
+        op_e = (
+            jnp.searchsorted(g.inc_indptr_op, e_idx, side="right") - 1
+        )
+        op_e = jnp.clip(op_e, 0, v_pad)
+        vals = g.sr_val_opmajor * jnp.take(rv, g.inc_trace_opmajor)
+        partial = (
+            jnp.zeros((k + 1, t_pad), jnp.float32)
+            .at[_slot_map(top_idx, v_pad)[op_e], g.inc_trace_opmajor]
+            .add(vals)
+        )[:k]
+        return (
+            partial
+            if psum_axis is None
+            else lax.psum(partial, psum_axis)
+        )
+
+    # coo / dense / dense_bf16 / pallas: the trace-major COO entries.
+    vals = g.sr_val * jnp.take(rv, g.inc_trace)
+    partial = (
+        jnp.zeros((k + 1, t_pad), jnp.float32)
+        .at[_slot_map(top_idx, v_pad)[jnp.clip(g.inc_op, 0, v_pad)],
+            g.inc_trace]
+        .add(vals)
+    )[:k]
+    return partial if psum_axis is None else lax.psum(partial, psum_axis)
+
+
+def _top_traces(
+    g: PartitionGraph,
+    top_idx,
+    rv,
+    explain_cfg: ExplainConfig,
+    kernel: str,
+    psum_axis: str | None,
+):
+    """(idx int32[K, J], val float32[K, J]): each suspect's top-J
+    contributing coverage columns of one partition, -inf past the live
+    columns (and past the partition's column count when J exceeds it).
+    Ties break by ascending column index (vocab-order determinism, same
+    two-key sort as the ranking itself)."""
+    contrib = _contrib_rows(g, top_idx, rv, kernel, psum_axis)
+    t_full = contrib.shape[1]
+    n_live = jnp.where(g.n_cols < 0, g.n_traces, g.n_cols)
+    live = jnp.arange(t_full) < n_live
+    masked = jnp.where(live[None, :], contrib, -jnp.inf)
+    j = min(int(explain_cfg.top_traces), t_full)
+    vals, idx = jax.vmap(lambda row: top_k_tiebroken(row, j))(masked)
+    j_want = int(explain_cfg.top_traces)
+    if j < j_want:
+        pad = j_want - j
+        vals = jnp.concatenate(
+            [vals, jnp.full((vals.shape[0], pad), -jnp.inf)], axis=1
+        )
+        idx = jnp.concatenate(
+            [idx, jnp.zeros((idx.shape[0], pad), jnp.int32)], axis=1
+        )
+    return idx.astype(jnp.int32), vals
+
+
+@contract(
+    graph="windowgraph",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]",
+        "float32[4,Ke]", "float32[M,Ke]", "float32[2,Ke]",
+        "int32[2,Ke,J]", "float32[2,Ke,J]",
+    ),
+)
+def rank_window_explained_core(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    explain_cfg: ExplainConfig,
+    psum_axis: str | None = None,
+    kernel: str = "coo",
+):
+    """The explained traced ranking: rank_window_traced_core's 5 outputs
+    plus the attribution tensors (module docstring), one program, one
+    fetch. ``explain_cfg`` is a static (hashable frozen dataclass) jit
+    argument like the other configs."""
+    n_weight, a_weight, rv_n, rv_a, residuals, n_iters = (
+        window_weights_full(graph, pagerank_cfg, psum_axis, kernel)
+    )
+    ef, nf, ep, np_, valid = spectrum_counters(
+        a_weight, graph.abnormal, n_weight, graph.normal, spectrum_cfg
+    )
+    scores = jnp.where(
+        valid, spectrum_scores(ef, nf, ep, np_, spectrum_cfg.method),
+        -jnp.inf,
+    )
+    k = min(spectrum_cfg.n_rows, scores.shape[0])
+    top_scores, top_idx = top_k_tiebroken(scores, k)
+    top_idx = top_idx.astype(jnp.int32)
+    n_valid = jnp.minimum(valid.sum(), k).astype(jnp.int32)
+
+    ke = (
+        k
+        if explain_cfg.top_suspects <= 0
+        else min(int(explain_cfg.top_suspects), k)
+    )
+    sus = top_idx[:ke]
+    c_sus = tuple(jnp.take(x, sus) for x in (ef, nf, ep, np_))
+    counters = jnp.stack(c_sus)
+    # Per-formula terms on the [Ke] gathered counters: elementwise
+    # formulas, so gather-then-score equals score-then-gather exactly.
+    terms = jnp.stack(
+        [spectrum_scores(*c_sus, m) for m in METHODS]
+    )
+    mass = jnp.stack(
+        [jnp.take(n_weight, sus), jnp.take(a_weight, sus)]
+    )
+    ti_n, tv_n = _top_traces(
+        graph.normal, sus, rv_n, explain_cfg, kernel, psum_axis
+    )
+    ti_a, tv_a = _top_traces(
+        graph.abnormal, sus, rv_a, explain_cfg, kernel, psum_axis
+    )
+    trace_idx = jnp.stack([ti_n, ti_a])
+    trace_val = jnp.stack([tv_n, tv_a])
+    return (
+        top_idx, top_scores, n_valid, residuals, n_iters,
+        counters, terms, mass, trace_idx, trace_val,
+    )
+
+
+@contract(
+    blob="uint32[N]",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]",
+        "float32[4,Ke]", "float32[M,Ke]", "float32[2,Ke]",
+        "int32[2,Ke,J]", "float32[2,Ke,J]",
+    ),
+)
+def rank_window_explained_blob_core(
+    blob, layout, pagerank_cfg, spectrum_cfg, explain_cfg, kernel="coo"
+):
+    """Blob-staged twin of rank_window_explained_core (the default
+    staging profile): unpack inside the program, same output tuple."""
+    from ..rank_backends.blob import unpack_graph_blob
+
+    graph = unpack_graph_blob(blob, layout)
+    return rank_window_explained_core(
+        graph, pagerank_cfg, spectrum_cfg, explain_cfg, None, kernel
+    )
+
+
+rank_window_explained_device = jax.jit(
+    rank_window_explained_core, static_argnums=(1, 2, 3, 4, 5)
+)
+rank_window_explained_blob_device = jax.jit(
+    rank_window_explained_blob_core, static_argnums=(1, 2, 3, 4, 5)
+)

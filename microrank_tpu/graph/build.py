@@ -626,7 +626,8 @@ def build_window_graph(
     aux: str = "auto",
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
     collapse: str = "off",
-) -> Tuple[WindowGraph, List[str], List, List]:
+    retain_columns: bool = False,
+):
     """Build both partitions of a window over one shared op vocab.
 
     The shared vocab is what makes the downstream spectrum step a single
@@ -639,6 +640,15 @@ def build_window_graph(
     views and the post-pass constructs them on the collapsed shapes.
 
     Returns (graph, op_names, normal_trace_ids, abnormal_trace_ids).
+
+    ``retain_columns`` (the explain subsystem's coverage-column
+    retention map): append a 5th element ``(map_normal, map_abnormal)``
+    — per partition, an int64 array mapping each COLLAPSED coverage
+    column to the partition-local index of its representative trace
+    (the lowest-index member of its kind group), or ``None`` for an
+    identity mapping (uncollapsed build, or a declined auto-collapse).
+    ``trace_ids[map[c]]`` then names the trace a device-side column
+    attribution refers to.
     """
     names = operation_names(span_df, "pod", strip_services)
     # sort=True interns the vocab in name order: vocab index then doubles
@@ -716,9 +726,15 @@ def build_window_graph(
         id_lists.append([tr_uniques[c] for c in local_codes])
 
     graph = WindowGraph(normal=parts[0], abnormal=parts[1])
+    column_map = (None, None)
     if collapse != "off":
-        graph = collapse_window_graph(
-            graph, aux, pad_policy, min_pad, dense_budget_bytes, collapse
+        graph, column_map = collapse_window_graph(
+            graph, aux, pad_policy, min_pad, dense_budget_bytes, collapse,
+            return_column_map=True,
+        )
+    if retain_columns:
+        return (
+            graph, list(op_uniques), id_lists[0], id_lists[1], column_map
         )
     return graph, list(op_uniques), id_lists[0], id_lists[1]
 
@@ -746,6 +762,11 @@ def _collapse_partition(
     are collapse-invariant by construction.
 
     ``mode`` is the RESOLVED aux mode for the collapsed shapes.
+
+    Returns ``(collapsed_part, rep_idx)`` where ``rep_idx[c]`` is the
+    partition-local trace index of column ``c``'s representative (the
+    coverage-column retention map the explain subsystem uses to name
+    the trace behind a device-side column attribution).
     """
     n_inc = int(part.n_inc)
     n_traces = int(part.n_traces)
@@ -795,7 +816,7 @@ def _collapse_partition(
         part.ss_child, part.ss_parent, part.ss_val,
         len(c_op), n_ss, v_pad, t_pad, mode,
     )
-    return part._replace(
+    collapsed = part._replace(
         inc_op=p_inc_op,
         inc_trace=p_inc_trace,
         sr_val=p_sr_val,
@@ -820,6 +841,7 @@ def _collapse_partition(
         pc_ell_op=pc_ell_op,
         pc_ell_rs=pc_ell_rs,
     )
+    return collapsed, first_idx[order]
 
 
 def _rebuild_aux(part: PartitionGraph, mode: str) -> PartitionGraph:
@@ -863,7 +885,8 @@ def collapse_window_graph(
     min_pad: int = 8,
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
     collapse: str = "auto",
-) -> WindowGraph:
+    return_column_map: bool = False,
+):
     """Kind-collapse both partitions' trace axes and (re)build aux views.
 
     The exact trace-axis compression the reference's own kind-dedup
@@ -880,6 +903,11 @@ def collapse_window_graph(
     when it shrinks the trace axis (when it doesn't, the aux views are
     built on the original arrays instead — same result as a direct
     build); ``"on"`` always collapses.
+
+    ``return_column_map``: also return ``(map_normal, map_abnormal)``
+    per-partition representative-trace indices (int64[n_cols]; None =
+    identity — the declined-collapse exit), the explain subsystem's
+    coverage-column retention map.
     """
     if collapse not in ("auto", "on"):
         raise ValueError(f"unknown collapse mode {collapse!r}")
@@ -921,7 +949,8 @@ def collapse_window_graph(
                     mode,
                 )
             )
-        return WindowGraph(normal=declined[0], abnormal=declined[1])
+        out = WindowGraph(normal=declined[0], abnormal=declined[1])
+        return (out, (None, None)) if return_column_map else out
     t_pads = tuple(
         pad_to(max(len(counts), 1), pad_policy, min_pad)
         for _, counts in groups
@@ -929,11 +958,14 @@ def collapse_window_graph(
     mode = resolve_aux(
         aux, int(parts[0].cov_unique.shape[0]), t_pads, dense_budget_bytes
     )
-    new_parts = [
+    collapsed = [
         _collapse_partition(p, mode, pad_policy, min_pad, grp)
         for p, grp in zip(parts, groups)
     ]
-    return WindowGraph(normal=new_parts[0], abnormal=new_parts[1])
+    out = WindowGraph(normal=collapsed[0][0], abnormal=collapsed[1][0])
+    if return_column_map:
+        return out, (collapsed[0][1], collapsed[1][1])
+    return out
 
 
 @contract(returns=("detectbatch", "any"))

@@ -322,6 +322,15 @@ def profile_sessions() -> Counter:
     )
 
 
+def explain_bundles() -> Counter:
+    return get_registry().counter(
+        "microrank_explain_bundles_total",
+        "Explain bundles materialized (rank provenance: per-suspect "
+        "counter decomposition + contributing traces), by trigger",
+        labelnames=("trigger",),  # incident | request | cli | on_demand
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -354,7 +363,7 @@ def ensure_catalog() -> None:
         compile_cache_events,
         build_pool_inflight, build_pool_builds,
         spans_recorded, flight_dumps, device_hbm_bytes,
-        kernel_ms_per_iter, profile_sessions,
+        kernel_ms_per_iter, profile_sessions, explain_bundles,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -436,6 +445,10 @@ def record_flight_dump(reason: str) -> None:
 
 def record_profile_session(trigger: str) -> None:
     profile_sessions().inc(trigger=trigger)
+
+
+def record_explain(trigger: str) -> None:
+    explain_bundles().inc(trigger=trigger)
 
 
 def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:

@@ -11,6 +11,11 @@ dependencies, nothing listening unless asked. Routes:
   under the server's profile directory and returns its path as JSON.
   One session at a time (409 while another runs); the capture blocks
   only the requesting handler thread, never the pipeline.
+* ``/explainz``     — rank provenance (``?window=<start>``): the
+  explain bundle of a recent window from the in-process store
+  (``explain.store`` — pipelines publish bundles there on incident
+  open / explain:true requests). Without ``window``, lists the stored
+  window ids and returns the latest bundle.
 """
 
 from __future__ import annotations
@@ -42,6 +47,9 @@ def _make_handler(registry: MetricsRegistry, profile_dir=None):
             elif route == "/profilez":
                 status, body = self._profilez(query)
                 ctype = "application/json"
+            elif route == "/explainz":
+                status, body = self._explainz(query)
+                ctype = "application/json"
             else:
                 self.send_error(404)
                 return
@@ -50,6 +58,30 @@ def _make_handler(registry: MetricsRegistry, profile_dir=None):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        @staticmethod
+        def _explainz(query: str):
+            from urllib.parse import parse_qs
+
+            from ..explain.store import get_explain_store
+
+            store = get_explain_store()
+            window = parse_qs(query).get("window", [None])[0]
+            if window is None:
+                latest = store.latest()
+                return 200, json.dumps(
+                    {"windows": store.windows(), "latest": latest}
+                ).encode()
+            bundle = store.get(window)
+            if bundle is None:
+                return 404, json.dumps(
+                    {
+                        "error": f"no explain bundle for window "
+                        f"{window!r}",
+                        "windows": store.windows(),
+                    }
+                ).encode()
+            return 200, json.dumps(bundle).encode()
 
         @staticmethod
         def _profilez(query: str):

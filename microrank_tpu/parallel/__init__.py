@@ -7,6 +7,7 @@ from .distributed import (
 from .mesh import SHARD_AXIS, WINDOW_AXIS, make_mesh, single_axis_mesh
 from .sharded_rank import (
     rank_windows_batched,
+    rank_windows_explained_sharded,
     rank_windows_sharded,
     rank_windows_sharded_checked,
     rank_windows_sharded_checked_traced,
@@ -20,6 +21,7 @@ __all__ = [
     "make_mesh",
     "single_axis_mesh",
     "rank_windows_batched",
+    "rank_windows_explained_sharded",
     "rank_windows_sharded",
     "rank_windows_sharded_checked",
     "rank_windows_sharded_checked_traced",

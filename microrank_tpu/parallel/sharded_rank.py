@@ -665,6 +665,61 @@ def rank_windows_sharded_traced(
     )(batched)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+@contract(
+    batched="windowgraph",
+    returns=(
+        "int32[B,K]", "float32[B,K]", "int32[B]", "float32[B,2,I]",
+        "int32[B]", "float32[B,4,Ke]", "float32[B,M,Ke]",
+        "float32[B,2,Ke]", "int32[B,2,Ke,J]", "float32[B,2,Ke,J]",
+    ),
+)
+def rank_windows_explained_sharded(
+    batched: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    explain_cfg,
+    mesh: Mesh,
+    kernel: str = "coo",
+):
+    """rank_windows_sharded_traced plus the rank-provenance epilogue
+    (explain.extract.rank_window_explained_core) — attribution tensors
+    for every window of a sharded batch in the same program. The
+    epilogue's contribution matrix is replicated before it leaves the
+    kernel (entry-sharded kernels psum their scatter partials; the
+    trace-sharded packed kernels all-gather their column blocks), so
+    the window-axis out_specs are sound exactly like the rank
+    outputs'."""
+    from ..explain.extract import rank_window_explained_core
+
+    if kernel not in SHARD_KERNELS:
+        raise ValueError(
+            f"kernel {kernel!r} is not shard-capable; use one of "
+            f"{SHARD_KERNELS}"
+        )
+    if kernel == "pcsr":
+        _validate_sharded_pcsr(batched, mesh)
+    specs = _partition_specs(WINDOW_AXIS, SHARD_AXIS, kernel)
+    in_specs = (WindowGraph(normal=specs, abnormal=specs),)
+    out_specs = tuple(P(WINDOW_AXIS) for _ in range(10))
+
+    def kernel_fn(graph: WindowGraph):
+        return jax.vmap(
+            lambda g: rank_window_explained_core(
+                g, pagerank_cfg, spectrum_cfg, explain_cfg,
+                SHARD_AXIS, kernel,
+            )
+        )(graph)
+
+    return shard_map(
+        kernel_fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=False,
+    )(batched)
+
+
 # ---------------------------------------------------------------------------
 # checkify instrumentation for the sharded path (PR 7). The single-device
 # checked programs thread checkify's error state through the whole rank;

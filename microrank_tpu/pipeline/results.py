@@ -51,6 +51,10 @@ class WindowResult:
     tenant: Optional[str] = None
     degraded: bool = False
     batch_windows: Optional[int] = None
+    # Rank provenance (explain/ subsystem): the window's ExplainBundle
+    # data when the caller asked for it (serve explain:true) — None
+    # everywhere else; the bundle files are the durable form.
+    explain: Optional[dict] = None
 
     def apply_convergence(self, conv: Optional[dict]) -> None:
         """Fold a convergence summary ({iterations, final_residual, ...})

@@ -266,6 +266,7 @@ def stage_rank_window(
     blob: bool,
     checked: bool = False,
     conv_trace: bool = False,
+    explain=None,
 ):
     """The one single-device stage+dispatch seam both the backend
     (JaxBackend.rank_window) and the pipeline (TableRCA.launch_rank)
@@ -285,9 +286,44 @@ def stage_rank_window(
     has a residual-traced twin (rank_window_checked_traced), so
     device_checks + conv_trace yields the checked 5-tuple instead of
     silently dropping telemetry.
+
+    ``explain`` (an ``ExplainConfig``, or None): dispatch the EXPLAINED
+    traced twin instead — the return grows to the 10-tuple whose last
+    five entries are the attribution tensors (explain.extract). The
+    explained program always carries the convergence trace; it does not
+    thread checkify (explain is an on-demand / incident-open path — the
+    host-side score validation still applies), so ``checked`` is
+    ignored for this dispatch.
     """
     from ..obs.metrics import record_retrace
 
+    if explain is not None and getattr(explain, "enabled", False):
+        from ..explain.extract import (
+            rank_window_explained_blob_device,
+            rank_window_explained_device,
+        )
+
+        if blob:
+            blob_arr, layout = pack_graph_blob(graph)
+            _account_staging(graph, "blob", 1)
+            out = rank_window_explained_blob_device(
+                jax.device_put(blob_arr), layout, pagerank_cfg,
+                spectrum_cfg, explain, kernel,
+            )
+            record_retrace(
+                "rank_window_explained_blob",
+                rank_window_explained_blob_device,
+            )
+            return out
+        _account_staging(graph, "tree", len(jax.tree.leaves(graph)))
+        out = rank_window_explained_device(
+            jax.device_put(graph), pagerank_cfg, spectrum_cfg, explain,
+            None, kernel,
+        )
+        record_retrace(
+            "rank_window_explained", rank_window_explained_device
+        )
+        return out
     if checked:
         if blob:
             from jax.experimental import checkify

@@ -1042,6 +1042,28 @@ def window_weights_traced(
 
     Returns (n_weight[V], a_weight[V], residuals[2, I], n_iters int32).
     """
+    n_weight, a_weight, _, _, residuals, n_iters = window_weights_full(
+        graph, pagerank_cfg, psum_axis, kernel
+    )
+    return n_weight, a_weight, residuals, n_iters
+
+
+def window_weights_full(
+    graph: WindowGraph,
+    pagerank_cfg: PageRankConfig,
+    psum_axis: str | None = None,
+    kernel: str = "coo",
+):
+    """window_weights_traced plus the FINAL trace-partition vectors —
+    the rank-provenance seam (explain/): the per-trace PPR mass ``rv``
+    at convergence is what the coverage-column attribution decomposes
+    (contribution of trace t to suspect v = p_sr[v, t] * rv[t]).
+
+    Returns (n_weight[V], a_weight[V], rv_n[T_n], rv_a[T_a],
+    residuals[2, I], n_iters int32). Under the trace-sharded packed
+    kernels the rv vectors stay LOCAL blocks (the explain epilogue
+    all-gathers them where needed).
+    """
     cfg = pagerank_cfg
     mv_n, pref_n, sv_n, rv_n, ax_n = _partition_setup(
         graph.normal, False, cfg, psum_axis, kernel
@@ -1118,10 +1140,10 @@ def window_weights_traced(
         n_iters, carry, _, residuals = lax.while_loop(
             cond, body, (jnp.int32(0), carry0, delta0, res0)
         )
-    (sv_n, _), (sv_a, _) = carry
+    (sv_n, rv_n), (sv_a, rv_a) = carry
     n_weight, _ = _partition_finish(graph.normal, sv_n)
     a_weight, _ = _partition_finish(graph.abnormal, sv_a)
-    return n_weight, a_weight, residuals, jnp.int32(n_iters)
+    return n_weight, a_weight, rv_n, rv_a, residuals, jnp.int32(n_iters)
 
 
 @contract(
@@ -1486,6 +1508,32 @@ def prepare_window_graph(span_df, normal_ids, abnormal_ids, config):
     the build's true thread and its causal parent (the window/request
     root).
     """
+    graph, op_names, kernel, _ = _prepare_window_graph(
+        span_df, normal_ids, abnormal_ids, config, retain_columns=False
+    )
+    return graph, op_names, kernel
+
+
+def prepare_window_graph_explained(span_df, normal_ids, abnormal_ids, config):
+    """prepare_window_graph plus the coverage-column retention context
+    the explain subsystem needs to name traces behind device-side
+    column attributions: returns ``(graph, op_names, kernel, ectx)``
+    where ``ectx`` is an ``explain.bundle.ExplainContext`` (per
+    partition: column -> trace id of the kind representative, and the
+    column multiplicities)."""
+    from ..explain.bundle import ExplainContext
+
+    graph, op_names, kernel, retained = _prepare_window_graph(
+        span_df, normal_ids, abnormal_ids, config, retain_columns=True
+    )
+    ids_n, ids_a, (map_n, map_a) = retained
+    ectx = ExplainContext.from_build(graph, ids_n, ids_a, map_n, map_a)
+    return graph, op_names, kernel, ectx
+
+
+def _prepare_window_graph(
+    span_df, normal_ids, abnormal_ids, config, retain_columns: bool
+):
     from ..graph.build import aux_for_kernel, build_window_graph
     from ..obs.spans import get_tracer
     from .base import validate_partitions
@@ -1496,7 +1544,7 @@ def prepare_window_graph(span_df, normal_ids, abnormal_ids, config):
     validate_tiebreak(config.spectrum)
     rt = config.runtime
     with get_tracer().span("build", service="pipeline"):
-        graph, op_names, _, _ = build_window_graph(
+        out = build_window_graph(
             span_df,
             normal_ids,
             abnormal_ids,
@@ -1505,13 +1553,18 @@ def prepare_window_graph(span_df, normal_ids, abnormal_ids, config):
             aux=aux_for_kernel(rt.kernel),
             dense_budget_bytes=rt.dense_budget_bytes,
             collapse=rt.collapse_kinds,
+            retain_columns=retain_columns,
+        )
+        graph, op_names = out[0], out[1]
+        retained = (
+            (out[2], out[3], out[4]) if retain_columns else None
         )
         kernel = rt.kernel
         if kernel == "auto":
             kernel = choose_kernel(
                 graph, rt.dense_budget_bytes, rt.prefer_bf16
             )
-    return device_subset(graph, kernel), op_names, kernel
+    return device_subset(graph, kernel), op_names, kernel, retained
 
 
 class JaxBackend:

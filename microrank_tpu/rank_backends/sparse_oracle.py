@@ -148,7 +148,9 @@ def _iterate_sparse(p, pref, v_pad: int, cfg: PageRankConfig):
                 break
         else:
             v_s, v_r = new_s, new_r
-    return v_s / np.amax(v_s)
+    # The final trace vector rides along for the explain oracle (the
+    # coverage-column attribution decomposes p_sr[v, t] * rv[t]).
+    return v_s / np.amax(v_s), v_r
 
 
 def _partition_rank(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
@@ -161,7 +163,7 @@ def _partition_rank(g: PartitionGraph, anomaly: bool, cfg: PageRankConfig):
         p["inc_trace"], p["inc_op"], p["tracelen"], p["n_traces"]
     )
     pref = _preference(kinds, p["tracelen"], anomaly, cfg)
-    v_s = _iterate_sparse(p, pref, v_pad, cfg)
+    v_s, _ = _iterate_sparse(p, pref, v_pad, cfg)
     total = float(v_s[p["op_present"]].sum())
     weight = np.where(p["op_present"], v_s * total / p["n_ops"], 0.0)
     trace_num = np.bincount(p["inc_op"], minlength=v_pad).astype(np.int64)

@@ -57,9 +57,16 @@ class PendingWindow:
     on_done: Optional[Callable] = None
     # Self-tracing: the request's root span context (obs.spans) and the
     # epoch-µs the request entered build — finish() records the root
-    # ``request`` span from these once the response resolves.
+    # ``request`` span from these once the response resolves. A caller
+    # traceparent's span id lands in ``parent_span`` so the root span
+    # joins the caller's distributed trace.
     ctx: object = None
     t0_us: int = 0
+    parent_span: Optional[str] = None
+    # Rank provenance: the build's coverage-column retention context
+    # (explain.bundle.ExplainContext) when the request asked for an
+    # explain bundle.
+    explain_ctx: object = None
     _finished: bool = field(default=False, repr=False)
 
     def finish(self, error: Optional[BaseException] = None) -> None:
@@ -81,6 +88,7 @@ class PendingWindow:
                 start_us=self.t0_us,
                 dur_us=int(time.time() * 1e6) - self.t0_us,
                 service="serve",
+                parent_id=self.parent_span,
                 tenant=self.request.tenant,
                 degraded=bool(self.result.degraded),
                 error=type(error).__name__ if error else None,
@@ -216,12 +224,80 @@ class MicroBatcher:
 
             record_serve_batch(len(items))
         self.dispatches += 1
+        self._explain_requests(items)
         self._journal_batch(
             items, batch_ms, degraded=0, warmup=warmup,
             route_info=route_info,
         )
         for pw in items:
             pw.finish()
+
+    def _explain_requests(self, items: List[PendingWindow]) -> None:
+        """Rank provenance for ``explain: true`` members: ONE extra
+        explained single-window dispatch per asking request, after the
+        batch resolved (the batched hot path never carries the explain
+        epilogue — requests that didn't ask pay nothing). Runs on the
+        scheduler thread like every device touch; a failed explain
+        degrades to a response without the bundle, never a failed
+        request."""
+        need = [
+            pw
+            for pw in items
+            if getattr(pw.request, "explain", False)
+            and pw.graph is not None
+        ]
+        if not need:
+            return
+        import dataclasses
+
+        import jax
+
+        from ..explain import build_bundle, get_explain_store
+        from ..obs.metrics import record_explain
+        from ..obs.spans import get_tracer
+        from ..rank_backends.blob import stage_rank_window
+
+        ex = dataclasses.replace(self.config.explain, enabled=True)
+        for pw in need:
+            try:
+                with get_tracer().span(
+                    "explain", service="serve", ctx=pw.ctx,
+                    kernel=pw.kernel,
+                ):
+                    outs = jax.device_get(
+                        stage_rank_window(
+                            pw.graph,
+                            self.config.pagerank,
+                            self.config.spectrum,
+                            pw.kernel,
+                            self.config.runtime.blob_staging,
+                            explain=ex,
+                        )
+                    )
+                bundle = build_bundle(
+                    outs,
+                    pw.op_names,
+                    pw.explain_ctx,
+                    method=self.config.spectrum.method,
+                    kernel=pw.kernel,
+                    window={
+                        "start": pw.result.start,
+                        "end": pw.result.end,
+                        "request_id": pw.request.request_id,
+                    },
+                    trigger="request",
+                )
+                pw.result.explain = bundle.data
+                record_explain("request")
+                get_explain_store().publish(
+                    str(pw.result.start), bundle.data
+                )
+            except Exception as e:  # noqa: BLE001 - provenance is
+                # best-effort; the ranked answer already stands.
+                self._log().warning(
+                    "explain dispatch failed for %s: %s",
+                    pw.request.request_id, e,
+                )
 
     def _device_dispatch(
         self,

@@ -9,11 +9,22 @@ One request = one detection window. Two payload forms:
   the server slices a dump it loaded at startup (``--dataset NAME=CSV``)
   to the requested time range.
 
-Either form may carry ``tenant`` (fair-dequeue key, default "default")
-and ``request_id`` (echoed back; generated when absent). The response is
-the request-scoped ``WindowResult`` serialization (pipeline.results)
-plus batching telemetry — including ``degraded: true`` when the answer
-came from the numpy_ref fallback path.
+Either form may carry ``tenant`` (fair-dequeue key, default "default"),
+``request_id`` (echoed back; generated when absent) and
+``explain: true`` (rank provenance: the response's ``explain`` field
+carries the window's ExplainBundle — per-suspect counter decomposition,
+per-formula terms, PPR mass split, top contributing traces — produced
+by one extra explained dispatch after the batch; the batched hot path
+is untouched). The response is the request-scoped ``WindowResult``
+serialization (pipeline.results) plus batching telemetry — including
+``degraded: true`` when the answer came from the numpy_ref fallback
+path.
+
+Tracing: a W3C ``traceparent`` request header joins the request's
+self-tracing spans to the CALLER's distributed trace (the request root
+adopts the caller's trace id and parent-links to the caller's span);
+responses carry a ``Server-Timing`` header built from the request's
+StageTimings (queue/parse/detect/build/rank).
 """
 
 from __future__ import annotations
@@ -21,8 +32,9 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import re
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from ..pipeline.results import WindowResult
 
@@ -43,10 +55,38 @@ class RankRequest:
     dataset: Optional[str] = None
     start: Optional[str] = None
     end: Optional[str] = None
+    # Rank provenance: build + return an ExplainBundle for this window.
+    explain: bool = False
+    # W3C trace context of the caller, parsed from the ``traceparent``
+    # header: (trace_id, parent_span_id) or None.
+    traceparent: Optional[Tuple[str, str]] = None
 
 
-def parse_rank_request(body: bytes) -> RankRequest:
-    """Parse + validate one POST /rank body."""
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
+    """Parse a W3C ``traceparent`` header (version-traceid-spanid-flags)
+    into (trace_id, parent_span_id); malformed or all-zero ids return
+    None (the spec says ignore, never reject the request)."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if not m:
+        return None
+    trace_id, span_id = m.group(2), m.group(3)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def parse_rank_request(
+    body: bytes, traceparent: Optional[str] = None
+) -> RankRequest:
+    """Parse + validate one POST /rank body (+ optional caller trace
+    context from the ``traceparent`` header)."""
     try:
         data = json.loads(body or b"")
     except json.JSONDecodeError as e:
@@ -76,6 +116,8 @@ def parse_rank_request(body: bytes) -> RankRequest:
         dataset=dataset,
         start=data.get("start"),
         end=data.get("end"),
+        explain=bool(data.get("explain", False)),
+        traceparent=parse_traceparent(traceparent),
     )
 
 
@@ -104,6 +146,19 @@ def response_body(result: WindowResult) -> bytes:
     d = dataclasses.asdict(result)
     d["ranking"] = [[n, float(s)] for n, s in result.ranking]
     return json.dumps(d).encode()
+
+
+def server_timing_header(timings: dict) -> Optional[str]:
+    """Render a request's StageTimings ``*_ms`` entries as a
+    ``Server-Timing`` response header value (RFC draft syntax:
+    ``name;dur=millis``) — queue/parse/detect/build/rank land in the
+    caller's devtools/tracing next to its own spans."""
+    parts = [
+        f"{key[:-3]};dur={float(val):.3f}"
+        for key, val in timings.items()
+        if key.endswith("_ms")
+    ]
+    return ", ".join(parts) or None
 
 
 def error_body(message: str, **extra) -> bytes:

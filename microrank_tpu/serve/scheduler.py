@@ -66,11 +66,18 @@ class BatchScheduler(threading.Thread):
     ) -> Future:
         """Enqueue one admitted request; returns its response future.
         The request's trace root (trace_id = request_id) is minted here
-        — at admission — so queue time is inside the ``request`` span."""
+        — at admission — so queue time is inside the ``request`` span.
+        A caller ``traceparent`` header overrides the trace id: the
+        request's spans then JOIN the caller's distributed trace (the
+        root span additionally parent-links to the caller's span id,
+        serve.server.build_pending)."""
         from ..obs.spans import get_tracer
 
         fut: Future = Future()
-        ctx = get_tracer().new_trace(request.request_id)
+        tp = getattr(request, "traceparent", None)
+        ctx = get_tracer().new_trace(
+            tp[0] if tp else request.request_id
+        )
         entry = (request, fut, time.monotonic(), on_done, ctx)
         with self._cond:
             if self._stopping:

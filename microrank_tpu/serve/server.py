@@ -273,20 +273,32 @@ class ServeService:
             kernel="", future=fut, enqueued=enqueued, on_done=on_done,
             # Root span bookkeeping: the ambient context is the request
             # trace the scheduler attached (queue time backdated into
-            # the root span's start).
+            # the root span's start); a caller traceparent additionally
+            # parent-links the root span to the caller's span.
             ctx=tracer.current_context(),
             t0_us=int((time.time() - queue_s) * 1e6),
+            parent_span=(
+                request.traceparent[1]
+                if getattr(request, "traceparent", None)
+                else None
+            ),
         )
         t0 = time.monotonic()
         try:
             with tracer.span("parse", service="serve"):
                 window_df = self._window_frame(request)
+            parse_s = time.monotonic() - t0
+            result.timings["parse_ms"] = round(parse_s * 1e3, 3)
             result.start = str(window_df["startTime"].min())
             result.end = str(window_df["endTime"].max())
+            t_det = time.monotonic()
             with tracer.span("detect", service="serve"):
                 flag, nrm, abn = _detect_partition(
                     self.config, self.slo_vocab, self.baseline, window_df
                 )
+            result.timings["detect_ms"] = round(
+                (time.monotonic() - t_det) * 1e3, 3
+            )
             result.anomaly = bool(flag)
             result.n_normal, result.n_abnormal = len(nrm), len(abn)
             result.n_traces = len(nrm) + len(abn)
@@ -297,11 +309,23 @@ class ServeService:
                 result.skipped_reason = "degenerate_partition"
                 pw.finish()
                 return None
-            from ..rank_backends.jax_tpu import prepare_window_graph
-
-            graph, names, kernel = prepare_window_graph(
-                window_df, nrm, abn, self.config
+            from ..rank_backends.jax_tpu import (
+                prepare_window_graph,
+                prepare_window_graph_explained,
             )
+
+            if getattr(request, "explain", False):
+                # explain:true — the build also retains the coverage-
+                # column map the bundle joins attributions against.
+                graph, names, kernel, pw.explain_ctx = (
+                    prepare_window_graph_explained(
+                        window_df, nrm, abn, self.config
+                    )
+                )
+            else:
+                graph, names, kernel = prepare_window_graph(
+                    window_df, nrm, abn, self.config
+                )
         except Exception as e:
             pw.finish(error=e)
             return None
@@ -434,9 +458,11 @@ class HttpFrontend:
             req = await self._read_request(reader)
             if req is None:
                 return
-            method, path, body = req
-            status, ctype, payload = await self._route(method, path, body)
-            await self._respond(writer, status, ctype, payload)
+            method, path, body, headers = req
+            out = await self._route(method, path, body, headers)
+            status, ctype, payload = out[:3]
+            extra = out[3] if len(out) > 3 else None
+            await self._respond(writer, status, ctype, payload, extra)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -467,12 +493,12 @@ class HttpFrontend:
             headers[name.strip().lower()] = value.strip()
         n = int(headers.get("content-length") or 0)
         body = await reader.readexactly(n) if n else b""
-        return method.upper(), path.split("?")[0], body
+        return method.upper(), path.split("?")[0], body, headers
 
-    async def _route(self, method, path, body):
+    async def _route(self, method, path, body, headers=None):
         svc = self.service
         if method == "POST" and path == "/rank":
-            return await self._rank(body)
+            return await self._rank(body, headers or {})
         if method == "GET" and path == "/healthz":
             payload = json.dumps(
                 {
@@ -497,11 +523,15 @@ class HttpFrontend:
             )
         return 404, "application/json", error_body("no such route")
 
-    async def _rank(self, body):
+    async def _rank(self, body, headers):
         svc = self.service
         retry = {"retry_after": svc.admission.retry_after_seconds}
         try:
-            request = parse_rank_request(body)
+            # W3C trace context: the request's self-tracing spans join
+            # the CALLER's distributed trace (serve.protocol).
+            request = parse_rank_request(
+                body, traceparent=headers.get("traceparent")
+            )
         except ProtocolError as e:
             return 400, "application/json", error_body(str(e))
         try:
@@ -531,9 +561,17 @@ class HttpFrontend:
                 "application/json",
                 error_body(str(e), request_id=request.request_id),
             )
-        return 200, "application/json", response_body(result)
+        # Server-Timing: the request's own stage durations land in the
+        # caller's tracing next to the traceparent-joined spans.
+        from .protocol import server_timing_header
 
-    async def _respond(self, writer, status, ctype, payload) -> None:
+        timing = server_timing_header(result.timings)
+        extra = {"Server-Timing": timing} if timing else None
+        return 200, "application/json", response_body(result), extra
+
+    async def _respond(
+        self, writer, status, ctype, payload, extra_headers=None
+    ) -> None:
         reason = {
             200: "OK", 400: "Bad Request", 404: "Not Found",
             429: "Too Many Requests", 500: "Internal Server Error",
@@ -550,6 +588,8 @@ class HttpFrontend:
                 1, int(round(self.service.admission.retry_after_seconds))
             )
             head.append(f"Retry-After: {retry}")
+        for name, value in (extra_headers or {}).items():
+            head.append(f"{name}: {value}")
         writer.write(
             ("\r\n".join(head) + "\r\n\r\n").encode() + payload
         )

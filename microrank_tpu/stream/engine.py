@@ -163,7 +163,11 @@ class StreamEngine:
                 self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
                 sinks.append(_JournalIncidentSink(self.journal))
         if sc.webhook_url:
-            sinks.append(WebhookIncidentSink(sc.webhook_url))
+            sinks.append(
+                WebhookIncidentSink(
+                    sc.webhook_url, timeout=sc.webhook_timeout_seconds
+                )
+            )
         self.tracker = IncidentTracker(
             top_k=sc.fingerprint_top_k,
             resolve_after=sc.resolve_after_windows,
@@ -180,6 +184,13 @@ class StreamEngine:
         self._cache_dir = None
         self._cache_probe = None
         self.summary = StreamSummary()
+        # Rank provenance (explain/): the most recent incident bundle,
+        # held until the flight dump it cross-links into is written.
+        self._last_bundle = None
+        if config.explain.enabled:
+            from ..explain import get_explain_store
+
+            get_explain_store().configure(config.explain.store_windows)
         # Flight recorder: dumps the span ring + correlated journal
         # events + metrics snapshot on incident open (rate-limited).
         self.flight = None
@@ -353,13 +364,11 @@ class StreamEngine:
         # lands — consecutive abnormal windows overlap build(N+1) with
         # rank(N). Healthy windows drained the pipe above, so lifecycle
         # observation order == window order.
-        from ..rank_backends.jax_tpu import prepare_window_graph
-
         # attach: the pool captures the submitter's ambient context, so
         # the off-thread build parent-links to THIS window's trace.
         with tracer.attach(trace.ctx):
             fut = self.pool.submit(
-                prepare_window_graph, closed.frame, nrm, abn, self.config
+                self._prepare, closed.frame, nrm, abn
             )
         self._pending.append(_PendingRank(closed, result, fut, trace))
         while len(self._pending) >= max(
@@ -368,6 +377,25 @@ class StreamEngine:
             self._rank_head()
 
     # ---------------------------------------------------------- ranking
+    def _prepare(self, frame, nrm, abn):
+        """The build-pool unit: prepared graph plus (when the explain
+        subsystem is armed) the coverage-column retention context the
+        incident bundle joins device attributions against. Uniform
+        4-tuple so the rank path never branches on the config."""
+        from ..rank_backends.jax_tpu import (
+            prepare_window_graph,
+            prepare_window_graph_explained,
+        )
+
+        if self.config.explain.enabled:
+            return prepare_window_graph_explained(
+                frame, nrm, abn, self.config
+            )
+        graph, op_names, kernel = prepare_window_graph(
+            frame, nrm, abn, self.config
+        )
+        return graph, op_names, kernel, None
+
     def _drain_all(self) -> None:
         while self._pending:
             self._rank_head()
@@ -375,7 +403,7 @@ class StreamEngine:
     def _rank_head(self) -> None:
         head = self._pending.popleft()
         try:
-            graph, op_names, kernel = head.future.result()
+            graph, op_names, kernel, ectx = head.future.result()
         except Exception as e:  # noqa: BLE001 - a bad window must not
             # kill the engine; the window records the failure and the
             # stream moves on.
@@ -385,10 +413,10 @@ class StreamEngine:
             head.result.skipped_reason = f"build_failed: {e}"
             self._finalize(head.result, "skipped", trace=head.trace)
             return
-        group = [(head, graph, op_names)]
+        group = [(head, graph, op_names, ectx)]
         if not self.config.runtime.device_checks:
             group.extend(self._coalesce_burst(graph, kernel))
-        for p, _, _ in group:
+        for p, _, _, _ in group:
             p.result.queue_depth = len(self._pending)
         try:
             if self.config.runtime.device_checks and len(group) == 1:
@@ -401,7 +429,7 @@ class StreamEngine:
             else:
                 self._dispatch_group(group, kernel)
         except Exception as e:  # noqa: BLE001 - same containment rule
-            for p, _, _ in group:
+            for p, _, _, _ in group:
                 self.log.error(
                     "window %s: device rank failed: %s", p.result.start, e
                 )
@@ -409,8 +437,11 @@ class StreamEngine:
                 p.result.ranking = []
                 self._finalize(p.result, "skipped", trace=p.trace)
             return
-        for p, _, _ in group:
-            self._finalize(p.result, "ranked", trace=p.trace)
+        for p, g, names, ec in group:
+            self._finalize(
+                p.result, "ranked", trace=p.trace,
+                explain_src=(g, names, p.result.kernel or kernel, ec),
+            )
 
     def _coalesce_burst(self, head_graph, kernel: str):
         """Abnormal-burst micro-batching: pending windows whose builds
@@ -428,14 +459,14 @@ class StreamEngine:
         while self._pending and len(extra) + 1 < cap:
             nxt = self._pending[0]
             try:
-                g2, n2, k2 = nxt.future.result()
+                g2, n2, k2, e2 = nxt.future.result()
             except Exception:  # noqa: BLE001 - its failure surfaces on
                 # its own _rank_head turn (futures cache exceptions).
                 break
             if bucket_key(g2, k2) != key:
                 break
             self._pending.popleft()
-            extra.append((nxt, g2, n2))
+            extra.append((nxt, g2, n2, e2))
         return extra
 
     def _dispatch_group(self, group, kernel: str) -> None:
@@ -451,13 +482,13 @@ class StreamEngine:
 
         rt = self.config.runtime
         conv = bool(rt.convergence_trace)
-        graphs = [g for _, g, _ in group]
+        graphs = [g for _, g, _, _ in group]
         next_batch = None
         if self.config.dispatch.double_buffer and self._pending:
             nxt = self._pending[0]
             if nxt.future.done():
                 try:
-                    g2, _, k2 = nxt.future.result()
+                    g2, _, k2, _ = nxt.future.result()
                     next_batch = ([g2], k2)
                 except Exception:  # noqa: BLE001 - handled on its turn
                     pass
@@ -481,7 +512,7 @@ class StreamEngine:
         occs.add(len(group))
         batch_ms = (time.monotonic() - t0) * 1e3
         ti, ts, nv = outs[:3]
-        for b, (p, _, op_names) in enumerate(group):
+        for b, (p, _, op_names, _) in enumerate(group):
             n = int(nv[b])
             names = [op_names[int(i)] for i in ti[b][:n]]
             scores = [float(s) for s in ts[b][:n]]
@@ -576,8 +607,65 @@ class StreamEngine:
                 {"iterations": n_it, "final_residual": final}
             )
 
+    def _explain_incident(self, result, explain_src) -> dict:
+        """Materialize the incident-opening window's explain bundle
+        (ON the engine thread — the device-owner rule): one explained
+        dispatch over the retained graph, bundle written under
+        out_dir/explain/, published to the /explainz store, mirrored
+        into the journal. Returns the open-event enrichment fields."""
+        import jax
+
+        from ..explain import build_bundle, get_explain_store
+        from ..obs.metrics import record_explain
+        from ..obs.spans import get_tracer
+        from ..rank_backends.blob import stage_rank_window
+
+        graph, op_names, kernel, ectx = explain_src
+        ex = self.config.explain
+        with get_tracer().span(
+            "explain", service="stream", kernel=kernel
+        ):
+            outs = jax.device_get(
+                stage_rank_window(
+                    graph,
+                    self.config.pagerank,
+                    self.config.spectrum,
+                    kernel,
+                    self.config.runtime.blob_staging,
+                    explain=ex,
+                )
+            )
+        bundle = build_bundle(
+            outs,
+            op_names,
+            ectx,
+            method=self.config.spectrum.method,
+            kernel=kernel,
+            window={"start": result.start, "end": result.end},
+            trigger="incident",
+        )
+        record_explain("incident")
+        get_explain_store().publish(str(result.start), bundle.data)
+        path = None
+        if self.out_dir is not None:
+            stem = str(result.start).replace(" ", "T").replace(":", "")
+            path = bundle.write(self.out_dir / "explain" / stem)
+        if self.journal is not None and ex.journal:
+            self.journal.emit(
+                "explain",
+                bundle=str(path) if path else None,
+                **bundle.journal_record(),
+            )
+        # Held until the flight dump this incident triggers, so the
+        # bundle lands next to the dump and its manifest links it.
+        self._last_bundle = bundle
+        return {"explain_bundle": str(path)} if path else {}
+
     # ------------------------------------------------------ finalization
-    def _finalize(self, result, outcome: str, frame=None, trace=None) -> None:
+    def _finalize(
+        self, result, outcome: str, frame=None, trace=None,
+        explain_src=None,
+    ) -> None:
         from ..obs.metrics import record_stream_window
         from ..obs.spans import get_tracer
 
@@ -589,9 +677,19 @@ class StreamEngine:
         )
         opened_before = self.tracker.opened
         if outcome == "ranked":
+            on_open = None
+            ex = self.config.explain
+            if (
+                explain_src is not None
+                and ex.enabled
+                and ex.on_incident
+            ):
+                on_open = lambda inc: self._explain_incident(  # noqa: E731
+                    result, explain_src
+                )
             with tracer.span("incident", service="stream", ctx=ctx):
                 inc = self.tracker.observe_ranked(
-                    result.start, result.ranking
+                    result.start, result.ranking, on_open=on_open
                 )
             if inc is not None:
                 self.summary.incidents_opened = self.tracker.opened
@@ -604,7 +702,15 @@ class StreamEngine:
                 # A NEW incident just opened: dump the causal record of
                 # how the pipeline got here while the ring still holds
                 # it (rate-limited inside the recorder).
-                self.flight.dump("incident")
+                dump_dir = self.flight.dump("incident")
+                if dump_dir is not None and self._last_bundle is not None:
+                    # Rank provenance next to the flight dump, cross-
+                    # linked in its manifest: the operator opens ONE
+                    # directory and sees both the causal trace and the
+                    # verdict's decomposition.
+                    self._last_bundle.write(dump_dir)
+                    self._link_bundle(dump_dir)
+            self._last_bundle = None
         elif outcome != "warmup":
             with tracer.span("incident", service="stream", ctx=ctx):
                 resolved = self.tracker.observe_healthy(result.start)
@@ -638,6 +744,22 @@ class StreamEngine:
                 dur_us=int((time.monotonic() - trace.perf0) * 1e6),
                 service="stream",
                 outcome=outcome,
+            )
+
+    def _link_bundle(self, dump_dir) -> None:
+        """Cross-link the explain bundle in the flight manifest."""
+        import json as _json
+
+        from ..explain.bundle import BUNDLE_JSON
+
+        man = Path(dump_dir) / "manifest.json"
+        try:
+            data = _json.loads(man.read_text())
+            data["explain_bundle"] = BUNDLE_JSON
+            man.write_text(_json.dumps(data, indent=2))
+        except (OSError, ValueError) as e:  # pragma: no cover
+            self.log.warning(
+                "could not cross-link explain bundle in %s: %s", man, e
             )
 
 

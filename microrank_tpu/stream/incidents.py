@@ -142,11 +142,20 @@ class StdoutIncidentSink:
 
 
 class WebhookIncidentSink:
-    """Best-effort JSON POST per transition (2 s timeout, never raises)."""
+    """Best-effort JSON POST per transition, never raises.
+
+    The sink runs ON the engine thread, so the POST is bounded by an
+    EXPLICIT timeout (``StreamConfig.webhook_timeout_seconds``) applied
+    to connect AND read — a hung endpoint costs at most ``timeout``
+    per transition, it cannot stall windowing/ranking indefinitely.
+    The payload enriches the raw lifecycle event with the top-k
+    ``suspects`` (name, score pairs at the fingerprint cut) and, when
+    the explain subsystem produced one, the ``explain_bundle`` path.
+    """
 
     def __init__(self, url: str, timeout: float = 2.0):
         self.url = url
-        self.timeout = float(timeout)
+        self.timeout = max(0.1, float(timeout))
         self.failures = 0
 
     def emit(self, event: dict) -> None:
@@ -159,6 +168,10 @@ class WebhookIncidentSink:
             method="POST",
         )
         try:
+            # The explicit timeout bounds the blocking socket ops
+            # (connect + response read) — urlopen with no timeout would
+            # inherit the global default of None and hang forever on a
+            # wedged endpoint.
             urllib.request.urlopen(req, timeout=self.timeout).close()
         except Exception as e:  # noqa: BLE001 - alerting must not kill RCA
             self.failures += 1
@@ -203,10 +216,19 @@ class IncidentTracker:
 
     # ------------------------------------------------------------ intake
     def observe_ranked(
-        self, window_start: str, ranking: Sequence[Tuple[str, float]]
+        self,
+        window_start: str,
+        ranking: Sequence[Tuple[str, float]],
+        on_open=None,
     ) -> Optional[Incident]:
         """One abnormal RANKED window; returns the incident it mapped to
-        (None when suppressed by cooldown)."""
+        (None when suppressed by cooldown).
+
+        ``on_open(incident) -> dict``: called once when a NEW incident
+        is about to open, BEFORE its ``incident_open`` event is emitted;
+        the returned fields merge into that event (the stream engine
+        attaches the explain-bundle path this way, so webhooks see it in
+        the open payload itself). A failing hook is contained."""
         self._window_no += 1
         fp = ranking_fingerprint(ranking, self.top_k)
         from ..obs.metrics import record_incident
@@ -268,8 +290,27 @@ class IncidentTracker:
         )
         self._open[fp] = inc
         self.opened += 1
+        extra = {}
+        if on_open is not None:
+            try:
+                extra = on_open(inc) or {}
+            except Exception as e:  # noqa: BLE001 - provenance must not
+                # block alerting; the open event just lacks the extras.
+                log.warning("incident on_open hook failed: %s", e)
         record_incident("open", open_now=len(self._open))
-        self._emit(inc.to_event("open"))
+        # Enrichment: the tie-aware top-k suspects WITH scores at the
+        # fingerprint cut, explicit in every open payload (the full
+        # ``top`` list stays for context).
+        self._emit(
+            inc.to_event(
+                "open",
+                suspects=[
+                    [str(n), float(s)]
+                    for n, s in inc.top[: self.top_k]
+                ],
+                **extra,
+            )
+        )
         return inc
 
     def observe_healthy(self, window_start: str) -> List[Incident]:

@@ -1,0 +1,721 @@
+"""Rank provenance (explain/) — oracle parity, bundles, and the API.
+
+The explain acceptance gate: device-side attribution tensors (the
+per-suspect ef/nf/ep/np counter decomposition, the per-formula term
+values across all 13 spectrum formulas, the normal/abnormal PPR mass
+split, and the top contributing coverage columns) must agree tie-aware
+with the float64 numpy oracle on EVERY kernel family (coo/csr/packed/
+pcsr), on collapsed AND uncollapsed builds, and on the sharded path.
+Plus: the hot path is untouched when explain is off, bundles
+materialize on incident open (next to the flight dump, cross-linked in
+its manifest), `GET /explainz` serves the store, `cli explain` renders
+run artifacts, serve honors `explain:true` + W3C `traceparent` +
+`Server-Timing`, and the incident webhook is timeout-bounded with the
+enriched payload.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import partition_case
+from microrank_tpu.config import (
+    ExplainConfig,
+    MicroRankConfig,
+    ServeConfig,
+    StreamConfig,
+)
+from microrank_tpu.explain import build_bundle, get_explain_store
+from microrank_tpu.explain.bundle import (
+    BUNDLE_JSON,
+    BUNDLE_TXT,
+    ExplainBundle,
+    ExplainContext,
+)
+from microrank_tpu.explain.oracle import explain_window_oracle
+from microrank_tpu.explain.store import ExplainStore
+from microrank_tpu.graph.build import PCSR_PART_TRACES, build_window_graph
+from microrank_tpu.obs import (
+    MetricsRegistry,
+    get_registry,
+    read_journal,
+    set_registry,
+)
+from microrank_tpu.parallel import (
+    make_mesh,
+    rank_windows_explained_sharded,
+    stack_window_graphs,
+)
+from microrank_tpu.rank_backends.blob import stage_rank_window
+from microrank_tpu.rank_backends.jax_tpu import device_subset
+from microrank_tpu.serve.protocol import (
+    parse_rank_request,
+    parse_traceparent,
+    server_timing_header,
+)
+from microrank_tpu.stream import (
+    IncidentTracker,
+    StreamEngine,
+    SyntheticSource,
+    WebhookIncidentSink,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+from microrank_tpu.utils.ranking_compare import tie_aware_topk_agreement
+
+CFG = MicroRankConfig()
+EXPLAIN = ExplainConfig(enabled=True, top_traces=5)
+KERNELS = ("coo", "csr", "packed", "pcsr")
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture(scope="module")
+def kind_case():
+    """Strong kind structure — collapse genuinely shrinks the axis, so
+    the collapsed parametrization exercises the retention map."""
+    return generate_case(
+        SyntheticConfig(n_operations=60, n_kinds=6, n_traces=400, seed=3)
+    )
+
+
+@pytest.fixture(scope="module")
+def builds(kind_case):
+    """(graph, names, ectx) per collapse mode + the uncollapsed oracle
+    inputs (graph, names, trace-id lists) the f64 twin recomputes on."""
+    nrm, abn = partition_case(kind_case)
+    out = {}
+    for collapse in ("off", "on"):
+        g, names, ids_n, ids_a, (mn, ma) = build_window_graph(
+            kind_case.abnormal, nrm, abn, aux="all", collapse=collapse,
+            retain_columns=True,
+        )
+        out[collapse] = (
+            g, names, ExplainContext.from_build(g, ids_n, ids_a, mn, ma)
+        )
+    g_un, names_u, idsn, idsa = build_window_graph(
+        kind_case.abnormal, nrm, abn, aux="all", collapse="off"
+    )
+    out["oracle_inputs"] = (g_un, names_u, idsn, idsa)
+    return out
+
+
+@pytest.fixture(scope="module")
+def oracles(builds):
+    g_un, names_u, idsn, idsa = builds["oracle_inputs"]
+    return {
+        collapse: explain_window_oracle(
+            g_un, names_u, idsn, idsa, CFG.pagerank, CFG.spectrum,
+            top_traces=None, aggregate_kinds=(collapse == "on"),
+        )
+        for collapse in ("off", "on")
+    }
+
+
+def _device_bundle(graph, names, ectx, kernel, blob=False, ex=EXPLAIN):
+    outs = jax.device_get(
+        stage_rank_window(
+            device_subset(graph, kernel), CFG.pagerank, CFG.spectrum,
+            kernel, blob, explain=ex,
+        )
+    )
+    assert len(outs) == 10  # the 5 traced-rank outputs + 5 attribution
+    return build_bundle(
+        outs, names, ectx, method=CFG.spectrum.method, kernel=kernel
+    )
+
+
+def _assert_bundle_matches_oracle(bundle, oracle, rtol=2e-5):
+    """The acceptance comparison: tie-aware suspect list, then
+    per-suspect counters/terms/mass/contributions against the f64
+    oracle (matched by op name, so legally permuted exact ties still
+    compare the right decompositions)."""
+    dev, orc = bundle.suspects, oracle["suspects"]
+    assert len(dev) == len(orc)
+    agree, reason = tie_aware_topk_agreement(
+        [s["op"] for s in dev], [s["score"] for s in dev],
+        [s["op"] for s in orc], [s["score"] for s in orc],
+        k=len(dev), rtol=1e-4, exempt_last=True,
+    )
+    assert agree, reason
+    by_op = {s["op"]: s for s in orc}
+    missing = [s["op"] for s in dev if s["op"] not in by_op]
+    assert len(missing) <= 1, missing  # only a cut-straddling near-tie
+    for s in dev:
+        o = by_op.get(s["op"])
+        if o is None:
+            continue
+        for c in ("ef", "nf", "ep", "np"):
+            assert np.isclose(
+                s["counters"][c], o["counters"][c], rtol=rtol
+            ), (s["op"], c, s["counters"][c], o["counters"][c])
+        for side in ("normal_weight", "abnormal_weight"):
+            assert np.isclose(
+                s["mass"][side], o["mass"][side], rtol=rtol, atol=1e-12
+            ), (s["op"], side)
+        for m, val in s["terms"].items():
+            assert np.isclose(
+                val, o["terms"][m], rtol=5e-4, atol=1e-9
+            ), (s["op"], m, val, o["terms"][m])
+        for p in ("normal", "abnormal"):
+            omap = dict(o["top_traces"][p])
+            entries = s["top_traces"][p]
+            for e in entries:
+                assert "trace" in e, (s["op"], p, e)  # ectx joined
+                assert e["trace"] in omap, (s["op"], p, e)
+                assert np.isclose(
+                    e["contribution"], omap[e["trace"]], rtol=5e-4
+                ), (s["op"], p, e["trace"])
+            if entries:
+                # Tie-aware top-J set: every oracle contributor that
+                # beats the device cut (beyond tie tolerance) is kept.
+                cut = min(e["contribution"] for e in entries)
+                kept = {e["trace"] for e in entries}
+                if len(entries) == len(
+                    [v for v in omap.values() if v > 0]
+                ):
+                    assert kept == {
+                        t for t, v in omap.items() if v > 0
+                    }, (s["op"], p)
+                else:
+                    beat = {
+                        t for t, v in omap.items()
+                        if v > cut * (1 + 1e-3)
+                    }
+                    assert beat <= kept, (s["op"], p, beat - kept)
+
+
+# ----------------------------------------------------- oracle parity
+
+
+@pytest.mark.parametrize("collapse", ["off", "on"])
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_explain_parity_oracle(builds, oracles, kernel, collapse):
+    graph, names, ectx = builds[collapse]
+    bundle = _device_bundle(graph, names, ectx, kernel)
+    _assert_bundle_matches_oracle(bundle, oracles[collapse])
+
+
+def test_explain_parity_blob_staging(builds, oracles):
+    """The blob-staged explained twin (unpack inside the program) pins
+    the same oracle — the codec carries every field the epilogue needs."""
+    graph, names, ectx = builds["on"]
+    bundle = _device_bundle(graph, names, ectx, "coo", blob=True)
+    _assert_bundle_matches_oracle(bundle, oracles["on"])
+
+
+def test_explain_top_suspects_truncates(builds):
+    graph, names, ectx = builds["off"]
+    ex = ExplainConfig(enabled=True, top_traces=3, top_suspects=2)
+    bundle = _device_bundle(graph, names, ectx, "coo", ex=ex)
+    assert len(bundle.suspects) == 2
+    for s in bundle.suspects:
+        for p in ("normal", "abnormal"):
+            assert len(s["top_traces"][p]) <= 3
+
+
+def test_explain_off_dispatches_plain_program(builds):
+    """The hot-path guarantee: explain=None or enabled=False dispatches
+    the UNCHANGED traced program (5-tuple), not the explained twin."""
+    graph, names, _ = builds["off"]
+    g = device_subset(graph, "coo")
+    plain = stage_rank_window(
+        g, CFG.pagerank, CFG.spectrum, "coo", False, conv_trace=True
+    )
+    assert len(plain) == 5
+    off = stage_rank_window(
+        g, CFG.pagerank, CFG.spectrum, "coo", False, conv_trace=True,
+        explain=ExplainConfig(enabled=False),
+    )
+    assert len(off) == 5
+    # And the first five explained outputs ARE the traced outputs.
+    exp = jax.device_get(
+        stage_rank_window(
+            g, CFG.pagerank, CFG.spectrum, "coo", False, explain=EXPLAIN
+        )
+    )
+    np.testing.assert_array_equal(
+        np.asarray(plain[0]), np.asarray(exp[0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(plain[1]), np.asarray(exp[1]), rtol=1e-6
+    )
+
+
+# ----------------------------------------------------- sharded path
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+@pytest.mark.parametrize(
+    "kernel,trace_multiple",
+    [("coo", 1), ("csr", 1), ("packed", 32), ("pcsr", PCSR_PART_TRACES * 4)],
+)
+def test_explained_sharded_matches_oracle(kernel, trace_multiple):
+    """The sharded epilogue (psum'd scatter partials / all-gathered
+    bitmap blocks) replicates the same attributions: every window of a
+    (2, 4)-mesh batch pins the f64 oracle like the single-device twin."""
+    cfg = MicroRankConfig()
+    windows = []
+    for seed in (1, 2, 3, 4):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        g, names, idsn, idsa, cmap = build_window_graph(
+            case.abnormal, nrm, abn, aux="all", retain_columns=True
+        )
+        ectx = ExplainContext.from_build(g, idsn, idsa, *cmap)
+        oracle = explain_window_oracle(
+            g, names, idsn, idsa, cfg.pagerank, cfg.spectrum,
+            top_traces=None,
+        )
+        windows.append((g, names, ectx, oracle))
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(
+        [g for g, _, _, _ in windows],
+        shard_multiple=4, trace_multiple=trace_multiple,
+    )
+    outs = jax.device_get(
+        rank_windows_explained_sharded(
+            jax.tree.map(jnp.asarray, stacked), cfg.pagerank,
+            cfg.spectrum, EXPLAIN, mesh, kernel,
+        )
+    )
+    assert len(outs) == 10
+    for b, (g, names, ectx, oracle) in enumerate(windows):
+        bundle = build_bundle(
+            tuple(o[b] for o in outs), names, ectx,
+            method=cfg.spectrum.method, kernel=kernel,
+        )
+        # Cross-shard psum reassociation wobbles the f32 partials a
+        # touch more than the single-device summation trees.
+        _assert_bundle_matches_oracle(bundle, oracle, rtol=5e-4)
+
+
+# ------------------------------------------------- bundle + store + API
+
+
+def test_bundle_roundtrip_table_and_journal_record(builds, tmp_path):
+    graph, names, ectx = builds["off"]
+    bundle = _device_bundle(graph, names, ectx, "coo")
+    bundle.data["window"] = {"start": "w0", "end": "w1"}
+    path = bundle.write(tmp_path / "b")
+    assert path.name == BUNDLE_JSON
+    assert (tmp_path / "b" / BUNDLE_TXT).exists()
+    loaded = ExplainBundle.load(path)
+    assert loaded.data == bundle.data
+    assert loaded.top1() == bundle.suspects[0]["op"]
+    table = loaded.to_table()
+    assert bundle.suspects[0]["op"] in table
+    assert "counters ef=" in table and "formulas" in table
+    rec = loaded.journal_record()
+    assert rec["top1"] == bundle.suspects[0]["op"]
+    assert rec["ef_top1"] == pytest.approx(
+        bundle.suspects[0]["counters"]["ef"]
+    )
+    assert rec["start"] == "w0" and rec["suspects"] == len(
+        bundle.suspects
+    )
+
+
+def test_explain_store_ring_evicts_oldest():
+    store = ExplainStore(capacity=2)
+    for i in range(3):
+        store.publish(f"w{i}", {"n": i})
+    assert store.windows() == ["w1", "w2"]
+    assert store.get("w0") is None
+    assert store.get("w1") == {"n": 1}
+    assert store.latest() == {"n": 2}
+    store.configure(capacity=1)
+    assert store.windows() == ["w2"]
+    # Republish moves to the back instead of duplicating.
+    store.publish("w2", {"n": 9})
+    assert len(store) == 1 and store.latest() == {"n": 9}
+
+
+def test_explainz_endpoint_serves_store(registry):
+    from microrank_tpu.obs.server import start_metrics_server
+
+    get_explain_store().publish("2020-01-01 00:00:00", {"schema": 1})
+    server = start_metrics_server(0, registry)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/explainz", timeout=30) as r:
+            listing = json.loads(r.read())
+        assert "2020-01-01 00:00:00" in listing["windows"]
+        assert listing["latest"]["schema"] == 1
+        with urllib.request.urlopen(
+            f"{base}/explainz?window=2020-01-01%2000:00:00", timeout=30
+        ) as r:
+            assert json.loads(r.read()) == {"schema": 1}
+        try:
+            urllib.request.urlopen(f"{base}/explainz?window=nope", timeout=30)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.close()
+
+
+# --------------------------------------------- serve protocol satellites
+
+
+def test_parse_traceparent():
+    tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+    assert parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+    assert parse_traceparent(f"  00-{tid.upper()}-{sid}-01 ") == (tid, sid)
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent(f"00-{tid}-{sid}") is None
+    assert parse_traceparent(f"00-{'0' * 32}-{sid}-01") is None
+    assert parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+    req = parse_rank_request(
+        json.dumps({"spans": [{"a": 1}], "explain": True}).encode(),
+        traceparent=f"00-{tid}-{sid}-01",
+    )
+    assert req.explain is True and req.traceparent == (tid, sid)
+
+
+def test_server_timing_header_renders_stage_timings():
+    hdr = server_timing_header(
+        {"parse_ms": 1.5, "detect_ms": 0.25, "total": 9, "rank_ms": 12.0}
+    )
+    assert hdr == "parse;dur=1.500, detect;dur=0.250, rank;dur=12.000"
+    assert server_timing_header({}) is None
+
+
+# ------------------------------------------------- webhook satellites
+
+
+def test_webhook_timeout_bounds_hung_endpoint():
+    """A wedged endpoint (accepts, never responds) costs at most the
+    explicit timeout — the engine-thread stall bound."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        sink = WebhookIncidentSink(
+            f"http://127.0.0.1:{srv.getsockname()[1]}/hook", timeout=0.5
+        )
+        t0 = time.monotonic()
+        sink.emit({"event": "incident_open"})
+        elapsed = time.monotonic() - t0
+        assert sink.failures == 1
+        assert elapsed < 5.0, elapsed
+    finally:
+        srv.close()
+
+
+def test_incident_open_payload_enriched(registry):
+    """The open event carries the tie-aware top-k suspects WITH scores
+    and the on_open hook's extras (the explain-bundle path); a failing
+    hook never blocks alerting."""
+    events = []
+    tracker = IncidentTracker(
+        top_k=2, sinks=[type("S", (), {"emit": lambda self, e: events.append(e)})()]
+    )
+    ranking = [("op-a", 1.0), ("op-b", 0.5), ("op-c", 0.1)]
+    inc = tracker.observe_ranked(
+        "w0", ranking, on_open=lambda i: {"explain_bundle": "/p/b.json"}
+    )
+    assert inc is not None
+    assert events[0]["event"] == "incident_open"
+    assert events[0]["suspects"] == [["op-a", 1.0], ["op-b", 0.5]]
+    assert events[0]["explain_bundle"] == "/p/b.json"
+    # Hook failure containment: the incident still opens, sans extras.
+    events.clear()
+    tracker2 = IncidentTracker(
+        top_k=2, sinks=[type("S", (), {"emit": lambda self, e: events.append(e)})()]
+    )
+    inc2 = tracker2.observe_ranked(
+        "w0", ranking, on_open=lambda i: 1 / 0
+    )
+    assert inc2 is not None and events[0]["event"] == "incident_open"
+    assert "explain_bundle" not in events[0]
+
+
+# -------------------------------------------------- stream end-to-end
+
+
+class _CaptureHook(BaseHTTPRequestHandler):
+    bodies = None
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        type(self).bodies.append(json.loads(self.rfile.read(n)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):  # noqa: D102 - quiet test output
+        pass
+
+
+def test_stream_incident_opens_with_explain_bundle(registry, tmp_path):
+    """Acceptance (stream): injected fault -> incident opens -> the
+    bundle lands under out_dir/explain/, next to the flight dump with
+    the manifest cross-link, mirrored into the journal (top-1/ef match
+    the ranked window), published to the /explainz store, and the
+    webhook open payload names suspects + the bundle path."""
+    bodies = []
+    _CaptureHook.bodies = bodies
+    hook = HTTPServer(("127.0.0.1", 0), _CaptureHook)
+    threading.Thread(target=hook.serve_forever, daemon=True).start()
+    src = SyntheticSource(
+        n_windows=8,
+        faulted=[3],
+        synth_config=SyntheticConfig(
+            n_operations=24, n_traces=200, n_kinds=16, seed=5
+        ),
+        pace_seconds=0.01,
+        sleep=lambda s: None,
+    )
+    cfg = MicroRankConfig(
+        stream=StreamConfig(
+            allowed_lateness_seconds=5.0,
+            webhook_url=f"http://127.0.0.1:{hook.server_port}/hook",
+            webhook_timeout_seconds=10.0,
+        ),
+        explain=ExplainConfig(enabled=True),
+    )
+    try:
+        eng = StreamEngine(cfg, src, out_dir=tmp_path)
+        s = eng.run()
+    finally:
+        hook.shutdown()
+        hook.server_close()
+    assert s.incidents_opened == 1
+    # Bundle on disk under out_dir/explain/<window-stem>/.
+    bundle_dirs = list((tmp_path / "explain").iterdir())
+    assert len(bundle_dirs) == 1
+    bundle = ExplainBundle.load(bundle_dirs[0] / BUNDLE_JSON)
+    assert bundle.data["trigger"] == "incident"
+    assert bundle.suspects and src.fault_pod_op in [
+        sus["op"] for sus in bundle.suspects[:5]
+    ]
+    for sus in bundle.suspects:
+        assert set(sus["counters"]) == {"ef", "nf", "ep", "np"}
+        assert len(sus["terms"]) == 13
+    # Journal mirror: explain event top-1/ef consistent with the ranked
+    # window event (the CI smoke's cross-check).
+    jev = read_journal(tmp_path / "journal.jsonl")
+    exp = [e for e in jev if e["event"] == "explain"]
+    assert len(exp) == 1
+    ranked = [
+        e for e in jev
+        if e["event"] == "window" and e.get("outcome") == "ranked"
+    ]
+    assert exp[0]["top1"] == ranked[0]["top1"]
+    assert exp[0]["ef_top1"] == pytest.approx(
+        bundle.suspects[0]["counters"]["ef"]
+    )
+    assert exp[0]["bundle"] == str(bundle_dirs[0] / BUNDLE_JSON)
+    # Next to the flight dump, cross-linked in its manifest.
+    dumps = [
+        d for d in (tmp_path / "flight").iterdir() if "incident" in d.name
+    ]
+    assert len(dumps) == 1
+    assert (dumps[0] / BUNDLE_JSON).exists()
+    manifest = json.loads((dumps[0] / "manifest.json").read_text())
+    assert manifest["explain_bundle"] == BUNDLE_JSON
+    # Store published (what /explainz serves).
+    stored = get_explain_store().get(str(ranked[0]["start"]))
+    assert stored is not None and stored["suspects"] == bundle.data[
+        "suspects"
+    ]
+    # Webhook open payload: suspects with scores + the bundle path.
+    opens = [b for b in bodies if b["event"] == "incident_open"]
+    assert len(opens) == 1
+    assert opens[0]["suspects"][0][0] == bundle.suspects[0]["op"]
+    assert opens[0]["explain_bundle"] == str(
+        bundle_dirs[0] / BUNDLE_JSON
+    )
+    assert (
+        registry.get("microrank_explain_bundles_total").value(
+            trigger="incident"
+        )
+        == 1
+    )
+
+
+def test_stream_explain_off_writes_nothing(registry, tmp_path):
+    src = SyntheticSource(
+        n_windows=6,
+        faulted=[2],
+        synth_config=SyntheticConfig(
+            n_operations=24, n_traces=200, n_kinds=16, seed=5
+        ),
+        pace_seconds=0.01,
+        sleep=lambda s: None,
+    )
+    cfg = MicroRankConfig(
+        stream=StreamConfig(allowed_lateness_seconds=5.0)
+    )
+    eng = StreamEngine(cfg, src, out_dir=tmp_path)
+    s = eng.run()
+    assert s.incidents_opened == 1
+    assert not (tmp_path / "explain").exists()
+    assert not [
+        e
+        for e in read_journal(tmp_path / "journal.jsonl")
+        if e["event"] == "explain"
+    ]
+
+
+# ----------------------------------------------------- cli explain
+
+
+def test_cli_explain_renders_run_artifacts(registry, tmp_path, capsys):
+    from microrank_tpu.cli.main import main
+
+    src = SyntheticSource(
+        n_windows=6,
+        faulted=[2],
+        synth_config=SyntheticConfig(
+            n_operations=24, n_traces=200, n_kinds=16, seed=5
+        ),
+        pace_seconds=0.01,
+        sleep=lambda s: None,
+    )
+    cfg = MicroRankConfig(
+        stream=StreamConfig(allowed_lateness_seconds=5.0),
+        explain=ExplainConfig(enabled=True),
+    )
+    eng = StreamEngine(cfg, src, out_dir=tmp_path)
+    eng.run()
+    bundle_dir = next((tmp_path / "explain").iterdir())
+    top1 = ExplainBundle.load(bundle_dir / BUNDLE_JSON).top1()
+    # Run output dir -> table rendering.
+    assert main(["explain", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "Rank provenance" in out and top1 in out
+    # Bundle dir and raw JSON formats; --json sidecar write.
+    sidecar = tmp_path / "picked.json"
+    assert (
+        main(
+            [
+                "explain", str(bundle_dir), "--format", "json",
+                "--json", str(sidecar),
+            ]
+        )
+        == 0
+    )
+    data = json.loads(capsys.readouterr().out)
+    assert data["suspects"][0]["op"] == top1
+    assert json.loads(sidecar.read_text()) == data
+    # Flight dump dir (the cross-linked copy) renders too.
+    dump = next(
+        d for d in (tmp_path / "flight").iterdir() if "incident" in d.name
+    )
+    assert main(["explain", str(dump)]) == 0
+    assert top1 in capsys.readouterr().out
+    # Window filter: hit and miss.
+    start = data["window"]["start"]
+    assert main(["explain", str(tmp_path), "--window", start]) == 0
+    capsys.readouterr()
+    assert main(["explain", str(tmp_path), "--window", "nope"]) == 2
+    assert main(["explain", str(tmp_path / "missing")]) == 2
+
+
+# -------------------------------------------------- serve end-to-end
+
+
+def _post_rank(port, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/rank",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def test_serve_explain_traceparent_server_timing(registry, tmp_path):
+    """POST /rank with explain:true returns the bundle inline; the
+    traceparent header joins the request trace to the caller's; every
+    200 carries Server-Timing stage durations. A request that did not
+    ask pays nothing (no explain field, one dispatch)."""
+    from microrank_tpu.obs.spans import get_tracer
+    from microrank_tpu.serve import ServeHandle, ServeService
+
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    cfg = MicroRankConfig(
+        serve=ServeConfig(warmup=False, max_wait_ms=2000.0)
+    )
+    svc = ServeService(cfg, out_dir=tmp_path)
+    svc.fit_baseline(case.normal)
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    df = case.abnormal.copy()
+    df["startTime"] = df["startTime"].astype(str)
+    df["endTime"] = df["endTime"].astype(str)
+    spans = df.to_dict("records")
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+    parent = "b7ad6b7169203331"
+    try:
+        status, body, headers = _post_rank(
+            port,
+            {"spans": spans, "explain": True, "request_id": "r-exp"},
+            headers={"traceparent": f"00-{trace_id}-{parent}-01"},
+        )
+        assert status == 200 and body["anomaly"] is True
+        exp = body["explain"]
+        assert exp["trigger"] == "request"
+        assert exp["window"]["request_id"] == "r-exp"
+        assert exp["suspects"][0]["op"] == body["ranking"][0][0]
+        assert set(exp["suspects"][0]["counters"]) == {
+            "ef", "nf", "ep", "np",
+        }
+        assert exp["suspects"][0]["top_traces"]["abnormal"]
+        timing = headers.get("Server-Timing", "")
+        for stage in ("parse", "detect", "rank"):
+            assert f"{stage};dur=" in timing, timing
+        # The explained request's spans joined the CALLER's trace.
+        spans_ring = [
+            s for s in get_tracer().snapshot()
+            if s.trace_id == trace_id
+        ]
+        names = {s.name for s in spans_ring}
+        assert "request" in names and "explain" in names
+        parents = {
+            s.parent_id for s in spans_ring if s.name == "request"
+        }
+        assert parents == {parent}
+        assert (
+            registry.get("microrank_explain_bundles_total").value(
+                trigger="request"
+            )
+            == 1
+        )
+        # Store published under the window start for /explainz.
+        assert get_explain_store().get(str(body["start"])) is not None
+        # No explain asked -> no bundle, nothing extra dispatched.
+        dispatches = svc.scheduler.batcher.dispatches
+        status2, body2, headers2 = _post_rank(port, {"spans": spans})
+        assert status2 == 200
+        assert body2.get("explain") is None
+        assert "Server-Timing" in headers2
+        assert svc.scheduler.batcher.dispatches == dispatches + 1
+    finally:
+        handle.stop()
