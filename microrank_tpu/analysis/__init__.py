@@ -14,7 +14,10 @@ in SURVEY.md §5):
   R2 dtype-drift   no float64 dtypes in jax-importing ranking modules
                    (the bf16/f32 device path must not silently upcast)
   R3 retrace       no jax.jit built per call without a cache; no Python
-                   branch on a traced value; no unhashable static args
+                   branch on a traced value; no unhashable static args;
+                   no raw host measurement (len()/int() of live data)
+                   flowing into a static argument or staged-array shape
+                   (the pad_policy="exact" one-trace-per-window hazard)
   R4 donation      no read of a buffer after it was passed in a donated
                    argument position
   R5 contracts     public rank/spectrum entry points carry @contract
@@ -25,6 +28,22 @@ in SURVEY.md §5):
                    samples/labels, or journal fields — telemetry sinks
                    are host values (a sync laundered through the
                    telemetry layer); record after the fetch
+  R8 device-ownership  no jax touch reachable from a non-owner thread
+                   class (Thread targets/subclasses, pool.submit
+                   workers, async handlers, sink callbacks) — one
+                   thread owns the device; roots opt in via
+                   claim_device_owner()/authorize_device_thread
+  R9 collective-order  inside shard_map-traced code, no psum/
+                   all_gather/ppermute under data-dependent control
+                   flow, and no call path reaching a collective-
+                   issuing kernel only under such a branch — every
+                   shard must issue the identical collective schedule
+
+R8/R9 are *static* claims about a concurrent system; their runtime
+twin is ``analysis.mrsan`` (armed by ``RuntimeConfig.sanitizers``):
+ownership asserted at every device seam, per-shard collective
+schedules recorded on the mesh and checked for uniformity. CI's
+mrsan-smoke job cross-validates the two models.
 
 Run it::
 

@@ -8,16 +8,19 @@ from typing import List
 def add_lint_parser(sub) -> None:
     p = sub.add_parser(
         "lint",
-        help="TPU-correctness static analysis (mrlint rules R1-R7)",
+        help="TPU-correctness static analysis (mrlint rules R1-R9)",
         description=(
             "AST lint of the repo's TPU invariants: host syncs inside "
             "jit graphs (R1), float64 drift on the bf16 ranking path "
-            "(R2), recompilation hazards (R3), donated-buffer reuse "
-            "(R4), missing shape/dtype contracts on rank/spectrum "
-            "entry points (R5), device_put inside traced code (R6), "
-            "traced arrays flowing into telemetry sinks (R7). "
-            "Suppress a finding in place with "
-            "`# mrlint: disable=RN(reason)` — the reason is mandatory."
+            "(R2), recompilation hazards incl. value->shape retraces "
+            "(R3), donated-buffer reuse (R4), missing shape/dtype "
+            "contracts on rank/spectrum entry points (R5), device_put "
+            "inside traced code (R6), traced arrays flowing into "
+            "telemetry sinks (R7), jax touches reachable from non-"
+            "owner threads (R8), data-dependent collective schedules "
+            "inside shard_map-traced code (R9). Suppress a finding in "
+            "place with `# mrlint: disable=RN(reason)` — the reason "
+            "is mandatory."
         ),
     )
     p.add_argument(
@@ -32,6 +35,15 @@ def add_lint_parser(sub) -> None:
     )
     p.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.add_argument(
+        "--sarif",
+        metavar="PATH",
+        help=(
+            "also write the findings as SARIF 2.1.0 (GitHub code "
+            "scanning uploads annotate PRs from it); exit status is "
+            "unchanged"
+        ),
     )
     p.set_defaults(fn=cmd_lint)
 
@@ -54,6 +66,11 @@ def cmd_lint(args) -> int:
     violations = lint_paths(args.paths, rules=rules)
     for v in violations:
         print(v.format())
+    if args.sarif:
+        from .sarif import write_sarif
+
+        out = write_sarif(violations, args.sarif)
+        print(f"sarif: {out}")
     n = len(violations)
     if n:
         print(f"mrlint: {n} finding{'s' if n != 1 else ''}")

@@ -148,6 +148,7 @@ class Project:
     def __init__(self, modules: List[ModuleInfo]):
         self.modules = modules
         self._traced = None
+        self._threads = None
 
     @property
     def traced(self):
@@ -158,6 +159,16 @@ class Project:
 
             self._traced = TracedAnalysis(self)
         return self._traced
+
+    @property
+    def threads(self):
+        """The cross-thread concurrency analysis
+        (analysis.threads.ThreadAnalysis), computed once per project."""
+        if self._threads is None:
+            from .threads import ThreadAnalysis
+
+            self._threads = ThreadAnalysis(self)
+        return self._threads
 
     def module_for(self, path: Path) -> Optional[ModuleInfo]:
         for m in self.modules:

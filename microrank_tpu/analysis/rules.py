@@ -1,4 +1,4 @@
-"""The mrlint rule set (R1-R7). See analysis/__init__ for the catalog.
+"""The mrlint rule set (R1-R9). See analysis/__init__ for the catalog.
 
 Each rule is intentionally heuristic — it encodes THIS repo's TPU
 invariants, not general Python semantics — and every finding can be
@@ -113,6 +113,14 @@ class RetraceRule(Rule):
     tracing) — from the same taint analysis as R1.
     (c) a list/dict/set literal passed in a static position of a known
     jit wrapper is unhashable and fails cache lookup.
+    (d) value->shape dataflow: a host measurement (``len()``/``int()``/
+    ``float()`` of live data) flowing into a STATIC argument of a known
+    jit wrapper, or into the shape of an array the wrapper is called
+    with, keys the jit cache on the data itself — under
+    ``pad_policy="exact"`` every distinct window retraces. Routing the
+    measurement through a bucketing helper (``pad*``/``bucket*``/
+    ``round*``/``pow2*``/``align*``) makes it shape-stable and breaks
+    the flow.
     """
 
     name = "R3"
@@ -125,6 +133,7 @@ class RetraceRule(Rule):
             if ev.kind == "tracer-branch" and ev.module is module:
                 yield _v(module, ev, self.name, ev.message)
         yield from self._unhashable_static(module, project)
+        yield from self._value_shape(module, project)
 
     def _jit_in_body(self, module: ModuleInfo, project: Project):
         class _Walker(ast.NodeVisitor):
@@ -214,6 +223,129 @@ class RetraceRule(Rule):
                         "hashable; pass a tuple (or mark the arg "
                         "non-static)",
                     )
+
+    # Value->shape dataflow (the pad_policy="exact" retrace gap): local
+    # measurements of live data and the array constructors they shape.
+    _MEASURES = {"len", "int", "float"}
+    _ARRAY_CTORS = {"zeros", "ones", "empty", "full", "arange"}
+    _BUCKET_HINTS = ("pad", "bucket", "pow2", "round", "align", "next_")
+
+    def _value_shape(self, module: ModuleInfo, project: Project):
+        analysis = project.traced
+        wrappers = {
+            (id(w.module), w.bound_name): w
+            for w in analysis.wrappers
+            if w.bound_name
+        }
+        if not wrappers:
+            return
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            measures: set = set()       # locals holding a raw measurement
+            exact_shaped: set = set()   # locals whose SHAPE is a measurement
+
+            def is_measure(expr) -> bool:
+                if isinstance(expr, ast.Name):
+                    return expr.id in measures
+                if isinstance(expr, ast.Call):
+                    name = None
+                    if isinstance(expr.func, ast.Name):
+                        name = expr.func.id
+                    elif isinstance(expr.func, ast.Attribute):
+                        name = expr.func.attr
+                    if name and any(
+                        h in name.lower() for h in self._BUCKET_HINTS
+                    ):
+                        return False  # bucketed -> shape-stable
+                    if (
+                        name in self._MEASURES
+                        and expr.args
+                        and not isinstance(expr.args[0], ast.Constant)
+                    ):
+                        return True
+                    return any(is_measure(a) for a in expr.args) or any(
+                        is_measure(k.value) for k in expr.keywords
+                    )
+                if isinstance(expr, ast.BinOp):
+                    return is_measure(expr.left) or is_measure(expr.right)
+                if isinstance(expr, ast.UnaryOp):
+                    return is_measure(expr.operand)
+                if isinstance(expr, (ast.Tuple, ast.List)):
+                    return any(is_measure(e) for e in expr.elts)
+                return False
+
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    tgt = stmt.targets[0]
+                    if isinstance(tgt, ast.Name):
+                        if is_measure(stmt.value):
+                            measures.add(tgt.id)
+                        else:
+                            measures.discard(tgt.id)
+                        shaped = (
+                            isinstance(stmt.value, ast.Call)
+                            and isinstance(stmt.value.func, ast.Attribute)
+                            and stmt.value.func.attr in self._ARRAY_CTORS
+                            and (
+                                any(
+                                    is_measure(a) for a in stmt.value.args
+                                )
+                                or any(
+                                    is_measure(k.value)
+                                    for k in stmt.value.keywords
+                                )
+                            )
+                        )
+                        if shaped:
+                            exact_shaped.add(tgt.id)
+                        else:
+                            exact_shaped.discard(tgt.id)
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                ):
+                    continue
+                w = wrappers.get((id(module), node.func.id))
+                if w is None:
+                    continue
+                params = w.target.params if w.target is not None else ()
+                for i, arg in enumerate(node.args):
+                    static = i in w.static_argnums or (
+                        i < len(params)
+                        and params[i] in w.static_argnames
+                    )
+                    if static and is_measure(arg):
+                        yield _v(
+                            module,
+                            arg,
+                            self.name,
+                            f"value-derived host scalar in static "
+                            f"position {i} of jit wrapper "
+                            f"`{node.func.id}` — the jit cache keys on "
+                            "the data itself (one retrace per distinct "
+                            "window under pad_policy=\"exact\"); bucket "
+                            "the measurement (pad_extent/pow2) before "
+                            "it reaches a static argument",
+                        )
+                    elif (
+                        isinstance(arg, ast.Name)
+                        and arg.id in exact_shaped
+                    ):
+                        yield _v(
+                            module,
+                            arg,
+                            self.name,
+                            f"`{arg.id}` is shaped by a raw host "
+                            f"measurement and passed to jit wrapper "
+                            f"`{node.func.id}` — its SHAPE keys the jit "
+                            "cache, so every distinct window retraces "
+                            "(the pad_policy=\"exact\" hazard); pad the "
+                            "extent through a bucketing helper "
+                            "(pad*/pow2*/round*) before building the "
+                            "array",
+                        )
 
 
 @register
@@ -385,6 +517,69 @@ class TelemetryTaintRule(Rule):
     def check(self, module: ModuleInfo, project: Project):
         for ev in project.traced.events:
             if ev.kind == "telemetry-taint" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class DeviceOwnershipRule(Rule):
+    """R8: device touches stay on the device-owner thread.
+
+    The pipeline is a three-thread system (serve scheduler, build
+    worker pool, stream engine) sharing one device; jax dispatch is
+    only program-ordered when a single thread issues it. The cross-
+    thread analysis (analysis.threads.ThreadAnalysis) classifies every
+    function by executing thread — ``threading.Thread`` subclasses and
+    targets, ``pool.submit``/``executor.submit`` callables (through
+    ``functools.partial`` and bound methods), ``async def`` event-loop
+    handlers, incident-sink callbacks — and fires on any jax-touching
+    call (jnp/lax/device_put/device_get, a known jit wrapper, or a
+    staging seam like ``stage_rank_window``/``stage_sharded``/
+    ``rank_batch``) reachable from a non-owner thread class. A thread
+    root becomes an owner by calling ``claim_device_owner()``
+    (utils.guards — the runtime mrsan twin asserts the same model), and
+    an executor's workers by ``initializer=authorize_device_thread``.
+    """
+
+    name = "R8"
+    slug = "device-ownership"
+    summary = "jax touch reachable from a non-owner thread"
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.threads.events:
+            if ev.kind == "cross-thread-device" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class CollectiveOrderRule(Rule):
+    """R9: uniform collective schedules inside shard_map-traced code.
+
+    Under SPMD every shard must issue the same psum/all_gather/ppermute
+    sequence in the same order — a shard that skips one deadlocks the
+    mesh (or silently corrupts the combine under single-controller
+    emulation). Fires when, inside a ``shard_map``-traced call graph, a
+    collective is issued under data-dependent control flow (a Python
+    ``if``/``while``/``for`` on a traced value), or a call path only
+    reaches a collective-issuing kernel under such a branch (two call
+    paths to the same kernel with divergent collective sequences).
+    Trace-static predicates (config flags, kernel names) are exempt:
+    every shard traces the same branch. The runtime half of this
+    contract is mrsan's per-shard collective-schedule recording
+    (analysis.mrsan) on the CPU mesh.
+    """
+
+    name = "R9"
+    slug = "collective-order"
+    summary = (
+        "data-dependent collective schedule inside shard_map-traced code"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.threads.events:
+            if (
+                ev.kind in ("collective-data-dep", "collective-divergent-path")
+                and ev.module is module
+            ):
                 yield _v(module, ev, self.name, ev.message)
 
 

@@ -1,0 +1,104 @@
+"""SARIF 2.1.0 rendering of mrlint findings.
+
+``cli lint --sarif out.sarif`` writes the run in the Static Analysis
+Results Interchange Format so GitHub code scanning (and any SARIF
+viewer) annotates PR diffs with the findings in place. One run, one
+tool (``mrlint``), one result per violation; the rule catalog rides
+along as ``tool.driver.rules`` so the UI shows slug + summary next to
+each annotation. R0 (unjustified disable) is reported at ``warning``
+level — it marks a missing audit trail, not a device hazard; every
+numbered rule is ``error``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, List
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _rule_meta(rule) -> dict:
+    return {
+        "id": rule.name,
+        "name": rule.slug,
+        "shortDescription": {"text": rule.summary},
+        "defaultConfiguration": {"level": "error"},
+    }
+
+
+def to_sarif(violations: Iterable["Violation"]) -> dict:  # noqa: F821
+    """Render violations as one SARIF run. The rule index includes every
+    registered rule plus R0 (which has no Rule class — the framework
+    emits it for unjustified disables)."""
+    from .core import RULES
+
+    rules: List[dict] = [
+        {
+            "id": "R0",
+            "name": "bare-disable",
+            "shortDescription": {
+                "text": "mrlint disable pragma without a justification"
+            },
+            "defaultConfiguration": {"level": "warning"},
+        }
+    ]
+    rules.extend(
+        _rule_meta(r) for r in sorted(RULES.values(), key=lambda r: r.name)
+    )
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for v in violations:
+        results.append(
+            {
+                "ruleId": v.rule,
+                "ruleIndex": index.get(v.rule, -1),
+                "level": "warning" if v.rule == "R0" else "error",
+                "message": {"text": v.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": str(v.path).replace("\\", "/"),
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {
+                                "startLine": max(1, v.line),
+                                # SARIF columns are 1-based; ast's are 0.
+                                "startColumn": v.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "mrlint",
+                        "informationUri": (
+                            "https://github.com/microrank-tpu/microrank-tpu"
+                        ),
+                        "rules": rules,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
+
+
+def write_sarif(violations, path) -> Path:
+    out = Path(path)
+    out.write_text(json.dumps(to_sarif(violations), indent=2) + "\n")
+    return out

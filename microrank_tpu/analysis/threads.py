@@ -1,0 +1,904 @@
+"""Cross-thread concurrency analysis: which thread executes each
+function, and what the collective schedule looks like inside
+``shard_map``-traced code.
+
+The pipeline is a three-thread system sharing one device: the serve
+scheduler (``BatchScheduler.run``), the build worker pool
+(``stream.pool.BuildWorkerPool``), and the stream engine / table lane
+(whatever thread drives ``run()``). The device-ownership rule — every
+jax dispatch happens on exactly one thread, the program-order guarantee
+collectives need — was documented prose until this analysis. It builds
+an interprocedural call graph over the linted module set and classifies
+each function by the thread class that can execute it:
+
+* ``threading.Thread`` subclasses: the ``run`` method roots a thread
+  named after the class; it is a device OWNER iff its body calls
+  ``claim_device_owner`` (utils.guards) — the runtime mrsan twin of
+  this static model.
+* ``threading.Thread(target=f)``: ``f`` roots a thread (owner iff it
+  claims).
+* ``pool.submit(f, ...)`` / ``executor.submit(f, ...)``: ``f`` runs on
+  a POOL WORKER — never a device owner, unless the executor was
+  constructed with ``initializer=authorize_device_thread`` (the table
+  lane's sanctioned async staging/fetch workers, RuntimeConfig.
+  async_dispatch). ``functools.partial(f, ...)`` and bound-method
+  targets resolve through to ``f``.
+* ``async def`` functions: the asyncio event-loop (HTTP handler)
+  thread — never a device owner.
+* ``*Sink.emit`` methods: incident-sink callbacks — they run inside
+  the dispatch lifecycle (and may be retried from helper threads) and
+  must stay host-only.
+
+R8 fires on any device-touching call — ``jax.numpy``/``jax.lax``/
+``jax.device_put``/``device_get``, a known jit-wrapper call, or one of
+the staging seams (``stage_rank_window``, ``stage_sharded``,
+``rank_batch``, compile-cache warmers) — reachable from a non-owner
+root. ``jax.tree``/``jax.profiler``/``jax.config`` are exempt: host
+utilities that never dispatch.
+
+R9 (collective order) analyzes ``shard_map`` call sites: the wrapped
+kernel and everything it reaches is SPMD code whose per-iteration
+psum/all_gather schedule must be identical on every shard. A collective
+issued under data-dependent control flow (a Python ``if``/``while`` on
+a traced value), or a call path that only reaches a collective-issuing
+kernel under such a branch, lets shards fall out of the schedule —
+deadlock on a real mesh, silent wrong answers with single-controller
+emulation. Taint comes from the same forward walk R1 uses, seeded from
+the shard_map operands and propagated through the call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .traced import Event, FuncDef, _TaintWalker, _identity_test
+
+# Device-touching call prefixes (dotted, resolved through import
+# aliases). jax.tree/jax.profiler/jax.config and friends are host-side
+# utilities — never a dispatch — and are exempted.
+_DEVICE_PREFIXES = (
+    "jax.numpy",
+    "jax.lax",
+    "jax.device_put",
+    "jax.device_get",
+    "jax.block_until_ready",
+    "jax.jit",
+    "jax.pjit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.make_array_from_callback",
+    "jax.make_array_from_single_device_arrays",
+    "jax.experimental",
+)
+_EXEMPT_PREFIXES = (
+    "jax.tree",
+    "jax.profiler",
+    "jax.config",
+    "jax.dtypes",
+    "jax.debug",
+    "jax.typing",
+    "jax.experimental.compilation_cache",
+)
+# Cross-module device seams: the staging/dispatch entry points every
+# caller funnels through. Flagged by NAME so a per-subsystem lint run
+# (e.g. `cli lint microrank_tpu/serve/`) still sees the touch even when
+# the defining module is outside the linted set.
+_DEVICE_SEAMS = {
+    "stage_rank_window",
+    "stage_windows_batched",
+    "dispatch_windows_staged",
+    "stage_sharded",
+    "warm_occupancies",
+    "rank_batch",
+}
+_OWNER_CLAIMS = {"claim_device_owner"}
+_AUTHORIZE_INITIALIZERS = {"authorize_device_thread"}
+_EXECUTOR_CTORS = {
+    "ThreadPoolExecutor",
+    "ProcessPoolExecutor",
+    "BuildWorkerPool",
+}
+# Mesh collectives whose per-shard issue order IS the program contract.
+_COLLECTIVES = {
+    "jax.lax.psum",
+    "jax.lax.pmean",
+    "jax.lax.pmax",
+    "jax.lax.pmin",
+    "jax.lax.all_gather",
+    "jax.lax.ppermute",
+    "jax.lax.pshuffle",
+    "jax.lax.psum_scatter",
+    "jax.lax.all_to_all",
+}
+_SHARD_MAP_NAMES = {
+    "shard_map",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+
+
+@dataclass
+class FuncInfo:
+    """One function or method in the linted set."""
+
+    module: object                   # core.ModuleInfo
+    node: ast.FunctionDef
+    name: str
+    cls: Optional[str] = None        # enclosing class name, methods only
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+@dataclass
+class ThreadRoot:
+    """One place a thread class starts executing project code."""
+
+    func: FuncInfo
+    label: str                       # thread-class label for messages
+    owner: bool                      # may touch the device
+    reason: str                      # how the root was derived
+    line: int = 0
+
+
+def _call_name(func_node) -> Optional[str]:
+    """Trailing identifier of a call target (``x.y.z`` -> ``z``)."""
+    if isinstance(func_node, ast.Name):
+        return func_node.id
+    if isinstance(func_node, ast.Attribute):
+        return func_node.attr
+    return None
+
+
+class ThreadAnalysis:
+    """Interprocedural thread classification + collective-order model.
+
+    Exposes ``events`` — kinds ``cross-thread-device`` (R8),
+    ``collective-data-dep`` and ``collective-divergent-path`` (R9) —
+    plus the root/classification tables the tests introspect.
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self.traced = project.traced
+        self.funcs: List[FuncInfo] = []
+        self._module_level: Dict[Tuple[int, str], FuncInfo] = {}
+        self._methods_by_name: Dict[str, List[FuncInfo]] = {}
+        self._class_methods: Dict[Tuple[int, str], Dict[str, FuncInfo]] = {}
+        self._attr_types: Dict[Tuple[int, str], Dict[str, str]] = {}
+        self._local_types_cache: Dict[int, Dict[str, str]] = {}
+        self.edges: Dict[int, Set[int]] = {}      # id(FuncInfo) -> callees
+        self._by_id: Dict[int, FuncInfo] = {}
+        self.roots: List[ThreadRoot] = []
+        self.events: List[Event] = []
+        self._index()
+        self._build_edges()
+        self._find_roots()
+        self._collect_device_events()
+        self._collect_collective_events()
+
+    # ------------------------------------------------------------ indexing
+
+    def _index(self) -> None:
+        for mod in self.project.modules:
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(module=mod, node=node, name=node.name)
+                    self.funcs.append(fi)
+                    self._module_level[(id(mod), node.name)] = fi
+                elif isinstance(node, ast.ClassDef):
+                    table: Dict[str, FuncInfo] = {}
+                    for item in node.body:
+                        if isinstance(
+                            item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fi = FuncInfo(
+                                module=mod, node=item, name=item.name,
+                                cls=node.name,
+                            )
+                            self.funcs.append(fi)
+                            table[item.name] = fi
+                            self._methods_by_name.setdefault(
+                                item.name, []
+                            ).append(fi)
+                    self._class_methods[(id(mod), node.name)] = table
+                    self._attr_types[(id(mod), node.name)] = (
+                        self._scan_attr_types(table)
+                    )
+        for fi in self.funcs:
+            self._by_id[id(fi)] = fi
+
+    @staticmethod
+    def _scan_attr_types(methods: Dict[str, FuncInfo]) -> Dict[str, str]:
+        """``self.X = ClassName(...)`` assignments anywhere in the class:
+        attr name -> constructing callable's trailing name."""
+        types: Dict[str, str] = {}
+        for fi in methods.values():
+            for node in ast.walk(fi.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                ctor = _call_name(node.value.func)
+                if ctor is None:
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        types[tgt.attr] = ctor
+        return types
+
+    def _local_types(self, fi: FuncInfo) -> Dict[str, str]:
+        """``x = ClassName(...)`` locals of one function body."""
+        cached = self._local_types_cache.get(id(fi))
+        if cached is not None:
+            return cached
+        types: Dict[str, str] = {}
+        for node in ast.walk(fi.node):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            ctor = _call_name(node.value.func)
+            if ctor:
+                types[node.targets[0].id] = ctor
+        self._local_types_cache[id(fi)] = types
+        return types
+
+    # ---------------------------------------------------------- resolution
+
+    def resolve_callable(
+        self, fi: FuncInfo, node
+    ) -> Optional[FuncInfo]:
+        """Resolve a callable expression at a call/submit site to a
+        project function: bare names (incl. relative imports),
+        ``self.method``, bound methods of typed locals/attrs,
+        unique-name methods, and ``functools.partial(f, ...)``."""
+        mod = fi.module
+        if isinstance(node, ast.Call):
+            # functools.partial(f, ...) — unwrap to f.
+            dotted = mod.dotted(node.func)
+            if (
+                dotted == "functools.partial"
+                or _call_name(node.func) == "partial"
+            ) and node.args:
+                return self.resolve_callable(fi, node.args[0])
+            return None
+        if isinstance(node, ast.Name):
+            fd = self.traced.resolve(mod, node.id)
+            if fd is not None:
+                found = self._module_level.get((id(fd.module), fd.name))
+                if found is not None:
+                    return found
+            return None
+        if isinstance(node, ast.Attribute):
+            # self.method — same class first.
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and fi.cls is not None
+            ):
+                table = self._class_methods.get((id(mod), fi.cls), {})
+                if node.attr in table:
+                    return table[node.attr]
+            # obj.method with a typed receiver (local or self-attr).
+            recv_cls = self._receiver_class(fi, node.value)
+            if recv_cls is not None:
+                for key, table in self._class_methods.items():
+                    if key[1] == recv_cls and node.attr in table:
+                        return table[node.attr]
+            # Unique-name fallback: exactly one method in the whole
+            # project bears the name and no module-level def shadows it.
+            candidates = self._methods_by_name.get(node.attr, [])
+            module_defs = [
+                f
+                for (mid, name), f in self._module_level.items()
+                if name == node.attr
+            ]
+            if len(candidates) == 1 and not module_defs:
+                return candidates[0]
+        return None
+
+    def _receiver_class(self, fi: FuncInfo, node) -> Optional[str]:
+        """Class name of a receiver expression, when statically known."""
+        if isinstance(node, ast.Name):
+            return self._local_types(fi).get(node.id)
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and fi.cls is not None
+        ):
+            mod = fi.module
+            return self._attr_types.get((id(mod), fi.cls), {}).get(node.attr)
+        return None
+
+    # ---------------------------------------------------------- call graph
+
+    def _build_edges(self) -> None:
+        for fi in self.funcs:
+            out = self.edges.setdefault(id(fi), set())
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_callable(fi, node.func)
+                if target is not None and target is not fi:
+                    out.add(id(target))
+
+    def reachable(self, fi: FuncInfo) -> List[FuncInfo]:
+        seen = {id(fi)}
+        stack = [id(fi)]
+        while stack:
+            cur = stack.pop()
+            for nxt in self.edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return [self._by_id[i] for i in seen]
+
+    # -------------------------------------------------------------- roots
+
+    def _claims_owner(self, fi: FuncInfo) -> bool:
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                if _call_name(node.func) in _OWNER_CLAIMS:
+                    return True
+        return False
+
+    def _is_thread_base(self, mod, base) -> bool:
+        dotted = mod.dotted(base)
+        if dotted == "threading.Thread":
+            return True
+        return isinstance(base, ast.Name) and base.id == "Thread"
+
+    def _executor_authorized(self, fi: FuncInfo, recv) -> Optional[bool]:
+        """For ``recv.submit(fn)``: was ``recv`` constructed as an
+        executor, and with ``initializer=authorize_device_thread``?
+        Returns None when the receiver's construction is unknown."""
+        ctor_call = None
+        if isinstance(recv, ast.Name):
+            # Local: find `recv = Executor(...)` in this function.
+            for node in ast.walk(fi.node):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == recv.id
+                    and isinstance(node.value, ast.Call)
+                ):
+                    ctor_call = node.value
+        elif (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and fi.cls is not None
+        ):
+            for m in self._class_methods.get(
+                (id(fi.module), fi.cls), {}
+            ).values():
+                for node in ast.walk(m.node):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and any(
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr == recv.attr
+                            for t in node.targets
+                        )
+                    ):
+                        ctor_call = node.value
+        if ctor_call is None:
+            if isinstance(recv, ast.Name):
+                return self._param_authorized(fi, recv.id)
+            return None
+        if _call_name(ctor_call.func) not in _EXECUTOR_CTORS:
+            return None
+        for kw in ctor_call.keywords:
+            if kw.arg == "initializer" and (
+                _call_name(kw.value) in _AUTHORIZE_INITIALIZERS
+                or (
+                    isinstance(kw.value, ast.Name)
+                    and kw.value.id in _AUTHORIZE_INITIALIZERS
+                )
+            ):
+                return True
+        return False
+
+    def _param_authorized(self, fi: FuncInfo, name: str) -> Optional[bool]:
+        """Executor received as a PARAMETER of ``fi``: resolve its
+        construction through the callers — find same-class/module calls
+        to ``fi`` and evaluate the argument bound to ``name`` in each
+        caller's scope. Returns the verdict when every resolving call
+        site agrees; None when no call site resolves."""
+        params = [
+            a.arg
+            for a in fi.node.args.posonlyargs + fi.node.args.args
+        ]
+        if name not in params:
+            return None
+        idx = params.index(name)
+        verdicts: List[bool] = []
+        for caller in self.funcs:
+            if caller.module is not fi.module or caller is fi:
+                continue
+            for node in ast.walk(caller.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                hits = (
+                    isinstance(f, ast.Name) and f.id == fi.name
+                ) or (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == fi.name
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                    and caller.cls == fi.cls
+                )
+                if not hits:
+                    continue
+                # Bound-method calls drop the leading `self`.
+                pos = idx - 1 if (fi.cls and params[0] == "self") else idx
+                arg = None
+                for kw in node.keywords:
+                    if kw.arg == name:
+                        arg = kw.value
+                if arg is None and 0 <= pos < len(node.args):
+                    arg = node.args[pos]
+                if arg is None:
+                    continue
+                verdict = self._executor_authorized(caller, arg)
+                if verdict is not None:
+                    verdicts.append(verdict)
+        if verdicts:
+            return all(verdicts)
+        return None
+
+    def _add_root(self, fi, label, owner, reason, line) -> None:
+        self.roots.append(
+            ThreadRoot(
+                func=fi, label=label, owner=owner, reason=reason, line=line
+            )
+        )
+
+    def _find_roots(self) -> None:
+        for mod in self.project.modules:
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._class_roots(mod, node)
+            for fi in self.funcs:
+                if fi.module is not mod:
+                    continue
+                if fi.is_async:
+                    self._add_root(
+                        fi,
+                        "async-handler",
+                        self._claims_owner(fi),
+                        "async def (event-loop thread)",
+                        fi.node.lineno,
+                    )
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    self._call_roots(mod, node)
+
+    def _class_roots(self, mod, cls: ast.ClassDef) -> None:
+        table = self._class_methods.get((id(mod), cls.name), {})
+        if any(self._is_thread_base(mod, b) for b in cls.bases):
+            run = table.get("run")
+            if run is not None:
+                self._add_root(
+                    run,
+                    cls.name,
+                    self._claims_owner(run),
+                    f"threading.Thread subclass `{cls.name}`",
+                    run.node.lineno,
+                )
+        if cls.name.endswith("Sink") and "emit" in table:
+            emit = table["emit"]
+            self._add_root(
+                emit,
+                "sink-callback",
+                self._claims_owner(emit),
+                f"incident sink `{cls.name}.emit`",
+                emit.node.lineno,
+            )
+
+    def _enclosing_func(self, mod, call: ast.Call) -> Optional[FuncInfo]:
+        best = None
+        for fi in self.funcs:
+            if fi.module is not mod:
+                continue
+            if (
+                fi.node.lineno <= call.lineno
+                and call.lineno <= max(
+                    (n.lineno for n in ast.walk(fi.node) if hasattr(n, "lineno")),
+                    default=fi.node.lineno,
+                )
+            ):
+                if best is None or fi.node.lineno > best.node.lineno:
+                    best = fi
+        return best
+
+    def _call_roots(self, mod, call: ast.Call) -> None:
+        enclosing = self._enclosing_func(mod, call)
+        scope = enclosing or FuncInfo(module=mod, node=mod.tree, name="<module>")
+        dotted = mod.dotted(call.func)
+        # threading.Thread(target=f)
+        if dotted == "threading.Thread" or (
+            isinstance(call.func, ast.Name) and call.func.id == "Thread"
+        ):
+            target = next(
+                (k.value for k in call.keywords if k.arg == "target"), None
+            )
+            name = next(
+                (
+                    k.value.value
+                    for k in call.keywords
+                    if k.arg == "name"
+                    and isinstance(k.value, ast.Constant)
+                ),
+                None,
+            )
+            if target is not None:
+                fi = self.resolve_callable(scope, target)
+                if fi is not None:
+                    self._add_root(
+                        fi,
+                        name or "thread-target",
+                        self._claims_owner(fi),
+                        "threading.Thread target",
+                        call.lineno,
+                    )
+            return
+        # pool.submit(f, ...) / executor.submit(f, ...)
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "submit"
+            and call.args
+        ):
+            fi = self.resolve_callable(scope, call.args[0])
+            if fi is None:
+                return
+            authorized = self._executor_authorized(scope, call.func.value)
+            if authorized:
+                self._add_root(
+                    fi,
+                    "authorized-worker",
+                    True,
+                    "executor with initializer=authorize_device_thread",
+                    call.lineno,
+                )
+            else:
+                self._add_root(
+                    fi,
+                    "pool-worker",
+                    self._claims_owner(fi),
+                    "submitted to a worker pool",
+                    call.lineno,
+                )
+            return
+        # fut.add_done_callback(f): the callback runs on the worker that
+        # completed the future.
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "add_done_callback"
+            and call.args
+        ):
+            fi = self.resolve_callable(scope, call.args[0])
+            if fi is not None:
+                self._add_root(
+                    fi,
+                    "pool-worker",
+                    self._claims_owner(fi),
+                    "future done-callback (runs on the completing worker)",
+                    call.lineno,
+                )
+
+    # --------------------------------------------------------- R8 events
+
+    def _device_touches(self, fi: FuncInfo) -> List[Tuple[ast.Call, str]]:
+        mod = fi.module
+        touches: List[Tuple[ast.Call, str]] = []
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func)
+            if dotted is not None:
+                if dotted.startswith(_EXEMPT_PREFIXES):
+                    continue
+                if dotted == "jax" or dotted.startswith(_DEVICE_PREFIXES):
+                    touches.append((node, f"`{dotted}`"))
+                    continue
+            name = _call_name(node.func)
+            if name is None:
+                continue
+            if (id(mod), name) in {
+                (id(w.module), w.bound_name)
+                for w in self.traced.wrappers
+                if w.bound_name
+            }:
+                touches.append((node, f"jit wrapper `{name}`"))
+            elif name in _DEVICE_SEAMS:
+                touches.append((node, f"device seam `{name}()`"))
+        return touches
+
+    def _collect_device_events(self) -> None:
+        seen = set()
+        for root in self.roots:
+            if root.owner:
+                continue
+            for fi in self.reachable(root.func):
+                for call, desc in self._device_touches(fi):
+                    key = (id(fi.module), call.lineno, call.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = (
+                        ""
+                        if fi is root.func
+                        else f" (reached via `{root.func.qualname}`)"
+                    )
+                    self.events.append(
+                        Event(
+                            kind="cross-thread-device",
+                            module=fi.module,
+                            line=call.lineno,
+                            col=call.col_offset,
+                            message=(
+                                f"{desc} in `{fi.qualname}` is reachable "
+                                f"from the non-owner thread class "
+                                f"`{root.label}` ({root.reason}, line "
+                                f"{root.line}){via} — only the device-"
+                                "owner thread may stage/dispatch/fetch "
+                                "(one-thread-owns-the-device program-"
+                                "order rule); move the device touch to "
+                                "the owner loop, or make the root an "
+                                "owner with claim_device_owner()/"
+                                "initializer=authorize_device_thread"
+                            ),
+                        )
+                    )
+
+    # --------------------------------------------------------- R9 events
+
+    def _shard_roots(self) -> List[FuncDef]:
+        roots: List[FuncDef] = []
+        for mod in self.project.modules:
+            enclosing_stack: List[ast.FunctionDef] = []
+
+            def visit(node):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing_stack.append(node)
+                    for child in ast.iter_child_nodes(node):
+                        visit(child)
+                    enclosing_stack.pop()
+                    return
+                if isinstance(node, ast.Call):
+                    dotted = mod.dotted(node.func)
+                    name = _call_name(node.func)
+                    if (
+                        dotted in _SHARD_MAP_NAMES
+                        or name in _SHARD_MAP_NAMES
+                    ) and node.args:
+                        fd = self._resolve_shard_body(
+                            mod, enclosing_stack, node.args[0]
+                        )
+                        if fd is not None:
+                            roots.append(fd)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+            visit(mod.tree)
+        return roots
+
+    def _resolve_shard_body(
+        self, mod, enclosing_stack, arg
+    ) -> Optional[FuncDef]:
+        if isinstance(arg, ast.Name):
+            # Nested def in the enclosing function(s), innermost first.
+            for fn in reversed(enclosing_stack):
+                for item in ast.walk(fn):
+                    if (
+                        isinstance(item, ast.FunctionDef)
+                        and item.name == arg.id
+                    ):
+                        return FuncDef(module=mod, node=item, name=item.name)
+            return self.traced.resolve(mod, arg.id)
+        return None
+
+    def _collective_functions(self) -> Set[int]:
+        """ids of module-level FuncDefs that (transitively) issue a mesh
+        collective — the kernels whose call paths R9 compares."""
+        direct: Set[int] = set()
+        calls: Dict[int, Set[int]] = {}
+        for fd in self.traced.defs.values():
+            out: Set[int] = set()
+            for node in ast.walk(fd.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if fd.module.dotted(node.func) in _COLLECTIVES:
+                    direct.add(id(fd))
+                elif isinstance(node.func, ast.Name):
+                    callee = self.traced.resolve(fd.module, node.func.id)
+                    if callee is not None:
+                        out.add(id(callee))
+            calls[id(fd)] = out
+        # Propagate collective-ness up the call graph to a fixpoint.
+        changed = True
+        while changed:
+            changed = False
+            for fid, out in calls.items():
+                if fid not in direct and out & direct:
+                    direct.add(fid)
+                    changed = True
+        return direct
+
+    def _collect_collective_events(self) -> None:
+        roots = self._shard_roots()
+        if not roots:
+            return
+        collective_fns = self._collective_functions()
+        # Shard-traced taint fixpoint, seeded from the shard_map bodies
+        # (operands are device shards by construction).
+        tainted: Dict[int, Set[str]] = {}
+        by_id: Dict[int, FuncDef] = {}
+        for fd in roots:
+            by_id[id(fd)] = fd
+            tainted[id(fd)] = set(fd.params)
+        changed = True
+        while changed:
+            changed = False
+            for fid in list(tainted):
+                fd = by_id[fid]
+                walker = _TaintWalker(self.traced, fd, set(tainted[fid]))
+                walker.run()
+                for callee, callee_tainted in walker.calls:
+                    if id(callee) not in tainted:
+                        by_id[id(callee)] = callee
+                        tainted[id(callee)] = set()
+                        changed = True
+                    cur = tainted[id(callee)]
+                    if callee_tainted - cur:
+                        cur |= callee_tainted
+                        changed = True
+        seen = set()
+        for fid, taint in tainted.items():
+            fd = by_id[fid]
+            walker = _CollectiveWalker(
+                self.traced, fd, set(taint), collective_fns
+            )
+            walker.run()
+            for ev in walker.col_events:
+                key = (id(ev.module), ev.line, ev.col, ev.kind)
+                if key not in seen:
+                    seen.add(key)
+                    self.events.append(ev)
+
+
+class _CollectiveWalker(_TaintWalker):
+    """Taint walk over shard-traced code tracking data-dependent control
+    flow, emitting R9's collective-order events."""
+
+    def __init__(self, analysis, fd: FuncDef, tainted, collective_fns):
+        super().__init__(analysis, fd, tainted, emit=False)
+        self.collective_fns = collective_fns
+        self.depth = 0                     # tainted-branch nesting
+        self.col_events: List[Event] = []
+
+    def _stmt(self, stmt) -> None:
+        import ast as _ast
+
+        if isinstance(stmt, (_ast.FunctionDef, _ast.AsyncFunctionDef)):
+            # Nested defs are the scan/while bodies of the kernels —
+            # walk them with THIS walker class so collectives under
+            # tainted branches inside them still surface.
+            inner = _CollectiveWalker(
+                self.analysis,
+                FuncDef(module=self.module, node=stmt, name=stmt.name),
+                self.tainted
+                | {
+                    a.arg
+                    for a in (
+                        stmt.args.posonlyargs
+                        + stmt.args.args
+                        + stmt.args.kwonlyargs
+                    )
+                },
+                self.collective_fns,
+            )
+            inner.depth = self.depth
+            inner.run()
+            self.col_events.extend(inner.col_events)
+            self.calls.extend(inner.calls)
+            return
+        if isinstance(stmt, (_ast.If, _ast.While)):
+            self._scan_expr(stmt.test)
+            dep = self.is_tainted(stmt.test) and not _identity_test(
+                stmt.test
+            )
+            self.depth += 1 if dep else 0
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            self.depth -= 1 if dep else 0
+            return
+        if isinstance(stmt, _ast.For):
+            self._scan_expr(stmt.iter)
+            dep = self.is_tainted(stmt.iter)
+            self._assign_target(stmt.target, dep)
+            self.depth += 1 if dep else 0
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            self.depth -= 1 if dep else 0
+            return
+        super()._stmt(stmt)
+
+    def _scan_expr(self, expr) -> None:
+        import ast as _ast
+
+        super()._scan_expr(expr)
+        for node in _ast.walk(expr):
+            if not isinstance(node, _ast.Call):
+                continue
+            dotted = self.module.dotted(node.func)
+            if dotted in _COLLECTIVES:
+                if self.depth > 0:
+                    op = dotted.rsplit(".", 1)[-1]
+                    self.col_events.append(
+                        Event(
+                            kind="collective-data-dep",
+                            module=self.module,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"`{op}` under data-dependent control "
+                                "flow inside shard_map-traced code — "
+                                "shards whose operands branch "
+                                "differently fall out of the collective "
+                                "schedule (deadlock on a real mesh); "
+                                "hoist the collective out of the branch "
+                                "or make the predicate trace-static"
+                            ),
+                        )
+                    )
+                continue
+            if self.depth > 0 and isinstance(node.func, _ast.Name):
+                target = self.analysis.resolve(self.module, node.func.id)
+                if target is not None and id(target) in self.collective_fns:
+                    self.col_events.append(
+                        Event(
+                            kind="collective-divergent-path",
+                            module=self.module,
+                            line=node.lineno,
+                            col=node.col_offset,
+                            message=(
+                                f"`{node.func.id}()` issues mesh "
+                                "collectives but is reached under data-"
+                                "dependent control flow inside "
+                                "shard_map-traced code — two call paths "
+                                "to the same kernel carry divergent "
+                                "collective sequences per shard; make "
+                                "the call unconditional (mask its "
+                                "inputs instead) or the predicate "
+                                "trace-static"
+                            ),
+                        )
+                    )

@@ -97,6 +97,14 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help='stage name --inject-stage-sleep-ms slows (default "build")',
     )
     p.add_argument(
+        "--sanitizers", action="store_true",
+        help="arm the mrsan runtime sanitizers (debug mode — mrlint "
+        "R8/R9's runtime twin): device-ownership asserted at every "
+        "staging/dispatch/fetch seam, per-shard collective schedules "
+        "recorded and checked for uniformity; forces a retrace of "
+        "collective-bearing programs on arm",
+    )
+    p.add_argument(
         "--explain", action="store_true",
         help="arm the rank-provenance subsystem (explain/): stream "
         "builds an explain bundle automatically when an incident "
@@ -233,6 +241,9 @@ def _config_from_args(args) -> "MicroRankConfig":
                     ),
                     "device_checks": (
                         True if getattr(args, "device_checks", False) else None
+                    ),
+                    "sanitizers": (
+                        True if getattr(args, "sanitizers", False) else None
                     ),
                     "pipeline_depth": getattr(args, "pipeline_depth", None),
                     "fetch_mode": getattr(args, "fetch_mode", None),

@@ -217,6 +217,18 @@ class RuntimeConfig:
     # named, at the cost of an error-state thread through the program.
     # Off by default; the host-side check above stays on regardless.
     device_checks: bool = False
+    # mrsan runtime sanitizers (debug mode — the runtime twin of mrlint
+    # R8/R9): every device-touching seam asserts it runs on the claimed
+    # device-owner thread (utils.guards.assert_device_owner raises
+    # DeviceOwnershipError on a cross-thread dispatch), and the mesh
+    # collectives are interposed so the per-shard psum/all_gather
+    # schedule is recorded and checked for uniformity after each
+    # sharded dispatch (analysis.mrsan). Off by default: arming forces
+    # a retrace of collective-bearing programs (the recording callback
+    # is baked into the trace) and adds a host callback per collective
+    # per shard — CI's mrsan-smoke runs with it on; production keeps it
+    # for debugging sessions.
+    sanitizers: bool = False
     # Window-loop pipelining (table lane): number of device rank programs
     # allowed in flight before the host blocks. 2 overlaps window N's
     # device execution with window N+1's host graph build (jax async

@@ -123,6 +123,12 @@ class CompileCacheProbe:
         if self.cache_dir is None:
             return None
         from ..obs.metrics import record_compile_cache
+        from ..utils.guards import assert_device_owner
+
+        # The probe reads the cache dir the owner thread's compiles
+        # write into; observing from another thread races the scan
+        # against an in-flight compile (mrsan seam).
+        assert_device_owner("dispatch.cache_probe")
 
         now = self._scan()
         event = "miss" if now > self._entries else "hit"

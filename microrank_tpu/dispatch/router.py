@@ -276,7 +276,9 @@ class DispatchRouter:
         import jax
 
         from ..obs.spans import get_tracer
+        from ..utils.guards import assert_device_owner
 
+        assert_device_owner("dispatch.rank_batch")
         tracer = get_tracer()
         t0 = time.monotonic()
         staged = self._take_prestaged(graphs, kernel)
@@ -325,6 +327,15 @@ class DispatchRouter:
         from ..obs.profiler import record_device_memory
 
         record_device_memory()
+        from ..utils.guards import sanitizers_enabled
+
+        if sanitizers_enabled() and staged.route == "sharded":
+            # mrsan: the per-shard collective multisets recorded by the
+            # armed interposition must match — a shard that skipped a
+            # psum (R9's bug class) diverges here, at the fetch edge.
+            from ..analysis import mrsan
+
+            mrsan.verify_and_reset(log=self.log)
         if staged.n_pad:
             outs = tuple(o[: len(graphs)] for o in outs)
         self.dispatches += 1

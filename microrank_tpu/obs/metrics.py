@@ -331,6 +331,36 @@ def explain_bundles() -> Counter:
     )
 
 
+def mrsan_checks() -> Counter:
+    return get_registry().counter(
+        "microrank_mrsan_checks_total",
+        "mrsan device-ownership seam checks performed while the "
+        "runtime sanitizers were armed (RuntimeConfig.sanitizers) — a "
+        "clean run with zero here means the sanitizer never looked",
+        labelnames=("seam",),
+    )
+
+
+def mrsan_violations() -> Counter:
+    return get_registry().counter(
+        "microrank_mrsan_violations_total",
+        "mrsan runtime violations: cross-thread-device (a jax seam "
+        "entered off the owner thread — mrlint R8's runtime twin) or "
+        "collective-divergence (per-shard collective multisets "
+        "diverged on the mesh — R9's runtime twin)",
+        labelnames=("kind",),
+    )
+
+
+def mrsan_collectives() -> Counter:
+    return get_registry().counter(
+        "microrank_mrsan_collectives_total",
+        "Mesh collectives observed by the mrsan interposition at "
+        "runtime, summed over shards",
+        labelnames=("op",),
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -364,6 +394,7 @@ def ensure_catalog() -> None:
         build_pool_inflight, build_pool_builds,
         spans_recorded, flight_dumps, device_hbm_bytes,
         kernel_ms_per_iter, profile_sessions, explain_bundles,
+        mrsan_checks, mrsan_violations, mrsan_collectives,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -449,6 +480,18 @@ def record_profile_session(trigger: str) -> None:
 
 def record_explain(trigger: str) -> None:
     explain_bundles().inc(trigger=trigger)
+
+
+def record_mrsan_check(seam: str) -> None:
+    mrsan_checks().inc(seam=seam)
+
+
+def record_mrsan_violation(kind: str, n: int = 1) -> None:
+    mrsan_violations().inc(float(n), kind=kind)
+
+
+def record_mrsan_collective(op: str, n: int = 1) -> None:
+    mrsan_collectives().inc(float(n), op=op)
 
 
 def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:

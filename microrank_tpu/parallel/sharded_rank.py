@@ -340,6 +340,9 @@ def stage_sharded(graphs, mesh: Mesh, kernel: str):
     kernel-correct partition specs — global_put handles both
     single-process meshes (a sharded device_put) and multi-host ones
     (each process contributes its addressable shards)."""
+    from ..utils.guards import assert_device_owner
+
+    assert_device_owner("parallel.stage_sharded")
     from ..parallel.distributed import global_put
     from ..rank_backends.jax_tpu import device_subset
 

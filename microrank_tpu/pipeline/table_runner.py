@@ -398,9 +398,16 @@ class TableRCA:
         cfg = self.config
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before run()")
+        from ..analysis.mrsan import configure_sanitizers
         from ..obs.spans import configure_tracer
+        from ..utils.guards import claim_device_owner
 
         configure_tracer(cfg.obs)  # fresh span ring per run
+        configure_sanitizers(cfg)  # mrsan arm/disarm + reset
+        # The table lane drives the device from the calling thread; the
+        # async stage/fetch executors are authorized delegates (their
+        # single-width PJRT calls are ordered by construction).
+        claim_device_owner("table-lane")
         if sink is None and out_dir is not None:
             sink = ResultSink(
                 out_dir, overwrite_csv=cfg.compat.overwrite_results
@@ -527,9 +534,15 @@ class TableRCA:
         if async_mode:
             from concurrent.futures import ThreadPoolExecutor
 
-            stage_pool = ThreadPoolExecutor(1, "mr-stage")
+            from ..utils.guards import authorize_device_thread
+
+            stage_pool = ThreadPoolExecutor(
+                1, "mr-stage", initializer=authorize_device_thread
+            )
             if not bulk and chunk_n == 1:  # bulk/chunked join in batches
-                fetch_pool = ThreadPoolExecutor(1, "mr-fetch")
+                fetch_pool = ThreadPoolExecutor(
+                    1, "mr-fetch", initializer=authorize_device_thread
+                )
 
         results: List[WindowResult] = []
         pending = []  # (result, mask, nrm, abn) for deferred batched rank

@@ -296,6 +296,9 @@ def stage_rank_window(
     ignored for this dispatch.
     """
     from ..obs.metrics import record_retrace
+    from ..utils.guards import assert_device_owner
+
+    assert_device_owner("blob.stage_rank_window")
 
     if explain is not None and getattr(explain, "enabled", False):
         from ..explain.extract import (
@@ -438,6 +441,9 @@ def stage_windows_batched(batched: WindowGraph, blob: bool):
     fetches results. The stacked graph should already be
     device_subset-stripped for its kernel.
     """
+    from ..utils.guards import assert_device_owner
+
+    assert_device_owner("blob.stage_windows_batched")
     if blob:
         blob_arr, layout = pack_graph_blob(batched)
         _account_staging(batched, "blob", 1)
@@ -460,6 +466,9 @@ def dispatch_windows_staged(
     ``donate`` releases the staged blob's device buffer to the program
     (ignored in tree mode and on backends without donation)."""
     from ..obs.metrics import record_retrace
+    from ..utils.guards import assert_device_owner
+
+    assert_device_owner("blob.dispatch_windows_staged")
 
     if staged[0] == "blob":
         _, blob_dev, layout = staged

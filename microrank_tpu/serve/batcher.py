@@ -375,6 +375,13 @@ class MicroBatcher:
         way the flight recorder dumps the span ring first — the causal
         record of the dispatch that just died is exactly what the
         post-mortem needs, and the ring is still hot."""
+        from ..utils.guards import assert_device_owner
+
+        # The per-member numpy_ref fallback re-runs detect+rank on THIS
+        # thread and mutates each member's result/future; it must stay
+        # on the scheduler (device-owner) thread like every other
+        # dispatch outcome — previously unguarded (mrsan satellite).
+        assert_device_owner("serve.degrade")
         if self.flight is not None:
             self.flight.dump("degraded")
         if not self.serve.fallback:

@@ -113,6 +113,13 @@ class BatchScheduler(threading.Thread):
 
     # --------------------------------------------------------------- run
     def run(self) -> None:
+        from ..utils.guards import claim_device_owner
+
+        # The scheduler thread IS the device owner on the serve path
+        # (mrlint R8 / mrsan): every staging/dispatch/fetch and the
+        # degrade fallback happen here; the HTTP threads only enqueue
+        # and the build pool only does host work.
+        claim_device_owner("serve-scheduler")
         while True:
             deadline = self.batcher.next_deadline()
             timeout = (

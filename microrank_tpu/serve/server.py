@@ -132,13 +132,19 @@ class ServeService:
         self.log.info("staged dataset %r: %d spans", name, len(span_df))
 
     def start(self) -> None:
+        from ..analysis.mrsan import configure_sanitizers
         from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
+        from ..utils.guards import claim_device_owner
 
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before start()")
         ensure_catalog()
         configure_tracer(self.config.obs)  # fresh span ring per service
+        configure_sanitizers(self.config)  # mrsan arm/disarm + reset
+        # Warmup dispatches run on THIS thread before the scheduler
+        # exists; the scheduler thread re-claims when it starts.
+        claim_device_owner("serve-warmup")
         if self.journal is not None:
             self.journal.run_start(
                 pipeline="serve",

@@ -203,11 +203,17 @@ class StreamEngine:
 
     # ------------------------------------------------------------------ run
     def run(self) -> StreamSummary:
+        from ..analysis.mrsan import configure_sanitizers
         from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
+        from ..utils.guards import claim_device_owner
 
         ensure_catalog()
         configure_tracer(self.config.obs)  # fresh span ring per run
+        configure_sanitizers(self.config)  # mrsan arm/disarm + reset
+        # The engine thread is the sole jax toucher on the stream path
+        # (program-order rule); builds go to the pool, sinks stay host.
+        claim_device_owner("stream-engine")
         self._warm_start()
         sc = self.config.stream
         if self.journal is not None:

@@ -30,7 +30,115 @@ class ContractError(TypeError):
     """A value violated an ``analysis.contracts.contract`` spec."""
 
 
+class DeviceOwnershipError(RuntimeError):
+    """A device-touching seam ran on a thread that is neither the
+    claimed device owner nor an authorized delegate (mrsan, rule R8's
+    runtime twin)."""
+
+
 _state = threading.local()
+
+# ---------------------------------------------------------------------------
+# Device-thread ownership (mrsan — the runtime twin of mrlint R8).
+#
+# The static model (analysis.threads): one thread owns the device; pool
+# workers, HTTP handlers and sink callbacks never dispatch. The runtime
+# sanitizer validates it: run entries claim ownership
+# (``claim_device_owner``), sanctioned delegates register
+# (``authorize_device_thread`` — the table lane's async staging/fetch
+# workers), and every staging/dispatch/fetch seam asserts
+# (``assert_device_owner``). Checks are armed by
+# ``RuntimeConfig.sanitizers`` (analysis.mrsan.configure_sanitizers);
+# disarmed they cost one boolean read.
+
+_own_lock = threading.Lock()
+_owner_ident: int | None = None
+_owner_role: str | None = None
+_authorized: set = set()
+_sanitizers_on = False
+
+
+def set_sanitizers(enabled: bool) -> None:
+    """Arm/disarm the mrsan runtime checks process-wide."""
+    global _sanitizers_on
+    _sanitizers_on = bool(enabled)
+
+
+def sanitizers_enabled() -> bool:
+    return _sanitizers_on
+
+
+def claim_device_owner(role: str) -> None:
+    """Declare the CURRENT thread the device owner (re-claimable: run
+    entries claim at start, so ownership follows the active pipeline).
+    The static analyzer treats thread roots that claim as owner threads
+    — keep the call lexically inside the thread's root function."""
+    global _owner_ident, _owner_role
+    with _own_lock:
+        _owner_ident = threading.get_ident()
+        _owner_role = role
+
+
+def release_device_owner() -> None:
+    global _owner_ident, _owner_role
+    with _own_lock:
+        _owner_ident = None
+        _owner_role = None
+
+
+def authorize_device_thread() -> None:
+    """Register the CURRENT thread as a sanctioned device delegate —
+    used as the ``initializer=`` of the table lane's staging/fetch
+    executors (RuntimeConfig.async_dispatch), whose device RPCs are
+    single-width and ordered by construction."""
+    with _own_lock:
+        _authorized.add(threading.get_ident())
+
+
+def reset_device_ownership() -> None:
+    """Fresh ownership state (run entries, tests)."""
+    global _owner_ident, _owner_role
+    with _own_lock:
+        _owner_ident = None
+        _owner_role = None
+        _authorized.clear()
+
+
+def device_owner() -> tuple:
+    """(role, ident) of the claimed owner, or (None, None)."""
+    with _own_lock:
+        return _owner_role, _owner_ident
+
+
+def assert_device_owner(seam: str) -> None:
+    """mrsan seam check: when sanitizers are armed and an owner is
+    claimed, the calling thread must be the owner or an authorized
+    delegate. Violations are counted (microrank_mrsan_violations_total)
+    and raised — a cross-thread dispatch is a program-order bug, not a
+    condition to limp through."""
+    if not _sanitizers_on:
+        return
+    from ..obs.metrics import record_mrsan_check, record_mrsan_violation
+
+    record_mrsan_check(seam)
+    with _own_lock:
+        owner = _owner_ident
+        role = _owner_role
+        ok = (
+            owner is None
+            or threading.get_ident() == owner
+            or threading.get_ident() in _authorized
+        )
+    if not ok:
+        record_mrsan_violation("cross-thread-device")
+        raise DeviceOwnershipError(
+            f"device seam `{seam}` entered on thread "
+            f"{threading.current_thread().name!r} but the device owner "
+            f"is {role!r} — jax staging/dispatch/fetch must stay on the "
+            "owner thread (mrlint R8's runtime model); route the work "
+            "through the owner loop or authorize_device_thread() if the "
+            "delegation is by design"
+        )
 
 
 def contracts_enabled() -> bool:

@@ -1,0 +1,18 @@
+"""R3 true positive (value->shape dataflow, the pad_policy="exact"
+hazard): the staging buffer's extent is the raw span count, so every
+distinct window keys a fresh trace."""
+import jax
+import numpy as np
+
+
+def kernel(buf):
+    return buf * 2
+
+
+kernel_jit = jax.jit(kernel)
+
+
+def run_window(spans):
+    n = len(spans)
+    buf = np.zeros(n, np.float32)
+    return kernel_jit(buf)

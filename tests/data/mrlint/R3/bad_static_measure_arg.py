@@ -1,0 +1,16 @@
+"""R3 true positive (value->static dataflow): a host-measured count in
+a static argument position keys the jit cache on the data itself — one
+retrace per distinct window."""
+import jax
+
+
+def kernel(buf, n):
+    return buf[:n] * 2
+
+
+kernel_jit = jax.jit(kernel, static_argnums=(1,))
+
+
+def run_window(spans, buf):
+    n = len(spans)
+    return kernel_jit(buf, n)

@@ -1,0 +1,22 @@
+"""R3 negative: the measured extent is bucketed through a pad helper
+before shaping the staging buffer — shapes repeat across windows and
+the jit cache converges."""
+import jax
+import numpy as np
+
+
+def pad_extent(n, multiple=256):
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def kernel(buf):
+    return buf * 2
+
+
+kernel_jit = jax.jit(kernel)
+
+
+def run_window(spans):
+    n = pad_extent(len(spans))
+    buf = np.zeros(n, np.float32)
+    return kernel_jit(buf)

@@ -1,0 +1,16 @@
+"""R8 true positive: an HTTP handler ranking inline on the asyncio
+event-loop thread instead of enqueueing to the scheduler."""
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x):
+    return jnp.cumsum(x)
+
+
+kernel_jit = jax.jit(kernel)
+
+
+async def handle_rank(request, buf):
+    out = kernel_jit(buf)
+    return out

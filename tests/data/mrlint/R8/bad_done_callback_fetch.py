@@ -1,0 +1,17 @@
+"""R8 true positive: a future done-callback fetching results — it runs
+on whichever pool worker completed the future, not the owner thread."""
+import jax
+
+
+def build_host_graph(graph):
+    return graph
+
+
+def fetch_result(fut):
+    return jax.device_get(fut.result())
+
+
+def launch(pool, graph):
+    fut = pool.submit(build_host_graph, graph)
+    fut.add_done_callback(fetch_result)
+    return fut

@@ -1,0 +1,14 @@
+"""R8 true positive: window staging submitted to an unauthorized worker
+pool — the device seam runs on whatever worker picks it up."""
+from concurrent.futures import ThreadPoolExecutor
+
+
+def launch_async(graph, cfg):
+    pool = ThreadPoolExecutor(2, "staging")
+    return pool.submit(stage_graph, graph, cfg)
+
+
+def stage_graph(graph, cfg):
+    return stage_rank_window(
+        graph, cfg.pagerank, cfg.spectrum, "coo", cfg.runtime.blob_staging
+    )

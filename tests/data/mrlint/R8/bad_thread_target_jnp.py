@@ -1,0 +1,15 @@
+"""R8 true positive: a polling thread target dispatches jax work — the
+poller races the owner loop's program order on the shared device."""
+import threading
+
+import jax.numpy as jnp
+
+
+def poll_device(buf):
+    return jnp.sum(buf) * 2
+
+
+def start_poller(buf):
+    t = threading.Thread(target=poll_device, name="poller", args=(buf,))
+    t.start()
+    return t

@@ -1,0 +1,12 @@
+"""R8 true positive: an incident webhook sink fetching device arrays —
+sink callbacks run inside the dispatch lifecycle (and may retry from
+helper threads); they must stay host-only."""
+import json
+
+import jax
+
+
+class WebhookSink:
+    def emit(self, incident, scores):
+        payload = {"scores": jax.device_get(scores).tolist()}
+        return json.dumps(payload)

@@ -1,0 +1,19 @@
+"""R8 negative: staging delegated to an executor whose workers register
+as sanctioned delegates via initializer=authorize_device_thread (the
+table lane's async staging/fetch pattern — single-width ordered RPCs)."""
+from concurrent.futures import ThreadPoolExecutor
+
+from microrank_tpu.utils.guards import authorize_device_thread
+
+
+def launch_async(graph, cfg):
+    pool = ThreadPoolExecutor(
+        1, "mr-stage", initializer=authorize_device_thread
+    )
+    return pool.submit(stage_graph, graph, cfg)
+
+
+def stage_graph(graph, cfg):
+    return stage_rank_window(
+        graph, cfg.pagerank, cfg.spectrum, "coo", cfg.runtime.blob_staging
+    )

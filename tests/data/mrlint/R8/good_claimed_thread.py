@@ -1,0 +1,16 @@
+"""R8 negative: the engine thread claims device ownership at its root —
+it IS the owner; dispatching from here is the program-order rule
+working as designed."""
+import threading
+
+import jax.numpy as jnp
+
+from microrank_tpu.utils.guards import claim_device_owner
+
+
+class EngineThread(threading.Thread):
+    def run(self):
+        claim_device_owner("engine")
+        for batch in self.batches:
+            out = jnp.sum(batch)
+            self.sink.append(out)

@@ -1,0 +1,17 @@
+"""R9 true positive: all_gather inside a loop iterating a shard-local
+operand — shards with different extents run different numbers of
+collectives."""
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def widen(x, steps):
+    for _ in steps:
+        x = jax.lax.all_gather(x, "shards").sum(axis=0)
+    return x
+
+
+def rank(mesh, spec, x, steps):
+    return shard_map(widen, mesh=mesh, in_specs=spec, out_specs=spec)(
+        x, steps
+    )

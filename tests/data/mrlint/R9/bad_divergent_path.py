@@ -1,0 +1,21 @@
+"""R9 true positive: two call paths into the same collective-issuing
+kernel, one of them under a data-dependent branch — the branching shard
+issues psum twice while the rest issue it once."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+
+def combine(x):
+    return jax.lax.psum(x, "shards")
+
+
+def kernel(x, y):
+    out = combine(x)
+    if jnp.max(y) > 0:
+        out = out + combine(y)
+    return out
+
+
+def rank(mesh, spec, x, y):
+    return shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(x, y)

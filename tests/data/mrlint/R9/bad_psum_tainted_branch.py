@@ -1,0 +1,16 @@
+"""R9 true positive: a psum issued only when this shard's local sum is
+positive — shards whose operands branch differently fall out of the
+collective schedule (deadlock on a real mesh)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+
+def kernel(x):
+    if jnp.sum(x) > 0:
+        x = jax.lax.psum(x, "shards")
+    return x
+
+
+def rank(mesh, specs, x):
+    return shard_map(kernel, mesh=mesh, in_specs=specs, out_specs=specs)(x)

@@ -1,0 +1,18 @@
+"""R9 true positive: a convergence while-loop on a traced residual with
+the cross-shard combine inside — per-shard iteration counts diverge and
+so do the collective sequences."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+
+def iterate(x):
+    r = jnp.max(jnp.abs(x))
+    while r > 1e-3:
+        x = jax.lax.psum(x, "shards") * 0.5
+        r = jnp.max(jnp.abs(x))
+    return x
+
+
+def rank(mesh, spec, x):
+    return shard_map(iterate, mesh=mesh, in_specs=spec, out_specs=spec)(x)

@@ -1,0 +1,19 @@
+"""R9 negative: the branch predicate is a trace-static config flag —
+every shard traces the same path, so the schedule stays uniform even
+though the two paths differ."""
+import jax
+from jax.experimental.shard_map import shard_map
+
+USE_COMPENSATED = True
+
+
+def kernel(x):
+    if USE_COMPENSATED:
+        hi = jax.lax.psum(x, "shards")
+        lo = jax.lax.psum(x - hi, "shards")
+        return hi + lo
+    return jax.lax.psum(x, "shards")
+
+
+def rank(mesh, spec, x):
+    return shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(x)

@@ -1,0 +1,17 @@
+"""R9 negative: unconditional per-iteration psum — every shard issues
+the identical collective sequence; data dependence is expressed by
+masking the operand, not by branching around the collective."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+
+def kernel(x):
+    mask = x > 0
+    contrib = jnp.where(mask, x, 0.0)
+    total = jax.lax.psum(contrib, "shards")
+    return x / (total + 1e-9)
+
+
+def rank(mesh, spec, x):
+    return shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(x)

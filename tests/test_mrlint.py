@@ -29,7 +29,9 @@ def _rules_fired(path: Path):
 
 
 def test_rule_catalog_complete():
-    assert set(RULES) == {"R1", "R2", "R3", "R4", "R5", "R6", "R7"}
+    assert set(RULES) == {
+        "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
+    }
     for rule in RULES.values():
         assert rule.slug and rule.summary
 
@@ -124,6 +126,215 @@ def test_wrong_rule_disable_does_not_suppress():
         "return x * float(x)  # mrlint: disable=R2(wrong rule)",
     )
     assert "R1" in {v.rule for v in lint_source(src)}
+
+
+# ----------------------------------------------- framework edge cases (v2)
+
+
+_DECORATED_SNIPPET = """\
+import jax
+
+
+def wrap(f):
+    return f
+
+
+@wrap
+def f(x):
+{body}
+
+
+f_jit = jax.jit(f)
+"""
+
+
+def test_disable_inside_decorated_def_suppresses():
+    """A comment-line pragma guards the next line even when the def it
+    lives in is decorated (decorators shift the def's lineno story —
+    the suppression must anchor to the violating line, not the def)."""
+    src = _DECORATED_SNIPPET.format(
+        body=(
+            "    # mrlint: disable=R1(fixture: justified sync)\n"
+            "    return x * float(x)"
+        )
+    )
+    assert all(v.rule != "R1" for v in lint_source(src))
+
+
+def test_disable_on_decorator_line_does_not_leak_into_body():
+    """An end-of-line pragma guards ITS line only: parked on the
+    decorator it must not swallow a violation inside the body."""
+    src = _DECORATED_SNIPPET.format(
+        body="    return x * float(x)"
+    ).replace(
+        "@wrap", "@wrap  # mrlint: disable=R1(wrong line: decorator)"
+    )
+    assert "R1" in {v.rule for v in lint_source(src)}
+
+
+def test_r0_counting_matched_and_floating_bare_disables():
+    """Suppression counting: a bare disable that matches a finding
+    converts it to exactly one R0; a floating bare disable adds exactly
+    one more — no double counting from the two emission paths."""
+    src = _BAD_SNIPPET.format(pragma="").replace(
+        "return x * float(x)",
+        "return x * float(x)  # mrlint: disable=R1",
+    ) + "\n# mrlint: disable=R4\n"
+    vs = lint_source(src)
+    assert [v.rule for v in vs].count("R0") == 2
+    assert "R1" not in {v.rule for v in vs}
+
+
+def test_r0_not_duplicated_for_multiple_findings_on_one_line():
+    """Two findings suppressed by one justified pragma line stay
+    suppressed; the same line bare produces R0 per emission, deduped by
+    line in the floating sweep."""
+    src = """\
+import jax
+
+
+def f(x):
+    return float(x) + float(x)  # mrlint: disable=R1(fixture: double)
+
+
+f_jit = jax.jit(f)
+"""
+    assert all(v.rule not in ("R0", "R1") for v in lint_source(src))
+
+
+def test_submit_through_functools_partial_resolves():
+    """Call-graph resolution through functools.partial: the partial's
+    underlying bound method roots the thread, so its jax touch fires
+    R8."""
+    src = """\
+import functools
+import threading
+
+import jax.numpy as jnp
+
+
+class Engine:
+    def loop(self):
+        return jnp.sum(self.buf)
+
+    def start(self):
+        t = threading.Thread(target=functools.partial(self.loop))
+        t.start()
+"""
+    assert "R8" in {v.rule for v in lint_source(src)}
+
+
+def test_submit_bound_method_of_typed_local_resolves():
+    """pool.submit(obj.method): the receiver's class is inferred from
+    its local construction, the method resolved, and its device touch
+    attributed to the pool-worker root."""
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+import jax.numpy as jnp
+
+
+class Stager:
+    def stage(self, g):
+        return jnp.asarray(g)
+
+
+def go(g):
+    s = Stager()
+    pool = ThreadPoolExecutor(1)
+    return pool.submit(s.stage, g)
+"""
+    assert "R8" in {v.rule for v in lint_source(src)}
+
+
+_PARAM_POOL_SNIPPET = """\
+from concurrent.futures import ThreadPoolExecutor
+
+import jax.numpy as jnp
+
+from microrank_tpu.utils.guards import authorize_device_thread
+
+
+class Lane:
+    def start(self):
+        pool = ThreadPoolExecutor(1, "s"{init})
+        self.loop(pool)
+
+    def loop(self, pool):
+        return pool.submit(self.stage)
+
+    def stage(self):
+        return jnp.zeros(4)
+"""
+
+
+def test_executor_authorization_resolves_through_parameters():
+    """The table-lane shape: the executor is constructed in one method
+    and submitted to in another that receives it as a parameter — the
+    authorization verdict must follow the value through the call."""
+    authorized = _PARAM_POOL_SNIPPET.format(
+        init=", initializer=authorize_device_thread"
+    )
+    assert "R8" not in {v.rule for v in lint_source(authorized)}
+    unauthorized = _PARAM_POOL_SNIPPET.format(init="")
+    assert "R8" in {v.rule for v in lint_source(unauthorized)}
+
+
+# ------------------------------------------------------------------- sarif
+
+
+def test_sarif_rendering_round_trip():
+    import json
+
+    from microrank_tpu.analysis.sarif import to_sarif
+
+    vs = lint_paths([str(DATA / "R8" / "bad_webhook_sink_fetch.py")])
+    doc = to_sarif(vs)
+    json.dumps(doc)  # serializable
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mrlint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == sorted(["R0"] + list(RULES))
+    (res,) = run["results"]
+    assert res["ruleId"] == "R8" and res["level"] == "error"
+    region = res["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] >= 1 and region["startColumn"] >= 1
+    # ruleIndex points back into the driver catalog.
+    assert rule_ids[res["ruleIndex"]] == "R8"
+
+
+def test_cli_lint_sarif_flag(tmp_path, capsys):
+    import json
+
+    from microrank_tpu.cli.main import main
+
+    out = tmp_path / "mrlint.sarif"
+    bad = DATA / "R3" / "bad_tracer_branch.py"
+    assert main(["lint", str(bad), "--sarif", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    assert any(
+        r["ruleId"] == "R3" for r in doc["runs"][0]["results"]
+    )
+    # A clean run still writes a (zero-result) SARIF for the upload step.
+    good = DATA / "R3" / "good_cached_jit.py"
+    assert main(["lint", str(good), "--sarif", str(out)]) == 0
+    assert json.loads(out.read_text())["runs"][0]["results"] == []
+
+
+def test_sarif_r0_reported_as_warning():
+    from microrank_tpu.analysis import lint_source
+    from microrank_tpu.analysis.sarif import to_sarif
+
+    src = _BAD_SNIPPET.format(pragma="").replace(
+        "return x * float(x)",
+        "return x * float(x)  # mrlint: disable=R1",
+    )
+    doc = to_sarif(lint_source(src))
+    levels = {
+        r["ruleId"]: r["level"] for r in doc["runs"][0]["results"]
+    }
+    assert levels.get("R0") == "warning"
 
 
 # ---------------------------------------------------------------- contracts

@@ -1,0 +1,402 @@
+"""mrsan — the runtime sanitizer that cross-checks mrlint's static
+model (R8 device ownership / R9 collective order).
+
+Covers: ownership asserts at the device seams (owner passes, foreign
+thread raises, authorized delegates pass, disarmed is free), per-shard
+collective-schedule recording on the CPU mesh (uniform real program;
+injected divergence trips), the serve degrade-path guard (satellite
+bugfix), sanitized end-to-end runs staying violation-free, and the CI
+cross-validation contract: the injected-bug fixtures flip BOTH the
+static and the runtime detector.
+"""
+
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from conftest import partition_case
+from microrank_tpu.analysis import lint_paths, mrsan
+from microrank_tpu.config import (
+    MicroRankConfig,
+    RuntimeConfig,
+    ServeConfig,
+    StreamConfig,
+)
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.utils.guards import (
+    DeviceOwnershipError,
+    assert_device_owner,
+    authorize_device_thread,
+    claim_device_owner,
+    device_owner,
+    release_device_owner,
+    sanitizers_enabled,
+)
+
+DATA = Path(__file__).parent / "data" / "mrlint"
+
+
+def _value(registry, name, **labels) -> float:
+    """Counter value, 0.0 when the metric was never recorded."""
+    m = registry.get(name)
+    return 0.0 if m is None else m.value(**labels)
+
+
+def _total(registry, name) -> float:
+    m = registry.get(name)
+    return (
+        0.0
+        if m is None
+        else sum(smp["value"] for smp in m.samples())
+    )
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture
+def armed(registry):
+    """Sanitizers armed process-wide for the test, disarmed after."""
+    cfg = MicroRankConfig(runtime=RuntimeConfig(sanitizers=True))
+    mrsan.configure_sanitizers(cfg)
+    yield cfg
+    mrsan.configure_sanitizers(MicroRankConfig())
+
+
+def _call_in_thread(fn, *args):
+    """Run fn on a fresh thread; return the exception it raised (or
+    None)."""
+    box = {}
+
+    def run():
+        try:
+            fn(*args)
+        except BaseException as e:  # noqa: BLE001 — test harness
+            box["err"] = e
+
+    t = threading.Thread(target=run, name="mrsan-foreign")
+    t.start()
+    t.join()
+    return box.get("err")
+
+
+# ----------------------------------------------------------- ownership
+
+
+def test_configure_arms_and_disarms(registry):
+    mrsan.configure_sanitizers(
+        MicroRankConfig(runtime=RuntimeConfig(sanitizers=True))
+    )
+    assert sanitizers_enabled() and mrsan.armed()
+    mrsan.configure_sanitizers(MicroRankConfig())
+    assert not sanitizers_enabled() and not mrsan.armed()
+    assert device_owner() == (None, None)
+
+
+def test_owner_thread_passes_foreign_thread_raises(armed, registry):
+    claim_device_owner("test-owner")
+    assert_device_owner("test.seam")  # owner: fine
+    err = _call_in_thread(assert_device_owner, "test.seam")
+    assert isinstance(err, DeviceOwnershipError)
+    assert "test.seam" in str(err) and "test-owner" in str(err)
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_violations_total",
+            kind="cross-thread-device",
+        )
+        == 1
+    )
+    # Both entries counted as performed checks.
+    assert (
+        _value(registry, "microrank_mrsan_checks_total", seam="test.seam")
+        == 2
+    )
+
+
+def test_authorized_delegate_passes(armed):
+    claim_device_owner("test-owner")
+    with ThreadPoolExecutor(
+        1, "delegate", initializer=authorize_device_thread
+    ) as pool:
+        pool.submit(assert_device_owner, "test.seam").result()
+
+
+def test_no_claim_means_no_enforcement(armed):
+    release_device_owner()
+    assert _call_in_thread(assert_device_owner, "test.seam") is None
+
+
+def test_disarmed_checks_are_free(registry):
+    mrsan.configure_sanitizers(MicroRankConfig())  # sanitizers off
+    claim_device_owner("test-owner")
+    try:
+        assert _call_in_thread(assert_device_owner, "test.seam") is None
+        assert (
+            _value(
+                registry, "microrank_mrsan_checks_total", seam="test.seam"
+            )
+            == 0
+        )
+    finally:
+        release_device_owner()
+
+
+def test_reclaim_follows_active_pipeline(armed):
+    claim_device_owner("first")
+    err = _call_in_thread(claim_device_owner, "second")
+    assert err is None  # re-claim from the new run's thread is legal
+    role, ident = device_owner()
+    assert role == "second" and ident != threading.get_ident()
+    with pytest.raises(DeviceOwnershipError):
+        assert_device_owner("test.seam")
+
+
+# ----------------------------------------------- collective recording
+
+
+def test_real_mesh_program_records_uniform_schedule(armed, registry):
+    """A shard_map psum over the 8-device CPU mesh: every shard reports
+    the same op multiset; the uniformity check stays silent."""
+    from jax.experimental.shard_map import shard_map
+
+    from microrank_tpu.parallel.mesh import SHARD_AXIS, single_axis_mesh
+
+    mesh = single_axis_mesh(4)
+    mrsan.reset_schedule()
+
+    def kern(x):
+        total = jax.lax.psum(x, SHARD_AXIS)
+        return x / (total + 1.0)
+
+    x = jnp.arange(8.0)
+    out = jax.jit(
+        shard_map(
+            kern,
+            mesh=mesh,
+            in_specs=P(SHARD_AXIS),
+            out_specs=P(SHARD_AXIS),
+        )
+    )(x)
+    out.block_until_ready()
+    assert "psum@shard" in mrsan.trace_schedule()
+    sched = mrsan.collective_schedule()
+    assert set(sched) == {0, 1, 2, 3}
+    assert all(c == {"psum": 1} for c in sched.values())
+    assert mrsan.verify_collective_uniformity() == []
+    assert (
+        _value(registry, "microrank_mrsan_collectives_total", op="psum")
+        == 4.0
+    )
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_violations_total",
+            kind="collective-divergence",
+        )
+        == 0
+    )
+
+
+def test_injected_shard_divergence_trips(armed, registry):
+    """The R9 runtime bug class, injected: one shard skips a psum (as a
+    data-dependent branch would make it on a real multi-host mesh —
+    single-controller tracing cannot produce it organically, which is
+    exactly why the recording seam exists)."""
+    mrsan.reset_schedule()
+    mrsan._record_runtime("psum", 0)
+    mrsan._record_runtime("psum", 0)
+    mrsan._record_runtime("all_gather", 0)
+    mrsan._record_runtime("psum", 1)
+    mrsan._record_runtime("psum", 1)  # shard 1 skipped the all_gather
+    violations = mrsan.verify_collective_uniformity()
+    assert len(violations) == 1
+    assert "shard 1" in violations[0] and "all_gather" in violations[0]
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_violations_total",
+            kind="collective-divergence",
+        )
+        == 1
+    )
+    mrsan.reset_schedule()
+    assert mrsan.collective_schedule() == {}
+
+
+def test_verify_and_reset_clears_between_dispatches(armed):
+    mrsan._record_runtime("psum", 0)
+    mrsan._record_runtime("psum", 1)
+    assert mrsan.verify_and_reset() == []
+    assert mrsan.collective_schedule() == {}
+
+
+# ------------------------------------------------ seam integration
+
+
+def test_stage_seam_trips_from_foreign_thread(armed, small_case):
+    """The real blob staging seam raises when entered off the owner
+    thread — the runtime twin of mrlint R8 on the injected bug."""
+    from microrank_tpu.graph.build import build_window_graph
+    from microrank_tpu.rank_backends.blob import stage_rank_window
+    from microrank_tpu.rank_backends.jax_tpu import device_subset
+
+    cfg = MicroRankConfig()
+    nrm, abn = partition_case(small_case)
+    graph, names, _, _ = build_window_graph(
+        small_case.abnormal, nrm, abn, aux="none"
+    )
+    graph = device_subset(graph, "coo")
+    claim_device_owner("test-owner")
+
+    def dispatch():
+        return stage_rank_window(
+            graph, cfg.pagerank, cfg.spectrum, "coo", False
+        )
+
+    err = _call_in_thread(dispatch)
+    assert isinstance(err, DeviceOwnershipError)
+    assert "blob.stage_rank_window" in str(err)
+    # Same call on the owner thread goes through to the device.
+    handles = dispatch()
+    assert handles is not None
+
+
+def test_both_detectors_flip_on_injected_cross_thread_jax(
+    armed, small_case
+):
+    """The CI contract, cross-thread half: the webhook-thread jax call
+    fires R8 statically AND DeviceOwnershipError at runtime."""
+    fired = {
+        v.rule
+        for v in lint_paths([str(DATA / "R8" / "bad_webhook_sink_fetch.py")])
+    }
+    assert "R8" in fired
+    claim_device_owner("engine")
+    scores = jnp.arange(4.0)
+
+    def webhook_emit():
+        # The fixture's bug, executed: fetch on the sink thread.
+        assert_device_owner("dispatch.rank_batch")
+        return jax.device_get(scores)
+
+    err = _call_in_thread(webhook_emit)
+    assert isinstance(err, DeviceOwnershipError)
+
+
+def test_both_detectors_flip_on_divergent_psum(armed, registry):
+    """The CI contract, collective half: the shard-divergent psum fires
+    R9 statically AND the uniformity check at runtime."""
+    fired = {
+        v.rule
+        for v in lint_paths([str(DATA / "R9" / "bad_psum_tainted_branch.py")])
+    }
+    assert "R9" in fired
+    mrsan.reset_schedule()
+    mrsan._record_runtime("psum", 0)  # shard 0 took the branch
+    # shard 1 skipped it — nothing recorded
+    mrsan._record_runtime("all_gather", 0)
+    mrsan._record_runtime("all_gather", 1)
+    assert mrsan.verify_collective_uniformity() != []
+
+
+# ---------------------------------------------------- e2e: stream/serve
+
+
+def test_stream_run_sanitized_stays_clean(registry, tmp_path):
+    """Repo lints clean <=> a sanitized run observes zero violations:
+    the runtime half, on a real gated stream run."""
+    from microrank_tpu.stream import StreamEngine, SyntheticSource
+    from microrank_tpu.testing import SyntheticConfig
+
+    src = SyntheticSource(
+        n_windows=4,
+        faulted=[2],
+        synth_config=SyntheticConfig(
+            n_operations=16, n_traces=120, n_kinds=8, seed=5
+        ),
+        pace_seconds=0.01,
+        sleep=lambda s: None,
+    )
+    cfg = MicroRankConfig(
+        runtime=RuntimeConfig(sanitizers=True),
+        stream=StreamConfig(allowed_lateness_seconds=5.0),
+    )
+    try:
+        eng = StreamEngine(cfg, src, out_dir=tmp_path)
+        s = eng.run()
+    finally:
+        mrsan.configure_sanitizers(MicroRankConfig())
+    assert s.windows == 4 and s.ranked == 1
+    assert _total(registry, "microrank_mrsan_checks_total") > 0
+    assert _total(registry, "microrank_mrsan_violations_total") == 0
+    # The engine thread claimed; the snapshot proves the seams looked.
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "microrank_mrsan_checks_total" in prom
+
+
+def test_serve_degrade_path_guarded_and_clean(registry):
+    """Satellite bugfix: the per-member numpy_ref fallback runs on the
+    scheduler (owner) thread behind assert_device_owner — a sanitized
+    degraded run completes with zero violations and the serve.degrade
+    seam check counted."""
+    import urllib.request
+
+    from microrank_tpu.serve import ServeHandle, ServeService
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    df = case.abnormal.copy()
+    df["startTime"] = df["startTime"].astype(str)
+    df["endTime"] = df["endTime"].astype(str)
+    payload = {"spans": df.to_dict("records")}
+    cfg = MicroRankConfig(
+        runtime=RuntimeConfig(sanitizers=True),
+        serve=ServeConfig(
+            warmup=False,
+            max_wait_ms=100.0,
+            max_batch_windows=1,
+            inject_dispatch_failures=2,
+        ),
+    )
+    svc = ServeService(cfg, out_dir=None)
+    svc.fit_baseline(case.normal)
+    svc.start()
+    handle = ServeHandle(svc)
+    port = handle.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/rank",
+            data=json.dumps(payload).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = json.loads(r.read())
+        assert body["degraded"] is True
+        assert body["kernel"] == "numpy_ref"
+    finally:
+        handle.stop()
+        mrsan.configure_sanitizers(MicroRankConfig())
+    assert (
+        _value(
+            registry, "microrank_mrsan_checks_total", seam="serve.degrade"
+        )
+        >= 1
+    )
+    assert _total(registry, "microrank_mrsan_violations_total") == 0
