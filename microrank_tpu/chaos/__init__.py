@@ -1,0 +1,69 @@
+"""Crash-only machinery: durable checkpoints, fault injection, retries.
+
+Three pieces with one theme — the always-on engine must survive the
+same chaos MicroRank's own evaluation methodology injects into the
+systems it watches:
+
+* ``checkpoint`` — the versioned, checksummed, atomically-written
+  ``state.ckpt`` that makes ``cli stream --resume`` continue a crashed
+  run instead of cold-starting it;
+* ``faults`` — the seeded deterministic ``FaultPlan`` registry every
+  seam consults (``--chaos PLAN.json``), replacing the ad-hoc
+  injection knobs;
+* ``retry`` — the one retry policy (exponential backoff + jitter +
+  per-seam circuit breaker) behind every retried seam.
+"""
+
+from .checkpoint import (
+    CHECKPOINT_NAME,
+    CheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from .faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    configure_chaos,
+    get_fault_plan,
+    maybe_inject,
+    record_injection,
+    set_chaos_journal,
+)
+from .retry import (
+    BUILD_POLICY,
+    DISPATCH_POLICY,
+    WEBHOOK_POLICY,
+    BreakerOpen,
+    CircuitBreaker,
+    RetryPolicy,
+    get_breaker,
+    record_attempt,
+    reset_breakers,
+    retry_call,
+)
+
+__all__ = [
+    "BUILD_POLICY",
+    "BreakerOpen",
+    "CHECKPOINT_NAME",
+    "CheckpointError",
+    "CircuitBreaker",
+    "DISPATCH_POLICY",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "RetryPolicy",
+    "WEBHOOK_POLICY",
+    "configure_chaos",
+    "get_breaker",
+    "get_fault_plan",
+    "load_checkpoint",
+    "maybe_inject",
+    "record_attempt",
+    "record_injection",
+    "reset_breakers",
+    "retry_call",
+    "save_checkpoint",
+    "set_chaos_journal",
+]

@@ -1,0 +1,98 @@
+"""Durable engine state: the versioned, checksummed ``state.ckpt``.
+
+A crashed/restarted ``cli stream`` used to lose everything host-side:
+the online SLO baselines (exp-decay moments + P^2 marker arrays), the
+incident open/resolve state, the windower watermark, and the source
+cursor — so a restart re-entered cold start, re-opened incidents it had
+already reported, and re-read or skipped spans. The checkpoint makes
+the engine crash-only: every healthy-window boundary (and the SIGTERM
+drain) atomically rewrites one small JSON file under the run dir, and
+``cli stream --resume`` restores it so the restarted process continues
+the SAME run — zero duplicate ``incident_open`` events, no cold-start
+window gating, the source picked up at its checkpointed offset.
+
+File format (version 1)::
+
+    {"version": 1, "ts": ..., "sha256": "<payload digest>",
+     "payload": {"baseline": ..., "tracker": ..., "windower": ...,
+                 "source": ..., "summary": ...}}
+
+The digest is over the canonical (sorted-keys) JSON of ``payload``; a
+truncated, bit-flipped or hand-edited checkpoint is REJECTED
+(:class:`CheckpointError`) rather than half-restored — the engine then
+logs and cold-starts, which is always safe (at-least-once semantics:
+the windower's restored emit cursor is what guards exactly-once window
+effects, and it is only trusted when the checksum holds).
+
+Writes go through ``utils.atomic`` (tmp + fsync + rename) with the
+``checkpoint`` chaos seam fired between the durable tmp write and the
+rename — the injected-crash test pins that the OLD checkpoint still
+loads after a kill at that exact instant.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+
+CHECKPOINT_VERSION = 1
+CHECKPOINT_NAME = "state.ckpt"
+
+
+class CheckpointError(RuntimeError):
+    """Unreadable / corrupt / incompatible checkpoint — never half-load."""
+
+
+def _digest(payload: dict) -> str:
+    canon = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+def save_checkpoint(path, payload: dict) -> Path:
+    """Atomically write ``payload`` as the engine checkpoint. May raise
+    ``InjectedFault`` (chaos seam ``checkpoint``) AFTER the tmp write
+    and BEFORE the rename — the caller treats that as the crash it
+    simulates; the previous checkpoint is untouched."""
+    from ..utils.atomic import atomic_write_json
+
+    doc = {
+        "version": CHECKPOINT_VERSION,
+        "ts": time.time(),
+        "sha256": _digest(payload),
+        "payload": payload,
+    }
+    return atomic_write_json(path, doc, fault_seam="checkpoint")
+
+
+def load_checkpoint(path) -> dict:
+    """Read + verify a checkpoint; returns the payload dict. Raises
+    :class:`CheckpointError` on any defect (missing file, torn JSON,
+    wrong version, checksum mismatch)."""
+    path = Path(path)
+    try:
+        raw = path.read_text()
+    except OSError as e:
+        raise CheckpointError(f"unreadable checkpoint {path}: {e}") from e
+    try:
+        doc = json.loads(raw)
+    except ValueError as e:
+        raise CheckpointError(
+            f"corrupt checkpoint {path} (torn JSON): {e}"
+        ) from e
+    if not isinstance(doc, dict) or "payload" not in doc:
+        raise CheckpointError(f"malformed checkpoint {path}")
+    version = doc.get("version")
+    if version != CHECKPOINT_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path} has version {version!r}; this build "
+            f"reads version {CHECKPOINT_VERSION}"
+        )
+    payload = doc["payload"]
+    if _digest(payload) != doc.get("sha256"):
+        raise CheckpointError(
+            f"checkpoint {path} failed its checksum (bit rot or a "
+            "non-atomic writer)"
+        )
+    return payload

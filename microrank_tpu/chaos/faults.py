@@ -1,0 +1,243 @@
+"""Unified fault-injection registry: one seeded, deterministic surface.
+
+MicroRank's own evaluation is chaos injection — faults are injected
+into a live system and the ranker must stay correct while the world
+misbehaves (PAPER.md). The repo grew two ad-hoc knobs for that
+(``ServeConfig.inject_dispatch_failures``,
+``ObsConfig.inject_stage_sleep_ms``); this module replaces the pattern
+with ONE registry every seam consults, so a chaos scenario is a JSON
+document instead of scattered counters:
+
+    {"seed": 7, "faults": [
+        {"seam": "dispatch",    "kind": "fail",    "count": 2},
+        {"seam": "build",       "kind": "fail",    "after": 1, "count": 1},
+        {"seam": "source_stall","kind": "stall",   "value": 200, "count": 1},
+        {"seam": "webhook",     "kind": "hang",    "value": 500, "count": 1},
+        {"seam": "checkpoint",  "kind": "crash",   "after": 2, "count": 1}
+    ]}
+
+Seams (each one a point the span tracer already instruments):
+
+* ``dispatch`` / ``serve_dispatch`` — device rank dispatch (stream /
+  serve); ``fail`` raises before the router call, retried by the
+  unified retry policy (chaos.retry).
+* ``build`` — the build-pool graph preparation; ``fail`` raises inside
+  the worker, retried there (the window is never dropped).
+* ``fetch`` — the result fetch; ``nan`` poisons the attempt so the
+  finite-score validation trips and the dispatch retries clean.
+* ``source_stall`` / ``source_torn`` / ``source_rotation`` — the span
+  source: an extra poll stall, a simulated torn tail line (parse fails
+  this poll, data intact the next), a forced cursor reset (rotation).
+* ``webhook`` — the incident webhook POST: ``hang`` (bounded sleep) or
+  ``http_5xx``/``fail`` (raised, enqueued for the sink's retry queue).
+* ``checkpoint`` — the state.ckpt writer, fired BETWEEN the durable tmp
+  write and the rename: the crash the atomic protocol exists to survive.
+* ``stage:<name>`` — a latency injection inside any traced span (the
+  legacy ``inject_stage_sleep_ms`` knob's seam).
+
+Determinism: spec matching is pure event counting per seam (``after`` /
+``count`` / ``every``); probabilistic specs (``prob`` < 1) draw from a
+``random.Random(seed)`` stream, so the same plan over the same run
+replays the same faults. The legacy knobs keep working and record
+their firings through :func:`record_injection`, so every injected
+fault — planned or legacy — lands in
+``microrank_fault_injections_total{seam,kind}`` and the run journal.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.chaos")
+
+# Kinds that unwind the seam with an InjectedFault when they fire; the
+# rest either sleep here (latency kinds) or are returned to the caller
+# to interpret (nan / torn_line / rotation).
+_RAISING_KINDS = frozenset({"fail", "crash", "http_5xx", "exception"})
+_SLEEPING_KINDS = frozenset({"latency", "stall", "hang"})
+
+
+class InjectedFault(RuntimeError):
+    """A fault the chaos plan injected at a seam (never a real error)."""
+
+    def __init__(self, seam: str, kind: str = "fail"):
+        super().__init__(f"chaos: injected {kind} at seam {seam!r}")
+        self.seam = seam
+        self.kind = kind
+
+
+@dataclass
+class FaultSpec:
+    """One deterministic fault rule at one seam."""
+
+    seam: str
+    kind: str = "fail"
+    after: int = 0          # skip this many events at the seam first
+    count: int = 1          # events affected once active (-1 = forever)
+    every: int = 1          # affect every k-th active event
+    value: float = 0.0      # milliseconds for latency/stall/hang kinds
+    prob: float = 1.0       # firing probability (seeded RNG)
+    _fired: int = field(default=0, repr=False)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        known = {
+            k: d[k]
+            for k in ("seam", "kind", "after", "count", "every", "value",
+                      "prob")
+            if k in d
+        }
+        if "seam" not in known:
+            raise ValueError(f"fault spec missing 'seam': {d}")
+        return cls(**known)
+
+    def decide(self, event_no: int, rng: random.Random) -> bool:
+        """Does this spec fire for the seam's ``event_no``-th event
+        (0-based)? Mutates the fired counter — call once per event."""
+        if event_no < self.after:
+            return False
+        if self.count >= 0 and self._fired >= self.count:
+            return False
+        if (event_no - self.after) % max(1, self.every) != 0:
+            return False
+        if self.prob < 1.0 and rng.random() >= self.prob:
+            return False
+        self._fired += 1
+        return True
+
+
+class FaultPlan:
+    """Seeded, deterministic fault schedule over named seams."""
+
+    def __init__(self, specs: List[FaultSpec] = None, seed: int = 0):
+        self.specs = list(specs or [])
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._events: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.injected: List[dict] = []  # what actually fired (tests)
+
+    @classmethod
+    def from_config(cls, chaos_config) -> Optional["FaultPlan"]:
+        """Build the plan a ChaosConfig describes (inline ``faults``
+        plus an optional ``plan_path`` JSON file); None when disabled."""
+        if chaos_config is None or not getattr(
+            chaos_config, "enabled", False
+        ):
+            return None
+        specs = [FaultSpec.from_dict(dict(f)) for f in chaos_config.faults]
+        seed = int(chaos_config.seed)
+        if chaos_config.plan_path:
+            data = json.loads(Path(chaos_config.plan_path).read_text())
+            seed = int(data.get("seed", seed))
+            specs.extend(
+                FaultSpec.from_dict(f) for f in data.get("faults", [])
+            )
+        return cls(specs, seed=seed)
+
+    def fire(self, seam: str) -> Optional[dict]:
+        """Record one event at ``seam``; return the firing spec's action
+        dict, or None. At most one spec fires per event (first match in
+        plan order)."""
+        with self._lock:
+            n = self._events.get(seam, 0)
+            self._events[seam] = n + 1
+            for spec in self.specs:
+                if spec.seam == seam and spec.decide(n, self._rng):
+                    action = {
+                        "seam": seam,
+                        "kind": spec.kind,
+                        "value": spec.value,
+                        "event": n,
+                    }
+                    self.injected.append(action)
+                    return action
+        return None
+
+
+# ------------------------------------------------------- process state
+
+_plan: Optional[FaultPlan] = None
+_journal = None
+_journal_lock = threading.Lock()
+
+
+def configure_chaos(config) -> Optional[FaultPlan]:
+    """Install the process fault plan from a MicroRankConfig (fresh
+    counters each call — one plan per run). Called by the stream engine
+    and the serve service at start; a config without chaos clears it."""
+    global _plan
+    _plan = FaultPlan.from_config(getattr(config, "chaos", None))
+    if _plan is not None and _plan.specs:
+        log.warning(
+            "chaos armed: %d fault spec(s), seed %d — this run WILL "
+            "misbehave on purpose", len(_plan.specs), _plan.seed,
+        )
+    return _plan
+
+
+def get_fault_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def set_chaos_journal(journal) -> None:
+    """Attach a RunJournal so every injected fault becomes a
+    ``fault_injected`` event next to the windows it disturbed."""
+    global _journal
+    with _journal_lock:
+        _journal = journal
+
+
+def record_injection(seam: str, kind: str, value: float = 0.0) -> None:
+    """Count one injected fault (metrics + journal) — the shared
+    recording surface planned faults AND the legacy knobs go through."""
+    from ..obs.metrics import record_fault_injection
+
+    record_fault_injection(seam, kind)
+    with _journal_lock:
+        j = _journal
+    if j is not None:
+        try:
+            j.emit("fault_injected", seam=seam, kind=kind, value=value)
+        except Exception:  # noqa: BLE001 - chaos must not add real faults
+            pass
+
+
+def maybe_inject(
+    seam: str, sleep: Callable[[float], None] = time.sleep
+) -> Optional[dict]:
+    """The one call every seam makes. Counts one event at ``seam``
+    against the installed plan; when a spec fires it is recorded
+    (metrics + journal) and then, by kind:
+
+    * ``fail``/``crash``/``http_5xx`` — raise :class:`InjectedFault`;
+    * ``latency``/``stall``/``hang`` — sleep ``value`` ms, return the
+      action;
+    * anything else (``nan``, ``torn_line``, ``rotation``) — return the
+      action for the caller to interpret.
+
+    No plan installed: a dict lookup and return, nothing else.
+    """
+    plan = _plan
+    if plan is None:
+        return None
+    action = plan.fire(seam)
+    if action is None:
+        return None
+    kind = action["kind"]
+    record_injection(seam, kind, value=action.get("value", 0.0))
+    log.warning("chaos: injecting %s at %s (event %d)",
+                kind, seam, action["event"])
+    if kind in _RAISING_KINDS:
+        raise InjectedFault(seam, kind)
+    if kind in _SLEEPING_KINDS and action.get("value", 0.0) > 0:
+        sleep(float(action["value"]) / 1e3)
+    return action

@@ -116,6 +116,20 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help="contributing coverage columns (traces) kept per suspect "
         "in explain bundles (default 5)",
     )
+    p.add_argument(
+        "--chaos", default=None, metavar="PLAN.json",
+        help="arm the unified fault-injection harness (chaos/): a "
+        'seeded JSON fault plan ({"seed": N, "faults": [{"seam": ..., '
+        '"kind": ..., ...}]}) injected deterministically at every '
+        "instrumented seam — dispatch/build/source/webhook/checkpoint/"
+        "fetch; injections land in "
+        "microrank_fault_injections_total and the journal",
+    )
+    p.add_argument(
+        "--chaos-seed", type=int, default=None,
+        help="RNG seed for probabilistic chaos fault specs (default: "
+        "the plan file's seed, else 0)",
+    )
     p.add_argument("--config-json", help="load a full MicroRankConfig dict")
 
 
@@ -199,10 +213,24 @@ def _config_from_args(args) -> "MicroRankConfig":
         }.items()
         if v is not None
     }
+    from ..config import ChaosConfig
+
+    chaos_overrides = {
+        k: v
+        for k, v in {
+            "enabled": (
+                True if getattr(args, "chaos", None) else None
+            ),
+            "plan_path": getattr(args, "chaos", None),
+            "seed": getattr(args, "chaos_seed", None),
+        }.items()
+        if v is not None
+    }
     cfg = MicroRankConfig(
         obs=ObsConfig(**obs_overrides),
         explain=ExplainConfig(**explain_overrides),
         dispatch=DispatchConfig(**dispatch_overrides),
+        chaos=ChaosConfig(**chaos_overrides),
         detector=DetectorConfig(
             k_sigma=args.k_sigma,
             slack_ms=args.slack_ms,
@@ -760,7 +788,21 @@ def cmd_stream(args) -> int:
         out_dir=args.output,
         normal_df=normal_df,
         incident_sinks=[StdoutIncidentSink()],
+        resume=bool(getattr(args, "resume", False)),
     )
+    # Crash-only shutdown: SIGTERM asks the engine to drain at the next
+    # batch boundary and write a final checkpoint — the process can be
+    # restarted with --resume and continue the SAME run.
+    import signal as _signal
+
+    def _on_sigterm(_signo, _frame):
+        log.info("SIGTERM: draining stream engine (checkpoint on exit)")
+        engine.request_stop()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except ValueError:  # pragma: no cover - not on the main thread
+        pass
     s = engine.run()
     for r in s.results:
         if r.ranking:
@@ -1221,6 +1263,14 @@ def main(argv=None) -> int:
         "from its own normal window)",
     )
     p_stream.add_argument("-o", "--output", default="stream_out")
+    p_stream.add_argument(
+        "--resume", action="store_true",
+        help="restore the engine's durable state checkpoint "
+        "(out_dir/state.ckpt: online SLO baselines, incident tracker, "
+        "windower watermark + buffered windows, source cursor) and "
+        "continue the crashed/stopped run — zero duplicate incidents, "
+        "no cold start, no re-ranked windows",
+    )
     p_stream.add_argument(
         "--slide-minutes", type=float, default=None,
         help="sliding-window step (default: tumbling windows of "

@@ -428,6 +428,35 @@ class ExplainConfig:
 
 
 @dataclass(frozen=True)
+class ChaosConfig:
+    """Unified fault-injection harness (``chaos/`` subsystem).
+
+    One seeded, deterministic ``FaultPlan`` drives every injection seam
+    the span tracer already instruments — dispatch failure/latency,
+    build-pool exception, source stall/rotation/torn-line, webhook
+    hang/5xx, checkpoint-write crash (kill between tmp and rename),
+    device-fetch NaN poison — instead of per-subsystem knobs. The
+    legacy knobs (``ServeConfig.inject_dispatch_failures``,
+    ``ObsConfig.inject_stage_sleep_ms``) keep working and are recorded
+    through the same surface
+    (``microrank_fault_injections_total{seam,kind}`` + journal
+    ``fault_injected`` events).
+    """
+
+    # Master switch (also set by ``--chaos PLAN.json``). Off: every
+    # maybe_inject() call is a None-check and the hot path pays nothing.
+    enabled: bool = False
+    # RNG seed for probabilistic specs (prob < 1); counting specs
+    # (after/count/every) are deterministic regardless.
+    seed: int = 0
+    # Path of a JSON fault plan: {"seed": N, "faults": [{spec}, ...]}.
+    plan_path: Optional[str] = None
+    # Inline fault specs (dicts with seam/kind/after/count/every/value/
+    # prob), merged before the plan file's.
+    faults: Tuple[Dict[str, Any], ...] = ()
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online RCA service knobs (``cli serve`` — serve/ subsystem).
 
@@ -536,6 +565,19 @@ class StreamConfig:
     # longer than this.
     webhook_url: Optional[str] = None
     webhook_timeout_seconds: float = 2.0
+    # Webhook delivery: a failed POST no longer silently loses the
+    # incident notification — it parks in a bounded retry queue and
+    # re-sends with backoff on later lifecycle traffic. Events past
+    # webhook_retry_max attempts (or evicted by a full queue) are
+    # dropped AND counted (microrank_webhook_dropped_total).
+    webhook_retry_max: int = 4
+    webhook_queue: int = 64
+    # Crash-only durability: checkpoint the engine's host state
+    # (baseline moments + P^2 markers, incident tracker, windower
+    # watermark + buffered open windows, source cursor) to
+    # out_dir/state.ckpt at every pipeline-drained window boundary, so
+    # `cli stream --resume` continues the run instead of cold-starting.
+    checkpoint: bool = True
     # Stop after this many CLOSED windows (0 = run until the source
     # ends) — the CI/smoke bound.
     max_windows: int = 0
@@ -554,6 +596,7 @@ class MicroRankConfig:
     dispatch: DispatchConfig = field(default_factory=DispatchConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     explain: ExplainConfig = field(default_factory=ExplainConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -580,6 +623,8 @@ class MicroRankConfig:
                 flt["mesh_axes"] = tuple(flt["mesh_axes"])
             if typ is ServeConfig and flt.get("warmup_occupancies") is not None:
                 flt["warmup_occupancies"] = tuple(flt["warmup_occupancies"])
+            if typ is ChaosConfig and flt.get("faults") is not None:
+                flt["faults"] = tuple(dict(f) for f in flt["faults"])
             return typ(**flt)
 
         return cls(
@@ -594,4 +639,5 @@ class MicroRankConfig:
             dispatch=_mk(DispatchConfig, d.get("dispatch", {})),
             obs=_mk(ObsConfig, d.get("obs", {})),
             explain=_mk(ExplainConfig, d.get("explain", {})),
+            chaos=_mk(ChaosConfig, d.get("chaos", {})),
         )

@@ -191,11 +191,12 @@ def record_manifest_entry(
                     "occupancies": occs,
                 }
             )
-        path = _manifest_path(cache_dir)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps({"programs": entries}, indent=2))
-        os.replace(tmp, path)
+        # Atomic + durable (tmp+fsync+rename, utils.atomic): the bare
+        # tmp+replace this used to do was atomic against readers but a
+        # power cut could still leave an empty rename target.
+        from ..utils.atomic import atomic_write_json
+
+        atomic_write_json(_manifest_path(cache_dir), {"programs": entries})
         from ..obs.metrics import record_compile_cache
 
         record_compile_cache("manifest_write")

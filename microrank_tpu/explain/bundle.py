@@ -243,12 +243,15 @@ class ExplainBundle:
 
     def write(self, dest) -> Path:
         """Write JSON + table under ``dest`` (a directory); returns the
-        JSON path."""
+        JSON path. Atomic per file (tmp+fsync+rename): incident bundles
+        are read back by `cli explain` and warm restarts — a SIGKILL
+        mid-write must leave either no bundle or a whole one."""
+        from ..utils.atomic import atomic_write_text
+
         dest = Path(dest)
         dest.mkdir(parents=True, exist_ok=True)
-        path = dest / BUNDLE_JSON
-        path.write_text(self.to_json())
-        (dest / BUNDLE_TXT).write_text(self.to_table())
+        path = atomic_write_text(dest / BUNDLE_JSON, self.to_json())
+        atomic_write_text(dest / BUNDLE_TXT, self.to_table())
         return path
 
     @classmethod

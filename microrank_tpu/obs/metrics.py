@@ -361,6 +361,64 @@ def mrsan_collectives() -> Counter:
     )
 
 
+def retry_attempts() -> Counter:
+    return get_registry().counter(
+        "microrank_retry_attempts_total",
+        "Retry attempts (second and later tries) through the unified "
+        "retry policy (chaos.retry), by seam — a healthy seam exposes "
+        "this at zero",
+        labelnames=("seam",),
+    )
+
+
+def retry_exhausted() -> Counter:
+    return get_registry().counter(
+        "microrank_retry_exhausted_total",
+        "Retried calls that gave up after the policy's max attempts, "
+        "by seam (the caller's containment/degradation path took over)",
+        labelnames=("seam",),
+    )
+
+
+def breaker_state() -> Gauge:
+    return get_registry().gauge(
+        "microrank_breaker_state",
+        "Circuit breaker state per retried seam: 0=closed, 1=open "
+        "(fast-failing), 2=half-open (probing)",
+        labelnames=("seam",),
+    )
+
+
+def fault_injections() -> Counter:
+    return get_registry().counter(
+        "microrank_fault_injections_total",
+        "Faults injected by the chaos harness (chaos.faults: a seeded "
+        "FaultPlan or a legacy inject_* knob), by seam and kind — "
+        "nonzero only when chaos is armed",
+        labelnames=("seam", "kind"),
+    )
+
+
+def webhook_dropped() -> Counter:
+    return get_registry().counter(
+        "microrank_webhook_dropped_total",
+        "Incident webhook events dropped after exhausting the sink's "
+        "bounded retry queue (max attempts reached or queue overflow)",
+    )
+
+
+def checkpoint_events() -> Counter:
+    return get_registry().counter(
+        "microrank_checkpoint_events_total",
+        "Engine state-checkpoint events: write per durable state.ckpt, "
+        "restore on a successful --resume, rejected when a corrupt/"
+        "incompatible checkpoint was refused (cold start), "
+        "crash_injected when the chaos seam killed a write between tmp "
+        "and rename (the previous checkpoint survives)",
+        labelnames=("event",),  # write | restore | rejected | crash_injected
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -395,6 +453,8 @@ def ensure_catalog() -> None:
         spans_recorded, flight_dumps, device_hbm_bytes,
         kernel_ms_per_iter, profile_sessions, explain_bundles,
         mrsan_checks, mrsan_violations, mrsan_collectives,
+        retry_attempts, retry_exhausted, breaker_state,
+        fault_injections, webhook_dropped, checkpoint_events,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -492,6 +552,30 @@ def record_mrsan_violation(kind: str, n: int = 1) -> None:
 
 def record_mrsan_collective(op: str, n: int = 1) -> None:
     mrsan_collectives().inc(float(n), op=op)
+
+
+def record_retry(seam: str) -> None:
+    retry_attempts().inc(seam=seam)
+
+
+def record_retry_exhausted(seam: str) -> None:
+    retry_exhausted().inc(seam=seam)
+
+
+def record_breaker_state(seam: str, state: float) -> None:
+    breaker_state().set(float(state), seam=seam)
+
+
+def record_fault_injection(seam: str, kind: str) -> None:
+    fault_injections().inc(seam=seam, kind=kind)
+
+
+def record_webhook_dropped(n: int = 1) -> None:
+    webhook_dropped().inc(float(n))
+
+
+def record_checkpoint(event: str) -> None:
+    checkpoint_events().inc(event=event)
 
 
 def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:

@@ -334,15 +334,19 @@ class MetricsRegistry:
 
     def write_snapshot(self, out_dir) -> None:
         """Persist both exposition forms into a run's output directory
-        (``metrics.json`` + ``metrics.prom``) for offline ``cli stats``."""
+        (``metrics.json`` + ``metrics.prom``) for offline ``cli stats``.
+        Atomic (tmp+fsync+rename): a SIGKILL mid-write must not leave a
+        torn snapshot that poisons the next `cli stats`/warm start."""
         from pathlib import Path
+
+        from ..utils.atomic import atomic_write_text
 
         out = Path(out_dir)
         out.mkdir(parents=True, exist_ok=True)
-        (out / "metrics.json").write_text(
-            json.dumps(self.to_json(), indent=2)
+        atomic_write_text(
+            out / "metrics.json", json.dumps(self.to_json(), indent=2)
         )
-        (out / "metrics.prom").write_text(self.to_prometheus())
+        atomic_write_text(out / "metrics.prom", self.to_prometheus())
 
 
 def registry_from_json(data: dict) -> MetricsRegistry:

@@ -218,6 +218,16 @@ class SpanTracer:
             return
         self._inject_seen += 1
         if (self._inject_seen - 1) % self.inject_every == 0:
+            # Legacy knob aliased onto the unified chaos surface: the
+            # firing is recorded like any FaultPlan injection
+            # (microrank_fault_injections_total + journal), the sleep
+            # itself stays here.
+            from ..chaos.faults import record_injection
+
+            record_injection(
+                f"stage:{self.inject_stage}", "latency",
+                value=self.inject_sleep_ms,
+            )
             time.sleep(self.inject_sleep_ms / 1e3)
 
     def _record(self, span: Span) -> None:

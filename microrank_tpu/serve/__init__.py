@@ -9,6 +9,7 @@ faults degrade to the numpy_ref oracle instead of dropping requests.
 from .admission import AdmissionController
 from .batcher import MicroBatcher, PendingWindow, bucket_key
 from .protocol import (
+    DeadlineExceeded,
     ProtocolError,
     RankRequest,
     parse_rank_request,
@@ -28,6 +29,7 @@ from .server import (
 __all__ = [
     "AdmissionController",
     "BatchScheduler",
+    "DeadlineExceeded",
     "HttpFrontend",
     "MicroBatcher",
     "PendingWindow",

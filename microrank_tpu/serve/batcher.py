@@ -196,27 +196,75 @@ class MicroBatcher:
             nxt = batches[i + 1] if i + 1 < len(batches) else None
             self.dispatch(batch, next_items=nxt)
 
+    def _expire_deadlined(
+        self, items: List[PendingWindow]
+    ) -> List[PendingWindow]:
+        """Drop batch members whose caller ``deadline_ms`` elapsed
+        while they were parked — their answer is already abandoned, so
+        staging them only burns device time (504 + journal event)."""
+        from .protocol import DeadlineExceeded
+
+        live: List[PendingWindow] = []
+        now = time.monotonic()
+        for pw in items:
+            dl = getattr(pw.request, "deadline_ms", None)
+            waited_ms = (now - pw.enqueued) * 1e3
+            if not dl or waited_ms <= float(dl):
+                live.append(pw)
+                continue
+            pw.result.skipped_reason = "deadline_expired"
+            if self.journal is not None:
+                self.journal.emit(
+                    "request_deadline_expired",
+                    request_id=pw.request.request_id,
+                    tenant=pw.request.tenant,
+                    deadline_ms=float(dl),
+                    waited_ms=round(waited_ms, 3),
+                    stage="batch",
+                )
+            pw.finish(
+                error=DeadlineExceeded(
+                    f"request {pw.request.request_id} expired before "
+                    f"dispatch: waited {waited_ms:.0f} ms of a "
+                    f"{float(dl):.0f} ms deadline"
+                )
+            )
+        return live
+
     def dispatch(
         self,
         items: List[PendingWindow],
         warmup=False,
         next_items: Optional[List[PendingWindow]] = None,
     ) -> None:
-        """Rank one coalesced batch; resolves every member's future."""
+        """Rank one coalesced batch; resolves every member's future.
+
+        The historical bare one-shot retry now rides the unified
+        policy (chaos.retry DISPATCH_POLICY: max_attempts=2 keeps the
+        same shape, plus jittered backoff, breaker accounting and the
+        shared ``microrank_retry_attempts_total{seam="serve_dispatch"}``
+        counter); exhaustion degrades exactly as before."""
+        from ..chaos import DISPATCH_POLICY, retry_call
+
+        if not warmup:
+            items = self._expire_deadlined(items)
+            if not items:
+                return
         t0 = time.monotonic()
         route_info = None
         try:
-            outs, route_info = self._device_dispatch(items, next_items)
-        except Exception as first:
-            self._log().warning(
-                "batch dispatch failed (%d windows): %s; retrying once",
-                len(items), first,
+            outs, route_info = retry_call(
+                "serve_dispatch",
+                lambda: self._device_dispatch(items, next_items),
+                policy=DISPATCH_POLICY,
+                on_retry=lambda attempt, e, delay: self._log().warning(
+                    "batch dispatch failed (%d windows): %s; retrying",
+                    len(items), e,
+                ),
             )
-            try:
-                outs, route_info = self._device_dispatch(items, next_items)
-            except Exception as second:
-                self._degrade(items, second, warmup=warmup)
-                return
+        except Exception as final:
+            self._degrade(items, final, warmup=warmup)
+            return
         batch_ms = (time.monotonic() - t0) * 1e3
         self._assign(items, outs, batch_ms, route_info)
         if not warmup:
@@ -304,8 +352,16 @@ class MicroBatcher:
         items: List[PendingWindow],
         next_items: Optional[List[PendingWindow]] = None,
     ):
+        # Chaos: the unified serve_dispatch seam, plus the legacy knob
+        # (ServeConfig.inject_dispatch_failures) now ALIASED onto the
+        # same recording surface — either way the injection lands in
+        # microrank_fault_injections_total{seam="serve_dispatch"}.
+        from ..chaos import maybe_inject, record_injection
+
+        maybe_inject("serve_dispatch")
         if self._inject_failures > 0:
             self._inject_failures -= 1
+            record_injection("serve_dispatch", "fail")
             raise RuntimeError(
                 "injected device dispatch failure "
                 "(ServeConfig.inject_dispatch_failures)"

@@ -47,6 +47,14 @@ class ProtocolError(ValueError):
     status = 400
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request's ``deadline_ms`` elapsed before its window staged —
+    the service expires it (504) instead of dispatching device work
+    nobody is waiting for."""
+
+    status = 504
+
+
 @dataclass
 class RankRequest:
     request_id: str
@@ -57,6 +65,11 @@ class RankRequest:
     end: Optional[str] = None
     # Rank provenance: build + return an ExplainBundle for this window.
     explain: bool = False
+    # Caller's patience bound: once this many milliseconds pass from
+    # admission, the request EXPIRES (504) at the next scheduling
+    # point instead of staging device work whose answer is already
+    # abandoned — a burst cannot convert into dead dispatches.
+    deadline_ms: Optional[float] = None
     # W3C trace context of the caller, parsed from the ``traceparent``
     # header: (trace_id, parent_span_id) or None.
     traceparent: Optional[Tuple[str, str]] = None
@@ -109,6 +122,16 @@ def parse_rank_request(
     request_id = str(
         data.get("request_id") or f"req-{next(_req_counter)}"
     )
+    deadline_ms = data.get("deadline_ms")
+    if deadline_ms is not None:
+        try:
+            deadline_ms = float(deadline_ms)
+        except (TypeError, ValueError):
+            raise ProtocolError(
+                f'"deadline_ms" must be a number, got {deadline_ms!r}'
+            ) from None
+        if deadline_ms <= 0:
+            raise ProtocolError('"deadline_ms" must be > 0')
     return RankRequest(
         request_id=request_id,
         tenant=tenant,
@@ -117,6 +140,7 @@ def parse_rank_request(
         start=data.get("start"),
         end=data.get("end"),
         explain=bool(data.get("explain", False)),
+        deadline_ms=deadline_ms,
         traceparent=parse_traceparent(traceparent),
     )
 

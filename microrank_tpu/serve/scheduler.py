@@ -157,9 +157,45 @@ class BatchScheduler(threading.Thread):
         with self._cond:
             return self._builds
 
+    def _expire_if_past_deadline(self, entry) -> bool:
+        """Per-request ``deadline_ms``: a queued request whose caller
+        deadline elapsed before its window staged is expired HERE (504
+        + journal event) — a burst cannot dispatch device work nobody
+        is waiting for. Returns True when the entry was expired."""
+        request, fut, enqueued, on_done, _ctx = entry
+        dl = getattr(request, "deadline_ms", None)
+        if not dl:
+            return False
+        waited_ms = (time.monotonic() - enqueued) * 1e3
+        if waited_ms <= float(dl):
+            return False
+        from .protocol import DeadlineExceeded
+
+        err = DeadlineExceeded(
+            f"request {request.request_id} expired in queue: waited "
+            f"{waited_ms:.0f} ms of a {float(dl):.0f} ms deadline"
+        )
+        if not fut.done():
+            fut.set_exception(err)
+        if on_done is not None:
+            on_done(None, err)
+        journal = getattr(self.service, "journal", None)
+        if journal is not None:
+            journal.emit(
+                "request_deadline_expired",
+                request_id=request.request_id,
+                tenant=request.tenant,
+                deadline_ms=float(dl),
+                waited_ms=round(waited_ms, 3),
+                stage="queue",
+            )
+        return True
+
     def _process(self, entry) -> None:
         from ..obs.spans import get_tracer
 
+        if self._expire_if_past_deadline(entry):
+            return
         request, fut, enqueued, on_done, ctx = entry
         tracer = get_tracer()
         if self.build_pool is None:

@@ -133,6 +133,7 @@ class ServeService:
 
     def start(self) -> None:
         from ..analysis.mrsan import configure_sanitizers
+        from ..chaos import configure_chaos, set_chaos_journal
         from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
         from ..utils.guards import claim_device_owner
@@ -142,6 +143,8 @@ class ServeService:
         ensure_catalog()
         configure_tracer(self.config.obs)  # fresh span ring per service
         configure_sanitizers(self.config)  # mrsan arm/disarm + reset
+        configure_chaos(self.config)       # fault plan arm/disarm
+        set_chaos_journal(self.journal)    # fault_injected -> journal
         # Warmup dispatches run on THIS thread before the scheduler
         # exists; the scheduler thread re-claims when it starts.
         claim_device_owner("serve-warmup")
@@ -237,15 +240,27 @@ class ServeService:
         from ..obs.metrics import record_serve_request
 
         self.admission.release()
-        if pw is None:  # abandoned by a non-draining shutdown
-            record_serve_request("failed")
+        if pw is None:  # expired in queue, or abandoned by a
+            # non-draining shutdown — no built window to journal.
+            from .protocol import DeadlineExceeded
+
+            record_serve_request(
+                "expired"
+                if isinstance(error, DeadlineExceeded)
+                else "failed"
+            )
             return
         result = pw.result
         total_s = time.monotonic() - pw.enqueued
         if error is not None:
-            outcome = (
-                "invalid" if isinstance(error, ProtocolError) else "failed"
-            )
+            from .protocol import DeadlineExceeded
+
+            if isinstance(error, ProtocolError):
+                outcome = "invalid"
+            elif isinstance(error, DeadlineExceeded):
+                outcome = "expired"
+            else:
+                outcome = "failed"
         elif result.ranking:
             outcome = "ranked"
         elif result.skipped_reason:
@@ -562,6 +577,17 @@ class HttpFrontend:
         except ProtocolError as e:
             return 400, "application/json", error_body(str(e))
         except Exception as e:
+            from .protocol import DeadlineExceeded
+
+            if isinstance(e, DeadlineExceeded):
+                # The service expired the request at its caller-supplied
+                # deadline_ms before staging it — same status as the
+                # frontend's own wait timeout, but no work was wasted.
+                return (
+                    504,
+                    "application/json",
+                    error_body(str(e), request_id=request.request_id),
+                )
             return (
                 500,
                 "application/json",

@@ -180,6 +180,28 @@ class P2Quantile:
             return float(np.quantile(h, self.q))
         return float(self.heights[2])
 
+    # ------------------------------------------------------- durability
+    def to_state(self) -> dict:
+        """JSON-serializable estimator state (chaos.checkpoint): the
+        five marker heights/positions ARE the whole estimator, so a
+        restore is bit-faithful."""
+        return {
+            "q": self.q,
+            "n": self.n,
+            "heights": [float(h) for h in self.heights],
+            "pos": [float(p) for p in self.pos],
+            "desired": [float(d) for d in self.desired],
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "P2Quantile":
+        p2 = cls(float(state["q"]))
+        p2.n = int(state["n"])
+        p2.heights = [float(h) for h in state["heights"]]
+        p2.pos = np.asarray(state["pos"], dtype=float)
+        p2.desired = np.asarray(state["desired"], dtype=float)
+        return p2
+
 
 class _OpState:
     """One operation's online baseline state (durations in ms)."""
@@ -277,6 +299,55 @@ class OnlineBaseline:
                 st.p2.update_batch(vals)
         self.n_updates += 1
         return True
+
+    # ------------------------------------------------------- durability
+    def to_state(self) -> dict:
+        """The full baseline as JSON-serializable checkpoint state: the
+        exp-decay moments and P^2 markers per op, plus the arming/freeze
+        flags — a restore resumes detection exactly where the crashed
+        process left it (no cold-start window gating)."""
+        return {
+            "decay": self.decay,
+            "slo_stat": self.slo_stat,
+            "min_windows": self.min_windows,
+            "frozen": self.frozen,
+            "seeded": self.seeded,
+            "n_updates": self.n_updates,
+            "n_frozen_skips": self.n_frozen_skips,
+            "ops": {
+                name: {
+                    "m1": st.m1,
+                    "m2": st.m2,
+                    "windows": st.windows,
+                    "p2": None if st.p2 is None else st.p2.to_state(),
+                }
+                for name, st in self._ops.items()
+            },
+        }
+
+    def restore(self, state: dict) -> None:
+        """Overwrite this baseline with checkpointed state. The SLO
+        statistic must match — a p99 marker array is meaningless under a
+        mean-configured detector (raises ValueError; the engine treats
+        that as an unusable checkpoint)."""
+        if state.get("slo_stat") != self.slo_stat:
+            raise ValueError(
+                f"checkpoint baseline slo_stat {state.get('slo_stat')!r} "
+                f"!= configured {self.slo_stat!r}"
+            )
+        self.frozen = bool(state.get("frozen", False))
+        self.seeded = bool(state.get("seeded", False))
+        self.n_updates = int(state.get("n_updates", 0))
+        self.n_frozen_skips = int(state.get("n_frozen_skips", 0))
+        self._ops = {}
+        for name, op in state.get("ops", {}).items():
+            st = _OpState(self.quantile)
+            st.m1 = float(op["m1"])
+            st.m2 = float(op["m2"])
+            st.windows = int(op.get("windows", 0))
+            if op.get("p2") is not None and self.quantile is not None:
+                st.p2 = P2Quantile.from_state(op["p2"])
+            self._ops[str(name)] = st
 
     # ----------------------------------------------------------- egress
     def snapshot(self) -> Tuple[Vocab, SloBaseline]:

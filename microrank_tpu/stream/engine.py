@@ -31,6 +31,17 @@ its programs as cache reloads instead of ~1.7 s cold compiles.
 Baseline poisoning guard: baselines update only on healthy windows and
 freeze while any incident is open, so a fault's own latencies cannot
 absorb into the SLO and mask the recovery.
+
+Crash-only (chaos/): the engine's host state — baseline moments + P^2
+markers, incident tracker, windower watermark + buffered open windows,
+source cursor — checkpoints atomically to ``out_dir/state.ckpt`` at
+every pipeline-drained window boundary and on the SIGTERM drain;
+``cli stream --resume`` restores it, so a restart opens ZERO duplicate
+incidents, re-enters no cold start, and re-ranks no finalized window.
+Dispatch and build go through the unified retry policy (chaos.retry:
+backoff + jitter + per-seam breaker), and every seam consults the
+seeded FaultPlan (``--chaos PLAN.json``) — the chaos the paper injects
+into the systems MicroRank watches, injected into MicroRank itself.
 """
 
 from __future__ import annotations
@@ -118,12 +129,14 @@ class StreamEngine:
         out_dir=None,
         normal_df=None,
         incident_sinks: Optional[List] = None,
+        resume: bool = False,
     ):
         self.config = config
         sc = config.stream
         self.source = source
         self.log = get_logger("microrank_tpu.stream")
         self.out_dir = Path(out_dir) if out_dir is not None else None
+        self._stop_requested = False
         slide_us = (
             None
             if sc.slide_minutes is None
@@ -165,7 +178,10 @@ class StreamEngine:
         if sc.webhook_url:
             sinks.append(
                 WebhookIncidentSink(
-                    sc.webhook_url, timeout=sc.webhook_timeout_seconds
+                    sc.webhook_url,
+                    timeout=sc.webhook_timeout_seconds,
+                    max_attempts=sc.webhook_retry_max,
+                    max_queue=sc.webhook_queue,
                 )
             )
         self.tracker = IncidentTracker(
@@ -200,10 +216,118 @@ class StreamEngine:
             self.flight = FlightRecorder(
                 self.out_dir, config.obs, journal=self.journal
             )
+        # Crash-only durability (chaos.checkpoint): state.ckpt under the
+        # run dir, written at every pipeline-drained window boundary.
+        from ..chaos import CHECKPOINT_NAME
+
+        self._ckpt_path = (
+            self.out_dir / CHECKPOINT_NAME
+            if self.out_dir is not None and sc.checkpoint
+            else None
+        )
+        self.resumed = False
+        if resume:
+            self._restore_checkpoint()
+
+    # ------------------------------------------------------ durability
+    def request_stop(self) -> None:
+        """Ask the engine to drain and exit (the SIGTERM path): the run
+        loop stops consuming the source at the next batch boundary,
+        pending ranks drain, and a final checkpoint is written — a
+        subsequent ``--resume`` continues the run."""
+        self._stop_requested = True
+
+    def _restore_checkpoint(self) -> None:
+        """``--resume``: load + verify state.ckpt and overwrite the
+        fresh components with the crashed run's state. Any defect —
+        corrupt file, version/checksum mismatch, incompatible config —
+        rejects the WHOLE checkpoint (never a partial restore) and the
+        engine cold-starts, which is always safe."""
+        from ..chaos import CheckpointError, load_checkpoint
+        from ..obs.metrics import record_checkpoint
+
+        if self._ckpt_path is None or not self._ckpt_path.exists():
+            if self._ckpt_path is not None:
+                self.log.info(
+                    "--resume: no checkpoint at %s; starting fresh",
+                    self._ckpt_path,
+                )
+            return
+        try:
+            payload = load_checkpoint(self._ckpt_path)
+            self.baseline.restore(payload["baseline"])
+            self.tracker.restore(payload["tracker"])
+            self.windower.restore(payload["windower"])
+            src_state = payload.get("source")
+            if src_state is not None and hasattr(
+                self.source, "restore_state"
+            ):
+                self.source.restore_state(src_state)
+            for k, v in payload.get("summary", {}).items():
+                if hasattr(self.summary, k) and k != "results":
+                    setattr(self.summary, k, v)
+        except (CheckpointError, KeyError, ValueError) as e:
+            record_checkpoint("rejected")
+            self.log.warning(
+                "--resume: checkpoint rejected (%s); cold start", e
+            )
+            return
+        self.resumed = True
+        record_checkpoint("restore")
+        self.log.info(
+            "resumed from %s: %d windows done, %d open incident(s), "
+            "watermark at window %d",
+            self._ckpt_path, self.summary.windows,
+            len(self.tracker.open_incidents()), self.windower._next,
+        )
+
+    def _checkpoint(self) -> None:
+        """Write state.ckpt — only at a drained boundary (no pending
+        ranks: every window the watermark sealed has been finalized, so
+        the captured windower/source cursors mark nothing as done that
+        a crash could lose)."""
+        if self._ckpt_path is None or self._pending:
+            return
+        from ..chaos import InjectedFault, save_checkpoint
+        from ..obs.metrics import record_checkpoint
+
+        src_state = None
+        ckpt_fn = getattr(self.source, "checkpoint_state", None)
+        if callable(ckpt_fn):
+            src_state = ckpt_fn()
+        payload = {
+            "baseline": self.baseline.to_state(),
+            "tracker": self.tracker.to_state(),
+            "windower": self.windower.to_state(),
+            "source": src_state,
+            "summary": {
+                k: getattr(self.summary, k)
+                for k in (
+                    "windows", "ranked", "clean", "empty", "skipped",
+                    "warmup", "dispatches", "late_spans",
+                    "incidents_opened", "incidents_resolved",
+                )
+            },
+        }
+        try:
+            save_checkpoint(self._ckpt_path, payload)
+            record_checkpoint("write")
+        except InjectedFault:
+            # The chaos seam killed the write between tmp and rename:
+            # exactly the crash the atomic protocol survives — the
+            # previous checkpoint is intact and stays authoritative.
+            record_checkpoint("crash_injected")
+            self.log.warning(
+                "chaos: checkpoint write crashed between tmp and "
+                "rename; previous checkpoint stands"
+            )
+        except OSError as e:
+            self.log.warning("checkpoint write failed: %s", e)
 
     # ------------------------------------------------------------------ run
     def run(self) -> StreamSummary:
         from ..analysis.mrsan import configure_sanitizers
+        from ..chaos import configure_chaos, set_chaos_journal
         from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
         from ..utils.guards import claim_device_owner
@@ -211,6 +335,8 @@ class StreamEngine:
         ensure_catalog()
         configure_tracer(self.config.obs)  # fresh span ring per run
         configure_sanitizers(self.config)  # mrsan arm/disarm + reset
+        configure_chaos(self.config)       # fault plan arm/disarm
+        set_chaos_journal(self.journal)    # fault_injected -> journal
         # The engine thread is the sole jax toucher on the stream path
         # (program-order rule); builds go to the pool, sinks stay host.
         claim_device_owner("stream-engine")
@@ -225,13 +351,17 @@ class StreamEngine:
                 slide_minutes=sc.slide_minutes,
                 lateness_seconds=sc.allowed_lateness_seconds,
                 seeded=self.baseline.seeded,
+                resumed=self.resumed,
             )
         try:
             done = False
             for batch in self.source:
+                if self._stop_requested:
+                    done = True
+                    break
                 for w in self.windower.add(batch):
                     self._process(w)
-                    if self._max_reached():
+                    if self._max_reached() or self._stop_requested:
                         done = True
                         break
                 if done:
@@ -246,6 +376,14 @@ class StreamEngine:
             self.pool.shutdown()
             self._record_manifest()
             self.summary.late_spans = self.windower.dropped_late
+            # Final durable state: on a SIGTERM drain (or a clean end)
+            # the checkpoint is the run's resumable truth. An exception
+            # mid-flight may leave pending ranks — _checkpoint refuses
+            # that state and the last boundary checkpoint stands.
+            self._checkpoint()
+            if self._stop_requested and self.journal is not None:
+                self.journal.emit("sigterm_drain", resumable=True)
+            self._flush_webhooks()
             if self.journal is not None:
                 self.journal.run_end(
                     windows=self.summary.windows,
@@ -255,6 +393,7 @@ class StreamEngine:
                     incidents_opened=self.summary.incidents_opened,
                     incidents_resolved=self.summary.incidents_resolved,
                 )
+            set_chaos_journal(None)
             if (
                 self.out_dir is not None
                 and self.config.runtime.telemetry
@@ -263,6 +402,18 @@ class StreamEngine:
 
                 get_registry().write_snapshot(self.out_dir)
         return self.summary
+
+    def _flush_webhooks(self) -> None:
+        """Drain-time best effort for webhook sinks' retry queues: one
+        flush pass per sink (entries still failing stay dropped-on-
+        restart — the checkpoint does not carry undelivered alerts)."""
+        for sink in self.tracker.sinks:
+            flush = getattr(sink, "flush", None)
+            if callable(flush):
+                try:
+                    flush()
+                except Exception:  # noqa: BLE001 - drain must complete
+                    pass
 
     def _max_reached(self) -> bool:
         mw = self.config.stream.max_windows
@@ -384,15 +535,30 @@ class StreamEngine:
 
     # ---------------------------------------------------------- ranking
     def _prepare(self, frame, nrm, abn):
-        """The build-pool unit: prepared graph plus (when the explain
-        subsystem is armed) the coverage-column retention context the
-        incident bundle joins device attributions against. Uniform
-        4-tuple so the rank path never branches on the config."""
+        """The build-pool unit, under the unified retry policy: a
+        build-pool exception (incl. the ``build`` chaos seam) retries
+        with backoff ON the worker before it can surface as a skipped
+        window — a transient build fault costs latency, not a window."""
+        from ..chaos import BUILD_POLICY, retry_call
+
+        return retry_call(
+            "build",
+            lambda: self._prepare_impl(frame, nrm, abn),
+            policy=BUILD_POLICY,
+        )
+
+    def _prepare_impl(self, frame, nrm, abn):
+        """Prepared graph plus (when the explain subsystem is armed)
+        the coverage-column retention context the incident bundle joins
+        device attributions against. Uniform 4-tuple so the rank path
+        never branches on the config."""
+        from ..chaos import maybe_inject
         from ..rank_backends.jax_tpu import (
             prepare_window_graph,
             prepare_window_graph_explained,
         )
 
+        maybe_inject("build")
         if self.config.explain.enabled:
             return prepare_window_graph_explained(
                 frame, nrm, abn, self.config
@@ -500,13 +666,33 @@ class StreamEngine:
                     pass
         head_trace = group[0][0].trace
         t0 = time.monotonic()
+
+        def _attempt():
+            """One dispatch attempt under the unified retry policy:
+            the ``dispatch`` seam fires before the router (injected
+            failure/latency), the ``fetch`` seam after it (a fired
+            ``nan`` action poisons THIS attempt — the retry refetches
+            clean, so validation never sees the poison)."""
+            from ..chaos import InjectedFault, maybe_inject
+
+            maybe_inject("dispatch")
+            with contract_checks(rt.validate_numerics):
+                o, i = self.router.rank_batch(
+                    graphs, kernel, conv_trace=conv, next_batch=next_batch
+                )
+            if maybe_inject("fetch") is not None:
+                raise InjectedFault("fetch", "nan")
+            return o, i
+
+        from ..chaos.retry import STREAM_DISPATCH_POLICY, retry_call
+
         with get_tracer().attach(
             head_trace.ctx if head_trace is not None else None
         ):
-            with contract_checks(rt.validate_numerics):
-                outs, info = self.router.rank_batch(
-                    graphs, kernel, conv_trace=conv, next_batch=next_batch
-                )
+            outs, info = retry_call(
+                "stream_dispatch", _attempt,
+                policy=STREAM_DISPATCH_POLICY,
+            )
         record_stream_dispatch()
         self.summary.dispatches += 1
         occs = self._warmed.setdefault(info.kernel, set())
@@ -565,13 +751,17 @@ class StreamEngine:
         # checkify program gained its residual-traced twin.
         conv = bool(rt.convergence_trace)
         t0 = time.monotonic()
-        with tracer.attach(trace.ctx if trace is not None else None):
+
+        def _attempt():
+            from ..chaos import maybe_inject
+
+            maybe_inject("dispatch")
             with tracer.span(
                 "device_dispatch", service="stream", kernel=kernel,
                 checked=True,
             ):
                 with contract_checks(rt.validate_numerics):
-                    out = stage_rank_window(
+                    staged = stage_rank_window(
                         graph,
                         self.config.pagerank,
                         self.config.spectrum,
@@ -581,7 +771,15 @@ class StreamEngine:
                         conv_trace=conv,
                     )
             with tracer.span("result_fetch", service="stream"):
-                out = jax.device_get(out)
+                return jax.device_get(staged)
+
+        from ..chaos.retry import STREAM_DISPATCH_POLICY, retry_call
+
+        with tracer.attach(trace.ctx if trace is not None else None):
+            out = retry_call(
+                "stream_dispatch", _attempt,
+                policy=STREAM_DISPATCH_POLICY,
+            )
         record_stream_dispatch()
         self.summary.dispatches += 1
         top_idx, top_scores, n_valid = out[:3]
@@ -751,6 +949,11 @@ class StreamEngine:
                 service="stream",
                 outcome=outcome,
             )
+        # Durable boundary: this window's effects (sink lines, incident
+        # transitions, baseline absorption) are on disk — capture the
+        # state that makes them exactly-once across a restart. No-op
+        # while pending ranks exist (the burst's drain boundary writes).
+        self._checkpoint()
 
     def _link_bundle(self, dump_dir) -> None:
         """Cross-link the explain bundle in the flight manifest."""

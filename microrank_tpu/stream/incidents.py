@@ -116,6 +116,36 @@ class Incident:
             **extra,
         }
 
+    # ------------------------------------------------------- durability
+    def to_state(self) -> dict:
+        return {
+            "incident_id": self.incident_id,
+            "fingerprint": sorted(self.fingerprint),
+            "opened_at": self.opened_at,
+            "last_seen": self.last_seen,
+            "windows": self.windows,
+            "healthy_streak": self.healthy_streak,
+            "top": [[str(n), float(s)] for n, s in self.top],
+            "status": self.status,
+            "scores": {str(k): float(v) for k, v in self.scores.items()},
+            "drift_events": self.drift_events,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "Incident":
+        return cls(
+            incident_id=str(state["incident_id"]),
+            fingerprint=frozenset(state["fingerprint"]),
+            opened_at=state["opened_at"],
+            last_seen=state["last_seen"],
+            windows=int(state.get("windows", 1)),
+            healthy_streak=int(state.get("healthy_streak", 0)),
+            top=[(str(n), float(s)) for n, s in state.get("top", [])],
+            status=state.get("status", "open"),
+            scores=dict(state.get("scores", {})),
+            drift_events=int(state.get("drift_events", 0)),
+        )
+
 
 class JsonlIncidentSink:
     """Append one JSON line per lifecycle transition."""
@@ -142,24 +172,106 @@ class StdoutIncidentSink:
 
 
 class WebhookIncidentSink:
-    """Best-effort JSON POST per transition, never raises.
+    """JSON POST per transition with a bounded retry queue, never raises.
 
-    The sink runs ON the engine thread, so the POST is bounded by an
+    The sink runs ON the engine thread, so every POST is bounded by an
     EXPLICIT timeout (``StreamConfig.webhook_timeout_seconds``) applied
     to connect AND read — a hung endpoint costs at most ``timeout``
     per transition, it cannot stall windowing/ranking indefinitely.
-    The payload enriches the raw lifecycle event with the top-k
-    ``suspects`` (name, score pairs at the fingerprint cut) and, when
-    the explain subsystem produced one, the ``explain_bundle`` path.
+
+    Delivery is no longer fire-and-forget: a failed POST parks the
+    event in a bounded FIFO with a per-event backoff schedule (the
+    unified WEBHOOK_POLICY from chaos.retry — exponential, jittered)
+    and re-sends due entries on later ``emit``/``flush`` calls, WITHOUT
+    ever sleeping on the engine thread. An event is dropped — and
+    counted in ``microrank_webhook_dropped_total`` — only after
+    ``max_attempts`` failed sends, or when the full queue evicts its
+    oldest entry. The payload enriches the raw lifecycle event with the
+    top-k ``suspects`` and, when the explain subsystem produced one,
+    the ``explain_bundle`` path. The ``webhook`` chaos seam fires
+    inside each send (hang = bounded sleep, 5xx/fail = simulated
+    failure) so the queue's behavior is drivable without a real wedged
+    endpoint.
     """
 
-    def __init__(self, url: str, timeout: float = 2.0):
+    def __init__(
+        self,
+        url: str,
+        timeout: float = 2.0,
+        max_attempts: int = 4,
+        max_queue: int = 64,
+        clock=time.monotonic,
+    ):
+        from collections import deque
+
+        from ..chaos.retry import WEBHOOK_POLICY
+
         self.url = url
         self.timeout = max(0.1, float(timeout))
-        self.failures = 0
+        self.max_attempts = max(1, int(max_attempts))
+        self.max_queue = max(1, int(max_queue))
+        self.clock = clock
+        self.policy = WEBHOOK_POLICY
+        self.failures = 0   # failed POST attempts (cumulative)
+        self.delivered = 0
+        self.dropped = 0
+        self._queue = deque()   # entries: [event, attempts, next_due]
 
     def emit(self, event: dict) -> None:
+        self.flush()
+        self._attempt(event, attempts=0)
+
+    def flush(self) -> None:
+        """Re-send every queued event whose backoff elapsed (called on
+        each lifecycle transition and at engine drain; one pass, no
+        sleeping — not-yet-due entries keep waiting)."""
+        now = self.clock()
+        for _ in range(len(self._queue)):
+            entry = self._queue.popleft()
+            event, attempts, due = entry
+            if due > now:
+                self._queue.append(entry)
+                continue
+            self._attempt(event, attempts)
+
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def _attempt(self, event: dict, attempts: int) -> None:
+        import random as _random
+
+        from ..chaos.retry import record_attempt
+
+        if attempts > 0:
+            record_attempt("webhook")
+        if self._send(event):
+            self.delivered += 1
+            return
+        self.failures += 1
+        attempts += 1
+        if attempts >= self.max_attempts:
+            self._drop(event, f"{attempts} failed attempts")
+            return
+        due = self.clock() + self.policy.delay(attempts, _random)
+        if len(self._queue) >= self.max_queue:
+            oldest = self._queue.popleft()
+            self._drop(oldest[0], "retry queue full")
+        self._queue.append([event, attempts, due])
+
+    def _drop(self, event: dict, why: str) -> None:
+        from ..obs.metrics import record_webhook_dropped
+
+        self.dropped += 1
+        record_webhook_dropped()
+        log.warning(
+            "incident webhook event %s dropped (%s): %s",
+            event.get("event"), why, self.url,
+        )
+
+    def _send(self, event: dict) -> bool:
         import urllib.request
+
+        from ..chaos.faults import InjectedFault, maybe_inject
 
         req = urllib.request.Request(
             self.url,
@@ -168,14 +280,21 @@ class WebhookIncidentSink:
             method="POST",
         )
         try:
+            # Chaos seam: hang sleeps (bounded by the plan's value),
+            # http_5xx/fail raise — both exercise the retry queue.
+            maybe_inject("webhook")
             # The explicit timeout bounds the blocking socket ops
             # (connect + response read) — urlopen with no timeout would
             # inherit the global default of None and hang forever on a
             # wedged endpoint.
             urllib.request.urlopen(req, timeout=self.timeout).close()
-        except Exception as e:  # noqa: BLE001 - alerting must not kill RCA
-            self.failures += 1
+            return True
+        except InjectedFault as e:
             log.warning("incident webhook failed (%s): %s", self.url, e)
+            return False
+        except Exception as e:  # noqa: BLE001 - alerting must not kill RCA
+            log.warning("incident webhook failed (%s): %s", self.url, e)
+            return False
 
 
 class IncidentTracker:
@@ -213,6 +332,42 @@ class IncidentTracker:
 
     def open_incidents(self) -> List[Incident]:
         return list(self._open.values())
+
+    # ------------------------------------------------------- durability
+    def to_state(self) -> dict:
+        """JSON-serializable tracker state (chaos.checkpoint): open
+        incidents, cooldown table, and the id/window counters — a
+        restored tracker dedups the restarted run's abnormal windows
+        into the SAME incidents instead of re-opening them."""
+        return {
+            "open": [inc.to_state() for inc in self._open.values()],
+            "cooldown": [
+                [sorted(fp), int(n)] for fp, n in self._cooldown.items()
+            ],
+            "window_no": self._window_no,
+            "ids": self._ids,
+            "opened": self.opened,
+            "resolved": self.resolved,
+            "suppressed": self.suppressed,
+        }
+
+    def restore(self, state: dict) -> None:
+        """Overwrite lifecycle state from a checkpoint. No events are
+        emitted — the sinks already saw these transitions in the run
+        that wrote the checkpoint."""
+        self._open = {}
+        for inc_state in state.get("open", []):
+            inc = Incident.from_state(inc_state)
+            self._open[inc.fingerprint] = inc
+        self._cooldown = {
+            frozenset(fp): int(n)
+            for fp, n in state.get("cooldown", [])
+        }
+        self._window_no = int(state.get("window_no", 0))
+        self._ids = int(state.get("ids", 0))
+        self.opened = int(state.get("opened", 0))
+        self.resolved = int(state.get("resolved", 0))
+        self.suppressed = int(state.get("suppressed", 0))
 
     # ------------------------------------------------------------ intake
     def observe_ranked(

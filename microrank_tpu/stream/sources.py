@@ -49,7 +49,14 @@ def _sorted_chunks(
 
 
 class ReplaySource:
-    """Replay a staged traces CSV (or an in-memory frame) with pacing."""
+    """Replay a staged traces CSV (or an in-memory frame) with pacing.
+
+    Resumable: the cursor is the count of rows already yielded (in the
+    stable event-time sort order, which is a pure function of the data
+    — a restarted replay re-sorts identically). The engine checkpoints
+    it via :meth:`checkpoint_state`; :meth:`restore_state` makes the
+    next iteration skip those rows.
+    """
 
     def __init__(
         self,
@@ -70,13 +77,44 @@ class ReplaySource:
         self.rate = rate
         self.sleep = sleep
         self.sleeps: List[float] = []   # what pacing actually did (tests)
+        self.rows_emitted = 0           # checkpoint cursor
+        self._skip_rows = 0
+
+    # ------------------------------------------------------- durability
+    def checkpoint_state(self) -> dict:
+        return {"type": "replay", "row": int(self.rows_emitted)}
+
+    def restore_state(self, state: dict) -> None:
+        if state.get("type") != "replay":
+            raise ValueError(f"not a replay cursor: {state}")
+        self._skip_rows = max(0, int(state.get("row", 0)))
 
     def __iter__(self) -> Iterator[pd.DataFrame]:
-        chunks = _sorted_chunks(self._df, self.chunk_spans)
+        from ..chaos.faults import maybe_inject
+
+        df = self._df.sort_values(
+            "startTime", kind="stable"
+        ).reset_index(drop=True)
+        if self._skip_rows:
+            # Resume: rows before the cursor were already windowed (and
+            # live on in the checkpointed windower buffers/emits).
+            log.info(
+                "replay resume: skipping %d already-emitted rows",
+                min(self._skip_rows, len(df)),
+            )
+            df = df.iloc[self._skip_rows :]
+        self.rows_emitted = self._skip_rows
+        chunks = _sorted_chunks(df, self.chunk_spans)
         for i, chunk in enumerate(chunks):
+            # Cursor BEFORE the yield: while the engine processes (and
+            # possibly checkpoints against) this chunk, the generator is
+            # suspended here — the cursor must already cover the chunk
+            # or a resume would re-feed spans the windower buffered.
+            self.rows_emitted += len(chunk)
             yield chunk
             if i == len(chunks) - 1:
                 break
+            maybe_inject("source_stall", sleep=self.sleep)
             if self.rate:
                 # Event-time faithful pacing: sleep the event-time gap
                 # to the next chunk, compressed by ``rate``.
@@ -122,9 +160,27 @@ class SyntheticSource:
     def __iter__(self) -> Iterator[pd.DataFrame]:
         return iter(self._replay)
 
+    # Resumable: the timeline is a pure function of the seed, so the
+    # inner replay cursor restores a restarted synthetic run exactly.
+    def checkpoint_state(self) -> dict:
+        return self._replay.checkpoint_state()
+
+    def restore_state(self, state: dict) -> None:
+        self._replay.restore_state(state)
+
 
 class FileTailSource:
-    """Tail a growing traces CSV; yield only the newly appended rows."""
+    """Tail a growing traces CSV; yield only the newly appended rows.
+
+    Resumable: the cursor is the tail's byte offset plus a ROTATION
+    SIGNATURE (hash of the header line) — a restart restores the offset
+    only when the signature still matches the file on disk; a rotated-
+    in file re-reads from scratch (the checkpointed windower cursor
+    still guards against double-emitting old windows). Chaos seams:
+    ``source_stall`` (extra poll latency), ``source_torn`` (simulated
+    torn tail line — parse fails this poll, the cursor holds, the data
+    parses next poll) and ``source_rotation`` (forced cursor reset).
+    """
 
     def __init__(
         self,
@@ -139,17 +195,82 @@ class FileTailSource:
         self.idle_exit = int(idle_exit)
         self.max_polls = int(max_polls)
         self.sleep = sleep
+        self._tracker = None
+        self._restore: Optional[dict] = None
+
+    # ------------------------------------------------------- durability
+    def _signature(self) -> Optional[str]:
+        """Rotation signature: hash of the header line. A rotated-in
+        file with a different header invalidates the byte cursor."""
+        import hashlib
+
+        try:
+            with open(self.path, "rb") as f:
+                header = f.readline()
+        except OSError:
+            return None
+        return hashlib.sha256(header).hexdigest() if header else None
+
+    def checkpoint_state(self) -> Optional[dict]:
+        t = self._tracker
+        if t is None or t.parsed_offset <= 0:
+            return {"type": "tail", "offset": 0}
+        return {
+            "type": "tail",
+            "offset": int(t.parsed_offset),
+            "size": int(t.last_size),
+            "signature": self._signature(),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        if state.get("type") != "tail":
+            raise ValueError(f"not a tail cursor: {state}")
+        self._restore = dict(state)
+
+    def _tracker_for_run(self):
+        from ..pipeline.follow import TailTracker
+
+        tracker = TailTracker(idle_exit=self.idle_exit)
+        st = self._restore
+        if st and st.get("offset", 0) > 0:
+            sig = self._signature()
+            if sig is not None and sig == st.get("signature"):
+                with open(self.path, "rb") as f:
+                    header = f.readline()
+                tracker.restore_cursor(
+                    offset=int(st["offset"]),
+                    size=int(st.get("size", st["offset"])),
+                    header=header,
+                )
+                log.info(
+                    "tail resume: cursor restored at byte %d of %s",
+                    tracker.parsed_offset, self.path,
+                )
+            else:
+                log.warning(
+                    "tail resume: %s rotated since the checkpoint "
+                    "(signature mismatch); re-reading from scratch",
+                    self.path,
+                )
+        return tracker
 
     def __iter__(self) -> Iterator[pd.DataFrame]:
         import io as _io
 
+        from ..chaos.faults import InjectedFault, maybe_inject
+        from ..chaos.retry import record_attempt
         from ..io import load_traces_csv
-        from ..pipeline.follow import TailTracker
 
-        tracker = TailTracker(idle_exit=self.idle_exit)
+        tracker = self._tracker = self._tracker_for_run()
         polls = 0
         while True:
             polls += 1
+            maybe_inject("source_stall", sleep=self.sleep)
+            if maybe_inject("source_rotation") is not None:
+                # Simulated rotation: the cursor resets exactly as a
+                # real size-shrink would reset it (full re-read next
+                # poll; the windower guards double emission).
+                tracker.force_rotation()
             size = (
                 os.path.getsize(self.path) if self.path.exists() else -1
             )
@@ -170,6 +291,8 @@ class FileTailSource:
             # successful parse reach pandas — O(appended) per poll, not
             # O(file); rotation resets the cursor to a full re-read.
             try:
+                if maybe_inject("source_torn") is not None:
+                    raise InjectedFault("source_torn", "torn_line")
                 appended = tracker.read_appended(self.path, size)
                 if appended is None:
                     # Only a torn partial line so far: no-progress poll;
@@ -180,10 +303,12 @@ class FileTailSource:
                     continue
                 payload, offset = appended
                 df = load_traces_csv(_io.BytesIO(payload))
-            except (ValueError, OSError) as exc:
+            except (ValueError, OSError, InjectedFault) as exc:
                 # Torn/corrupt tail: error this poll, valid data the
                 # next (the tracker counts it toward idle_exit; the
-                # cursor did not advance, so the slice re-feeds).
+                # cursor did not advance, so the slice re-feeds). The
+                # re-read is a retry in the unified accounting.
+                record_attempt("source_parse")
                 if tracker.parse_failed(exc) == "exit":
                     return
                 if self.max_polls and polls >= self.max_polls:

@@ -169,3 +169,57 @@ class StreamWindower:
                 out.append(self._pop_window(self._next))
                 self._next += 1
         return out
+
+    # ------------------------------------------------------- durability
+    def to_state(self) -> dict:
+        """JSON-serializable windower state (chaos.checkpoint): the
+        geometry (validated on restore — a resumed run must window
+        identically), the emit cursor/watermark, and the OPEN buffers
+        serialized as CSV text. Buffer size is bounded by the window
+        overlap plus allowed lateness, and a checkpoint whose cursor
+        and buffers were captured together is exactly consistent with
+        the source cursor captured in the same checkpoint: the restored
+        engine re-emits no window twice and loses none."""
+        buffers = {}
+        for idx, parts in self._buffers.items():
+            frame = (
+                parts[0]
+                if len(parts) == 1
+                else pd.concat(parts, ignore_index=True)
+            )
+            buffers[str(idx)] = frame.to_csv(index=False)
+        return {
+            "width_us": self.width_us,
+            "slide_us": self.slide_us,
+            "lateness_us": self.lateness_us,
+            "origin_us": self.origin_us,
+            "max_event_us": self.max_event_us,
+            "next": self._next,
+            "dropped_late": self.dropped_late,
+            "buffers": buffers,
+        }
+
+    def restore(self, state: dict) -> None:
+        """Overwrite windower state from a checkpoint; raises
+        ValueError when the checkpointed geometry differs from the
+        configured one (the run would re-window the stream
+        differently, so the checkpoint is unusable)."""
+        import io as _io
+
+        geom = (state["width_us"], state["slide_us"], state["lateness_us"])
+        if geom != (self.width_us, self.slide_us, self.lateness_us):
+            raise ValueError(
+                f"checkpoint window geometry {geom} != configured "
+                f"{(self.width_us, self.slide_us, self.lateness_us)}"
+            )
+        self.origin_us = state["origin_us"]
+        self.max_event_us = state["max_event_us"]
+        self._next = int(state["next"])
+        self.dropped_late = int(state.get("dropped_late", 0))
+        self._buffers = {}
+        for idx, csv_text in state.get("buffers", {}).items():
+            frame = pd.read_csv(_io.StringIO(csv_text))
+            for col in ("startTime", "endTime"):
+                if col in frame.columns:
+                    frame[col] = pd.to_datetime(frame[col])
+            self._buffers[int(idx)] = [frame]

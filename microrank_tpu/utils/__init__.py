@@ -1,4 +1,16 @@
+from .atomic import (
+    atomic_write_bytes,
+    atomic_write_json,
+    atomic_write_text,
+)
 from .logging import get_logger
 from .profiling import StageTimings, trace_context
 
-__all__ = ["get_logger", "StageTimings", "trace_context"]
+__all__ = [
+    "atomic_write_bytes",
+    "atomic_write_json",
+    "atomic_write_text",
+    "get_logger",
+    "StageTimings",
+    "trace_context",
+]

@@ -1,0 +1,87 @@
+"""Atomic file writes: tmp + fsync + rename, the crash-only contract.
+
+A SIGKILL between ``open()`` and ``close()`` of a plain ``write_text``
+leaves a torn file — half a JSON object where ``warmup_manifest.json``
+or ``metrics.json`` used to be — and the NEXT process's warm start then
+chokes on it (or worse, silently ignores it and cold-starts). Every
+state file a restart reads back goes through this module instead:
+
+1. write the full payload to ``<name>.tmp.<pid>`` in the SAME directory
+   (``os.replace`` is only atomic within a filesystem);
+2. flush + fsync the tmp file (the bytes are durable, not just cached);
+3. ``os.replace`` onto the final name (atomic on POSIX: readers see the
+   old complete file or the new complete file, never a mix);
+4. best-effort fsync of the parent directory (the rename itself is
+   durable across power loss, not just process death).
+
+A crash at any point leaves either the old file intact (steps 1-3) or
+the new file complete (after 3) — plus at most one stale ``.tmp.*``
+the next writer overwrites. The chaos harness injects a kill between
+steps 2 and 3 (seam passed via ``fault_seam``) to pin exactly this
+property in tests and the CI ``chaos-smoke`` job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+
+def atomic_write_bytes(
+    path, data: bytes, fault_seam: Optional[str] = None
+) -> Path:
+    """Atomically replace ``path`` with ``data`` (tmp+fsync+rename).
+
+    ``fault_seam``: chaos injection point fired BETWEEN the durable tmp
+    write and the rename — an injected fault here simulates a crash at
+    the worst possible instant; the previous file must survive it.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.parent / f"{path.name}.tmp.{os.getpid()}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    if fault_seam is not None:
+        from ..chaos.faults import maybe_inject
+
+        maybe_inject(fault_seam)  # may raise: tmp stays, target intact
+    os.replace(tmp, path)
+    _fsync_dir(path.parent)
+    return path
+
+
+def atomic_write_text(
+    path, text: str, fault_seam: Optional[str] = None
+) -> Path:
+    return atomic_write_bytes(
+        path, text.encode("utf-8"), fault_seam=fault_seam
+    )
+
+
+def atomic_write_json(
+    path, obj, indent: int = 2, fault_seam: Optional[str] = None
+) -> Path:
+    return atomic_write_text(
+        path, json.dumps(obj, indent=indent), fault_seam=fault_seam
+    )
+
+
+def _fsync_dir(dirpath) -> None:
+    """Durability of the rename itself; best-effort (some filesystems
+    refuse O_RDONLY directory fds)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)

@@ -1,0 +1,774 @@
+"""Crash-only streaming (chaos/): checkpoint round-trip + corruption
+rejection, atomic-write crash injection (old checkpoint survives a kill
+between tmp and rename), the unified retry policy (backoff, jitter,
+circuit breaker states), seeded FaultPlan determinism, the webhook
+sink's bounded retry queue + drop accounting, serve per-request
+deadline_ms expiry, and the acceptance paths: an in-process
+stop-and-resume run plus the real thing — a stream subprocess SIGKILLed
+mid-incident and restarted with ``--resume`` re-opens ZERO duplicate
+incidents, keeps its baseline (no cold-start re-seed) and resumes the
+source at its checkpointed cursor. All on CPU jax.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from microrank_tpu.chaos import (
+    CheckpointError,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    RetryPolicy,
+    configure_chaos,
+    get_breaker,
+    load_checkpoint,
+    maybe_inject,
+    reset_breakers,
+    retry_call,
+    save_checkpoint,
+)
+from microrank_tpu.config import ChaosConfig, MicroRankConfig, StreamConfig
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.stream import (
+    IncidentTracker,
+    OnlineBaseline,
+    StreamEngine,
+    StreamWindower,
+    SyntheticSource,
+    WebhookIncidentSink,
+)
+from microrank_tpu.testing import SyntheticConfig, generate_case
+
+T0 = pd.Timestamp("2025-03-01 00:00:00")
+
+
+@pytest.fixture(autouse=True)
+def chaos_isolation():
+    """Fresh registry + disarmed plan + closed breakers per test —
+    chaos state is process-global by design; tests must not leak it."""
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    configure_chaos(MicroRankConfig())   # plan = None
+    reset_breakers()
+    yield reg
+    configure_chaos(MicroRankConfig())
+    reset_breakers()
+    set_registry(old)
+
+
+registry = chaos_isolation  # alias for readability at use sites
+
+
+def _chaos_cfg(*fault_dicts, seed=0, **stream_kw):
+    stream_kw.setdefault("allowed_lateness_seconds", 5.0)
+    return MicroRankConfig(
+        stream=StreamConfig(**stream_kw),
+        chaos=ChaosConfig(
+            enabled=True, seed=seed, faults=tuple(fault_dicts)
+        ),
+    )
+
+
+# ------------------------------------------------------- checkpoint IO
+
+
+def test_checkpoint_round_trip_rejects_corruption(tmp_path):
+    path = tmp_path / "state.ckpt"
+    payload = {"a": [1, 2, 3], "b": {"c": "x"}}
+    save_checkpoint(path, payload)
+    assert load_checkpoint(path) == payload
+    # Bit rot in the payload: checksum rejects.
+    doc = json.loads(path.read_text())
+    doc["payload"]["a"] = [1, 2, 4]
+    path.write_text(json.dumps(doc))
+    with pytest.raises(CheckpointError, match="checksum"):
+        load_checkpoint(path)
+    # Torn JSON (the non-atomic writer this module replaces).
+    path.write_text('{"version": 1, "payload": {"a"')
+    with pytest.raises(CheckpointError, match="torn"):
+        load_checkpoint(path)
+    # A future version is refused, not half-understood.
+    save_checkpoint(path, payload)
+    doc = json.loads(path.read_text())
+    doc["version"] = 999
+    path.write_text(json.dumps(doc))
+    with pytest.raises(CheckpointError, match="version"):
+        load_checkpoint(path)
+    with pytest.raises(CheckpointError, match="unreadable"):
+        load_checkpoint(tmp_path / "missing.ckpt")
+
+
+def test_checkpoint_write_crash_between_tmp_and_rename(tmp_path):
+    """Acceptance: an injected crash BETWEEN the durable tmp write and
+    the rename leaves the previous checkpoint fully loadable."""
+    path = tmp_path / "state.ckpt"
+    save_checkpoint(path, {"gen": 1})
+    configure_chaos(
+        _chaos_cfg({"seam": "checkpoint", "kind": "crash", "count": 1})
+    )
+    with pytest.raises(InjectedFault):
+        save_checkpoint(path, {"gen": 2})
+    assert load_checkpoint(path) == {"gen": 1}   # old ckpt survives
+    # The plan's count is spent: the next write goes through.
+    save_checkpoint(path, {"gen": 3})
+    assert load_checkpoint(path) == {"gen": 3}
+
+
+# -------------------------------------------------- state round trips
+
+
+def test_baseline_state_round_trip_preserves_p2_markers():
+    rng = np.random.default_rng(0)
+    ob = OnlineBaseline(decay=0.3, slo_stat="p90")
+    n = 400
+    frame = pd.DataFrame(
+        {
+            "traceID": [f"t{i}" for i in range(n)],
+            "serviceName": ["svcA"] * n,
+            "operationName": ["op"] * n,
+            "duration": (rng.lognormal(2.0, 0.5, n) * 1000).astype(int),
+            "startTime": [T0] * n,
+            "endTime": [T0] * n,
+        }
+    )
+    ob.update(frame)
+    ob.freeze()
+    state = json.loads(json.dumps(ob.to_state()))   # via-JSON fidelity
+    twin = OnlineBaseline(decay=0.3, slo_stat="p90")
+    twin.restore(state)
+    v1, b1 = ob.snapshot()
+    v2, b2 = twin.snapshot()
+    assert v1.names == v2.names
+    np.testing.assert_array_equal(b1.mean_ms, b2.mean_ms)
+    np.testing.assert_array_equal(b1.std_ms, b2.std_ms)
+    assert twin.frozen and twin.n_updates == ob.n_updates
+    assert twin.ready == ob.ready
+    # A mismatched SLO statistic is an unusable checkpoint, not a
+    # silent misread of p99 markers as means.
+    with pytest.raises(ValueError, match="slo_stat"):
+        OnlineBaseline(decay=0.3, slo_stat="mean").restore(state)
+
+
+def test_incident_tracker_state_round_trip_dedups_after_restore():
+    tr = IncidentTracker(top_k=3, resolve_after=2, cooldown_windows=2)
+    rank = [("a", 1.0), ("b", 0.8), ("c", 0.6)]
+    inc = tr.observe_ranked("w1", rank)
+    state = json.loads(json.dumps(tr.to_state()))
+    twin = IncidentTracker(top_k=3, resolve_after=2, cooldown_windows=2)
+    twin.restore(state)
+    assert twin.has_open and twin.opened == 1
+    # The restarted run's abnormal window DEDUPS into the restored
+    # incident instead of opening a duplicate.
+    again = twin.observe_ranked("w2", rank)
+    assert again is not None
+    assert again.incident_id == inc.incident_id
+    assert twin.opened == 1
+    resolved = [twin.observe_healthy(f"w{i}") for i in (3, 4)]
+    assert [i.incident_id for i in resolved[1]] == [inc.incident_id]
+    # Cooldown survives the round trip too.
+    state2 = twin.to_state()
+    twin2 = IncidentTracker(top_k=3, resolve_after=2, cooldown_windows=2)
+    twin2.restore(state2)
+    assert twin2.observe_ranked("w5", rank) is None   # suppressed
+    assert twin2.suppressed == 1
+
+
+def test_windower_state_round_trip_keeps_buffers_and_cursor():
+    def spans(*offsets_s, tag="s"):
+        return pd.DataFrame(
+            {
+                "traceID": [f"{tag}{i}" for i in range(len(offsets_s))],
+                "startTime": [
+                    T0 + pd.Timedelta(seconds=o) for o in offsets_s
+                ],
+                "off": list(offsets_s),
+            }
+        )
+
+    w = StreamWindower(width_us=60_000_000)
+    closed = w.add(spans(10, 70, 80))     # [0,60) closes; [60,120) open
+    assert len(closed) == 1
+    state = json.loads(json.dumps(w.to_state()))
+    twin = StreamWindower(width_us=60_000_000)
+    twin.restore(state)
+    assert twin._next == 1 and twin.max_event_us == w.max_event_us
+    # The buffered open window survives: later spans close it with the
+    # buffered content intact, and nothing re-emits window 0.
+    out = twin.add(spans(130, tag="n"))
+    assert [sorted(c.frame["off"]) for c in out] == [[70, 80]]
+    # Mismatched geometry rejects (a resumed run must window alike).
+    with pytest.raises(ValueError, match="geometry"):
+        StreamWindower(width_us=30_000_000).restore(state)
+
+
+# --------------------------------------------------------- fault plan
+
+
+def test_fault_plan_counting_and_determinism():
+    specs = [
+        {"seam": "dispatch", "kind": "fail", "after": 1, "count": 2},
+        {"seam": "webhook", "kind": "hang", "value": 5.0,
+         "every": 2, "count": -1},
+    ]
+    plan_a = FaultPlan([FaultSpec.from_dict(s) for s in specs], seed=7)
+    plan_b = FaultPlan([FaultSpec.from_dict(s) for s in specs], seed=7)
+    for plan in (plan_a, plan_b):
+        fired = [
+            plan.fire("dispatch") is not None for _ in range(5)
+        ]
+        # after=1, count=2: events 1 and 2 fire, then the spec is spent.
+        assert fired == [False, True, True, False, False]
+        wh = [plan.fire("webhook") is not None for _ in range(4)]
+        assert wh == [True, False, True, False]    # every=2, unbounded
+    assert plan_a.injected == plan_b.injected
+
+
+def test_maybe_inject_kinds(registry):
+    configure_chaos(
+        _chaos_cfg(
+            {"seam": "s1", "kind": "fail", "count": 1},
+            {"seam": "s2", "kind": "stall", "value": 80.0, "count": 1},
+            {"seam": "s3", "kind": "nan", "count": 1},
+        )
+    )
+    with pytest.raises(InjectedFault):
+        maybe_inject("s1")
+    assert maybe_inject("s1") is None               # count spent
+    slept = []
+    act = maybe_inject("s2", sleep=slept.append)    # sleeping kind
+    assert act["kind"] == "stall" and slept == [0.08]
+    act = maybe_inject("s3")                        # caller-interpreted
+    assert act["kind"] == "nan"
+    inj = registry.get("microrank_fault_injections_total")
+    assert sum(s["value"] for s in inj.samples()) == 3
+
+
+# -------------------------------------------------------- retry policy
+
+
+def test_retry_call_backoff_and_metrics(registry):
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.1, jitter=0.0, breaker_threshold=99
+    )
+    out = retry_call("t_seam", flaky, policy=policy, sleep=sleeps.append)
+    assert out == "ok" and calls["n"] == 3
+    # Exponential, no jitter: 0.1 then 0.2.
+    assert sleeps == pytest.approx([0.1, 0.2])
+    assert registry.get("microrank_retry_attempts_total").value(
+        seam="t_seam"
+    ) == 2
+    # Exhaustion re-raises and is counted.
+    with pytest.raises(RuntimeError, match="always"):
+        retry_call(
+            "t_seam2",
+            lambda: (_ for _ in ()).throw(RuntimeError("always")),
+            policy=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                               breaker_threshold=99),
+            sleep=lambda s: None,
+        )
+    assert registry.get("microrank_retry_exhausted_total").value(
+        seam="t_seam2"
+    ) == 1
+
+
+def test_circuit_breaker_open_half_open_close(registry):
+    from microrank_tpu.chaos import BreakerOpen
+
+    now = {"t": 0.0}
+    policy = RetryPolicy(
+        max_attempts=1, breaker_threshold=3, breaker_reset_s=10.0
+    )
+    br = get_breaker("br_seam", policy)
+    br.clock = lambda: now["t"]
+    boom = lambda: (_ for _ in ()).throw(RuntimeError("down"))
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            retry_call("br_seam", boom, policy=policy, sleep=lambda s: None)
+    assert br.state == "open"
+    assert registry.get("microrank_breaker_state").value(
+        seam="br_seam"
+    ) == 1.0
+    # Open: fast-fail without calling fn.
+    with pytest.raises(BreakerOpen):
+        retry_call(
+            "br_seam", lambda: "never", policy=policy, sleep=lambda s: None
+        )
+    # Reset window elapses: the next call is the half-open probe; its
+    # success closes the breaker.
+    now["t"] = 11.0
+    assert retry_call(
+        "br_seam", lambda: "ok", policy=policy, sleep=lambda s: None
+    ) == "ok"
+    assert br.state == "closed"
+    assert registry.get("microrank_breaker_state").value(
+        seam="br_seam"
+    ) == 0.0
+    # A failing probe re-opens immediately.
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            retry_call("br_seam", boom, policy=policy, sleep=lambda s: None)
+    now["t"] = 22.0
+    with pytest.raises(RuntimeError):
+        retry_call("br_seam", boom, policy=policy, sleep=lambda s: None)
+    assert br.state == "open"
+
+
+# ------------------------------------------------------- webhook queue
+
+
+def test_webhook_retry_queue_backoff_and_drop(registry):
+    """A failed POST parks in the bounded queue and retries with
+    backoff on later traffic; max_attempts exhaustion drops + counts."""
+    now = {"t": 0.0}
+    sink = WebhookIncidentSink(
+        "http://127.0.0.1:9/unroutable",
+        timeout=0.2,
+        max_attempts=3,
+        max_queue=4,
+        clock=lambda: now["t"],
+    )
+    sink.emit({"event": "incident_open", "top": []})
+    assert sink.failures == 1 and sink.pending() == 1
+    # Not yet due: flush is a no-op.
+    sink.flush()
+    assert sink.failures == 1
+    # Due entries re-send (and fail again) as the clock advances.
+    now["t"] = 60.0
+    sink.flush()
+    assert sink.failures == 2 and sink.pending() == 1
+    now["t"] = 120.0
+    sink.flush()    # third attempt == max_attempts -> dropped
+    assert sink.pending() == 0 and sink.dropped == 1
+    assert registry.get("microrank_webhook_dropped_total").value() == 1
+    # Queue overflow evicts (and counts) the oldest entry.
+    for i in range(6):
+        sink.emit({"event": f"e{i}", "top": []})
+    assert sink.pending() == 4
+    assert sink.dropped == 1 + 2
+
+
+# ------------------------------------------------------ source cursors
+
+
+def test_file_tail_source_cursor_restore(tmp_path, registry):
+    from microrank_tpu.stream import FileTailSource
+
+    case = generate_case(
+        SyntheticConfig(n_operations=10, n_traces=40, seed=2)
+    )
+    df = case.normal
+    csv = tmp_path / "grow.csv"
+    half = len(df) // 2
+    df.iloc[:half].to_csv(csv, index=False)
+    src = FileTailSource(csv, poll_seconds=0, max_polls=2,
+                         sleep=lambda s: None)
+    first = next(iter(src))
+    assert len(first) == half
+    cursor = src.checkpoint_state()
+    assert cursor["offset"] > 0 and cursor["signature"]
+    # A NEW source (a restarted process) restored at the cursor yields
+    # only the rows appended after it.
+    df.iloc[half:].to_csv(csv, mode="a", header=False, index=False)
+    src2 = FileTailSource(csv, poll_seconds=0, max_polls=2,
+                          sleep=lambda s: None)
+    src2.restore_state(cursor)
+    batches = list(src2)
+    assert sum(len(b) for b in batches) == len(df) - half
+    # Rotation invalidates the cursor: a different file re-reads fully.
+    csv.write_text("")  # truncate
+    df.iloc[:half].rename(columns={"traceID": "traceID2"}).rename(
+        columns={"traceID2": "traceID"}
+    ).to_csv(csv, index=False)
+    src3 = FileTailSource(csv, poll_seconds=0, max_polls=2,
+                          sleep=lambda s: None)
+    bad = dict(cursor)
+    bad["signature"] = "not-the-header"
+    src3.restore_state(bad)
+    batches = list(src3)
+    assert sum(len(b) for b in batches) == half   # full re-read
+
+
+def test_replay_source_cursor_restore():
+    from microrank_tpu.stream import ReplaySource
+
+    df = pd.DataFrame(
+        {
+            "traceID": [f"t{i}" for i in range(10)],
+            "startTime": [
+                T0 + pd.Timedelta(seconds=i) for i in range(10)
+            ],
+        }
+    )
+    src = ReplaySource(df, chunk_spans=3)
+    it = iter(src)
+    next(it), next(it)
+    assert src.rows_emitted == 6
+    twin = ReplaySource(df, chunk_spans=3)
+    twin.restore_state(src.checkpoint_state())
+    rest = list(twin)
+    assert sum(len(c) for c in rest) == 4
+    assert list(rest[0]["traceID"])[0] == "t6"
+
+
+# ----------------------------------------- engine chaos + stop/resume
+
+
+def _synthetic_source(**kw):
+    kw.setdefault("n_windows", 8)
+    kw.setdefault("faulted", [3])
+    kw.setdefault(
+        "synth_config",
+        SyntheticConfig(n_operations=24, n_traces=200, n_kinds=16, seed=5),
+    )
+    kw.setdefault("pace_seconds", 0.0)
+    return SyntheticSource(**kw)
+
+
+def test_engine_fault_plan_zero_dropped_windows(registry, tmp_path):
+    """Acceptance: a seeded FaultPlan across >= 5 distinct seams —
+    dispatch fail, build fail, fetch NaN poison, source stall, webhook
+    hang — completes with ZERO dropped windows (every abnormal window
+    still ranks; the retries absorb the faults) and every injection
+    visible in the retry/fault metrics and the journal."""
+    cfg = _chaos_cfg(
+        {"seam": "dispatch", "kind": "fail", "count": 1},
+        {"seam": "build", "kind": "fail", "count": 1},
+        {"seam": "fetch", "kind": "nan", "count": 1},
+        {"seam": "source_stall", "kind": "stall", "value": 10.0,
+         "count": 1},
+        {"seam": "webhook", "kind": "hang", "value": 10.0, "count": 1},
+        seed=3,
+        webhook_url="http://127.0.0.1:9/unroutable",
+        webhook_timeout_seconds=0.2,
+    )
+    src = _synthetic_source(faulted=[3, 4])
+    eng = StreamEngine(cfg, src, out_dir=tmp_path)
+    s = eng.run()
+    assert s.windows == 8
+    assert s.ranked == 2 and s.skipped == 0     # zero dropped windows
+    assert s.incidents_opened == 1 and s.incidents_resolved == 1
+    inj = registry.get("microrank_fault_injections_total")
+    seams = {smp["labels"]["seam"] for smp in inj.samples()}
+    assert {
+        "dispatch", "build", "fetch", "source_stall", "webhook"
+    } <= seams
+    # dispatch + fetch retries ride the unified counter; build retries
+    # happen on the pool under the same surface.
+    retries = registry.get("microrank_retry_attempts_total")
+    by_seam = {
+        smp["labels"]["seam"]: smp["value"] for smp in retries.samples()
+    }
+    assert by_seam.get("stream_dispatch", 0) >= 2   # fail + nan poison
+    assert by_seam.get("build", 0) >= 1
+    # Breaker gauges exposed (closed) for the retried seams.
+    br = registry.get("microrank_breaker_state")
+    assert br.value(seam="stream_dispatch") == 0.0
+    # Journal carries the fault_injected trail.
+    from microrank_tpu.obs import read_journal
+
+    faults = [
+        e
+        for e in read_journal(tmp_path / "journal.jsonl")
+        if e["event"] == "fault_injected"
+    ]
+    assert {f["seam"] for f in faults} >= {
+        "dispatch", "build", "fetch", "source_stall", "webhook"
+    }
+
+
+def test_engine_stop_and_resume_no_duplicate_incident(
+    registry, tmp_path
+):
+    """In-process half of the kill-resume acceptance: stop a run
+    mid-incident (max_windows), resume a FRESH engine from the
+    checkpoint, and the restarted run dedups into the SAME incident
+    (zero duplicate opens), skips re-ranking finalized windows, and
+    re-enters no cold start."""
+    cfg = _chaos_cfg(max_windows=5)          # stop with the incident open
+    src = _synthetic_source(faulted=[3, 4])
+    eng = StreamEngine(cfg, src, out_dir=tmp_path)
+    s1 = eng.run()
+    assert s1.windows == 5 and s1.incidents_opened == 1
+    assert s1.incidents_resolved == 0        # still open at the stop
+    ckpt = load_checkpoint(tmp_path / "state.ckpt")
+    assert ckpt["tracker"]["open"], "checkpoint must carry the incident"
+    assert ckpt["source"]["row"] > 0
+    # A fresh process: new engine, new (deterministically regenerated)
+    # source, resume=True.
+    cfg2 = _chaos_cfg()                      # run to the end this time
+    src2 = _synthetic_source(faulted=[3, 4])
+    eng2 = StreamEngine(cfg2, src2, out_dir=tmp_path, resume=True)
+    assert eng2.resumed
+    s2 = eng2.run()
+    # Continuity: totals continue the first run's counters.
+    assert s2.windows == 8
+    assert s2.incidents_opened == 1 and s2.incidents_resolved == 1
+    assert s2.warmup == 0                    # no cold-start re-seed
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "incidents.jsonl").read_text().splitlines()
+    ]
+    opens = [e for e in events if e["event"] == "incident_open"]
+    resolves = [e for e in events if e["event"] == "incident_resolve"]
+    assert len(opens) == 1, "duplicate incident_open after resume"
+    assert len(resolves) == 1
+    assert opens[0]["incident_id"] == resolves[0]["incident_id"]
+    # No window processed twice, in order, none lost: the two runs'
+    # window events tile the timeline.
+    from microrank_tpu.obs import read_journal
+
+    jev = read_journal(tmp_path / "journal.jsonl")
+    starts = [e["start"] for e in jev if e["event"] == "window"]
+    assert len(starts) == 8 and len(set(starts)) == 8
+    assert starts == sorted(starts)
+    run_starts = [e for e in jev if e["event"] == "run_start"]
+    assert [r.get("resumed") for r in run_starts] == [False, True]
+
+
+def test_engine_rejects_corrupt_checkpoint_and_cold_starts(
+    registry, tmp_path
+):
+    (tmp_path / "state.ckpt").write_text("{ torn garbage")
+    src = _synthetic_source()
+    eng = StreamEngine(
+        _chaos_cfg(), src, out_dir=tmp_path, resume=True
+    )
+    assert not eng.resumed                   # rejected, not half-loaded
+    assert registry.get("microrank_checkpoint_events_total").value(
+        event="rejected"
+    ) == 1
+    s = eng.run()
+    assert s.windows == 8 and s.incidents_opened == 1
+
+
+# --------------------------------------------------- serve deadline_ms
+
+
+def test_parse_rank_request_deadline_validation():
+    from microrank_tpu.serve import ProtocolError, parse_rank_request
+
+    req = parse_rank_request(
+        json.dumps({"dataset": "d", "deadline_ms": 250}).encode()
+    )
+    assert req.deadline_ms == 250.0
+    with pytest.raises(ProtocolError, match="deadline_ms"):
+        parse_rank_request(
+            json.dumps({"dataset": "d", "deadline_ms": -1}).encode()
+        )
+    with pytest.raises(ProtocolError, match="deadline_ms"):
+        parse_rank_request(
+            json.dumps({"dataset": "d", "deadline_ms": "soon"}).encode()
+        )
+
+
+def test_serve_deadline_expires_queued_request(registry, tmp_path):
+    """A request whose deadline elapsed in the queue expires BEFORE
+    staging (504 path, outcome 'expired', journal event) — the batch
+    never dispatches device work nobody is waiting for."""
+    from concurrent.futures import Future
+
+    from microrank_tpu.config import ServeConfig
+    from microrank_tpu.serve import DeadlineExceeded, RankRequest
+    from microrank_tpu.serve.server import ServeService
+
+    case = generate_case(
+        SyntheticConfig(n_operations=24, n_traces=120, seed=7)
+    )
+    cfg = MicroRankConfig(
+        serve=ServeConfig(warmup=False, build_workers=0)
+    )
+    svc = ServeService(cfg, out_dir=tmp_path)
+    svc.fit_baseline(case.normal)
+    outcomes = []
+    svc._on_done = (  # observe without the HTTP stack
+        lambda pw, err: outcomes.append(type(err).__name__ if err else None)
+    )
+    req = RankRequest(
+        request_id="r-exp", dataset="case", deadline_ms=50.0
+    )
+    fut = Future()
+    stale = (req, fut, time.monotonic() - 1.0, svc._on_done, None)
+    svc.scheduler._process(stale)
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=5)
+    assert outcomes == ["DeadlineExceeded"]
+    from microrank_tpu.obs import read_journal
+
+    expired = [
+        e
+        for e in read_journal(tmp_path / "journal.jsonl")
+        if e["event"] == "request_deadline_expired"
+    ]
+    assert len(expired) == 1 and expired[0]["stage"] == "queue"
+    # The batcher half: a parked window past its deadline is expired at
+    # dispatch time instead of riding the device batch.
+    from microrank_tpu.pipeline.results import WindowResult
+    from microrank_tpu.serve.batcher import PendingWindow
+
+    pw = PendingWindow(
+        request=RankRequest(
+            request_id="r-exp2", dataset="case", deadline_ms=50.0
+        ),
+        result=WindowResult(start="", end="", anomaly=True),
+        span_df=None, normal_ids=[], abnormal_ids=[], graph=None,
+        op_names=[], kernel="packed", future=Future(),
+        enqueued=time.monotonic() - 1.0, built=time.monotonic(),
+    )
+    svc.scheduler.batcher.dispatch([pw])
+    with pytest.raises(DeadlineExceeded):
+        pw.future.result(timeout=5)
+    assert svc.scheduler.batcher.dispatches == 0
+
+
+# --------------------------------------------------- atomic file writes
+
+
+def test_atomic_writers_used_for_snapshots_and_manifest(tmp_path):
+    """The warm-start inputs (metrics snapshot, warmup manifest,
+    explain bundle) all go through tmp+fsync+rename now: no *.tmp.*
+    litter on success, and a reader never sees a torn file."""
+    from microrank_tpu.dispatch import record_manifest_entry
+    from microrank_tpu.obs.metrics import ensure_catalog
+
+    reg = get_registry()
+    ensure_catalog()
+    reg.write_snapshot(tmp_path)
+    assert json.loads((tmp_path / "metrics.json").read_text())["metrics"]
+    assert (tmp_path / "metrics.prom").read_text()
+    record_manifest_entry(str(tmp_path), "stream", "packed", [1, 2])
+    man = json.loads((tmp_path / "warmup_manifest.json").read_text())
+    assert man["programs"][0]["occupancies"] == [1, 2]
+    assert not list(tmp_path.glob("*.tmp.*"))
+
+
+# ------------------------------------------------ kill -9 + --resume e2e
+
+
+def _metric_total(prom_text: str, name: str, label: str = None) -> float:
+    total = 0.0
+    for line in prom_text.splitlines():
+        if not line.startswith(name):
+            continue
+        if label is not None and label not in line:
+            continue
+        total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def test_stream_sigkill_resume_e2e(tmp_path):
+    """THE acceptance path: a real `cli stream` process SIGKILLed
+    mid-incident, restarted with --resume — zero duplicate
+    incident_open, baseline continuity (no cold-start gating), source
+    resumed at the checkpointed cursor (no window ranked twice)."""
+    out_dir = tmp_path / "out"
+    src = _synthetic_source(faulted=[3, 4])
+    input_csv = tmp_path / "timeline.csv"
+    normal_csv = tmp_path / "normal.csv"
+    src.timeline.timeline.to_csv(input_csv, index=False)
+    src.normal.to_csv(normal_csv, index=False)
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).parent.parent),
+    }
+    base_cmd = [
+        sys.executable, "-m", "microrank_tpu.cli", "stream",
+        "--source", "replay", "--input", str(input_csv),
+        "--chunk-spans", "400", "--lateness-seconds", "5",
+        "-o", str(out_dir),
+    ]
+    # Run 1: paced so the kill lands mid-run, seeded from the normal
+    # dump (run 2 passes no --normal: only the checkpoint can arm it).
+    proc = subprocess.Popen(
+        base_cmd + ["--normal", str(normal_csv), "--pace-seconds", "0.3"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    inc_log = out_dir / "incidents.jsonl"
+    ckpt_path = out_dir / "state.ckpt"
+    killed_mid_incident = False
+    deadline = time.time() + 300
+    try:
+        while time.time() < deadline and proc.poll() is None:
+            if ckpt_path.exists():
+                try:
+                    ck = load_checkpoint(ckpt_path)
+                except CheckpointError:
+                    ck = None
+                if ck and ck["tracker"]["open"]:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    killed_mid_incident = True
+                    break
+            time.sleep(0.05)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=60)
+    assert killed_mid_incident, (
+        "run finished before the kill — raise --pace-seconds"
+    )
+    opens_before = sum(
+        1
+        for line in inc_log.read_text().splitlines()
+        if json.loads(line)["event"] == "incident_open"
+    )
+    assert opens_before == 1
+    # Run 2: --resume, no --normal, unpaced.
+    proc2 = subprocess.run(
+        base_cmd + ["--resume"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    events = [
+        json.loads(line)
+        for line in inc_log.read_text().splitlines()
+    ]
+    opens = [e for e in events if e["event"] == "incident_open"]
+    resolves = [e for e in events if e["event"] == "incident_resolve"]
+    assert len(opens) == 1, "duplicate incident_open across the kill"
+    assert len(resolves) == 1
+    assert opens[0]["incident_id"] == resolves[0]["incident_id"]
+    from microrank_tpu.obs import read_journal
+
+    jev = read_journal(out_dir / "journal.jsonl")
+    run_starts = [e for e in jev if e["event"] == "run_start"]
+    assert len(run_starts) == 2
+    assert run_starts[1]["resumed"] is True
+    # Baseline continuity: nothing after the resume is a warmup window,
+    # and no window was processed twice (unique, ordered starts).
+    windows = [e for e in jev if e["event"] == "window"]
+    assert all(
+        w.get("skipped_reason") != "baseline_warmup" for w in windows
+    )
+    starts = [w["start"] for w in windows]
+    assert len(starts) == len(set(starts)) == 8
+    assert starts == sorted(starts)
+    # Source cursor: run 2's final checkpoint consumed the whole replay.
+    final = load_checkpoint(ckpt_path)
+    assert final["source"]["row"] == len(src.timeline.timeline)
+    # Run 2's snapshot shows a checkpoint restore and writes.
+    prom = (out_dir / "metrics.prom").read_text()
+    assert _metric_total(
+        prom, "microrank_checkpoint_events_total{", 'event="restore"'
+    ) == 1
+    assert _metric_total(
+        prom, "microrank_checkpoint_events_total{", 'event="write"'
+    ) >= 1

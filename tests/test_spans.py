@@ -214,14 +214,16 @@ def test_journal_run_end_and_flight_dump_fsync(
     """Durability satellite: run_end and every flight dump flush+fsync
     the journal, so a crash never truncates the last incident's
     events."""
-    import microrank_tpu.obs.journal as journal_mod
     from microrank_tpu.obs.journal import RunJournal
 
+    # Count JOURNAL syncs specifically (patching os.fsync globally
+    # would also count the atomic tmp+fsync+rename writers the flight
+    # dump's snapshot files now go through — utils.atomic).
     synced = []
-    real_fsync = journal_mod.os.fsync
+    real_sync = RunJournal.sync
     monkeypatch.setattr(
-        journal_mod.os, "fsync",
-        lambda fd: (synced.append(fd), real_fsync(fd)),
+        RunJournal, "sync",
+        lambda self: (synced.append(self.path), real_sync(self)),
     )
     j = RunJournal(tmp_path / "journal.jsonl")
     j.emit("window", start="w0")
