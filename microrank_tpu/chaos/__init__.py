@@ -28,6 +28,7 @@ from .faults import (
     get_fault_plan,
     maybe_inject,
     record_injection,
+    set_chaos_host,
     set_chaos_journal,
 )
 from .retry import (
@@ -65,5 +66,6 @@ __all__ = [
     "reset_breakers",
     "retry_call",
     "save_checkpoint",
+    "set_chaos_host",
     "set_chaos_journal",
 ]

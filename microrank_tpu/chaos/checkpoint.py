@@ -17,6 +17,15 @@ File format (version 1)::
      "payload": {"baseline": ..., "tracker": ..., "windower": ...,
                  "source": ..., "summary": ...}}
 
+Fleet extensions (PR 11, additive within version 1): a fleet worker's
+``tracker`` is the coordinator proxy's state (``{"type": "fleet",
+"window_no", "buffered": [parked reports]}`` — single-process and
+fleet checkpoints refuse to cross-restore), and ``source`` wraps the
+inner cursor in the partition-filter identity (``{"type":
+"partitioned", "partition_by", "n_partitions", "partitions",
+"inner"}``) so a cursor taken under a different partition assignment
+rejects WHOLE instead of silently resuming a different sub-stream.
+
 The digest is over the canonical (sorted-keys) JSON of ``payload``; a
 truncated, bit-flipped or hand-edited checkpoint is REJECTED
 (:class:`CheckpointError`) rather than half-restored — the engine then

@@ -34,6 +34,23 @@ Seams (each one a point the span tracer already instruments):
   write and the rename: the crash the atomic protocol exists to survive.
 * ``stage:<name>`` — a latency injection inside any traced span (the
   legacy ``inject_stage_sleep_ms`` knob's seam).
+* ``host_kill`` — the fleet worker's per-window report point; ``kill``
+  terminates the WHOLE worker process with ``os._exit`` (no drain, no
+  final checkpoint — the loss a SIGKILL models; the coordinator's
+  lease expiry and the worker's ``--resume`` rejoin are the recovery
+  under test).
+* ``heartbeat_drop`` — the worker's heartbeat loop; ``drop`` skips the
+  send (the lease keeps aging — enough consecutive drops and the
+  coordinator declares the host dead while it is still running).
+* ``coordinator_unreachable`` — the worker->coordinator HTTP client;
+  ``fail`` raises as a connection failure, driving the worker-side
+  report buffering + backoff/breaker path without a real partition.
+
+Fleet plans are usually shared by every process of the fleet (the
+launcher passes one ``--chaos`` file to all workers); a spec carrying
+``"host": "host1"`` fires only in the process that called
+:func:`set_chaos_host` with that id, so one plan can kill exactly one
+host of a three-host fleet deterministically.
 
 Determinism: spec matching is pure event counting per seam (``after`` /
 ``count`` / ``every``); probabilistic specs (``prob`` < 1) draw from a
@@ -85,6 +102,7 @@ class FaultSpec:
     every: int = 1          # affect every k-th active event
     value: float = 0.0      # milliseconds for latency/stall/hang kinds
     prob: float = 1.0       # firing probability (seeded RNG)
+    host: Optional[str] = None  # fleet scoping: fire only in this host
     _fired: int = field(default=0, repr=False)
 
     @classmethod
@@ -92,7 +110,7 @@ class FaultSpec:
         known = {
             k: d[k]
             for k in ("seam", "kind", "after", "count", "every", "value",
-                      "prob")
+                      "prob", "host")
             if k in d
         }
         if "seam" not in known:
@@ -151,6 +169,8 @@ class FaultPlan:
             n = self._events.get(seam, 0)
             self._events[seam] = n + 1
             for spec in self.specs:
+                if spec.host is not None and spec.host != _chaos_host:
+                    continue
                 if spec.seam == seam and spec.decide(n, self._rng):
                     action = {
                         "seam": seam,
@@ -168,6 +188,16 @@ class FaultPlan:
 _plan: Optional[FaultPlan] = None
 _journal = None
 _journal_lock = threading.Lock()
+_chaos_host: Optional[str] = None
+
+
+def set_chaos_host(host_id: Optional[str]) -> None:
+    """Declare which fleet host THIS process is, so host-scoped fault
+    specs (``"host": "host1"``) can target one process of a fleet that
+    shares a single plan file. None (the default) matches no scoped
+    spec; unscoped specs fire everywhere regardless."""
+    global _chaos_host
+    _chaos_host = host_id
 
 
 def configure_chaos(config) -> Optional[FaultPlan]:

@@ -719,6 +719,38 @@ def cmd_stream(args) -> int:
         if v is not None
     }
     cfg = cfg.replace(stream=dataclasses.replace(cfg.stream, **overrides))
+    fleet_overrides = {
+        k: v
+        for k, v in {
+            "partitions": getattr(args, "fleet_partitions", None),
+            "partition_by": getattr(args, "partition_by", None),
+            "heartbeat_seconds": getattr(args, "heartbeat_seconds", None),
+            "lease_seconds": getattr(args, "lease_seconds", None),
+            "port": getattr(args, "fleet_port", None),
+            "restart_delay_seconds": getattr(
+                args, "fleet_restart_delay", None
+            ),
+            "restart_dead_workers": (
+                False
+                if getattr(args, "fleet_no_restart", False)
+                else None
+            ),
+        }.items()
+        if v is not None
+    }
+    if fleet_overrides:
+        cfg = cfg.replace(
+            fleet=dataclasses.replace(cfg.fleet, **fleet_overrides)
+        )
+
+    if getattr(args, "fleet", None):
+        # Fleet launcher: this process becomes the coordinator; workers
+        # are subprocesses re-invoking this command with --fleet-role
+        # worker. Source flags forward verbatim; everything else rides
+        # a config-json snapshot of the merged config.
+        from ..fleet.launcher import run_local_fleet
+
+        return run_local_fleet(cfg, args)
 
     if args.source == "synthetic":
         from ..testing import SyntheticConfig
@@ -782,6 +814,49 @@ def cmd_stream(args) -> int:
             "metrics endpoint: http://127.0.0.1:%d/metrics (+ /profilez)",
             server.port,
         )
+    # Crash-only shutdown: SIGTERM asks the engine to drain at the next
+    # batch boundary and write a final checkpoint — the process can be
+    # restarted with --resume and continue the SAME run.
+    import signal as _signal
+
+    def _install_sigterm(engine):
+        def _on_sigterm(_signo, _frame):
+            log.info(
+                "SIGTERM: draining stream engine (checkpoint on exit)"
+            )
+            engine.request_stop()
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except ValueError:  # pragma: no cover - not on the main thread
+            pass
+
+    if getattr(args, "fleet_role", None) == "worker":
+        if not args.coordinator_url or not args.host_id:
+            log.error(
+                "--fleet-role worker needs --coordinator-url and "
+                "--host-id"
+            )
+            return 2
+        from ..fleet.worker import run_fleet_worker
+
+        s, _engine = run_fleet_worker(
+            cfg,
+            source,
+            out_dir=args.output,
+            host_id=args.host_id,
+            coordinator_url=args.coordinator_url,
+            normal_df=normal_df,
+            resume=bool(getattr(args, "resume", False)),
+            on_engine=_install_sigterm,
+        )
+        log.info(
+            "fleet worker %s done: %d windows (%d ranked), %d spans; "
+            "results in %s",
+            args.host_id, s.windows, s.ranked, s.spans, args.output,
+        )
+        return 0
+
     engine = StreamEngine(
         cfg,
         source,
@@ -790,19 +865,7 @@ def cmd_stream(args) -> int:
         incident_sinks=[StdoutIncidentSink()],
         resume=bool(getattr(args, "resume", False)),
     )
-    # Crash-only shutdown: SIGTERM asks the engine to drain at the next
-    # batch boundary and write a final checkpoint — the process can be
-    # restarted with --resume and continue the SAME run.
-    import signal as _signal
-
-    def _on_sigterm(_signo, _frame):
-        log.info("SIGTERM: draining stream engine (checkpoint on exit)")
-        engine.request_stop()
-
-    try:
-        _signal.signal(_signal.SIGTERM, _on_sigterm)
-    except ValueError:  # pragma: no cover - not on the main thread
-        pass
+    _install_sigterm(engine)
     s = engine.run()
     for r in s.results:
         if r.ranking:
@@ -1371,6 +1434,63 @@ def main(argv=None) -> int:
         "--metrics-port", type=int, default=None,
         help="serve live telemetry over HTTP on this port; the "
         "snapshot also lands in -o at exit",
+    )
+    p_stream.add_argument(
+        "--fleet", type=_positive_int, default=None, metavar="N",
+        help="fleet mode: run the global incident coordinator in this "
+        "process and spawn N worker subprocesses, each streaming its "
+        "partition of the span source under -o/host<i>/ with its own "
+        "checkpoint; heartbeat leases + partition reassignment make "
+        "the fleet survive losing a worker, and dead workers restart "
+        "with --resume (crash-only supervision)",
+    )
+    p_stream.add_argument(
+        "--fleet-role", choices=["worker"], default=None,
+        help="join an existing fleet as a worker (needs "
+        "--coordinator-url and --host-id; `--fleet N` spawns these "
+        "for you locally — use this directly to place workers on "
+        "their own hosts)",
+    )
+    p_stream.add_argument(
+        "--coordinator-url", default=None,
+        help="fleet coordinator base URL (worker role)",
+    )
+    p_stream.add_argument(
+        "--host-id", default=None,
+        help="this worker's stable fleet identity (worker role; also "
+        "the id host-scoped chaos specs match)",
+    )
+    p_stream.add_argument(
+        "--fleet-partitions", type=_positive_int, default=None,
+        help="source partitions split across the fleet (default: one "
+        "per worker)",
+    )
+    p_stream.add_argument(
+        "--partition-by", choices=["trace", "service"], default=None,
+        help="partition key: crc32 of traceID (even spread; default) "
+        "or of serviceName (service locality)",
+    )
+    p_stream.add_argument(
+        "--heartbeat-seconds", type=float, default=None,
+        help="worker heartbeat cadence (renews the coordinator lease)",
+    )
+    p_stream.add_argument(
+        "--lease-seconds", type=float, default=None,
+        help="lease a silent worker holds before it is marked dead "
+        "and its partitions reassign to survivors",
+    )
+    p_stream.add_argument(
+        "--fleet-port", type=int, default=None,
+        help="coordinator bind port for --fleet (default: a free port)",
+    )
+    p_stream.add_argument(
+        "--fleet-restart-delay", type=float, default=None,
+        help="--fleet supervision: seconds before a dead worker "
+        "restarts with --resume",
+    )
+    p_stream.add_argument(
+        "--fleet-no-restart", action="store_true",
+        help="--fleet supervision: leave dead workers dead",
     )
     _add_config_flags(p_stream)
     p_stream.set_defaults(fn=cmd_stream)

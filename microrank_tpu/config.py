@@ -85,6 +85,21 @@ class PageRankConfig:
     # regression test pins). Kept as an opt-in for shard-count-
     # invariance experiments; costs S x the collective bytes.
     compensated_psum: bool = False
+    # Entry-sharded cross-shard combine, sparse prototype (arxiv
+    # 1312.3020): True replaces the dense psum of the [V]/[T] SpMV
+    # partials with a top-cap (index, value) exchange —
+    # ops.segment.sparse_psum: each shard contributes its
+    # ``sparse_allreduce_cap`` largest-|value| entries, one all_gather
+    # moves the pairs, and a local scatter-add rebuilds the dense
+    # vector. Exact whenever every shard's partial has at most ``cap``
+    # nonzeros (cap 0 = the full axis, always exact). Evaluated for the
+    # ISSUE-11 fleet-scaling item and left OFF — see DESIGN.md "Sparse
+    # allreduce evaluation" for the CPU-mesh measurement and verdict.
+    sparse_allreduce: bool = False
+    # Per-shard entry budget of the sparse exchange; 0 = the full axis
+    # length (exact, but then the exchange moves MORE bytes than the
+    # dense psum — useful only for parity tests and measurement).
+    sparse_allreduce_cap: int = 0
 
 
 @dataclass(frozen=True)
@@ -457,6 +472,49 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Fleet-scale streaming knobs (``fleet/`` subsystem).
+
+    Span sources partition across N worker processes (hash-of-trace-id
+    or per-service), each running its own windower + online baselines +
+    per-host ``state.ckpt``; a global coordinator merges per-host
+    watermarks into the fleet watermark, merges ranked verdicts with
+    the tie-aware comparator, and owns the SINGLE incident lifecycle —
+    N hosts seeing the same fault open exactly one incident. Workers
+    carry heartbeat leases; a missed lease marks the host dead and
+    reassigns its partitions to survivors; a rejoining worker restores
+    from its own checkpoint (``--resume``) without duplicate or lost
+    windows.
+    """
+
+    # Source partitions across the fleet; 0 = one per expected worker.
+    partitions: int = 0
+    # Partition key: "trace" (crc32 of traceID — even spread, every
+    # host sees every service) or "service" (crc32 of serviceName —
+    # RankMap-style locality: one service's spans land on one host).
+    partition_by: str = "trace"
+    # Heartbeat cadence (worker -> coordinator) and the lease it renews;
+    # a worker silent past ``lease_seconds`` is marked dead and its
+    # partitions reassign to survivors.
+    heartbeat_seconds: float = 1.0
+    lease_seconds: float = 5.0
+    # Coordinator bind address for `cli stream --fleet N` (port 0 picks
+    # a free one; workers get the resolved URL on their command line).
+    host: str = "127.0.0.1"
+    port: int = 0
+    # Worker -> coordinator HTTP timeout, and the bounded buffer reports
+    # park in while the coordinator is unreachable (drained in order on
+    # the next successful send; overflow drops oldest, counted).
+    report_timeout_seconds: float = 2.0
+    report_queue: int = 256
+    # Local launcher supervision: restart a dead worker with --resume
+    # (the rejoin path), after this delay, at most this many times.
+    restart_dead_workers: bool = True
+    restart_delay_seconds: float = 0.0
+    max_restarts: int = 1
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online RCA service knobs (``cli serve`` — serve/ subsystem).
 
@@ -597,6 +655,7 @@ class MicroRankConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     explain: ExplainConfig = field(default_factory=ExplainConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -640,4 +699,5 @@ class MicroRankConfig:
             obs=_mk(ObsConfig, d.get("obs", {})),
             explain=_mk(ExplainConfig, d.get("explain", {})),
             chaos=_mk(ChaosConfig, d.get("chaos", {})),
+            fleet=_mk(FleetConfig, d.get("fleet", {})),
         )

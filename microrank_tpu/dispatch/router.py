@@ -224,8 +224,14 @@ class DispatchRouter:
         if staged.route == "sharded":
             from ..parallel.sharded_rank import resolve_sharded_rank_fn
 
+            # The sharded route's staged global arrays donate exactly
+            # like the blob path's buffer: each staged handle is
+            # dispatched once, so the program may consume it (halves
+            # peak staging HBM under double-buffering on donation-
+            # capable backends).
             fn = resolve_sharded_rank_fn(
-                conv_trace, cfg.runtime.device_checks
+                conv_trace, cfg.runtime.device_checks,
+                donate=self._donate(),
             )
             return fn(
                 staged.handle, cfg.pagerank, cfg.spectrum, self._mesh,

@@ -1,0 +1,49 @@
+"""Fleet-scale crash-tolerant streaming (ISSUE 11, ROADMAP item 3).
+
+One logical span stream partitions across N worker processes
+(``partition``: crc32-of-trace-id or per-service assignment); each
+worker runs the full single-host streaming stack — windower, online
+baselines, gated device rank, per-host ``state.ckpt`` — and reports
+every finalized window to a global coordinator (``coordinator``) that
+merges per-host watermarks into the fleet watermark, merges ranked
+verdicts with the tie-aware comparator (``merge``), and owns the ONE
+incident lifecycle: N hosts seeing the same fault open exactly one
+incident. Heartbeat leases make host loss a first-class event — missed
+beats mark the host dead and reassign its partitions to survivors; the
+dead host rejoins with ``--resume`` and its re-reports dedup at the
+coordinator (``worker``). ``launcher`` is the one-command local shape
+(``cli stream --fleet N``) with crash-only supervision.
+"""
+
+from .coordinator import (
+    FleetCoordinator,
+    FleetServer,
+    WorkerState,
+)
+from .merge import fleet_watermark, merge_rankings
+from .partition import (
+    PartitionSet,
+    PartitionedSource,
+    partition_of,
+    split_partitions,
+)
+from .worker import (
+    CoordinatorClient,
+    FleetTracker,
+    run_fleet_worker,
+)
+
+__all__ = [
+    "CoordinatorClient",
+    "FleetCoordinator",
+    "FleetServer",
+    "FleetTracker",
+    "PartitionSet",
+    "PartitionedSource",
+    "WorkerState",
+    "fleet_watermark",
+    "merge_rankings",
+    "partition_of",
+    "run_fleet_worker",
+    "split_partitions",
+]

@@ -1,0 +1,544 @@
+"""The fleet's global coordinator: one incident lifecycle for N hosts.
+
+Workers register, heartbeat, and report every finalized window; the
+coordinator owns everything that must be GLOBAL so that N hosts seeing
+the same fault open exactly ONE incident:
+
+* **membership + leases** — every heartbeat (and report) renews a
+  worker's lease; a worker silent past ``lease_seconds`` is marked
+  dead, its source partitions reassign to the survivors (round-robin
+  over the live set), and sealing stops waiting for it. A rejoining
+  worker re-registers, the partitions rebalance back, and its
+  ``--resume``-restored stream re-reports from its checkpoint — those
+  already-sealed windows are dropped as ``late``/``duplicate``
+  (counted, never re-merged), which is the exactly-once guarantee
+  across a host loss.
+
+* **watermark sealing** — per-window report slots keyed by the
+  event-time window start; the fleet watermark is the MIN over live
+  workers' last-reported window, and every pending window at or below
+  it SEALS in start order, exactly once (the seal cursor is
+  monotonic). Workers window the same epoch-aligned geometry over the
+  same event time, so the same fault produces the same window keys on
+  every host.
+
+* **verdict merge + incident lifecycle** — a sealed window with any
+  ranked report merges the per-host rankings (``merge.merge_rankings``
+  — summed scores, tie-aware name order) and feeds the ONE
+  ``IncidentTracker``; otherwise it advances the healthy streak. The
+  tracker, its sinks (incidents.jsonl / stdout / webhook) and the
+  open/update/resolve dedup are exactly the single-process machinery —
+  lifted up one level.
+
+The HTTP surface (``FleetServer``) is the same stdlib shape as the
+serve/ and obs/ servers: POST /register, /heartbeat, /report,
+/goodbye; GET /fleetz for status. A reaper thread ticks leases so a
+dead host is noticed even while no traffic flows.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .merge import fleet_watermark, merge_rankings
+from .partition import split_partitions
+
+log = get_logger("microrank_tpu.fleet.coordinator")
+
+FLEET_INCIDENT_LOG = "incidents.jsonl"
+
+
+@dataclass
+class WorkerState:
+    host_id: str
+    partitions: List[int] = field(default_factory=list)
+    lease_deadline: float = 0.0
+    state: str = "alive"            # pending | alive | dead | done
+    spans: int = 0
+    windows: int = 0
+    uptime_s: float = 0.0
+    last_start_us: Optional[int] = None
+    registrations: int = 0
+
+    @property
+    def spans_per_second(self) -> float:
+        return self.spans / self.uptime_s if self.uptime_s > 0 else 0.0
+
+
+class FleetCoordinator:
+    """Global fleet state machine (lock-per-call; HTTP handler threads
+    and the reaper all funnel through one lock)."""
+
+    def __init__(
+        self,
+        config,
+        out_dir=None,
+        sinks: Optional[List] = None,
+        journal=None,
+        expected_workers: int = 0,
+        clock=time.monotonic,
+    ):
+        from ..stream.incidents import IncidentTracker
+
+        self.config = config
+        fc = config.fleet
+        sc = config.stream
+        self.clock = clock
+        self.lease_seconds = float(fc.lease_seconds)
+        self.heartbeat_seconds = float(fc.heartbeat_seconds)
+        self.partition_by = fc.partition_by
+        self.n_partitions = int(fc.partitions) or max(
+            1, int(expected_workers)
+        )
+        self.journal = journal
+        self.tracker = IncidentTracker(
+            top_k=sc.fingerprint_top_k,
+            resolve_after=sc.resolve_after_windows,
+            cooldown_windows=sc.cooldown_windows,
+            jaccard=sc.fingerprint_jaccard,
+            score_drift=sc.fingerprint_score_drift,
+            sinks=list(sinks or []),
+        )
+        self.out_dir = out_dir
+        self.workers: Dict[str, WorkerState] = {}
+        self._slots: Dict[int, Dict[str, dict]] = {}  # start_us -> host
+        self._lock = threading.Lock()
+        self._seal_cursor: Optional[int] = None  # last sealed start_us
+        self.sealed: List[dict] = []  # {start, start_us, outcome, hosts}
+        self.duplicate_reports = 0
+        self.late_reports = 0
+        self.reassignments = 0
+        # Expected-host pre-registration: the launcher knows its worker
+        # ids up front, so partitions are assigned stably BEFORE anyone
+        # registers (no first-comer-takes-all startup race) and a host
+        # that is merely slow to boot (jax import) blocks sealing
+        # through a startup grace instead of being sealed past.
+        with self._lock:
+            for i in range(max(0, int(expected_workers))):
+                host_id = f"host{i}"
+                self.workers[host_id] = WorkerState(
+                    host_id=host_id,
+                    state="pending",
+                    lease_deadline=self.clock()
+                    + 3.0 * self.lease_seconds,
+                )
+            if self.workers:
+                self._rebalance_locked("expect")
+                self._workers_gauge_locked()
+
+    # -------------------------------------------------------- lifecycle
+    def _journal(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.emit(event, **fields)
+            except Exception:  # noqa: BLE001 - telemetry stays best-effort
+                pass
+
+    def _status_locked(self, ws: Optional[WorkerState]) -> dict:
+        return {
+            "ok": True,
+            "partitions": sorted(ws.partitions) if ws else [],
+            "n_partitions": self.n_partitions,
+            "partition_by": self.partition_by,
+            "lease_seconds": self.lease_seconds,
+            "heartbeat_seconds": self.heartbeat_seconds,
+            "incident_open": self.tracker.has_open,
+            "opened": self.tracker.opened,
+            "resolved": self.tracker.resolved,
+            "sealed": len(self.sealed),
+        }
+
+    def _workers_gauge_locked(self) -> None:
+        from ..obs.metrics import record_fleet_workers
+
+        counts = {"alive": 0, "dead": 0, "done": 0}
+        for ws in self.workers.values():
+            counts[ws.state] = counts.get(ws.state, 0) + 1
+        record_fleet_workers(**counts)
+
+    def _rebalance_locked(self, why: str) -> None:
+        """Redistribute every partition round-robin across the live
+        workers (deterministic sorted-host order); journal + count each
+        host whose set changed."""
+        from ..obs.metrics import record_fleet_reassignment
+
+        # "done" workers keep their seats: a clean end-of-stream exit
+        # only happens on finite sources (nothing left to own), and
+        # keeping the map STABLE across it means a host that rejoins a
+        # winding-down fleet gets its own partitions back — which is
+        # exactly what lets its checkpointed source cursor restore
+        # (the partition-assignment validation would reject a cursor
+        # taken under a different set). Only death strips partitions.
+        live = [
+            w for w in self.workers.values() if w.state != "dead"
+        ]
+        if not live:
+            return
+        target = split_partitions(
+            self.n_partitions, [w.host_id for w in live]
+        )
+        for ws in live:
+            new = target[ws.host_id]
+            if new != ws.partitions:
+                if ws.partitions or why not in ("register", "expect"):
+                    # First-ever assignment is not a "reassignment";
+                    # every later move is.
+                    self.reassignments += 1
+                    record_fleet_reassignment()
+                    self._journal(
+                        "partition_reassigned",
+                        host=ws.host_id,
+                        partitions=new,
+                        previous=ws.partitions,
+                        why=why,
+                    )
+                ws.partitions = new
+
+    # -------------------------------------------------------------- API
+    def register(self, host_id: str, resume: bool = False) -> dict:
+        with self._lock:
+            ws = self.workers.get(host_id)
+            rejoin = ws is not None and ws.registrations > 0
+            if ws is None:
+                ws = self.workers[host_id] = WorkerState(host_id=host_id)
+            ws.state = "alive"
+            ws.registrations += 1
+            ws.lease_deadline = self.clock() + self.lease_seconds
+            self._rebalance_locked("rejoin" if rejoin else "register")
+            self._workers_gauge_locked()
+            self._journal(
+                "worker_registered",
+                host=host_id,
+                rejoin=rejoin,
+                resume=bool(resume),
+                partitions=sorted(ws.partitions),
+            )
+            log.info(
+                "worker %s %s (partitions %s)",
+                host_id,
+                "rejoined" if rejoin else "registered",
+                sorted(ws.partitions),
+            )
+            return self._status_locked(ws)
+
+    def heartbeat(
+        self,
+        host_id: str,
+        spans: int = 0,
+        windows: int = 0,
+        uptime_s: float = 0.0,
+    ) -> dict:
+        from ..obs.metrics import (
+            record_fleet_heartbeat,
+            record_fleet_host_rate,
+        )
+
+        with self._lock:
+            ws = self.workers.get(host_id)
+            if ws is None:
+                return {"ok": False, "error": f"unknown host {host_id!r}"}
+            ws.lease_deadline = self.clock() + self.lease_seconds
+            if ws.state == "dead":
+                # A heartbeat from a "dead" host: it was only silent —
+                # bring it back and rebalance (the lease system's
+                # false-positive recovery path).
+                ws.state = "alive"
+                self._rebalance_locked("lease_recovered")
+                self._workers_gauge_locked()
+            ws.spans = int(spans)
+            ws.windows = int(windows)
+            ws.uptime_s = float(uptime_s)
+            record_fleet_heartbeat(host_id)
+            record_fleet_host_rate(host_id, ws.spans_per_second)
+            self._reap_locked()
+            self._seal_locked()
+            return self._status_locked(ws)
+
+    def report(self, host_id: str, window: dict) -> dict:
+        """One finalized window from one host. Idempotent per
+        (host, window): re-reports after a resume dedup here, and
+        reports for already-sealed windows drop as ``late`` — both
+        counted, neither ever reaches the tracker twice."""
+        from ..obs.metrics import record_fleet_report
+
+        with self._lock:
+            ws = self.workers.get(host_id)
+            if ws is None:
+                return {"ok": False, "error": f"unknown host {host_id!r}"}
+            ws.lease_deadline = self.clock() + self.lease_seconds
+            if ws.state != "alive":
+                ws.state = "alive"
+                self._rebalance_locked("lease_recovered")
+                self._workers_gauge_locked()
+            start_us = int(window["start_us"])
+            ws.last_start_us = start_us
+            if (
+                self._seal_cursor is not None
+                and start_us <= self._seal_cursor
+            ):
+                self.late_reports += 1
+                status = "late"
+            elif host_id in self._slots.get(start_us, {}):
+                self.duplicate_reports += 1
+                status = "duplicate"
+            else:
+                self._slots.setdefault(start_us, {})[host_id] = dict(
+                    window
+                )
+                status = "accepted"
+            record_fleet_report(status)
+            self._reap_locked()
+            self._seal_locked()
+            resp = self._status_locked(ws)
+            resp["report"] = status
+            return resp
+
+    def goodbye(self, host_id: str) -> dict:
+        """Clean worker exit (finite source drained): the host stops
+        blocking the fleet watermark without the lease having to age
+        out; when the LAST worker leaves, everything pending seals."""
+        with self._lock:
+            ws = self.workers.get(host_id)
+            if ws is None:
+                return {"ok": False, "error": f"unknown host {host_id!r}"}
+            ws.state = "done"
+            self._workers_gauge_locked()
+            self._journal(
+                "worker_done", host=host_id, windows=ws.windows,
+                spans=ws.spans,
+            )
+            if all(
+                w.state not in ("alive", "pending")
+                for w in self.workers.values()
+            ):
+                self._seal_locked(flush=True)
+            else:
+                self._seal_locked()
+            return self._status_locked(ws)
+
+    def tick(self) -> None:
+        """Reaper entry: age leases, then try to seal (a death can
+        unblock the watermark)."""
+        with self._lock:
+            self._reap_locked()
+            self._seal_locked()
+
+    # ------------------------------------------------------------ leases
+    def _reap_locked(self) -> None:
+        now = self.clock()
+        newly_dead = [
+            ws
+            for ws in self.workers.values()
+            if ws.state in ("alive", "pending")
+            and ws.lease_deadline < now
+        ]
+        if not newly_dead:
+            return
+        for ws in newly_dead:
+            ws.state = "dead"
+            log.warning(
+                "worker %s lease expired (%.1fs silent); marking dead "
+                "and reassigning partitions %s",
+                ws.host_id, self.lease_seconds, sorted(ws.partitions),
+            )
+            self._journal(
+                "worker_dead",
+                host=ws.host_id,
+                partitions=sorted(ws.partitions),
+                last_start_us=ws.last_start_us,
+            )
+            ws.partitions = []
+        self._rebalance_locked("lease_expired")
+        self._workers_gauge_locked()
+
+    # ----------------------------------------------------------- sealing
+    def _seal_locked(self, flush: bool = False) -> None:
+        from ..obs.metrics import record_fleet_sealed
+
+        while self._slots:
+            start_us = min(self._slots)
+            if not flush:
+                wm = fleet_watermark(
+                    ws.last_start_us
+                    for ws in self.workers.values()
+                    if ws.state in ("alive", "pending")
+                )
+                if wm is None or start_us > wm:
+                    return
+            reports = self._slots.pop(start_us)
+            self._seal_cursor = start_us
+            ranked = [
+                r for r in reports.values() if r.get("outcome") == "ranked"
+            ]
+            start = next(iter(reports.values())).get("start") or str(
+                start_us
+            )
+            if ranked:
+                merged = merge_rankings(r.get("ranking") for r in ranked)
+                outcome = "ranked"
+                self.tracker.observe_ranked(start, merged)
+            else:
+                merged = []
+                outcome = "healthy"
+                self.tracker.observe_healthy(start)
+            record_fleet_sealed(outcome)
+            self.sealed.append(
+                {
+                    "start": start,
+                    "start_us": start_us,
+                    "outcome": outcome,
+                    "hosts": sorted(reports),
+                    "n_spans": sum(
+                        int(r.get("n_spans", 0)) for r in reports.values()
+                    ),
+                }
+            )
+            self._journal(
+                "fleet_window",
+                start=start,
+                outcome=outcome,
+                hosts=sorted(reports),
+                ranked_hosts=len(ranked),
+                top=[[n, float(s)] for n, s in merged[:5]],
+            )
+
+    # ------------------------------------------------------------ status
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "workers": {
+                    w.host_id: {
+                        "state": w.state,
+                        "partitions": sorted(w.partitions),
+                        "spans": w.spans,
+                        "windows": w.windows,
+                        "spans_per_second": round(w.spans_per_second, 2),
+                        "last_start_us": w.last_start_us,
+                    }
+                    for w in self.workers.values()
+                },
+                "n_partitions": self.n_partitions,
+                "sealed": len(self.sealed),
+                "pending": len(self._slots),
+                "duplicate_reports": self.duplicate_reports,
+                "late_reports": self.late_reports,
+                "reassignments": self.reassignments,
+                "incidents_opened": self.tracker.opened,
+                "incidents_resolved": self.tracker.resolved,
+                "incident_open": self.tracker.has_open,
+            }
+
+    def finalize(self) -> dict:
+        """End of run: seal everything pending, journal per-host rates
+        and the run summary. Returns the final status dict."""
+        with self._lock:
+            self._seal_locked(flush=True)
+            for ws in self.workers.values():
+                self._journal(
+                    "fleet_host_stats",
+                    host=ws.host_id,
+                    state=ws.state,
+                    spans=ws.spans,
+                    windows=ws.windows,
+                    spans_per_second=round(ws.spans_per_second, 2),
+                )
+        return self.status()
+
+
+class FleetServer:
+    """stdlib HTTP front of a FleetCoordinator + the lease reaper."""
+
+    def __init__(self, coordinator: FleetCoordinator,
+                 host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        coord = coordinator
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.partition("?")[0] == "/fleetz":
+                    self._reply(200, coord.status())
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError):
+                    self._reply(400, {"ok": False, "error": "bad JSON"})
+                    return
+                host_id = str(doc.get("host", ""))
+                route = self.path.partition("?")[0]
+                if route == "/register":
+                    resp = coord.register(
+                        host_id, resume=bool(doc.get("resume"))
+                    )
+                elif route == "/heartbeat":
+                    resp = coord.heartbeat(
+                        host_id,
+                        spans=int(doc.get("spans", 0)),
+                        windows=int(doc.get("windows", 0)),
+                        uptime_s=float(doc.get("uptime_s", 0.0)),
+                    )
+                elif route == "/report":
+                    resp = coord.report(host_id, doc.get("window") or {})
+                elif route == "/goodbye":
+                    resp = coord.goodbye(host_id)
+                else:
+                    self.send_error(404)
+                    return
+                self._reply(200 if resp.get("ok") else 404, resp)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self.coordinator = coordinator
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self.url = f"http://{host}:{self.port}"
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._httpd.serve_forever,
+                name="mr-fleet-http",
+                daemon=True,
+            ),
+            threading.Thread(
+                target=self._reap_loop, name="mr-fleet-reaper", daemon=True
+            ),
+        ]
+
+    def _reap_loop(self) -> None:
+        tick = max(0.05, min(self.coordinator.lease_seconds / 4.0, 1.0))
+        while not self._stop.wait(tick):
+            try:
+                self.coordinator.tick()
+            except Exception:  # noqa: BLE001 - the reaper must survive
+                log.exception("fleet reaper tick failed")
+
+    def start(self) -> "FleetServer":
+        for t in self._threads:
+            t.start()
+        log.info("fleet coordinator listening on %s", self.url)
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()

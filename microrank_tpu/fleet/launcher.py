@@ -1,0 +1,252 @@
+"""Local fleet launcher: ``cli stream --fleet N`` on one machine.
+
+Runs the coordinator IN this process (HTTP on a loopback port) and
+spawns N worker subprocesses, each a full ``cli stream --fleet-role
+worker`` invocation writing under ``out_dir/host<i>/`` — the
+one-command shape of the N-host deployment (real fleets start workers
+on their own hosts pointing ``--coordinator-url`` at this process, and
+optionally join a cross-host device mesh via ``--distributed`` /
+``initialize_distributed`` exactly like ``cli run``).
+
+Supervision is the crash-only story at fleet scope: a worker that dies
+(nonzero exit — e.g. the ``host_kill`` chaos seam's ``os._exit(137)``)
+restarts with ``--resume`` after ``restart_delay_seconds``, up to
+``max_restarts`` times; its lease meanwhile expires, the survivors
+absorb its partitions, and the rejoin rebalances them back. The
+coordinator's incidents.jsonl, journal and metrics snapshot land in
+``out_dir`` — the per-host artifacts under ``out_dir/host<i>/``.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.fleet.launcher")
+
+FLEET_CONFIG_NAME = "fleet_config.json"
+
+# Source / stream flags forwarded verbatim to worker command lines
+# (argparse dest -> flag). Everything else rides --config-json.
+_FORWARDED_FLAGS = {
+    "source": "--source",
+    "input": "--input",
+    "normal": "--normal",
+    "detect_minutes": "--detect-minutes",
+    "slide_minutes": "--slide-minutes",
+    "lateness_seconds": "--lateness-seconds",
+    "max_windows": "--max-windows",
+    "pace_seconds": "--pace-seconds",
+    "chunk_spans": "--chunk-spans",
+    "rate": "--rate",
+    "poll_seconds": "--poll-seconds",
+    "idle_exit": "--idle-exit",
+    "windows": "--windows",
+    "fault_windows": "--fault-windows",
+    "operations": "--operations",
+    "pods": "--pods",
+    "kinds": "--kinds",
+    "traces": "--traces",
+    "fault_ms": "--fault-ms",
+    "seed": "--seed",
+    "chaos": "--chaos",
+    "chaos_seed": "--chaos-seed",
+}
+
+
+def worker_command(
+    args,
+    config_json: Path,
+    url: str,
+    host_id: str,
+    host_out: Path,
+    resume: bool = False,
+) -> List[str]:
+    """The `cli stream --fleet-role worker` command line for one host."""
+    cmd = [
+        sys.executable, "-m", "microrank_tpu.cli", "stream",
+        "--fleet-role", "worker",
+        "--coordinator-url", url,
+        "--host-id", host_id,
+        "--config-json", str(config_json),
+        "-o", str(host_out),
+    ]
+    for dest, flag in _FORWARDED_FLAGS.items():
+        val = getattr(args, dest, None)
+        if val is not None:
+            cmd += [flag, str(val)]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+class _Worker:
+    def __init__(
+        self,
+        host_id: str,
+        cmd: List[str],
+        resume_cmd: List[str],
+        out_dir: Path,
+    ):
+        self.host_id = host_id
+        self.cmd = cmd
+        self.resume_cmd = resume_cmd
+        self.out_dir = out_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.exit_code: Optional[int] = None
+
+    def spawn(self, resume: bool = False) -> None:
+        cmd = list(self.resume_cmd if resume else self.cmd)
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        log_path = self.out_dir / "worker.log"
+        with open(log_path, "ab") as logf:
+            self.proc = subprocess.Popen(
+                cmd, stdout=logf, stderr=subprocess.STDOUT
+            )
+        log.info(
+            "spawned %s (pid %d%s); log: %s",
+            self.host_id, self.proc.pid,
+            ", resume" if resume else "", log_path,
+        )
+
+
+def run_local_fleet(config, args) -> int:
+    """Coordinator + N local worker subprocesses; returns exit code."""
+    from ..obs.metrics import ensure_catalog
+    from ..stream.incidents import JsonlIncidentSink, StdoutIncidentSink
+
+    fc = config.fleet
+    n_workers = int(args.fleet)
+    out_dir = Path(args.output)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    ensure_catalog()
+
+    journal = None
+    sinks = [StdoutIncidentSink()]
+    from ..stream.engine import INCIDENT_LOG_NAME, _JournalIncidentSink
+
+    sinks.append(JsonlIncidentSink(out_dir / INCIDENT_LOG_NAME))
+    if config.runtime.telemetry:
+        from ..obs import JOURNAL_NAME, RunJournal
+
+        journal = RunJournal(out_dir / JOURNAL_NAME)
+        sinks.append(_JournalIncidentSink(journal))
+
+    from .coordinator import FleetCoordinator, FleetServer
+
+    coordinator = FleetCoordinator(
+        config,
+        out_dir=out_dir,
+        sinks=sinks,
+        journal=journal,
+        expected_workers=n_workers,
+    )
+    server = FleetServer(coordinator, host=fc.host, port=fc.port).start()
+    if journal is not None:
+        journal.run_start(
+            pipeline="fleet",
+            workers=n_workers,
+            partitions=coordinator.n_partitions,
+            partition_by=coordinator.partition_by,
+            lease_seconds=coordinator.lease_seconds,
+        )
+
+    config_json = out_dir / FLEET_CONFIG_NAME
+    config_json.write_text(json.dumps(config.to_dict(), indent=2))
+    # Restart incarnations run chaos-CLEAN: a plan's event counters are
+    # per-process, so re-arming it on the rejoin would replay the same
+    # deterministic kill and defeat supervision.
+    from ..config import ChaosConfig
+
+    clean_json = out_dir / ("clean_" + FLEET_CONFIG_NAME)
+    clean_json.write_text(
+        json.dumps(config.replace(chaos=ChaosConfig()).to_dict(), indent=2)
+    )
+    workers = []
+    for i in range(n_workers):
+        host_id = f"host{i}"
+        host_out = out_dir / host_id
+        cmd = worker_command(
+            args, config_json, server.url, host_id, host_out,
+            resume=bool(getattr(args, "resume", False)),
+        )
+        resume_cmd = worker_command(
+            args, clean_json, server.url, host_id, host_out, resume=True
+        )
+        # Drop the forwarded chaos flags from the restart line too (the
+        # clean config already disarms them; this keeps the logged
+        # command honest).
+        for flag in ("--chaos", "--chaos-seed"):
+            while flag in resume_cmd:
+                i_f = resume_cmd.index(flag)
+                del resume_cmd[i_f : i_f + 2]
+        w = _Worker(host_id, cmd, resume_cmd, host_out)
+        w.spawn()
+        workers.append(w)
+
+    try:
+        running = list(workers)
+        while running:
+            time.sleep(0.2)
+            for w in list(running):
+                rc = w.proc.poll()
+                if rc is None:
+                    continue
+                w.exit_code = rc
+                if (
+                    rc != 0
+                    and fc.restart_dead_workers
+                    and w.restarts < fc.max_restarts
+                ):
+                    # The rejoin path: the worker's own checkpoint is
+                    # the lossless half, the lease/reassignment dance
+                    # covered the gap.
+                    log.warning(
+                        "%s exited %d; restarting with --resume "
+                        "(%d/%d)", w.host_id, rc, w.restarts + 1,
+                        fc.max_restarts,
+                    )
+                    w.restarts += 1
+                    if fc.restart_delay_seconds > 0:
+                        time.sleep(fc.restart_delay_seconds)
+                    w.spawn(resume=True)
+                    continue
+                running.remove(w)
+                if rc != 0:
+                    log.error("%s exited %d (no restart)", w.host_id, rc)
+    finally:
+        status = coordinator.finalize()
+        if journal is not None:
+            journal.run_end(
+                sealed=status["sealed"],
+                incidents_opened=status["incidents_opened"],
+                incidents_resolved=status["incidents_resolved"],
+                duplicate_reports=status["duplicate_reports"],
+                late_reports=status["late_reports"],
+                reassignments=status["reassignments"],
+            )
+            journal.sync()
+        if config.runtime.telemetry:
+            from ..obs import get_registry
+
+            get_registry().write_snapshot(out_dir)
+        server.shutdown()
+
+    failed = [w for w in workers if w.exit_code != 0]
+    log.info(
+        "fleet done: %d sealed windows, incidents %d opened / %d "
+        "resolved, %d duplicate + %d late reports, %d reassignments, "
+        "%d worker restart(s); results in %s",
+        status["sealed"], status["incidents_opened"],
+        status["incidents_resolved"], status["duplicate_reports"],
+        status["late_reports"], status["reassignments"],
+        sum(w.restarts for w in workers), out_dir,
+    )
+    return 1 if failed else 0

@@ -1,0 +1,47 @@
+"""Cross-host verdict + watermark merging (``fleet/`` subsystem).
+
+Each worker ranks the sub-window its partitions produced; the
+coordinator re-joins the per-host verdicts into ONE fleet verdict per
+window:
+
+* scores SUM per suspect name — the spectrum counters underlying a
+  score are counts over the host's (disjoint) trace subset, so the sum
+  is the natural pooled evidence: a suspect two hosts both blame
+  outranks one only a single host saw;
+* the merged list sorts with the SAME tie-aware two-key comparator the
+  device path realizes (descending score, ascending name on an exact
+  tie — SpectrumConfig.tiebreak="name") so a legally permuted tie on
+  two hosts cannot produce two different fleet verdicts.
+
+The fleet watermark is the MIN over live workers' last-finalized
+window: a window seals only once every live host's stream has moved
+past it, which is what makes the coordinator's incident lifecycle
+observe windows exactly once and strictly in order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+Ranking = Sequence[Tuple[str, float]]
+
+
+def merge_rankings(rankings: Iterable[Ranking]) -> List[Tuple[str, float]]:
+    """Pool per-host ranked verdicts into one fleet ranking."""
+    totals: Dict[str, float] = {}
+    for ranking in rankings:
+        for name, score in ranking or ():
+            totals[str(name)] = totals.get(str(name), 0.0) + float(score)
+    return sorted(totals.items(), key=lambda kv: (-kv[1], kv[0]))
+
+
+def fleet_watermark(
+    worker_watermarks: Iterable[Optional[int]],
+) -> Optional[int]:
+    """MIN over live workers' last-finalized window start (µs); None —
+    a live worker that has not finalized a window yet — blocks sealing
+    entirely (the fleet cannot know that worker's stream position)."""
+    marks = list(worker_watermarks)
+    if not marks or any(m is None for m in marks):
+        return None
+    return min(marks)

@@ -1,0 +1,417 @@
+"""Fleet worker: one stream engine + the coordinator protocol client.
+
+A worker is a whole single-host streaming stack — partitioned source,
+windower, online baselines, build pool, device dispatch, per-host
+``state.ckpt`` — with the incident lifecycle REPLACED by a proxy: every
+finalized window becomes a report to the coordinator, which owns the
+one global tracker. Three moving parts:
+
+* ``CoordinatorClient`` — the HTTP client (stdlib urllib, explicit
+  timeouts). Every send consults the ``coordinator_unreachable`` chaos
+  seam; sends go through the unified retry policy
+  (``FLEET_REPORT_POLICY`` — short backoff, per-seam breaker), and a
+  report that still fails PARKS in a bounded FIFO, re-sent IN ORDER
+  before the next report — an unreachable coordinator costs the fleet
+  verdict latency, never a window (the coordinator's per-(host,window)
+  dedup makes the re-sends idempotent).
+
+* ``FleetTracker`` — the engine-facing IncidentTracker stand-in:
+  ``observe_ranked``/``observe_healthy`` build reports;
+  ``has_open``/``opened``/``resolved`` mirror the coordinator's
+  response so the baseline anti-poisoning freeze and the incident
+  flight dump keep working per host. Its checkpoint state carries the
+  parked report buffer, so a SIGKILL loses no buffered report either.
+  The ``host_kill`` chaos seam fires here, once per observed window —
+  ``kind: "kill"`` is ``os._exit``, the modeled host loss.
+
+* ``_HeartbeatLoop`` — a daemon thread renewing the lease every
+  ``heartbeat_seconds`` with per-host throughput stats, applying any
+  partition reassignment the coordinator returns to the live
+  ``PartitionSet`` (the ``heartbeat_drop`` seam skips sends so lease
+  expiry is drivable without killing anything). Heartbeats touch no
+  jax — the engine thread stays the sole device owner.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import List, Optional
+
+import pandas as pd
+
+from ..chaos.retry import RetryPolicy, retry_call
+from ..utils.logging import get_logger
+from .partition import PartitionSet, PartitionedSource
+
+log = get_logger("microrank_tpu.fleet.worker")
+
+# Report sends fail fast and lean on the buffer (the engine thread is
+# calling); registration retries patiently — a worker that cannot join
+# the fleet has nothing else to do.
+FLEET_REPORT_POLICY = RetryPolicy(
+    max_attempts=2, base_delay_s=0.05, max_delay_s=0.5,
+    breaker_threshold=4, breaker_reset_s=2.0,
+)
+FLEET_REGISTER_POLICY = RetryPolicy(
+    max_attempts=10, base_delay_s=0.2, max_delay_s=2.0,
+    breaker_threshold=100,
+)
+
+
+class CoordinatorClient:
+    """Worker -> coordinator HTTP with buffering + backoff + breaker."""
+
+    def __init__(
+        self,
+        url: str,
+        host_id: str,
+        timeout: float = 2.0,
+        max_queue: int = 256,
+    ):
+        self.url = url.rstrip("/")
+        self.host_id = host_id
+        self.timeout = max(0.1, float(timeout))
+        self.max_queue = max(1, int(max_queue))
+        self._buffer = deque()        # parked report payloads, in order
+        self._lock = threading.Lock()
+        self.sent = 0
+        self.buffered = 0
+        self.dropped = 0
+        self.last_status: dict = {}
+
+    # ------------------------------------------------------------- wire
+    def _post(self, route: str, payload: dict) -> dict:
+        from ..chaos.faults import InjectedFault, maybe_inject
+
+        action = maybe_inject("coordinator_unreachable")
+        if action is not None:
+            # Non-raising kinds (e.g. "drop") simulate the same loss.
+            raise InjectedFault("coordinator_unreachable", action["kind"])
+        req = urllib.request.Request(
+            f"{self.url}{route}",
+            data=json.dumps({"host": self.host_id, **payload}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            doc = json.loads(resp.read() or b"{}")
+        if not doc.get("ok"):
+            raise RuntimeError(
+                f"coordinator rejected {route}: {doc.get('error')}"
+            )
+        self.sent += 1
+        self.last_status = doc
+        return doc
+
+    # -------------------------------------------------------------- API
+    def register(self, resume: bool = False) -> dict:
+        return retry_call(
+            "fleet_register",
+            lambda: self._post("/register", {"resume": bool(resume)}),
+            policy=FLEET_REGISTER_POLICY,
+        )
+
+    def heartbeat(
+        self, spans: int, windows: int, uptime_s: float
+    ) -> Optional[dict]:
+        """Best-effort lease renewal; a failure is counted by the
+        caller, never raised (the next beat retries naturally)."""
+        try:
+            return self._post(
+                "/heartbeat",
+                {
+                    "spans": int(spans),
+                    "windows": int(windows),
+                    "uptime_s": float(uptime_s),
+                },
+            )
+        except Exception as e:  # noqa: BLE001 - heartbeats are lossy
+            log.warning("heartbeat failed: %s", e)
+            return None
+
+    def report(self, window: dict) -> Optional[dict]:
+        """Deliver one finalized window, draining parked reports first
+        (order preserved). On failure the window parks; a full buffer
+        evicts the OLDEST entry (counted) — the coordinator will seal
+        that window from the other hosts' reports."""
+        with self._lock:
+            self._buffer.append(window)
+            if len(self._buffer) > self.max_queue:
+                from ..obs.metrics import record_fleet_report
+
+                self._buffer.popleft()
+                self.dropped += 1
+                record_fleet_report("dropped")
+            return self._flush_locked()
+
+    def flush(self) -> Optional[dict]:
+        """Drain parked reports (engine drain / final checkpoint)."""
+        with self._lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> Optional[dict]:
+        from ..chaos.retry import BreakerOpen
+        from ..obs.metrics import record_fleet_report
+
+        resp = None
+        while self._buffer:
+            head = self._buffer[0]
+            try:
+                resp = retry_call(
+                    "fleet_report",
+                    lambda: self._post("/report", {"window": head}),
+                    policy=FLEET_REPORT_POLICY,
+                )
+            except BreakerOpen:
+                # Coordinator definitively down right now: park
+                # silently, the breaker's half-open probe gates the
+                # next attempt.
+                self.buffered = len(self._buffer)
+                record_fleet_report("buffered")
+                return resp
+            except Exception as e:  # noqa: BLE001 - park and move on
+                log.warning(
+                    "report for window %s parked (%s); %d buffered",
+                    head.get("start"), e, len(self._buffer),
+                )
+                self.buffered = len(self._buffer)
+                record_fleet_report("buffered")
+                return resp
+            self._buffer.popleft()
+        self.buffered = 0
+        return resp
+
+    def goodbye(self) -> None:
+        try:
+            self.flush()
+            self._post("/goodbye", {})
+        except Exception as e:  # noqa: BLE001 - exit is best-effort
+            log.warning("goodbye failed: %s", e)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+    # ------------------------------------------------------- durability
+    def buffered_state(self) -> List[dict]:
+        with self._lock:
+            return [dict(w) for w in self._buffer]
+
+    def restore_buffer(self, windows: List[dict]) -> None:
+        with self._lock:
+            self._buffer = deque(dict(w) for w in windows)
+
+    def reset_buffer(self) -> None:
+        with self._lock:
+            self._buffer.clear()
+
+
+def _start_us(window_start: str) -> int:
+    return int(pd.Timestamp(window_start).value // 1000)
+
+
+class FleetTracker:
+    """IncidentTracker-shaped proxy: windows out, lifecycle state in.
+
+    The engine drives it exactly like the local tracker; every observed
+    window becomes a coordinator report, and the lifecycle counters
+    (``has_open``/``opened``/``resolved``) mirror the coordinator's
+    last response — so the worker's baseline freeze and
+    incident-open flight dump follow the FLEET lifecycle, not a local
+    one. ``on_open`` hooks (the explain bundle) are ignored: provenance
+    for a fleet incident is the coordinator's concern.
+    """
+
+    def __init__(self, client: CoordinatorClient, host_id: str):
+        self.client = client
+        self.host_id = host_id
+        self.sinks: List = []     # engine flushes tracker sinks at drain
+        self.opened = 0
+        self.resolved = 0
+        self.suppressed = 0
+        self._open = False
+        self._window_no = 0
+
+    # ------------------------------------------------------------- state
+    @property
+    def has_open(self) -> bool:
+        return self._open
+
+    def open_incidents(self) -> List:
+        return []
+
+    def apply_status(self, resp: Optional[dict]) -> None:
+        if not resp:
+            return
+        self.opened = int(resp.get("opened", self.opened))
+        self.resolved = int(resp.get("resolved", self.resolved))
+        self._open = bool(resp.get("incident_open", self._open))
+
+    # ------------------------------------------------------------ intake
+    def _observe(self, window: dict):
+        from ..chaos.faults import maybe_inject
+
+        action = maybe_inject("host_kill")
+        if action is not None and action["kind"] in ("kill", "fail"):
+            # The modeled host loss: no drain, no final checkpoint, no
+            # goodbye — the coordinator finds out via the lease.
+            log.warning("chaos host_kill: exiting hard (os._exit 137)")
+            os._exit(137)
+        self._window_no += 1
+        self.apply_status(self.client.report(window))
+
+    def observe_ranked(self, window_start: str, ranking, on_open=None):
+        self._observe(
+            {
+                "start": str(window_start),
+                "start_us": _start_us(window_start),
+                "outcome": "ranked",
+                "ranking": [[str(n), float(s)] for n, s in ranking],
+            }
+        )
+        return None
+
+    def observe_healthy(self, window_start: str) -> List:
+        self._observe(
+            {
+                "start": str(window_start),
+                "start_us": _start_us(window_start),
+                "outcome": "healthy",
+                "ranking": [],
+            }
+        )
+        return []
+
+    # ------------------------------------------------------- durability
+    def to_state(self) -> dict:
+        return {
+            "type": "fleet",
+            "window_no": self._window_no,
+            "buffered": self.client.buffered_state(),
+        }
+
+    def restore(self, state: dict) -> None:
+        if state.get("type") != "fleet":
+            raise ValueError(
+                "checkpoint tracker state is not a fleet proxy state "
+                "(single-process and fleet checkpoints do not mix)"
+            )
+        buffered = [dict(w) for w in state.get("buffered", [])]
+        self._window_no = int(state.get("window_no", 0))
+        self.client.restore_buffer(buffered)
+
+    def reset(self) -> None:
+        self._window_no = 0
+        self.client.reset_buffer()
+
+
+class _HeartbeatLoop(threading.Thread):
+    def __init__(self, client: CoordinatorClient, engine,
+                 assignment: PartitionSet, tracker: FleetTracker,
+                 interval: float):
+        super().__init__(name="mr-fleet-heartbeat", daemon=True)
+        self.client = client
+        self.engine = engine
+        self.assignment = assignment
+        self.tracker = tracker
+        self.interval = max(0.05, float(interval))
+        self.beats = 0
+        self.drops = 0
+        self._t0 = time.monotonic()
+        # NB: not ``_stop`` — threading.Thread has a private method of
+        # that name and shadowing it breaks join().
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        from ..chaos.faults import maybe_inject
+
+        while not self._halt.wait(self.interval):
+            if maybe_inject("heartbeat_drop") is not None:
+                self.drops += 1
+                continue
+            summary = self.engine.summary
+            resp = self.client.heartbeat(
+                spans=getattr(summary, "spans", 0),
+                windows=summary.windows,
+                uptime_s=time.monotonic() - self._t0,
+            )
+            if resp is not None:
+                self.beats += 1
+                self.tracker.apply_status(resp)
+                self.assignment.set(resp.get("partitions", []))
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def run_fleet_worker(
+    config,
+    source,
+    out_dir,
+    host_id: str,
+    coordinator_url: str,
+    normal_df=None,
+    resume: bool = False,
+    on_engine=None,
+):
+    """Join the fleet and stream until the source drains.
+
+    Registration blocks (with patient retry) until the coordinator
+    answers with this host's partition assignment; the engine then runs
+    the ordinary crash-only loop with the partitioned source and the
+    tracker proxy. Exit flushes parked reports and says goodbye so the
+    fleet watermark stops waiting on this host without a lease timeout.
+    """
+    from ..chaos import set_chaos_host
+    from ..stream.engine import StreamEngine
+
+    fc = config.fleet
+    set_chaos_host(host_id)
+    client = CoordinatorClient(
+        coordinator_url,
+        host_id,
+        timeout=fc.report_timeout_seconds,
+        max_queue=fc.report_queue,
+    )
+    hello = client.register(resume=resume)
+    assignment = PartitionSet(hello.get("partitions", []))
+    psource = PartitionedSource(
+        source,
+        assignment,
+        n_partitions=int(hello.get("n_partitions", 1)),
+        partition_by=hello.get("partition_by", fc.partition_by),
+    )
+    tracker = FleetTracker(client, host_id)
+    tracker.apply_status(hello)
+    engine = StreamEngine(
+        config,
+        psource,
+        out_dir=out_dir,
+        normal_df=normal_df,
+        tracker=tracker,
+        resume=resume,
+    )
+    if on_engine is not None:
+        on_engine(engine)   # e.g. the CLI's SIGTERM drain hook
+    heartbeat = _HeartbeatLoop(
+        client, engine, assignment, tracker,
+        interval=float(hello.get("heartbeat_seconds", fc.heartbeat_seconds)),
+    )
+    heartbeat.start()
+    try:
+        summary = engine.run()
+    finally:
+        heartbeat.stop()
+        client.goodbye()
+    log.info(
+        "fleet worker %s done: %d windows (%d ranked), %d spans, "
+        "%d reports sent, %d still buffered",
+        host_id, summary.windows, summary.ranked,
+        getattr(summary, "spans", 0), client.sent, client.pending(),
+    )
+    return summary, engine

@@ -419,6 +419,60 @@ def checkpoint_events() -> Counter:
     )
 
 
+def fleet_heartbeats() -> Counter:
+    return get_registry().counter(
+        "microrank_fleet_heartbeats_total",
+        "Worker heartbeats received by the fleet coordinator",
+        labelnames=("host",),
+    )
+
+
+def fleet_reports() -> Counter:
+    return get_registry().counter(
+        "microrank_fleet_reports_total",
+        "Per-window worker reports by disposition: accepted into a "
+        "seal slot, duplicate (same host re-reported a pending window "
+        "— the resume-rejoin dedup), late (window already sealed), "
+        "buffered (worker-side park while the coordinator was "
+        "unreachable), dropped (worker buffer overflow)",
+        labelnames=("status",),
+    )
+
+
+def fleet_workers_gauge() -> Gauge:
+    return get_registry().gauge(
+        "microrank_fleet_workers",
+        "Fleet membership by worker state (lease-derived)",
+        labelnames=("state",),  # alive | dead | done
+    )
+
+
+def fleet_reassignments() -> Counter:
+    return get_registry().counter(
+        "microrank_fleet_reassignments_total",
+        "Source-partition moves between workers (lease expiry takes a "
+        "dead host's partitions to survivors; a rejoin rebalances "
+        "them back)",
+    )
+
+
+def fleet_sealed_windows() -> Counter:
+    return get_registry().counter(
+        "microrank_fleet_sealed_windows_total",
+        "Windows sealed at the fleet watermark, by merged outcome",
+        labelnames=("outcome",),  # ranked | healthy
+    )
+
+
+def fleet_host_spans_rate() -> Gauge:
+    return get_registry().gauge(
+        "microrank_fleet_host_spans_per_second",
+        "Per-host ingest throughput from the last heartbeat "
+        "(spans processed / worker uptime)",
+        labelnames=("host",),
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -455,6 +509,8 @@ def ensure_catalog() -> None:
         mrsan_checks, mrsan_violations, mrsan_collectives,
         retry_attempts, retry_exhausted, breaker_state,
         fault_injections, webhook_dropped, checkpoint_events,
+        fleet_heartbeats, fleet_reports, fleet_workers_gauge,
+        fleet_reassignments, fleet_sealed_windows, fleet_host_spans_rate,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -576,6 +632,34 @@ def record_webhook_dropped(n: int = 1) -> None:
 
 def record_checkpoint(event: str) -> None:
     checkpoint_events().inc(event=event)
+
+
+def record_fleet_heartbeat(host: str) -> None:
+    fleet_heartbeats().inc(host=host)
+
+
+def record_fleet_report(status: str) -> None:
+    fleet_reports().inc(status=status)
+
+
+def record_fleet_workers(alive: int = 0, dead: int = 0, done: int = 0,
+                         **extra) -> None:
+    g = fleet_workers_gauge()
+    g.set(float(alive), state="alive")
+    g.set(float(dead), state="dead")
+    g.set(float(done), state="done")
+
+
+def record_fleet_reassignment(n: int = 1) -> None:
+    fleet_reassignments().inc(float(n))
+
+
+def record_fleet_sealed(outcome: str) -> None:
+    fleet_sealed_windows().inc(outcome=outcome)
+
+
+def record_fleet_host_rate(host: str, spans_per_second: float) -> None:
+    fleet_host_spans_rate().set(float(spans_per_second), host=host)
 
 
 def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:

@@ -535,12 +535,11 @@ def _validate_sharded_pcsr(batched: WindowGraph, mesh: Mesh) -> None:
             )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 @contract(
     batched="windowgraph",
     returns=("int32[B,K]", "float32[B,K]", "int32[B]"),
 )
-def rank_windows_sharded(
+def _rank_windows_sharded_impl(
     batched: WindowGraph,
     pagerank_cfg: PageRankConfig,
     spectrum_cfg: SpectrumConfig,
@@ -618,7 +617,55 @@ def rank_windows_sharded(
     )(batched)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+# The public sharded programs and their DONATED twins share one traced
+# body; donation marks the staged batch's device buffers as consumable
+# so XLA may reuse their HBM for outputs/scratch — under the dispatch
+# router's double-buffering two staged batches are alive at once, and
+# donation caps that at one batch plus the in-flight program's working
+# set (the blob path has had this since PR 5; the sharded route only
+# grew it in PR 11 — the "untested donation" thread from ROADMAP
+# item 3). CPU backends ignore donation with a warning, so the router
+# only requests it where it buys the HBM back.
+rank_windows_sharded = functools.partial(
+    jax.jit, static_argnums=(1, 2, 3, 4)
+)(_rank_windows_sharded_impl)
+
+_DONATED_SHARDED_JIT = None
+_DONATED_SHARDED_TRACED_JIT = None
+
+
+def _donated_sharded_jit():
+    global _DONATED_SHARDED_JIT
+    if _DONATED_SHARDED_JIT is None:
+        _DONATED_SHARDED_JIT = jax.jit(
+            _rank_windows_sharded_impl,
+            static_argnums=(1, 2, 3, 4),
+            donate_argnums=(0,),
+        )
+    return _DONATED_SHARDED_JIT
+
+
+def _donated_sharded_traced_jit():
+    global _DONATED_SHARDED_TRACED_JIT
+    if _DONATED_SHARDED_TRACED_JIT is None:
+        _DONATED_SHARDED_TRACED_JIT = jax.jit(
+            _rank_windows_sharded_traced_impl,
+            static_argnums=(1, 2, 3, 4),
+            donate_argnums=(0,),
+        )
+    return _DONATED_SHARDED_TRACED_JIT
+
+
+def sharded_donated_entry(conv_trace: bool):
+    """The donated sharded program for (conv_trace,) — lazily jitted
+    once per process (module singletons, like blob.batched_blob_entry)."""
+    return (
+        _donated_sharded_traced_jit()
+        if conv_trace
+        else _donated_sharded_jit()
+    )
+
+
 @contract(
     batched="windowgraph",
     returns=(
@@ -626,7 +673,7 @@ def rank_windows_sharded(
         "int32[B]",
     ),
 )
-def rank_windows_sharded_traced(
+def _rank_windows_sharded_traced_impl(
     batched: WindowGraph,
     pagerank_cfg: PageRankConfig,
     spectrum_cfg: SpectrumConfig,
@@ -666,6 +713,11 @@ def rank_windows_sharded_traced(
         out_specs=out_specs,
         check_rep=False,
     )(batched)
+
+
+rank_windows_sharded_traced = functools.partial(
+    jax.jit, static_argnums=(1, 2, 3, 4)
+)(_rank_windows_sharded_traced_impl)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
@@ -857,15 +909,24 @@ def rank_windows_sharded_checked_traced(
     return outs
 
 
-def resolve_sharded_rank_fn(conv_trace: bool, device_checks: bool):
-    """The one (conv, checks) -> sharded-program policy, shared by the
-    table lane and the dispatch router so they cannot disagree."""
+def resolve_sharded_rank_fn(
+    conv_trace: bool, device_checks: bool, donate: bool = False
+):
+    """The one (conv, checks, donate) -> sharded-program policy, shared
+    by the table lane and the dispatch router so they cannot disagree.
+    ``donate`` selects the donated twin of the unchecked programs (the
+    staged batch is consumed by the dispatch); the checked paths stay
+    undonated — their epilogue jit re-reads nothing, but keeping the
+    checked program identical to the long-tested one keeps the
+    device_checks debugging path boring."""
     if device_checks:
         return (
             rank_windows_sharded_checked_traced
             if conv_trace
             else rank_windows_sharded_checked
         )
+    if donate:
+        return sharded_donated_entry(conv_trace)
     return (
         rank_windows_sharded_traced if conv_trace else rank_windows_sharded
     )

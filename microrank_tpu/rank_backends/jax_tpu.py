@@ -315,10 +315,23 @@ def _partition_setup(
         getattr(cfg, "compensated_psum", False)
         and kernel in ("coo", "csr", "pcsr", "pallas")
     )
+    # Sparse-allreduce prototype (arxiv 1312.3020; ISSUE-11 satellite):
+    # swap the dense psum of the [V]/[T] partials for a top-cap
+    # (index, value) exchange. Opt-in and OFF by default — see the
+    # config comment and DESIGN.md "Sparse allreduce evaluation".
+    sparse = bool(
+        getattr(cfg, "sparse_allreduce", False)
+        and kernel in ("coo", "csr", "pcsr", "pallas")
+    )
+    sparse_cap = int(getattr(cfg, "sparse_allreduce_cap", 0))
 
     def reduce_shards(x):
         if psum_axis is None:
             return x
+        if sparse:
+            from ..ops.segment import sparse_psum
+
+            return sparse_psum(x, psum_axis, cap=sparse_cap)
         if compensate:
             from ..ops.segment import compensated_psum
 

@@ -99,6 +99,7 @@ class StreamSummary:
     empty: int = 0
     skipped: int = 0
     warmup: int = 0
+    spans: int = 0
     dispatches: int = 0
     late_spans: int = 0
     incidents_opened: int = 0
@@ -130,6 +131,7 @@ class StreamEngine:
         normal_df=None,
         incident_sinks: Optional[List] = None,
         resume: bool = False,
+        tracker=None,
     ):
         self.config = config
         sc = config.stream
@@ -137,61 +139,55 @@ class StreamEngine:
         self.log = get_logger("microrank_tpu.stream")
         self.out_dir = Path(out_dir) if out_dir is not None else None
         self._stop_requested = False
-        slide_us = (
-            None
-            if sc.slide_minutes is None
-            else int(sc.slide_minutes * 60e6)
-        )
-        self.windower = StreamWindower(
-            width_us=int(sc.window_minutes * 60e6),
-            slide_us=slide_us,
-            lateness_us=int(sc.allowed_lateness_seconds * 1e6),
-        )
-        self.baseline = OnlineBaseline(
-            decay=sc.baseline_decay,
-            slo_stat=config.detector.slo_stat,
-            min_windows=sc.min_healthy_windows,
-        )
+        self.windower = self._make_windower()
         if normal_df is None:
             normal_df = getattr(source, "normal", None)
-        if normal_df is not None:
-            self.baseline.seed(normal_df)
+        self._normal_df = normal_df     # kept for cold-reset re-seed
+        self.baseline = self._make_baseline()
         self.pool = BuildWorkerPool(
             sc.build_workers, name="mr-stream-build"
         )
         self.journal = None
         self.sink = None
-        sinks = list(incident_sinks or [])
         if self.out_dir is not None:
             self.sink = ResultSink(
                 self.out_dir,
                 overwrite_csv=config.compat.overwrite_results,
             )
-            sinks.append(
-                JsonlIncidentSink(self.out_dir / INCIDENT_LOG_NAME)
-            )
             if config.runtime.telemetry:
                 from ..obs import JOURNAL_NAME, RunJournal
 
                 self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
-                sinks.append(_JournalIncidentSink(self.journal))
-        if sc.webhook_url:
-            sinks.append(
-                WebhookIncidentSink(
-                    sc.webhook_url,
-                    timeout=sc.webhook_timeout_seconds,
-                    max_attempts=sc.webhook_retry_max,
-                    max_queue=sc.webhook_queue,
+        if tracker is not None:
+            # Injected lifecycle (the fleet worker's coordinator proxy):
+            # incidents are a GLOBAL concern there, so no local sinks —
+            # the coordinator owns incidents.jsonl/webhook/journal.
+            self.tracker = tracker
+        else:
+            sinks = list(incident_sinks or [])
+            if self.out_dir is not None:
+                sinks.append(
+                    JsonlIncidentSink(self.out_dir / INCIDENT_LOG_NAME)
                 )
+                if self.journal is not None:
+                    sinks.append(_JournalIncidentSink(self.journal))
+            if sc.webhook_url:
+                sinks.append(
+                    WebhookIncidentSink(
+                        sc.webhook_url,
+                        timeout=sc.webhook_timeout_seconds,
+                        max_attempts=sc.webhook_retry_max,
+                        max_queue=sc.webhook_queue,
+                    )
+                )
+            self.tracker = IncidentTracker(
+                top_k=sc.fingerprint_top_k,
+                resolve_after=sc.resolve_after_windows,
+                cooldown_windows=sc.cooldown_windows,
+                jaccard=sc.fingerprint_jaccard,
+                score_drift=sc.fingerprint_score_drift,
+                sinks=sinks,
             )
-        self.tracker = IncidentTracker(
-            top_k=sc.fingerprint_top_k,
-            resolve_after=sc.resolve_after_windows,
-            cooldown_windows=sc.cooldown_windows,
-            jaccard=sc.fingerprint_jaccard,
-            score_drift=sc.fingerprint_score_drift,
-            sinks=sinks,
-        )
         from ..dispatch import DispatchRouter
 
         self.router = DispatchRouter(config)
@@ -229,6 +225,31 @@ class StreamEngine:
         if resume:
             self._restore_checkpoint()
 
+    # ------------------------------------------------------ components
+    def _make_windower(self) -> StreamWindower:
+        sc = self.config.stream
+        slide_us = (
+            None
+            if sc.slide_minutes is None
+            else int(sc.slide_minutes * 60e6)
+        )
+        return StreamWindower(
+            width_us=int(sc.window_minutes * 60e6),
+            slide_us=slide_us,
+            lateness_us=int(sc.allowed_lateness_seconds * 1e6),
+        )
+
+    def _make_baseline(self) -> OnlineBaseline:
+        sc = self.config.stream
+        baseline = OnlineBaseline(
+            decay=sc.baseline_decay,
+            slo_stat=self.config.detector.slo_stat,
+            min_windows=sc.min_healthy_windows,
+        )
+        if self._normal_df is not None:
+            baseline.seed(self._normal_df)
+        return baseline
+
     # ------------------------------------------------------ durability
     def request_stop(self) -> None:
         """Ask the engine to drain and exit (the SIGTERM path): the run
@@ -240,9 +261,14 @@ class StreamEngine:
     def _restore_checkpoint(self) -> None:
         """``--resume``: load + verify state.ckpt and overwrite the
         fresh components with the crashed run's state. Any defect —
-        corrupt file, version/checksum mismatch, incompatible config —
-        rejects the WHOLE checkpoint (never a partial restore) and the
-        engine cold-starts, which is always safe."""
+        corrupt file, version/checksum mismatch, incompatible config,
+        a source cursor whose partition assignment no longer matches —
+        rejects the WHOLE checkpoint and the engine cold-starts, which
+        is always safe. Rejection is genuinely whole: components
+        restored before the defect was hit are rebuilt cold
+        (``_cold_reset``) — the pre-ISSUE-11 code restored in place and
+        a late source-cursor mismatch left a half-restored engine
+        (restored baselines over a cold cursor)."""
         from ..chaos import CheckpointError, load_checkpoint
         from ..obs.metrics import record_checkpoint
 
@@ -266,8 +292,9 @@ class StreamEngine:
             for k, v in payload.get("summary", {}).items():
                 if hasattr(self.summary, k) and k != "results":
                     setattr(self.summary, k, v)
-        except (CheckpointError, KeyError, ValueError) as e:
+        except (CheckpointError, KeyError, TypeError, ValueError) as e:
             record_checkpoint("rejected")
+            self._cold_reset()
             self.log.warning(
                 "--resume: checkpoint rejected (%s); cold start", e
             )
@@ -280,6 +307,21 @@ class StreamEngine:
             self._ckpt_path, self.summary.windows,
             len(self.tracker.open_incidents()), self.windower._next,
         )
+
+    def _cold_reset(self) -> None:
+        """Discard every partially-restored component: fresh windower,
+        fresh (re-seeded) baseline, lifecycle counters and source
+        cursor back to zero — the rejected checkpoint leaves NO trace,
+        so cold start actually means cold."""
+        self.windower = self._make_windower()
+        self.baseline = self._make_baseline()
+        reset = getattr(self.tracker, "reset", None)
+        if callable(reset):
+            reset()
+        reset_cursor = getattr(self.source, "reset_cursor", None)
+        if callable(reset_cursor):
+            reset_cursor()
+        self.summary = StreamSummary()
 
     def _checkpoint(self) -> None:
         """Write state.ckpt — only at a drained boundary (no pending
@@ -304,7 +346,7 @@ class StreamEngine:
                 k: getattr(self.summary, k)
                 for k in (
                     "windows", "ranked", "clean", "empty", "skipped",
-                    "warmup", "dispatches", "late_spans",
+                    "warmup", "spans", "dispatches", "late_spans",
                     "incidents_opened", "incidents_resolved",
                 )
             },
@@ -342,6 +384,7 @@ class StreamEngine:
         claim_device_owner("stream-engine")
         self._warm_start()
         sc = self.config.stream
+        run_t0 = time.monotonic()
         if self.journal is not None:
             self.journal.run_start(
                 pipeline="stream",
@@ -385,10 +428,15 @@ class StreamEngine:
                 self.journal.emit("sigterm_drain", resumable=True)
             self._flush_webhooks()
             if self.journal is not None:
+                elapsed = max(1e-9, time.monotonic() - run_t0)
                 self.journal.run_end(
                     windows=self.summary.windows,
                     ranked=self.summary.ranked,
                     dispatches=self.summary.dispatches,
+                    spans=self.summary.spans,
+                    spans_per_second=round(
+                        self.summary.spans / elapsed, 2
+                    ),
                     late_spans=self.summary.late_spans,
                     incidents_opened=self.summary.incidents_opened,
                     incidents_resolved=self.summary.incidents_resolved,
@@ -478,6 +526,7 @@ class StreamEngine:
             perf0=time.monotonic(),
         )
         self.summary.windows += 1
+        self.summary.spans += closed.n_spans
         result = WindowResult(
             start=closed.start, end=closed.end, anomaly=False
         )

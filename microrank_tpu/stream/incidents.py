@@ -354,20 +354,45 @@ class IncidentTracker:
     def restore(self, state: dict) -> None:
         """Overwrite lifecycle state from a checkpoint. No events are
         emitted — the sinks already saw these transitions in the run
-        that wrote the checkpoint."""
-        self._open = {}
-        for inc_state in state.get("open", []):
-            inc = Incident.from_state(inc_state)
-            self._open[inc.fingerprint] = inc
-        self._cooldown = {
+        that wrote the checkpoint. Parse-then-commit: every field is
+        decoded (and may raise) BEFORE any tracker state mutates, so a
+        malformed checkpoint can never leave a half-restored
+        lifecycle."""
+        if not isinstance(state, dict) or "open" not in state:
+            raise ValueError(
+                f"not an incident-tracker state (keys "
+                f"{sorted(state) if isinstance(state, dict) else state})"
+            )
+        open_incidents = [
+            Incident.from_state(s) for s in state.get("open", [])
+        ]
+        cooldown = {
             frozenset(fp): int(n)
             for fp, n in state.get("cooldown", [])
         }
-        self._window_no = int(state.get("window_no", 0))
-        self._ids = int(state.get("ids", 0))
-        self.opened = int(state.get("opened", 0))
-        self.resolved = int(state.get("resolved", 0))
-        self.suppressed = int(state.get("suppressed", 0))
+        window_no = int(state.get("window_no", 0))
+        ids = int(state.get("ids", 0))
+        opened = int(state.get("opened", 0))
+        resolved = int(state.get("resolved", 0))
+        suppressed = int(state.get("suppressed", 0))
+        self._open = {inc.fingerprint: inc for inc in open_incidents}
+        self._cooldown = cooldown
+        self._window_no = window_no
+        self._ids = ids
+        self.opened = opened
+        self.resolved = resolved
+        self.suppressed = suppressed
+
+    def reset(self) -> None:
+        """Back to a cold lifecycle (the engine's whole-checkpoint
+        rejection path); sinks and thresholds stay."""
+        self._open = {}
+        self._cooldown = {}
+        self._window_no = 0
+        self._ids = 0
+        self.opened = 0
+        self.resolved = 0
+        self.suppressed = 0
 
     # ------------------------------------------------------------ intake
     def observe_ranked(

@@ -89,6 +89,10 @@ class ReplaySource:
             raise ValueError(f"not a replay cursor: {state}")
         self._skip_rows = max(0, int(state.get("row", 0)))
 
+    def reset_cursor(self) -> None:
+        """Drop a stashed resume cursor (whole-checkpoint rejection)."""
+        self._skip_rows = 0
+
     def __iter__(self) -> Iterator[pd.DataFrame]:
         from ..chaos.faults import maybe_inject
 
@@ -168,6 +172,9 @@ class SyntheticSource:
     def restore_state(self, state: dict) -> None:
         self._replay.restore_state(state)
 
+    def reset_cursor(self) -> None:
+        self._replay.reset_cursor()
+
 
 class FileTailSource:
     """Tail a growing traces CSV; yield only the newly appended rows.
@@ -226,6 +233,10 @@ class FileTailSource:
         if state.get("type") != "tail":
             raise ValueError(f"not a tail cursor: {state}")
         self._restore = dict(state)
+
+    def reset_cursor(self) -> None:
+        """Drop a stashed resume cursor (whole-checkpoint rejection)."""
+        self._restore = None
 
     def _tracker_for_run(self):
         from ..pipeline.follow import TailTracker

@@ -474,3 +474,91 @@ def test_table_rca_sharded_device_checks_keeps_convergence(tmp_path):
     for r in ranked:
         assert r.rank_iterations is not None
         assert r.rank_residual is not None
+
+
+def test_sparse_allreduce_cross_shard_parity():
+    """The ISSUE-11 sparse-allreduce evaluation (arxiv 1312.3020),
+    pinned: with the cap at the full axis (``sparse_allreduce_cap=0``)
+    the top-cap (index, value) exchange keeps EVERY entry, so the
+    sparse combine must reproduce the dense-psum sharded ranking
+    bitwise — the only difference is the scatter-add reassociation,
+    which lands identically here. The evaluation's conclusion (see
+    DESIGN.md "Sparse allreduce evaluation"): at this workload's [V]/
+    [T] vector sizes the exchange costs MORE than the dense psum
+    (measured ~1.9x per dispatch on the (2,4) CPU mesh) and an
+    undersized cap silently drops true support — default stays OFF."""
+    import dataclasses
+
+    cfg = MicroRankConfig()
+    assert not cfg.pagerank.sparse_allreduce  # evaluated, default off
+    graphs = []
+    for seed in (9, 10):
+        case = generate_case(
+            SyntheticConfig(n_operations=20, n_traces=100, seed=seed)
+        )
+        nrm, abn = partition_case(case)
+        graph, _, _, _ = build_window_graph(case.abnormal, nrm, abn)
+        graphs.append(graph)
+    mesh = make_mesh((1, 4))
+    stacked = jax.tree.map(
+        jnp.asarray, stack_window_graphs(graphs, shard_multiple=4)
+    )
+    dense = rank_windows_sharded(
+        stacked, cfg.pagerank, cfg.spectrum, mesh, "coo"
+    )
+    sparse = rank_windows_sharded(
+        stacked,
+        dataclasses.replace(cfg.pagerank, sparse_allreduce=True),
+        cfg.spectrum,
+        mesh,
+        "coo",
+    )
+    for d, s in zip(dense, sparse):
+        assert np.array_equal(np.asarray(d), np.asarray(s))
+
+
+def test_donated_sharded_twin_matches_and_is_consumed(window_batch):
+    """The donated twins of the sharded programs (ROADMAP item 3's
+    "untested donation" thread): donation is an aliasing HINT — the
+    donated program must produce bit-identical rankings — and on
+    donation-capable backends the staged input buffers must actually be
+    consumed (CPU ignores donation with a warning; parity still
+    holds)."""
+    import warnings
+
+    from microrank_tpu.parallel.sharded_rank import (
+        resolve_sharded_rank_fn,
+        sharded_donated_entry,
+    )
+
+    graphs, _ = window_batch
+    cfg = MicroRankConfig()
+    mesh = make_mesh((2, 4))
+    stacked = stack_window_graphs(graphs, shard_multiple=4)
+    ref = rank_windows_sharded(
+        jax.device_put(stacked), cfg.pagerank, cfg.spectrum, mesh, "coo"
+    )
+    ref = jax.device_get(ref)
+    for conv_trace in (False, True):
+        donated_fn = resolve_sharded_rank_fn(
+            conv_trace, device_checks=False, donate=True
+        )
+        assert donated_fn is sharded_donated_entry(conv_trace)
+        donated_in = jax.device_put(stacked)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # CPU: donation unusable
+            out = jax.device_get(
+                donated_fn(
+                    donated_in, cfg.pagerank, cfg.spectrum, mesh, "coo"
+                )
+            )
+        for a, b in zip(ref, out[:3]):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        if jax.default_backend() not in ("cpu",):
+            leaves = jax.tree.leaves(donated_in)
+            assert any(x.is_deleted() for x in leaves)
+    # The undonated resolution is unchanged by the new parameter.
+    assert (
+        resolve_sharded_rank_fn(False, False, donate=False)
+        is rank_windows_sharded
+    )
