@@ -38,12 +38,31 @@ in SURVEY.md §5):
                    flow, and no call path reaching a collective-
                    issuing kernel only under such a branch — every
                    shard must issue the identical collective schedule
+  R10 shared-state-race  cross-thread shared state (attrs of lock-
+                   owning classes, globals of lock-owning modules)
+                   carries a non-empty COMMON lockset across every
+                   access — the Eraser lockset discipline, statically;
+                   queue/Event handoffs, __init__-only publishes and
+                   utils.guards.published(...) writes are safe seams
+  R11 lock-order-cycle  the static lock-acquisition-order graph
+                   (edge A->B when B is acquired while A held, incl.
+                   acquire-via-callee) stays acyclic — any cycle,
+                   including re-acquiring a held non-reentrant lock,
+                   is a potential deadlock
+  R12 blocking-under-lock  no HTTP/webhook POST, time.sleep, fsync/
+                   atomic write, subprocess wait, Future.result()/
+                   join(), or device dispatch/fetch seam reached while
+                   a lock is statically held — a blocked lock is a
+                   convoy (the PR-8 webhook-hang bug, generalized)
 
-R8/R9 are *static* claims about a concurrent system; their runtime
+R8-R12 are *static* claims about a concurrent system; their runtime
 twin is ``analysis.mrsan`` (armed by ``RuntimeConfig.sanitizers``):
 ownership asserted at every device seam, per-shard collective
-schedules recorded on the mesh and checked for uniformity. CI's
-mrsan-smoke job cross-validates the two models.
+schedules recorded on the mesh and checked for uniformity, production
+locks tracked per-thread (utils.guards.TrackedLock) with an
+Eraser-style lockset checker on registered shared objects and a
+lock-order watchdog asserting the observed acquisition DAG. CI's
+mrsan-smoke and race-smoke jobs cross-validate the models.
 
 Run it::
 

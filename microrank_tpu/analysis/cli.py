@@ -8,7 +8,7 @@ from typing import List
 def add_lint_parser(sub) -> None:
     p = sub.add_parser(
         "lint",
-        help="TPU-correctness static analysis (mrlint rules R1-R9)",
+        help="TPU-correctness static analysis (mrlint rules R1-R12)",
         description=(
             "AST lint of the repo's TPU invariants: host syncs inside "
             "jit graphs (R1), float64 drift on the bf16 ranking path "
@@ -18,9 +18,12 @@ def add_lint_parser(sub) -> None:
             "inside traced code (R6), traced arrays flowing into "
             "telemetry sinks (R7), jax touches reachable from non-"
             "owner threads (R8), data-dependent collective schedules "
-            "inside shard_map-traced code (R9). Suppress a finding in "
-            "place with `# mrlint: disable=RN(reason)` — the reason "
-            "is mandatory."
+            "inside shard_map-traced code (R9), cross-thread shared "
+            "state with no common lock (R10, Eraser-style locksets), "
+            "lock-acquisition-order cycles (R11), and blocking calls "
+            "under a held lock (R12). Suppress a finding in place "
+            "with `# mrlint: disable=RN(reason)` — the reason is "
+            "mandatory."
         ),
     )
     p.add_argument(

@@ -149,6 +149,7 @@ class Project:
         self.modules = modules
         self._traced = None
         self._threads = None
+        self._locks = None
 
     @property
     def traced(self):
@@ -169,6 +170,16 @@ class Project:
 
             self._threads = ThreadAnalysis(self)
         return self._threads
+
+    @property
+    def locks(self):
+        """The lock model / race analysis (analysis.locks.LockAnalysis),
+        computed once per project on top of the thread analysis."""
+        if self._locks is None:
+            from .locks import LockAnalysis
+
+            self._locks = LockAnalysis(self)
+        return self._locks
 
     def module_for(self, path: Path) -> Optional[ModuleInfo]:
         for m in self.modules:

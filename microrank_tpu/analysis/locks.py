@@ -1,0 +1,961 @@
+"""mrrace — the lock model behind rules R10/R11/R12.
+
+PRs 3-11 grew a genuinely concurrent host system around the device
+pipeline: the serve scheduler thread, the stream engine with its build
+worker pool, the fleet coordinator with heartbeat and lease-reaper
+threads. Seventeen modules hold ``threading.Lock``s, yet the thread
+model (analysis.threads, R8/R9) only checked *device* ownership — the
+host-side shared state those threads mutate was unexamined. This module
+builds a **lock model** on top of :class:`~.threads.ThreadAnalysis`:
+
+* **Lock identification** — every ``threading.Lock``/``RLock``/
+  ``Condition`` (and the mrsan runtime wrapper ``TrackedLock``)
+  construction bound to a ``self.<attr>`` or a module global becomes a
+  :class:`LockId`. Attr locks are keyed per owning class (instances
+  share the key — two instances of one class alias statically, a
+  deliberate under-approximation), module locks per module.
+
+* **Held-lockset tracking** — a linear walk over every function body
+  threads the statically-held lockset through ``with lock:`` regions
+  and paired ``lock.acquire()``/``release()`` calls, and records four
+  event streams per function: lock acquisitions (with the set held
+  before), resolved project-internal calls (with the set held at the
+  call site), known blocking calls, and shared-variable accesses.
+
+* **R10 shared-state race** (Eraser's lockset discipline, statically):
+  a variable in the race-checked set — an attribute of a class that
+  owns at least one lock, or a global of a module that owns one —
+  written outside ``__init__`` and accessed from two distinct thread
+  classes whose locksets share no common lock. Safe seams are
+  recognized: attributes holding thread-safe handoff types
+  (``queue.Queue``/``threading.Event``/``collections.deque``/...),
+  single-assignment-then-publish (all writes in ``__init__``), and
+  writes wrapped in ``utils.guards.published(...)`` — the explicit
+  intentional-handoff marker. Everything else needs a common lock or a
+  ``# mrlint: disable=R10(reason)``.
+
+* **R11 lock-order cycle**: the static lock-acquisition-order graph —
+  edge A→B whenever B is acquired (directly, or transitively through a
+  resolved callee) while A is held — must stay acyclic; any strongly-
+  connected component (including a self-edge: re-acquiring a
+  non-reentrant lock you hold) is a potential deadlock. The runtime
+  twin is the mrsan lock-order watchdog (utils.guards), which asserts
+  the *observed* acquisition DAG on every armed acquire.
+
+* **R12 blocking-call-under-lock** — the generalization of the
+  webhook-hang bug fixed by hand in PR 8: an HTTP/socket POST,
+  ``time.sleep``, ``fsync``/atomic write, subprocess wait, a pool
+  ``Future.result()``/thread ``join()``, or a device dispatch/fetch
+  seam reached (directly or through resolved callees) while a lock is
+  statically held. Every thread that ever contends on that lock then
+  waits out the I/O. ``Condition.wait`` on the *held* condition is
+  exempt (wait releases it by contract).
+
+Known under-approximations (documented, runtime-compensated): in-place
+container mutation (``d[k] = v`` on a shared dict) reads the binding
+but never rebinds it, so R10's write detection misses it — the mrsan
+lockset checker (``note_shared_access``) covers registered objects at
+runtime; calls resolved through dynamic dispatch (``for s in
+self.sinks: s.emit(...)``) do not contribute R11/R12 edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .threads import FuncInfo, _call_name
+from .traced import Event
+
+# Constructors that create a lock object. Condition defaults to an
+# RLock, so it is reentrant; TrackedLock (utils.guards — the mrsan
+# runtime wrapper) is reentrant only with reentrant=True.
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "TrackedLock"}
+_REENTRANT_CTORS = {"RLock", "Condition"}
+_LOCK_DOTTED_PREFIXES = ("threading.",)
+
+# Attribute types that ARE the sanctioned cross-thread handoff: their
+# methods are internally synchronized (or GIL-atomic for deque), so
+# accesses through them need no common lock.
+_SAFE_HANDOFF_CTORS = {
+    "Queue",
+    "SimpleQueue",
+    "LifoQueue",
+    "PriorityQueue",
+    "Event",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "deque",
+    "Future",
+    "local",           # threading.local: per-thread by construction
+    "ContextVar",
+}
+_PUBLISH_MARKER = "published"
+
+# Thread-class labels that describe a POOL of threads — code running
+# only under such a label still races with itself (N workers execute
+# the same function concurrently).
+_MULTI_INSTANCE_LABELS = {"pool-worker", "authorized-worker"}
+
+# Device dispatch/fetch seams (mirrors threads._DEVICE_SEAMS plus the
+# explicit fetch entry points): issuing one while holding a lock parks
+# every contending thread behind device latency.
+_DEVICE_BLOCKING_NAMES = {
+    "stage_rank_window",
+    "stage_windows_batched",
+    "dispatch_windows_staged",
+    "stage_sharded",
+    "warm_occupancies",
+    "rank_batch",
+    "device_get",
+    "block_until_ready",
+}
+
+
+@dataclass(frozen=True, order=True)
+class LockId:
+    """One statically-identified lock object."""
+
+    kind: str      # "attr" | "global"
+    owner: str     # owning class name, or module rel path
+    name: str      # attribute / global name
+    reentrant: bool = field(compare=False, default=False)
+
+    @property
+    def label(self) -> str:
+        sep = "." if self.kind == "attr" else ":"
+        return f"{self.owner}{sep}{self.name}"
+
+
+@dataclass
+class _Access:
+    var: Tuple[str, str, str]       # ("attr", cls, name) | ("global", rel, name)
+    write: bool
+    module: object
+    node: ast.AST
+    held: FrozenSet[LockId]
+    func: FuncInfo
+
+
+@dataclass
+class _FuncSummary:
+    acquires: List[Tuple[LockId, FrozenSet[LockId], ast.AST]] = field(
+        default_factory=list
+    )
+    calls: List[Tuple[FuncInfo, FrozenSet[LockId], ast.AST]] = field(
+        default_factory=list
+    )
+    blocking: List[Tuple[str, ast.AST, FrozenSet[LockId]]] = field(
+        default_factory=list
+    )
+    accesses: List[_Access] = field(default_factory=list)
+
+
+def _is_lock_ctor(module, call: ast.Call) -> Optional[str]:
+    """The lock-constructor name when ``call`` builds a lock, else None."""
+    name = _call_name(call.func)
+    if name not in _LOCK_CTORS:
+        return None
+    dotted = module.dotted(call.func)
+    if dotted is not None and not dotted.startswith(
+        _LOCK_DOTTED_PREFIXES
+    ) and "." in dotted:
+        # Imported from somewhere that is not threading (or the guards
+        # TrackedLock, which resolves as a bare/from-import name).
+        if not dotted.endswith(("TrackedLock", f"guards.{name}")):
+            return None
+    return name
+
+
+def _ctor_reentrant(module, call: ast.Call, ctor: str) -> bool:
+    if ctor in _REENTRANT_CTORS:
+        return True
+    for kw in call.keywords:
+        if (
+            kw.arg == "reentrant"
+            and isinstance(kw.value, ast.Constant)
+            and bool(kw.value.value)
+        ):
+            return True
+    return False
+
+
+class LockAnalysis:
+    """Project-wide lock model: locks, per-function held-lockset
+    summaries, and the R10/R11/R12 event streams."""
+
+    def __init__(self, project):
+        self.project = project
+        self.threads = project.threads
+        # (class name, attr) -> LockId  /  (id(module), name) -> LockId
+        self.attr_locks: Dict[Tuple[str, str], LockId] = {}
+        self.module_locks: Dict[Tuple[int, str], LockId] = {}
+        self.lock_owning_classes: Set[str] = set()
+        self._lock_owning_modules: Set[int] = set()
+        self._module_globals: Dict[int, Set[str]] = {}
+        self._safe_attrs: Set[Tuple[str, str]] = set()
+        self._published_attrs: Set[Tuple[str, str]] = set()
+        self._published_globals: Set[Tuple[int, str]] = set()
+        self.summaries: Dict[int, _FuncSummary] = {}
+        # Bodies of nested defs (callbacks, thunks): they execute LATER
+        # on whichever thread invokes them, so their acquires/blocking
+        # never join the enclosing function's transitive summary, and
+        # no caller-held lockset propagates in.
+        self.deferred: List[Tuple[FuncInfo, _FuncSummary]] = []
+        # Interprocedural entry locksets: the locks held at EVERY
+        # resolved call site of a function (the `_locked`-suffix helper
+        # pattern: the caller takes the lock, the helper touches the
+        # state). Intersection over call sites; __init__ call sites are
+        # pre-publication and excluded.
+        self.entry_held: Dict[int, FrozenSet[LockId]] = {}
+        self._labels: Dict[int, Set[str]] = {}
+        self.events: List[Event] = []
+        self._index_locks()
+        self._index_shared()
+        self._compute_labels()
+        self._summarize()
+        self._propagate_entry_locksets()
+        self._collect_race_events()
+        self._collect_order_events()
+        self._collect_blocking_events()
+
+    # ------------------------------------------------------------ indexing
+
+    def _index_locks(self) -> None:
+        for mod in self.project.modules:
+            for node in mod.tree.body:
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                ctor = _is_lock_ctor(mod, node.value)
+                if ctor is None:
+                    continue
+                name = node.targets[0].id
+                self.module_locks[(id(mod), name)] = LockId(
+                    kind="global",
+                    owner=mod.rel,
+                    name=name,
+                    reentrant=_ctor_reentrant(mod, node.value, ctor),
+                )
+                self._lock_owning_modules.add(id(mod))
+        for fi in self.threads.funcs:
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                ctor = _is_lock_ctor(fi.module, node.value)
+                for tgt in node.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    if ctor is not None:
+                        self.attr_locks[(fi.cls, tgt.attr)] = LockId(
+                            kind="attr",
+                            owner=fi.cls,
+                            name=tgt.attr,
+                            reentrant=_ctor_reentrant(
+                                fi.module, node.value, ctor
+                            ),
+                        )
+                        self.lock_owning_classes.add(fi.cls)
+
+    def _index_shared(self) -> None:
+        """Race-checked variables, safe-handoff attrs, published marks."""
+        for mod in self.project.modules:
+            if id(mod) not in self._lock_owning_modules:
+                continue
+            names: Set[str] = set()
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    names.add(node.target.id)
+            names -= {
+                n for (mid, n) in self.module_locks if mid == id(mod)
+            }
+            self._module_globals[id(mod)] = names
+        for fi in self.threads.funcs:
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    continue
+                ctor = _call_name(node.value.func)
+                for tgt in node.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    if ctor in _SAFE_HANDOFF_CTORS:
+                        self._safe_attrs.add((fi.cls, tgt.attr))
+                    elif ctor == _PUBLISH_MARKER:
+                        self._published_attrs.add((fi.cls, tgt.attr))
+        for mod in self.project.modules:
+            for node in ast.walk(mod.tree):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _call_name(node.value.func) == _PUBLISH_MARKER
+                ):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._published_globals.add((id(mod), t.id))
+
+    def _compute_labels(self) -> None:
+        for root in self.threads.roots:
+            for fi in self.threads.reachable(root.func):
+                self._labels.setdefault(id(fi), set()).add(root.label)
+
+    def labels_of(self, fi: FuncInfo) -> Set[str]:
+        """Thread classes that can execute ``fi``: the labels of every
+        thread root that reaches it, or {"main"} for code no root
+        reaches (the caller's own thread)."""
+        return self._labels.get(id(fi), {"main"})
+
+    # --------------------------------------------------------- resolution
+
+    def lock_for(self, fi: FuncInfo, expr) -> Optional[LockId]:
+        """The LockId an expression denotes, when statically known."""
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get((id(fi.module), expr.id))
+        if isinstance(expr, ast.Attribute):
+            if (
+                isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and fi.cls is not None
+            ):
+                return self.attr_locks.get((fi.cls, expr.attr))
+            recv = self.threads._receiver_class(fi, expr.value)
+            if recv is not None:
+                return self.attr_locks.get((recv, expr.attr))
+        return None
+
+    def resolve_call(self, fi: FuncInfo, func_node) -> Optional[FuncInfo]:
+        """Resolve a call target for the lock model. Unlike the thread
+        analysis this does NOT use the unique-method-name fallback: an
+        ``f.write(...)`` on an unknown receiver must not resolve to the
+        one project class that happens to define ``write`` — a spurious
+        edge here invents lock-order cycles and blocking paths."""
+        t = self.threads
+        if isinstance(func_node, ast.Attribute):
+            if (
+                isinstance(func_node.value, ast.Name)
+                and func_node.value.id == "self"
+                and fi.cls is not None
+            ):
+                table = t._class_methods.get((id(fi.module), fi.cls), {})
+                if func_node.attr in table:
+                    return table[func_node.attr]
+            recv = t._receiver_class(fi, func_node.value)
+            if recv is not None:
+                for key, table in t._class_methods.items():
+                    if key[1] == recv and func_node.attr in table:
+                        return table[func_node.attr]
+            return None
+        return t.resolve_callable(fi, func_node)
+
+    # --------------------------------------------------------- summaries
+
+    def _summarize(self) -> None:
+        for fi in self.threads.funcs:
+            walker = _LockWalker(self, fi)
+            walker.run()
+            self.summaries[id(fi)] = walker.summary
+            for nested in walker.nested:
+                self.deferred.append((fi, nested))
+
+    def _propagate_entry_locksets(self) -> None:
+        """Fixpoint over the resolved call graph: a function's entry
+        lockset is the intersection, over every resolved call site, of
+        the locks statically held there (plus the caller's own entry
+        set). Functions with no resolved caller enter with nothing —
+        dynamic dispatch is invisible, so the set is a best-effort
+        floor, not a proof."""
+        incoming_sites: Dict[int, List[Tuple[int, FrozenSet[LockId]]]] = {}
+        for fid, s in self.summaries.items():
+            fi = self.threads._by_id.get(fid)
+            caller_init = fi is not None and fi.name == "__init__"
+            for callee, held, _ in s.calls:
+                if caller_init or id(callee) not in self.summaries:
+                    continue
+                incoming_sites.setdefault(id(callee), []).append(
+                    (fid, held)
+                )
+        for fi, s in self.deferred:
+            for callee, held, _ in s.calls:
+                if id(callee) in self.summaries:
+                    # A callback's call executes with unknown ambient
+                    # locks: contribute only what it holds itself.
+                    incoming_sites.setdefault(id(callee), []).append(
+                        (0, held)
+                    )
+        entry = {fid: frozenset() for fid in self.summaries}
+        changed = True
+        while changed:
+            changed = False
+            for fid in self.summaries:
+                sites = incoming_sites.get(fid)
+                if not sites:
+                    continue
+                new = frozenset.intersection(
+                    *[
+                        held | entry.get(caller, frozenset())
+                        for caller, held in sites
+                    ]
+                )
+                if new != entry[fid]:
+                    entry[fid] = new
+                    changed = True
+        self.entry_held = entry
+
+    def _iter_summaries(
+        self,
+    ) -> Iterable[Tuple[FuncInfo, _FuncSummary, FrozenSet[LockId]]]:
+        """(function, summary, entry-lockset augmentation) for every
+        analyzed body — deferred (nested-def) bodies augment with
+        nothing."""
+        for fid, s in self.summaries.items():
+            fi = self.threads._by_id.get(fid)
+            if fi is not None:
+                yield fi, s, self.entry_held.get(fid, frozenset())
+        for fi, s in self.deferred:
+            yield fi, s, frozenset()
+
+    # -------------------------------------------------------- R10 events
+
+    def _race_checked_var(
+        self, fi: FuncInfo, node
+    ) -> Optional[Tuple[Tuple[str, str, str], bool]]:
+        """(var key, is_write) when ``node`` accesses a race-checked
+        variable from ``fi``, else None."""
+        if isinstance(node, ast.Attribute):
+            if not (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and fi.cls is not None
+                and fi.cls in self.lock_owning_classes
+            ):
+                return None
+            key = (fi.cls, node.attr)
+            if (
+                key in self.attr_locks
+                or key in self._safe_attrs
+                or key in self._published_attrs
+            ):
+                return None
+            return (
+                ("attr", fi.cls, node.attr),
+                isinstance(node.ctx, (ast.Store, ast.Del)),
+            )
+        if isinstance(node, ast.Name):
+            mod = fi.module
+            if node.id not in self._module_globals.get(id(mod), ()):
+                return None
+            if (id(mod), node.id) in self._published_globals:
+                return None
+            return (
+                ("global", mod.rel, node.id),
+                isinstance(node.ctx, (ast.Store, ast.Del)),
+            )
+        return None
+
+    def _collect_race_events(self) -> None:
+        by_var: Dict[Tuple[str, str, str], List[_Access]] = {}
+        for fi, s, aug in self._iter_summaries():
+            if fi.name == "__init__":
+                continue  # publish-before-start: constructor accesses
+                # happen before any thread can see the object.
+            for acc in s.accesses:
+                if aug:
+                    acc = _Access(
+                        var=acc.var,
+                        write=acc.write,
+                        module=acc.module,
+                        node=acc.node,
+                        held=acc.held | aug,
+                        func=acc.func,
+                    )
+                by_var.setdefault(acc.var, []).append(acc)
+        for var in sorted(by_var):
+            accesses = by_var[var]
+            writes = [a for a in accesses if a.write]
+            if not writes:
+                continue
+            labels: Set[str] = set()
+            for a in accesses:
+                labels |= self.labels_of(a.func)
+            if len(labels) < 2 and not (labels & _MULTI_INSTANCE_LABELS):
+                continue
+            common = frozenset.intersection(
+                *[a.held for a in accesses]
+            )
+            if common:
+                continue
+            accesses.sort(
+                key=lambda a: (a.module.rel, a.node.lineno, a.node.col_offset)
+            )
+            site = next(
+                (a for a in accesses if not a.held),
+                next((a for a in accesses if a.write), accesses[0]),
+            )
+            other = next(
+                (
+                    a
+                    for a in accesses
+                    if self.labels_of(a.func) != self.labels_of(site.func)
+                ),
+                next((a for a in accesses if a is not site), site),
+            )
+            kind = "attribute" if var[0] == "attr" else "module global"
+            vlabel = (
+                f"{var[1]}.{var[2]}" if var[0] == "attr" else var[2]
+            )
+            held_desc = (
+                "no lock"
+                if not site.held
+                else "{" + ", ".join(
+                    sorted(l.label for l in site.held)
+                ) + "}"
+            )
+            self.events.append(
+                Event(
+                    kind="shared-state-race",
+                    module=site.module,
+                    line=site.node.lineno,
+                    col=site.node.col_offset,
+                    message=(
+                        f"{kind} `{vlabel}` is accessed by thread "
+                        f"classes {sorted(labels)} with no common lock "
+                        f"(this access in `{site.func.qualname}` holds "
+                        f"{held_desc}; see also "
+                        f"`{other.func.qualname}` at "
+                        f"{other.module.rel}:{other.node.lineno}) — "
+                        "guard every access with one shared lock, hand "
+                        "the value off through a queue/Event seam, or "
+                        "mark an intentional lock-free publish with "
+                        "utils.guards.published(...)"
+                    ),
+                )
+            )
+
+    # -------------------------------------------------------- R11 events
+
+    def _transitive_acquires(self) -> Dict[int, Set[LockId]]:
+        acq: Dict[int, Set[LockId]] = {}
+        callees: Dict[int, Set[int]] = {}
+        for fid, s in self.summaries.items():
+            acq[fid] = {lock for lock, _, _ in s.acquires}
+            callees[fid] = {
+                id(callee) for callee, _, _ in s.calls
+                if id(callee) in self.summaries
+            }
+        changed = True
+        while changed:
+            changed = False
+            for fid, outs in callees.items():
+                cur = acq[fid]
+                before = len(cur)
+                for o in outs:
+                    cur |= acq.get(o, set())
+                if len(cur) != before:
+                    changed = True
+        return acq
+
+    def _collect_order_events(self) -> None:
+        trans = self._transitive_acquires()
+        # (a, b) -> (module, node, via description)
+        edges: Dict[Tuple[LockId, LockId], Tuple[object, ast.AST, str]] = {}
+
+        def add_edge(a: LockId, b: LockId, module, node, via: str) -> None:
+            if a == b and a.reentrant:
+                return
+            edges.setdefault((a, b), (module, node, via))
+
+        for fi, s, aug in self._iter_summaries():
+            for lock, held, node in s.acquires:
+                for h in held | aug:
+                    add_edge(h, lock, fi.module, node, "")
+            for callee, held, node in s.calls:
+                eff = held | aug
+                if not eff:
+                    continue
+                for b in trans.get(id(callee), ()):
+                    for h in eff:
+                        add_edge(
+                            h, b, fi.module, node,
+                            f" via `{callee.qualname}()`",
+                        )
+        if not edges:
+            return
+        graph: Dict[LockId, Set[LockId]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        for scc in _sccs(graph):
+            cyclic = len(scc) > 1 or any(
+                (a, a) in edges for a in scc
+            )
+            if not cyclic:
+                continue
+            members = sorted(scc)
+            cycle_edges = sorted(
+                (
+                    ((a, b), edges[(a, b)])
+                    for (a, b) in edges
+                    if a in scc and b in scc
+                ),
+                key=lambda e: (e[1][0].rel, e[1][1].lineno),
+            )
+            (a0, b0), (mod0, node0, via0) = cycle_edges[0]
+            chain = " -> ".join(l.label for l in members + [members[0]])
+            sites = "; ".join(
+                f"{a.label}->{b.label}{via} at {m.rel}:{n.lineno}"
+                for (a, b), (m, n, via) in cycle_edges
+            )
+            self.events.append(
+                Event(
+                    kind="lock-order-cycle",
+                    module=mod0,
+                    line=node0.lineno,
+                    col=node0.col_offset,
+                    message=(
+                        f"lock-acquisition-order cycle {chain} — two "
+                        "threads taking these locks in opposite orders "
+                        f"deadlock (edges: {sites}); impose one global "
+                        "acquisition order (the DESIGN.md lock catalog "
+                        "ranks them) or collapse to a single lock"
+                    ),
+                )
+            )
+
+    # -------------------------------------------------------- R12 events
+
+    def _surface(
+        self, fid: int, memo: Dict[int, List[str]], visiting: Set[int]
+    ) -> List[str]:
+        """Blocking descriptions reachable from a function along paths
+        that hold NO additional lock (those already reported in place)."""
+        if fid in memo:
+            return memo[fid]
+        if fid in visiting:
+            return []
+        visiting.add(fid)
+        s = self.summaries.get(fid)
+        aug = self.entry_held.get(fid, frozenset())
+        out: List[str] = []
+        if s is not None:
+            for desc, _, held in s.blocking:
+                if not (held | aug):
+                    out.append(desc)
+            for callee, held, _ in s.calls:
+                if (held | aug) or id(callee) not in self.summaries:
+                    continue
+                for desc in self._surface(id(callee), memo, visiting):
+                    out.append(f"{desc} (via `{callee.qualname}()`)")
+        visiting.discard(fid)
+        memo[fid] = out[:4]
+        return memo[fid]
+
+    def _collect_blocking_events(self) -> None:
+        memo: Dict[int, List[str]] = {}
+        seen = set()
+
+        def emit(module, node, held, desc):
+            key = (id(module), node.lineno, node.col_offset)
+            if key in seen:
+                return
+            seen.add(key)
+            locks = ", ".join(sorted(l.label for l in held))
+            self.events.append(
+                Event(
+                    kind="blocking-under-lock",
+                    module=module,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"{desc} while holding {{{locks}}} — every "
+                        "thread contending on the lock waits out the "
+                        "blocking call (the webhook-hang bug class); "
+                        "snapshot state under the lock, release it, "
+                        "then block"
+                    ),
+                )
+            )
+
+        for fi, s, aug in self._iter_summaries():
+            for desc, node, held in s.blocking:
+                if held | aug:
+                    emit(fi.module, node, held | aug, desc)
+            for callee, held, node in s.calls:
+                eff = held | aug
+                if not eff:
+                    continue
+                surface = self._surface(id(callee), memo, set())
+                if surface:
+                    emit(
+                        fi.module, node, eff,
+                        f"`{callee.qualname}()` reaches {surface[0]}",
+                    )
+
+
+def _sccs(graph: Dict[LockId, Set[LockId]]) -> List[Set[LockId]]:
+    """Tarjan strongly-connected components (iterative)."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on_stack: Set[LockId] = set()
+    stack: List[LockId] = []
+    out: List[Set[LockId]] = []
+    counter = [0]
+
+    def strongconnect(root: LockId) -> None:
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc: Set[LockId] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                out.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+class _LockWalker:
+    """Held-lockset walk over one function body."""
+
+    def __init__(
+        self, analysis: LockAnalysis, fi: FuncInfo, root=None
+    ):
+        self.la = analysis
+        self.fi = fi
+        self.module = fi.module
+        self.summary = _FuncSummary()
+        self.nested: List[_FuncSummary] = []
+        self._root = root if root is not None else fi.node
+        self._global_decls: Set[str] = set()
+        self._shadowed: Set[str] = set()
+        for node in ast.walk(self._root):
+            if isinstance(node, ast.Global):
+                self._global_decls.update(node.names)
+        for node in ast.walk(self._root):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                if node.id not in self._global_decls:
+                    self._shadowed.add(node.id)
+
+    def run(self) -> None:
+        self._walk(self._root.body, frozenset())
+
+    # ------------------------------------------------------------- walk
+
+    def _walk(
+        self, stmts: Iterable[ast.stmt], held: FrozenSet[LockId]
+    ) -> FrozenSet[LockId]:
+        for stmt in stmts:
+            held = self._stmt(stmt, held)
+        return held
+
+    def _stmt(self, stmt, held: FrozenSet[LockId]) -> FrozenSet[LockId]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs run later (callbacks, thunks): no lexically
+            # enclosing lock is held when they execute, and their
+            # acquires/blocking must not join THIS function's
+            # transitive summary — they get a deferred summary of
+            # their own (attributed to the enclosing function for
+            # thread-classification purposes).
+            inner = _LockWalker(self.la, self.fi, root=stmt)
+            inner.run()
+            self.nested.append(inner.summary)
+            self.nested.extend(inner.nested)
+            return held
+        if isinstance(stmt, ast.ClassDef):
+            return held
+        if isinstance(stmt, ast.With):
+            inner = held
+            for item in stmt.items:
+                self._scan(item.context_expr, held)
+                lock = self.la.lock_for(self.fi, item.context_expr)
+                if lock is not None:
+                    self.summary.acquires.append(
+                        (lock, inner, item.context_expr)
+                    )
+                    inner = inner | {lock}
+            self._walk(stmt.body, inner)
+            return held
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan(stmt.test, held)
+            self._walk(stmt.body, held)
+            self._walk(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.For):
+            self._scan(stmt.iter, held)
+            self._scan(stmt.target, held)
+            self._walk(stmt.body, held)
+            self._walk(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.Try):
+            self._walk(stmt.body, held)
+            for h in stmt.handlers:
+                self._walk(h.body, held)
+            self._walk(stmt.orelse, held)
+            self._walk(stmt.finalbody, held)
+            return held
+        # Plain statement: scan expressions, then apply any
+        # acquire()/release() effect to the set held AFTERWARDS.
+        self._scan(stmt, held)
+        for node in self._nodes(stmt):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            if node.func.attr == "acquire":
+                lock = self.la.lock_for(self.fi, node.func.value)
+                if lock is not None:
+                    self.summary.acquires.append((lock, held, node))
+                    held = held | {lock}
+            elif node.func.attr == "release":
+                lock = self.la.lock_for(self.fi, node.func.value)
+                if lock is not None:
+                    held = held - {lock}
+        return held
+
+    @staticmethod
+    def _nodes(root):
+        """Walk a statement/expression, not descending into nested
+        function/class definitions (handled at statement level)."""
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            yield n
+            for c in ast.iter_child_nodes(n):
+                if isinstance(
+                    c,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                stack.append(c)
+
+    # ------------------------------------------------------------- scan
+
+    def _scan(self, root, held: FrozenSet[LockId]) -> None:
+        for node in self._nodes(root):
+            if isinstance(node, ast.Call):
+                target = self.la.resolve_call(self.fi, node.func)
+                if target is not None and target is not self.fi:
+                    self.summary.calls.append((target, held, node))
+                desc = self._blocking_desc(node, held)
+                if desc is not None:
+                    self.summary.blocking.append((desc, node, held))
+            found = self.la._race_checked_var(self.fi, node)
+            if found is not None:
+                var, write = found
+                if (
+                    var[0] == "global"
+                    and var[2] in self._shadowed
+                ):
+                    continue
+                if isinstance(node, ast.Name):
+                    if write and node.id not in self._global_decls:
+                        continue  # plain local assignment
+                self.summary.accesses.append(
+                    _Access(
+                        var=var,
+                        write=write,
+                        module=self.module,
+                        node=node,
+                        held=held,
+                        func=self.fi,
+                    )
+                )
+
+    # -------------------------------------------------- blocking matcher
+
+    def _blocking_desc(
+        self, call: ast.Call, held: FrozenSet[LockId]
+    ) -> Optional[str]:
+        name = _call_name(call.func)
+        if name is None:
+            return None
+        dotted = self.module.dotted(call.func)
+        if name == "sleep":
+            return "`time.sleep`-style blocking sleep"
+        if name in ("urlopen", "getresponse", "create_connection"):
+            return f"HTTP/socket I/O (`{name}`)"
+        if name == "fsync" or name.startswith("atomic_write"):
+            return f"fsync/atomic write (`{name}`)"
+        if (dotted or "").startswith("subprocess.") or name in (
+            "communicate",
+            "check_call",
+            "check_output",
+        ):
+            return f"subprocess wait (`{name}`)"
+        if name == "result" and isinstance(call.func, ast.Attribute):
+            return "`Future.result()` wait"
+        if (
+            name == "join"
+            and isinstance(call.func, ast.Attribute)
+            and not call.args
+        ):
+            return "`join()` wait"
+        if name == "wait" and isinstance(call.func, ast.Attribute):
+            recv = self.la.lock_for(self.fi, call.func.value)
+            if recv is not None and recv in held:
+                return None  # Condition.wait releases the held lock
+            return "`wait()` on an event/future"
+        if name in _DEVICE_BLOCKING_NAMES:
+            return f"device dispatch/fetch seam (`{name}()`)"
+        return None

@@ -23,10 +23,22 @@ cross-check, armed by ``RuntimeConfig.sanitizers``:
   sanitizer. Ordering within a shard is validated statically by R9;
   participation is what only the runtime can see.
 
-The CI contract (mrsan-smoke): the repo lints clean ⇔ a sanitized
-stream run observes zero violations; the injected-bug fixtures (a jax
-call from a webhook-sink thread; a shard-divergent psum) flip BOTH
-detectors.
+* **Locksets & lock order** (mrrace, R10/R11's runtime twin) —
+  production locks wrap in ``utils.guards.TrackedLock``; armed, every
+  acquire records into a per-thread held-lockset, an Eraser-style
+  checker validates registered shared objects on access
+  (``register_shared``/``note_shared_access``, candidates seeded from
+  the static lock catalog, violations =
+  ``microrank_mrsan_violations_total{kind="shared-state-race"}``), and
+  a process-wide watchdog asserts the observed acquisition order stays
+  a DAG (``kind="lock-order"``, raised as ``LockOrderError``). Checks
+  count into ``microrank_mrsan_lockset_checks_total{object}``.
+
+The CI contract (mrsan-smoke + race-smoke): the repo lints clean ⇔ a
+sanitized stream run observes zero violations; the injected-bug
+fixtures (a jax call from a webhook-sink thread; a shard-divergent
+psum; an unlocked cross-thread counter; an A/B-B/A lock inversion)
+flip BOTH detectors.
 
 Debug-mode cost: the interposition is baked into traces made while
 armed (programs retrace on arm/disarm), and each collective pays one
@@ -43,11 +55,19 @@ from typing import Dict, List, Optional
 
 from ..utils.guards import (  # noqa: F401  (re-exported: the seam API)
     DeviceOwnershipError,
+    LockOrderError,
+    LocksetError,
+    TrackedLock,
     assert_device_owner,
     authorize_device_thread,
     claim_device_owner,
+    held_locks,
+    note_shared_access,
+    published,
+    register_shared,
     release_device_owner,
     reset_device_ownership,
+    reset_lock_tracking,
     sanitizers_enabled,
     set_sanitizers,
 )
@@ -215,6 +235,7 @@ def configure_sanitizers(config) -> None:
     enabled = bool(getattr(runtime, "sanitizers", False))
     set_sanitizers(enabled)
     reset_device_ownership()
+    reset_lock_tracking()
     reset_schedule()
     if enabled:
         arm_collectives()

@@ -583,6 +583,91 @@ class CollectiveOrderRule(Rule):
                 yield _v(module, ev, self.name, ev.message)
 
 
+@register
+class SharedStateRaceRule(Rule):
+    """R10: cross-thread shared state carries a common lock.
+
+    The Eraser lockset discipline, statically: an attribute of a
+    lock-owning class (or a global of a lock-owning module) written
+    outside ``__init__`` and accessed from two distinct thread classes
+    must have at least one lock held at EVERY access — the intersection
+    of the statically-held locksets must be non-empty. The thread
+    classes come from the same interprocedural classifier R8 uses
+    (analysis.threads); the locksets from the lock model
+    (analysis.locks). Safe seams are recognized, not flagged:
+    ``queue.Queue``/``threading.Event``/``deque`` handoff attributes,
+    single-assignment-then-publish (all writes in ``__init__``), and
+    writes wrapped in ``utils.guards.published(...)`` — the explicit
+    intentional-handoff marker that doubles as documentation. The
+    runtime twin is mrsan's lockset checker
+    (``utils.guards.note_shared_access``) on registered objects.
+    """
+
+    name = "R10"
+    slug = "shared-state-race"
+    summary = (
+        "cross-thread shared state accessed with no common lock"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.locks.events:
+            if ev.kind == "shared-state-race" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class LockOrderRule(Rule):
+    """R11: the lock-acquisition-order graph stays acyclic.
+
+    Edge A→B whenever B is acquired while A is held — directly
+    (``with a: with b:``) or through a resolved callee (``with a:
+    self.grab_b()``). Any cycle (including re-acquiring a held
+    non-reentrant lock) is a potential deadlock: two threads taking
+    the locks in opposite orders block each other forever. The
+    DESIGN.md lock catalog assigns every production lock an ordering
+    rank; the runtime twin is mrsan's lock-order watchdog
+    (utils.guards.TrackedLock), which asserts the OBSERVED acquisition
+    DAG on every armed acquire.
+    """
+
+    name = "R11"
+    slug = "lock-order-cycle"
+    summary = "cycle in the static lock-acquisition-order graph"
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.locks.events:
+            if ev.kind == "lock-order-cycle" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """R12: no blocking call while a lock is statically held.
+
+    The generalization of the webhook-hang bug PR 8 fixed once by
+    hand: an HTTP/webhook POST, ``time.sleep``, ``fsync``/atomic
+    write, subprocess wait, pool ``Future.result()``/``join()``, or a
+    device dispatch/fetch seam reached while a lock is held turns
+    that lock into a convoy — every thread that contends waits out
+    the I/O (heartbeats stall, lease reapers mark live hosts dead,
+    the engine thread misses its window deadline). Acquire-via-callee
+    counts: a function whose resolved call graph reaches a blocking
+    call fires at the call site made under the lock.
+    ``Condition.wait`` on the HELD condition is exempt — wait
+    releases it by contract. Snapshot state under the lock, release
+    it, then block.
+    """
+
+    name = "R12"
+    slug = "blocking-under-lock"
+    summary = "blocking call reached while a lock is held"
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.locks.events:
+            if ev.kind == "blocking-under-lock" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
 def iter_rules() -> Iterable[Rule]:
     from .core import RULES
 

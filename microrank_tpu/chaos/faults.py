@@ -71,6 +71,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Dict, List, Optional
 
+from ..utils.guards import published
 from ..utils.logging import get_logger
 
 log = get_logger("microrank_tpu.chaos")
@@ -136,11 +137,16 @@ class FaultPlan:
     """Seeded, deterministic fault schedule over named seams."""
 
     def __init__(self, specs: List[FaultSpec] = None, seed: int = 0):
+        from ..utils.guards import TrackedLock, register_shared
+
         self.specs = list(specs or [])
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self._events: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        # Every seam on every thread funnels through fire(): the event
+        # counters are a registered mrsan shared object.
+        self._lock = TrackedLock("fault_plan")
+        register_shared("fault_plan", {"fault_plan"})
         self.injected: List[dict] = []  # what actually fired (tests)
 
     @classmethod
@@ -165,7 +171,10 @@ class FaultPlan:
         """Record one event at ``seam``; return the firing spec's action
         dict, or None. At most one spec fires per event (first match in
         plan order)."""
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("fault_plan")
             n = self._events.get(seam, 0)
             self._events[seam] = n + 1
             for spec in self.specs:
@@ -195,9 +204,11 @@ def set_chaos_host(host_id: Optional[str]) -> None:
     """Declare which fleet host THIS process is, so host-scoped fault
     specs (``"host": "host1"``) can target one process of a fleet that
     shares a single plan file. None (the default) matches no scoped
-    spec; unscoped specs fire everywhere regardless."""
+    spec; unscoped specs fire everywhere regardless. Set once at
+    process start, before any engine thread exists — the lock-free
+    publish is intentional (mrlint R10's ``published`` seam)."""
     global _chaos_host
-    _chaos_host = host_id
+    _chaos_host = published(host_id)
 
 
 def configure_chaos(config) -> Optional[FaultPlan]:
@@ -205,7 +216,11 @@ def configure_chaos(config) -> Optional[FaultPlan]:
     counters each call — one plan per run). Called by the stream engine
     and the serve service at start; a config without chaos clears it."""
     global _plan
-    _plan = FaultPlan.from_config(getattr(config, "chaos", None))
+    # Installed at run entry before worker/scheduler threads spin up;
+    # seam threads read the binding lock-free by design (the plan
+    # object itself synchronizes its counters) — mrlint R10's
+    # ``published`` seam.
+    _plan = published(FaultPlan.from_config(getattr(config, "chaos", None)))
     if _plan is not None and _plan.specs:
         log.warning(
             "chaos armed: %d fault spec(s), seed %d — this run WILL "

@@ -104,6 +104,8 @@ class CircuitBreaker:
         policy: RetryPolicy,
         clock: Callable[[], float] = time.monotonic,
     ):
+        from ..utils.guards import TrackedLock, register_shared
+
         self.seam = seam
         self.policy = policy
         self.clock = clock
@@ -111,7 +113,10 @@ class CircuitBreaker:
         self.failures = 0              # consecutive
         self.opened_at = 0.0
         self._probes = 0
-        self._lock = threading.Lock()
+        # Retries from any thread feed one breaker per seam: the state
+        # machine is a registered mrsan shared object.
+        self._lock = TrackedLock("retry_breaker")
+        register_shared("retry_breaker", {"retry_breaker"})
         self._gauge()
 
     def _gauge(self) -> None:
@@ -122,7 +127,10 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May a call proceed right now? Transitions open -> half-open
         when the reset window elapsed (the caller becomes the probe)."""
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("retry_breaker")
             if self.state == "closed":
                 return True
             if self.state == "open":

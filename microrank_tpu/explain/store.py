@@ -14,16 +14,23 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional
 
+from ..utils.guards import TrackedLock, note_shared_access, register_shared
+
 
 class ExplainStore:
     def __init__(self, capacity: int = 32):
         self.capacity = max(1, int(capacity))
-        self._lock = threading.Lock()
+        # Engine/scheduler threads publish, HTTP handler threads read:
+        # the ring is a registered mrsan shared object — armed runs
+        # lockset-check every access (mrlint R10's runtime twin).
+        self._lock = TrackedLock("explain_store")
+        register_shared("explain_store", {"explain_store"})
         self._bundles: "OrderedDict[str, dict]" = OrderedDict()
 
     def publish(self, window_id: str, bundle_data: dict) -> None:
         key = str(window_id)
         with self._lock:
+            note_shared_access("explain_store")
             self._bundles.pop(key, None)
             self._bundles[key] = bundle_data
             while len(self._bundles) > self.capacity:
@@ -31,20 +38,24 @@ class ExplainStore:
 
     def get(self, window_id: str) -> Optional[dict]:
         with self._lock:
+            note_shared_access("explain_store")
             return self._bundles.get(str(window_id))
 
     def latest(self) -> Optional[dict]:
         with self._lock:
+            note_shared_access("explain_store")
             if not self._bundles:
                 return None
             return next(reversed(self._bundles.values()))
 
     def windows(self) -> List[str]:
         with self._lock:
+            note_shared_access("explain_store")
             return list(self._bundles)
 
     def configure(self, capacity: int) -> None:
         with self._lock:
+            note_shared_access("explain_store")
             self.capacity = max(1, int(capacity))
             while len(self._bundles) > self.capacity:
                 self._bundles.popitem(last=False)

@@ -105,9 +105,15 @@ class FleetCoordinator:
             sinks=list(sinks or []),
         )
         self.out_dir = out_dir
+        from ..utils.guards import TrackedLock, register_shared
+
         self.workers: Dict[str, WorkerState] = {}
         self._slots: Dict[int, Dict[str, dict]] = {}  # start_us -> host
-        self._lock = threading.Lock()
+        # HTTP handler threads (register/heartbeat/report) and the
+        # lease reaper funnel through one lock: the fleet state machine
+        # is a registered mrsan shared object.
+        self._lock = TrackedLock("fleet_coordinator")
+        register_shared("fleet_coordinator", {"fleet_coordinator"})
         self._seal_cursor: Optional[int] = None  # last sealed start_us
         self.sealed: List[dict] = []  # {start, start_us, outcome, hosts}
         self.duplicate_reports = 0
@@ -201,7 +207,10 @@ class FleetCoordinator:
 
     # -------------------------------------------------------------- API
     def register(self, host_id: str, resume: bool = False) -> dict:
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("fleet_coordinator")
             ws = self.workers.get(host_id)
             rejoin = ws is not None and ws.registrations > 0
             if ws is None:
@@ -238,7 +247,10 @@ class FleetCoordinator:
             record_fleet_host_rate,
         )
 
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("fleet_coordinator")
             ws = self.workers.get(host_id)
             if ws is None:
                 return {"ok": False, "error": f"unknown host {host_id!r}"}
@@ -266,7 +278,10 @@ class FleetCoordinator:
         counted, neither ever reaches the tracker twice."""
         from ..obs.metrics import record_fleet_report
 
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("fleet_coordinator")
             ws = self.workers.get(host_id)
             if ws is None:
                 return {"ok": False, "error": f"unknown host {host_id!r}"}
@@ -324,7 +339,10 @@ class FleetCoordinator:
     def tick(self) -> None:
         """Reaper entry: age leases, then try to seal (a death can
         unblock the watermark)."""
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("fleet_coordinator")
             self._reap_locked()
             self._seal_locked()
 

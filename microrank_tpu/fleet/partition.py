@@ -39,12 +39,12 @@ WHOLE checkpoint (cold start) instead.
 
 from __future__ import annotations
 
-import threading
 import zlib
 from typing import Iterable, Iterator, List, Optional, Set
 
 import pandas as pd
 
+from ..utils.guards import TrackedLock, note_shared_access, register_shared
 from ..utils.logging import get_logger
 
 log = get_logger("microrank_tpu.fleet")
@@ -87,18 +87,24 @@ class PartitionSet:
     engine thread reads per source chunk."""
 
     def __init__(self, partitions: Iterable[int] = ()):
-        self._lock = threading.Lock()
+        # The heartbeat thread swaps the assignment, the engine thread
+        # reads it per source chunk — a registered mrsan shared object
+        # (mrlint R10's runtime twin lockset-checks every access).
+        self._lock = TrackedLock("fleet_partitions")
+        register_shared("fleet_partitions", {"fleet_partitions"})
         self._parts: Set[int] = {int(p) for p in partitions}
         self.changes = 0
 
     def get(self) -> Set[int]:
         with self._lock:
+            note_shared_access("fleet_partitions")
             return set(self._parts)
 
     def set(self, partitions: Iterable[int]) -> bool:
         """Overwrite the assignment; returns True when it changed."""
         new = {int(p) for p in partitions}
         with self._lock:
+            note_shared_access("fleet_partitions")
             if new == self._parts:
                 return False
             log.info(

@@ -77,8 +77,14 @@ class CoordinatorClient:
         self.host_id = host_id
         self.timeout = max(0.1, float(timeout))
         self.max_queue = max(1, int(max_queue))
+        from ..utils.guards import TrackedLock, register_shared
+
         self._buffer = deque()        # parked report payloads, in order
-        self._lock = threading.Lock()
+        # Engine thread parks/drains, heartbeat thread updates stats,
+        # checkpoints snapshot — a registered mrsan shared object.
+        self._lock = TrackedLock("fleet_report_buffer")
+        register_shared("fleet_report_buffer", {"fleet_report_buffer"})
+        self._draining = False        # one drainer at a time (in-order)
         self.sent = 0
         self.buffered = 0
         self.dropped = 0
@@ -104,8 +110,12 @@ class CoordinatorClient:
             raise RuntimeError(
                 f"coordinator rejected {route}: {doc.get('error')}"
             )
-        self.sent += 1
-        self.last_status = doc
+        # The engine thread (reports) and the heartbeat thread both
+        # land here; the stats share the buffer's lock. The wire call
+        # above is NEVER made under it (mrlint R12).
+        with self._lock:
+            self.sent += 1
+            self.last_status = doc
         return doc
 
     # -------------------------------------------------------------- API
@@ -147,44 +157,69 @@ class CoordinatorClient:
                 self._buffer.popleft()
                 self.dropped += 1
                 record_fleet_report("dropped")
-            return self._flush_locked()
+        return self._drain()
 
     def flush(self) -> Optional[dict]:
         """Drain parked reports (engine drain / final checkpoint)."""
-        with self._lock:
-            return self._flush_locked()
+        return self._drain()
 
-    def _flush_locked(self) -> Optional[dict]:
+    def _drain(self) -> Optional[dict]:
+        """Send parked reports head-first, the WIRE CALL outside the
+        buffer lock (mrlint R12: a hung coordinator — 2 s timeout x
+        retry attempts — must not convoy the heartbeat thread and the
+        checkpoint snapshot behind ``_lock``). Order is preserved by a
+        single-drainer flag plus pop-after-ack: the head stays in the
+        buffer until its send succeeds, so a crash mid-send checkpoints
+        the unacknowledged report and ``--resume`` re-sends it (the
+        coordinator dedups)."""
         from ..chaos.retry import BreakerOpen
         from ..obs.metrics import record_fleet_report
 
+        from ..utils.guards import note_shared_access
+
         resp = None
-        while self._buffer:
-            head = self._buffer[0]
-            try:
-                resp = retry_call(
-                    "fleet_report",
-                    lambda: self._post("/report", {"window": head}),
-                    policy=FLEET_REPORT_POLICY,
-                )
-            except BreakerOpen:
-                # Coordinator definitively down right now: park
-                # silently, the breaker's half-open probe gates the
-                # next attempt.
-                self.buffered = len(self._buffer)
-                record_fleet_report("buffered")
-                return resp
-            except Exception as e:  # noqa: BLE001 - park and move on
-                log.warning(
-                    "report for window %s parked (%s); %d buffered",
-                    head.get("start"), e, len(self._buffer),
-                )
-                self.buffered = len(self._buffer)
-                record_fleet_report("buffered")
-                return resp
-            self._buffer.popleft()
-        self.buffered = 0
-        return resp
+        with self._lock:
+            note_shared_access("fleet_report_buffer")
+            if self._draining:
+                return None  # the active drainer owns the in-order send
+            self._draining = True
+        try:
+            while True:
+                with self._lock:
+                    if not self._buffer:
+                        self.buffered = 0
+                        return resp
+                    head = self._buffer[0]
+                try:
+                    resp = retry_call(
+                        "fleet_report",
+                        lambda: self._post("/report", {"window": head}),
+                        policy=FLEET_REPORT_POLICY,
+                    )
+                except BreakerOpen:
+                    # Coordinator definitively down right now: park
+                    # silently, the breaker's half-open probe gates the
+                    # next attempt.
+                    with self._lock:
+                        self.buffered = len(self._buffer)
+                    record_fleet_report("buffered")
+                    return resp
+                except Exception as e:  # noqa: BLE001 - park, move on
+                    with self._lock:
+                        parked = len(self._buffer)
+                        self.buffered = parked
+                    log.warning(
+                        "report for window %s parked (%s); %d buffered",
+                        head.get("start"), e, parked,
+                    )
+                    record_fleet_report("buffered")
+                    return resp
+                with self._lock:
+                    if self._buffer and self._buffer[0] is head:
+                        self._buffer.popleft()
+        finally:
+            with self._lock:
+                self._draining = False
 
     def goodbye(self) -> None:
         try:
@@ -199,7 +234,10 @@ class CoordinatorClient:
 
     # ------------------------------------------------------- durability
     def buffered_state(self) -> List[dict]:
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("fleet_report_buffer")
             return [dict(w) for w in self._buffer]
 
     def restore_buffer(self, windows: List[dict]) -> None:

@@ -25,7 +25,6 @@ an incident storm cannot fill the disk; suppressed dumps are counted.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from pathlib import Path
 from typing import List, Optional
@@ -144,11 +143,17 @@ class FlightRecorder:
         journal=None,
         tracer=None,
     ):
+        from ..utils.guards import TrackedLock, register_shared
+
         self.base = Path(out_dir) / FLIGHT_DIR
         self.cfg = obs_config
         self.journal = journal
         self._tracer = tracer
-        self._lock = threading.Lock()
+        # Incident-open (engine), degraded-dispatch (scheduler) and
+        # SIGTERM (main) triggers race into the rate limiter — a
+        # registered mrsan shared object (R10's runtime twin).
+        self._lock = TrackedLock("flight_recorder")
+        register_shared("flight_recorder", {"flight_recorder"})
         self._last_mono: Optional[float] = None
         self.dumps = 0
 
@@ -163,7 +168,10 @@ class FlightRecorder:
 
         if not self.cfg.flight:
             return None
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("flight_recorder")
             now = time.monotonic()
             if (
                 self._last_mono is not None

@@ -113,6 +113,7 @@ class RunJournal:
             try:
                 with open(self.path, "a") as f:
                     f.flush()
+                    # mrlint: disable=R12(durability contract: the fsync must serialize with emit() writers under the same lock so it covers every line already written; bounded by local-disk latency, no network I/O)
                     os.fsync(f.fileno())
             except OSError:  # pragma: no cover - exotic filesystems
                 pass

@@ -345,9 +345,12 @@ def mrsan_violations() -> Counter:
     return get_registry().counter(
         "microrank_mrsan_violations_total",
         "mrsan runtime violations: cross-thread-device (a jax seam "
-        "entered off the owner thread — mrlint R8's runtime twin) or "
+        "entered off the owner thread — mrlint R8's runtime twin), "
         "collective-divergence (per-shard collective multisets "
-        "diverged on the mesh — R9's runtime twin)",
+        "diverged on the mesh — R9's), shared-state-race (a "
+        "registered object's candidate lockset emptied — R10's), or "
+        "lock-order (an armed acquire closed a cycle in the observed "
+        "acquisition DAG — R11's)",
         labelnames=("kind",),
     )
 
@@ -358,6 +361,17 @@ def mrsan_collectives() -> Counter:
         "Mesh collectives observed by the mrsan interposition at "
         "runtime, summed over shards",
         labelnames=("op",),
+    )
+
+
+def mrsan_lockset_checks() -> Counter:
+    return get_registry().counter(
+        "microrank_mrsan_lockset_checks_total",
+        "mrsan Eraser-style lockset validations on registered shared "
+        "objects (utils.guards.note_shared_access) while the runtime "
+        "sanitizers were armed — mrlint R10's runtime twin; a clean "
+        "run with zero here means the checker never looked",
+        labelnames=("object",),
     )
 
 
@@ -507,6 +521,7 @@ def ensure_catalog() -> None:
         spans_recorded, flight_dumps, device_hbm_bytes,
         kernel_ms_per_iter, profile_sessions, explain_bundles,
         mrsan_checks, mrsan_violations, mrsan_collectives,
+        mrsan_lockset_checks,
         retry_attempts, retry_exhausted, breaker_state,
         fault_injections, webhook_dropped, checkpoint_events,
         fleet_heartbeats, fleet_reports, fleet_workers_gauge,
@@ -608,6 +623,10 @@ def record_mrsan_violation(kind: str, n: int = 1) -> None:
 
 def record_mrsan_collective(op: str, n: int = 1) -> None:
     mrsan_collectives().inc(float(n), op=op)
+
+
+def record_mrsan_lockset_check(obj: str) -> None:
+    mrsan_lockset_checks().inc(object=obj)
 
 
 def record_retry(seam: str) -> None:

@@ -216,8 +216,13 @@ class SpanTracer:
         genuinely slow stage would look like)."""
         if self.inject_sleep_ms <= 0 or name != self.inject_stage:
             return
-        self._inject_seen += 1
-        if (self._inject_seen - 1) % self.inject_every == 0:
+        # Spans complete on whichever thread ran the stage; the firing
+        # decision shares the ring's lock (mrlint R10) and the sleep
+        # itself stays outside it (R12).
+        with self._lock:
+            self._inject_seen += 1
+            fire = (self._inject_seen - 1) % self.inject_every == 0
+        if fire:
             # Legacy knob aliased onto the unified chaos surface: the
             # firing is recorded like any FaultPlan injection
             # (microrank_fault_injections_total + journal), the sleep

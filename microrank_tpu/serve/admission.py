@@ -12,14 +12,17 @@ the admitted set. A draining service (SIGTERM received) admits nothing.
 
 from __future__ import annotations
 
-import threading
+from ..utils.guards import TrackedLock, note_shared_access, register_shared
 
 
 class AdmissionController:
     def __init__(self, max_depth: int, retry_after_seconds: float = 1.0):
         self.max_depth = int(max_depth)
         self.retry_after_seconds = float(retry_after_seconds)
-        self._lock = threading.Lock()
+        # HTTP threads admit, the scheduler thread releases — a
+        # registered mrsan shared object (R10's runtime twin).
+        self._lock = TrackedLock("serve_admission")
+        register_shared("serve_admission", {"serve_admission"})
         self._depth = 0
         self._closed = False
 
@@ -28,6 +31,7 @@ class AdmissionController:
         from ..obs.metrics import serve_queue_depth
 
         with self._lock:
+            note_shared_access("serve_admission")
             if self._closed or self._depth >= self.max_depth:
                 return False
             self._depth += 1
@@ -39,6 +43,7 @@ class AdmissionController:
         from ..obs.metrics import serve_queue_depth
 
         with self._lock:
+            note_shared_access("serve_admission")
             self._depth = max(0, self._depth - 1)
             depth = self._depth
         serve_queue_depth().set(float(depth))

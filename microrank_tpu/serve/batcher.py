@@ -20,7 +20,6 @@ rather than not at all. No request is dropped on a device fault.
 
 from __future__ import annotations
 
-import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -136,7 +135,12 @@ class MicroBatcher:
         self.router = (
             router if router is not None else DispatchRouter(config)
         )
-        self._lock = threading.Lock()
+        from ..utils.guards import TrackedLock, register_shared
+
+        # The scheduler thread parks/pops; HTTP threads read stats —
+        # a registered mrsan shared object (R10's runtime twin).
+        self._lock = TrackedLock("serve_buckets")
+        register_shared("serve_buckets", {"serve_buckets"})
         # bucket key -> FIFO of PendingWindow (insertion order = age).
         self._buckets: Dict[Tuple, List[PendingWindow]] = {}
         self._inject_failures = int(self.serve.inject_dispatch_failures)
@@ -144,12 +148,18 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ intake
     def submit(self, pw: PendingWindow) -> None:
+        from ..utils.guards import note_shared_access
+
         key = bucket_key(pw.graph, pw.kernel)
         with self._lock:
+            note_shared_access("serve_buckets")
             self._buckets.setdefault(key, []).append(pw)
 
     def pending(self) -> int:
+        from ..utils.guards import note_shared_access
+
         with self._lock:
+            note_shared_access("serve_buckets")
             return sum(len(v) for v in self._buckets.values())
 
     def next_deadline(self) -> Optional[float]:
@@ -168,8 +178,11 @@ class MicroBatcher:
         now = time.monotonic()
         wait_s = max(0.0, float(self.serve.max_wait_ms)) / 1e3
         cap = max(1, int(self.serve.max_batch_windows))
+        from ..utils.guards import note_shared_access
+
         out: List[List[PendingWindow]] = []
         with self._lock:
+            note_shared_access("serve_buckets")
             for key in list(self._buckets):
                 bucket = self._buckets[key]
                 while len(bucket) >= cap:

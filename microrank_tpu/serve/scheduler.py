@@ -132,12 +132,16 @@ class BatchScheduler(threading.Thread):
                 self._process(entry)
             # In-flight (already built or still building) windows always
             # complete at shutdown — only queued-not-yet-built requests
-            # are failed by a non-draining stop.
-            force = (
-                self._stopping
-                and self.queued() == 0
-                and self.builds_inflight() == 0
-            )
+            # are failed by a non-draining stop. One condition hold for
+            # the whole read: _stopping is written by stop() on another
+            # thread (mrlint R10 — the force decision must see a
+            # consistent (stopping, queued, builds) triple).
+            with self._cond:
+                force = (
+                    self._stopping
+                    and not any(self._tenants.values())
+                    and self._builds == 0
+                )
             # All ready batches dispatch through the router pipelined:
             # batch i+1's staging (host pack + H2D) overlaps batch i's
             # device execution (dispatch router double-buffering).

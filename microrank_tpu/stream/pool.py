@@ -25,6 +25,8 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Optional, Set
 
+from ..utils.guards import TrackedLock, note_shared_access, register_shared
+
 
 class BuildWorkerPool:
     """A small thread pool with build accounting.
@@ -39,7 +41,11 @@ class BuildWorkerPool:
         self._ex = ThreadPoolExecutor(
             max_workers=self.workers, thread_name_prefix=name
         )
-        self._lock = threading.Lock()
+        # Submitters (engine/scheduler thread) and workers both touch
+        # the accounting: a registered mrsan shared object (R10's
+        # runtime twin lockset-checks it when sanitizers are armed).
+        self._lock = TrackedLock("build_pool")
+        register_shared("build_pool", {"build_pool"})
         self._inflight = 0
         self.build_threads: Set[int] = set()
         self.builds = 0
@@ -67,6 +73,7 @@ class BuildWorkerPool:
         tracer = get_tracer()
         ctx = tracer.current_context()
         with self._lock:
+            note_shared_access("build_pool")
             self._inflight += 1
             record_build_pool(inflight=self._inflight)
 
@@ -77,6 +84,7 @@ class BuildWorkerPool:
                     return fn(*args, **kwargs)
             finally:
                 with self._lock:
+                    note_shared_access("build_pool")
                     self._inflight -= 1
                     self.builds += 1
                     self.build_threads.add(threading.get_ident())

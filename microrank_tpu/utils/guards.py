@@ -59,9 +59,13 @@ _sanitizers_on = False
 
 
 def set_sanitizers(enabled: bool) -> None:
-    """Arm/disarm the mrsan runtime checks process-wide."""
+    """Arm/disarm the mrsan runtime checks process-wide. The flag is
+    read lock-free on every seam check by design (disarmed = one
+    boolean read is the documented cost model); a stale read during
+    the arm/disarm transition at worst skips or adds one check —
+    mrlint R10's ``published`` seam."""
     global _sanitizers_on
-    _sanitizers_on = bool(enabled)
+    _sanitizers_on = published(bool(enabled))
 
 
 def sanitizers_enabled() -> bool:
@@ -139,6 +143,210 @@ def assert_device_owner(seam: str) -> None:
             "through the owner loop or authorize_device_thread() if the "
             "delegation is by design"
         )
+
+
+# ---------------------------------------------------------------------------
+# Lock tracking (mrsan — the runtime twin of mrlint R10/R11/R12).
+#
+# The static model (analysis.locks): every shared variable has a
+# non-empty common lockset across its cross-thread accesses (R10), the
+# lock-acquisition-order graph is acyclic (R11), and no blocking call
+# happens under a lock (R12). The runtime half validates the first two
+# Eraser-style: production locks wrap in :class:`TrackedLock` (a named
+# threading lock recording per-thread held-locksets when sanitizers
+# are armed), registered shared objects are lockset-checked on access
+# (``register_shared``/``note_shared_access`` — candidate sets seeded
+# from the static lock catalog), and a process-wide watchdog asserts
+# the OBSERVED acquisition order stays a DAG on every armed acquire.
+# Disarmed, every hook is one module-global boolean read.
+
+
+class LockOrderError(RuntimeError):
+    """An armed TrackedLock acquisition closed a cycle in the observed
+    lock-order graph (mrsan, rule R11's runtime twin)."""
+
+
+class LocksetError(RuntimeError):
+    """A registered shared object was accessed with an empty candidate
+    lockset (mrsan, rule R10's runtime twin — the Eraser discipline)."""
+
+
+class _HeldLocks(threading.local):
+    def __init__(self):
+        self.stack: list = []
+
+
+_held = _HeldLocks()
+_order_lock = threading.Lock()
+_order_edges: dict = {}       # lock name -> set of lock names acquired under it
+_shared_lock = threading.Lock()
+_shared_seed: dict = {}        # object name -> declared candidate lock names
+_shared_candidates: dict = {}  # object name -> current (refined) candidates
+
+
+def held_locks() -> tuple:
+    """Names of the TrackedLocks the CURRENT thread holds, in
+    acquisition order (armed mode only — disarmed holds record
+    nothing)."""
+    return tuple(_held.stack)
+
+
+def _order_reaches(start: str, goal: str) -> bool:
+    """DFS over the observed acquisition edges (caller holds
+    _order_lock)."""
+    stack = [start]
+    seen = set()
+    while stack:
+        cur = stack.pop()
+        if cur == goal:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(_order_edges.get(cur, ()))
+    return False
+
+
+def _note_acquire(name: str) -> None:
+    """Lock-order watchdog: record held->name edges; a new edge that
+    closes a cycle is a potential deadlock — counted and raised (the
+    second thread would already be blocked for real)."""
+    holders = [h for h in _held.stack if h != name]
+    if not holders:
+        return
+    with _order_lock:
+        inversion = None
+        for h in holders:
+            if _order_reaches(name, h):
+                inversion = h
+                break
+        if inversion is None:
+            # Record only DAG-preserving edges: the inverting edge is
+            # reported, not merged, so later well-ordered acquires of
+            # the same locks do not trip on a poisoned graph.
+            for h in holders:
+                _order_edges.setdefault(h, set()).add(name)
+    if inversion is not None:
+        from ..obs.metrics import record_mrsan_violation
+
+        record_mrsan_violation("lock-order")
+        raise LockOrderError(
+            f"lock-order inversion: acquiring {name!r} while holding "
+            f"{inversion!r}, but the observed acquisition order already "
+            f"has {name!r} -> ... -> {inversion!r} (mrlint R11's "
+            "runtime model) — impose one global acquisition order"
+        )
+
+
+class TrackedLock:
+    """A named threading lock that feeds the mrsan lockset/lock-order
+    checkers when sanitizers are armed. Instances of one class share
+    the name — the granularity of the static model. Disarmed cost: one
+    boolean read per acquire/release on top of the raw lock."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = str(name)
+        self._inner = (
+            threading.RLock() if reentrant else threading.Lock()
+        )
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _sanitizers_on:
+            _note_acquire(self.name)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _sanitizers_on:
+            _held.stack.append(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        if _sanitizers_on:
+            stack = _held.stack
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return bool(locked()) if locked is not None else False
+
+
+def register_shared(name: str, candidates) -> None:
+    """Declare one shared object and the lock names the static
+    analysis says guard it (the DESIGN.md lock catalog seeds these).
+    The declaration survives ``reset_lock_tracking`` — a run entry
+    resets the REFINED candidate sets back to the declared seed, not
+    the registrations themselves (objects register at construction,
+    which may precede the run entry)."""
+    with _shared_lock:
+        seed = frozenset(map(str, candidates))
+        _shared_seed[str(name)] = seed
+        _shared_candidates[str(name)] = set(seed)
+
+
+def note_shared_access(name: str) -> None:
+    """Eraser lockset check on one registered shared object: the
+    candidate set intersects with the caller's held locks; an access
+    that empties it means NO lock consistently guards the object —
+    counted into microrank_mrsan_violations_total{kind=
+    "shared-state-race"} and raised. Unregistered names are ignored
+    (the checker validates the catalog, it does not invent one)."""
+    if not _sanitizers_on:
+        return
+    from ..obs.metrics import (
+        record_mrsan_lockset_check,
+        record_mrsan_violation,
+    )
+
+    key = str(name)
+    held = set(_held.stack)
+    with _shared_lock:
+        cand = _shared_candidates.get(key)
+        if cand is None:
+            return
+        cand &= held
+        emptied = not cand
+    record_mrsan_lockset_check(key)
+    if emptied:
+        record_mrsan_violation("shared-state-race")
+        raise LocksetError(
+            f"shared object {key!r} accessed with candidate lockset "
+            f"emptied (thread {threading.current_thread().name!r} "
+            f"holds {sorted(held) or 'no tracked locks'}) — no lock "
+            "consistently guards this object across its accessing "
+            "threads (mrlint R10's runtime model)"
+        )
+
+
+def reset_lock_tracking() -> None:
+    """Fresh lock-order graph; refined candidate locksets return to
+    their declared seeds (run entries, tests). Held stacks are
+    per-thread and clear as locks release."""
+    with _order_lock:
+        _order_edges.clear()
+    with _shared_lock:
+        _shared_candidates.clear()
+        for name, seed in _shared_seed.items():
+            _shared_candidates[name] = set(seed)
+
+
+def published(value):
+    """Mark an INTENTIONAL lock-free cross-thread publish (mrlint R10's
+    escape seam): ``self.stop = published(True)`` documents that racy
+    readers are by design (monotonic flags, best-effort stats).
+    Identity at runtime; the static analysis exempts every variable
+    whose writes route through it."""
+    return value
 
 
 def contracts_enabled() -> bool:

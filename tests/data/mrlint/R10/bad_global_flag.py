@@ -1,0 +1,20 @@
+"""R10 bad: a module global rebound by a pool worker and read by the
+caller with no common lock — the module owns a lock (for other state),
+so its globals are in the race-checked set."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_state_lock = threading.Lock()
+_last_result = None
+
+
+def _work(x):
+    global _last_result
+    _last_result = x * 2
+
+
+def run(pool_size=2):
+    pool = ThreadPoolExecutor(pool_size)
+    pool.submit(_work, 21)
+    return _last_result

@@ -1,0 +1,18 @@
+"""R10 bad: the reader diligently takes the lock but the writer (on
+the sink-callback thread class) does not — one unguarded side is
+enough to empty the common lockset."""
+
+import threading
+
+
+class StatsSink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.emitted = 0
+
+    def emit(self, event):
+        self.emitted = self.emitted + 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.emitted

@@ -1,0 +1,26 @@
+"""R10 bad: writer and reader each hold a lock — but DIFFERENT locks,
+so the intersection of the locksets is empty and the accesses still
+race (the classic two-lock false-protection bug)."""
+
+import threading
+
+
+class Buffered:
+    def __init__(self):
+        self._write_lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        self.pending = []
+
+    def push(self, item):
+        with self._write_lock:
+            self.pending = self.pending + [item]
+
+    def start(self):
+        t = threading.Thread(target=self.drain)
+        t.start()
+
+    def drain(self):
+        with self._read_lock:
+            items = self.pending
+            self.pending = []
+        return items

@@ -1,0 +1,21 @@
+"""R10 bad: a counter written on a spawned thread and read on the main
+thread with no lock held at either access — the class owns a lock, it
+just never guards this attribute."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.windows = 0
+
+    def loop(self):
+        self.windows = self.windows + 1
+
+    def start(self):
+        t = threading.Thread(target=self.loop, name="engine")
+        t.start()
+
+    def stats(self):
+        return self.windows

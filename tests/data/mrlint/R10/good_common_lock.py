@@ -1,0 +1,22 @@
+"""R10 good: every access — writer thread and main-thread reader —
+holds the SAME lock, so the intersected lockset is non-empty."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.windows = 0
+
+    def loop(self):
+        with self._lock:
+            self.windows = self.windows + 1
+
+    def start(self):
+        t = threading.Thread(target=self.loop, name="engine")
+        t.start()
+
+    def stats(self):
+        with self._lock:
+            return self.windows

@@ -1,0 +1,18 @@
+"""R10 good: single-assignment-then-publish — the attribute is written
+only in __init__ (before any thread can see the object) and read
+cross-thread afterwards."""
+
+import threading
+
+
+class Engine:
+    def __init__(self, config):
+        self._lock = threading.Lock()
+        self.config = config
+
+    def loop(self):
+        return self.config
+
+    def start(self):
+        t = threading.Thread(target=self.loop)
+        t.start()

@@ -1,0 +1,24 @@
+"""R10 good: an intentional lock-free publish (a monotonic stop flag)
+routed through the ``published()`` marker — documented handoff, not a
+finding."""
+
+import threading
+
+from microrank_tpu.utils.guards import published
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()   # guards other state
+        self.stop = published(False)
+
+    def request_stop(self):
+        self.stop = published(True)
+
+    def loop(self):
+        while not self.stop:
+            pass
+
+    def start(self):
+        t = threading.Thread(target=self.loop)
+        t.start()

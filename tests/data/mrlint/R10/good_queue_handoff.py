@@ -1,0 +1,22 @@
+"""R10 good: the safe queue-handoff seam — cross-thread data flows
+through a queue.Queue attribute (internally synchronized), not through
+bare shared attributes."""
+
+import queue
+import threading
+
+
+class Producer:
+    def __init__(self):
+        self._lock = threading.Lock()   # guards other state
+        self.q = queue.Queue()
+
+    def produce(self):
+        self.q.put("window")
+
+    def start(self):
+        t = threading.Thread(target=self.produce)
+        t.start()
+
+    def consume(self):
+        return self.q.get(timeout=1.0)

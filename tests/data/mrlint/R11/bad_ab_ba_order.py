@@ -1,0 +1,20 @@
+"""R11 bad: the textbook AB/BA deadlock — two methods nest the same
+two locks in opposite orders."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._stage_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def advance(self):
+        with self._stage_lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            with self._stage_lock:
+                pass

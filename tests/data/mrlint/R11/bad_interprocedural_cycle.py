@@ -1,0 +1,27 @@
+"""R11 bad: the lock-order cycle only exists ACROSS functions — each
+holder calls a helper that takes the second lock (acquire-via-callee
+edges)."""
+
+import threading
+
+
+class Coordinator:
+    def __init__(self):
+        self._lease_lock = threading.Lock()
+        self._seal_lock = threading.Lock()
+
+    def renew(self):
+        with self._lease_lock:
+            self._record_seal()
+
+    def _record_seal(self):
+        with self._seal_lock:
+            pass
+
+    def seal(self):
+        with self._seal_lock:
+            self._touch_lease()
+
+    def _touch_lease(self):
+        with self._lease_lock:
+            pass

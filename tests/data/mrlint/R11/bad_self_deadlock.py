@@ -1,0 +1,18 @@
+"""R11 bad: re-acquiring a held NON-reentrant lock through a callee —
+the thread deadlocks on itself (a plain threading.Lock is not an
+RLock)."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def publish(self, item):
+        with self._lock:
+            self.evict()
+
+    def evict(self):
+        with self._lock:
+            pass

@@ -1,0 +1,20 @@
+"""R11 good: both paths acquire the two locks in the SAME global
+order (stage before stats) — the acquisition graph stays a DAG."""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._stage_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def advance(self):
+        with self._stage_lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._stage_lock:
+            with self._stats_lock:
+                pass

@@ -1,0 +1,17 @@
+"""R11 good: re-entering a held RLock through a callee is legal —
+reentrant locks are exempt from the self-edge."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def publish(self, item):
+        with self._lock:
+            self.evict()
+
+    def evict(self):
+        with self._lock:
+            pass

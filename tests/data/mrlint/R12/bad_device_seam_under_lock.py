@@ -1,0 +1,23 @@
+"""R12 bad: a device dispatch/fetch seam entered while a lock is held
+— every contending thread waits out device latency behind a host
+lock."""
+
+import threading
+
+from microrank_tpu.rank_backends.blob import stage_rank_window
+
+
+class Dispatcher:
+    def __init__(self, config):
+        self._lock = threading.Lock()
+        self.config = config
+
+    def rank(self, graph, kernel):
+        with self._lock:
+            return stage_rank_window(
+                graph,
+                self.config.pagerank,
+                self.config.spectrum,
+                kernel,
+                False,
+            )

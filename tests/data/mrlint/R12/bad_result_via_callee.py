@@ -1,0 +1,20 @@
+"""R12 bad: the blocking call hides one hop away — the method called
+under the lock waits on a pool future (``submit(...).result()``)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Builder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = ThreadPoolExecutor(2)
+        self.built = []
+
+    def build_next(self, graph):
+        with self._lock:
+            out = self._run_build(graph)
+            self.built.append(out)
+
+    def _run_build(self, graph):
+        return self.pool.submit(len, graph).result()

@@ -1,0 +1,16 @@
+"""R12 bad: a backoff sleep inside the lock — every thread contending
+on the lock sleeps too."""
+
+import threading
+import time
+
+
+class RateLimiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = 0.0
+
+    def pace(self):
+        with self._lock:
+            time.sleep(0.2)
+            self.last = time.monotonic()

@@ -1,0 +1,19 @@
+"""R12 bad: the webhook-hang bug class — an HTTP POST issued while the
+incident lock is held; a hung endpoint stalls every thread touching
+incident state."""
+
+import threading
+import urllib.request
+
+
+class IncidentNotifier:
+    def __init__(self, url):
+        self._lock = threading.Lock()
+        self.url = url
+        self.sent = 0
+
+    def notify(self, payload):
+        with self._lock:
+            req = urllib.request.Request(self.url, data=payload)
+            urllib.request.urlopen(req, timeout=5.0)
+            self.sent += 1

@@ -1,0 +1,23 @@
+"""R12 good: ``Condition.wait`` on the HELD condition is exempt — wait
+releases the lock by contract (the scheduler's idle-park idiom)."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.queue = []
+
+    def pop(self, timeout):
+        with self._cond:
+            if not self.queue:
+                self._cond.wait(timeout=timeout)
+            if self.queue:
+                return self.queue.pop(0)
+        return None
+
+    def push(self, item):
+        with self._cond:
+            self.queue.append(item)
+            self._cond.notify()

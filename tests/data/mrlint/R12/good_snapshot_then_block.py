@@ -1,0 +1,20 @@
+"""R12 good: the fix shape — snapshot state under the lock, release
+it, THEN do the blocking I/O."""
+
+import threading
+import urllib.request
+
+
+class IncidentNotifier:
+    def __init__(self, url):
+        self._lock = threading.Lock()
+        self.url = url
+        self.pending = []
+
+    def notify(self):
+        with self._lock:
+            batch = list(self.pending)
+            self.pending = []
+        for payload in batch:
+            req = urllib.request.Request(self.url, data=payload)
+            urllib.request.urlopen(req, timeout=5.0)

@@ -31,6 +31,7 @@ def _rules_fired(path: Path):
 def test_rule_catalog_complete():
     assert set(RULES) == {
         "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
+        "R10", "R11", "R12",
     }
     for rule in RULES.values():
         assert rule.slug and rule.summary
@@ -278,6 +279,138 @@ def test_executor_authorization_resolves_through_parameters():
     assert "R8" not in {v.rule for v in lint_source(authorized)}
     unauthorized = _PARAM_POOL_SNIPPET.format(init="")
     assert "R8" in {v.rule for v in lint_source(unauthorized)}
+
+
+# ---------------------------------------------------- mrrace (R10-R12)
+
+
+def test_lock_model_identifies_attr_and_module_locks():
+    from microrank_tpu.analysis.core import Project, _parse_text
+    from pathlib import Path
+
+    src = """\
+import threading
+
+_mod_lock = threading.Lock()
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.RLock()
+"""
+    project = Project([_parse_text(src, Path("<s>"), "<s>")])
+    locks = project.locks
+    assert ("S", "_lock") in locks.attr_locks
+    assert locks.attr_locks[("S", "_lock")].reentrant
+    assert any(
+        name == "_mod_lock" for (_, name) in locks.module_locks
+    )
+
+
+def test_r10_locked_helper_inherits_caller_lockset():
+    """The `_locked`-suffix helper pattern: every resolved caller holds
+    the lock, so the helper's accesses inherit it and do NOT fire."""
+    src = """\
+import threading
+
+
+class Coord:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def tick(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self.count = self.count + 1
+
+    def start(self):
+        t = threading.Thread(target=self.tick)
+        t.start()
+
+    def read(self):
+        with self._lock:
+            return self.count
+"""
+    assert "R10" not in {v.rule for v in lint_source(src)}
+
+
+def test_r11_acquire_release_pairs_tracked():
+    """Explicit acquire()/release() regions feed the order graph like
+    `with` blocks do."""
+    src = """\
+import threading
+
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        self._a.acquire()
+        with self._b:
+            pass
+        self._a.release()
+
+    def two(self):
+        with self._b:
+            self._a.acquire()
+            self._a.release()
+"""
+    assert "R11" in {v.rule for v in lint_source(src)}
+
+
+def test_r12_nested_callback_does_not_leak_lock(tmp_path):
+    """A blocking call inside a nested def (deferred callback) is NOT
+    attributed to the enclosing function's lexical lock region."""
+    src = """\
+import threading
+import time
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = []
+
+    def submit(self, fn):
+        with self._lock:
+            def run_later():
+                time.sleep(1.0)
+                return fn()
+
+            self.jobs.append(run_later)
+"""
+    assert "R12" not in {v.rule for v in lint_source(src)}
+
+
+def test_r12_fires_through_sleep_parameter_chain():
+    """retry-style helpers: the sleep happens in a callee reached from
+    a call made under the lock."""
+    src = """\
+import threading
+import time
+
+
+def backoff(delay):
+    time.sleep(delay)
+
+
+class Client:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sent = 0
+
+    def send(self):
+        with self._lock:
+            backoff(0.1)
+            self.sent += 1
+"""
+    fired = {v.rule for v in lint_source(src)}
+    assert "R12" in fired
 
 
 # ------------------------------------------------------------------- sarif

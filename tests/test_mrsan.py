@@ -165,6 +165,147 @@ def test_reclaim_follows_active_pipeline(armed):
         assert_device_owner("test.seam")
 
 
+# ------------------------------------------- locksets & lock order
+
+
+def test_tracked_lock_records_held_locksets(armed):
+    from microrank_tpu.utils.guards import TrackedLock, held_locks
+
+    a = TrackedLock("t.a")
+    b = TrackedLock("t.b")
+    assert held_locks() == ()
+    with a:
+        assert held_locks() == ("t.a",)
+        with b:
+            assert held_locks() == ("t.a", "t.b")
+        assert held_locks() == ("t.a",)
+    assert held_locks() == ()
+
+
+def test_tracked_lock_disarmed_records_nothing(registry):
+    from microrank_tpu import analysis
+    from microrank_tpu.utils.guards import TrackedLock, held_locks
+
+    analysis.mrsan.configure_sanitizers(MicroRankConfig())
+    lock = TrackedLock("t.off")
+    with lock:
+        assert held_locks() == ()
+    # Still a real mutex when disarmed.
+    assert lock.locked() is False
+
+
+def test_registered_object_foreign_access_trips(armed, registry):
+    """The Eraser discipline at runtime: an access that empties the
+    candidate lockset raises and counts the violation."""
+    from microrank_tpu.utils.guards import (
+        LocksetError,
+        TrackedLock,
+        note_shared_access,
+        register_shared,
+    )
+
+    lock = TrackedLock("obj.lock")
+    register_shared("obj", {"obj.lock"})
+    with lock:
+        note_shared_access("obj")  # candidates stay {obj.lock}
+    with pytest.raises(LocksetError, match="'obj'"):
+        note_shared_access("obj")  # no lock held -> emptied
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_violations_total",
+            kind="shared-state-race",
+        )
+        == 1
+    )
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_lockset_checks_total",
+            object="obj",
+        )
+        == 2
+    )
+
+
+def test_lockset_checker_disarmed_is_noop(registry):
+    from microrank_tpu import analysis
+    from microrank_tpu.utils.guards import (
+        note_shared_access,
+        register_shared,
+    )
+
+    analysis.mrsan.configure_sanitizers(MicroRankConfig())
+    register_shared("obj2", {"some.lock"})
+    note_shared_access("obj2")  # no lock held, sanitizers off: free
+    assert _total(registry, "microrank_mrsan_lockset_checks_total") == 0
+    assert _total(registry, "microrank_mrsan_violations_total") == 0
+
+
+def test_unregistered_object_access_is_ignored(armed, registry):
+    from microrank_tpu.utils.guards import note_shared_access
+
+    note_shared_access("never-registered")
+    assert _total(registry, "microrank_mrsan_lockset_checks_total") == 0
+
+
+def test_lock_order_watchdog_trips_on_inversion(armed, registry):
+    """A-then-B established, B-then-A raises LockOrderError (mrlint
+    R11's runtime twin) and counts the violation."""
+    from microrank_tpu.utils.guards import LockOrderError, TrackedLock
+
+    a = TrackedLock("w.a")
+    b = TrackedLock("w.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderError, match="w.a"):
+            with a:
+                pass
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_violations_total",
+            kind="lock-order",
+        )
+        == 1
+    )
+    # The inverting edge was reported, not merged: the established
+    # order keeps working afterwards.
+    with a:
+        with b:
+            pass
+
+
+def test_lock_order_reset_between_runs(armed):
+    from microrank_tpu import analysis
+    from microrank_tpu.utils.guards import TrackedLock
+
+    a = TrackedLock("r.a")
+    b = TrackedLock("r.b")
+    with a:
+        with b:
+            pass
+    analysis.mrsan.configure_sanitizers(
+        MicroRankConfig(runtime=RuntimeConfig(sanitizers=True))
+    )
+    # Fresh run: the opposite order is legal again (no stale edges).
+    with b:
+        with a:
+            pass
+
+
+def test_reentrant_tracked_lock_reenters(armed):
+    from microrank_tpu.utils.guards import TrackedLock, held_locks
+
+    r = TrackedLock("r.lock", reentrant=True)
+    with r:
+        with r:
+            assert held_locks() == ("r.lock", "r.lock")
+    assert held_locks() == ()
+
+
 # ----------------------------------------------- collective recording
 
 
@@ -342,6 +483,10 @@ def test_stream_run_sanitized_stays_clean(registry, tmp_path):
         mrsan.configure_sanitizers(MicroRankConfig())
     assert s.windows == 4 and s.ranked == 1
     assert _total(registry, "microrank_mrsan_checks_total") > 0
+    # The mrrace runtime half looked too: registered shared objects
+    # (build pool accounting at minimum) were lockset-checked, and
+    # nothing tripped.
+    assert _total(registry, "microrank_mrsan_lockset_checks_total") > 0
     assert _total(registry, "microrank_mrsan_violations_total") == 0
     # The engine thread claimed; the snapshot proves the seams looked.
     prom = (tmp_path / "metrics.prom").read_text()
@@ -396,6 +541,16 @@ def test_serve_degrade_path_guarded_and_clean(registry):
     assert (
         _value(
             registry, "microrank_mrsan_checks_total", seam="serve.degrade"
+        )
+        >= 1
+    )
+    # Serve-path shared objects (admission counter, shape buckets)
+    # were lockset-checked by the armed run.
+    assert (
+        _value(
+            registry,
+            "microrank_mrsan_lockset_checks_total",
+            object="serve_admission",
         )
         >= 1
     )
