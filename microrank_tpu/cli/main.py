@@ -126,6 +126,13 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         "microrank_fault_injections_total and the journal",
     )
     p.add_argument(
+        "--no-tuned-policy", action="store_true",
+        help="do not consult the persisted tuned policy (policy.json "
+        "written by `cli scenarios` next to the warmup manifest); "
+        "pins the built-in spectrum/kernel/pad defaults. Explicit "
+        "flags always beat the policy even without this",
+    )
+    p.add_argument(
         "--chaos-seed", type=int, default=None,
         help="RNG seed for probabilistic chaos fault specs (default: "
         "the plan file's seed, else 0)",
@@ -272,6 +279,11 @@ def _config_from_args(args) -> "MicroRankConfig":
                     ),
                     "sanitizers": (
                         True if getattr(args, "sanitizers", False) else None
+                    ),
+                    "tuned_policy": (
+                        "off"
+                        if getattr(args, "no_tuned_policy", False)
+                        else None
                     ),
                     "pipeline_depth": getattr(args, "pipeline_depth", None),
                     "fetch_mode": getattr(args, "fetch_mode", None),
@@ -769,6 +781,9 @@ def cmd_stream(args) -> int:
                 n_kinds=args.kinds,
                 n_traces=args.traces,
                 fault_latency_ms=args.fault_ms,
+                fault_kind=args.fault_kind,
+                n_faults=args.fault_count,
+                drift_per_window=args.drift,
                 window_minutes=args.detect_minutes,
                 seed=args.seed,
             ),
@@ -776,8 +791,9 @@ def cmd_stream(args) -> int:
         )
         log.info(
             "synthetic source: %d windows, fault windows %s, "
-            "injected fault %s",
-            args.windows, faulted or "none", source.fault_pod_op,
+            "injected %s fault(s) %s",
+            args.windows, faulted or "none", args.fault_kind,
+            source.fault_pod_ops,
         )
     elif args.input is None:
         log.error("--source %s needs --input TRACES_CSV", args.source)
@@ -968,6 +984,62 @@ def cmd_explain(args) -> int:
         print(json.dumps(data, indent=2))
     else:
         print(ExplainBundle(data).to_table(), end="")
+    return 0
+
+
+def cmd_scenarios(args) -> int:
+    """Scenario matrix + self-tuning policy engine (scenarios/): run
+    every fault family through the real batch + streaming pipelines,
+    score all 13 spectrum formulas per scenario (tie-aware MAP/MRR/
+    top-k), emit the matrix artifact, and persist the auto-selected
+    formula/kernel/pad policy as policy.json next to the warmup
+    manifest — restarted serve/stream/table/run processes inherit it."""
+    from ..scenarios import FAMILIES, default_matrix, render_table, run_matrix
+    from ..utils.logging import get_logger
+
+    log = get_logger("microrank_tpu.cli")
+    cfg = _config_from_args(args)
+    specs = default_matrix(args.seed, full=args.full)
+    if args.families:
+        wanted = {f.strip() for f in args.families.split(",") if f.strip()}
+        unknown = wanted - set(FAMILIES)
+        if unknown:
+            log.error(
+                "unknown families %s; available: %s",
+                sorted(unknown), ", ".join(FAMILIES),
+            )
+            return 2
+        specs = [s for s in specs if s.family in wanted]
+    if not specs:
+        log.error("no scenarios selected")
+        return 2
+    log.info(
+        "scenario matrix: %d scenarios over %d families (seed %d)",
+        len(specs), len({s.family for s in specs}), args.seed,
+    )
+    artifact = run_matrix(
+        cfg,
+        specs=specs,
+        out_dir=args.output,
+        seed=args.seed,
+        stream_lane=not args.no_stream_lane,
+        tune=not args.no_tune,
+        persist_policy=not args.no_persist_policy,
+    )
+    print(render_table(artifact), end="")
+    if args.json:
+        Path(args.json).write_text(json.dumps(artifact, indent=2))
+    errors = [
+        r["scenario"]
+        for r in artifact["scenarios"]
+        if r["truth"] and not r["formulas"]
+    ]
+    if errors:
+        log.error(
+            "scenarios with injected faults but no scored windows: %s",
+            errors,
+        )
+        return 1
     return 0
 
 
@@ -1429,6 +1501,22 @@ def main(argv=None) -> int:
     p_stream.add_argument("--kinds", type=int, default=24)
     p_stream.add_argument("--traces", type=int, default=300)
     p_stream.add_argument("--fault-ms", type=float, default=2000.0)
+    p_stream.add_argument(
+        "--fault-kind", choices=["latency", "error"], default="latency",
+        help="synthetic: injected fault family — latency (own time "
+        "jumps) or error (status-code fault, fail-fast; only the "
+        "error-status detector path sees it)",
+    )
+    p_stream.add_argument(
+        "--fault-count", type=_positive_int, default=1,
+        help="synthetic: simultaneous culprits per faulted window "
+        "(ground truth carries the full set)",
+    )
+    p_stream.add_argument(
+        "--drift", type=float, default=0.0,
+        help="synthetic: per-window multiplicative own-time growth "
+        "(gradual SLO drift the online baseline must absorb)",
+    )
     p_stream.add_argument("--seed", type=int, default=0)
     p_stream.add_argument(
         "--metrics-port", type=int, default=None,
@@ -1520,6 +1608,52 @@ def main(argv=None) -> int:
         help="also write the selected bundle JSON to this path",
     )
     p_exp.set_defaults(fn=cmd_explain)
+
+    p_scn = sub.add_parser(
+        "scenarios",
+        help="run the scenario matrix (every fault family x all 13 "
+        "spectrum formulas) through the real pipelines, emit the "
+        "per-scenario MAP/top-k artifact, and persist the auto-"
+        "selected formula/kernel/pad policy for restarts to inherit",
+    )
+    p_scn.add_argument(
+        "-o", "--output", default="scenario_out",
+        help="artifact directory: scenario_matrix.json + per-scenario "
+        "stream-lane run dirs (journal, incidents)",
+    )
+    p_scn.add_argument(
+        "--seed", type=int, default=0,
+        help="ONE seed reproduces the whole matrix byte-for-byte",
+    )
+    p_scn.add_argument(
+        "--full", action="store_true",
+        help="two specs per family (harder variants) instead of one",
+    )
+    p_scn.add_argument(
+        "--families", default=None, metavar="F1,F2,...",
+        help="restrict to these families (latency, error, multi, "
+        "cascade, cold_start, drift)",
+    )
+    p_scn.add_argument(
+        "--no-stream-lane", action="store_true",
+        help="skip the streaming-engine lane (batch scoring only; "
+        "cold-start and drift evidence comes from the stream lane)",
+    )
+    p_scn.add_argument(
+        "--no-tune", action="store_true",
+        help="skip the kernel/pad-policy timing sweep (the persisted "
+        "policy keeps built-in kernel/pad defaults)",
+    )
+    p_scn.add_argument(
+        "--no-persist-policy", action="store_true",
+        help="emit the matrix artifact but do not write policy.json",
+    )
+    p_scn.add_argument(
+        "--json", default=None,
+        help="also write the full matrix artifact JSON here",
+    )
+    _add_config_flags(p_scn)
+    p_scn.set_defaults(fn=cmd_scenarios)
 
     p_synth = sub.add_parser("synth", help="generate a synthetic chaos case")
     p_synth.add_argument("-o", "--output", required=True)
@@ -1629,7 +1763,9 @@ def main(argv=None) -> int:
     add_lint_parser(sub)
 
     args = parser.parse_args(argv)
-    if args.fn in (cmd_run, cmd_eval, cmd_serve, cmd_stream):  # jax-touching only
+    if args.fn in (
+        cmd_run, cmd_eval, cmd_serve, cmd_stream, cmd_scenarios,
+    ):  # jax-touching only
         _enable_jit_cache()
     return args.fn(args)
 

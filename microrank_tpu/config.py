@@ -37,6 +37,13 @@ class DetectorConfig:
     # any percentile "pNN" — e.g. "p90" (the alternative the reference left
     # commented out at preprocess_data.py:72), "p99", "p99.9".
     slo_stat: str = "mean"
+    # Error/status-code faults: a trace carrying a span with
+    # ``statusCode > 0`` (when the optional column is present) is
+    # classified abnormal regardless of latency — error faults fail
+    # FAST, so the latency deviation check alone is blind to them. The
+    # error bit feeds the same partition the spectrum ranks over; span
+    # frames without the column behave exactly as before.
+    error_status_abnormal: bool = True
 
     @classmethod
     def single_trace_variant(cls) -> "DetectorConfig":
@@ -297,6 +304,15 @@ class RuntimeConfig:
     # (round 3: 5 MB staged in 1,675 ms of pure latency). The sharded
     # path ignores this (shards need per-device placement).
     blob_staging: bool = True
+    # Tuned-policy consultation (scenarios/ subsystem): "auto" (default)
+    # resolves spectrum method / kernel / pad_policy from the persisted
+    # policy.json (written by `cli scenarios` next to the warmup
+    # manifest) for any of those fields still at its built-in default —
+    # explicit config always wins; "off" never consults (pins the
+    # built-in defaults even when a policy file exists). Stale policies
+    # (schema/profile mismatch) are rejected whole and counted in
+    # microrank_policy_events_total{outcome="rejected"}.
+    tuned_policy: str = "auto"     # "auto" | "off"
     # Persistent XLA compilation cache directory (jax_compilation_cache_dir).
     # None resolves MICRORANK_JIT_CACHE, else ~/.cache/microrank_tpu/jit —
     # the CLI default since PR 5. First-call compile of the fused rank

@@ -2,15 +2,35 @@ from .detector import DetectResult, detect_jax, detect_numpy
 from .slo import compute_slo, slo_as_dict
 
 
+def error_trace_ids(window_df) -> frozenset:
+    """Traces carrying an error-status span (``statusCode > 0``).
+
+    The column is optional — span frames without it (every pre-existing
+    dump and the native lane) return the empty set, so the latency-only
+    behavior is unchanged. Non-numeric status values count as OK.
+    """
+    if "statusCode" not in window_df.columns:
+        return frozenset()
+    import pandas as pd
+
+    status = pd.to_numeric(
+        window_df["statusCode"], errors="coerce"
+    ).fillna(0)
+    return frozenset(window_df.loc[status > 0, "traceID"].unique())
+
+
 def detect_partition(config, slo_vocab, baseline, window_df):
     """Detect + partition one window frame: returns
     ``(flag, normal_ids, abnormal_ids)``.
 
-    The shared twin of ``OnlineRCA.detect_window`` used by every
-    non-batch path (serve request handling, the streaming engine):
-    valid traces split into abnormal (exceeded expected duration) and
+    The ONE detection seam shared by the batch runner
+    (``OnlineRCA.detect_window``), serve request handling, and the
+    streaming engine: valid traces split into abnormal (exceeded
+    expected duration, or — with ``DetectorConfig.
+    error_status_abnormal`` — carrying an error-status span) and
     normal; invalid (non-positive duration) traces drop, matching the
-    reference's edge semantics.
+    reference's edge semantics. The window flags anomalous once the
+    abnormal partition reaches ``min_abnormal_traces``.
     """
     from ..graph import build_detect_batch
     from ..utils.guards import contract_checks
@@ -18,13 +38,21 @@ def detect_partition(config, slo_vocab, baseline, window_df):
     with contract_checks(config.runtime.validate_numerics):
         batch, trace_ids = build_detect_batch(window_df, slo_vocab)
     res = detect_numpy(batch, baseline, config.detector)
-    abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
-    nrm = [
-        t
-        for t, a, v in zip(trace_ids, res.abnormal, res.valid)
-        if v and not a
-    ]
-    return bool(res.flag), nrm, abn
+    err = (
+        error_trace_ids(window_df)
+        if config.detector.error_status_abnormal
+        else frozenset()
+    )
+    nrm, abn = [], []
+    for t, a, v in zip(trace_ids, res.abnormal, res.valid):
+        if not v:
+            continue
+        if a or t in err:
+            abn.append(t)
+        else:
+            nrm.append(t)
+    flag = len(abn) >= config.detector.min_abnormal_traces
+    return bool(flag), nrm, abn
 
 
 __all__ = [
@@ -32,6 +60,7 @@ __all__ = [
     "detect_jax",
     "detect_numpy",
     "detect_partition",
+    "error_trace_ids",
     "compute_slo",
     "slo_as_dict",
 ]

@@ -21,13 +21,119 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .config import MicroRankConfig, SpectrumConfig
-from .detect import compute_slo, detect_numpy
+from .detect import compute_slo, detect_numpy, detect_partition
 from .graph import build_detect_batch
 from .rank_backends import get_backend
 from .testing import SyntheticConfig, generate_case
 from .utils.logging import get_logger
 
 log = get_logger("microrank_tpu.evaluation")
+
+# ---------------------------------------------------------------------------
+# Shared tie-aware ranking metrics
+#
+# Every ranked-list score in the repo (this module's R@k/Exam harness,
+# bench.py's fault-hit checks, the scenario matrix) goes through these
+# helpers, and every tie rule is the ONE comparator
+# ``utils.ranking_compare.scores_tied`` — two suspects whose scores
+# agree within rounding share the MINIMUM rank of their tie group (the
+# tie-expanded-top-k convention the incident fingerprints and the
+# oracle parity gates already use).
+
+#: Tie tolerance for device-produced score lists. Tighter than the
+#: cross-path parity gates' 1e-3 (those compare DIFFERENT compute
+#: paths); within one fetched ranking only genuine float ties should
+#: collapse.
+DEFAULT_TIE_RTOL = 1e-6
+
+
+def tie_aware_ranks(
+    names, scores, rtol: float = DEFAULT_TIE_RTOL
+) -> Dict[str, int]:
+    """1-based tie-aware rank per name over one DESCENDING ranked list:
+    members of a tie group (scores tied to the group head within
+    ``rtol`` — head-anchored, so chained near-ties cannot drift a group
+    downhill) all take the group's first position."""
+    from .utils.ranking_compare import scores_tied
+
+    ranks: Dict[str, int] = {}
+    head = None
+    group_rank = 1
+    for i, (name, score) in enumerate(zip(names, scores)):
+        s = float(score)
+        if head is None or not scores_tied(s, head, rtol):
+            group_rank = i + 1
+            head = s
+        ranks.setdefault(str(name), group_rank)
+    return ranks
+
+
+def rank_of_culprit(
+    names, scores, culprit: str, rtol: float = DEFAULT_TIE_RTOL
+) -> Optional[int]:
+    """Tie-aware 1-based rank of ``culprit`` (None when unranked)."""
+    return tie_aware_ranks(names, scores, rtol).get(str(culprit))
+
+
+def topk_exact(
+    names, scores, truth, k: int, rtol: float = DEFAULT_TIE_RTOL
+) -> bool:
+    """True when EVERY true culprit sits inside the tie-expanded top-k
+    (tie-aware rank <= k). The multi-fault generalization of "fault
+    top-1": with 2 culprits, top-2 exact means both are there."""
+    truth = [str(t) for t in truth]
+    if not truth:
+        return False
+    ranks = tie_aware_ranks(names, scores, rtol)
+    return all(t in ranks and ranks[t] <= k for t in truth)
+
+
+def reciprocal_rank(
+    names, scores, truth, rtol: float = DEFAULT_TIE_RTOL
+) -> float:
+    """1 / best tie-aware rank over the culprit set (0.0 = none ranked)."""
+    ranks = tie_aware_ranks(names, scores, rtol)
+    found = [ranks[str(t)] for t in truth if str(t) in ranks]
+    return 1.0 / min(found) if found else 0.0
+
+
+def average_precision(
+    names, scores, truth, rtol: float = DEFAULT_TIE_RTOL
+) -> float:
+    """AP of one ranked list against the culprit set, tie-aware: the
+    i-th found culprit (ascending tie-aware rank r_i) contributes
+    precision i / r_i; unranked culprits contribute 0; the mean runs
+    over ALL |truth| culprits."""
+    truth = [str(t) for t in truth]
+    if not truth:
+        return float("nan")
+    ranks = tie_aware_ranks(names, scores, rtol)
+    found = sorted(ranks[t] for t in truth if t in ranks)
+    total = sum((i + 1) / r for i, r in enumerate(found))
+    return total / len(truth)
+
+
+def ranking_metrics(
+    names,
+    scores,
+    truth,
+    ks: Tuple[int, ...] = (1, 3, 5),
+    rtol: float = DEFAULT_TIE_RTOL,
+) -> Dict[str, object]:
+    """The full per-window scorecard of one ranked list vs the true
+    culprit SET: AP, reciprocal rank, tie-aware rank per culprit, and
+    tie-expanded top-k exactness per k."""
+    truth = [str(t) for t in truth]
+    ranks = tie_aware_ranks(names, scores, rtol)
+    return {
+        "ap": average_precision(names, scores, truth, rtol),
+        "rr": reciprocal_rank(names, scores, truth, rtol),
+        "ranks": {t: ranks.get(t) for t in truth},
+        "topk_exact": {
+            int(k): topk_exact(names, scores, truth, int(k), rtol)
+            for k in ks
+        },
+    }
 
 
 @dataclass(frozen=True)
@@ -115,19 +221,17 @@ def _case_config(eval_cfg: EvalConfig, seed: int) -> SyntheticConfig:
 
 
 def _detect_partition(case, config: MicroRankConfig):
-    """Shared detection + partitioning front half of every eval case.
+    """Shared detection + partitioning front half of every eval case
+    (the production seam — ``detect.detect_partition`` — so error-
+    status faults classify here exactly as they do on the serve/stream
+    paths).
 
     Returns (ok, nrm, abn) with the compat partition swap applied."""
     vocab, baseline = compute_slo(case.normal)
-    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
-    det = detect_numpy(batch, baseline, config.detector)
-    abn = [t for t, a in zip(trace_ids, det.abnormal) if a]
-    nrm = [
-        t
-        for t, a, v in zip(trace_ids, det.abnormal, det.valid)
-        if v and not a
-    ]
-    ok = bool(det.flag) and bool(nrm) and bool(abn)
+    flag, nrm, abn = detect_partition(
+        config, vocab, baseline, case.abnormal
+    )
+    ok = bool(flag) and bool(nrm) and bool(abn)
     if ok and config.compat.partition_swap:
         nrm, abn = abn, nrm
     return ok, nrm, abn

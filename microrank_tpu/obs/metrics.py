@@ -433,6 +433,19 @@ def checkpoint_events() -> Counter:
     )
 
 
+def policy_events() -> Counter:
+    return get_registry().counter(
+        "microrank_policy_events_total",
+        "Tuned-policy resolutions (scenarios.policy): applied when a "
+        "persisted policy.json supplied at least one field, override "
+        "when explicit config won every tuned field, default when no "
+        "policy file exists, rejected when a stale/mismatched policy "
+        "was refused WHOLE (cold start on built-in defaults), disabled "
+        "under tuned_policy=off; one sample per lane startup",
+        labelnames=("lane", "outcome"),
+    )
+
+
 def fleet_heartbeats() -> Counter:
     return get_registry().counter(
         "microrank_fleet_heartbeats_total",
@@ -524,6 +537,7 @@ def ensure_catalog() -> None:
         mrsan_lockset_checks,
         retry_attempts, retry_exhausted, breaker_state,
         fault_injections, webhook_dropped, checkpoint_events,
+        policy_events,
         fleet_heartbeats, fleet_reports, fleet_workers_gauge,
         fleet_reassignments, fleet_sealed_windows, fleet_host_spans_rate,
         host_load_gauge, host_steal_gauge,
@@ -651,6 +665,10 @@ def record_webhook_dropped(n: int = 1) -> None:
 
 def record_checkpoint(event: str) -> None:
     checkpoint_events().inc(event=event)
+
+
+def record_policy_event(outcome: str, lane: str) -> None:
+    policy_events().inc(lane=lane, outcome=outcome)
 
 
 def record_fleet_heartbeat(host: str) -> None:

@@ -23,8 +23,7 @@ from typing import List, Optional
 import pandas as pd
 
 from ..config import MicroRankConfig
-from ..detect import compute_slo, detect_numpy
-from ..graph import build_detect_batch
+from ..detect import compute_slo
 from ..io.loader import window_spans
 from ..obs.metrics import record_window_outcome
 from ..rank_backends import get_backend
@@ -41,11 +40,22 @@ class OnlineRCA:
         self.log = get_logger("microrank_tpu.pipeline")
         self.slo_vocab = None
         self.baseline = None
+        self.policy_resolution = None   # set by fit_baseline
 
     # ------------------------------------------------------------------ SLO
     def fit_baseline(self, normal_df: pd.DataFrame, cache_path=None) -> None:
         """Compute (or load) the SLO baseline from a normal-period dump
-        (reference: online_rca.py:251-253)."""
+        (reference: online_rca.py:251-253). Also the tuned-policy
+        resolution point (the shared lane seam): the normal dump is the
+        workload-profile witness, and the backend re-resolves so a
+        policy-supplied spectrum method/kernel reaches the programs."""
+        from ..scenarios.policy import apply_tuned_policy
+
+        self.config, self.policy_resolution = apply_tuned_policy(
+            self.config, lane="run", profile_frame=normal_df
+        )
+        if self.policy_resolution.outcome == "applied":
+            self.backend = get_backend(self.config)
         if cache_path is not None and Path(cache_path).exists():
             self.slo_vocab, self.baseline = load_slo(cache_path)
             self.log.info(
@@ -63,23 +73,17 @@ class OnlineRCA:
 
     # --------------------------------------------------------------- detect
     def detect_window(self, window_df: pd.DataFrame):
-        """Detect + partition one window; returns (flag, normal, abnormal)."""
+        """Detect + partition one window; returns (flag, normal,
+        abnormal) via the shared seam (``detect.detect_partition`` —
+        the same latency + error-status classification serve and the
+        streaming engine run)."""
         if self.baseline is None:
             raise RuntimeError("call fit_baseline() before detection")
-        from ..utils.guards import contract_checks
+        from ..detect import detect_partition
 
-        # validate_numerics arms the DetectBatch layout contract the
-        # same way it arms the rank-seam contracts.
-        with contract_checks(self.config.runtime.validate_numerics):
-            batch, trace_ids = build_detect_batch(window_df, self.slo_vocab)
-        res = detect_numpy(batch, self.baseline, self.config.detector)
-        abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
-        nrm = [
-            t
-            for t, a, v in zip(trace_ids, res.abnormal, res.valid)
-            if v and not a
-        ]
-        return bool(res.flag), nrm, abn
+        return detect_partition(
+            self.config, self.slo_vocab, self.baseline, window_df
+        )
 
     # ------------------------------------------------------------------ run
     def run(

@@ -51,6 +51,7 @@ class TableRCA:
         validate_tiebreak(config.spectrum)
         self.slo_vocab = None
         self.baseline = None
+        self.policy_resolution = None   # set by fit_baseline
         self._thresh = None       # mu + k*sigma f32, set by fit_baseline
         self._remap_cache = None  # (id(table), svc-op -> SLO vocab remap)
         self._mesh = None
@@ -96,9 +97,23 @@ class TableRCA:
 
     def fit_baseline(self, normal_table) -> None:
         from ..detect.detector import _thresholds
+        from ..scenarios.policy import apply_tuned_policy
 
         self.slo_vocab, self.baseline = compute_slo_from_table(
             normal_table, stat=self.config.detector.slo_stat
+        )
+        # Tuned-policy resolution (the shared lane seam). The native
+        # table exposes span count and the fitted vocab gives op
+        # cardinality; trace-kind dedup is not cheaply measurable here,
+        # so the profile takes the conservative "low" dedup bucket.
+        self.config, self.policy_resolution = apply_tuned_policy(
+            self.config,
+            lane="table",
+            counts=(
+                int(getattr(normal_table, "n_spans", 0) or 0),
+                len(self.slo_vocab),
+                None,
+            ),
         )
         self._thresh = _thresholds(self.baseline, self.config.detector)
         self._remap_cache = None

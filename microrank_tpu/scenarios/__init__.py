@@ -1,0 +1,72 @@
+"""Scenario matrix + self-tuning policy engine (ISSUE 13).
+
+Three cooperating layers:
+
+* **spec/generate** — declarative ``ScenarioSpec`` (fault family,
+  intensity, topology, timing) compiled into byte-reproducible span
+  workloads through the seeded synthetic path; six families cover
+  latency, error/status-code, multi-culprit, cascading backpressure,
+  fault-during-cold-start, and baseline drift.
+* **harness** — every scenario runs the real batch + streaming
+  pipelines; all 13 spectrum formulas score per scenario with
+  tie-aware MAP/MRR/top-k exactness, joined with the explain
+  subsystem's attribution terms; the matrix artifact lands as
+  ``scenario_matrix.json`` (``cli scenarios`` renders the table).
+* **policy** — matrix results auto-select formula/kernel/pad-policy
+  per workload profile, persisted atomically as ``policy.json`` next
+  to the warmup manifest; serve, stream and the table lane consult it
+  through ONE resolver seam with explicit config overrides winning
+  and stale policies rejected whole.
+"""
+
+from .generate import (
+    ScenarioWorkload,
+    generate_scenario,
+    workload_digest,
+)
+from .harness import (
+    MATRIX_NAME,
+    render_table,
+    run_matrix,
+    run_scenario,
+    time_policy_candidates,
+)
+from .policy import (
+    POLICY_NAME,
+    PolicyResolution,
+    WorkloadProfile,
+    apply_tuned_policy,
+    load_policy,
+    profile_from_counts,
+    profile_from_frame,
+    resolve_policy,
+    resolve_policy_dir,
+    save_policy,
+    select_policy,
+)
+from .spec import FAMILIES, ScenarioSpec, default_matrix
+
+__all__ = [
+    "FAMILIES",
+    "MATRIX_NAME",
+    "POLICY_NAME",
+    "PolicyResolution",
+    "ScenarioSpec",
+    "ScenarioWorkload",
+    "WorkloadProfile",
+    "apply_tuned_policy",
+    "default_matrix",
+    "generate_scenario",
+    "load_policy",
+    "profile_from_counts",
+    "profile_from_frame",
+    "render_table",
+    "resolve_policy",
+    "resolve_policy_dir",
+    "run_matrix",
+    "run_scenario",
+    "save_policy",
+    "select_policy",
+    "time_policy_candidates",
+    "workload_digest",
+]

@@ -1,0 +1,72 @@
+"""Scenario compilation: spec -> reproducible span workload.
+
+One function of one spec: the seeded synthetic path
+(``testing.synthetic.generate_timeline``) renders the timeline, so the
+same spec always yields a byte-identical span stream — the determinism
+the regression net needs (and a test pins via :func:`workload_digest`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List
+
+import pandas as pd
+
+from .spec import ScenarioSpec
+
+
+@dataclass
+class ScenarioWorkload:
+    """A compiled scenario: span frames + ground truth."""
+
+    spec: ScenarioSpec
+    normal: pd.DataFrame              # baseline-seed window
+    timeline: pd.DataFrame            # n_windows consecutive windows
+    window_faulted: List[bool]
+    start: pd.Timestamp
+    # Ground truth: the FULL culprit set (instance-level vocab names);
+    # empty for the drift family (success there is NOT alarming).
+    truth: List[str] = field(default_factory=list)
+
+    @property
+    def n_spans(self) -> int:
+        return len(self.timeline)
+
+    def window_frame(self, i: int) -> pd.DataFrame:
+        """Window i's spans, by the pipeline's own window predicate."""
+        from ..io.loader import window_spans
+
+        w0 = self.start + pd.Timedelta(
+            minutes=i * self.spec.window_minutes
+        )
+        w1 = w0 + pd.Timedelta(minutes=self.spec.window_minutes)
+        return window_spans(self.timeline, w0, w1)
+
+
+def generate_scenario(spec: ScenarioSpec) -> ScenarioWorkload:
+    """Compile one spec into its workload (pure function of the spec)."""
+    from ..testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        spec.synth_config(), spec.n_windows, list(spec.faulted)
+    )
+    truth = list(tl.fault_pod_ops) if spec.faulted else []
+    return ScenarioWorkload(
+        spec=spec,
+        normal=tl.normal,
+        timeline=tl.timeline,
+        window_faulted=tl.window_faulted,
+        start=tl.start,
+        truth=truth,
+    )
+
+
+def workload_digest(workload: ScenarioWorkload) -> str:
+    """sha256 over the canonical CSV bytes of normal + timeline — the
+    determinism witness (same seed => same digest, byte for byte)."""
+    h = hashlib.sha256()
+    h.update(workload.normal.to_csv(index=False).encode())
+    h.update(workload.timeline.to_csv(index=False).encode())
+    return h.hexdigest()

@@ -1,0 +1,608 @@
+"""The scenario evaluation harness: every family x all 13 formulas.
+
+Each scenario runs through the REAL pipeline twice:
+
+* **batch lane** — the ``cli run`` seam: SLO baseline from the normal
+  window (``detect.compute_slo``), the shared detect+partition seam on
+  every timeline window, and ONE all-formulas device dispatch per
+  abnormal window (``JaxBackend.rank_window_all_methods`` — power
+  iterations are method-independent, so 13 rankings cost one program).
+  Every faulted window scores every formula with the shared tie-aware
+  metrics (``evaluation.ranking_metrics``: MAP/MRR/top-k exactness/
+  rank-of-true-culprit against the full culprit SET).
+
+* **stream lane** — the ``cli stream`` engine end to end: event-time
+  windower, ONLINE baselines (seeded or cold-starting, per the spec),
+  anomaly-gated dispatch and the incident lifecycle. This is where the
+  cold-start and drift families actually mean something: a fault
+  burning before the baseline armed, and a gradual SLO shift that must
+  retrain rather than alarm.
+
+The per-scenario records join the explain subsystem's attribution
+terms (ef/nf/ep/np counters, PPR mass split, per-formula term values
+for each true culprit — one explained dispatch on the first ranked
+faulted window) as diagnostic features, land in the matrix artifact
+(``scenario_matrix.json``), and feed :func:`scenarios.policy.
+select_policy`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..config import MicroRankConfig, SpectrumConfig
+from ..utils.logging import get_logger
+from .generate import ScenarioWorkload, generate_scenario, workload_digest
+from .policy import (
+    profile_from_frame,
+    select_policy,
+)
+from .spec import FAMILIES, ScenarioSpec, default_matrix
+
+log = get_logger("microrank_tpu.scenarios")
+
+MATRIX_NAME = "scenario_matrix.json"
+MATRIX_SCHEMA = 1
+
+#: (kernel, pad_policy) candidates the optional tuning sweep times.
+DEFAULT_TUNE_CANDIDATES: Tuple[Tuple[str, str], ...] = (
+    ("packed", "pow2q"),
+    ("pcsr", "pow2q"),
+)
+
+
+def _widen(config: MicroRankConfig, spec: ScenarioSpec) -> MicroRankConfig:
+    """Full-depth rankings so rank-of-culprit is exact (the evaluation
+    harness's own convention)."""
+    return config.replace(
+        spectrum=SpectrumConfig(
+            method=config.spectrum.method,
+            top_max=spec.n_operations * max(1, spec.n_pods),
+            extra_rows=config.spectrum.extra_rows,
+            eps=config.spectrum.eps,
+        )
+    )
+
+
+def _rank_all_methods(config, backend, frame, nrm, abn):
+    """{method: (names, scores)} — one fused dispatch on the jax
+    backend, a per-method loop on the oracle."""
+    if hasattr(backend, "rank_window_all_methods"):
+        return backend.rank_window_all_methods(frame, nrm, abn)
+    from ..rank_backends import get_backend
+    from ..spectrum.formulas import METHODS
+
+    out = {}
+    for m in METHODS:
+        mcfg = config.replace(
+            spectrum=dataclasses.replace(config.spectrum, method=m)
+        )
+        out[m] = get_backend(mcfg).rank_window(frame, nrm, abn)
+    return out
+
+
+def _attribution_features(
+    config: MicroRankConfig, frame, nrm, abn, truth: Sequence[str]
+) -> Optional[dict]:
+    """One explained dispatch; returns {culprit: {counters, mass,
+    terms, rank}} for every true culprit the explain epilogue surfaced
+    — PR 8's per-formula attribution joined as diagnostic features."""
+    import jax
+
+    from ..config import ExplainConfig
+    from ..explain import build_bundle
+    from ..rank_backends.blob import stage_rank_window
+    from ..rank_backends.jax_tpu import prepare_window_graph_explained
+
+    ex = ExplainConfig(enabled=True, top_traces=3)
+    graph, op_names, kernel, ectx = prepare_window_graph_explained(
+        frame, nrm, abn, config
+    )
+    outs = jax.device_get(
+        stage_rank_window(
+            graph,
+            config.pagerank,
+            config.spectrum,
+            kernel,
+            config.runtime.blob_staging,
+            explain=ex,
+        )
+    )
+    bundle = build_bundle(
+        outs, op_names, ectx,
+        method=config.spectrum.method, kernel=kernel,
+        trigger="scenario",
+    )
+    features = {}
+    for s in bundle.suspects:
+        if s["op"] in truth:
+            features[s["op"]] = {
+                "rank": s["rank"],
+                "score": s["score"],
+                "counters": s["counters"],
+                "mass": s["mass"],
+                "terms": s["terms"],
+            }
+    return features or None
+
+
+def _stream_lane(
+    config: MicroRankConfig,
+    wl: ScenarioWorkload,
+    out_dir: Optional[Path],
+    ks: Sequence[int],
+) -> dict:
+    """Run the workload through the real streaming engine."""
+    import numpy as np
+
+    from ..evaluation import topk_exact
+    from ..stream import ReplaySource, StreamEngine
+
+    spec = wl.spec
+    scfg = dataclasses.replace(
+        config.stream,
+        window_minutes=spec.window_minutes,
+        slide_minutes=None,
+        allowed_lateness_seconds=5.0,
+        checkpoint=False,
+        max_windows=0,
+    )
+    # The harness measures the config under test; a previously persisted
+    # policy must not contaminate the matrix that will REPLACE it.
+    rcfg = dataclasses.replace(config.runtime, tuned_policy="off")
+    cfg = config.replace(stream=scfg, runtime=rcfg)
+    source = ReplaySource(wl.timeline, chunk_spans=4000)
+    engine = StreamEngine(
+        cfg,
+        source,
+        out_dir=str(out_dir) if out_dir is not None else None,
+        normal_df=wl.normal if spec.seed_baseline else None,
+    )
+    seeded_mean = None
+    if engine.baseline.seeded:
+        _, slo0 = engine.baseline.snapshot()
+        seeded_mean = float(np.mean(slo0.mean_ms)) if len(
+            slo0.mean_ms
+        ) else None
+    summary = engine.run()
+    # Baseline-retrain evidence (the drift family's success metric):
+    # how far the online SLO center moved over the run.
+    baseline_shift = None
+    if engine.baseline.ready:
+        _, slo1 = engine.baseline.snapshot()
+        if seeded_mean and len(slo1.mean_ms):
+            baseline_shift = round(
+                float(np.mean(slo1.mean_ms)) / seeded_mean, 4
+            )
+    hits = 0
+    ranked_faulted = 0
+    for i, r in enumerate(summary.results):
+        if not r.ranking:
+            continue
+        # Event-time window index relative to the timeline start.
+        widx = None
+        try:
+            import pandas as pd
+
+            widx = int(
+                (pd.Timestamp(r.start) - wl.start).total_seconds()
+                // (spec.window_minutes * 60)
+            )
+        except (ValueError, TypeError):
+            pass
+        if (
+            widx is not None
+            and 0 <= widx < len(wl.window_faulted)
+            and wl.window_faulted[widx]
+            and wl.truth
+        ):
+            ranked_faulted += 1
+            names = [n for n, _ in r.ranking]
+            scores = [s for _, s in r.ranking]
+            hits += topk_exact(
+                names, scores, wl.truth, k=max(1, len(wl.truth))
+            )
+    return {
+        "windows": summary.windows,
+        "ranked": summary.ranked,
+        "dispatches": summary.dispatches,
+        "warmup": summary.warmup,
+        "incidents_opened": summary.incidents_opened,
+        "incidents_resolved": summary.incidents_resolved,
+        "ranked_faulted": ranked_faulted,
+        "topc_hits": int(hits),
+        "baseline_shift": baseline_shift,
+        "seeded": bool(spec.seed_baseline),
+    }
+
+
+def run_scenario(
+    config: MicroRankConfig,
+    spec: ScenarioSpec,
+    out_dir=None,
+    stream_lane: bool = True,
+    ks: Sequence[int] = (1, 3, 5),
+) -> dict:
+    """Run + score one scenario; returns the matrix record."""
+    from ..detect import compute_slo, detect_partition
+    from ..evaluation import ranking_metrics
+    from ..rank_backends import get_backend
+    from ..spectrum.formulas import METHODS
+
+    t0 = time.monotonic()
+    wl = generate_scenario(spec)
+    cfg = _widen(config, spec)
+    backend = get_backend(cfg)
+    vocab, slo = compute_slo(wl.normal)
+
+    detection = {"tp": 0, "fp": 0, "fn": 0, "tn": 0}
+    per_method: Dict[str, List[dict]] = {m: [] for m in METHODS}
+    attribution = None
+    first_ranked = None  # (frame, nrm, abn) of the first faulted rank
+    for i in range(spec.n_windows):
+        frame = wl.window_frame(i)
+        truth_window = wl.window_faulted[i]
+        if len(frame) == 0:
+            detection["fn" if truth_window else "tn"] += 1
+            continue
+        flag, nrm, abn = detect_partition(cfg, vocab, slo, frame)
+        if flag and truth_window:
+            detection["tp"] += 1
+        elif flag:
+            detection["fp"] += 1
+        elif truth_window:
+            detection["fn"] += 1
+        else:
+            detection["tn"] += 1
+        if not (flag and nrm and abn and truth_window and wl.truth):
+            continue
+        ranked = _rank_all_methods(cfg, backend, frame, nrm, abn)
+        for m in METHODS:
+            names, scores = ranked[m]
+            per_method[m].append(
+                ranking_metrics(names, scores, wl.truth, ks=tuple(ks))
+            )
+        if first_ranked is None:
+            first_ranked = (frame, nrm, abn)
+
+    if first_ranked is not None:
+        try:
+            attribution = _attribution_features(
+                cfg, *first_ranked, truth=wl.truth
+            )
+        except Exception as exc:  # noqa: BLE001 - diagnostics only
+            log.warning(
+                "scenario %s: attribution join failed (%s)",
+                spec.name, exc,
+            )
+
+    formulas: Dict[str, dict] = {}
+    for m, rows in per_method.items():
+        if not rows:
+            continue
+        n = len(rows)
+        mean = lambda vals: sum(vals) / n  # noqa: E731
+        topk_rate = {
+            int(k): mean(
+                [float(r["topk_exact"][int(k)]) for r in rows]
+            )
+            for k in ks
+        }
+        found = [
+            r2
+            for r in rows
+            for r2 in r["ranks"].values()
+            if r2 is not None
+        ]
+        formulas[m] = {
+            "map": round(mean([r["ap"] for r in rows]), 4),
+            "mrr": round(mean([r["rr"] for r in rows]), 4),
+            "top1_rate": round(topk_rate.get(1, 0.0), 4),
+            "topc_rate": round(
+                mean(
+                    [
+                        float(
+                            all(
+                                r3 is not None
+                                and r3 <= max(1, len(wl.truth))
+                                for r3 in r["ranks"].values()
+                            )
+                        )
+                        for r in rows
+                    ]
+                ),
+                4,
+            ),
+            "topk_rate": topk_rate,
+            "mean_rank": (
+                round(sum(found) / len(found), 2) if found else None
+            ),
+            "unranked": sum(
+                1
+                for r in rows
+                for r2 in r["ranks"].values()
+                if r2 is None
+            ),
+            "windows": n,
+        }
+
+    record = {
+        "scenario": spec.name,
+        "family": spec.family,
+        "seed": spec.seed,
+        "spec": spec.to_dict(),
+        "digest": workload_digest(wl),
+        "profile": (
+            profile_from_frame(wl.normal).key()
+            if len(wl.normal)
+            else None
+        ),
+        "spans": int(wl.n_spans),
+        "truth": list(wl.truth),
+        "detection": detection,
+        "formulas": formulas,
+        "attribution": attribution,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    }
+    if stream_lane:
+        sdir = (
+            Path(out_dir) / "scenarios" / spec.name / "stream"
+            if out_dir is not None
+            else None
+        )
+        record["stream"] = _stream_lane(config, wl, sdir, ks)
+    return record
+
+
+# ------------------------------------------------------------ tuning sweep
+
+
+def time_policy_candidates(
+    config: MicroRankConfig,
+    wl: ScenarioWorkload,
+    candidates: Tuple[Tuple[str, str], ...] = DEFAULT_TUNE_CANDIDATES,
+) -> Optional[dict]:
+    """Time each (kernel, pad_policy) candidate on this workload's
+    first abnormal window (one warm + one timed dispatch each); the
+    fastest candidate whose ranking stays tie-aware-identical to the
+    first candidate's wins. Returns the timing record select_policy
+    persists, or None when no window partitions."""
+    import jax
+
+    from ..detect import compute_slo, detect_partition
+    from ..rank_backends.blob import stage_rank_window
+    from ..rank_backends.jax_tpu import prepare_window_graph
+    from ..utils.ranking_compare import tie_aware_topk_agreement
+
+    spec = wl.spec
+    vocab, slo = compute_slo(wl.normal)
+    picked = None
+    for i in range(spec.n_windows):
+        if not wl.window_faulted[i]:
+            continue
+        frame = wl.window_frame(i)
+        if len(frame) == 0:
+            continue
+        flag, nrm, abn = detect_partition(config, vocab, slo, frame)
+        if flag and nrm and abn:
+            picked = (frame, nrm, abn)
+            break
+    if picked is None:
+        return None
+    frame, nrm, abn = picked
+    results = {}
+    reference = None
+    for kernel, pad in candidates:
+        cfg = config.replace(
+            runtime=dataclasses.replace(
+                config.runtime, kernel=kernel, pad_policy=pad,
+                tuned_policy="off",
+            )
+        )
+        try:
+            graph, op_names, resolved = prepare_window_graph(
+                frame, nrm, abn, cfg
+            )
+
+            def _once():
+                return jax.device_get(
+                    stage_rank_window(
+                        graph,
+                        cfg.pagerank,
+                        cfg.spectrum,
+                        resolved,
+                        cfg.runtime.blob_staging,
+                    )
+                )
+
+            _once()  # warm (compile) pass
+            t0 = time.monotonic()
+            out = _once()
+            ms = (time.monotonic() - t0) * 1e3
+            ti, ts, nv = out[:3]
+            n = int(nv)
+            names = [op_names[int(j)] for j in ti[:n]]
+            scores = [float(s) for s in ts[:n]]
+            parity = True
+            if reference is None:
+                reference = (names, scores)
+            else:
+                parity, _ = tie_aware_topk_agreement(
+                    names, scores, reference[0], reference[1],
+                    k=min(5, len(names), len(reference[0])),
+                    rtol=1e-3, exempt_last=True,
+                )
+            results[f"{kernel}/{pad}"] = {
+                "kernel": kernel,
+                "pad_policy": pad,
+                "resolved_kernel": resolved,
+                "rank_ms": round(ms, 2),
+                "parity": bool(parity),
+            }
+        except Exception as exc:  # noqa: BLE001 - a candidate that
+            # cannot build/dispatch at this shape simply loses the sweep.
+            log.warning(
+                "tune candidate %s/%s failed (%s)", kernel, pad, exc
+            )
+    viable = [r for r in results.values() if r["parity"]]
+    if not viable:
+        return None
+    best = min(viable, key=lambda r: r["rank_ms"])
+    return {
+        "kernel": best["kernel"],
+        "pad_policy": best["pad_policy"],
+        "rank_ms": best["rank_ms"],
+        "candidates": results,
+    }
+
+
+# --------------------------------------------------------------- the matrix
+
+
+def run_matrix(
+    config: MicroRankConfig,
+    specs: Optional[List[ScenarioSpec]] = None,
+    out_dir=None,
+    seed: int = 0,
+    full: bool = False,
+    stream_lane: bool = True,
+    tune: bool = True,
+    persist_policy: bool = True,
+    cache_dir: Optional[str] = None,
+) -> dict:
+    """Run every scenario, score every formula, select + persist the
+    tuned policy. Returns the matrix artifact (also written to
+    ``out_dir/scenario_matrix.json``)."""
+    if specs is None:
+        specs = default_matrix(seed, full=full)
+    records = []
+    for spec in specs:
+        log.info("scenario %s (%s family)...", spec.name, spec.family)
+        records.append(
+            run_scenario(
+                config, spec, out_dir=out_dir, stream_lane=stream_lane
+            )
+        )
+
+    timings: Dict[str, dict] = {}
+    if tune:
+        for spec, rec in zip(specs, records):
+            prof = rec.get("profile")
+            if not prof or prof in timings or not rec.get("formulas"):
+                continue
+            timing = time_policy_candidates(
+                config, generate_scenario(spec)
+            )
+            if timing is not None:
+                timings[prof] = timing
+
+    policy = select_policy(records, timings, matrix_seed=seed)
+    artifact = {
+        "schema": MATRIX_SCHEMA,
+        "seed": seed,
+        "families": sorted({s.family for s in specs}),
+        "n_scenarios": len(records),
+        "scenarios": records,
+        "policy": policy,
+    }
+    if persist_policy and policy["profiles"]:
+        from .policy import resolve_policy_dir, save_policy
+
+        if cache_dir is None:
+            cache_dir = resolve_policy_dir(config.runtime)
+        ppath = save_policy(cache_dir, policy)
+        log.info("tuned policy persisted: %s", ppath)
+        artifact["policy_path"] = str(ppath)
+    if out_dir is not None:
+        from ..utils.atomic import atomic_write_json
+
+        path = Path(out_dir) / MATRIX_NAME
+        atomic_write_json(path, artifact)
+        log.info("matrix artifact: %s", path)
+    return artifact
+
+
+def render_table(artifact: dict) -> str:
+    """Human-readable matrix summary (the ``cli scenarios`` output)."""
+    lines = []
+    lines.append(
+        f"scenario matrix (seed {artifact.get('seed')}): "
+        f"{artifact.get('n_scenarios')} scenarios, "
+        f"{len(artifact.get('families', []))} families"
+    )
+    header = (
+        f"{'scenario':<24} {'family':<11} {'profile':<36} "
+        f"{'det tp/fp':<10} {'best formula':<14} {'MAP':>6} "
+        f"{'top-1':>6} {'stream':<14}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for rec in artifact.get("scenarios", []):
+        det = rec.get("detection", {})
+        formulas = rec.get("formulas") or {}
+        if formulas:
+            best_m = max(
+                sorted(formulas),
+                key=lambda m: (
+                    formulas[m]["map"],
+                    formulas[m]["top1_rate"],
+                ),
+            )
+            best = (
+                f"{best_m:<14} {formulas[best_m]['map']:>6.2f} "
+                f"{formulas[best_m]['top1_rate']:>6.2f}"
+            )
+        else:
+            best = f"{'-':<14} {'-':>6} {'-':>6}"
+        stream = rec.get("stream") or {}
+        stream_s = (
+            f"inc {stream.get('incidents_opened', '-')}"
+            f"/{stream.get('incidents_resolved', '-')}"
+            + (
+                f" hit {stream.get('topc_hits')}"
+                f"/{stream.get('ranked_faulted')}"
+                if stream.get("ranked_faulted")
+                else ""
+            )
+            if stream
+            else "-"
+        )
+        lines.append(
+            f"{rec['scenario']:<24} {rec['family']:<11} "
+            f"{(rec.get('profile') or '-'):<36} "
+            f"{det.get('tp', 0)}/{det.get('fp', 0):<8} "
+            f"{best} {stream_s:<14}"
+        )
+    prof = (artifact.get("policy") or {}).get("profiles", {})
+    if prof:
+        lines.append("")
+        lines.append("tuned policy (persisted as policy.json):")
+        for key, entry in sorted(prof.items()):
+            ev = entry.get("evidence", {})
+            lines.append(
+                f"  {key}: method={entry['method']} "
+                f"kernel={entry['kernel']} "
+                f"pad={entry['pad_policy']} "
+                f"(MAP {ev.get('map')}, {ev.get('scenarios')} scenarios"
+                + (
+                    f", {ev.get('rank_ms')} ms/rank"
+                    if ev.get("rank_ms") is not None
+                    else ""
+                )
+                + ")"
+            )
+    return "\n".join(lines) + "\n"
+
+
+__all__ = [
+    "DEFAULT_TUNE_CANDIDATES",
+    "FAMILIES",
+    "MATRIX_NAME",
+    "render_table",
+    "run_matrix",
+    "run_scenario",
+    "time_policy_candidates",
+]

@@ -1,0 +1,435 @@
+"""The self-tuning policy engine: matrix results -> persisted policy.
+
+RankMap's framing (PAPERS.md, arxiv 1503.08169): platform- and
+workload-aware tuning belongs in a *persisted policy*, not in hardcoded
+defaults. The scenario matrix measures which spectrum formula wins on
+which workload (and, optionally, which kernel/pad-policy is fastest
+there); :func:`select_policy` distills that into ``policy.json`` —
+written atomically next to the warmup manifest in the compile-cache
+directory, so a restarted serve/stream/table process inherits the
+tuned policy the same way it inherits its compiled programs.
+
+Resolution is ONE seam (:func:`apply_tuned_policy`) all three lanes
+call, with strict precedence:
+
+    explicit config  >  persisted policy  >  built-in default
+
+"Explicit" means the field differs from its built-in default — the
+operator asked for something; the policy never overrides an operator.
+(To pin the built-in default itself against a persisted policy, disable
+consultation: ``RuntimeConfig.tuned_policy="off"`` / CLI
+``--no-tuned-policy``.)
+
+Staleness: a ``policy.json`` whose schema version or profile-bucket
+schema differs from this build's — or which has no entry for the run's
+workload profile — is rejected WHOLE (the checkpoint whole-rejection
+rule from the chaos subsystem): the run cold-starts on built-in
+defaults and ``microrank_policy_events_total{outcome="rejected"}``
+counts it. A half-applied stale policy is worse than none.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..config import MicroRankConfig, RuntimeConfig, SpectrumConfig
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.scenarios.policy")
+
+POLICY_NAME = "policy.json"
+POLICY_VERSION = 1
+
+# Workload-profile bucket edges. Part of the policy file's identity:
+# a policy tuned under different edges is stale by definition.
+PROFILE_SCHEMA: Dict[str, object] = {
+    "version": 1,
+    # Spans per detection window.
+    "span_volume": [50_000, 2_000_000],        # small | medium | large
+    # Distinct (service, op) names.
+    "op_cardinality": [256, 4096],             # small | medium | large
+    # Trace-kind dedup factor (traces per distinct trace shape).
+    "dedup_factor": [8.0],                     # low | high
+}
+
+_SIZE_NAMES = ("small", "medium", "large")
+
+#: The tuned fields and their built-in defaults (the "explicit config"
+#: test compares against these).
+TUNED_DEFAULTS: Dict[str, str] = {
+    "method": SpectrumConfig().method,
+    "kernel": RuntimeConfig().kernel,
+    "pad_policy": RuntimeConfig().pad_policy,
+}
+
+
+def _bucket(value: float, edges) -> str:
+    for name, edge in zip(_SIZE_NAMES, edges):
+        if value < edge:
+            return name
+    return _SIZE_NAMES[len(edges)]
+
+
+@dataclass(frozen=True)
+class WorkloadProfile:
+    """A run's workload, bucketed — the policy lookup key."""
+
+    span_volume: str
+    op_cardinality: str
+    dedup: str
+
+    def key(self) -> str:
+        return (
+            f"spans={self.span_volume}|ops={self.op_cardinality}"
+            f"|dedup={self.dedup}"
+        )
+
+
+def profile_from_counts(
+    n_spans: int,
+    n_ops: int,
+    dedup_factor: Optional[float] = None,
+) -> WorkloadProfile:
+    """Profile from raw counts. ``dedup_factor=None`` (lanes that cannot
+    cheaply measure trace kinds, e.g. the native table lane) buckets as
+    "low" — the conservative bucket: no dedup assumed."""
+    return WorkloadProfile(
+        span_volume=_bucket(n_spans, PROFILE_SCHEMA["span_volume"]),
+        op_cardinality=_bucket(n_ops, PROFILE_SCHEMA["op_cardinality"]),
+        dedup=(
+            "high"
+            if dedup_factor is not None
+            and dedup_factor >= PROFILE_SCHEMA["dedup_factor"][0]
+            else "low"
+        ),
+    )
+
+
+def dedup_factor_from_frame(span_df, sample_traces: int = 2000) -> float:
+    """Traces per distinct trace shape (byte-signature kind grouping),
+    measured on a bounded trace sample — the same equivalence the
+    kind-collapse build exploits."""
+    ids = span_df["traceID"]
+    unique = ids.unique()
+    if len(unique) == 0:
+        return 1.0
+    if len(unique) > sample_traces:
+        sub = span_df[ids.isin(unique[:sample_traces])]
+    else:
+        sub = span_df
+    names = (
+        sub["serviceName"].astype(str)
+        + "_"
+        + sub["operationName"].astype(str)
+    )
+    sig = names.groupby(sub["traceID"].to_numpy()).apply(
+        lambda s: hash(tuple(sorted(s)))
+    )
+    return float(len(sig) / max(sig.nunique(), 1))
+
+
+def profile_from_frame(span_df) -> Optional[WorkloadProfile]:
+    """Profile one representative span frame (a normal-period window);
+    None for an empty/absent frame (no lookup key — defaults apply)."""
+    if span_df is None or len(span_df) == 0:
+        return None
+    n_ops = int(
+        (
+            span_df["serviceName"].astype(str)
+            + "_"
+            + span_df["operationName"].astype(str)
+        ).nunique()
+    )
+    return profile_from_counts(
+        n_spans=len(span_df),
+        n_ops=n_ops,
+        dedup_factor=dedup_factor_from_frame(span_df),
+    )
+
+
+# ------------------------------------------------------------- persistence
+
+
+def resolve_policy_dir(runtime=None) -> str:
+    """Directory holding ``policy.json``: ``MICRORANK_POLICY_DIR`` env
+    (hermetic tests / split deployments) over the compile-cache dir
+    (the default — the policy lives next to the warmup manifest, so a
+    restart inherits both through one mount)."""
+    import os
+
+    env = os.environ.get("MICRORANK_POLICY_DIR")
+    if env:
+        return env
+    from ..dispatch import resolve_cache_dir
+
+    return resolve_cache_dir(runtime)
+
+
+def policy_path(cache_dir) -> Path:
+    return Path(cache_dir) / POLICY_NAME
+
+
+def save_policy(cache_dir, data: dict) -> Path:
+    """Atomic + durable write next to the warmup manifest."""
+    from ..utils.atomic import atomic_write_json
+
+    return atomic_write_json(policy_path(cache_dir), data)
+
+
+def load_policy(
+    cache_dir,
+) -> Tuple[Optional[dict], Optional[str]]:
+    """(data, reject_reason): (None, None) when absent; (None, reason)
+    when present but stale/corrupt — rejected WHOLE; (data, None) when
+    valid for this build."""
+    path = policy_path(cache_dir) if cache_dir else None
+    if path is None or not path.exists():
+        return None, None
+    import json
+
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        return None, f"unreadable ({exc})"
+    if not isinstance(data, dict):
+        return None, "not a JSON object"
+    if data.get("version") != POLICY_VERSION:
+        return None, (
+            f"schema version {data.get('version')!r} != "
+            f"{POLICY_VERSION}"
+        )
+    if data.get("profile_schema") != PROFILE_SCHEMA:
+        return None, "profile-bucket schema mismatch"
+    profiles = data.get("profiles")
+    if not isinstance(profiles, dict):
+        return None, "missing profiles table"
+    return data, None
+
+
+# -------------------------------------------------------------- resolution
+
+
+@dataclass
+class PolicyResolution:
+    """What one lane's policy consultation decided (journal evidence)."""
+
+    lane: str
+    outcome: str                       # applied|override|default|rejected|disabled
+    profile: Optional[str] = None
+    reason: Optional[str] = None
+    policy_file: Optional[str] = None
+    # field -> {"value": ..., "source": "config"|"policy"|"default"}
+    fields: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    def journal(self) -> dict:
+        return {
+            "lane": self.lane,
+            "outcome": self.outcome,
+            "profile": self.profile,
+            "reason": self.reason,
+            "policy_file": self.policy_file,
+            **{
+                f"{name}": d["value"]
+                for name, d in self.fields.items()
+            },
+            **{
+                f"{name}_source": d["source"]
+                for name, d in self.fields.items()
+            },
+        }
+
+
+def _apply_fields(
+    config: MicroRankConfig, values: Dict[str, str]
+) -> MicroRankConfig:
+    return config.replace(
+        spectrum=dataclasses.replace(
+            config.spectrum, method=values["method"]
+        ),
+        runtime=dataclasses.replace(
+            config.runtime,
+            kernel=values["kernel"],
+            pad_policy=values["pad_policy"],
+        ),
+    )
+
+
+def resolve_policy(
+    config: MicroRankConfig,
+    profile: Optional[WorkloadProfile],
+    lane: str,
+    cache_dir: Optional[str] = None,
+) -> Tuple[MicroRankConfig, PolicyResolution]:
+    """The ONE resolver seam: serve, stream, and the table lane call
+    this (via :func:`apply_tuned_policy`) before their first dispatch.
+    Returns the (possibly-updated) config plus the resolution record;
+    every call lands one ``microrank_policy_events_total`` sample."""
+    from ..obs.metrics import record_policy_event
+
+    current = {
+        "method": config.spectrum.method,
+        "kernel": config.runtime.kernel,
+        "pad_policy": config.runtime.pad_policy,
+    }
+    explicit = {
+        name: current[name] != default
+        for name, default in TUNED_DEFAULTS.items()
+    }
+    res = PolicyResolution(
+        lane=lane,
+        outcome="default",
+        profile=profile.key() if profile is not None else None,
+        fields={
+            name: {
+                "value": current[name],
+                "source": "config" if explicit[name] else "default",
+            }
+            for name in TUNED_DEFAULTS
+        },
+    )
+    if getattr(config.runtime, "tuned_policy", "auto") == "off":
+        res.outcome = "disabled"
+        record_policy_event("disabled", lane)
+        return config, res
+
+    if cache_dir is None:
+        cache_dir = resolve_policy_dir(config.runtime)
+    data, reject = load_policy(cache_dir)
+    if data is None and reject is None:
+        record_policy_event("default", lane)
+        return config, res
+    res.policy_file = str(policy_path(cache_dir))
+    if reject is None:
+        entry = (
+            data["profiles"].get(profile.key())
+            if profile is not None
+            else None
+        )
+        if entry is None:
+            reject = (
+                f"no tuned entry for workload profile "
+                f"{profile.key() if profile else None!r}"
+            )
+    if reject is not None:
+        # Whole rejection (the checkpoint rule): stale or mismatched
+        # policy applies NOTHING — built-in defaults, counted.
+        res.outcome = "rejected"
+        res.reason = reject
+        record_policy_event("rejected", lane)
+        log.warning(
+            "%s lane: policy.json rejected (%s); built-in defaults",
+            lane, reject,
+        )
+        return config, res
+
+    values = dict(current)
+    applied = []
+    for name in TUNED_DEFAULTS:
+        tuned = entry.get(name)
+        if tuned is None or explicit[name]:
+            continue  # operator's explicit choice (or untuned field) wins
+        values[name] = str(tuned)
+        res.fields[name] = {"value": values[name], "source": "policy"}
+        applied.append(name)
+    res.outcome = "applied" if applied else "override"
+    record_policy_event(res.outcome, lane)
+    log.info(
+        "%s lane: tuned policy %s for profile %s (%s)",
+        lane,
+        res.outcome,
+        res.profile,
+        ", ".join(
+            f"{n}={d['value']}({d['source']})"
+            for n, d in res.fields.items()
+        ),
+    )
+    return _apply_fields(config, values), res
+
+
+def apply_tuned_policy(
+    config: MicroRankConfig,
+    lane: str,
+    profile_frame=None,
+    counts: Optional[Tuple[int, int, Optional[float]]] = None,
+    cache_dir: Optional[str] = None,
+) -> Tuple[MicroRankConfig, PolicyResolution]:
+    """Lane entry point: compute the workload profile from a
+    representative frame (pandas lanes) or raw ``(n_spans, n_ops,
+    dedup_factor)`` counts (the native table lane), then resolve."""
+    if profile_frame is not None:
+        profile = profile_from_frame(profile_frame)
+    elif counts is not None:
+        profile = profile_from_counts(*counts)
+    else:
+        profile = None
+    return resolve_policy(config, profile, lane, cache_dir=cache_dir)
+
+
+# --------------------------------------------------------------- selection
+
+
+def select_policy(
+    scenario_records: List[dict],
+    timings: Optional[Dict[str, dict]] = None,
+    matrix_seed: Optional[int] = None,
+) -> dict:
+    """Distill matrix results into the persisted policy document.
+
+    Per workload profile observed in the matrix: the formula with the
+    best mean MAP across that profile's scenarios wins (ties break by
+    top-1 exact rate, then mean MRR, then name — deterministic);
+    kernel/pad-policy come from the harness's timing sweep for that
+    profile when one ran, else stay at the built-in defaults.
+    """
+    by_profile: Dict[str, List[dict]] = {}
+    for rec in scenario_records:
+        prof = rec.get("profile")
+        formulas = rec.get("formulas") or {}
+        if prof and formulas:
+            by_profile.setdefault(prof, []).append(formulas)
+
+    profiles: Dict[str, dict] = {}
+    for prof, recs in sorted(by_profile.items()):
+        methods = sorted({m for r in recs for m in r})
+        scored = []
+        for m in methods:
+            rows = [r[m] for r in recs if m in r]
+            mean = lambda key: (  # noqa: E731
+                sum(float(r.get(key) or 0.0) for r in rows)
+                / max(len(rows), 1)
+            )
+            scored.append(
+                (-mean("map"), -mean("top1_rate"), -mean("mrr"), m)
+            )
+        scored.sort()
+        best = scored[0]
+        entry = {
+            "method": best[3],
+            "kernel": TUNED_DEFAULTS["kernel"],
+            "pad_policy": TUNED_DEFAULTS["pad_policy"],
+            "evidence": {
+                "scenarios": len(recs),
+                "map": round(-best[0], 4),
+                "top1_rate": round(-best[1], 4),
+                "mrr": round(-best[2], 4),
+            },
+        }
+        timing = (timings or {}).get(prof)
+        if timing:
+            entry["kernel"] = timing["kernel"]
+            entry["pad_policy"] = timing["pad_policy"]
+            entry["evidence"]["rank_ms"] = timing.get("rank_ms")
+            entry["evidence"]["timed_candidates"] = timing.get(
+                "candidates"
+            )
+        profiles[prof] = entry
+
+    return {
+        "version": POLICY_VERSION,
+        "profile_schema": PROFILE_SCHEMA,
+        "matrix_seed": matrix_seed,
+        "profiles": profiles,
+    }

@@ -1,0 +1,165 @@
+"""Declarative scenario specs: every fault family the matrix covers.
+
+The paper's evaluation (and the repo's replay bench) exercises ONE
+fault family — a large latency fault on a single op — and the 8/8
+fault-top-1 headline reflects exactly that. A ``ScenarioSpec`` names a
+*family* (what kind of failure), an *intensity* (how hard it hits), a
+*topology* (how big/deep the service graph is) and a *timing* (which
+windows carry it), and compiles — via the seeded synthetic generator —
+into a reproducible span workload with ground-truth culprit labels.
+
+Families (``FAMILIES``):
+
+* ``latency``    — the paper's shape: one op's own time jumps.
+* ``error``      — status-code fault: the op FAILS FAST (no latency
+  signal at all; only the error-status detector path can see it).
+* ``multi``      — 2+ simultaneous culprits on separated call paths;
+  scoring is against the full culprit SET.
+* ``cascade``    — latency fault plus backpressure: ancestors slow in
+  EVERY trace, so abnormal traces exist that never touch the culprit.
+* ``cold_start`` — the fault is already burning while the stream
+  engine's online baseline is still warming up (no --normal seed).
+* ``drift``      — no fault: a gradual SLO shift the baseline must
+  absorb (retrain) without opening an incident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+FAMILIES = (
+    "latency", "error", "multi", "cascade", "cold_start", "drift",
+)
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    """One reproducible scenario: family + intensity + topology + timing.
+
+    Pure data — :func:`scenarios.generate.generate_scenario` compiles it
+    into span frames; the same spec (same seed) always yields a
+    byte-identical span stream.
+    """
+
+    name: str
+    family: str
+    seed: int = 0
+    # Timing: timeline length and which windows carry the fault(s).
+    n_windows: int = 8
+    faulted: Tuple[int, ...] = (3, 4)
+    # Topology.
+    n_operations: int = 24
+    n_pods: int = 1
+    n_kinds: int = 16
+    n_traces: int = 200
+    child_keep_prob: float = 0.8
+    window_minutes: float = 5.0
+    # Intensity / family knobs.
+    fault_latency_ms: float = 2000.0
+    n_faults: int = 1
+    fault_kind: str = "latency"          # "latency" | "error"
+    fault_path_overlap: Optional[float] = None
+    cascade_fraction: float = 0.0
+    error_duration_factor: float = 0.25
+    drift_per_window: float = 0.0
+    # Stream-lane shape: seed the online baseline from the generator's
+    # normal window (False = the cold-start family — the engine warms
+    # up from the live stream while the fault may already be burning).
+    seed_baseline: bool = True
+
+    def __post_init__(self):
+        if self.family not in FAMILIES:
+            raise ValueError(
+                f"unknown scenario family {self.family!r}; "
+                f"expected one of {FAMILIES}"
+            )
+
+    def synth_config(self):
+        """The seeded SyntheticConfig this spec compiles through."""
+        from ..testing import SyntheticConfig
+
+        return SyntheticConfig(
+            n_operations=self.n_operations,
+            n_pods=self.n_pods,
+            n_kinds=self.n_kinds,
+            child_keep_prob=self.child_keep_prob,
+            n_traces=self.n_traces,
+            fault_latency_ms=self.fault_latency_ms,
+            n_faults=self.n_faults,
+            fault_kind=self.fault_kind,
+            fault_path_overlap=self.fault_path_overlap,
+            cascade_fraction=self.cascade_fraction,
+            error_duration_factor=self.error_duration_factor,
+            drift_per_window=self.drift_per_window,
+            window_minutes=self.window_minutes,
+            seed=self.seed,
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def default_matrix(seed: int = 0, full: bool = False) -> List[ScenarioSpec]:
+    """The standard scenario matrix: one spec per family (the CI smoke
+    shape), plus a harder variant per family with ``full=True``. Every
+    spec's seed derives from the ONE matrix seed, so the whole matrix is
+    reproducible from a single integer."""
+
+    def s(i: int) -> int:
+        return seed * 1009 + i
+
+    specs = [
+        ScenarioSpec(
+            name="latency-basic", family="latency", seed=s(1),
+        ),
+        ScenarioSpec(
+            name="error-failfast", family="error", seed=s(2),
+            fault_kind="error",
+        ),
+        ScenarioSpec(
+            name="multi-disjoint", family="multi", seed=s(3),
+            n_faults=2, fault_path_overlap=0.0, n_operations=30,
+        ),
+        ScenarioSpec(
+            name="cascade-backpressure", family="cascade", seed=s(4),
+            cascade_fraction=0.5, n_operations=30,
+        ),
+        ScenarioSpec(
+            name="coldstart-early-fault", family="cold_start", seed=s(5),
+            faulted=(2, 3), seed_baseline=False,
+        ),
+        ScenarioSpec(
+            name="drift-slo-shift", family="drift", seed=s(6),
+            faulted=(), drift_per_window=0.05,
+        ),
+    ]
+    if full:
+        specs += [
+            ScenarioSpec(
+                name="latency-subtle", family="latency", seed=s(7),
+                fault_latency_ms=600.0, n_operations=40, n_kinds=24,
+            ),
+            ScenarioSpec(
+                name="error-multi-pod", family="error", seed=s(8),
+                fault_kind="error", n_pods=2, n_traces=300,
+            ),
+            ScenarioSpec(
+                name="multi-nested", family="multi", seed=s(9),
+                n_faults=2, fault_path_overlap=1.0, n_operations=30,
+            ),
+            ScenarioSpec(
+                name="cascade-strong", family="cascade", seed=s(10),
+                cascade_fraction=0.8, n_operations=40, n_kinds=24,
+            ),
+            ScenarioSpec(
+                name="coldstart-immediate", family="cold_start",
+                seed=s(11), faulted=(1, 2, 3), seed_baseline=False,
+            ),
+            ScenarioSpec(
+                name="drift-fast", family="drift", seed=s(12),
+                faulted=(), drift_per_window=0.10,
+            ),
+        ]
+    return specs

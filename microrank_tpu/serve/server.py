@@ -112,13 +112,24 @@ class ServeService:
         self.datasets: Dict[str, object] = {}
         self.slo_vocab = None
         self.baseline = None
+        self.policy_resolution = None   # set by fit_baseline
         self.draining = False
         self._stopped = False
 
     # ------------------------------------------------------------- setup
     def fit_baseline(self, normal_df) -> None:
         from ..detect import compute_slo
+        from ..scenarios.policy import apply_tuned_policy
 
+        # Tuned-policy resolution (the shared lane seam): the normal
+        # dump is the workload-profile witness. The router and the
+        # batcher captured the un-tuned config at construction; both
+        # re-point here, BEFORE warmup traces any program.
+        self.config, self.policy_resolution = apply_tuned_policy(
+            self.config, lane="serve", profile_frame=normal_df
+        )
+        self.router.config = self.config
+        self.scheduler.batcher.config = self.config
         self.slo_vocab, self.baseline = compute_slo(
             normal_df, stat=self.config.detector.slo_stat
         )
@@ -157,6 +168,12 @@ class ServeService:
                 max_wait_ms=self.serve.max_wait_ms,
                 max_queue_depth=self.serve.max_queue_depth,
             )
+            if self.policy_resolution is not None:
+                # Journal evidence of the tuned-policy consultation
+                # (resolved at fit_baseline, after run_start on disk).
+                self.journal.emit(
+                    "policy", **self.policy_resolution.journal()
+                )
         if self.serve.warmup:
             occs = self.serve.warmup_occupancies
             bad = [

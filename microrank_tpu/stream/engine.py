@@ -143,6 +143,17 @@ class StreamEngine:
         if normal_df is None:
             normal_df = getattr(source, "normal", None)
         self._normal_df = normal_df     # kept for cold-reset re-seed
+        # Tuned-policy resolution (scenarios.policy — the ONE seam all
+        # lanes share): a persisted policy.json may supply the spectrum
+        # method / kernel / pad policy for this workload profile;
+        # explicit config overrides always win. Resolved BEFORE any
+        # config consumer (router, backend programs) is built.
+        from ..scenarios.policy import apply_tuned_policy
+
+        self.config, self.policy_resolution = apply_tuned_policy(
+            config, lane="stream", profile_frame=normal_df
+        )
+        config = self.config
         self.baseline = self._make_baseline()
         self.pool = BuildWorkerPool(
             sc.build_workers, name="mr-stream-build"
@@ -395,6 +406,12 @@ class StreamEngine:
                 lateness_seconds=sc.allowed_lateness_seconds,
                 seeded=self.baseline.seeded,
                 resumed=self.resumed,
+            )
+            # Journal evidence that the tuned policy was (or was not)
+            # consulted — the scenario-smoke CI job greps this on the
+            # warm-restart half.
+            self.journal.emit(
+                "policy", **self.policy_resolution.journal()
             )
         try:
             done = False

@@ -135,7 +135,15 @@ class ReplaySource:
 
 
 class SyntheticSource:
-    """Paced synthetic timeline with injected fault windows."""
+    """Paced synthetic timeline with injected fault windows.
+
+    Fault family/injection knobs ride the ``SyntheticConfig``
+    (``fault_kind="error"`` for status-code faults, ``n_faults`` for
+    multi-culprit windows, ``cascade_fraction``/``drift_per_window``
+    for the cascade and drift families); the ground truth carries the
+    FULL culprit set (``fault_pod_ops``) so multi-fault scoring is
+    well-defined (``fault_pod_op`` stays the first culprit for back
+    compat)."""
 
     def __init__(
         self,
@@ -152,7 +160,8 @@ class SyntheticSource:
         tl = generate_timeline(cfg, int(n_windows), list(faulted))
         self.timeline = tl
         self.normal = tl.normal                 # baseline seed dump
-        self.fault_pod_op = tl.fault_pod_op     # ground truth
+        self.fault_pod_op = tl.fault_pod_op     # ground truth (first)
+        self.fault_pod_ops = list(tl.fault_pod_ops)  # full culprit set
         self.window_faulted = tl.window_faulted
         self._replay = ReplaySource(
             tl.timeline,

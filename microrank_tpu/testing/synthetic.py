@@ -49,6 +49,29 @@ class SyntheticConfig:
     # propagation). None (default) keeps the historical unconstrained
     # random choice.
     fault_path_overlap: Optional[float] = None
+    # Fault family. "latency" adds fault_latency_ms to the faulted
+    # (op, pod) own time (the paper's chaos shape). "error" models a
+    # status-code fault instead: the faulted span FAILS — its own time
+    # collapses to error_duration_factor of the sampled value (fail
+    # fast) and a ``statusCode`` column is emitted with the error bit
+    # set on the faulted span and propagated to every ancestor span
+    # (callers observe the failure) — no latency signal at all, so only
+    # a status-aware detector can see it.
+    fault_kind: str = "latency"
+    error_duration_factor: float = 0.25
+    # Cascading downstream propagation (latency faults): every ancestor
+    # of a faulted op ALSO gains own-time latency fault_latency_ms *
+    # cascade_fraction**depth in ALL traces passing through it — the
+    # backpressure shape, where traces that never touch the culprit
+    # still slow at shared upstream services (abnormal traces without
+    # culprit coverage degrade the spectrum counters; this is the
+    # irreducible hardness of the cascade family). 0 disables.
+    cascade_fraction: float = 0.0
+    # Baseline drift (timelines only): multiplicative own-time growth
+    # per window — window i renders at (1 + drift_per_window)**i. A
+    # gradual SLO shift the online baseline must absorb (retrain), not
+    # alarm on. 0 disables.
+    drift_per_window: float = 0.0
     window_minutes: float = 5.0
     seed: int = 0
 
@@ -153,6 +176,17 @@ def _pick_faults(
     return [(int(op), int(rng.integers(0, n_pods))) for op in chosen]
 
 
+def _ancestor_depths(parent: np.ndarray, op: int) -> dict:
+    """{ancestor op: depth} walking parent pointers from ``op`` (depth 1
+    = direct parent), root included, ``op`` itself excluded."""
+    out = {}
+    o, d = int(parent[int(op)]), 1
+    while o >= 0:
+        out[o] = d
+        o, d = int(parent[o]), d + 1
+    return out
+
+
 def achieved_overlap(
     parent: np.ndarray, faults: List[Tuple[int, int]]
 ) -> Optional[float]:
@@ -212,12 +246,21 @@ def _render_spans(
     t0: pd.Timestamp,
     faults: Optional[List[Tuple[int, int]]],  # (op, pod) pairs
     trace_prefix: str,
+    scale: float = 1.0,
 ) -> pd.DataFrame:
     kind_of_trace = rng.integers(0, len(topo.kinds), size=n_traces)
     start_offsets_us = np.sort(
         rng.uniform(0, cfg.window_minutes * 60e6, size=n_traces)
     ).astype(np.int64)
 
+    error_fault = cfg.fault_kind == "error"
+    # Ancestor depth maps (one parent-pointer walk per fault) for error
+    # propagation and latency cascades; computed outside the kind loop.
+    anc_depths = (
+        {op: _ancestor_depths(topo.parent, op) for op, _ in faults}
+        if faults
+        else {}
+    )
     blocks = []
     for k, ops in enumerate(topo.kinds):
         t_idx = np.flatnonzero(kind_of_trace == k)
@@ -228,21 +271,48 @@ def _render_spans(
         own_ms = rng.lognormal(
             mean=mu[None, :], sigma=cfg.sigma_log, size=(len(t_idx), m)
         )
+        if scale != 1.0:
+            own_ms *= scale
         # Pod assignment per (trace, op).
         pods = rng.integers(0, cfg.n_pods, size=(len(t_idx), m))
+        status = np.zeros((len(t_idx), m), dtype=np.int64)
         if faults:
+            pos = {int(o): j for j, o in enumerate(ops)}
             for fault_op, fault_pod in faults:
-                j = np.flatnonzero(ops == fault_op)
-                if len(j):
-                    j = int(j[0])
+                j = pos.get(int(fault_op))
+                if j is not None:
                     hit = pods[:, j] == fault_pod
-                    own_ms[:, j] += np.where(hit, cfg.fault_latency_ms, 0.0)
+                    if error_fault:
+                        # Fail-fast: the span errors instead of slowing.
+                        own_ms[:, j] = np.where(
+                            hit,
+                            own_ms[:, j] * cfg.error_duration_factor,
+                            own_ms[:, j],
+                        )
+                        status[:, j] |= hit.astype(np.int64)
+                    else:
+                        own_ms[:, j] += np.where(
+                            hit, cfg.fault_latency_ms, 0.0
+                        )
+                if not error_fault and cfg.cascade_fraction > 0.0:
+                    # Backpressure cascade: ancestors slow in EVERY
+                    # trace through them, culprit-covering or not.
+                    for anc, depth in anc_depths[fault_op].items():
+                        ja = pos.get(anc)
+                        if ja is not None:
+                            own_ms[:, ja] += (
+                                cfg.fault_latency_ms
+                                * cfg.cascade_fraction ** depth
+                            )
         # Inclusive durations: add each op's total into its parent,
-        # deepest-first (ops are topo-ordered).
+        # deepest-first (ops are topo-ordered). Error status propagates
+        # up the same call chain: callers observe the failure.
         dur_ms = own_ms.copy()
         ppos = topo.kind_parent_pos[k]
         for j in range(m - 1, 0, -1):
             dur_ms[:, ppos[j]] += dur_ms[:, j]
+            if error_fault:
+                status[:, ppos[j]] |= status[:, j]
 
         nt = len(t_idx)
         trace_rows = np.repeat(t_idx, m)
@@ -252,7 +322,10 @@ def _render_spans(
         root_dur_us = np.repeat((dur_ms[:, 0] * 1000.0).astype(np.int64), m)
         parent_rows = np.tile(topo.parent[ops], nt)
         blocks.append(
-            (trace_rows, op_rows, pod_rows, dur_rows, root_dur_us, parent_rows)
+            (
+                trace_rows, op_rows, pod_rows, dur_rows, root_dur_us,
+                parent_rows, status.reshape(-1),
+            )
         )
 
     trace_rows = np.concatenate([b[0] for b in blocks])
@@ -261,6 +334,7 @@ def _render_spans(
     dur_rows = np.concatenate([b[3] for b in blocks])
     root_dur_us = np.concatenate([b[4] for b in blocks])
     parent_rows = np.concatenate([b[5] for b in blocks])
+    status_rows = np.concatenate([b[6] for b in blocks])
 
     trace_str = np.char.add(trace_prefix, trace_rows.astype(np.str_))
     op_str = op_rows.astype(np.str_)
@@ -285,19 +359,23 @@ def _render_spans(
     start_ts = t0 + pd.to_timedelta(start_us, unit="us")
     end_ts = t0 + pd.to_timedelta(start_us + root_dur_us, unit="us")
 
-    return pd.DataFrame(
-        {
-            "traceID": trace_str,
-            "spanID": span_id,
-            "ParentSpanId": parent_id,
-            "operationName": opname,
-            "serviceName": svc,
-            "podName": pod,
-            "duration": dur_rows,
-            "startTime": start_ts,
-            "endTime": end_ts,
-        }
-    )
+    columns = {
+        "traceID": trace_str,
+        "spanID": span_id,
+        "ParentSpanId": parent_id,
+        "operationName": opname,
+        "serviceName": svc,
+        "podName": pod,
+        "duration": dur_rows,
+        "startTime": start_ts,
+        "endTime": end_ts,
+    }
+    if error_fault:
+        # Optional status column (0 = OK): only error-fault generators
+        # emit it, so every pre-existing fixture/golden CSV is
+        # byte-identical and the native lane never sees it.
+        columns["statusCode"] = status_rows
+    return pd.DataFrame(columns)
 
 
 @dataclass
@@ -358,6 +436,10 @@ class SyntheticTimeline:
     window_minutes: float
     start: pd.Timestamp          # first timeline window's start
     fault_pod_op: str
+    # Full injected culprit SET (instance-level names) — multi-fault
+    # timelines need every culprit for well-defined scoring;
+    # fault_pod_op stays the first for back compat.
+    fault_pod_ops: List[str] = field(default_factory=list)
 
 
 def generate_timeline(
@@ -366,11 +448,16 @@ def generate_timeline(
     faulted: List[int],
 ) -> SyntheticTimeline:
     """Generate a continuous ``n_windows``-window trace stream where the
-    windows listed in ``faulted`` carry the injected latency fault and the
-    rest are clean. ``cfg.n_traces`` applies per window."""
+    windows listed in ``faulted`` carry the injected fault(s) —
+    ``cfg.n_faults`` simultaneous culprits of ``cfg.fault_kind`` — and
+    the rest are clean. ``cfg.n_traces`` applies per window. With
+    ``cfg.drift_per_window`` set, window i renders all own times scaled
+    by ``(1 + drift)**i`` (gradual SLO shift, no fault needed)."""
     rng = np.random.default_rng(cfg.seed)
     topo = _make_topology(cfg, rng)
-    faults = _pick_faults(topo, rng, cfg.n_pods, 1)
+    faults = _pick_faults(
+        topo, rng, cfg.n_pods, cfg.n_faults, cfg.fault_path_overlap
+    )
     fault_op, fault_pod = faults[0]
 
     t0 = pd.Timestamp("2025-02-14 12:00:00")
@@ -386,6 +473,7 @@ def generate_timeline(
             _render_spans(
                 topo, cfg, rng, cfg.n_traces, ti,
                 faults if is_faulted else None, f"w{i}x",
+                scale=(1.0 + cfg.drift_per_window) ** i,
             )
         )
         flags.append(is_faulted)
@@ -396,6 +484,9 @@ def generate_timeline(
         window_minutes=cfg.window_minutes,
         start=t1,
         fault_pod_op=_pod_op_name(fault_op, fault_pod, cfg.n_operations),
+        fault_pod_ops=[
+            _pod_op_name(op, pod, cfg.n_operations) for op, pod in faults
+        ],
     )
 
 

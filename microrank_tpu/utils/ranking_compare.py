@@ -24,6 +24,16 @@ from __future__ import annotations
 from typing import Sequence, Tuple
 
 
+def scores_tied(a: float, b: float, rtol: float = 1e-3) -> bool:
+    """THE tie comparator: two scores are an exact-tie-within-rounding
+    when they agree within ``rtol`` relative tolerance (1e-12 floor for
+    near-zero scores). Shared by :func:`tie_aware_topk_agreement`, the
+    evaluation metrics (``evaluation.tie_aware_ranks``) and the
+    scenario harness, so every tie rule in the repo is this one."""
+    a, b = float(a), float(b)
+    return abs(a - b) <= rtol * max(abs(a), abs(b), 1e-12)
+
+
 def tie_aware_topk_agreement(
     ids_a: Sequence,
     scores_a: Sequence[float],
@@ -43,7 +53,7 @@ def tie_aware_topk_agreement(
     ids_a, ids_b = list(ids_a[:k]), list(ids_b[:k])
     for r in range(n):
         sa, sb = float(scores_a[r]), float(scores_b[r])
-        if abs(sa - sb) > rtol * max(abs(sa), abs(sb), 1e-12):
+        if not scores_tied(sa, sb, rtol):
             return False, f"score mismatch at rank {r}: {sa} vs {sb}"
         if ids_a[r] == ids_b[r]:
             continue
@@ -57,6 +67,6 @@ def tie_aware_topk_agreement(
         sb_of_a = float(scores_b[ids_b.index(ids_a[r])])
         sa_of_b = float(scores_a[ids_a.index(ids_b[r])])
         for cross in (sb_of_a, sa_of_b):
-            if abs(cross - sa) > rtol * max(abs(cross), abs(sa), 1e-12):
+            if not scores_tied(cross, sa, rtol):
                 return False, f"non-tied id swap at rank {r}"
     return True, "ok"

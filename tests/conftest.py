@@ -7,11 +7,20 @@ TPU slice, just on emulated host devices.
 """
 
 import os
+import tempfile
 
 # The shell environment pins JAX_PLATFORMS=axon (the TPU tunnel) and the
 # plugin wins over a plain env override, so force CPU through the config
 # API before any backend initializes.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Hermetic tuned-policy resolution: a policy.json persisted by a real
+# `cli scenarios` run (next to the shared compile cache) must never
+# leak into the suite's default-config rankings. Tests that exercise
+# policy resolution point MICRORANK_POLICY_DIR at their own tmp dir.
+os.environ.setdefault(
+    "MICRORANK_POLICY_DIR", tempfile.mkdtemp(prefix="mr-policy-test-")
+)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -114,3 +114,63 @@ def test_overlap_ablation_smoke():
     for rep in reports.values():
         assert len(rep.cases) == 2
         assert all(len(c.faults) == 2 for c in rep.cases)
+
+
+# ---------------------------------------------------- tie-aware metrics
+# Hand-computed fixtures for the shared ranking-metric helpers the
+# scenario matrix, bench.py and this harness all score with.
+
+
+def test_tie_aware_ranks_hand_fixture():
+    from microrank_tpu.evaluation import tie_aware_ranks
+
+    names = ["a", "b", "c", "d", "e"]
+    scores = [5.0, 5.0, 5.0, 3.0, 1.0]
+    # Three-way tie at the top: all share rank 1; d is 4th, e 5th.
+    assert tie_aware_ranks(names, scores) == {
+        "a": 1, "b": 1, "c": 1, "d": 4, "e": 5,
+    }
+    # Head-anchored grouping: a chain of near-ties cannot drift — each
+    # member must tie the group HEAD, not just its neighbor.
+    drift = [1.0, 1.0 - 4e-7, 1.0 - 8e-7, 1.0 - 1.2e-6]
+    r = tie_aware_ranks(["w", "x", "y", "z"], drift, rtol=1e-6)
+    assert r["w"] == r["x"] == r["y"] == 1  # all within rtol of head
+    assert r["z"] == 4                      # past the head's tolerance
+
+
+def test_topk_exact_hand_fixture():
+    from microrank_tpu.evaluation import topk_exact
+
+    names = ["a", "b", "c", "d"]
+    scores = [5.0, 5.0, 3.0, 1.0]
+    assert topk_exact(names, scores, ["b"], 1)      # tie expands top-1
+    assert topk_exact(names, scores, ["a", "b"], 1)
+    assert not topk_exact(names, scores, ["c"], 2)  # c's rank is 3
+    assert topk_exact(names, scores, ["c"], 3)
+    assert not topk_exact(names, scores, ["z"], 4)  # unranked culprit
+    assert not topk_exact(names, scores, [], 1)     # no truth: vacuous
+
+
+def test_average_precision_hand_fixture():
+    from microrank_tpu.evaluation import average_precision
+
+    names = ["a", "b", "c", "d"]
+    scores = [5.0, 4.0, 3.0, 1.0]
+    # Truth {b, d}: ranks 2 and 4 -> (1/2 + 2/4) / 2 = 0.5.
+    assert average_precision(names, scores, ["b", "d"]) == 0.5
+    # Truth {a}: rank 1 -> AP 1.0; unranked culprit halves it.
+    assert average_precision(names, scores, ["a"]) == 1.0
+    assert average_precision(names, scores, ["a", "zz"]) == 0.5
+
+
+def test_reciprocal_rank_and_metrics_bundle():
+    from microrank_tpu.evaluation import ranking_metrics, reciprocal_rank
+
+    names = ["a", "b", "c", "d"]
+    scores = [5.0, 4.0, 3.0, 1.0]
+    assert reciprocal_rank(names, scores, ["c", "d"]) == 1 / 3
+    assert reciprocal_rank(names, scores, ["zz"]) == 0.0
+    m = ranking_metrics(names, scores, ["c"], ks=(1, 3))
+    assert m["ranks"] == {"c": 3}
+    assert m["topk_exact"] == {1: False, 3: True}
+    assert m["rr"] == 1 / 3 and m["ap"] == 1 / 3
