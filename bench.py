@@ -25,7 +25,11 @@ Config via env: BENCH_CONFIG=1..7 selects a workload preset
 default 5 = 1M spans / 5k ops); BENCH_SPANS / BENCH_OPS override the
 preset's sizes; BENCH_REPEATS (5), BENCH_ORACLE_SPANS (20_000),
 BENCH_KERNEL
-(auto|packed|packed_bf16|packed_blocked|csr|coo|dense|dense_bf16|pallas),
+(auto|kind|packed|packed_bf16|packed_blocked|csr|coo|dense|dense_bf16|
+pallas; "kind" = the kind-compressed reduced-precision kernel, which
+"auto" selects itself once the window's measured dedup factor clears
+the threshold — the artifact records the factor as "kind_dedup" and
+the differenced-profile ratio as "speedup_kind_vs_packed"),
 BENCH_FAULT_MS (60000), BENCH_BATCH (preset-dependent; 1 disables),
 Host->device staging is part of the headline value BY DEFAULT (round 4
 on; BENCH_TIME_STAGING=0 excludes it to reproduce the r1-r3
@@ -359,6 +363,15 @@ def _analytic_iter_cost(graph, kernel):
             vp_ss = ss8 * 8
             flops += 4.0 * vp * tp + 2.0 * vp * vp_ss
             bytes_ += 2.0 * cov_bytes + ss_bytes
+        elif kernel == "kind":
+            # Kind-compressed: the int8 pattern streams once per matvec
+            # direction (1 byte/cell, NO unpack arithmetic — the whole
+            # point), the kind axis is the collapsed width, and the
+            # call-graph term is an O(C) row-sum (~20 B and ~4 flops
+            # per edge like the csr model) instead of V^2 cells.
+            c = int(p.ss_child.shape[-1]) or int(p.ss_val.shape[-1])
+            flops += 4.0 * vp * tp + 4.0 * c
+            bytes_ += 2.0 * float(vp * tp) + 20.0 * c
         elif kernel == "csr":
             e = int(p.inc_op.shape[-1])
             c = int(p.ss_child.shape[-1])
@@ -1225,12 +1238,16 @@ def main() -> int:
     if kernel == "auto":
         kernel = choose_kernel(graph, prefer_bf16=_prefer_bf16())
     collapsed = int(graph.normal.n_cols) >= 0
+    from microrank_tpu.graph.build import kind_dedup_ratio
+
+    kind_dedup = kind_dedup_ratio(graph)
     log(
         f"pagerank kernel: {kernel}"
         + (
             f"; kind-collapsed trace axes "
             f"{int(graph.normal.n_traces)}->{int(graph.normal.n_cols)} / "
             f"{int(graph.abnormal.n_traces)}->{int(graph.abnormal.n_cols)}"
+            f" (dedup factor {kind_dedup:.1f}x)"
             if collapsed
             else ""
         )
@@ -1355,12 +1372,15 @@ def main() -> int:
         try:
             if kernel in (
                 "packed", "packed_bf16", "packed_blocked", "csr", "pcsr",
+                "kind",
             ):
                 device_profile[kernel] = _profile_device_time(
                     run_iters, cfg.pagerank.iterations, rank_s, graph,
                     kernel, repeats,
                 )
-            for other in ("pcsr", "csr", "packed_bf16", "packed_blocked"):
+            for other in (
+                "kind", "pcsr", "csr", "packed_bf16", "packed_blocked",
+            ):
                 if other == kernel or other in device_profile:
                     continue
                 # Forced aux builds ignore the budgets the auto policy
@@ -1492,11 +1512,40 @@ def main() -> int:
             f"(oracle top-1 {top_full_o[0]})"
         )
 
+    # Kind-vs-packed per-iteration speedup — the ISSUE-14 acceptance
+    # number (>2x on the 1M-span window), computed from the differenced
+    # device profiles whenever both sides were measured.
+    speedup_kind = None
+    kind_prof = device_profile.get("kind")
+    packed_prof = device_profile.get("packed_bf16") or device_profile.get(
+        "packed"
+    )
+    if kind_prof and packed_prof and kind_prof["per_iter_us"]:
+        speedup_kind = round(
+            packed_prof["per_iter_us"] / kind_prof["per_iter_us"], 2
+        )
+        log(
+            f"kind vs packed per-iter speedup: {speedup_kind}x "
+            f"({packed_prof['per_iter_us']:.0f} -> "
+            f"{kind_prof['per_iter_us']:.0f} us/iter)"
+        )
+
     result = {
         "metric": "spans_per_sec_ranked",
         "value": round(spans_per_sec, 1),
         "unit": "spans/s",
         "vs_baseline": round(spans_per_sec / oracle_sps, 2),
+        # Reduced-precision / kind-compression telemetry (ISSUE 14):
+        # the window's measured dedup factor (the auto-select signal),
+        # the kind matvec precision in effect, and the headline
+        # kind-vs-packed per-iteration speedup when both profiled.
+        "kind_dedup": round(kind_dedup, 2),
+        "kind_precision": cfg.pagerank.kind_precision,
+        **(
+            {"speedup_kind_vs_packed": speedup_kind}
+            if speedup_kind is not None
+            else {}
+        ),
         # One-time C++ mmap ingest of the whole dump (normal + abnormal
         # CSVs -> interned arrays; sidecar-cached across runs). Not part
         # of the per-window numbers: a deployment ingests a span once

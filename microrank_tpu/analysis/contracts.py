@@ -79,6 +79,7 @@ PARTITION_FIELD_DTYPES: Dict[str, str] = {
     "pc_blk_indptr": "int32",
     "pc_ell_op": "int32",
     "pc_ell_rs": "float32",
+    "cov_i8": "int8",
 }
 
 

@@ -248,6 +248,15 @@ def _config_from_args(args) -> "MicroRankConfig":
             damping=args.damping,
             call_weight=args.call_weight,
             preference=args.preference,
+            **{
+                k: v
+                for k, v in {
+                    "kind_precision": getattr(
+                        args, "kind_precision", None
+                    ),
+                }.items()
+                if v is not None
+            },
         ),
         spectrum=SpectrumConfig(
             method=args.spectrum_method, top_max=args.top_max
@@ -295,6 +304,9 @@ def _config_from_args(args) -> "MicroRankConfig":
                     ),
                     "compile_cache_dir": getattr(
                         args, "compile_cache_dir", None
+                    ),
+                    "kind_dedup_threshold": getattr(
+                        args, "kind_dedup_threshold", None
                     ),
                 }.items()
                 if v is not None
@@ -1198,10 +1210,31 @@ def main(argv=None) -> int:
         "--kernel",
         default="auto",
         choices=[
-            "auto", "packed", "packed_bf16", "packed_blocked", "pcsr",
-            "csr", "coo", "dense", "dense_bf16", "pallas",
+            "auto", "kind", "packed", "packed_bf16", "packed_blocked",
+            "pcsr", "csr", "coo", "dense", "dense_bf16", "pallas",
         ],
-        help="power-iteration kernel",
+        help="power-iteration kernel ('kind' = kind-compressed "
+        "reduced-precision iteration over the collapsed trace-kind "
+        "axis; 'auto' selects it when the measured dedup factor "
+        "clears --kind-dedup-threshold)",
+    )
+    p_run.add_argument(
+        "--kind-precision",
+        default=None,
+        choices=["int8", "bf16", "f32"],
+        help="kernel='kind' coverage matvec precision: f32 (default — "
+        "bit-identical to packed f32) / bf16 operands with f32 "
+        "accumulation, or scaled-int8 operands with exact int32 "
+        "accumulation",
+    )
+    p_run.add_argument(
+        "--kind-dedup-threshold",
+        type=float,
+        default=None,
+        help="measured window dedup factor (true traces / distinct "
+        "kinds) past which kernel='auto' selects the kind-compressed "
+        "kernel (default 4.0; microrank_kind_dedup_ratio records the "
+        "measured factor)",
     )
     p_run.add_argument(
         "--profile-dir",

@@ -78,6 +78,20 @@ class PageRankConfig:
     # fewest power-of-two blocks that fit). Static under jit (part of
     # the config cache key), so changing it recompiles correctly.
     packed_block_bytes: int = 128 << 20
+    # kernel="kind" compute precision of the kind-compressed coverage
+    # matvec pair (STORAGE is the int8 pattern in every mode — that is
+    # the reduced-precision representation; the call-graph row-sum
+    # stays f32 either way): "f32" (default — f32 operands and
+    # accumulation, bit-identical scores to the f32 packed kernel, so
+    # auto-selected kind preserves every tight-parity guarantee),
+    # "bf16" (bf16 operands, f32 accumulation — the measured-parity
+    # trade packed_bf16 established), or "int8" (scaled fixed-point per
+    # arxiv 2009.10443: the 0/1 pattern streams as int8, the operand
+    # vector quantizes per iteration with a symmetric max/127 scale,
+    # and the int32 accumulation is exact — operand quantization is the
+    # only rounding; rank parity is tie-aware-tested, score tolerance
+    # widens). Static under jit (config cache key).
+    kind_precision: str = "f32"
     # Entry-sharded (coo/csr/pallas) cross-shard combine: True replaces
     # the plain psum of the dense SpMV partials with a compensated
     # all-gather TwoSum fold (ops.segment.compensated_psum). Evaluated
@@ -193,11 +207,28 @@ class RuntimeConfig:
     #   "pallas" — one-hot MXU segment sums (measured on v5e: beats the
     #       coo scatter at 1M entries, ~7x slower than packed — see
     #       DESIGN.md's kernel table; never chosen by "auto");
-    #   "auto" — packed when both partitions' unpacked matrices fit
+    #   "kind" — kind-compressed reduced-precision iteration: the
+    #       coverage pattern materialized as int8 over the COLLAPSED
+    #       kind column axis (multiplicity weights folded — exactly
+    #       equivalent PageRank over unique kinds) streamed without the
+    #       packed kernel's per-iteration bit-unpack, the call-graph
+    #       term an O(C) scatter-free row-sum instead of a [V, V]
+    #       matvec, and pagerank.kind_precision selecting
+    #       int8/bf16/f32 operands with f32 (int8: exact int32)
+    #       accumulation;
+    #   "auto" — kind when the build kind-collapsed the window AND the
+    #       measured dedup factor cleared kind_dedup_threshold, else
+    #       packed when both partitions' unpacked matrices fit
     #       dense_budget_bytes, packed_blocked when only the bitmaps fit
     #       a quarter of it (graph build constructs the matching
     #       auxiliary view), else pcsr.
     kernel: str = "auto"
+    # kernel="auto": window dedup factor (true traces / distinct kind
+    # columns, both partitions) at which a collapsed build constructs
+    # the kind-compressed views and auto-selects kernel="kind". The
+    # microrank_kind_dedup_ratio gauge + per-window journal field
+    # record the measured factor so this is tunable from real profiles.
+    kind_dedup_threshold: float = 4.0
     # Budget for the packed kernel's unpacked f32 matrices, summed over
     # both partitions (graph.build.resolve_aux applies it at build time).
     dense_budget_bytes: int = 2 << 30
@@ -304,6 +335,17 @@ class RuntimeConfig:
     # (round 3: 5 MB staged in 1,675 ms of pure latency). The sharded
     # path ignores this (shards need per-device placement).
     blob_staging: bool = True
+    # Warm-start seam (down payment on ROADMAP item 2): the stream
+    # engine threads each open incident's previous window's converged
+    # rv/score vectors into the next overlapping window's iteration
+    # (mapped across the window delta by op name and the kind retention
+    # map — rank_backends.warm). Pays off with a convergence tol set
+    # (pagerank.tol: iteration counts drop, residual-trace-proven);
+    # without one the fixed 25 iterations run either way and only the
+    # final-residual telemetry improves. Warm windows dispatch
+    # single-window (no coalescing/sharding), so keep this off for
+    # burst-heavy streams where micro-batching wins.
+    warm_start: bool = False
     # Tuned-policy consultation (scenarios/ subsystem): "auto" (default)
     # resolves spectrum method / kernel / pad_policy from the persisted
     # policy.json (written by `cli scenarios` next to the warmup

@@ -85,12 +85,15 @@ def _contrib_rows(
     v_pad = g.cov_unique.shape[0]
     t_pad = g.kind.shape[0]  # LOCAL under the trace-sharded packed path
 
-    if kernel in ("packed", "packed_bf16", "packed_blocked"):
-        # Bitmap rows: K gathered rows unpacked to the (local) trace
-        # axis; inv_tracelen is the per-column p_sr value (multiplicity
-        # folded in on collapsed builds).
-        rows = unpack_bits(
-            jnp.take(g.cov_bits, top_idx, axis=0), t_pad
+    if kernel in ("packed", "packed_bf16", "packed_blocked", "kind"):
+        # Bitmap rows (or the kind view's int8 pattern rows — same 0/1
+        # semantics, no unpack needed): K gathered rows over the
+        # (local) column axis; inv_tracelen is the per-column p_sr
+        # value (multiplicity folded in on collapsed builds).
+        rows = (
+            jnp.take(g.cov_i8, top_idx, axis=0).astype(jnp.float32)
+            if kernel == "kind"
+            else unpack_bits(jnp.take(g.cov_bits, top_idx, axis=0), t_pad)
         )
         local = rows * (rv * g.inv_tracelen)[None, :]
         if psum_axis is None:
@@ -218,7 +221,7 @@ def rank_window_explained_core(
     plus the attribution tensors (module docstring), one program, one
     fetch. ``explain_cfg`` is a static (hashable frozen dataclass) jit
     argument like the other configs."""
-    n_weight, a_weight, rv_n, rv_a, residuals, n_iters = (
+    n_weight, a_weight, rv_n, rv_a, residuals, n_iters, _, _ = (
         window_weights_full(graph, pagerank_cfg, psum_axis, kernel)
     )
     ef, nf, ep, np_, valid = spectrum_counters(

@@ -303,6 +303,15 @@ def csr_auxiliary(
 # can never disagree. Matches RuntimeConfig.dense_budget_bytes's default.
 DEFAULT_DENSE_BUDGET_BYTES = 2 << 30
 
+# Measured window dedup factor (true traces / distinct kind columns,
+# summed over both partitions) at which an auto-resolved collapsed build
+# constructs the kind-compressed views instead of bitmaps, so
+# choose_kernel selects kernel="kind". Below it the axis barely shrank
+# and the packed family keeps the window; the
+# microrank_kind_dedup_ratio gauge exists to tune this from real
+# profiles (RuntimeConfig.kind_dedup_threshold overrides per run).
+DEFAULT_KIND_DEDUP_THRESHOLD = 4.0
+
 # Above this many cells, build bitmaps by direct bit-scatter instead of a
 # dense bool temporary + packbits (the bool temp is 8x the bitmap bytes).
 _BOOL_TEMP_CELL_BUDGET = 128 << 20
@@ -328,17 +337,24 @@ def resolve_aux(
     v_pad: int,
     t_pads,
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
+    dedup: float | None = None,
+    kind_dedup_threshold: float = DEFAULT_KIND_DEDUP_THRESHOLD,
 ) -> str:
     """Window-level auxiliary-view policy (one decision for BOTH
     partitions, so a window can never mix bitmap and CSR partitions).
 
-    "auto" -> "packed" when both partitions' PACKED bitmaps fit a
-    quarter of the budget (the unpacked-f32 budget itself is applied at
-    kernel-choice time: within it the kernel is "packed", past it
-    "packed_blocked" streams column blocks so only the bitmap must be
-    resident) -> "pcsr" when even the bitmaps blow that (the
-    partition-centric fallback — no per-trace bitmap needs to exist at
-    any point, and the kernel never issues a T-range random gather).
+    "auto" -> "kind" when the caller measured a trace-kind dedup factor
+    (``dedup`` — true traces / distinct kind columns; only the collapse
+    post-pass knows it, so ``t_pads`` here are already the COLLAPSED
+    axes) at or past ``kind_dedup_threshold`` AND the kind views fit
+    the same quarter-budget the bitmaps would -> "packed" when both
+    partitions' PACKED bitmaps fit a quarter of the budget (the
+    unpacked-f32 budget itself is applied at kernel-choice time: within
+    it the kernel is "packed", past it "packed_blocked" streams column
+    blocks so only the bitmap must be resident) -> "pcsr" when even the
+    bitmaps blow that (the partition-centric fallback — no per-trace
+    bitmap needs to exist at any point, and the kernel never issues a
+    T-range random gather).
 
     "auto_all" (the sharded path's mode) -> "all" inside the bitmap
     budget, "pcsr" past it: the mesh kernel choice depends on the
@@ -348,15 +364,30 @@ def resolve_aux(
     available where the single-device "auto" would have built bitmaps
     only.
 
-    Explicit modes ("packed" | "csr" | "pcsr" | "all" | "none") pass
-    through for forced-kernel runs.
+    Explicit modes ("packed" | "csr" | "pcsr" | "kind" | "all" |
+    "none") pass through for forced-kernel runs.
     """
     if aux not in ("auto", "auto_all"):
         return aux
     bits_total = packed_bits_bytes(v_pad, t_pads)
     if bits_total > dense_budget_bytes // 4:
         return "pcsr"
+    if (
+        aux == "auto"
+        and dedup is not None
+        and dedup >= kind_dedup_threshold
+        and kind_bytes(v_pad, t_pads) <= dense_budget_bytes // 4
+    ):
+        return "kind"
     return "all" if aux == "auto_all" else "packed"
+
+
+def kind_bytes(v_pad: int, t_pads) -> int:
+    """Resident bytes of the kind-compressed views: the int8 [V, K]
+    coverage pattern per partition plus its staged bitmap twin (the
+    kind aux mode keeps the bitmap so packed parity runs stay possible
+    on the same build)."""
+    return sum(v_pad * t + v_pad * ((t + 7) // 8) for t in t_pads)
 
 
 def aux_for_kernel(kernel: str, sharded: bool = False) -> str:
@@ -368,6 +399,7 @@ def aux_for_kernel(kernel: str, sharded: bool = False) -> str:
         "packed": "packed",
         "packed_bf16": "packed",
         "packed_blocked": "packed",
+        "kind": "kind",
     }.get(kernel, "none")
     if sharded and mode == "auto":
         # Mesh dispatch: build BOTH view families (inside the bitmap
@@ -437,6 +469,29 @@ def packed_aux(
     )
 
 
+def kind_aux(cov_bits: np.ndarray, ss_child: np.ndarray, n_ss: int,
+             v_pad: int, t_pad: int):
+    """Kind-compressed reduced-precision views from an already-built
+    coverage bitmap: the int8 [V, K] pattern (np.unpackbits — 0/1 is
+    exact in int8, so this is a representation change, not a rounding)
+    plus the call-edge row offsets the kernel's O(C) scatter-free
+    row-sum differences at (the same indptr csr_auxiliary builds; the
+    big op-major incidence copies are NOT needed and stay unbuilt).
+
+    Returns (cov_i8 int8[v_pad, t_pad], ss_indptr int32[v_pad + 1]).
+    """
+    cov_i8 = (
+        np.unpackbits(cov_bits, axis=1)[:, :t_pad].astype(np.int8)
+        if cov_bits.shape[1]
+        else np.zeros((v_pad, t_pad), np.int8)
+    )
+    ss_indptr = np.zeros(v_pad + 1, dtype=np.int64)
+    np.cumsum(
+        np.bincount(ss_child[:n_ss], minlength=v_pad), out=ss_indptr[1:]
+    )
+    return cov_i8, ss_indptr.astype(np.int32)
+
+
 def build_aux_views(
     inc_op: np.ndarray,
     inc_trace: np.ndarray,
@@ -453,18 +508,21 @@ def build_aux_views(
 ):
     """The shared (numpy-lane + native-lane) auxiliary-view constructor.
 
-    ``mode`` is a RESOLVED aux mode ("packed" | "csr" | "pcsr" | "all" |
-    "none" — run resolve_aux first; "auto" is rejected here so the two
-    build lanes can't silently apply different policies). Unbuilt views
-    are [0]-shaped ([x, 0] for bitmaps and partition tables)
-    placeholders; the kernels raise loudly on them.
+    ``mode`` is a RESOLVED aux mode ("packed" | "csr" | "pcsr" | "kind"
+    | "all" | "none" — run resolve_aux first; "auto" is rejected here so
+    the two build lanes can't silently apply different policies).
+    Unbuilt views are [0]-shaped ([x, 0] for bitmaps and partition
+    tables) placeholders; the kernels raise loudly on them. "kind"
+    builds the packed bitmaps PLUS the kind-compressed views (int8
+    pattern + ss row offsets), so packed parity runs stay possible on a
+    kind build.
 
-    Returns the 15 PartitionGraph aux fields: (inc_trace_opmajor,
+    Returns the 16 PartitionGraph aux fields: (inc_trace_opmajor,
     sr_val_opmajor, inc_indptr_op, inc_indptr_trace, ss_indptr, cov_bits,
     ss_bits, inv_tracelen, inv_cov_dup, inv_outdeg, pc_trace, pc_sr_val,
-    pc_blk_indptr, pc_ell_op, pc_ell_rs).
+    pc_blk_indptr, pc_ell_op, pc_ell_rs, cov_i8).
     """
-    if mode not in ("packed", "csr", "pcsr", "all", "none"):
+    if mode not in ("packed", "csr", "pcsr", "kind", "all", "none"):
         raise ValueError(f"unresolved aux mode {mode!r}")
     if mode in ("csr", "all"):
         csr = csr_auxiliary(
@@ -481,7 +539,7 @@ def build_aux_views(
     packed = packed_aux(
         inc_op, inc_trace, sr_val, rs_val, ss_child, ss_parent, ss_val,
         n_inc, n_ss, v_pad, t_pad,
-        with_bitmaps=mode in ("packed", "all"),
+        with_bitmaps=mode in ("packed", "kind", "all"),
     )
     if mode in ("pcsr", "all"):
         pc = pcsr_auxiliary(
@@ -495,7 +553,14 @@ def build_aux_views(
             np.zeros((1, 0), np.int32),
             np.zeros((1, 0), np.float32),
         )
-    return csr + packed + pc
+    if mode == "kind":
+        cov_i8, ss_indptr = kind_aux(
+            packed[0], ss_child, n_ss, v_pad, t_pad
+        )
+        csr = csr[:4] + (ss_indptr,)
+    else:
+        cov_i8 = np.zeros((1, 0), np.int8)
+    return csr + packed + pc + (cov_i8,)
 
 
 def _build_partition(
@@ -575,7 +640,7 @@ def _build_partition(
     (
         tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
         cov_bits, ss_bits, inv_len, inv_cov, inv_out,
-        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs,
+        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs, cov_i8,
     ) = build_aux_views(
         p_inc_op, p_inc_trace, p_sr_val, p_rs_val,
         p_ss_child, p_ss_parent, p_ss_val,
@@ -612,6 +677,7 @@ def _build_partition(
         pc_blk_indptr=pc_blk,
         pc_ell_op=pc_ell_op,
         pc_ell_rs=pc_ell_rs,
+        cov_i8=cov_i8,
     )
     return graph, local_uniques
 
@@ -627,6 +693,7 @@ def build_window_graph(
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
     collapse: str = "off",
     retain_columns: bool = False,
+    kind_dedup_threshold: float = DEFAULT_KIND_DEDUP_THRESHOLD,
 ):
     """Build both partitions of a window over one shared op vocab.
 
@@ -731,6 +798,7 @@ def build_window_graph(
         graph, column_map = collapse_window_graph(
             graph, aux, pad_policy, min_pad, dense_budget_bytes, collapse,
             return_column_map=True,
+            kind_dedup_threshold=kind_dedup_threshold,
         )
     if retain_columns:
         return (
@@ -810,7 +878,7 @@ def _collapse_partition(
     (
         tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
         cov_bits, ss_bits, inv_len, inv_cov, inv_out,
-        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs,
+        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs, cov_i8,
     ) = build_aux_views(
         p_inc_op, p_inc_trace, p_sr_val, p_rs_val,
         part.ss_child, part.ss_parent, part.ss_val,
@@ -840,6 +908,7 @@ def _collapse_partition(
         pc_blk_indptr=pc_blk,
         pc_ell_op=pc_ell_op,
         pc_ell_rs=pc_ell_rs,
+        cov_i8=cov_i8,
     )
     return collapsed, first_idx[order]
 
@@ -853,7 +922,7 @@ def _rebuild_aux(part: PartitionGraph, mode: str) -> PartitionGraph:
     (
         tr_om, sr_om, indptr_op, indptr_trace, ss_indptr,
         cov_bits, ss_bits, inv_len, inv_cov, inv_out,
-        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs,
+        pc_trace, pc_sr, pc_blk, pc_ell_op, pc_ell_rs, cov_i8,
     ) = build_aux_views(
         part.inc_op, part.inc_trace, part.sr_val, part.rs_val,
         part.ss_child, part.ss_parent, part.ss_val,
@@ -875,7 +944,25 @@ def _rebuild_aux(part: PartitionGraph, mode: str) -> PartitionGraph:
         pc_blk_indptr=pc_blk,
         pc_ell_op=pc_ell_op,
         pc_ell_rs=pc_ell_rs,
+        cov_i8=cov_i8,
     )
+
+
+def kind_dedup_ratio(graph: WindowGraph) -> float:
+    """The window's measured trace-kind dedup factor: true traces /
+    distinct kind columns, summed over both partitions (1.0 on an
+    uncollapsed build). The observability satellite's one number — the
+    ``microrank_kind_dedup_ratio`` gauge, the journal's per-window
+    field and the bench artifact column all record this value, so the
+    kind auto-select threshold is tunable from real profiles."""
+    total_t = total_c = 0
+    for p in (graph.normal, graph.abnormal):
+        # [-1]-style int() reads so batched ([B]-leading) graphs work.
+        n_tr = int(np.max(np.asarray(p.n_traces)))
+        n_co = int(np.max(np.asarray(p.n_cols)))
+        total_t += n_tr
+        total_c += n_tr if n_co < 0 else n_co
+    return float(total_t) / float(max(total_c, 1))
 
 
 def collapse_window_graph(
@@ -886,6 +973,7 @@ def collapse_window_graph(
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
     collapse: str = "auto",
     return_column_map: bool = False,
+    kind_dedup_threshold: float = DEFAULT_KIND_DEDUP_THRESHOLD,
 ):
     """Kind-collapse both partitions' trace axes and (re)build aux views.
 
@@ -956,7 +1044,9 @@ def collapse_window_graph(
         for _, counts in groups
     )
     mode = resolve_aux(
-        aux, int(parts[0].cov_unique.shape[0]), t_pads, dense_budget_bytes
+        aux, int(parts[0].cov_unique.shape[0]), t_pads, dense_budget_bytes,
+        dedup=float(total_t) / float(max(total_g, 1)),
+        kind_dedup_threshold=kind_dedup_threshold,
     )
     collapsed = [
         _collapse_partition(p, mode, pad_policy, min_pad, grp)

@@ -113,6 +113,20 @@ class PartitionGraph(NamedTuple):
     pc_blk_indptr: np.ndarray = np.zeros((1, 0), np.int32)  # int32[P, V+1]
     pc_ell_op: np.ndarray = np.zeros((1, 0), np.int32)    # int32[T, W]
     pc_ell_rs: np.ndarray = np.zeros((1, 0), np.float32)  # float32[T, W]
+    # Kind-compressed reduced-precision view (kernel="kind", aux="kind"):
+    # the coverage PATTERN materialized as int8 over the (collapsed) kind
+    # column axis. 0/1 values are exact in every reduced dtype, so the
+    # device streams this matrix directly — int8 as-is, or cast once
+    # (loop-invariant) to bf16/f32 per PageRankConfig.kind_precision —
+    # with NO per-iteration bit-unpack arithmetic. That trade is the
+    # point: the packed kernel's roofline is shift/mask unpack compute,
+    # not bandwidth, and at the kind-collapsed width (K = distinct trace
+    # kinds << T) the 8x byte cost over the bitmap is noise while the
+    # unpack disappears. [x, 0] means "not built" (choose_kernel then
+    # avoids "kind"). The call-graph term never joins this matrix: the
+    # kind kernel computes it as an O(C) scatter-free row-sum over the
+    # ss edge list (ss_indptr), not a [V, V] matvec.
+    cov_i8: np.ndarray = np.zeros((1, 0), np.int8)        # int8[V, K]
 
 
 class WindowGraph(NamedTuple):

@@ -274,6 +274,7 @@ def _graph_from_padded(p):
         pc_blk_indptr=p.pc_blk_indptr,
         pc_ell_op=p.pc_ell_op,
         pc_ell_rs=p.pc_ell_rs,
+        cov_i8=p.cov_i8,
     )
 
 
@@ -289,6 +290,7 @@ def build_window_graph_from_table(
     dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
     collapse: str = "off",
     row_range: Tuple[int, int] | None = None,
+    kind_dedup_threshold: float | None = None,
 ) -> Tuple[WindowGraph, List[str], np.ndarray, np.ndarray]:
     """Both partitions' graphs from table rows — ints end to end.
 
@@ -309,9 +311,17 @@ def build_window_graph_from_table(
     O(table) on multi-window replays. ``mask`` may be table-length or
     already slice-local (length hi-lo, as with_range returns it).
 
+    ``kind_dedup_threshold``: the measured-dedup factor past which a
+    collapsed auto build constructs the kind-compressed views
+    (RuntimeConfig.kind_dedup_threshold; None = the build module's
+    default).
+
     Returns (graph, op_names, normal_codes, abnormal_codes).
     """
-    from .build import collapse_window_graph
+    from .build import DEFAULT_KIND_DEDUP_THRESHOLD, collapse_window_graph
+
+    if kind_dedup_threshold is None:
+        kind_dedup_threshold = DEFAULT_KIND_DEDUP_THRESHOLD
 
     vocab_size = len(table.pod_op_names)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
@@ -353,7 +363,7 @@ def build_window_graph_from_table(
         if collapse != "off":
             return collapse_window_graph(
                 graph, aux, pad_policy, min_pad, dense_budget_bytes,
-                collapse,
+                collapse, kind_dedup_threshold=kind_dedup_threshold,
             )
         return graph
 
@@ -394,6 +404,7 @@ def build_window_graph_from_table(
                     collapse=collapse,
                     dense_budget_bytes=dense_budget_bytes,
                     parent_base=lo,
+                    kind_dedup_threshold=kind_dedup_threshold,
                 )
             except NativeUnavailable:
                 raw_n = raw_a = None  # fall through to the numpy lane

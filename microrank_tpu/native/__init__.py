@@ -450,6 +450,11 @@ class PaddedPartition(NamedTuple):
     pc_blk_indptr: np.ndarray = np.zeros((1, 0), np.int32)
     pc_ell_op: np.ndarray = np.zeros((1, 0), np.int32)
     pc_ell_rs: np.ndarray = np.zeros((1, 0), np.float32)
+    # Kind-compressed reduced-precision view (kernel="kind"): int8
+    # coverage pattern over the collapsed kind axis, derived from the
+    # C++-exported bitmap by graph.build.kind_aux (shared with the
+    # pandas lane so the two builders cannot diverge).
+    cov_i8: np.ndarray = np.zeros((1, 0), np.int8)
 
 
 def build_window_padded(
@@ -466,6 +471,7 @@ def build_window_padded(
     collapse: str = "off",
     dense_budget_bytes: Optional[int] = None,
     parent_base: int = 0,
+    kind_dedup_threshold: Optional[float] = None,
 ) -> Tuple[PaddedPartition, PaddedPartition]:
     """Build both partitions' COO graphs in C++ (fused single scans),
     exported directly into padded numpy buffers (single copy).
@@ -492,7 +498,7 @@ def build_window_padded(
     their edge, same as -1.
     """
     if mode not in (
-        "packed", "csr", "pcsr", "all", "none", "auto", "auto_all"
+        "packed", "csr", "pcsr", "kind", "all", "none", "auto", "auto_all"
     ):
         raise ValueError(f"unknown aux mode {mode!r}")
     if mode in ("auto", "auto_all") and collapse == "off":
@@ -553,16 +559,33 @@ def build_window_padded(
         sizes = np.zeros(8, dtype=np.int64)
         lib.mr_window_sizes(handle, sizes.ctypes.data_as(i64p))
         if mode in ("auto", "auto_all"):
-            from ..graph.build import resolve_aux
+            from ..graph.build import (
+                DEFAULT_KIND_DEDUP_THRESHOLD,
+                resolve_aux,
+            )
 
             t_pads = (pad(int(sizes[2])), pad(int(sizes[6])))
+            # The collapse already ran, so the measured dedup factor
+            # (true traces / kind columns) is known here — the same
+            # auto -> "kind" decision the pandas lane's collapse
+            # post-pass makes (resolve_aux holds the one policy).
+            dedup = None
+            if true_traces is not None:
+                cols = int(sizes[2]) + int(sizes[6])
+                dedup = float(sum(true_traces)) / float(max(cols, 1))
             mode = resolve_aux(
                 mode, v_pad, t_pads,
                 *(() if dense_budget_bytes is None
                   else (dense_budget_bytes,)),
+                dedup=dedup,
+                kind_dedup_threshold=(
+                    DEFAULT_KIND_DEDUP_THRESHOLD
+                    if kind_dedup_threshold is None
+                    else kind_dedup_threshold
+                ),
             )
         out = []
-        want_bits = mode in ("packed", "all")
+        want_bits = mode in ("packed", "kind", "all")
         want_csr = mode in ("csr", "all")
         want_pc = mode in ("pcsr", "all")
         for idx in range(2):
@@ -662,6 +685,15 @@ def build_window_padded(
                     pc_blk_indptr=pc_blk, pc_ell_op=pc_eop,
                     pc_ell_rs=pc_ers,
                 )
+            if mode == "kind":
+                # Kind-compressed views from the exported bitmap + edge
+                # list (the shared constructor — graph.build.kind_aux).
+                from ..graph.build import kind_aux
+
+                cov_i8, ss_indptr = kind_aux(
+                    p.cov_bits, p.ss_child, n_ss, v_pad, t_pad
+                )
+                p = p._replace(cov_i8=cov_i8, ss_indptr=ss_indptr)
             out.append(p)
         return out[0], out[1]
     finally:

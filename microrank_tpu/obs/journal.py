@@ -79,6 +79,7 @@ class RunJournal:
             rank_iterations=result.rank_iterations,
             rank_residual=result.rank_residual,
             kernel=result.kernel,
+            kind_dedup=result.kind_dedup,
             queue_depth=(
                 queue_depth if queue_depth is not None
                 else result.queue_depth

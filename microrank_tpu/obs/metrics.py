@@ -314,6 +314,22 @@ def kernel_ms_per_iter() -> Gauge:
     )
 
 
+def kind_dedup_gauge() -> Gauge:
+    return get_registry().gauge(
+        "microrank_kind_dedup_ratio",
+        "Trace-kind dedup factor of the most recent built window (true "
+        "traces / distinct kind columns, both partitions; 1.0 on an "
+        "uncollapsed build) — the measured signal behind the "
+        "kernel='kind' auto-select threshold "
+        "(RuntimeConfig.kind_dedup_threshold)",
+    )
+
+
+def record_kind_dedup(ratio: float) -> None:
+    """Per-window dedup-factor telemetry (host side, at graph build)."""
+    kind_dedup_gauge().set(float(ratio))
+
+
 def profile_sessions() -> Counter:
     return get_registry().counter(
         "microrank_profile_sessions_total",

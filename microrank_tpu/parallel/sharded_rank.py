@@ -37,7 +37,7 @@ except AttributeError:  # pragma: no cover
 # axes, pcsr shards the PARTITION axis of its binned tables (each device
 # scans a contiguous block of source partitions), packed shards the
 # TRACE axis.
-SHARD_KERNELS = ("coo", "csr", "pcsr", "packed", "packed_bf16")
+SHARD_KERNELS = ("coo", "csr", "pcsr", "packed", "packed_bf16", "kind")
 
 
 def _pad_axis0(arr: np.ndarray, size: int, fill=0) -> np.ndarray:
@@ -103,6 +103,12 @@ def stack_window_graphs(
         # edge list) while cov_bits stays host-packed.
         have_cov = all(p.cov_bits.shape[1] for p in parts)
         have_ss = all(p.ss_bits.shape[1] for p in parts)
+        # Kind-compressed views: the int8 pattern pads 2D like the
+        # bitmaps (zero columns are inert); its ss row offsets ride
+        # ss_indptr, which a "kind" build fills WITHOUT the other csr
+        # views — stack it whenever present, independent of have_csr.
+        have_kind = all(p.cov_i8.shape[-1] for p in parts)
+        have_ssptr = all(p.ss_indptr.shape[0] for p in parts)
         # indptr re-padding: a row-offset array padded with its last real
         # value keeps every added row an empty range (the arrays end at the
         # true entry count, so repeating indptr[-1] is exact).
@@ -206,7 +212,7 @@ def stack_window_graphs(
             ),
             ss_indptr=(
                 np.stack([pad_indptr(p.ss_indptr, v) for p in parts])
-                if have_csr
+                if have_ssptr
                 else np.zeros((len(parts), 0), np.int32)
             ),
             # Bitmaps: 2D zero-pad is exact (absent rows/traces are 0 bits).
@@ -251,6 +257,11 @@ def stack_window_graphs(
             pc_blk_indptr=stack_pc_indptr(),
             pc_ell_op=stack_pc_ell(lambda p: p.pc_ell_op, np.int32),
             pc_ell_rs=stack_pc_ell(lambda p: p.pc_ell_rs, np.float32),
+            cov_i8=(
+                np.stack([_pad2d(p.cov_i8, v, t) for p in parts])
+                if have_kind
+                else np.zeros((len(parts), v, 0), np.int8)
+            ),
         )
 
     return WindowGraph(
@@ -351,6 +362,10 @@ def stage_sharded(graphs, mesh: Mesh, kernel: str):
     shard_n = int(mesh.devices.shape[1])
     if kernel in ("packed", "packed_bf16"):
         trace_multiple = 8 * shard_n  # whole bitmap BYTES per shard
+    elif kernel == "kind":
+        # The int8 pattern has byte columns (no bit packing), so the
+        # kind axis only needs to divide the shard count.
+        trace_multiple = shard_n
     elif kernel == "pcsr":
         # The trace axis must tile exactly into whole source partitions
         # AND whole per-shard partition blocks, so each device's y_r
@@ -378,6 +393,49 @@ def _partition_specs(
 ) -> PartitionGraph:
     entry = P(window_axis, shard_axis)   # big COO entry axes: sharded
     per_window = P(window_axis)          # [V]/[T]/scalar arrays: replicated
+    if kernel == "kind":
+        # Kind-column sharding — the trace-sharded packed layout on the
+        # int8 pattern: each device holds a [V, K/S] COLUMN block of
+        # cov_i8 plus the matching [K/S] blocks of the kind-axis
+        # vectors (rv lives sharded through the whole iteration); the
+        # ss edge list + row offsets and every [V] array replicate (the
+        # O(C) row-sum is replicated work, the kernel's substitute for
+        # the replicated b_ss matvec).
+        trace = P(window_axis, shard_axis)
+        return PartitionGraph(
+            inc_op=entry,
+            inc_trace=entry,
+            sr_val=entry,
+            rs_val=entry,
+            ss_child=per_window,
+            ss_parent=per_window,
+            ss_val=per_window,
+            inc_trace_opmajor=entry,
+            sr_val_opmajor=entry,
+            inc_indptr_op=per_window,
+            inc_indptr_trace=per_window,
+            ss_indptr=per_window,
+            cov_bits=per_window,
+            ss_bits=per_window,
+            inv_tracelen=trace,
+            inv_cov_dup=per_window,
+            inv_outdeg=per_window,
+            kind=trace,
+            tracelen=trace,
+            cov_unique=per_window,
+            op_present=per_window,
+            n_ops=per_window,
+            n_traces=per_window,
+            n_inc=per_window,
+            n_ss=per_window,
+            n_cols=per_window,
+            pc_trace=per_window,
+            pc_sr_val=per_window,
+            pc_blk_indptr=per_window,
+            pc_ell_op=per_window,
+            pc_ell_rs=per_window,
+            cov_i8=P(window_axis, None, shard_axis),
+        )
     if kernel in ("packed", "packed_bf16"):
         # Trace-sharded layout: each device holds a COLUMN block of the
         # coverage bitmap ([V, T8/S] bytes) plus the matching [T/S]
@@ -421,6 +479,7 @@ def _partition_specs(
             pc_blk_indptr=per_window,
             pc_ell_op=per_window,
             pc_ell_rs=per_window,
+            cov_i8=per_window,
         )
     if kernel == "pcsr":
         # Partition-axis sharding: each device holds a contiguous block
@@ -460,6 +519,7 @@ def _partition_specs(
             pc_blk_indptr=pc,
             pc_ell_op=pc,
             pc_ell_rs=pc,
+            cov_i8=per_window,
         )
     return PartitionGraph(
         inc_op=entry,
@@ -498,6 +558,7 @@ def _partition_specs(
         pc_blk_indptr=per_window,
         pc_ell_op=per_window,
         pc_ell_rs=per_window,
+        cov_i8=per_window,
     )
 
 
@@ -564,7 +625,12 @@ def _rank_windows_sharded_impl(
     * "packed" / "packed_bf16" — the MXU bitmap kernel with the TRACE
       axis sharded (bitmap column blocks; rv stays distributed), ONE
       psum per iteration. Needs aux="packed"/"all" graphs stacked with
-      ``trace_multiple = 8 * mesh.shape['shard']``.
+      ``trace_multiple = 8 * mesh.shape['shard']``;
+    * "kind" — the kind-compressed kernel with its KIND column axis
+      sharded exactly like packed's trace axis (int8 pattern column
+      blocks, ONE psum per iteration; the O(C) ss row-sum replicates).
+      Needs aux="kind" graphs stacked with
+      ``trace_multiple = mesh.shape['shard']``.
 
     Returns (top_idx [B, k], top_scores [B, k], n_valid [B]).
     """
@@ -575,6 +641,22 @@ def _rank_windows_sharded_impl(
         )
     if kernel == "pcsr":
         _validate_sharded_pcsr(batched, mesh)
+    if kernel == "kind":
+        shard_n = int(
+            dict(zip(mesh.axis_names, mesh.devices.shape))[SHARD_AXIS]
+        )
+        t_pad = int(batched.normal.kind.shape[-1])
+        if int(batched.normal.cov_i8.shape[-1]) == 0:
+            raise ValueError(
+                "sharded kind kernel needs kind-compressed graphs — "
+                "build with aux='kind'"
+            )
+        if t_pad % shard_n:
+            raise ValueError(
+                f"sharded kind kernel needs the kind axis divisible by "
+                f"the shard count ({shard_n}); stack with "
+                f"trace_multiple={shard_n}"
+            )
     if kernel in ("packed", "packed_bf16"):
         shard_n = int(dict(zip(mesh.axis_names, mesh.devices.shape))[SHARD_AXIS])
         t_pad = int(batched.normal.kind.shape[-1])

@@ -42,6 +42,11 @@ class WindowResult:
     # Dispatch route the window's device program took ("vmapped" |
     # "sharded", dispatch router); None off the router paths.
     route: Optional[str] = None
+    # Measured trace-kind dedup factor of the window's graph build
+    # (graph.build.kind_dedup_ratio — true traces / distinct kind
+    # columns; 1.0 uncollapsed, None when the window wasn't built).
+    # The per-window journal twin of microrank_kind_dedup_ratio.
+    kind_dedup: Optional[float] = None
     # Request-scoped fields (serve/ subsystem): the caller-supplied
     # request id and tenant, whether the response came from the
     # numpy_ref fallback after a failed device dispatch, and how many

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..config import MicroRankConfig
 from ..detect import detect_numpy
+from ..graph.build import kind_dedup_ratio
 from ..graph.table_ops import (
     build_window_graph_from_table,
     compute_slo_from_table,
@@ -198,6 +199,7 @@ class TableRCA:
             dense_budget_bytes=cfg.runtime.dense_budget_bytes,
             collapse=cfg.runtime.collapse_kinds,
             row_range=row_range,
+            kind_dedup_threshold=cfg.runtime.kind_dedup_threshold,
         )
         if self._mesh is not None:
             if int(self._mesh.devices.shape[0]) != 1:
@@ -216,6 +218,9 @@ class TableRCA:
                     cfg.runtime.dense_budget_bytes,
                     cfg.runtime.prefer_bf16,
                 )
+        from ..obs.metrics import record_kind_dedup
+
+        record_kind_dedup(kind_dedup_ratio(graph))
         return graph, op_names, shard_kernel
 
     def _conv_enabled(self) -> bool:
@@ -879,6 +884,7 @@ class TableRCA:
                                 table, mask, nrm, abn, row_range
                             )
                         result.kernel = kernel
+                        result.kind_dedup = kind_dedup_ratio(graph)
                         result.queue_depth = len(inflight)
                         chunk_pending.append(
                             (result, graph, op_names, kernel, timings)
@@ -899,6 +905,7 @@ class TableRCA:
                                 table, mask, nrm, abn, row_range
                             )
                             result.kernel = prep[2]
+                            result.kind_dedup = kind_dedup_ratio(prep[0])
                             if stage_pool is not None:
                                 handles = stage_pool.submit(
                                     self.launch_rank, *prep
@@ -958,7 +965,7 @@ class TableRCA:
         per_device = -(-len(pending) // w_n)
         build_aux = aux_for_kernel(kernel, sharded=self._mesh is not None)
         with timings.stage("build"):
-            for _, mask, nrm, abn, row_range in pending:
+            for res, mask, nrm, abn, row_range in pending:
                 graph, _, _, _ = build_window_graph_from_table(
                     table, mask, nrm, abn,
                     pad_policy=cfg.runtime.pad_policy,
@@ -969,7 +976,9 @@ class TableRCA:
                     ),
                     collapse=cfg.runtime.collapse_kinds,
                     row_range=row_range,
+                    kind_dedup_threshold=cfg.runtime.kind_dedup_threshold,
                 )
+                res.kind_dedup = kind_dedup_ratio(graph)
                 graphs.append(graph)
         conv = self._conv_enabled()
         with timings.stage("rank_batched"):

@@ -74,12 +74,18 @@ def _decode_leaf(blob, dtype_str: str, shape: Tuple[int, ...], off: int, n_words
         return lax.bitcast_convert_type(w, jnp.float32).reshape(shape)
     if dtype_str == "int32":
         return lax.bitcast_convert_type(w, jnp.int32).reshape(shape)
-    if dtype_str in ("uint8", "bool"):
+    if dtype_str in ("uint8", "bool", "int8"):
         n = math.prod(shape)
         shifts = jnp.arange(4, dtype=jnp.uint32) * 8
         by = ((w[:, None] >> shifts) & jnp.uint32(0xFF)).astype(jnp.uint8)
         a = by.reshape(-1)[:n].reshape(shape)
-        return a != 0 if dtype_str == "bool" else a
+        if dtype_str == "bool":
+            return a != 0
+        if dtype_str == "int8":
+            # Same-width bitcast, not a value convert — int8 fields
+            # (the kind view's 0/1 pattern) round-trip bit-exactly.
+            return lax.bitcast_convert_type(a, jnp.int8)
+        return a
     raise TypeError(f"blob staging: unsupported leaf dtype {dtype_str!r}")
 
 

@@ -93,6 +93,22 @@ def preference_vector(
     return jnp.where(live, pref, 0.0).astype(jnp.float32)
 
 
+def quantize_i8(x):
+    """Symmetric per-vector scaled-int8 quantization — the fixed-point
+    operand representation of the streaming-SpMV PPR formulation (arxiv
+    2009.10443), applied to the kind kernel's iteration vectors:
+    scale = max|x|/127 (guarded for the all-zero vector),
+    q = round(x/scale) clamped to [-127, 127]. Returns (q int8,
+    scale f32 0-d). Against the 0/1 int8 pattern matrix the int32
+    accumulation is EXACT (|sum| <= 127*K << 2^31), so operand
+    quantization is the only rounding and one f32 multiply undoes the
+    scale."""
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
 def unpack_bits(bits, n_cols: int, dtype=jnp.float32):
     """Device-side bitmap expansion: uint8[V, C] -> dtype[V, n_cols].
 
@@ -291,7 +307,8 @@ def _partition_setup(
     n_total = (g.n_ops + g.n_traces).astype(jnp.float32)
     rv_axis = (
         psum_axis
-        if psum_axis is not None and kernel in ("packed", "packed_bf16")
+        if psum_axis is not None
+        and kernel in ("packed", "packed_bf16", "kind")
         else None
     )
     t_base = 0 if rv_axis is None else lax.axis_index(rv_axis) * t_pad
@@ -461,6 +478,129 @@ def _partition_setup(
                     preferred_element_type=jnp.float32,
                 ),
             )
+
+    elif kernel == "kind":
+        # Kind-compressed, reduced-precision iteration (ROADMAP item 1;
+        # representation per the FPGA streaming-SpMV PPR work, arxiv
+        # 2009.10443, keeping the fused single-dispatch shape of
+        # FUSED-PAGERANK, arxiv 2203.09284). Two changes vs "packed",
+        # both aimed at the measured roofline (DESIGN.md "Device time
+        # and utilization": the packed loop is capped by shift/mask
+        # UNPACK ARITHMETIC over matrix cells, not by bandwidth or MXU):
+        #
+        #   * the coverage matrix is the MATERIALIZED int8 0/1 pattern
+        #     over the kind-collapsed column axis (graph build already
+        #     folded each kind's multiplicity/len into inv_tracelen and
+        #     the preference sums weight by multiplicity — PageRank over
+        #     weighted unique kinds is exactly the per-trace iteration).
+        #     0/1 is exact in int8, the 8x byte cost over the bitmap is
+        #     amortized by the dedup-factor column shrink, and the
+        #     per-iteration unpack disappears: the matrix streams as-is
+        #     (int8) or through one loop-invariant cast (bf16/f32);
+        #   * the call-graph term never becomes a [V, V] matvec (the
+        #     dominant cell count once the coverage axis collapsed): it
+        #     is an O(C) scatter-free row-sum over the ss edge list —
+        #     gather + compensated cumsum differenced at ss_indptr —
+        #     and the call graph has C ~ V*fanout unique edges, a tiny
+        #     fraction of V^2 cells.
+        #
+        # Precision (cfg.kind_precision): "f32" (default — the cast
+        # matvec is bit-identical to the f32 packed kernel, so
+        # auto-selection preserves every tight-parity guarantee) /
+        # "bf16" cast the pattern once and run packed-style
+        # mixed-precision matvecs (f32 accumulate via
+        # preferred_element_type); "int8" keeps the pattern int8 and
+        # QUANTIZES the operand vector per iteration (quantize_i8:
+        # symmetric max|x|/127 scale), accumulating in int32 — exact
+        # accumulation, operand quantization the only rounding, one f32
+        # multiply rescales. The f64 sparse oracle pins tie-aware top-k
+        # parity for every precision in the tests.
+        #
+        # Sharded (psum_axis set): the KIND column axis distributes
+        # exactly like the packed kernel's trace axis — each device
+        # holds a [V, K/S] pattern block and local [K/S] vectors, ONE
+        # psum combines the coverage partials, y_r needs no collective,
+        # and the O(C) ss row-sum is replicated work outside the psum
+        # (the packed kernel's replicated-b_ss argument, at 1/V-th the
+        # flops).
+        if g.cov_i8.shape[-1] == 0:
+            raise ValueError(
+                "kernel='kind' needs the kind-compressed views, but "
+                "this window was built without them — build with "
+                "aux='kind' (collapse_kinds != 'off' resolves "
+                "aux='auto' to it past the dedup threshold)"
+            )
+        if g.ss_indptr.shape[-1] == 0:
+            raise ValueError(
+                "kernel='kind' needs the call-edge row offsets — build "
+                "with aux='kind'"
+            )
+        precision = str(getattr(cfg, "kind_precision", "bf16"))
+        if precision not in ("int8", "bf16", "f32"):
+            raise ValueError(
+                f"unknown kind_precision {precision!r} "
+                "(expected 'int8' | 'bf16' | 'f32')"
+            )
+        if precision == "int8":
+            q_mat = g.cov_i8
+
+            def cov_pair(x_col, x_row):
+                qc, sc = quantize_i8(x_col)
+                qr, sr = quantize_i8(x_row)
+                y_fwd = sc * jnp.dot(
+                    q_mat, qc, preferred_element_type=jnp.int32
+                ).astype(jnp.float32)
+                y_bwd = sr * jnp.dot(
+                    qr, q_mat, preferred_element_type=jnp.int32
+                ).astype(jnp.float32)
+                return y_fwd, y_bwd
+
+        else:
+            mat_dtype = (
+                jnp.bfloat16 if precision == "bf16" else jnp.float32
+            )
+            m = g.cov_i8.astype(mat_dtype)  # loop-invariant: cast once
+
+            def cov_pair(x_col, x_row):
+                return (
+                    jnp.dot(
+                        m,
+                        x_col.astype(mat_dtype),
+                        preferred_element_type=jnp.float32,
+                    ),
+                    jnp.dot(
+                        x_row.astype(mat_dtype),
+                        m,
+                        preferred_element_type=jnp.float32,
+                    ),
+                )
+
+        from ..ops.segment import compensated_cumsum
+
+        def ss_rowsum(sv):
+            # Scatter-free O(C) call-graph term: same compensated
+            # prefix-difference as the csr kernel's rowsum (position-
+            # independent rounding keeps exact ties exact), over the
+            # REPLICATED edge list — base 0 in every layout.
+            prod = g.ss_val * jnp.take(sv, g.ss_parent)
+            hi, lo_c = compensated_cumsum(prod)
+            z = jnp.zeros((1,), jnp.float32)
+            hi = jnp.concatenate([z, hi])
+            lo_c = jnp.concatenate([z, lo_c])
+            a = g.ss_indptr[:-1]
+            b = g.ss_indptr[1:]
+            return (jnp.take(hi, b) - jnp.take(hi, a)) + (
+                jnp.take(lo_c, b) - jnp.take(lo_c, a)
+            )
+
+        w_len = g.inv_tracelen
+        w_cov = g.inv_cov_dup
+
+        # reduce_shards psums over psum_axis == rv_axis here: ONLY the
+        # coverage partials sum; the replicated ss term stays outside.
+        def matvecs(sv, rv):
+            y_cov, y_r = cov_pair(rv * w_len, sv * w_cov)
+            return reduce_shards(y_cov) + alpha * ss_rowsum(sv), y_r
 
     elif kernel == "packed_blocked":
         # The at-scale packed path (VERDICT r3 #4): same math and same
@@ -948,17 +1088,24 @@ def rank_window_core(
     spectrum_cfg: SpectrumConfig,
     psum_axis: str | None = None,
     kernel: str = "coo",
+    init=None,
 ):
     """The full single-window ranking: both partitions' power iterations,
     spectrum, top-k. Pure traced function — jit it (single device), vmap
     it (window batches), or call it under shard_map with the entry axes
     sharded and ``psum_axis`` set (multi-chip).
 
+    ``init``: optional warm-start (sv_n, rv_n, sv_a, rv_a) vectors (the
+    previous overlapping window's converged state mapped across the
+    window delta — rank_backends.warm); None is the cold uniform start.
+
     Returns (top_idx int32[k], top_scores float32[k], n_valid int32):
     indices into the shared window op vocab, score-descending;
     entries beyond ``n_valid`` are padding (score -inf).
     """
-    n_weight, a_weight = window_weights(graph, pagerank_cfg, psum_axis, kernel)
+    n_weight, a_weight = window_weights(
+        graph, pagerank_cfg, psum_axis, kernel, init
+    )
     return _finish_topk(graph, n_weight, a_weight, spectrum_cfg)
 
 
@@ -974,6 +1121,7 @@ def rank_window_traced_core(
     spectrum_cfg: SpectrumConfig,
     psum_axis: str | None = None,
     kernel: str = "coo",
+    init=None,
 ):
     """rank_window_core plus the device-side convergence trace
     (RuntimeConfig.convergence_trace — the pipelines' default program).
@@ -992,7 +1140,7 @@ def rank_window_traced_core(
     bytes; measured <1% on the 1M-span replay.
     """
     n_weight, a_weight, residuals, n_iters = window_weights_traced(
-        graph, pagerank_cfg, psum_axis, kernel
+        graph, pagerank_cfg, psum_axis, kernel, init
     )
     top_idx, top_scores, n_valid = _finish_topk(
         graph, n_weight, a_weight, spectrum_cfg
@@ -1000,11 +1148,54 @@ def rank_window_traced_core(
     return top_idx, top_scores, n_valid, residuals, n_iters
 
 
+def _warm_override(graph: WindowGraph, cold, init, psum_axis):
+    """Replace the cold-start iteration vectors with a warm-start init
+    (the down payment on ROADMAP item 2): ``init`` is a
+    (sv_n, rv_n, sv_a, rv_a) tuple of float32 vectors padded to the
+    graph's axes — ``rank_backends.warm.map_warm_state`` builds it
+    host-side across the window delta (op names for sv, the kind
+    retention map's column identities for rv). Entries at padding
+    positions are masked off, and a side whose init carries no mass (an
+    all-miss mapping) falls back to its cold vector, so the program can
+    never divide by a zero max on a bad map. Scale is irrelevant under
+    max_normalize_each_iter; without it the first normalization inside
+    _partition_finish still absorbs it.
+    """
+    if init is None:
+        return cold
+    if psum_axis is not None:
+        raise ValueError(
+            "warm-start init is single-device only (the trace-sharded "
+            "kernels keep rv as local blocks); dispatch warm windows "
+            "unsharded"
+        )
+    (sv_n_c, rv_n_c), (sv_a_c, rv_a_c) = cold
+    sv_n_i, rv_n_i, sv_a_i, rv_a_i = (
+        jnp.asarray(x, jnp.float32) for x in init
+    )
+
+    def pick(g, sv_c, rv_c, sv_i, rv_i):
+        t_pad = g.kind.shape[0]
+        n_live = jnp.where(g.n_cols < 0, g.n_traces, g.n_cols)
+        sv_i = jnp.where(g.op_present, sv_i, 0.0)
+        rv_i = jnp.where(jnp.arange(t_pad) < n_live, rv_i, 0.0)
+        return (
+            jnp.where(jnp.max(sv_i) > 0, sv_i, sv_c),
+            jnp.where(jnp.max(rv_i) > 0, rv_i, rv_c),
+        )
+
+    return (
+        pick(graph.normal, sv_n_c, rv_n_c, sv_n_i, rv_n_i),
+        pick(graph.abnormal, sv_a_c, rv_a_c, sv_a_i, rv_a_i),
+    )
+
+
 def window_weights(
     graph: WindowGraph,
     pagerank_cfg: PageRankConfig,
     psum_axis: str | None = None,
     kernel: str = "coo",
+    init=None,
 ):
     """Both partitions' PageRank weights, iterated together.
 
@@ -1012,13 +1203,17 @@ def window_weights(
     independent; fusing halves the loop-body op count and lets XLA
     schedule the small partition's matvecs into the big one's gaps).
     Per-partition math is identical to partition_pagerank.
-    Returns (n_weight[V], a_weight[V]).
+    ``init``: optional warm-start (sv_n, rv_n, sv_a, rv_a) override
+    (_warm_override). Returns (n_weight[V], a_weight[V]).
     """
     mv_n, pref_n, sv_n, rv_n, ax_n = _partition_setup(
         graph.normal, False, pagerank_cfg, psum_axis, kernel
     )
     mv_a, pref_a, sv_a, rv_a, ax_a = _partition_setup(
         graph.abnormal, True, pagerank_cfg, psum_axis, kernel
+    )
+    (sv_n, rv_n), (sv_a, rv_a) = _warm_override(
+        graph, ((sv_n, rv_n), (sv_a, rv_a)), init, psum_axis
     )
 
     def step(carry):
@@ -1041,6 +1236,7 @@ def window_weights_traced(
     pagerank_cfg: PageRankConfig,
     psum_axis: str | None = None,
     kernel: str = "coo",
+    init=None,
 ):
     """window_weights plus the per-partition convergence trace.
 
@@ -1055,8 +1251,8 @@ def window_weights_traced(
 
     Returns (n_weight[V], a_weight[V], residuals[2, I], n_iters int32).
     """
-    n_weight, a_weight, _, _, residuals, n_iters = window_weights_full(
-        graph, pagerank_cfg, psum_axis, kernel
+    n_weight, a_weight, _, _, residuals, n_iters, _, _ = (
+        window_weights_full(graph, pagerank_cfg, psum_axis, kernel, init)
     )
     return n_weight, a_weight, residuals, n_iters
 
@@ -1066,6 +1262,7 @@ def window_weights_full(
     pagerank_cfg: PageRankConfig,
     psum_axis: str | None = None,
     kernel: str = "coo",
+    init=None,
 ):
     """window_weights_traced plus the FINAL trace-partition vectors —
     the rank-provenance seam (explain/): the per-trace PPR mass ``rv``
@@ -1073,7 +1270,11 @@ def window_weights_full(
     (contribution of trace t to suspect v = p_sr[v, t] * rv[t]).
 
     Returns (n_weight[V], a_weight[V], rv_n[T_n], rv_a[T_a],
-    residuals[2, I], n_iters int32). Under the trace-sharded packed
+    residuals[2, I], n_iters int32, score_n[V], score_a[V]) — the score
+    vectors are the final max-normalized sv per partition, which with
+    the rv vectors form the warm-start state the next overlapping
+    window can iterate from (``init``: the (sv_n, rv_n, sv_a, rv_a)
+    override; see _warm_override). Under the trace-sharded packed/kind
     kernels the rv vectors stay LOCAL blocks (the explain epilogue
     all-gathers them where needed).
     """
@@ -1083,6 +1284,9 @@ def window_weights_full(
     )
     mv_a, pref_a, sv_a, rv_a, ax_a = _partition_setup(
         graph.abnormal, True, cfg, psum_axis, kernel
+    )
+    (sv_n, rv_n), (sv_a, rv_a) = _warm_override(
+        graph, ((sv_n, rv_n), (sv_a, rv_a)), init, psum_axis
     )
     n_steps = int(cfg.iterations)
 
@@ -1154,9 +1358,12 @@ def window_weights_full(
             cond, body, (jnp.int32(0), carry0, delta0, res0)
         )
     (sv_n, rv_n), (sv_a, rv_a) = carry
-    n_weight, _ = _partition_finish(graph.normal, sv_n)
-    a_weight, _ = _partition_finish(graph.abnormal, sv_a)
-    return n_weight, a_weight, rv_n, rv_a, residuals, jnp.int32(n_iters)
+    n_weight, score_n = _partition_finish(graph.normal, sv_n)
+    a_weight, score_a = _partition_finish(graph.abnormal, sv_a)
+    return (
+        n_weight, a_weight, rv_n, rv_a, residuals, jnp.int32(n_iters),
+        score_n, score_a,
+    )
 
 
 @contract(
@@ -1361,9 +1568,49 @@ def rank_window_checked_traced(
     return out
 
 
+@contract(
+    graph="windowgraph",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]",
+        "float32[V]", "float32[T]", "float32[V]", "float32[U]",
+    ),
+)
+def rank_window_warm_core(
+    graph: WindowGraph,
+    init,
+    pagerank_cfg: PageRankConfig,
+    spectrum_cfg: SpectrumConfig,
+    kernel: str = "coo",
+):
+    """The warm-start ranking program (the stream engine's open-incident
+    dispatch): rank_window_traced_core's 5 outputs PLUS the converged
+    per-partition state (score_n[V], rv_n[T_n], score_a[V], rv_a[T_a])
+    riding the same fetch, so the NEXT overlapping window can start its
+    iteration from this one's fixed point instead of the uniform vector.
+    ``init`` is the mapped (sv_n, rv_n, sv_a, rv_a) tuple or None (a
+    cold solve that still exports its state — the seam's first window).
+    With a convergence tol configured the residual trace proves the
+    iteration count drops; without one the cost is identical to the
+    traced program.
+    """
+    n_weight, a_weight, rv_n, rv_a, residuals, n_iters, sc_n, sc_a = (
+        window_weights_full(graph, pagerank_cfg, None, kernel, init)
+    )
+    top_idx, top_scores, n_valid = _finish_topk(
+        graph, n_weight, a_weight, spectrum_cfg
+    )
+    return (
+        top_idx, top_scores, n_valid, residuals, n_iters,
+        sc_n, rv_n, sc_a, rv_a,
+    )
+
+
 rank_window_device = jax.jit(rank_window_core, static_argnums=(1, 2, 3, 4))
 rank_window_traced_device = jax.jit(
     rank_window_traced_core, static_argnums=(1, 2, 3, 4)
+)
+rank_window_warm_device = jax.jit(
+    rank_window_warm_core, static_argnums=(2, 3, 4)
 )
 rank_window_all_methods_device = jax.jit(
     rank_window_all_methods_core, static_argnums=(1, 2, 3, 4)
@@ -1376,7 +1623,7 @@ _PACKED_UNUSED = (
     # ~19 of 28 MB at the 1M-span scale) never reach the traced branch.
     # Partition-centric tables (aux="all" builds) are pcsr-only.
     "inc_op", "inc_trace", "sr_val", "rs_val", "ss_val",
-    "inc_trace_opmajor", "sr_val_opmajor",
+    "inc_trace_opmajor", "sr_val_opmajor", "cov_i8",
     "pc_trace", "pc_sr_val", "pc_blk_indptr", "pc_ell_op", "pc_ell_rs",
 )
 # The pcsr kernel reads the partition tables, the call-edge list and the
@@ -1389,8 +1636,21 @@ _PCSR_UNUSED = (
     "inc_trace_opmajor", "sr_val_opmajor",
     "inc_indptr_op", "inc_indptr_trace", "ss_indptr",
     "cov_bits", "ss_bits", "inv_tracelen", "inv_cov_dup", "inv_outdeg",
+    "cov_i8",
 )
 _PC_FIELDS = ("pc_trace", "pc_sr_val", "pc_blk_indptr", "pc_ell_op", "pc_ell_rs")
+# The kind kernel reads cov_i8, the inverse vectors, the ss edge values
+# + parents + row offsets, and the per-axis stats. Everything else —
+# the COO incidence arrays, CSR op-major copies, BOTH bitmaps (the int8
+# pattern replaces cov_bits on device; the ss term is a row-sum, never
+# a bitmap matvec), ss_child (its information lives in ss_indptr) and
+# the partition-centric tables — stays on the host.
+_KIND_UNUSED = (
+    "inc_op", "inc_trace", "sr_val", "rs_val",
+    "inc_trace_opmajor", "sr_val_opmajor",
+    "inc_indptr_op", "inc_indptr_trace",
+    "cov_bits", "ss_bits", "ss_child",
+) + _PC_FIELDS
 _KERNEL_UNUSED_FIELDS = {
     # Default ss_stage="edges": the V*V/8-byte call-edge bitmap stays on
     # the host too — the kernel rebuilds it on device from the (much
@@ -1407,11 +1667,13 @@ _KERNEL_UNUSED_FIELDS = {
     # lives in the indptrs and the op-major copies) or the bitmaps
     # (already empty under the aux policy).
     ("csr", "edges"): ("inc_trace", "ss_child", "sr_val", "cov_bits",
-                       "ss_bits") + _PC_FIELDS,
+                       "ss_bits", "cov_i8") + _PC_FIELDS,
     ("csr", "bits"): ("inc_trace", "ss_child", "sr_val", "cov_bits",
-                      "ss_bits") + _PC_FIELDS,
+                      "ss_bits", "cov_i8") + _PC_FIELDS,
     ("pcsr", "edges"): _PCSR_UNUSED,
     ("pcsr", "bits"): _PCSR_UNUSED,
+    ("kind", "edges"): _KIND_UNUSED,
+    ("kind", "bits"): _KIND_UNUSED,
 }
 
 
@@ -1493,6 +1755,11 @@ def choose_kernel(
         dense_budget_bytes = DEFAULT_DENSE_BUDGET_BYTES
     parts = (graph.normal, graph.abnormal)
     # [-1] indexing so batched ([B, ...]-leading) graphs work too.
+    # Kind-compressed views exist only when the build measured a dedup
+    # factor past the threshold (graph.build.resolve_aux) — presence IS
+    # the auto-select decision, same rule as every other view family.
+    if all(int(g.cov_i8.shape[-1]) > 0 for g in parts):
+        return "kind"
     if all(int(g.cov_bits.shape[-1]) > 0 for g in parts):
         unpacked = packed_unpacked_bytes(
             int(parts[0].cov_unique.shape[-1]),
@@ -1567,6 +1834,7 @@ def _prepare_window_graph(
             dense_budget_bytes=rt.dense_budget_bytes,
             collapse=rt.collapse_kinds,
             retain_columns=retain_columns,
+            kind_dedup_threshold=rt.kind_dedup_threshold,
         )
         graph, op_names = out[0], out[1]
         retained = (
@@ -1577,6 +1845,10 @@ def _prepare_window_graph(
             kernel = choose_kernel(
                 graph, rt.dense_budget_bytes, rt.prefer_bf16
             )
+        from ..graph.build import kind_dedup_ratio
+        from ..obs.metrics import record_kind_dedup
+
+        record_kind_dedup(kind_dedup_ratio(graph))
     return device_subset(graph, kernel), op_names, kernel, retained
 
 

@@ -1,0 +1,101 @@
+"""Warm-start state capture + mapping across the sliding-window delta.
+
+Sliding windows overlap by construction, and an open incident re-ranks
+near-identical graphs every window — yet the cold program runs every
+power iteration from the uniform 1/(O+T) vector. This module is the
+HOST half of the warm-start seam (the down payment on ROADMAP item 2):
+it captures the converged per-partition state a warm rank program
+exports (``rank_window_warm_core``: max-normalized score[V] + trace
+mass rv[T] per partition) and maps it onto the NEXT window's axes:
+
+* the op axis maps by NAME — both windows intern their vocab in sorted
+  name order, but membership shifts with the delta, so the join is the
+  name itself;
+* the trace/kind column axis maps by the kind retention map's column
+  identity — each collapsed column's REPRESENTATIVE trace id
+  (explain.bundle.ExplainContext, identity mapping uncollapsed).
+  Overlapping windows share trace ids, so a surviving kind's mass
+  carries over; a regrouped or departed kind simply misses.
+
+Misses map to 0 and are refilled by the iteration in one step (the
+matvec + preference term); a fully-missed side falls back to the cold
+vector inside the program (jax_tpu._warm_override), so a bad map can
+degrade warm-start back to cold but never corrupt a ranking. With a
+convergence tol configured the payoff is measurable: iteration counts
+drop window over window (the residual-traced outputs prove it — see
+tests/test_kind_kernel.py's sliding replay).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class WarmState:
+    """One ranked window's converged iteration state + the axis labels
+    needed to re-map it onto a later window."""
+
+    op_names: List[str]
+    col_ids_n: List           # normal partition: per-column trace id
+    col_ids_a: List           # abnormal partition: per-column trace id
+    score_n: np.ndarray       # float32[V_prev] final normalized sv
+    rv_n: np.ndarray          # float32[T_prev] final trace/kind mass
+    score_a: np.ndarray
+    rv_a: np.ndarray
+
+
+def capture_warm_state(op_names, ectx, fetched) -> WarmState:
+    """Fold a warm program's fetched state tail (score_n, rv_n,
+    score_a, rv_a — host arrays) into a WarmState keyed by this
+    window's op names and the retention context's per-column trace
+    ids."""
+    sc_n, rv_n, sc_a, rv_a = (np.asarray(x, np.float32) for x in fetched)
+    return WarmState(
+        op_names=list(op_names),
+        col_ids_n=list(ectx.normal_trace_ids),
+        col_ids_a=list(ectx.abnormal_trace_ids),
+        score_n=sc_n,
+        rv_n=rv_n,
+        score_a=sc_a,
+        rv_a=rv_a,
+    )
+
+
+def _map_axis(
+    prev_vals: np.ndarray, prev_keys, new_keys, pad: int
+) -> np.ndarray:
+    """Value-carrying join: out[i] = prev_vals[prev_index[new_keys[i]]]
+    (0 on a miss), zero-padded to ``pad``."""
+    index = {k: i for i, k in enumerate(prev_keys)}
+    out = np.zeros(pad, np.float32)
+    for i, k in enumerate(new_keys):
+        j = index.get(k)
+        if j is not None and j < len(prev_vals):
+            out[i] = prev_vals[j]
+    return out
+
+
+def map_warm_state(
+    prev: Optional[WarmState], op_names, ectx, graph
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """The (sv_n, rv_n, sv_a, rv_a) init tuple for a NEW window's warm
+    rank, mapped from ``prev`` across the window delta — or None when
+    there is nothing to map (a cold first window)."""
+    if prev is None:
+        return None
+    v_pad = int(graph.normal.cov_unique.shape[-1])
+    t_pad_n = int(graph.normal.kind.shape[-1])
+    t_pad_a = int(graph.abnormal.kind.shape[-1])
+    sv_n = _map_axis(prev.score_n, prev.op_names, op_names, v_pad)
+    sv_a = _map_axis(prev.score_a, prev.op_names, op_names, v_pad)
+    rv_n = _map_axis(
+        prev.rv_n, prev.col_ids_n, ectx.normal_trace_ids, t_pad_n
+    )
+    rv_a = _map_axis(
+        prev.rv_a, prev.col_ids_a, ectx.abnormal_trace_ids, t_pad_a
+    )
+    return sv_n, rv_n, sv_a, rv_a

@@ -48,8 +48,12 @@ MATRIX_NAME = "scenario_matrix.json"
 MATRIX_SCHEMA = 1
 
 #: (kernel, pad_policy) candidates the optional tuning sweep times.
+#: "kind" joined in PR 14 — the kind-compressed reduced-precision
+#: kernel competes for the persisted per-workload policy like any
+#: other (its parity vs packed is gated by the scenario-matrix test).
 DEFAULT_TUNE_CANDIDATES: Tuple[Tuple[str, str], ...] = (
     ("packed", "pow2q"),
+    ("kind", "pow2q"),
     ("pcsr", "pow2q"),
 )
 

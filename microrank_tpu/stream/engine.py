@@ -204,6 +204,14 @@ class StreamEngine:
         self.router = DispatchRouter(config)
         self._pending: Deque[_PendingRank] = deque()
         self._warmed: dict = {}     # kernel -> occupancies dispatched
+        # Warm-start seam (RuntimeConfig.warm_start): the previous
+        # ranked window's converged iteration state
+        # (rank_backends.warm.WarmState), threaded into the next
+        # overlapping window's rank while an incident is open. Dropped
+        # on incident resolution (an all-healthy stream has nothing to
+        # warm) and never checkpointed — a restart simply cold-starts
+        # its first window, which is exactly crash-only semantics.
+        self._warm_state = None
         self._cache_dir = None
         self._cache_probe = None
         self.summary = StreamSummary()
@@ -625,7 +633,10 @@ class StreamEngine:
         )
 
         maybe_inject("build")
-        if self.config.explain.enabled:
+        if self.config.explain.enabled or self.config.runtime.warm_start:
+            # The retention context doubles as the warm-start seam's
+            # column identity map (rank_backends.warm maps rv across
+            # the window delta by representative trace id).
             return prepare_window_graph_explained(
                 frame, nrm, abn, self.config
             )
@@ -651,13 +662,25 @@ class StreamEngine:
             head.result.skipped_reason = f"build_failed: {e}"
             self._finalize(head.result, "skipped", trace=head.trace)
             return
+        warm = bool(
+            self.config.runtime.warm_start
+            and not self.config.runtime.device_checks
+            and ectx is not None
+        )
         group = [(head, graph, op_names, ectx)]
-        if not self.config.runtime.device_checks:
+        if not self.config.runtime.device_checks and not warm:
             group.extend(self._coalesce_burst(graph, kernel))
         for p, _, _, _ in group:
             p.result.queue_depth = len(self._pending)
         try:
-            if self.config.runtime.device_checks and len(group) == 1:
+            if warm:
+                # Warm-start single-window dispatch: seeds from the
+                # previous ranked window's converged state while an
+                # incident is open and captures this window's state.
+                self._dispatch_rank_warm(
+                    head, graph, op_names, kernel, ectx
+                )
+            elif self.config.runtime.device_checks and len(group) == 1:
                 # checkify programs have no batched twin: the checked
                 # path keeps the single-window dispatch.
                 self._dispatch_rank(
@@ -770,7 +793,7 @@ class StreamEngine:
         occs.add(len(group))
         batch_ms = (time.monotonic() - t0) * 1e3
         ti, ts, nv = outs[:3]
-        for b, (p, _, op_names, _) in enumerate(group):
+        for b, (p, g_b, op_names, _) in enumerate(group):
             n = int(nv[b])
             names = [op_names[int(i)] for i in ti[b][:n]]
             scores = [float(s) for s in ts[b][:n]]
@@ -782,6 +805,9 @@ class StreamEngine:
             p.result.kernel = info.kernel
             p.result.route = info.route
             p.result.batch_windows = len(group)
+            from ..graph.build import kind_dedup_ratio
+
+            p.result.kind_dedup = kind_dedup_ratio(g_b)
             p.result.timings["rank_ms"] = round(batch_ms / len(group), 3)
             if conv:
                 from ..obs.metrics import record_convergence
@@ -876,6 +902,95 @@ class StreamEngine:
             result.apply_convergence(
                 {"iterations": n_it, "final_residual": final}
             )
+
+    def _dispatch_rank_warm(
+        self, head, graph, op_names, kernel, ectx
+    ) -> None:
+        """Warm-start single-window dispatch (RuntimeConfig.warm_start):
+        rank through the warm program (rank_window_warm_device), seeding
+        the iteration from the previous ranked window's converged state
+        while an incident is open, and capture this window's state for
+        the next — the converged vectors ride the same result fetch, so
+        the seam adds no extra sync. With pagerank.tol configured the
+        journal's rank_iterations visibly drops window over window."""
+        import jax
+
+        from ..obs.metrics import record_stream_dispatch
+        from ..obs.spans import get_tracer
+        from ..rank_backends.jax_tpu import rank_window_warm_device
+        from ..rank_backends.warm import capture_warm_state, map_warm_state
+        from ..utils.guards import contract_checks
+
+        tracer = get_tracer()
+        rt = self.config.runtime
+        result = head.result
+        init = None
+        if self._warm_state is not None and self.tracker.open_incidents():
+            init = map_warm_state(self._warm_state, op_names, ectx, graph)
+        t0 = time.monotonic()
+
+        def _attempt():
+            from ..chaos import InjectedFault, maybe_inject
+
+            maybe_inject("dispatch")
+            with tracer.span(
+                "device_dispatch", service="stream", kernel=kernel,
+                warm=init is not None,
+            ):
+                with contract_checks(rt.validate_numerics):
+                    staged = rank_window_warm_device(
+                        jax.device_put(graph),
+                        init,
+                        self.config.pagerank,
+                        self.config.spectrum,
+                        kernel,
+                    )
+            with tracer.span("result_fetch", service="stream"):
+                out = jax.device_get(staged)
+            if maybe_inject("fetch") is not None:
+                raise InjectedFault("fetch", "nan")
+            return out
+
+        from ..chaos.retry import STREAM_DISPATCH_POLICY, retry_call
+
+        with tracer.attach(head.trace.ctx if head.trace is not None else None):
+            out = retry_call(
+                "stream_dispatch", _attempt,
+                policy=STREAM_DISPATCH_POLICY,
+            )
+        record_stream_dispatch()
+        self.summary.dispatches += 1
+        top_idx, top_scores, n_valid = out[:3]
+        n = int(n_valid)
+        names = [op_names[int(i)] for i in top_idx[:n]]
+        scores = [float(s) for s in top_scores[:n]]
+        if rt.validate_numerics:
+            from ..utils.guards import assert_finite_scores
+
+            assert_finite_scores(scores, "stream window (warm)")
+        result.ranking = list(zip(names, scores))
+        result.kernel = kernel
+        result.route = "warm" if init is not None else "warm_cold"
+        result.batch_windows = 1
+        from ..graph.build import kind_dedup_ratio
+
+        result.kind_dedup = kind_dedup_ratio(graph)
+        result.timings["rank_ms"] = round(
+            (time.monotonic() - t0) * 1e3, 3
+        )
+        from ..obs.metrics import record_convergence
+
+        res = np.asarray(
+            out[3],
+            dtype=np.float64,  # mrlint: disable=R2(host-side summary of an already-fetched trace; never re-enters a jnp expression)
+        )
+        n_it = int(out[4])
+        final = float(res[:, n_it - 1].max()) if n_it else float("nan")
+        record_convergence(kernel, n_it, final)
+        result.apply_convergence(
+            {"iterations": n_it, "final_residual": final}
+        )
+        self._warm_state = capture_warm_state(op_names, ectx, out[5:9])
 
     def _explain_incident(self, result, explain_src) -> dict:
         """Materialize the incident-opening window's explain bundle
@@ -996,6 +1111,9 @@ class StreamEngine:
             self.baseline.freeze()
         else:
             self.baseline.thaw()
+            # Nothing left to warm-start against: the next incident's
+            # first window cold-starts (and re-seeds the state).
+            self._warm_state = None
         if outcome == "clean" and frame is not None:
             self.baseline.update(frame)   # no-op while frozen
         self.summary.results.append(result)

@@ -1,0 +1,640 @@
+"""Kind-compressed reduced-precision kernel (ISSUE 14).
+
+Covers the tentpole end to end: the compression math (folded
+multiplicity weights reproduce uncollapsed scores bit-for-bit in f64),
+the scaled-int8 operand quantization's edge cases, degenerate builds,
+the blob round-trip of the new fields, aux/kernel auto-select policy,
+tie-aware oracle parity for every precision on collapsed AND
+uncollapsed builds, single-device AND sharded, the scenario-matrix
+family parity gate vs the packed kernel, and the warm-start seam
+(iteration counts drop on an overlapping-window replay, residual-trace
+proven).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import MicroRankConfig, PageRankConfig
+from microrank_tpu.graph import build_window_graph
+from microrank_tpu.graph.build import (
+    DEFAULT_KIND_DEDUP_THRESHOLD,
+    kind_aux,
+    kind_dedup_ratio,
+    resolve_aux,
+)
+from microrank_tpu.rank_backends.jax_tpu import (
+    choose_kernel,
+    device_subset,
+    quantize_i8,
+    rank_window_device,
+    rank_window_warm_device,
+)
+from microrank_tpu.rank_backends.sparse_oracle import rank_window_sparse
+from microrank_tpu.testing import SyntheticConfig, generate_case
+from microrank_tpu.utils.ranking_compare import tie_aware_topk_agreement
+
+CFG = MicroRankConfig()
+
+
+def _span_frame(traces):
+    """Tiny span frame from [(traceID, [op names])]: one pod-op per
+    span, parent chain within each trace."""
+    rows = []
+    t0 = pd.Timestamp("2025-03-01 10:00:00")
+    for tid, ops in traces:
+        for i, op in enumerate(ops):
+            rows.append(
+                {
+                    "traceID": tid,
+                    "spanID": f"{tid}-s{i}",
+                    "ParentSpanId": f"{tid}-s{i - 1}" if i else "",
+                    "serviceName": op.split("_")[0],
+                    "podName": op.split("_")[0] + "-0",
+                    "operationName": op.split("_")[1],
+                    "startTime": t0,
+                    "endTime": t0 + pd.Timedelta(milliseconds=5),
+                    "duration": 5000,
+                }
+            )
+    return pd.DataFrame(rows)
+
+
+@pytest.fixture(scope="module")
+def kind_case():
+    """A window with real kind structure: two identical abnormal traces
+    (one kind of multiplicity 2, len 3 so 1/len is inexact in binary)
+    plus distinct singleton kinds."""
+    frame = _span_frame(
+        [
+            ("a1", ["svcA_op1", "svcB_op2", "svcC_op3"]),
+            ("a2", ["svcA_op1", "svcB_op2", "svcC_op3"]),
+            ("a3", ["svcA_op1", "svcD_op4"]),
+            ("n1", ["svcA_op1", "svcB_op2"]),
+            ("n2", ["svcA_op1", "svcC_op3", "svcD_op4"]),
+        ]
+    )
+    nrm = ["n1", "n2"]
+    abn = ["a1", "a2", "a3"]
+    return frame, nrm, abn
+
+
+def _f64_partition_scores(g, anomaly, iters=25, d=0.85, alpha=0.01):
+    """Float64 reference iteration straight off the (possibly
+    collapsed) COO arrays, multiplicity-weighted exactly as the device
+    kernels read them — the hand-checkable twin of the folded math."""
+    v = g.cov_unique.shape[0]
+    t = g.kind.shape[0]
+    n_cols = int(g.n_cols)
+    n_live = int(g.n_traces) if n_cols < 0 else n_cols
+    p_sr = np.zeros((v, t))
+    p_rs = np.zeros((t, v))
+    n_inc = int(g.n_inc)
+    for e in range(n_inc):
+        p_sr[g.inc_op[e], g.inc_trace[e]] += np.float64(g.sr_val[e])
+        p_rs[g.inc_trace[e], g.inc_op[e]] += np.float64(g.rs_val[e])
+    p_ss = np.zeros((v, v))
+    for e in range(int(g.n_ss)):
+        p_ss[g.ss_child[e], g.ss_parent[e]] += np.float64(g.ss_val[e])
+    kind = np.asarray(g.kind, np.float64)
+    mult = np.ones(t) if n_cols < 0 else kind
+    live = np.arange(t) < n_live
+    inv_kind = np.where(live, 1.0 / np.maximum(kind, 1), 0.0)
+    kind_sum = float((mult * inv_kind).sum())
+    if not anomaly:
+        pref = np.where(live, inv_kind / kind_sum, 0.0)
+    else:
+        tlen = np.asarray(g.tracelen, np.float64)
+        inv_len = np.where(live, 1.0 / np.maximum(tlen, 1), 0.0)
+        num_sum = float((mult * inv_len).sum())
+        pref = np.where(
+            live, 0.5 / num_sum / (kind / kind_sum * 0.5 + inv_len), 0.0
+        )
+    n_total = float(int(g.n_ops) + int(g.n_traces))
+    sv = np.where(np.asarray(g.op_present), 1.0 / n_total, 0.0)
+    rv = np.where(live, 1.0 / n_total, 0.0)
+    for _ in range(iters):
+        sv_new = d * (p_sr @ rv + alpha * (p_ss @ sv))
+        rv_new = d * (p_rs @ sv) + (1 - d) * pref
+        sv = sv_new / sv_new.max()
+        rv = rv_new / rv_new.max()
+    return sv
+
+
+# ------------------------------------------------------ compression math
+
+
+def test_folded_multiplicity_reproduces_uncollapsed_f64(kind_case):
+    """The core equivalence claim, bit-for-bit in f64: PageRank over
+    weighted unique kinds (sr_val = m/len folded, preference sums
+    multiplicity-weighted) equals PageRank over per-trace columns.
+    Multiplicity 2 is a power of two, so even the f32-stored folded
+    values are exactly 2x the per-trace values and the f64 iterations
+    agree to the last bit."""
+    frame, nrm, abn = kind_case
+    g_u, names, _, _ = build_window_graph(
+        frame, nrm, abn, aux="none", collapse="off"
+    )
+    g_c, names_c, _, _ = build_window_graph(
+        frame, nrm, abn, aux="kind", collapse="on"
+    )
+    assert names == names_c
+    assert int(g_c.abnormal.n_cols) == 2   # {op1,op2,op3} x2 + {op1,op4}
+    assert int(g_c.abnormal.n_traces) == 3
+    # The folded forward value IS m/len: column 0 stands for two
+    # traces of three spans each.
+    mult_col = np.asarray(g_c.abnormal.kind)[: int(g_c.abnormal.n_cols)]
+    assert sorted(mult_col.tolist()) == [1, 2]
+    for side in ("normal", "abnormal"):
+        anomaly = side == "abnormal"
+        sv_u = _f64_partition_scores(getattr(g_u, side), anomaly)
+        sv_c = _f64_partition_scores(getattr(g_c, side), anomaly)
+        assert np.array_equal(sv_u, sv_c), side
+
+
+def test_kind_aux_views(kind_case):
+    """kind_aux derives the int8 pattern + ss row offsets exactly from
+    the bitmap/edge list."""
+    frame, nrm, abn = kind_case
+    g, _, _, _ = build_window_graph(
+        frame, nrm, abn, aux="kind", collapse="on"
+    )
+    for part in (g.normal, g.abnormal):
+        t_pad = part.kind.shape[0]
+        v_pad = part.cov_unique.shape[0]
+        assert part.cov_i8.shape == (v_pad, t_pad)
+        assert part.cov_i8.dtype == np.int8
+        assert set(np.unique(part.cov_i8)) <= {0, 1}
+        # Pattern matches the bitmap bit-for-bit.
+        bits = np.unpackbits(part.cov_bits, axis=1)[:, :t_pad]
+        assert np.array_equal(part.cov_i8, bits.astype(np.int8))
+        # Row offsets bracket exactly the ss edges of each child.
+        assert part.ss_indptr.shape == (v_pad + 1,)
+        n_ss = int(part.n_ss)
+        counts = np.bincount(
+            np.asarray(part.ss_child[:n_ss]), minlength=v_pad
+        )
+        assert np.array_equal(np.diff(part.ss_indptr), counts)
+
+
+# --------------------------------------------------------- int8 quantize
+
+
+def test_quantize_i8_edges():
+    # All-zero vector: guarded scale, all-zero q.
+    q, s = quantize_i8(jnp.zeros(8))
+    assert float(s) == 1.0 and int(jnp.abs(q).max()) == 0
+    # Max magnitude lands exactly on +/-127; nothing wraps.
+    x = jnp.asarray([-3.0, -1.5, 0.0, 1e-9, 3.0])
+    q, s = quantize_i8(x)
+    assert q.dtype == jnp.int8
+    assert int(q[0]) == -127 and int(q[-1]) == 127
+    # Round-trip error bounded by scale/2 everywhere.
+    err = np.abs(np.asarray(q, np.float64) * float(s) - np.asarray(x))
+    assert (err <= float(s) / 2 + 1e-12).all()
+    # Huge dynamic range: tiny entries quantize to 0 (no wraparound,
+    # no negative surprise), the max stays exact.
+    x = jnp.asarray([1e-30, 1e30])
+    q, s = quantize_i8(x)
+    assert int(q[0]) == 0 and int(q[1]) == 127
+    assert np.isfinite(float(s))
+
+
+# ------------------------------------------------------ degenerate builds
+
+
+def test_single_kind_window_builds_and_ranks():
+    """Every abnormal trace identical -> ONE kind column; the kernel
+    still ranks and matches the packed kernel."""
+    frame = _span_frame(
+        [
+            ("a1", ["svcA_op1", "svcB_op2"]),
+            ("a2", ["svcA_op1", "svcB_op2"]),
+            ("a3", ["svcA_op1", "svcB_op2"]),
+            ("n1", ["svcA_op1"]),
+        ]
+    )
+    g, names, _, _ = build_window_graph(
+        frame, ["n1"], ["a1", "a2", "a3"], aux="kind", collapse="on"
+    )
+    assert int(g.abnormal.n_cols) == 1
+    out_k = rank_window_device(
+        device_subset(g, "kind"), CFG.pagerank, CFG.spectrum, None, "kind"
+    )
+    g2, _, _, _ = build_window_graph(
+        frame, ["n1"], ["a1", "a2", "a3"], aux="packed", collapse="on"
+    )
+    out_p = rank_window_device(
+        device_subset(g2, "packed"), CFG.pagerank, CFG.spectrum, None,
+        "packed",
+    )
+    n = int(out_k[2])
+    assert n == int(out_p[2]) > 0
+    assert np.array_equal(
+        np.asarray(out_k[0])[:n], np.asarray(out_p[0])[:n]
+    )
+
+
+def test_empty_partition_kind_build():
+    """A partition with no call edges / minimal traces still produces
+    well-formed kind views (all-zero offsets, zero pattern rows)."""
+    frame = _span_frame([("a1", ["svcA_op1"]), ("n1", ["svcB_op2"])])
+    g, _, _, _ = build_window_graph(
+        frame, ["n1"], ["a1"], aux="kind", collapse="on"
+    )
+    for part in (g.normal, g.abnormal):
+        assert part.cov_i8.shape[-1] == part.kind.shape[0]
+        assert int(part.n_ss) == 0
+        assert np.array_equal(
+            part.ss_indptr, np.zeros_like(part.ss_indptr)
+        )
+    # And it ranks without NaNs.
+    ti, ts, nv = rank_window_device(
+        device_subset(g, "kind"), CFG.pagerank, CFG.spectrum, None, "kind"
+    )
+    assert np.isfinite(np.asarray(ts)[: int(nv)]).all()
+
+
+# ------------------------------------------------------- blob round trip
+
+
+def test_blob_roundtrip_kind_fields(kind_case):
+    from microrank_tpu.rank_backends.blob import (
+        pack_graph_blob,
+        unpack_graph_blob,
+    )
+
+    frame, nrm, abn = kind_case
+    g, _, _, _ = build_window_graph(
+        frame, nrm, abn, aux="kind", collapse="on"
+    )
+    sub = device_subset(g, "kind")
+    blob, layout = pack_graph_blob(sub)
+    out = jax.jit(
+        lambda b: unpack_graph_blob(b, layout)
+    )(jnp.asarray(blob))
+    for side in ("normal", "abnormal"):
+        a, b = getattr(sub, side), getattr(out, side)
+        assert np.array_equal(np.asarray(b.cov_i8), a.cov_i8)
+        assert np.asarray(b.cov_i8).dtype == np.int8
+        assert np.array_equal(np.asarray(b.ss_indptr), a.ss_indptr)
+        assert np.array_equal(np.asarray(b.inv_tracelen), a.inv_tracelen)
+
+
+# ----------------------------------------------------- auto-select policy
+
+
+def test_resolve_aux_kind_threshold():
+    # No measured dedup -> packed as before.
+    assert resolve_aux("auto", 64, (64, 64)) == "packed"
+    # Past the threshold -> kind; below -> packed.
+    assert resolve_aux("auto", 64, (8, 8), dedup=8.0) == "kind"
+    assert resolve_aux("auto", 64, (8, 8), dedup=1.5) == "packed"
+    assert (
+        resolve_aux(
+            "auto", 64, (8, 8), dedup=2.0, kind_dedup_threshold=2.0
+        )
+        == "kind"
+    )
+    # auto_all (the sharded build) never resolves to kind.
+    assert resolve_aux("auto_all", 64, (8, 8), dedup=8.0) == "all"
+    # Past the bitmap budget the memory-bounded fallback still wins.
+    assert (
+        resolve_aux(
+            "auto", 1 << 16, (1 << 16,), 1 << 20, dedup=100.0
+        )
+        == "pcsr"
+    )
+    assert DEFAULT_KIND_DEDUP_THRESHOLD == 4.0
+
+
+def test_choose_kernel_and_subset(kind_case):
+    frame, nrm, abn = kind_case
+    g, _, _, _ = build_window_graph(
+        frame, nrm, abn, aux="kind", collapse="on"
+    )
+    assert choose_kernel(g) == "kind"
+    assert kind_dedup_ratio(g) > 1.0
+    sub = device_subset(g, "kind")
+    for part in (sub.normal, sub.abnormal):
+        assert part.cov_i8.shape[-1] > 0
+        assert part.ss_indptr.shape[-1] > 0
+        assert part.cov_bits.shape[-1] == 0
+        assert part.ss_bits.shape[-1] == 0
+        assert part.inc_op.shape[-1] == 0
+        assert part.pc_trace.shape[-1] == 0
+
+
+def test_auto_pipeline_selects_kind_past_threshold(kind_case):
+    """End to end through the backend: collapse auto + measured dedup
+    over the threshold -> the auto kernel is kind (and parity holds)."""
+    from microrank_tpu.rank_backends.jax_tpu import prepare_window_graph
+
+    frame, nrm, abn = kind_case
+    cfg = CFG.replace(
+        runtime=dataclasses.replace(
+            CFG.runtime, kind_dedup_threshold=1.2, collapse_kinds="on"
+        )
+    )
+    graph, names, kernel = prepare_window_graph(frame, nrm, abn, cfg)
+    assert kernel == "kind"
+    assert graph.normal.cov_i8.shape[-1] > 0
+    # Below threshold: packed keeps the window.
+    cfg2 = CFG.replace(
+        runtime=dataclasses.replace(
+            CFG.runtime, kind_dedup_threshold=1e9, collapse_kinds="on"
+        )
+    )
+    _, _, kernel2 = prepare_window_graph(frame, nrm, abn, cfg2)
+    assert kernel2 in ("packed", "packed_bf16")
+
+
+# ------------------------------------------------------------ rank parity
+
+
+@pytest.fixture(scope="module")
+def synth_case():
+    case = generate_case(
+        SyntheticConfig(n_operations=30, n_kinds=6, n_traces=200, seed=3)
+    )
+    nrm, abn = partition_case(case)
+    return case, nrm, abn
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16", "int8"])
+@pytest.mark.parametrize("collapse", ["on", "off"])
+def test_kind_parity_vs_f64_oracle(synth_case, precision, collapse):
+    """Tie-aware top-5 parity vs the f64 sparse oracle (always ranked
+    on an UNCOLLAPSED build) for every precision, collapsed and
+    uncollapsed — the acceptance gate's single-device half."""
+    case, nrm, abn = synth_case
+    g_o, names, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux="none", collapse="off"
+    )
+    top_o, sc_o = rank_window_sparse(g_o, names, CFG.pagerank, CFG.spectrum)
+    g, names_k, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux="kind", collapse=collapse
+    )
+    pr = dataclasses.replace(CFG.pagerank, kind_precision=precision)
+    ti, ts, nv = rank_window_device(
+        device_subset(g, "kind"), pr, CFG.spectrum, None, "kind"
+    )
+    n = int(nv)
+    ok, why = tie_aware_topk_agreement(
+        [names_k[int(i)] for i in np.asarray(ti)[:n]],
+        [float(s) for s in np.asarray(ts)[:n]],
+        top_o,
+        sc_o,
+        k=5,
+        rtol=5e-2 if precision == "int8" else 1e-3,
+        exempt_last=True,
+    )
+    assert ok, why
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh"
+)
+def test_kind_parity_sharded(synth_case):
+    """The acceptance gate's sharded half: the kind kernel over the
+    (windows, shard) mesh reproduces its own single-device ranking and
+    the f64 oracle's top-5."""
+    from microrank_tpu.parallel import make_mesh, rank_windows_sharded
+    from microrank_tpu.parallel.sharded_rank import stage_sharded
+
+    case, nrm, abn = synth_case
+    g_o, names, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux="none", collapse="off"
+    )
+    top_o, sc_o = rank_window_sparse(g_o, names, CFG.pagerank, CFG.spectrum)
+    g, _, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux="kind", collapse="on"
+    )
+    mesh = make_mesh((2, 4))
+    batched = stage_sharded([g, g], mesh, "kind")
+    sti, sts, snv = rank_windows_sharded(
+        batched, CFG.pagerank, CFG.spectrum, mesh, "kind"
+    )
+    ti, ts, nv = rank_window_device(
+        device_subset(g, "kind"), CFG.pagerank, CFG.spectrum, None, "kind"
+    )
+    n = int(nv)
+    for b in range(2):
+        assert np.array_equal(
+            np.asarray(sti)[b][:n], np.asarray(ti)[:n]
+        )
+    ok, why = tie_aware_topk_agreement(
+        [names[int(i)] for i in np.asarray(sti)[0][:n]],
+        [float(s) for s in np.asarray(sts)[0][:n]],
+        top_o,
+        sc_o,
+        k=5,
+        rtol=1e-3,
+        exempt_last=True,
+    )
+    assert ok, why
+
+
+# ------------------------------------------- scenario-family parity gate
+
+
+@pytest.mark.parametrize("family", ["cascade", "multi"])
+def test_scenario_family_kind_matches_packed(family):
+    """ROADMAP item 5's REMAINING thread: the matrix's harder families
+    are the parity gate for the new kernel — kernel='kind' must match
+    the packed kernel's tie-aware rankings family-by-family."""
+    from microrank_tpu.detect import compute_slo, detect_partition
+    from microrank_tpu.rank_backends.jax_tpu import JaxBackend
+    from microrank_tpu.scenarios import ScenarioSpec, generate_scenario
+
+    spec = ScenarioSpec(
+        name=f"gate-{family}",
+        family=family,
+        seed=7,
+        n_windows=4,
+        faulted=(2,),
+        n_operations=20,
+        n_traces=150,
+        n_kinds=12,
+    )
+    wl = generate_scenario(spec)
+    vocab, slo = compute_slo(wl.normal)
+    compared = 0
+    for i in range(spec.n_windows):
+        frame = wl.window_frame(i)
+        if len(frame) == 0 or not wl.window_faulted[i]:
+            continue
+        flag, nrm, abn = detect_partition(CFG, vocab, slo, frame)
+        if not (flag and nrm and abn):
+            continue
+        rankings = {}
+        for kernel in ("kind", "packed"):
+            cfg = CFG.replace(
+                runtime=dataclasses.replace(
+                    CFG.runtime, kernel=kernel, collapse_kinds="auto"
+                )
+            )
+            rankings[kernel] = JaxBackend(cfg).rank_window(
+                frame, nrm, abn
+            )
+        ok, why = tie_aware_topk_agreement(
+            rankings["kind"][0],
+            rankings["kind"][1],
+            rankings["packed"][0],
+            rankings["packed"][1],
+            k=min(5, len(rankings["packed"][0])),
+            rtol=1e-3,
+            exempt_last=True,
+        )
+        assert ok, f"{family} window {i}: {why}"
+        compared += 1
+    assert compared >= 1, f"{family}: no faulted window ranked"
+
+
+# ---------------------------------------------------------- warm start
+
+
+def _detect_frame(frame, vocab, slo):
+    from microrank_tpu.detect import detect_partition
+
+    flag, nrm, abn = detect_partition(CFG, vocab, slo, frame)
+    assert flag and nrm and abn
+    return nrm, abn
+
+
+def _build_retained(frame, nrm, abn):
+    from microrank_tpu.explain.bundle import ExplainContext
+
+    graph, names, ids_n, ids_a, cmap = build_window_graph(
+        frame, nrm, abn, aux="kind", collapse="on", retain_columns=True
+    )
+    ectx = ExplainContext.from_build(graph, ids_n, ids_a, *cmap)
+    return graph, names, ectx
+
+
+def test_warm_start_drops_iterations_on_overlapping_replay():
+    """The warm-start seam's proof: rank window W cold (tol set),
+    capture the converged state, re-rank the OVERLAPPING next window
+    warm — the residual-traced iteration count drops, and a fully
+    identical window converges almost immediately. Rankings stay
+    tie-aware-identical to the cold solve."""
+    from microrank_tpu.detect import compute_slo
+    from microrank_tpu.rank_backends.warm import (
+        capture_warm_state,
+        map_warm_state,
+    )
+    from microrank_tpu.testing.synthetic import generate_timeline
+
+    tl = generate_timeline(
+        SyntheticConfig(
+            n_operations=24, n_traces=160, n_kinds=12, seed=9
+        ),
+        3,
+        [0, 1, 2],
+    )
+    frames = tl.timeline
+    vocab, slo = compute_slo(tl.normal)
+    w_us = int(tl.window_minutes * 60e6)
+    start = int(tl.start.value // 1000)
+    t_us = frames["startTime"].astype("int64") // 1000
+
+    def window(lo_w, hi_w):
+        lo, hi = start + lo_w * w_us, start + hi_w * w_us
+        return frames[(t_us >= lo) & (t_us < hi)]
+
+    # W1 = windows [0, 2), W2 = windows [1, 3): 50% span overlap.
+    f1, f2 = window(0, 2), window(1, 3)
+    nrm1, abn1 = _detect_frame(f1, vocab, slo)
+    nrm2, abn2 = _detect_frame(f2, vocab, slo)
+    g1, names1, ectx1 = _build_retained(f1, nrm1, abn1)
+    g2, names2, ectx2 = _build_retained(f2, nrm2, abn2)
+    pr = dataclasses.replace(CFG.pagerank, tol=1e-4, iterations=50)
+
+    def run(graph, init):
+        out = jax.device_get(
+            rank_window_warm_device(
+                device_subset(graph, "kind"), init, pr, CFG.spectrum,
+                "kind",
+            )
+        )
+        return out
+
+    cold1 = run(g1, None)
+    state = capture_warm_state(names1, ectx1, cold1[5:9])
+    cold2 = run(g2, None)
+    warm2 = run(g2, map_warm_state(state, names2, ectx2, g2))
+    it_cold, it_warm = int(cold2[4]), int(warm2[4])
+    assert it_warm <= it_cold
+    # Identical-window replay: starting AT the fixed point converges
+    # almost immediately — the strict drop.
+    state2 = capture_warm_state(names2, ectx2, warm2[5:9])
+    again = run(g2, map_warm_state(state2, names2, ectx2, g2))
+    assert int(again[4]) <= 3 < it_cold
+    # Ranking parity warm vs cold.
+    n = int(cold2[2])
+    ok, why = tie_aware_topk_agreement(
+        [names2[int(i)] for i in np.asarray(warm2[0])[: int(warm2[2])]],
+        [float(s) for s in np.asarray(warm2[1])[: int(warm2[2])]],
+        [names2[int(i)] for i in np.asarray(cold2[0])[:n]],
+        [float(s) for s in np.asarray(cold2[1])[:n]],
+        k=min(5, n),
+        rtol=1e-3,
+        exempt_last=True,
+    )
+    assert ok, why
+
+
+def test_stream_engine_threads_warm_state(tmp_path):
+    """Engine-level warm-start smoke: consecutive abnormal windows of
+    one open incident dispatch through the warm program — the first
+    cold (route 'warm_cold'), later ones seeded (route 'warm') — and
+    rankings match the warm-off engine tie-aware."""
+    from microrank_tpu.config import StreamConfig
+    from microrank_tpu.stream import StreamEngine, SyntheticSource
+
+    def source():
+        return SyntheticSource(
+            n_windows=6,
+            faulted=[2, 3, 4],
+            synth_config=SyntheticConfig(
+                n_operations=24, n_traces=200, n_kinds=16, seed=5
+            ),
+            pace_seconds=0.01,
+            sleep=lambda s: None,
+        )
+
+    def run(warm: bool, out):
+        cfg = MicroRankConfig(
+            stream=StreamConfig(allowed_lateness_seconds=5.0)
+        ).replace()
+        cfg = cfg.replace(
+            runtime=dataclasses.replace(cfg.runtime, warm_start=warm),
+            pagerank=PageRankConfig(tol=1e-4, iterations=50),
+        )
+        eng = StreamEngine(cfg, source(), out_dir=out)
+        s = eng.run()
+        return [r for r in s.results if r.ranking]
+
+    warm_res = run(True, tmp_path / "warm")
+    cold_res = run(False, tmp_path / "cold")
+    assert len(warm_res) == len(cold_res) == 3
+    assert warm_res[0].route == "warm_cold"
+    assert {r.route for r in warm_res[1:]} == {"warm"}
+    assert all(r.kind_dedup and r.kind_dedup >= 1.0 for r in warm_res)
+    for w, c in zip(warm_res, cold_res):
+        assert w.rank_iterations is not None
+        ok, why = tie_aware_topk_agreement(
+            [n for n, _ in w.ranking],
+            [s for _, s in w.ranking],
+            [n for n, _ in c.ranking],
+            [s for _, s in c.ranking],
+            k=min(5, len(c.ranking)),
+            rtol=1e-3,
+            exempt_last=True,
+        )
+        assert ok, why
