@@ -28,6 +28,16 @@ Seams (each one a point the span tracer already instruments):
 * ``source_stall`` / ``source_torn`` / ``source_rotation`` — the span
   source: an extra poll stall, a simulated torn tail line (parse fails
   this poll, data intact the next), a forced cursor reset (rotation).
+* ``source_data`` — DATA corruption at the source (ReplaySource /
+  SyntheticSource, per chunk): kinds ``corrupt_row`` (unparseable
+  timestamps + negative/NaN durations), ``dup_span`` (duplicated
+  rows), ``orphan`` (parent ids repointed at ghosts), ``clock_skew``
+  (cross-host time shifts, half clampable half hopeless) and
+  ``cardinality_bomb`` (one adversarial trace of unique op names) —
+  generated deterministically by ``ingest.hostile.corrupt_frame``
+  seeded from the plan seed + event number; the span-admission ladder
+  (ingest/) is the defense under test. ``value`` sets the corrupted
+  row fraction (or the bomb's op count).
 * ``webhook`` — the incident webhook POST: ``hang`` (bounded sleep) or
   ``http_5xx``/``fail`` (raised, enqueued for the sink's retry queue).
 * ``checkpoint`` — the state.ckpt writer, fired BETWEEN the durable tmp

@@ -137,6 +137,48 @@ def _add_config_flags(p: argparse.ArgumentParser) -> None:
         help="RNG seed for probabilistic chaos fault specs (default: "
         "the plan file's seed, else 0)",
     )
+    p.add_argument(
+        "--quarantine-dir", default=None,
+        help="directory for the span-admission dead-letter store "
+        "(quarantine.jsonl — every rejected row with its reason; "
+        "default: the run's output directory)",
+    )
+    p.add_argument(
+        "--orphan-policy", default=None, choices=["stitch", "drop"],
+        help="orphan spans (parent id absent from the trace): stitch "
+        "clears the link (span becomes a root, kept + counted) or "
+        "drop rejects the row to quarantine (default stitch)",
+    )
+    p.add_argument(
+        "--max-skew-seconds", type=float, default=None,
+        help="clock-skew clamp bound: spans outside the window by up "
+        "to this many seconds normalize to the bound; far beyond it "
+        "(skew_reject_seconds) they quarantine (default 300)",
+    )
+    p.add_argument(
+        "--max-ops-per-window", type=int, default=None,
+        help="op-vocab budget per window: distinct operations past "
+        "this keep the highest-span-count ops and quarantine the thin "
+        "tail — the cardinality-bomb guard (default 20000, 0 off)",
+    )
+    p.add_argument(
+        "--max-spans-per-trace", type=int, default=None,
+        help="trace-length budget: spans of one trace past this "
+        "quarantine (reason trace_too_long) so a mega-trace cannot "
+        "escalate the pad buckets (default 4096, 0 off)",
+    )
+    p.add_argument(
+        "--min-admission-ratio", type=float, default=None,
+        help="refuse a window WHOLE when fewer than this fraction of "
+        "its spans survive admission: no baseline update, no incident "
+        "transition (default 0.5)",
+    )
+    p.add_argument(
+        "--no-ingest-guard", action="store_true",
+        help="disable span admission + quarantine entirely (frames "
+        "pass through unvalidated — one malformed row can abort a "
+        "frame; debugging only)",
+    )
     p.add_argument("--config-json", help="load a full MicroRankConfig dict")
 
 
@@ -220,8 +262,31 @@ def _config_from_args(args) -> "MicroRankConfig":
         }.items()
         if v is not None
     }
-    from ..config import ChaosConfig
+    from ..config import ChaosConfig, IngestConfig
 
+    ingest_overrides = {
+        k: v
+        for k, v in {
+            "enabled": (
+                False
+                if getattr(args, "no_ingest_guard", False)
+                else None
+            ),
+            "quarantine_dir": getattr(args, "quarantine_dir", None),
+            "orphan_policy": getattr(args, "orphan_policy", None),
+            "max_skew_seconds": getattr(args, "max_skew_seconds", None),
+            "max_ops_per_window": getattr(
+                args, "max_ops_per_window", None
+            ),
+            "max_spans_per_trace": getattr(
+                args, "max_spans_per_trace", None
+            ),
+            "min_admission_ratio": getattr(
+                args, "min_admission_ratio", None
+            ),
+        }.items()
+        if v is not None
+    }
     chaos_overrides = {
         k: v
         for k, v in {
@@ -238,6 +303,7 @@ def _config_from_args(args) -> "MicroRankConfig":
         explain=ExplainConfig(**explain_overrides),
         dispatch=DispatchConfig(**dispatch_overrides),
         chaos=ChaosConfig(**chaos_overrides),
+        ingest=IngestConfig(**ingest_overrides),
         detector=DetectorConfig(
             k_sigma=args.k_sigma,
             slack_ms=args.slack_ms,
@@ -822,6 +888,7 @@ def cmd_stream(args) -> int:
             args.input,
             poll_seconds=args.poll_seconds,
             idle_exit=args.idle_exit or 0,
+            parse_retry_max=cfg.ingest.parse_retry_max,
         )
 
     normal_df = None

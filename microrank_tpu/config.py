@@ -501,6 +501,79 @@ class ExplainConfig:
 
 
 @dataclass(frozen=True)
+class IngestConfig:
+    """Span admission + quarantine knobs (``ingest/`` subsystem).
+
+    Every lane passes span frames through the admission ladder
+    (``ingest.admission.admit_frame``) before detect/build: per-row
+    schema+value validation with rejected rows routed to a bounded
+    dead-letter store (``quarantine.jsonl``) under a fixed reason
+    taxonomy, plus resource-budget guards that keep adversarial
+    high-cardinality traffic from growing the op vocab, the pad
+    buckets, and the staged-bytes footprint without bound.
+    """
+
+    # Master switch. Off: frames pass through untouched (the pre-PR-15
+    # behavior — one malformed row can abort a frame; keep on).
+    enabled: bool = True
+    # Orphan spans (parent id absent from the trace): "stitch" clears
+    # the link — the span becomes a trace root, its coverage still
+    # counts (kept + counted in microrank_ingest_clamped_total) —
+    # "drop" rejects the row to quarantine instead.
+    orphan_policy: str = "stitch"      # "stitch" | "drop"
+    # Cross-host clock-skew normalization: a span whose start sits
+    # outside the window by up to max_skew_seconds CLAMPS to the
+    # window-relative bound (kept); beyond skew_reject_seconds it is
+    # hopeless and rejects (reason clock_skew). The clamp bound must
+    # exceed half the window width or healthy edge rows would clamp.
+    max_skew_seconds: float = 300.0
+    skew_reject_seconds: float = 3600.0
+    # FORWARD skew bound at the pre-windowing gate: rows claiming a
+    # time ahead of the batch's robust spread clamp to this much —
+    # tighter than max_skew_seconds because a future-claiming row
+    # advances the event-time WATERMARK, and every second of advance
+    # closes innocent windows that much earlier (their real spans then
+    # drop as late). Backward skew cannot close windows, so it keeps
+    # the loose bound.
+    forward_skew_seconds: float = 30.0
+    # Duration overflow bound (microseconds): anything longer than an
+    # hour is a corrupt export, not a span (reason duration_overflow).
+    max_duration_us: int = 3_600_000_000
+    # Resource budgets (the cardinality-bomb guards): spans per trace
+    # past the cap reject (reason trace_too_long) so one mega-trace
+    # cannot escalate the pad buckets; distinct ops per window past the
+    # cap keep the highest-span-count ops and reject the thin tail
+    # (reason vocab_budget) so the op vocab and the staged footprint
+    # stay bounded. 0 disables either budget.
+    max_spans_per_trace: int = 4096
+    max_ops_per_window: int = 20_000
+    # Op-vocab GROWTH cap: when the caller supplies the baseline's
+    # known operation set, a window introducing more than this many
+    # never-seen operations is under cardinality attack — ALL its
+    # never-seen-op spans quarantine (reason vocab_budget), so a bomb
+    # of novel op names can neither open a spurious incident (the
+    # detector never sees them) nor poison the online baseline nor
+    # grow the pad buckets. Gradual real deployments stay under the
+    # cap and admit normally. 0 disables.
+    max_new_ops_per_window: int = 32
+    # Baseline anti-poisoning: a window whose admitted fraction falls
+    # below this neither updates the online baseline nor advances the
+    # incident lifecycle — a corruption burst cannot retrain the SLO
+    # floor or fire a spurious incident (the window journals as
+    # skipped, reason low_admission).
+    min_admission_ratio: float = 0.5
+    # Dead-letter store: directory for quarantine.jsonl (None = the
+    # run's out_dir) and its byte cap (records past it drop + count).
+    quarantine_dir: Optional[str] = None
+    quarantine_max_bytes: int = 16 << 20
+    # Tail source: consecutive failed parses of the SAME byte range
+    # before the offending line is dead-lettered (with its byte offset)
+    # and the cursor advances past it — a permanently unparseable line
+    # must not retry forever.
+    parse_retry_max: int = 3
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Unified fault-injection harness (``chaos/`` subsystem).
 
@@ -714,6 +787,7 @@ class MicroRankConfig:
     explain: ExplainConfig = field(default_factory=ExplainConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -758,4 +832,5 @@ class MicroRankConfig:
             explain=_mk(ExplainConfig, d.get("explain", {})),
             chaos=_mk(ChaosConfig, d.get("chaos", {})),
             fleet=_mk(FleetConfig, d.get("fleet", {})),
+            ingest=_mk(IngestConfig, d.get("ingest", {})),
         )

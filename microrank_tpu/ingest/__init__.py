@@ -1,0 +1,49 @@
+"""Span admission + quarantine: the data-plane robustness layer.
+
+PRs 10-12 made the *process* crash-only; this subsystem makes the
+*data path* hostile-proof. Every lane (batch, serve, stream, fleet)
+passes span frames through :func:`admit_frame` before detect/build:
+per-row schema+value validation vectorized over the frame, rejected
+rows routed to a bounded dead-letter store
+(:class:`QuarantineStore`, ``quarantine.jsonl``) with a fixed reason
+taxonomy — never a crash, never silent — and resource-budget guards
+(op-vocab growth, trace length, duration overflow) that keep an
+adversarial cardinality bomb from growing the pad buckets and the
+staged-bytes footprint without bound. :mod:`ingest.hostile` is the
+attack side: deterministic corruption generators the chaos registry's
+``source_data`` seam and the ``hostile`` scenario family share.
+"""
+
+from .admission import (
+    AdmissionResult,
+    TraceClock,
+    admit_frame,
+    coercible_event_times,
+    pre_admit_frame,
+)
+from .hostile import CORRUPTION_KINDS, corrupt_frame, corrupt_timeline
+from .quarantine import (
+    QUARANTINE_NAME,
+    REASONS,
+    QuarantineStore,
+    configure_quarantine,
+    get_quarantine,
+)
+from .table_admission import admit_table
+
+__all__ = [
+    "AdmissionResult",
+    "TraceClock",
+    "CORRUPTION_KINDS",
+    "QUARANTINE_NAME",
+    "QuarantineStore",
+    "REASONS",
+    "admit_frame",
+    "admit_table",
+    "coercible_event_times",
+    "configure_quarantine",
+    "corrupt_frame",
+    "corrupt_timeline",
+    "get_quarantine",
+    "pre_admit_frame",
+]

@@ -1,0 +1,625 @@
+"""Span admission: per-row schema+value validation, vectorized.
+
+Every lane — batch ``TableRCA``/``OnlineRCA``, ``serve`` POST /rank,
+the ``stream`` sources, ``fleet`` workers — passes span frames through
+:func:`admit_frame` before detect/build. The checks run in a fixed
+order (each step sees only rows the previous steps admitted), all of
+them vectorized over the frame:
+
+1. **identity** — null/empty ``traceID``/``spanID`` reject
+   (``missing_id``);
+2. **timestamps** — ``startTime``/``endTime`` coerce with
+   ``errors="coerce"``; NaT rejects (``bad_timestamp``) — one malformed
+   row never aborts the frame;
+3. **durations** — non-numeric/negative reject (``bad_duration``),
+   values past ``IngestConfig.max_duration_us`` reject
+   (``duration_overflow``);
+4. **duplicates** — repeated ``(traceID, spanID)`` keeps the FIRST
+   occurrence, rejects the rest (``dup_span``);
+5. **trace-length budget** — a trace's spans past
+   ``max_spans_per_trace`` (event-time order) reject
+   (``trace_too_long``): a single adversarial mega-trace cannot grow
+   the pad buckets without bound;
+6. **parent linkage** — a span naming a parent absent from its trace
+   is an orphan: ``orphan_policy="stitch"`` clears the link (the span
+   becomes a root, kept and counted), ``"drop"`` rejects (``orphan``);
+7. **vocab budget** — distinct operations past ``max_ops_per_window``
+   keep the highest-span-count ops and reject the tail
+   (``vocab_budget``): the cardinality-bomb guard — bomb ops are
+   many-and-thin by construction, so the real vocabulary survives;
+8. **clock skew** — spans whose start sits outside the window bound by
+   up to ``skew_reject_seconds`` CLAMP to the window-relative bound
+   (``max_skew_seconds``, kept and counted — cross-host skew is
+   normalized, not punished); further out rejects (``clock_skew``).
+
+Rejected rows route to the dead-letter store (ingest.quarantine) with
+exactly one reason each; per-reason counts land in
+``microrank_ingest_rejected_total{reason}`` and the caller's journal.
+Admission is idempotent: re-admitting the clean subset rejects nothing
+and changes nothing (pinned by a property test).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..utils.logging import get_logger
+from .quarantine import QuarantineStore
+
+log = get_logger("microrank_tpu.ingest")
+
+
+@dataclass
+class AdmissionResult:
+    """What admission decided about one frame."""
+
+    frame: pd.DataFrame                 # the clean (admitted) subset
+    n_input: int = 0
+    n_admitted: int = 0
+    rejected: Dict[str, int] = field(default_factory=dict)
+    clamped_skew: int = 0               # kept rows whose times clamped
+    stitched_orphans: int = 0           # kept rows whose parent cleared
+    window_ops: int = 0                 # post-admission distinct op count
+
+    @property
+    def n_rejected(self) -> int:
+        return sum(self.rejected.values())
+
+    @property
+    def admission_ratio(self) -> float:
+        """Admitted fraction of the input (1.0 for an empty input —
+        an empty frame is vacuously clean, not hostile)."""
+        if self.n_input == 0:
+            return 1.0
+        return self.n_admitted / self.n_input
+
+    @property
+    def degraded(self) -> bool:
+        """True when anything was rejected: downstream results are
+        correct on the clean subset but partial on the window."""
+        return self.n_rejected > 0
+
+    def journal_fields(self) -> dict:
+        """Compact per-window journal record of the admission."""
+        return {
+            "n_input": self.n_input,
+            "n_admitted": self.n_admitted,
+            "rejected": dict(self.rejected),
+            "clamped_skew": self.clamped_skew,
+            "stitched_orphans": self.stitched_orphans,
+            "admission_ratio": round(self.admission_ratio, 4),
+        }
+
+
+def _empty_result(frame: pd.DataFrame) -> AdmissionResult:
+    return AdmissionResult(
+        frame=frame, n_input=len(frame), n_admitted=len(frame)
+    )
+
+
+def _coerce_datetime(col: pd.Series) -> pd.Series:
+    """errors="coerce" datetime parse that accepts already-parsed
+    columns unchanged (fast path: no re-parse of datetime64)."""
+    if pd.api.types.is_datetime64_any_dtype(col):
+        return col
+    return pd.to_datetime(col, format="mixed", errors="coerce")
+
+
+def coercible_event_times(
+    frame: pd.DataFrame,
+) -> Tuple[pd.Series, pd.Series, np.ndarray]:
+    """(start, end, bad_mask) — coerced timestamps plus the rows whose
+    event time cannot exist. Shared by :func:`admit_frame` and the
+    stream engine's pre-windowing gate (the windower needs sane
+    ``startTime`` before window assignment is even defined)."""
+    start = _coerce_datetime(frame["startTime"])
+    end = _coerce_datetime(frame["endTime"])
+    bad = (start.isna() | end.isna()).to_numpy()
+    return start, end, bad
+
+
+def _skew_normalize(
+    start: pd.Series,
+    end: pd.Series,
+    dur: pd.Series,
+    alive: np.ndarray,
+    cfg,
+    window_bounds: Optional[Tuple],
+) -> Tuple[pd.Series, pd.Series, int, np.ndarray]:
+    """Clock-skew normalization, shared by the per-window ladder and
+    the pre-windowing gate: spans outside the reference interval by up
+    to ``skew_reject_seconds`` CLAMP to the ``max_skew_seconds`` bound
+    (kept); further out is hopeless (rejected). The reference interval
+    is the window bounds when given, else the ROBUST (10th..90th
+    percentile) start-time spread of the frame itself — robust, because
+    the skewed rows are in the frame and a min/max reference would
+    follow them. The clamp is what protects the WATERMARK: a forward-
+    skewed row that kept its claimed time would advance the event-time
+    watermark by the full skew and close innocent windows early (their
+    real spans then drop as late) — clamped to the bound, the damage
+    is capped at ``max_skew_seconds``. Returns
+    (start, end, n_clamped, hopeless_mask)."""
+    n = len(start)
+    hopeless = np.zeros(n, dtype=bool)
+    skew_s = float(getattr(cfg, "max_skew_seconds", 0.0) or 0.0)
+    reject_s = float(getattr(cfg, "skew_reject_seconds", 0.0) or 0.0)
+    if skew_s <= 0 or not alive.any():
+        return start, end, 0, hopeless
+    start_ns = start.values.astype("int64")
+    if window_bounds is not None:
+        ref_lo = pd.Timestamp(window_bounds[0]).value
+        ref_hi = pd.Timestamp(window_bounds[1]).value
+        hop_lo, hop_hi = ref_lo, ref_hi
+        fwd_s = skew_s
+    else:
+        ref_lo = int(np.quantile(start_ns[alive], 0.1))
+        ref_hi = int(np.quantile(start_ns[alive], 0.9))
+        # The HOPELESS bound anchors on the median, not the spread
+        # quantiles: an event-time sort concentrates skewed rows at
+        # the batch edges, where they'd capture q10/q90 and certify
+        # themselves sane. The median survives any minority attack
+        # (a majority-corrupt batch defeats it — the per-window
+        # min_admission_ratio refusal is the backstop there).
+        hop_lo = hop_hi = int(np.median(start_ns[alive]))
+        # Pre-windowing: the forward bound is tight (watermark
+        # protection), the backward bound loose (a past-claiming row
+        # only risks being late itself).
+        fwd_s = float(
+            getattr(cfg, "forward_skew_seconds", skew_s) or skew_s
+        )
+    lo = ref_lo - int(skew_s * 1e9)
+    hi = ref_hi + int(fwd_s * 1e9)
+    off = ((start_ns < lo) | (start_ns > hi)) & alive
+    if reject_s > skew_s:
+        hopeless = (
+            (start_ns < hop_lo - int(reject_s * 1e9))
+            | (start_ns > hop_hi + int(reject_s * 1e9))
+        ) & alive
+    clamp = off & ~hopeless
+    n_clamp = int(clamp.sum())
+    if n_clamp:
+        clamped = np.clip(start_ns, lo, hi)
+        new_start_ns = np.where(clamp, clamped, start_ns)
+        dur_ns = (
+            dur.fillna(0).to_numpy(dtype="float64") * 1e3
+        ).astype("int64")
+        new_end_ns = np.where(
+            clamp, new_start_ns + dur_ns, end.values.astype("int64")
+        )
+        start = pd.Series(
+            pd.to_datetime(new_start_ns), index=start.index
+        )
+        end = pd.Series(pd.to_datetime(new_end_ns), index=end.index)
+        from ..obs.metrics import record_ingest_clamped
+
+        record_ingest_clamped("clock_skew", n_clamp)
+    return start, end, n_clamp, hopeless
+
+
+class TraceClock:
+    """Bounded per-trace first-seen event-time registry: the trace-
+    relative half of clock-skew normalization.
+
+    Batch-relative bounds cannot see a skewed span once the stream is
+    re-sorted — a row shifted ten minutes forward sits among rows that
+    genuinely started then, perfectly sane relative to its neighbors.
+    What betrays it is its own TRACE: spans of one trace start within
+    the trace's real duration of each other, so a span claiming a time
+    far from its trace's first-seen event time is skew-displaced (a
+    torn trace's root span landing alone in the wrong window is what
+    turns cross-host skew into spurious anomalies). ``normalize``
+    clamps such spans to ``first_seen ± forward_skew_seconds`` (kept +
+    counted — normalization, not punishment). The registry is a
+    bounded LRU over trace ids, so an unbounded id stream cannot grow
+    host memory.
+    """
+
+    def __init__(self, max_traces: int = 1 << 16):
+        from collections import OrderedDict
+
+        self.max_traces = int(max_traces)
+        self._first: "OrderedDict[str, int]" = OrderedDict()
+
+    def normalize(
+        self, trace_ids: np.ndarray, start: pd.Series,
+        end: Optional[pd.Series], alive: np.ndarray, cfg,
+    ) -> Tuple[pd.Series, Optional[pd.Series], int]:
+        bound_s = float(
+            getattr(cfg, "forward_skew_seconds", 0.0) or 0.0
+        )
+        if bound_s <= 0 or not alive.any():
+            return start, end, 0
+        bound = int(bound_s * 1e9)
+        start_ns = start.values.astype("int64").copy()
+        idx = np.flatnonzero(alive)
+        sub_tr = trace_ids[idx]
+        sub_ns = start_ns[idx]
+        # Per-trace batch minimum, joined (vectorized) with the
+        # registry's earlier first-seen where one exists.
+        codes, uniq = pd.factorize(sub_tr)
+        bmin = np.full(len(uniq), np.iinfo(np.int64).max, np.int64)
+        np.minimum.at(bmin, codes, sub_ns)
+        seen = np.array(
+            [self._first.get(t, -1) for t in uniq], dtype=np.int64
+        )
+        first = np.where(seen >= 0, np.minimum(seen, bmin), bmin)
+        row_first = first[codes]
+        off = (sub_ns < row_first - bound) | (
+            sub_ns > row_first + bound
+        )
+        n_clamp = int(off.sum())
+        delta = None
+        if n_clamp:
+            # Repair lands ON first_seen, not at the bound edge: a
+            # displaced span rejoins its trace's window — clamping to
+            # first_seen + bound would park boundary-adjacent spans
+            # one window over, and a torn partial trace there reads as
+            # an anomaly.
+            clamped = np.where(off, row_first, sub_ns)
+            delta = clamped - sub_ns
+            sub_ns = clamped
+            start_ns[idx] = sub_ns
+        new_first = np.minimum(first, bmin)
+        np.minimum.at(new_first, codes, sub_ns)
+        for t, v in zip(uniq, new_first):
+            self._first[t] = int(v)
+            self._first.move_to_end(t)
+        while len(self._first) > self.max_traces:
+            self._first.popitem(last=False)
+        if n_clamp:
+            from ..obs.metrics import record_ingest_clamped
+
+            record_ingest_clamped("clock_skew", n_clamp)
+            start = pd.Series(
+                pd.to_datetime(start_ns), index=start.index
+            )
+            if end is not None:
+                # The span's whole time range shifts by the repair
+                # delta — end must follow start or the batch window
+                # predicate (start >= w0 AND end <= w1) would silently
+                # exclude the repaired span from every window.
+                end_ns = end.values.astype("int64").copy()
+                end_ns[idx] = end_ns[idx] + delta
+                end = pd.Series(
+                    pd.to_datetime(end_ns), index=end.index
+                )
+        return start, end, n_clamp
+
+
+def pre_admit_frame(
+    frame: pd.DataFrame,
+    ingest_config,
+    quarantine: Optional[QuarantineStore] = None,
+    source: str = "",
+    trace_clock: Optional[TraceClock] = None,
+) -> Tuple[pd.DataFrame, Dict[str, int]]:
+    """The pre-windowing gate: reject rows that cannot be ASSIGNED to a
+    window (missing ids, uncoercible timestamps, non-numeric durations,
+    hopeless clock skew) and clamp salvageable skew to the batch's
+    robust event-time spread — BEFORE the windower files spans by start
+    time, so a skewed span neither poisons the watermark (closing
+    innocent windows early, late-dropping their real spans) nor
+    silently late-drops itself. Window-relative checks (duplicates,
+    orphans, budgets) stay with :func:`admit_frame` on the CLOSED
+    window. Returns (clean_frame, rejected_counts)."""
+    if not getattr(ingest_config, "enabled", True) or len(frame) == 0:
+        return frame, {}
+    masks: Dict[str, np.ndarray] = {}
+    missing = _missing_id_mask(frame)
+    start, end, bad_ts = coercible_event_times(frame)
+    dur = pd.to_numeric(frame["duration"], errors="coerce")
+    bad_dur = (dur.isna() | (dur < 0)).to_numpy()
+    masks["missing_id"] = missing
+    masks["bad_timestamp"] = bad_ts & ~missing
+    masks["bad_duration"] = bad_dur & ~missing & ~bad_ts
+    alive = ~(missing | bad_ts | bad_dur)
+    start, end, n_skew, hopeless = _skew_normalize(
+        start, end, dur, alive, ingest_config, window_bounds=None
+    )
+    n_clock = 0
+    if trace_clock is not None:
+        # Trace-relative skew repair: a span claiming a time far from
+        # its own trace's first-seen event time clamps back to it —
+        # the only reference a re-sorted stream cannot fake.
+        tr = frame["traceID"].astype(str).to_numpy()
+        start, end, n_clock = trace_clock.normalize(
+            tr, start, end, alive & ~hopeless, ingest_config
+        )
+    masks["clock_skew"] = hopeless
+    rejected = _reject(frame, masks, quarantine, source)
+    bad = ~alive | hopeless
+    if (
+        not bad.any()
+        and n_skew == 0
+        and n_clock == 0
+        and pd.api.types.is_datetime64_any_dtype(frame["startTime"])
+        and pd.api.types.is_datetime64_any_dtype(frame["endTime"])
+        and pd.api.types.is_numeric_dtype(frame["duration"])
+    ):
+        # Clean batch, nothing coerced or clamped: the hot path pays
+        # the vectorized checks and zero copies.
+        return frame, rejected
+    keep = np.flatnonzero(~bad)
+    out = frame.iloc[keep].copy()
+    out["startTime"] = start.iloc[keep]
+    out["endTime"] = end.iloc[keep]
+    out["duration"] = dur.iloc[keep]
+    return out.reset_index(drop=True), rejected
+
+
+def _missing_id_mask(frame: pd.DataFrame) -> np.ndarray:
+    bad = np.zeros(len(frame), dtype=bool)
+    for col in ("traceID", "spanID"):
+        s = frame[col]
+        bad |= s.isna().to_numpy()
+        bad |= (s.astype(str).str.len() == 0).to_numpy()
+    return bad
+
+
+def _reject(
+    frame: pd.DataFrame,
+    masks: Dict[str, np.ndarray],
+    quarantine: Optional[QuarantineStore],
+    source: str,
+) -> Dict[str, int]:
+    """Record + quarantine per-reason reject masks; returns counts."""
+    from ..obs.metrics import record_ingest_rejected
+    from .quarantine import get_quarantine
+
+    counts = {
+        reason: int(np.asarray(m).sum())
+        for reason, m in masks.items()
+        if np.asarray(m).any()
+    }
+    if not counts:
+        return counts
+    for reason, n in counts.items():
+        record_ingest_rejected(reason, n)
+    store = quarantine if quarantine is not None else get_quarantine()
+    store.put_frame(frame, masks, source=source)
+    return counts
+
+
+def admit_frame(
+    frame: pd.DataFrame,
+    ingest_config,
+    quarantine: Optional[QuarantineStore] = None,
+    source: str = "",
+    window_bounds: Optional[Tuple] = None,
+    known_ops=None,
+) -> AdmissionResult:
+    """Run the full admission ladder over one window frame (see module
+    docstring for the step order). ``window_bounds=(start, end)``
+    anchors the clock-skew bound to the window; without it the frame's
+    own robust start-time spread anchors it (the serve shape, where the
+    request IS the window). ``known_ops`` — the baseline's service-
+    level operation set — arms the vocab-GROWTH guard: a window
+    introducing more than ``max_new_ops_per_window`` never-seen
+    operations is under cardinality attack and ALL its never-seen-op
+    spans quarantine (a bomb of novel op names must not reach the
+    detector, the baseline, or the pad buckets)."""
+    cfg = ingest_config
+    if not getattr(cfg, "enabled", True) or len(frame) == 0:
+        return _empty_result(frame)
+
+    n_input = len(frame)
+    work = frame.reset_index(drop=True)
+    masks: Dict[str, np.ndarray] = {}
+    result = AdmissionResult(frame=work, n_input=n_input)
+
+    # 1-3: identity, timestamps, durations (the pre-windowing trio).
+    missing = _missing_id_mask(work)
+    start, end, bad_ts = coercible_event_times(work)
+    dur = pd.to_numeric(work["duration"], errors="coerce")
+    bad_dur = (dur.isna() | (dur < 0)).to_numpy()
+    max_dur = int(getattr(cfg, "max_duration_us", 0) or 0)
+    over_dur = (
+        (dur > max_dur).fillna(False).to_numpy()
+        if max_dur > 0
+        else np.zeros(n_input, dtype=bool)
+    )
+    masks["missing_id"] = missing
+    masks["bad_timestamp"] = bad_ts & ~missing
+    masks["bad_duration"] = bad_dur & ~missing & ~bad_ts
+    masks["duration_overflow"] = (
+        over_dur & ~missing & ~bad_ts & ~bad_dur
+    )
+    rejected = missing | bad_ts | bad_dur | over_dur
+
+    # 4: duplicate (traceID, spanID) — first occurrence wins.
+    alive = ~rejected
+    dup = (
+        work[["traceID", "spanID"]]
+        .astype(str)
+        .duplicated(keep="first")
+        .to_numpy()
+    )
+    # A duplicate of a REJECTED first occurrence is still a duplicate
+    # of data that existed; keeping taxonomy simple, any repeat of a
+    # key already seen rejects as dup_span.
+    masks["dup_span"] = dup & alive
+    rejected |= dup
+
+    # 5: trace-length budget (event-time order within each trace).
+    max_trace = int(getattr(cfg, "max_spans_per_trace", 0) or 0)
+    if max_trace > 0:
+        alive = ~rejected
+        # Rank of each alive row within its trace, in start order:
+        # stable sort by (trace, start), then position minus the first
+        # position of the trace run.
+        tr = work["traceID"].astype(str).to_numpy()
+        key_start = start.values.astype("int64")
+        idx = np.flatnonzero(alive)
+        if idx.size:
+            sub_order = idx[
+                np.lexsort((key_start[idx], tr[idx]))
+            ]
+            tr_sorted = tr[sub_order]
+            run_start = np.flatnonzero(
+                np.concatenate(
+                    ([True], tr_sorted[1:] != tr_sorted[:-1])
+                )
+            )
+            pos = np.arange(sub_order.size)
+            rank = pos - np.repeat(
+                run_start, np.diff(np.append(run_start, sub_order.size))
+            )
+            too_long = np.zeros(n_input, dtype=bool)
+            too_long[sub_order[rank >= max_trace]] = True
+            masks["trace_too_long"] = too_long
+            rejected |= too_long
+
+    # (Parent linkage runs LAST — steps 7/8 can reject a span whose
+    # children survive, and the orphan pass must see the final set or
+    # re-admission would find new orphans, breaking idempotence.)
+
+    # 7: vocab budgets — the cardinality-bomb guards.
+    max_ops = int(getattr(cfg, "max_ops_per_window", 0) or 0)
+    max_new = int(getattr(cfg, "max_new_ops_per_window", 0) or 0)
+    alive = ~rejected
+    op_names = (
+        work["podName"].astype(str)
+        + "_"
+        + work["operationName"].astype(str)
+    ).to_numpy()
+    if known_ops and max_new > 0 and alive.any():
+        # 7a: GROWTH cap against the baseline's known vocabulary. A
+        # never-seen op is fine (deployments happen); a window full of
+        # them is an attack — past the cap, every never-seen-op span
+        # rejects, so novel-name bombs cannot trigger the detector,
+        # retrain the baseline, or escalate the pad buckets.
+        from ..io.naming import operation_names
+
+        svc_names = operation_names(work, "service").to_numpy()
+        uniq_new = pd.unique(
+            svc_names[alive & ~np.isin(svc_names, list(known_ops))]
+        )
+        if uniq_new.size > max_new:
+            over = np.isin(svc_names, uniq_new) & alive
+            masks["vocab_budget"] = over
+            rejected |= over
+            alive = ~rejected
+            log.warning(
+                "%s: vocab growth cap hit — window introduces %d "
+                "never-seen ops > %d cap; rejected all %d of their "
+                "spans (cardinality attack)",
+                source or "ingest", uniq_new.size, max_new,
+                int(over.sum()),
+            )
+    if max_ops > 0 and alive.any():
+        uniq, inv, counts = np.unique(
+            op_names[alive], return_inverse=True, return_counts=True
+        )
+        if uniq.size > max_ops:
+            # Keep the max_ops highest-span-count ops (ties by name for
+            # determinism); everything else is past the budget.
+            order2 = np.lexsort((uniq, -counts))
+            kept_ops = set(uniq[order2[:max_ops]])
+            over = np.zeros(n_input, dtype=bool)
+            over[np.flatnonzero(alive)] = np.array(
+                [u not in kept_ops for u in uniq], dtype=bool
+            )[inv]
+            # 7a (growth cap) may have fired too: one reason, one mask.
+            masks["vocab_budget"] = (
+                masks.get("vocab_budget", np.zeros(n_input, bool)) | over
+            )
+            rejected |= over
+            log.warning(
+                "%s: vocab budget hit — %d distinct ops > %d cap; "
+                "rejected %d spans of the %d thinnest ops",
+                source or "ingest", uniq.size, max_ops,
+                int(over.sum()), uniq.size - max_ops,
+            )
+
+    # 8: clock skew — clamp to the window-relative bound, reject the
+    # hopeless.
+    alive = ~rejected
+    start, end, n_clamp, hopeless = _skew_normalize(
+        start, end, dur, alive, cfg, window_bounds
+    )
+    result.clamped_skew = n_clamp
+    if hopeless.any():
+        masks["clock_skew"] = hopeless
+        rejected |= hopeless
+
+    # 6 (last): parent linkage over the FINAL survivor set — any
+    # earlier rejection can orphan a surviving child. "stitch" clears
+    # the link in one pass (the span becomes a trace root, kept and
+    # counted); "drop" rejects and must iterate — dropping a parent
+    # orphans its children, so the pass runs to a fixpoint (bounded by
+    # trace depth) or re-admission would keep finding new orphans.
+    if "ParentSpanId" in work.columns:
+        drop_policy = getattr(cfg, "orphan_policy", "stitch") == "drop"
+        orphan_total = np.zeros(n_input, dtype=bool)
+        for _ in range(n_input):
+            alive = ~rejected
+            parent = work["ParentSpanId"].fillna("").astype(str)
+            has_parent = (parent.str.len() > 0).to_numpy()
+            tr_str = work["traceID"].astype(str)
+            span_keys = (
+                tr_str + "\x00" + work["spanID"].astype(str)
+            ).to_numpy()[alive]
+            parent_keys = (tr_str + "\x00" + parent).to_numpy()
+            orphan = has_parent & alive & ~np.isin(
+                parent_keys, span_keys
+            )
+            if not orphan.any():
+                break
+            if drop_policy:
+                orphan_total |= orphan
+                rejected |= orphan
+                continue  # a dropped parent may orphan its children
+            # Stitch: one pass suffices (no rows are removed).
+            work = work.copy()
+            work.loc[orphan, "ParentSpanId"] = ""
+            result.stitched_orphans = int(orphan.sum())
+            from ..obs.metrics import record_ingest_clamped
+
+            record_ingest_clamped(
+                "orphan_stitched", result.stitched_orphans
+            )
+            break
+        if drop_policy and orphan_total.any():
+            masks["orphan"] = orphan_total
+
+    # Materialize: quarantine + count the rejects, emit the clean frame
+    # with coerced dtypes (clean windows skip the copy entirely).
+    result.rejected = _reject(work, masks, quarantine, source)
+    if (
+        not rejected.any()
+        and result.clamped_skew == 0
+        and pd.api.types.is_datetime64_any_dtype(work["startTime"])
+        and pd.api.types.is_datetime64_any_dtype(work["endTime"])
+        and pd.api.types.is_numeric_dtype(work["duration"])
+    ):
+        clean = work
+    else:
+        keep = np.flatnonzero(~rejected)
+        clean = work.iloc[keep].copy()
+        clean["startTime"] = start.iloc[keep]
+        clean["endTime"] = end.iloc[keep]
+        clean["duration"] = dur.iloc[keep]
+        clean = clean.reset_index(drop=True)
+    result.frame = clean
+    result.n_admitted = len(clean)
+    if len(clean):
+        result.window_ops = int(
+            pd.unique(op_names[np.flatnonzero(~rejected)]).size
+        )
+    from ..obs.metrics import record_ingest_admitted, record_window_ops
+
+    record_ingest_admitted(result.n_admitted)
+    record_window_ops(result.window_ops)
+    if result.degraded:
+        log.warning(
+            "%s: admitted %d/%d spans (%s)",
+            source or "ingest", result.n_admitted, result.n_input,
+            ", ".join(
+                f"{k}={v}" for k, v in sorted(result.rejected.items())
+            ),
+        )
+    return result

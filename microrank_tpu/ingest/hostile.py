@@ -1,0 +1,178 @@
+"""Deterministic span-data corruption: the hostile side of admission.
+
+One seeded function per corruption class, applied to a canonical span
+frame. Three consumers share it so the attack and the defense are
+pinned against the same bytes:
+
+* the chaos registry's ``source_data`` seam (``ReplaySource``/
+  ``SyntheticSource`` corrupt a chunk when a fault spec fires — the
+  fault plan's seed + event number make the corruption replayable);
+* the ``hostile`` scenario family (``scenarios.generate`` corrupts the
+  compiled timeline so the policy engine scores formulas under dirty
+  data);
+* the adversarial corpus fixtures under ``tests/data/hostile/``
+  (``tests/data/hostile/make_fixtures.py`` renders one CSV per class).
+
+Corruptions mirror the admission taxonomy (ingest.quarantine.REASONS):
+
+* ``corrupt_row``       — unparseable timestamps + negative/NaN
+  durations on a row sample (the classic torn/garbled export rows);
+* ``dup_span``          — a row sample duplicated verbatim;
+* ``orphan``            — a row sample's ``ParentSpanId`` repointed at
+  span ids that do not exist;
+* ``clock_skew``        — a row sample's timestamps shifted by a
+  cross-host offset (half clampable, half hopeless);
+* ``cardinality_bomb``  — one adversarial trace appended whose every
+  span carries a UNIQUE operation name (vocab growth) on one long
+  trace (pad-bucket growth) — the budget guard's target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+CORRUPTION_KINDS = (
+    "corrupt_row", "dup_span", "orphan", "clock_skew",
+    "cardinality_bomb",
+)
+
+
+def _sample(rng: np.random.Generator, n: int, fraction: float) -> np.ndarray:
+    k = max(1, int(round(n * fraction)))
+    return rng.choice(n, size=min(k, n), replace=False)
+
+
+def corrupt_frame(
+    frame: pd.DataFrame,
+    kind: str,
+    seed: int = 0,
+    fraction: float = 0.05,
+    bomb_ops: int = 64,
+) -> pd.DataFrame:
+    """Return a corrupted COPY of ``frame`` (the input is never
+    mutated). ``fraction`` sizes the row sample for the row-local
+    kinds; ``bomb_ops`` sizes the cardinality bomb's unique-op count.
+    Deterministic in (frame, kind, seed)."""
+    rng = np.random.default_rng(
+        np.uint64(seed) + np.uint64(len(frame)) * np.uint64(2654435761)
+    )
+    out = frame.copy()
+    n = len(out)
+    if n == 0:
+        return out
+    if kind == "corrupt_row":
+        rows = _sample(rng, n, fraction)
+        half = rows[: max(1, len(rows) // 2)]
+        rest = rows[len(half):]
+        # Timestamp garbage needs an object column; duration garbage
+        # needs a float/object column — exactly the dirtiness a real
+        # CSV row brings in.
+        out["startTime"] = out["startTime"].astype(object)
+        out.iloc[
+            half, out.columns.get_loc("startTime")
+        ] = "not-a-timestamp"
+        if len(rest):
+            out["duration"] = out["duration"].astype(object)
+            neg = rest[: len(rest) // 2 + 1]
+            nan = rest[len(neg):]
+            out.iloc[neg, out.columns.get_loc("duration")] = -1
+            if len(nan):
+                out.iloc[
+                    nan, out.columns.get_loc("duration")
+                ] = "garbage"
+        return out
+    if kind == "dup_span":
+        rows = _sample(rng, n, fraction)
+        return pd.concat(
+            [out, out.iloc[rows]], ignore_index=True
+        )
+    if kind == "orphan":
+        rows = _sample(rng, n, fraction)
+        ghosts = np.array(
+            [f"ghost-{seed}-{i}" for i in range(len(rows))]
+        )
+        out.iloc[
+            rows, out.columns.get_loc("ParentSpanId")
+        ] = ghosts
+        return out
+    if kind == "clock_skew":
+        rows = _sample(rng, n, fraction)
+        # Half a clampable cross-host offset (minutes), half hopeless
+        # (days) — exercising BOTH admission outcomes.
+        near = rows[: max(1, len(rows) // 2)]
+        far = rows[len(near):]
+        # Coerce: classes compose (corrupt_timeline chains them), so a
+        # frame may already carry unparseable cells — they stay bad
+        # (NaT) and the shift applies to the parseable rest.
+        start = pd.to_datetime(
+            out["startTime"], format="mixed", errors="coerce"
+        ).copy()
+        end = pd.to_datetime(
+            out["endTime"], format="mixed", errors="coerce"
+        ).copy()
+        near_off = pd.Timedelta(minutes=10)
+        far_off = pd.Timedelta(days=3)
+        start.iloc[near] = start.iloc[near] + near_off
+        end.iloc[near] = end.iloc[near] + near_off
+        if len(far):
+            start.iloc[far] = start.iloc[far] - far_off
+            end.iloc[far] = end.iloc[far] - far_off
+        out["startTime"] = start
+        out["endTime"] = end
+        return out
+    if kind == "cardinality_bomb":
+        t0 = pd.to_datetime(
+            out["startTime"], format="mixed", errors="coerce"
+        ).min()
+        trace = f"bomb-{seed}"
+        k = int(bomb_ops)
+        rows = {
+            "traceID": [trace] * k,
+            "spanID": [f"{trace}-s{i}" for i in range(k)],
+            "ParentSpanId": [""]
+            + [f"{trace}-s{i}" for i in range(k - 1)],
+            "operationName": [
+                f"op-bomb-{seed}-{i}" for i in range(k)
+            ],
+            "serviceName": [f"svc-bomb-{seed}"] * k,
+            "podName": [f"svc-bomb-{seed}-0"] * k,
+            "duration": np.full(k, 1000, dtype=np.int64),
+            "startTime": [
+                t0 + pd.Timedelta(microseconds=10 * i) for i in range(k)
+            ],
+            "endTime": [
+                t0 + pd.Timedelta(microseconds=10 * i + 1000)
+                for i in range(k)
+            ],
+        }
+        bomb = pd.DataFrame(rows)
+        for col in out.columns:
+            if col not in bomb.columns:
+                bomb[col] = 0
+        return pd.concat(
+            [out, bomb[list(out.columns)]], ignore_index=True
+        )
+    raise ValueError(
+        f"unknown corruption kind {kind!r}; expected one of "
+        f"{CORRUPTION_KINDS}"
+    )
+
+
+def corrupt_timeline(
+    frame: pd.DataFrame,
+    kinds,
+    seed: int = 0,
+    fraction: float = 0.05,
+    bomb_ops: int = 64,
+) -> pd.DataFrame:
+    """Apply several corruption classes in sequence (the ``hostile``
+    scenario family's mixed shape); each class draws from a distinct
+    derived seed so the mix is reproducible from one integer."""
+    out = frame
+    for i, kind in enumerate(kinds):
+        out = corrupt_frame(
+            out, kind, seed=seed * 1009 + i, fraction=fraction,
+            bomb_ops=bomb_ops,
+        )
+    return out

@@ -1,0 +1,203 @@
+"""Dead-letter store for rejected span rows: bounded, never a crash.
+
+Every row span admission (ingest.admission) refuses — and every raw
+line the tail source gives up re-parsing — lands here as ONE JSONL
+record carrying the row content, the rejection reason (the taxonomy
+below), the lane it came from, and where in the source it sat (byte
+offset for raw lines). The store is bounded: past
+``IngestConfig.quarantine_max_bytes`` new records are dropped AND
+counted (``microrank_ingest_quarantine_dropped_total``) — hostile data
+must not convert into a disk-filling attack through the very mechanism
+that contains it. With no path configured (no out_dir, library use)
+records are counted but not written; rejection is never silent either
+way, because the per-reason counter and the journal event fire at the
+admission seam, not here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Dict, Optional
+
+from ..utils.guards import published
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.ingest")
+
+QUARANTINE_NAME = "quarantine.jsonl"
+
+#: The rejection-reason taxonomy. Every quarantined row names exactly
+#: one of these; the per-reason counter and the DESIGN.md table use the
+#: same strings.
+REASONS = (
+    "bad_timestamp",      # start/end would not coerce to a datetime
+    "bad_duration",       # duration non-numeric or negative
+    "duration_overflow",  # duration past IngestConfig.max_duration_us
+    "missing_id",         # empty/null traceID or spanID
+    "dup_span",           # duplicate (traceID, spanID) — first kept
+    "orphan",             # parent span absent (orphan_policy="drop")
+    "clock_skew",         # start beyond skew_reject_seconds of the window
+    "trace_too_long",     # spans past max_spans_per_trace (truncated)
+    "vocab_budget",       # op past max_ops_per_window (cardinality bomb)
+    "unparseable_line",   # tail line that never parsed (byte offset kept)
+    "low_admission",      # whole window below min_admission_ratio
+)
+
+
+class QuarantineStore:
+    """Bounded JSONL dead-letter writer (thread-safe: sources, the
+    engine thread and serve's build pool all reject rows)."""
+
+    def __init__(self, path=None, max_bytes: int = 16 << 20):
+        from ..utils.guards import TrackedLock, register_shared
+
+        self.path = Path(path) if path is not None else None
+        self.max_bytes = int(max_bytes)
+        self._lock = TrackedLock("quarantine")
+        register_shared("quarantine", {"quarantine"})
+        self.records = 0
+        self.dropped = 0
+        self._bytes = 0
+        if self.path is not None and self.path.exists():
+            self._bytes = self.path.stat().st_size
+
+    # -------------------------------------------------------------- intake
+    def put_frame(
+        self,
+        frame,
+        reasons: Dict[str, "object"],
+        source: str = "",
+    ) -> int:
+        """Quarantine rejected rows of one frame. ``reasons`` maps a
+        reason string to a boolean row mask (pandas/numpy); a row
+        matching several masks records its FIRST reason in taxonomy
+        order, so every rejected row appears exactly once."""
+        import numpy as np
+
+        taken = None
+        lines = []
+        for reason in REASONS:
+            mask = reasons.get(reason)
+            if mask is None:
+                continue
+            m = np.asarray(mask, dtype=bool)
+            if taken is None:
+                taken = np.zeros(m.shape, dtype=bool)
+            m = m & ~taken
+            taken |= m
+            if not m.any():
+                continue
+            sub = frame.iloc[np.flatnonzero(m)]
+            for rec in sub.to_dict(orient="records"):
+                lines.append(self._record(rec, reason, source))
+        return self._write(lines)
+
+    def put_raw(
+        self,
+        payload,
+        reason: str,
+        source: str = "",
+        offset: Optional[int] = None,
+    ) -> int:
+        """Quarantine one raw (unparseable) source line, with the byte
+        offset it occupied so an operator can find it in the file."""
+        if isinstance(payload, bytes):
+            payload = payload.decode("utf-8", errors="replace")
+        rec = self._record(
+            {"raw": payload.rstrip("\n")}, reason, source
+        )
+        if offset is not None:
+            rec["offset"] = int(offset)
+        return self._write([rec])
+
+    # ------------------------------------------------------------ plumbing
+    @staticmethod
+    def _record(row: dict, reason: str, source: str) -> dict:
+        import time
+
+        clean = {}
+        for k, v in row.items():
+            # JSONL must always serialize: timestamps/NaT/numpy scalars
+            # render as strings, everything else passes through.
+            try:
+                json.dumps(v)
+                clean[k] = v
+            except (TypeError, ValueError):
+                clean[k] = str(v)
+        return {
+            "reason": reason,
+            "source": source,
+            "ts": time.time(),
+            "row": clean,
+        }
+
+    def _write(self, records) -> int:
+        from ..utils.guards import note_shared_access
+
+        if not records:
+            return 0
+        lines = [json.dumps(r, default=str) + "\n" for r in records]
+        kept = []
+        with self._lock:
+            note_shared_access("quarantine")
+            if self.path is None:
+                # Unconfigured (library use): count only, no cap — the
+                # records exist nowhere, so there is nothing to bound.
+                self.records += len(lines)
+                return len(lines)
+            for line in lines:
+                if self._bytes + len(line) > self.max_bytes:
+                    self.dropped += 1
+                    continue
+                self._bytes += len(line)
+                self.records += 1
+                kept.append(line)
+            dropped_now = len(lines) - len(kept)
+        if self.path is not None and kept:
+            try:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                with open(self.path, "a") as f:
+                    f.writelines(kept)
+            except OSError as e:  # pragma: no cover - disk trouble must
+                # not convert a data rejection into an engine crash.
+                log.warning("quarantine write failed: %s", e)
+        if dropped_now:
+            from ..obs.metrics import record_quarantine_dropped
+
+            record_quarantine_dropped(dropped_now)
+            log.warning(
+                "quarantine full (%d bytes cap): dropped %d record(s)",
+                self.max_bytes, dropped_now,
+            )
+        return len(kept)
+
+
+# -------------------------------------------------------- process store
+
+_store: Optional[QuarantineStore] = None
+
+
+def configure_quarantine(ingest_config, default_dir=None) -> QuarantineStore:
+    """Install the process dead-letter store (one per run entry —
+    stream engine, serve service, batch runners all call this with
+    their out_dir). ``IngestConfig.quarantine_dir`` overrides the run
+    dir; neither configured means a counting-only store. Installed at
+    run entry before worker threads spin up; seam threads read the
+    binding lock-free by design (mrlint R10's ``published`` seam)."""
+    global _store
+    qdir = getattr(ingest_config, "quarantine_dir", None) or default_dir
+    path = Path(qdir) / QUARANTINE_NAME if qdir is not None else None
+    max_bytes = getattr(ingest_config, "quarantine_max_bytes", 16 << 20)
+    _store = published(QuarantineStore(path, max_bytes=max_bytes))
+    return _store
+
+
+def get_quarantine() -> QuarantineStore:
+    """The process store; a counting-only fallback when none was
+    configured (rejection must never crash OR silently vanish)."""
+    global _store
+    if _store is None:
+        _store = published(QuarantineStore(None))
+    return _store

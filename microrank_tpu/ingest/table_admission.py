@@ -1,0 +1,148 @@
+"""Span admission for the native table lane (``TableRCA``).
+
+The native ingest (``native.load_span_table``) interns names and
+resolves parent linkage at load time, so half the pandas ladder is
+already settled by construction: unparseable rows never produce table
+rows, and a missing parent is already ``parent_row = -1`` (the stitch
+policy). What remains hostile at this level is VALUES — negative or
+overflow durations, inverted/impossible time ranges — and the resource
+budgets: a mega-trace that would blow the pad buckets, duration
+overflows that poison the SLO statistics. :func:`admit_table` applies
+those vectorized over the interned arrays and returns a filtered
+``SpanTable`` plus the per-reason counts; rejected rows land in the
+dead-letter store with their decoded names, and ``parent_row`` is
+remapped so surviving spans whose parent was rejected become roots
+(the stitch policy, consistent with the pandas lane).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .quarantine import QuarantineStore
+
+log = get_logger("microrank_tpu.ingest")
+
+
+def _quarantine_rows(
+    table, mask: np.ndarray, reason: str, store, source: str
+) -> None:
+    idx = np.flatnonzero(mask)
+    for i in idx:
+        store.put_raw(
+            (
+                f"trace={table.trace_names[int(table.trace_id[i])]} "
+                f"op={table.pod_op_names[int(table.pod_op[i])]} "
+                f"duration_us={int(table.duration_us[i])} "
+                f"start_us={int(table.start_us[i])} "
+                f"end_us={int(table.end_us[i])}"
+            ),
+            reason,
+            source=source,
+            offset=int(i),
+        )
+
+
+def admit_table(
+    table,
+    ingest_config,
+    quarantine: Optional[QuarantineStore] = None,
+    source: str = "table",
+) -> Tuple[object, Dict[str, int]]:
+    """Validate + budget one ``SpanTable``; returns
+    ``(clean_table, rejected_counts)``. The input is never mutated."""
+    from ..obs.metrics import record_ingest_admitted, record_ingest_rejected
+    from .quarantine import get_quarantine
+
+    cfg = ingest_config
+    n = table.n_spans
+    if not getattr(cfg, "enabled", True) or n == 0:
+        return table, {}
+
+    masks: Dict[str, np.ndarray] = {}
+    dur = table.duration_us
+    bad_dur = dur < 0
+    masks["bad_duration"] = bad_dur
+    max_dur = int(getattr(cfg, "max_duration_us", 0) or 0)
+    if max_dur > 0:
+        masks["duration_overflow"] = (dur > max_dur) & ~bad_dur
+    # Impossible event times: a trace-level end before its start (the
+    # loader parses both independently, so a garbled row can invert).
+    bad_ts = table.end_us < table.start_us
+    masks["bad_timestamp"] = bad_ts & ~bad_dur
+
+    rejected = np.zeros(n, dtype=bool)
+    for m in masks.values():
+        rejected |= m
+
+    # Trace-length budget: spans of a trace past the cap reject in row
+    # (event-time) order — the table is time-sorted, so "first cap
+    # spans" is well defined.
+    max_trace = int(getattr(cfg, "max_spans_per_trace", 0) or 0)
+    if max_trace > 0:
+        alive = ~rejected
+        tid = table.trace_id.astype(np.int64)
+        idx = np.flatnonzero(alive)
+        if idx.size:
+            order = idx[np.argsort(tid[idx], kind="stable")]
+            t_sorted = tid[order]
+            run_start = np.flatnonzero(
+                np.concatenate(([True], t_sorted[1:] != t_sorted[:-1]))
+            )
+            pos = np.arange(order.size)
+            rank = pos - np.repeat(
+                run_start, np.diff(np.append(run_start, order.size))
+            )
+            too_long = np.zeros(n, dtype=bool)
+            too_long[order[rank >= max_trace]] = True
+            if too_long.any():
+                masks["trace_too_long"] = too_long
+                rejected |= too_long
+
+    counts = {
+        reason: int(m.sum()) for reason, m in masks.items() if m.any()
+    }
+    if not counts:
+        record_ingest_admitted(n)
+        return table, {}
+
+    store = quarantine if quarantine is not None else get_quarantine()
+    for reason, m in masks.items():
+        if not m.any():
+            continue
+        record_ingest_rejected(reason, int(m.sum()))
+        _quarantine_rows(table, m, reason, store, source)
+
+    keep = ~rejected
+    # parent_row holds ABSOLUTE row indices; remap them onto the
+    # filtered table, stitching spans whose parent was rejected into
+    # roots (-1) — the same policy the pandas lane applies.
+    new_pos = np.cumsum(keep) - 1
+    parent = table.parent_row
+    has_parent = parent >= 0
+    parent_kept = np.zeros(n, dtype=bool)
+    parent_kept[has_parent] = keep[parent[has_parent]]
+    new_parent = np.where(
+        has_parent & parent_kept,
+        new_pos[np.clip(parent, 0, None)],
+        -1,
+    ).astype(parent.dtype)
+    clean = table._replace(
+        trace_id=table.trace_id[keep],
+        svc_op=table.svc_op[keep],
+        pod_op=table.pod_op[keep],
+        duration_us=table.duration_us[keep],
+        start_us=table.start_us[keep],
+        end_us=table.end_us[keep],
+        parent_row=new_parent[keep],
+    )
+    record_ingest_admitted(int(keep.sum()))
+    log.warning(
+        "%s: admitted %d/%d spans (%s)",
+        source, clean.n_spans, n,
+        ", ".join(f"{k}={v}" for k, v in sorted(counts.items())),
+    )
+    return clean, counts

@@ -1,6 +1,13 @@
 """CSV ingest honoring the reference's load contract
 (/root/reference/online_rca.py:219-248): read the ClickHouse export, rename
 columns to the canonical schema, and parse trace-level start/end datetimes.
+
+Hostile-data hardening (ingest/ subsystem): one malformed timestamp no
+longer aborts the whole frame — ``pd.to_datetime(errors="coerce")``
+turns it into NaT, the poisoned rows route to the dead-letter store
+(reason ``bad_timestamp``) and are counted in
+``microrank_ingest_rejected_total``, and the 9,999 good rows of a
+10,000-row dump load normally.
 """
 
 from __future__ import annotations
@@ -10,22 +17,63 @@ from typing import Union
 
 import pandas as pd
 
+from ..utils.logging import get_logger
 from .schema import CLICKHOUSE_RENAME, REQUIRED_COLUMNS, validate_columns
 
+log = get_logger("microrank_tpu.io")
 
-def load_traces_csv(path: Union[str, Path]) -> pd.DataFrame:
-    """Load one ``traces.csv`` dump into the canonical span DataFrame."""
+
+def load_traces_csv(
+    path: Union[str, Path], quarantine=None, source: str = "csv"
+) -> pd.DataFrame:
+    """Load one ``traces.csv`` dump into the canonical span DataFrame.
+
+    Rows whose timestamps will not coerce are dropped to the
+    dead-letter store (``quarantine`` or the process store) instead of
+    raising — a single poisoned row must not abort the frame.
+    """
     df = pd.read_csv(path)
     # Renaming is a no-op for already-canonical columns, so both raw
     # ClickHouse exports and canonical CSVs load through the same path.
     df = df.rename(columns=CLICKHOUSE_RENAME)
     validate_columns(df.columns)
-    df["startTime"] = pd.to_datetime(df["startTime"], format="mixed")
-    df["endTime"] = pd.to_datetime(df["endTime"], format="mixed")
+    start = pd.to_datetime(df["startTime"], format="mixed", errors="coerce")
+    end = pd.to_datetime(df["endTime"], format="mixed", errors="coerce")
+    bad = (start.isna() | end.isna()).to_numpy()
+    df["startTime"] = start
+    df["endTime"] = end
+    if bad.all() and len(df) > 0:
+        # NOTHING coerced: this is not a dump with some bad rows, it
+        # is a mis-parse (e.g. pandas index-inference on an over-long
+        # first data row silently shifts every column). Raise like a
+        # parse failure so retry/salvage machinery — not wholesale
+        # quarantine — handles it.
+        raise ValueError(
+            f"{path}: no row had a coercible timestamp "
+            f"({len(df)} rows) — mis-parsed or wholly corrupt input"
+        )
+    if bad.any():
+        from ..ingest.quarantine import get_quarantine
+        from ..obs.metrics import record_ingest_rejected
+
+        n_bad = int(bad.sum())
+        record_ingest_rejected("bad_timestamp", n_bad)
+        store = quarantine if quarantine is not None else get_quarantine()
+        store.put_frame(
+            df, {"bad_timestamp": bad}, source=f"{source}:{path}"
+        )
+        log.warning(
+            "%s: %d/%d rows had uncoercible timestamps; quarantined "
+            "(reason bad_timestamp), loading the clean remainder",
+            path, n_bad, len(df),
+        )
+        df = df.loc[~bad].reset_index(drop=True)
     return df
 
 
-def window_spans(df: pd.DataFrame, start=None, end=None) -> pd.DataFrame:
+def window_spans(
+    df: pd.DataFrame, start=None, end=None
+) -> pd.DataFrame:
     """Filter spans to a window (reference: get_span, preprocess_data.py:10-14).
 
     Keeps rows with ``startTime >= start`` and ``endTime <= end``. Like the

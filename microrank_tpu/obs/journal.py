@@ -80,6 +80,10 @@ class RunJournal:
             rank_residual=result.rank_residual,
             kernel=result.kernel,
             kind_dedup=result.kind_dedup,
+            ingest_rejected=getattr(result, "ingest_rejected", 0),
+            degraded_input=bool(
+                getattr(result, "degraded_input", False)
+            ),
             queue_depth=(
                 queue_depth if queue_depth is not None
                 else result.queue_depth

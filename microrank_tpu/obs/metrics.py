@@ -516,6 +516,53 @@ def fleet_host_spans_rate() -> Gauge:
     )
 
 
+def ingest_rejected() -> Counter:
+    return get_registry().counter(
+        "microrank_ingest_rejected_total",
+        "Span rows refused by admission (ingest/), by reason — every "
+        "counted row also lands exactly once in the dead-letter store "
+        "(quarantine.jsonl) with the same reason",
+        labelnames=("reason",),  # ingest.quarantine.REASONS
+    )
+
+
+def ingest_admitted() -> Counter:
+    return get_registry().counter(
+        "microrank_ingest_admitted_total",
+        "Span rows admitted past the ingest validation ladder "
+        "(the clean subset detect/build actually sees)",
+    )
+
+
+def ingest_clamped() -> Counter:
+    return get_registry().counter(
+        "microrank_ingest_clamped_total",
+        "Rows NORMALIZED (kept) by admission rather than rejected: "
+        "clock_skew = timestamps clamped to the window-relative bound, "
+        "orphan_stitched = broken parent links cleared (span becomes a "
+        "trace root)",
+        labelnames=("kind",),  # clock_skew | orphan_stitched
+    )
+
+
+def ingest_quarantine_dropped() -> Counter:
+    return get_registry().counter(
+        "microrank_ingest_quarantine_dropped_total",
+        "Dead-letter records dropped because quarantine.jsonl reached "
+        "its byte cap (IngestConfig.quarantine_max_bytes) — hostile "
+        "data must not become a disk-filling attack",
+    )
+
+
+def ingest_window_ops() -> Gauge:
+    return get_registry().gauge(
+        "microrank_ingest_window_ops",
+        "Distinct operations in the most recently admitted window "
+        "(post-budget: bounded by IngestConfig.max_ops_per_window — "
+        "the vocab-growth guard's observable)",
+    )
+
+
 def host_load_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_norm_load",
@@ -556,6 +603,8 @@ def ensure_catalog() -> None:
         policy_events,
         fleet_heartbeats, fleet_reports, fleet_workers_gauge,
         fleet_reassignments, fleet_sealed_windows, fleet_host_spans_rate,
+        ingest_rejected, ingest_admitted, ingest_clamped,
+        ingest_quarantine_dropped, ingest_window_ops,
         host_load_gauge, host_steal_gauge,
     ):
         ctor()
@@ -713,6 +762,28 @@ def record_fleet_sealed(outcome: str) -> None:
 
 def record_fleet_host_rate(host: str, spans_per_second: float) -> None:
     fleet_host_spans_rate().set(float(spans_per_second), host=host)
+
+
+def record_ingest_rejected(reason: str, n: int = 1) -> None:
+    ingest_rejected().inc(float(n), reason=reason)
+
+
+def record_ingest_admitted(n: int) -> None:
+    if n > 0:
+        ingest_admitted().inc(float(n))
+
+
+def record_ingest_clamped(kind: str, n: int = 1) -> None:
+    if n > 0:
+        ingest_clamped().inc(float(n), kind=kind)
+
+
+def record_quarantine_dropped(n: int = 1) -> None:
+    ingest_quarantine_dropped().inc(float(n))
+
+
+def record_window_ops(n: int) -> None:
+    ingest_window_ops().set(float(n))
 
 
 def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:

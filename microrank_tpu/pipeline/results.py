@@ -60,6 +60,12 @@ class WindowResult:
     # data when the caller asked for it (serve explain:true) — None
     # everywhere else; the bundle files are the durable form.
     explain: Optional[dict] = None
+    # Span admission (ingest/ subsystem): rows of this window the
+    # admission ladder refused (each one in the dead-letter store with
+    # a reason), and whether the ranking therefore ran on a partial —
+    # degraded-but-correct — clean subset of the window.
+    ingest_rejected: int = 0
+    degraded_input: bool = False
 
     def apply_convergence(self, conv: Optional[dict]) -> None:
         """Fold a convergence summary ({iterations, final_residual, ...})

@@ -56,6 +56,21 @@ class OnlineRCA:
         )
         if self.policy_resolution.outcome == "applied":
             self.backend = get_backend(self.config)
+        if self.config.ingest.enabled:
+            # A poisoned normal dump must not poison the SLO floor:
+            # the baseline fits on the admitted subset only.
+            from ..ingest import admit_frame
+
+            adm = admit_frame(
+                normal_df, self.config.ingest, source="run:normal"
+            )
+            if adm.degraded:
+                self.log.warning(
+                    "normal dump: %d/%d rows rejected by admission; "
+                    "baseline fits on the clean subset",
+                    adm.n_rejected, adm.n_input,
+                )
+            normal_df = adm.frame
         if cache_path is not None and Path(cache_path).exists():
             self.slo_vocab, self.baseline = load_slo(cache_path)
             self.log.info(
@@ -96,6 +111,29 @@ class OnlineRCA:
         """Slide over ``data`` (the abnormal dump) and RCA every anomalous
         window (reference: online_anomaly_detect_RCA, online_rca.py:155)."""
         cfg = self.config
+        if cfg.ingest.enabled:
+            from ..ingest import TraceClock, configure_quarantine, pre_admit_frame
+
+            configure_quarantine(cfg.ingest, default_dir=out_dir)
+            # The batch twin of the stream engine's pre-windowing gate:
+            # unplaceable rows quarantine before the window loop ever
+            # slices, and trace-relative clock skew repairs against the
+            # first-seen registry — a displaced root span must not turn
+            # into a spurious anomaly in somebody else's window.
+            data, pre_rejected = pre_admit_frame(
+                data, cfg.ingest, source="run",
+                trace_clock=TraceClock(),
+            )
+            if pre_rejected:
+                self.log.warning(
+                    "abnormal dump: %d rows rejected before windowing "
+                    "(%s)",
+                    sum(pre_rejected.values()),
+                    ", ".join(
+                        f"{k}={v}"
+                        for k, v in sorted(pre_rejected.items())
+                    ),
+                )
         if sink is None and out_dir is not None:
             sink = ResultSink(out_dir, overwrite_csv=cfg.compat.overwrite_results)
         cursor = (
@@ -133,8 +171,38 @@ class OnlineRCA:
             result = WindowResult(start=str(w_start), end=str(w_end), anomaly=False)
 
             window_df = window_spans(data, w_start, w_end)
+            if len(window_df) > 0 and cfg.ingest.enabled:
+                # Per-window admission ladder (the shared ingest seam):
+                # the clean subset detects/ranks, rejected rows are in
+                # the dead-letter store, and a window mostly made of
+                # garbage is refused whole (low_admission).
+                from ..ingest import admit_frame
+
+                with timings.stage("admit"):
+                    adm = admit_frame(
+                        window_df, cfg.ingest, source="run",
+                        window_bounds=(w_start, w_end),
+                        known_ops=(
+                            frozenset(self.slo_vocab.names)
+                            if self.slo_vocab is not None
+                            else None
+                        ),
+                    )
+                window_df = adm.frame
+                result.ingest_rejected = adm.n_rejected
+                result.degraded_input = adm.degraded
+                if adm.degraded and journal is not None:
+                    journal.emit(
+                        "ingest", stage="window",
+                        window_start=str(w_start),
+                        **adm.journal_fields(),
+                    )
+                if adm.admission_ratio < cfg.ingest.min_admission_ratio:
+                    result.skipped_reason = "low_admission"
+                    window_df = window_df.iloc[:0]
             if len(window_df) == 0:
-                result.skipped_reason = "empty_window"
+                if result.skipped_reason is None:
+                    result.skipped_reason = "empty_window"
             else:
                 with timings.stage("detect"):
                     flag, nrm, abn = self.detect_window(window_df)

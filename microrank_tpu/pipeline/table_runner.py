@@ -100,6 +100,15 @@ class TableRCA:
         from ..detect.detector import _thresholds
         from ..scenarios.policy import apply_tuned_policy
 
+        if self.config.ingest.enabled:
+            # Value-level admission on the interned table (the native
+            # twin of the pandas ladder): a poisoned normal dump must
+            # not poison the SLO floor.
+            from ..ingest import admit_table
+
+            normal_table, _ = admit_table(
+                normal_table, self.config.ingest, source="table:normal"
+            )
         self.slo_vocab, self.baseline = compute_slo_from_table(
             normal_table, stat=self.config.detector.slo_stat
         )
@@ -428,6 +437,16 @@ class TableRCA:
         # async stage/fetch executors are authorized delegates (their
         # single-width PJRT calls are ordered by construction).
         claim_device_owner("table-lane")
+        if cfg.ingest.enabled:
+            # Admission on the interned table (values + budgets; the
+            # native loader already settled parse/linkage): rejected
+            # rows land in the dead-letter store next to the results.
+            from ..ingest import admit_table, configure_quarantine
+
+            configure_quarantine(cfg.ingest, default_dir=out_dir)
+            table, _rej = admit_table(
+                table, cfg.ingest, source="table"
+            )
         if sink is None and out_dir is not None:
             sink = ResultSink(
                 out_dir, overwrite_csv=cfg.compat.overwrite_results

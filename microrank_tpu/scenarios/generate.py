@@ -35,14 +35,28 @@ class ScenarioWorkload:
         return len(self.timeline)
 
     def window_frame(self, i: int) -> pd.DataFrame:
-        """Window i's spans, by the pipeline's own window predicate."""
-        from ..io.loader import window_spans
+        """Window i's spans, by the pipeline's own window predicate.
 
+        Hostile timelines carry rows whose timestamps will not coerce;
+        window placement is undefined for those, so the predicate runs
+        on the COERCED key — NaT rows fall out here exactly as they do
+        at the stream engine's pre-windowing admission gate (the batch
+        lane counts them once up front, see harness.run_scenario)."""
         w0 = self.start + pd.Timedelta(
             minutes=i * self.spec.window_minutes
         )
         w1 = w0 + pd.Timedelta(minutes=self.spec.window_minutes)
-        return window_spans(self.timeline, w0, w1)
+        df = self.timeline
+        start = df["startTime"]
+        end = df["endTime"]
+        if not pd.api.types.is_datetime64_any_dtype(start):
+            start = pd.to_datetime(
+                start, format="mixed", errors="coerce"
+            )
+        if not pd.api.types.is_datetime64_any_dtype(end):
+            end = pd.to_datetime(end, format="mixed", errors="coerce")
+        mask = (start >= w0) & (end <= w1)
+        return df[mask.fillna(False)]
 
 
 def generate_scenario(spec: ScenarioSpec) -> ScenarioWorkload:
@@ -53,10 +67,25 @@ def generate_scenario(spec: ScenarioSpec) -> ScenarioWorkload:
         spec.synth_config(), spec.n_windows, list(spec.faulted)
     )
     truth = list(tl.fault_pod_ops) if spec.faulted else []
+    timeline = tl.timeline
+    if getattr(spec, "hostile_classes", ()):
+        # The hostile family: corrupt the compiled timeline (NOT the
+        # normal baseline window) with the spec's class mix — the
+        # corruption is a pure function of the spec seed, so the
+        # workload digest stays a determinism witness.
+        from ..ingest.hostile import corrupt_timeline
+
+        timeline = corrupt_timeline(
+            timeline,
+            spec.hostile_classes,
+            seed=spec.seed,
+            fraction=spec.hostile_fraction,
+            bomb_ops=spec.hostile_bomb_ops,
+        )
     return ScenarioWorkload(
         spec=spec,
         normal=tl.normal,
-        timeline=tl.timeline,
+        timeline=timeline,
         window_faulted=tl.window_faulted,
         start=tl.start,
         truth=truth,

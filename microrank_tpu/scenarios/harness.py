@@ -245,10 +245,38 @@ def run_scenario(
     detection = {"tp": 0, "fp": 0, "fn": 0, "tn": 0}
     per_method: Dict[str, List[dict]] = {m: [] for m in METHODS}
     attribution = None
+    ingest_rejected = 0
+    if cfg.ingest.enabled and getattr(spec, "hostile_classes", ()):
+        # Hostile family, batch lane: run the SAME pre-windowing gate
+        # the stream engine runs — rows without a placeable event time
+        # reject (and are counted here, since no window frame would
+        # ever see them), and trace-relative clock skew repairs
+        # against the first-seen registry BEFORE window slicing, so a
+        # displaced root span cannot turn into a spurious anomaly in
+        # somebody else's window.
+        from ..ingest import TraceClock, pre_admit_frame
+
+        repaired, rej = pre_admit_frame(
+            wl.timeline, cfg.ingest, source=f"scenario:{spec.name}",
+            trace_clock=TraceClock(),
+        )
+        ingest_rejected += sum(rej.values())
+        wl.timeline = repaired
     first_ranked = None  # (frame, nrm, abn) of the first faulted rank
     for i in range(spec.n_windows):
         frame = wl.window_frame(i)
         truth_window = wl.window_faulted[i]
+        if len(frame) > 0 and cfg.ingest.enabled:
+            # The shared admission seam: the clean subset detects and
+            # ranks; the scenario record carries the rejection total.
+            from ..ingest import admit_frame
+
+            adm = admit_frame(
+                frame, cfg.ingest, source=f"scenario:{spec.name}",
+                known_ops=frozenset(vocab.names),
+            )
+            frame = adm.frame
+            ingest_rejected += adm.n_rejected
         if len(frame) == 0:
             detection["fn" if truth_window else "tn"] += 1
             continue
@@ -349,6 +377,7 @@ def run_scenario(
         "detection": detection,
         "formulas": formulas,
         "attribution": attribution,
+        "ingest_rejected": int(ingest_rejected),
         "elapsed_s": round(time.monotonic() - t0, 2),
     }
     if stream_lane:
@@ -388,6 +417,12 @@ def time_policy_candidates(
         if not wl.window_faulted[i]:
             continue
         frame = wl.window_frame(i)
+        if len(frame) > 0 and config.ingest.enabled:
+            from ..ingest import admit_frame
+
+            frame = admit_frame(
+                frame, config.ingest, source=f"tune:{spec.name}"
+            ).frame
         if len(frame) == 0:
             continue
         flag, nrm, abn = detect_partition(config, vocab, slo, frame)

@@ -21,6 +21,13 @@ Families (``FAMILIES``):
   engine's online baseline is still warming up (no --normal seed).
 * ``drift``      — no fault: a gradual SLO shift the baseline must
   absorb (retrain) without opening an incident.
+* ``hostile``    — a latency fault UNDER DIRTY DATA: the compiled
+  timeline is corrupted with the ``hostile_classes`` mix
+  (ingest.hostile — unparseable rows, duplicate spans, orphans, clock
+  skew, a cardinality bomb); the admission ladder must contain the
+  corruption and the fault window must still rank the true culprit on
+  the clean subset. This is the family the policy engine scores
+  formulas under dirty data with.
 """
 
 from __future__ import annotations
@@ -31,6 +38,7 @@ from typing import List, Optional, Tuple
 
 FAMILIES = (
     "latency", "error", "multi", "cascade", "cold_start", "drift",
+    "hostile",
 )
 
 
@@ -64,6 +72,13 @@ class ScenarioSpec:
     cascade_fraction: float = 0.0
     error_duration_factor: float = 0.25
     drift_per_window: float = 0.0
+    # Hostile family: corruption classes applied to the compiled
+    # timeline (ingest.hostile.CORRUPTION_KINDS subset; the normal
+    # baseline window stays clean), the corrupted row fraction per
+    # class, and the cardinality bomb's unique-op count.
+    hostile_classes: Tuple[str, ...] = ()
+    hostile_fraction: float = 0.05
+    hostile_bomb_ops: int = 64
     # Stream-lane shape: seed the online baseline from the generator's
     # normal window (False = the cold-start family — the engine warms
     # up from the live stream while the fault may already be burning).
@@ -134,6 +149,13 @@ def default_matrix(seed: int = 0, full: bool = False) -> List[ScenarioSpec]:
             name="drift-slo-shift", family="drift", seed=s(6),
             faulted=(), drift_per_window=0.05,
         ),
+        ScenarioSpec(
+            name="hostile-mixed", family="hostile", seed=s(13),
+            hostile_classes=(
+                "corrupt_row", "dup_span", "orphan", "clock_skew",
+                "cardinality_bomb",
+            ),
+        ),
     ]
     if full:
         specs += [
@@ -160,6 +182,15 @@ def default_matrix(seed: int = 0, full: bool = False) -> List[ScenarioSpec]:
             ScenarioSpec(
                 name="drift-fast", family="drift", seed=s(12),
                 faulted=(), drift_per_window=0.10,
+            ),
+            ScenarioSpec(
+                name="hostile-heavy", family="hostile", seed=s(14),
+                hostile_classes=(
+                    "corrupt_row", "dup_span", "orphan", "clock_skew",
+                    "cardinality_bomb",
+                ),
+                hostile_fraction=0.15, hostile_bomb_ops=128,
+                n_operations=30,
             ),
         ]
     return specs

@@ -47,6 +47,27 @@ class ProtocolError(ValueError):
     status = 400
 
 
+class AdmissionError(ProtocolError):
+    """The request parsed, but span admission (ingest/) rejected every
+    row — an unsalvageable payload maps to HTTP 422 with the
+    per-reason rejection counts in the body, so the caller learns WHY
+    (bad timestamps vs duplicate ids vs a blown budget) instead of a
+    blanket 400. Salvageable payloads never raise: they rank
+    degraded-but-correct on the clean subset."""
+
+    status = 422
+
+    def __init__(self, rejected: dict):
+        self.rejected = dict(rejected)
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(self.rejected.items())
+        )
+        super().__init__(
+            f"no span rows survived admission ({detail}); see the "
+            "dead-letter store (quarantine.jsonl) for the rows"
+        )
+
+
 class DeadlineExceeded(RuntimeError):
     """The request's ``deadline_ms`` elapsed before its window staged —
     the service expires it (504) instead of dispatching device work
@@ -157,11 +178,16 @@ def spans_to_frame(spans: List[dict]):
         validate_columns(df.columns)
     except ValueError as e:
         raise ProtocolError(str(e)) from None
-    try:
-        df["startTime"] = pd.to_datetime(df["startTime"], format="mixed")
-        df["endTime"] = pd.to_datetime(df["endTime"], format="mixed")
-    except (ValueError, TypeError) as e:
-        raise ProtocolError(f"unparseable span timestamps: {e}") from None
+    # Timestamps coerce rather than raise: one malformed row must not
+    # abort the request — the admission ladder (serve.server) routes
+    # NaT rows to the dead-letter store and ranks the clean subset
+    # (422 via AdmissionError only when NOTHING survives).
+    df["startTime"] = pd.to_datetime(
+        df["startTime"], format="mixed", errors="coerce"
+    )
+    df["endTime"] = pd.to_datetime(
+        df["endTime"], format="mixed", errors="coerce"
+    )
     return df
 
 

@@ -156,6 +156,14 @@ class ServeService:
         configure_sanitizers(self.config)  # mrsan arm/disarm + reset
         configure_chaos(self.config)       # fault plan arm/disarm
         set_chaos_journal(self.journal)    # fault_injected -> journal
+        from ..ingest import configure_quarantine
+
+        # Dead-letter store next to the service outputs: rows span
+        # admission refuses (hostile payload fragments) land in
+        # quarantine.jsonl; unsalvageable payloads answer 422.
+        configure_quarantine(
+            self.config.ingest, default_dir=self.out_dir
+        )
         # Warmup dispatches run on THIS thread before the scheduler
         # exists; the scheduler thread re-claims when it starts.
         claim_device_owner("serve-warmup")
@@ -327,6 +335,43 @@ class ServeService:
                 window_df = self._window_frame(request)
             parse_s = time.monotonic() - t0
             result.timings["parse_ms"] = round(parse_s * 1e3, 3)
+            if self.config.ingest.enabled:
+                # Span admission: the full per-row ladder (the request
+                # IS the window). Unsalvageable payloads 422 with the
+                # per-reason counts; salvageable ones rank degraded-
+                # but-correct on the clean subset.
+                from ..ingest import admit_frame
+
+                t_adm = time.monotonic()
+                with tracer.span("admit", service="serve"):
+                    adm = admit_frame(
+                        window_df,
+                        self.config.ingest,
+                        source=f"serve:{request.request_id}",
+                        known_ops=(
+                            frozenset(self.slo_vocab.names)
+                            if self.slo_vocab is not None
+                            else None
+                        ),
+                    )
+                result.timings["admit_ms"] = round(
+                    (time.monotonic() - t_adm) * 1e3, 3
+                )
+                result.ingest_rejected = adm.n_rejected
+                result.degraded_input = adm.degraded
+                if adm.degraded and self.journal is not None:
+                    self.journal.emit(
+                        "ingest",
+                        stage="serve",
+                        request_id=request.request_id,
+                        tenant=request.tenant,
+                        **adm.journal_fields(),
+                    )
+                if adm.n_admitted == 0:
+                    from .protocol import AdmissionError
+
+                    raise AdmissionError(adm.rejected)
+                window_df = adm.frame
             result.start = str(window_df["startTime"].min())
             result.end = str(window_df["endTime"].max())
             t_det = time.monotonic()
@@ -592,7 +637,17 @@ class HttpFrontend:
                 ),
             )
         except ProtocolError as e:
-            return 400, "application/json", error_body(str(e))
+            # AdmissionError (status 422) carries the per-reason
+            # rejection counts so the caller learns what was hostile.
+            extra = {"request_id": request.request_id}
+            rejected = getattr(e, "rejected", None)
+            if rejected:
+                extra["rejected"] = rejected
+            return (
+                getattr(e, "status", 400),
+                "application/json",
+                error_body(str(e), **extra),
+            )
         except Exception as e:
             from .protocol import DeadlineExceeded
 
@@ -623,8 +678,9 @@ class HttpFrontend:
     ) -> None:
         reason = {
             200: "OK", 400: "Bad Request", 404: "Not Found",
-            429: "Too Many Requests", 500: "Internal Server Error",
-            503: "Service Unavailable", 504: "Gateway Timeout",
+            422: "Unprocessable Entity", 429: "Too Many Requests",
+            500: "Internal Server Error", 503: "Service Unavailable",
+            504: "Gateway Timeout",
         }.get(status, "OK")
         head = [
             f"HTTP/1.1 {status} {reason}",

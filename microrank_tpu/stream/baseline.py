@@ -255,6 +255,14 @@ class OnlineBaseline:
     def thaw(self) -> None:
         self.frozen = False
 
+    def known_ops(self) -> frozenset:
+        """The operations this baseline has SLO state for — the
+        reference set of the admission ladder's vocab-growth guard
+        (ingest.admit_frame known_ops): ops outside it are never-seen,
+        and a window introducing a burst of them is a cardinality
+        attack, not a deployment."""
+        return frozenset(self._ops)
+
     # ------------------------------------------------------------ intake
     def _grouped_ms(self, span_df: pd.DataFrame):
         names = operation_names(span_df, "service")

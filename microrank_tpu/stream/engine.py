@@ -212,6 +212,11 @@ class StreamEngine:
         # warm) and never checkpointed — a restart simply cold-starts
         # its first window, which is exactly crash-only semantics.
         self._warm_state = None
+        # Trace-relative clock-skew registry (ingest.TraceClock),
+        # lazily built on the first pre-admitted batch. Never
+        # checkpointed: a restart re-learns first-seen times from the
+        # resumed stream (worst case, one window of unclamped skew).
+        self._trace_clock = None
         self._cache_dir = None
         self._cache_probe = None
         self.summary = StreamSummary()
@@ -389,6 +394,7 @@ class StreamEngine:
     def run(self) -> StreamSummary:
         from ..analysis.mrsan import configure_sanitizers
         from ..chaos import configure_chaos, set_chaos_journal
+        from ..ingest import configure_quarantine
         from ..obs import configure_tracer
         from ..obs.metrics import ensure_catalog
         from ..utils.guards import claim_device_owner
@@ -398,6 +404,11 @@ class StreamEngine:
         configure_sanitizers(self.config)  # mrsan arm/disarm + reset
         configure_chaos(self.config)       # fault plan arm/disarm
         set_chaos_journal(self.journal)    # fault_injected -> journal
+        # Dead-letter store next to the run outputs: every span row
+        # admission refuses lands in quarantine.jsonl with a reason.
+        configure_quarantine(
+            self.config.ingest, default_dir=self.out_dir
+        )
         # The engine thread is the sole jax toucher on the stream path
         # (program-order rule); builds go to the pool, sinks stay host.
         claim_device_owner("stream-engine")
@@ -427,6 +438,12 @@ class StreamEngine:
                 if self._stop_requested:
                     done = True
                     break
+                # Pre-windowing admission gate: rows whose event time
+                # cannot exist (uncoercible timestamps, missing ids,
+                # garbage durations, hopeless clock skew) quarantine
+                # HERE — window assignment is undefined for them, so
+                # they must never reach the windower.
+                batch = self._pre_admit(batch)
                 for w in self.windower.add(batch):
                     self._process(w)
                     if self._max_reached() or self._stop_requested:
@@ -541,6 +558,30 @@ class StreamEngine:
             )
 
     # -------------------------------------------------------- per window
+    def _pre_admit(self, batch):
+        """Source-boundary admission (ingest.pre_admit_frame): reject
+        rows the windower cannot even place, coerce the survivors'
+        dtypes, and repair trace-relative clock skew against the
+        bounded first-seen registry. The window-relative ladder runs
+        in :meth:`_process` on the closed window."""
+        if batch is None or len(batch) == 0:
+            return batch
+        if not self.config.ingest.enabled:
+            return batch
+        from ..ingest import TraceClock, pre_admit_frame
+
+        if self._trace_clock is None:
+            self._trace_clock = TraceClock()
+        clean, rejected = pre_admit_frame(
+            batch, self.config.ingest, source="stream",
+            trace_clock=self._trace_clock,
+        )
+        if rejected and self.journal is not None:
+            self.journal.emit(
+                "ingest", stage="source", rejected=rejected
+            )
+        return clean
+
     def _process(self, closed: ClosedWindow) -> None:
         from ..obs.spans import get_tracer
 
@@ -560,11 +601,68 @@ class StreamEngine:
             result.skipped_reason = "empty_window"
             self._finalize(result, "empty", trace=trace)
             return
+        # Window-relative admission ladder: duplicates, orphans,
+        # clock-skew normalization and the resource budgets, on the
+        # CLOSED window (the pre-windowing gate already rejected rows
+        # without a placeable event time). A window mostly made of
+        # garbage is refused WHOLE (low_admission): it must neither
+        # retrain the baseline nor advance the incident lifecycle.
+        frame = closed.frame
+        if self.config.ingest.enabled:
+            from ..ingest import admit_frame
+
+            timings0 = StageTimings(ctx=trace.ctx)
+            with timings0.stage("admit"):
+                adm = admit_frame(
+                    frame,
+                    self.config.ingest,
+                    source="stream",
+                    window_bounds=(closed.start, closed.end),
+                    # Vocab-growth guard reference: what the online
+                    # baseline already knows (armed once detection is).
+                    known_ops=(
+                        self.baseline.known_ops()
+                        if self.baseline.ready
+                        else None
+                    ),
+                )
+            frame = adm.frame
+            result.ingest_rejected = adm.n_rejected
+            result.degraded_input = adm.degraded
+            result.timings.update(timings0.as_dict())
+            if adm.degraded and self.journal is not None:
+                self.journal.emit(
+                    "ingest",
+                    stage="window",
+                    window_start=result.start,
+                    **adm.journal_fields(),
+                )
+            if (
+                adm.admission_ratio
+                < self.config.ingest.min_admission_ratio
+            ):
+                self._drain_all()
+                self.log.warning(
+                    "window %s: admission ratio %.2f below %.2f — "
+                    "refusing the window whole (baseline and "
+                    "incident lifecycle untouched)",
+                    result.start, adm.admission_ratio,
+                    self.config.ingest.min_admission_ratio,
+                )
+                result.skipped_reason = "low_admission"
+                self._finalize(result, "skipped", trace=trace)
+                return
+            if len(frame) == 0:
+                self._drain_all()
+                result.skipped_reason = "empty_window"
+                self._finalize(result, "empty", trace=trace)
+                return
         if not self.baseline.ready:
-            # Cold start: feed the baseline, don't detect yet.
+            # Cold start: feed the baseline, don't detect yet. The
+            # CLEAN subset feeds it — quarantined rows never train.
             self._drain_all()
-            self.baseline.update(closed.frame)
-            result.n_traces = int(closed.frame["traceID"].nunique())
+            self.baseline.update(frame)
+            result.n_traces = int(frame["traceID"].nunique())
             result.skipped_reason = "baseline_warmup"
             self._finalize(result, "warmup", trace=trace)
             return
@@ -574,16 +672,16 @@ class StreamEngine:
         with timings.stage("detect"):
             vocab, slo = self.baseline.snapshot()
             flag, nrm, abn = detect_partition(
-                self.config, vocab, slo, closed.frame
+                self.config, vocab, slo, frame
             )
-        result.timings = timings.as_dict()
+        result.timings.update(timings.as_dict())
         result.anomaly = bool(flag)
         result.n_normal, result.n_abnormal = len(nrm), len(abn)
         result.n_traces = len(nrm) + len(abn)
         if not flag:
             self._drain_all()
             self._finalize(
-                result, "clean", frame=closed.frame, trace=trace
+                result, "clean", frame=frame, trace=trace
             )
             return
         if not nrm or not abn:
@@ -594,12 +692,13 @@ class StreamEngine:
         # Gate open: host build on the pool; rank on THIS thread when it
         # lands — consecutive abnormal windows overlap build(N+1) with
         # rank(N). Healthy windows drained the pipe above, so lifecycle
-        # observation order == window order.
+        # observation order == window order. The CLEAN subset builds —
+        # quarantined rows never stage (degraded-but-correct ranking).
         # attach: the pool captures the submitter's ambient context, so
         # the off-thread build parent-links to THIS window's trace.
         with tracer.attach(trace.ctx):
             fut = self.pool.submit(
-                self._prepare, closed.frame, nrm, abn
+                self._prepare, frame, nrm, abn
             )
         self._pending.append(_PendingRank(closed, result, fut, trace))
         while len(self._pending) >= max(
@@ -1096,6 +1195,12 @@ class StreamEngine:
                     self._last_bundle.write(dump_dir)
                     self._link_bundle(dump_dir)
             self._last_bundle = None
+        elif outcome != "warmup" and result.skipped_reason == "low_admission":
+            # A window refused whole by admission is EVIDENCE-FREE: it
+            # neither opens incidents (its garbage never ranked) nor
+            # counts as a healthy observation (it cannot resolve one) —
+            # a corruption burst is invisible to the lifecycle.
+            pass
         elif outcome != "warmup":
             with tracer.span("incident", service="stream", ctx=ctx):
                 resolved = self.tracker.observe_healthy(result.start)

@@ -31,6 +31,7 @@ import time
 from pathlib import Path
 from typing import Callable, Iterator, List, Optional, Sequence
 
+import numpy as np
 import pandas as pd
 
 from ..utils.logging import get_logger
@@ -38,14 +39,59 @@ from ..utils.logging import get_logger
 log = get_logger("microrank_tpu.stream.sources")
 
 
+def _sort_by_event_time(df: pd.DataFrame) -> pd.DataFrame:
+    """Stable event-time sort that survives hostile data: a corrupted
+    ``startTime`` column (object dtype with garbage strings mixed in)
+    sorts by the COERCED key — unparseable rows order first, flow to
+    the engine's pre-admission gate, and get quarantined there instead
+    of crashing the comparator here."""
+    col = df["startTime"]
+    if pd.api.types.is_datetime64_any_dtype(col):
+        return df.sort_values(
+            "startTime", kind="stable"
+        ).reset_index(drop=True)
+    key = pd.to_datetime(col, format="mixed", errors="coerce")
+    order = np.argsort(
+        key.values.astype("int64"), kind="stable"
+    )
+    return df.iloc[order].reset_index(drop=True)
+
+
 def _sorted_chunks(
     df: pd.DataFrame, chunk_spans: int
 ) -> List[pd.DataFrame]:
-    df = df.sort_values("startTime", kind="stable").reset_index(drop=True)
+    df = _sort_by_event_time(df)
     return [
         df.iloc[i : i + chunk_spans]
         for i in range(0, len(df), max(1, int(chunk_spans)))
     ]
+
+
+def _maybe_corrupt_chunk(chunk: pd.DataFrame) -> pd.DataFrame:
+    """The ``source_data`` chaos seam: when a fault spec fires with a
+    data-corruption kind (ingest.hostile.CORRUPTION_KINDS), the chunk
+    is deterministically corrupted — seeded by the plan seed and the
+    seam's event number, so the same plan over the same stream replays
+    the same dirty bytes. The admission ladder downstream is the
+    defense under test."""
+    from ..chaos.faults import get_fault_plan, maybe_inject
+    from ..ingest.hostile import CORRUPTION_KINDS, corrupt_frame
+
+    action = maybe_inject("source_data")
+    if action is None or action["kind"] not in CORRUPTION_KINDS:
+        return chunk
+    plan = get_fault_plan()
+    seed = (plan.seed if plan is not None else 0) * 7919 + int(
+        action.get("event", 0)
+    )
+    value = action.get("value") or 0.0
+    kwargs = {}
+    if value:
+        if action["kind"] == "cardinality_bomb":
+            kwargs["bomb_ops"] = int(value)
+        else:
+            kwargs["fraction"] = float(value)
+    return corrupt_frame(chunk, action["kind"], seed=seed, **kwargs)
 
 
 class ReplaySource:
@@ -96,9 +142,7 @@ class ReplaySource:
     def __iter__(self) -> Iterator[pd.DataFrame]:
         from ..chaos.faults import maybe_inject
 
-        df = self._df.sort_values(
-            "startTime", kind="stable"
-        ).reset_index(drop=True)
+        df = _sort_by_event_time(self._df)
         if self._skip_rows:
             # Resume: rows before the cursor were already windowed (and
             # live on in the checkpointed windower buffers/emits).
@@ -115,17 +159,22 @@ class ReplaySource:
             # suspended here — the cursor must already cover the chunk
             # or a resume would re-feed spans the windower buffered.
             self.rows_emitted += len(chunk)
-            yield chunk
+            yield _maybe_corrupt_chunk(chunk)
             if i == len(chunks) - 1:
                 break
             maybe_inject("source_stall", sleep=self.sleep)
             if self.rate:
                 # Event-time faithful pacing: sleep the event-time gap
-                # to the next chunk, compressed by ``rate``.
-                gap_s = (
-                    chunks[i + 1]["startTime"].iloc[0]
-                    - chunk["startTime"].iloc[-1]
-                ).total_seconds()
+                # to the next chunk, compressed by ``rate``. Hostile
+                # data may leave garbage in the boundary cells; an
+                # uncomputable gap paces at the fixed fallback.
+                try:
+                    gap_s = (
+                        chunks[i + 1]["startTime"].iloc[0]
+                        - chunk["startTime"].iloc[-1]
+                    ).total_seconds()
+                except (TypeError, ValueError, AttributeError):
+                    gap_s = 0.0
                 delay = max(0.0, gap_s / float(self.rate))
             else:
                 delay = self.pace_seconds
@@ -205,12 +254,19 @@ class FileTailSource:
         idle_exit: int = 0,
         max_polls: int = 0,
         sleep: Callable[[float], None] = time.sleep,
+        parse_retry_max: int = 3,
     ):
         self.path = Path(path)
         self.poll_seconds = float(poll_seconds)
         self.idle_exit = int(idle_exit)
         self.max_polls = int(max_polls)
         self.sleep = sleep
+        # Dead-letter escalation: after this many consecutive failed
+        # parses of the SAME byte range, the slice re-parses line by
+        # line and the offending line(s) quarantine with their byte
+        # offsets instead of retrying forever (0 disables).
+        self.parse_retry_max = int(parse_retry_max)
+        self._parse_fails = 0
         self._tracker = None
         self._restore: Optional[dict] = None
 
@@ -246,6 +302,69 @@ class FileTailSource:
     def reset_cursor(self) -> None:
         """Drop a stashed resume cursor (whole-checkpoint rejection)."""
         self._restore = None
+
+    def _salvage(self, tracker, size: int) -> Optional[pd.DataFrame]:
+        """Per-line re-parse of a slice that exhausted its whole-slice
+        retries: each complete appended line parses alone (header
+        prepended); lines that still fail quarantine with the reason
+        ``unparseable_line`` and their ABSOLUTE byte offset, so an
+        operator can find them in the file. The cursor then advances
+        past the whole slice — the stream never retries a poison line
+        again. Returns the good rows (possibly empty), or None when
+        there was nothing to salvage (torn partial line: the normal
+        hold-and-retry semantics keep applying)."""
+        import io as _io
+
+        from ..ingest.quarantine import get_quarantine
+        from ..io import load_traces_csv
+        from ..obs.metrics import record_ingest_rejected
+
+        appended = tracker.read_appended(self.path, size)
+        if appended is None:
+            return None
+        payload, offset = appended
+        head_end = payload.find(b"\n")
+        if head_end < 0:
+            return None
+        header = payload[: head_end + 1]
+        body = payload[head_end + 1 :]
+        if not body:
+            return None
+        base = offset - len(body)
+        good: List[pd.DataFrame] = []
+        bad = []
+        pos = 0
+        for line in body.splitlines(keepends=True):
+            abs_off = base + pos
+            pos += len(line)
+            try:
+                df = load_traces_csv(_io.BytesIO(header + line))
+            except (ValueError, OSError):
+                bad.append((line, abs_off))
+                continue
+            if len(df):
+                good.append(df)
+        store = get_quarantine()
+        for line, abs_off in bad:
+            store.put_raw(
+                line,
+                "unparseable_line",
+                source=f"tail:{self.path}",
+                offset=abs_off,
+            )
+            record_ingest_rejected("unparseable_line")
+        if bad:
+            log.warning(
+                "tail %s: dead-lettered %d unparseable line(s) after "
+                "%d whole-slice retries; cursor advanced to byte %d",
+                self.path, len(bad), self._parse_fails, offset,
+            )
+        tracker.parsed(size, offset=offset)
+        return (
+            pd.concat(good, ignore_index=True)
+            if good
+            else pd.DataFrame()
+        )
 
     def _tracker_for_run(self):
         from ..pipeline.follow import TailTracker
@@ -329,12 +448,31 @@ class FileTailSource:
                 # cursor did not advance, so the slice re-feeds). The
                 # re-read is a retry in the unified accounting.
                 record_attempt("source_parse")
+                self._parse_fails += 1
+                if (
+                    self.parse_retry_max
+                    and self._parse_fails >= self.parse_retry_max
+                ):
+                    # The slice will never parse whole: re-parse it
+                    # line by line, dead-letter the poison line(s)
+                    # with their byte offsets, advance the cursor past
+                    # them and keep streaming the good rows.
+                    salvaged = self._salvage(tracker, size)
+                    if salvaged is not None:
+                        self._parse_fails = 0
+                        if len(salvaged):
+                            yield salvaged
+                        if self.max_polls and polls >= self.max_polls:
+                            return
+                        self.sleep(self.poll_seconds)
+                        continue
                 if tracker.parse_failed(exc) == "exit":
                     return
                 if self.max_polls and polls >= self.max_polls:
                     return
                 self.sleep(self.poll_seconds)
                 continue
+            self._parse_fails = 0
             tracker.parsed(size, offset=offset)
             if len(df):
                 yield df

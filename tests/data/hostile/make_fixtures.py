@@ -1,0 +1,66 @@
+"""Render the adversarial corpus fixtures (one CSV per corruption
+class + a mixed file) from the seeded synthetic generator + the
+seeded corruption functions (ingest.hostile) — run from the repo root:
+
+    python tests/data/hostile/make_fixtures.py
+
+The CSVs are checked in; this script exists so the fixtures are
+regenerable (and auditable) rather than hand-typed. Each file is a
+small abnormal window (one injected latency fault, truth in
+TRUTH.json) with exactly one corruption class applied; ``mixed.csv``
+stacks all five classes. ``clean.csv``/``normal.csv`` are the
+uncorrupted pair the admission idempotence property and the lane
+tests baseline against.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+HERE = Path(__file__).parent
+SEED = 20250804
+FRACTION = 0.08
+BOMB_OPS = 48
+
+
+def main() -> None:
+    from microrank_tpu.ingest.hostile import (
+        CORRUPTION_KINDS,
+        corrupt_frame,
+        corrupt_timeline,
+    )
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+
+    case = generate_case(
+        SyntheticConfig(n_operations=16, n_traces=60, seed=11)
+    )
+    case.normal.to_csv(HERE / "normal.csv", index=False)
+    case.abnormal.to_csv(HERE / "clean.csv", index=False)
+    for kind in CORRUPTION_KINDS:
+        corrupt_frame(
+            case.abnormal, kind, seed=SEED, fraction=FRACTION,
+            bomb_ops=BOMB_OPS,
+        ).to_csv(HERE / f"{kind}.csv", index=False)
+    corrupt_timeline(
+        case.abnormal, CORRUPTION_KINDS, seed=SEED,
+        fraction=FRACTION, bomb_ops=BOMB_OPS,
+    ).to_csv(HERE / "mixed.csv", index=False)
+    (HERE / "TRUTH.json").write_text(
+        json.dumps(
+            {
+                "fault_pod_op": case.fault_pod_op,
+                "fault_service_op": case.fault_service_op,
+                "seed": SEED,
+                "fraction": FRACTION,
+                "bomb_ops": BOMB_OPS,
+            },
+            indent=2,
+        )
+        + "\n"
+    )
+    print(f"fixtures written under {HERE}")
+
+
+if __name__ == "__main__":
+    main()
