@@ -733,6 +733,14 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
         # ``trace_overhead`` artifact field (acceptance: within 5%).
         obs=dataclasses.replace(cfg.obs, spans=False),
     )
+    # Compile witness (mrshape R13-R16's runtime mirror): armed with the
+    # statically predicted key space for this config, every dispatch
+    # seam reports its compile-key signature. The acceptance criterion
+    # is zero keys outside the prediction — the artifact records it.
+    from microrank_tpu.analysis import mrsan
+    from microrank_tpu.analysis.shapes import predict_key_space
+
+    mrsan.arm_witness(predict_key_space(cfg))
     rca = TableRCA(cfg)
     rca.fit_baseline(normal_table)
     host_start = _host_sentinel().sample()
@@ -842,11 +850,23 @@ def _run_replay(cfg, spans_per_window, n_ops, fault_ms, n_windows):
             f"{replay_s * 1e3 / len(ranked):.0f} ms/window)"
         )
 
+    witness = mrsan.witness_report()
+    mrsan.disarm_witness()
+    log(
+        f"compile witness: {witness['keys_total']} key(s) observed, "
+        f"{len(witness['unpredicted'])} outside the static prediction"
+    )
+    for esc in witness["unpredicted"]:
+        log(f"compile witness ESCAPE: {esc['reason']}")
+
     return {
         **journal_fields,
         **(
             {"trace_overhead": trace_overhead} if trace_overhead else {}
         ),
+        "replay_compile_keys": witness["keys_total"],
+        "replay_compile_keys_by_program": witness["programs"],
+        "replay_unpredicted_keys": len(witness["unpredicted"]),
         "replay_spans_per_sec": round(sps, 1),
         "replay_windows": len(ranked),
         "replay_ms": round(replay_s * 1e3, 1),

@@ -54,15 +54,41 @@ in SURVEY.md §5):
                    join(), or device dispatch/fetch seam reached while
                    a lock is statically held — a blocked lock is a
                    convoy (the PR-8 webhook-hang bug, generalized)
+  R13 recompile-bomb  interprocedural R3(d): no ⊤-provenance value (a
+                   raw host measurement of live data, through ANY
+                   chain of helper calls) reaching a static argument
+                   of a jit wrapper — the shape/dtype provenance
+                   lattice ⊥ < const < bucket < ⊤ (analysis.shapes)
+                   tracks the flow project-wide
+  R14 precision-ladder-break  no two distinct precision-ladder levels
+                   (f32 / bf16 / int8) meeting one fused jit boundary
+                   without an explicit cast at the call site — XLA
+                   would place the implicit upcast inside the fusion,
+                   so accumulation precision drifts between callers
+  R15 pad-bucket-escape  no array whose shape carries ⊤ provenance
+                   (measured, not pad_to-bucketed or graph-builder
+                   produced) reaching a dispatch seam — an unbucketed
+                   extent keys the compile cache per distinct window
+  R16 warmup-coverage  every statically enumerable compile key a
+                   production dispatch can form is covered by a warm*
+                   call path — an uncovered key pays its compile on
+                   the first live request the warmup existed to absorb
 
-R8-R12 are *static* claims about a concurrent system; their runtime
-twin is ``analysis.mrsan`` (armed by ``RuntimeConfig.sanitizers``):
-ownership asserted at every device seam, per-shard collective
-schedules recorded on the mesh and checked for uniformity, production
-locks tracked per-thread (utils.guards.TrackedLock) with an
-Eraser-style lockset checker on registered shared objects and a
-lock-order watchdog asserting the observed acquisition DAG. CI's
-mrsan-smoke and race-smoke jobs cross-validate the models.
+R8-R16 are *static* claims about a concurrent (R8-R12) or compiled
+(R13-R16) system; their runtime twin is ``analysis.mrsan`` (armed by
+``RuntimeConfig.sanitizers``): ownership asserted at every device
+seam, per-shard collective schedules recorded on the mesh and checked
+for uniformity, production locks tracked per-thread
+(utils.guards.TrackedLock) with an Eraser-style lockset checker on
+registered shared objects and a lock-order watchdog asserting the
+observed acquisition DAG, and the compile witness — every dispatch
+seam reports its (kernel, occupancy, leaf-shapes) compile signature,
+first-seen keys journal as ``jit_cache_miss`` events, and a key
+outside the statically predicted ``CompileKeySpace``
+(analysis.shapes.predict_key_space) is a sanitizer violation. The
+``witness`` CLI replays a finished run's journal against the
+prediction offline. CI's mrsan-smoke and race-smoke jobs
+cross-validate the models.
 
 Run it::
 

@@ -1,4 +1,5 @@
-"""``python -m microrank_tpu.cli lint`` — the mrlint command surface."""
+"""``python -m microrank_tpu.cli lint`` / ``witness`` — the mrlint
+and compile-witness command surfaces."""
 
 from __future__ import annotations
 
@@ -8,7 +9,7 @@ from typing import List
 def add_lint_parser(sub) -> None:
     p = sub.add_parser(
         "lint",
-        help="TPU-correctness static analysis (mrlint rules R1-R12)",
+        help="TPU-correctness static analysis (mrlint rules R0-R16)",
         description=(
             "AST lint of the repo's TPU invariants: host syncs inside "
             "jit graphs (R1), float64 drift on the bf16 ranking path "
@@ -20,10 +21,17 @@ def add_lint_parser(sub) -> None:
             "owner threads (R8), data-dependent collective schedules "
             "inside shard_map-traced code (R9), cross-thread shared "
             "state with no common lock (R10, Eraser-style locksets), "
-            "lock-acquisition-order cycles (R11), and blocking calls "
-            "under a held lock (R12). Suppress a finding in place "
-            "with `# mrlint: disable=RN(reason)` — the reason is "
-            "mandatory."
+            "lock-acquisition-order cycles (R11), blocking calls "
+            "under a held lock (R12), plus the interprocedural "
+            "shape/dtype-flow rules: live measurements reaching "
+            "static jit arguments (R13, recompile bomb), mixed "
+            "precision-ladder dtypes meeting a fused boundary uncast "
+            "(R14), measured shapes escaping the pad-bucket registry "
+            "into dispatch seams (R15), and statically enumerable "
+            "compile keys the warmup path never covers (R16). "
+            "Suppress a finding in place with `# mrlint: "
+            "disable=RN(reason)` — the reason is mandatory (bare "
+            "disables are R0)."
         ),
     )
     p.add_argument(
@@ -79,4 +87,109 @@ def cmd_lint(args) -> int:
         print(f"mrlint: {n} finding{'s' if n != 1 else ''}")
         return 1
     print("mrlint: clean")
+    return 0
+
+
+def add_witness_parser(sub) -> None:
+    p = sub.add_parser(
+        "witness",
+        help=(
+            "replay a run journal's jit_cache_miss events against the "
+            "static compile-key-space prediction (R13-R16's runtime "
+            "mirror)"
+        ),
+        description=(
+            "Offline half of the mrsan compile witness: read "
+            "journal.jsonl from a finished run, re-check every "
+            "jit_cache_miss event against the CompileKeySpace the "
+            "shape analysis predicts for the given pad policy, and "
+            "exit 1 if any observed compile key falls outside it. A "
+            "clean exit is the acceptance criterion that the static "
+            "model (analysis.shapes) covers what the run actually "
+            "compiled."
+        ),
+    )
+    p.add_argument(
+        "journal",
+        help="path to a run's journal.jsonl (or its directory)",
+    )
+    p.add_argument(
+        "--pad-policy",
+        default=None,
+        help=(
+            "pad policy to predict with (default: the run_start "
+            "event's recorded policy, else pow2q)"
+        ),
+    )
+    p.add_argument(
+        "--min-pad", type=int, default=8, help="pad floor (default 8)"
+    )
+    p.add_argument(
+        "--cache-dir",
+        default=None,
+        help=(
+            "compile-cache dir holding a warmup manifest; with "
+            "--pipeline, pins the predicted occupancy set to the "
+            "manifest's declarations"
+        ),
+    )
+    p.add_argument(
+        "--pipeline",
+        default=None,
+        help="manifest pipeline name (serve | stream | table)",
+    )
+    p.set_defaults(fn=cmd_witness)
+
+
+def cmd_witness(args) -> int:
+    from pathlib import Path
+
+    from ..obs.journal import JOURNAL_NAME, read_journal
+    from .shapes import CompileKeySpace
+
+    path = Path(args.journal)
+    if path.is_dir():
+        path = path / JOURNAL_NAME
+    if not path.exists():
+        print(f"witness: no journal at {path}")
+        return 2
+    events = read_journal(path)
+    policy = args.pad_policy
+    if policy is None:
+        for ev in events:
+            if ev.get("event") == "run_start" and ev.get("pad_policy"):
+                policy = str(ev["pad_policy"])
+                break
+    policy = policy or "pow2q"
+    occupancies = None
+    if args.cache_dir and args.pipeline:
+        from ..dispatch.cache import manifest_occupancies
+
+        occs = manifest_occupancies(args.cache_dir, args.pipeline)
+        occupancies = frozenset(occs) if occs else None
+    space = CompileKeySpace(
+        pad_policy=policy, min_pad=args.min_pad, occupancies=occupancies
+    )
+    misses = [e for e in events if e.get("event") == "jit_cache_miss"]
+    escapes = []
+    for ev in misses:
+        shapes = [tuple(s) for s in (ev.get("key") or [])]
+        reason = space.admits(
+            str(ev.get("program")),
+            ev.get("kernel"),
+            ev.get("occupancy"),
+            shapes,
+        )
+        if reason is not None:
+            escapes.append((ev, reason))
+    print(
+        f"witness: {len(misses)} compile key(s) observed "
+        f"(pad_policy={policy})"
+    )
+    for ev, reason in escapes:
+        print(f"  ESCAPE {ev.get('program')}: {reason}")
+    if escapes:
+        print(f"witness: {len(escapes)} key(s) outside the predicted space")
+        return 1
+    print("witness: all observed keys inside the predicted space")
     return 0

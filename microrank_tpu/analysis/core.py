@@ -73,6 +73,30 @@ class ModuleInfo:
     disables: Dict[int, Dict[str, str]] = field(default_factory=dict)
 
     @property
+    def stmt_starts(self) -> Dict[int, int]:
+        """line -> first physical line of the INNERMOST statement
+        spanning it. A pragma on a multi-line statement's first line
+        suppresses a violation reported on a continuation line (ast
+        anchors some nodes — a wrapped call's argument, a parenthesized
+        expression — lines below the statement head the pragma sits
+        on)."""
+        if not hasattr(self, "_stmt_starts"):
+            starts: Dict[int, int] = {}
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.stmt):
+                    continue
+                end = getattr(node, "end_lineno", None)
+                if end is None:
+                    continue
+                for ln in range(node.lineno, end + 1):
+                    # Innermost wins: the deepest statement containing
+                    # the line has the largest start line.
+                    if starts.get(ln, 0) < node.lineno:
+                        starts[ln] = node.lineno
+            self._stmt_starts = starts
+        return self._stmt_starts
+
+    @property
     def imports_jax(self) -> bool:
         return any(
             m == "jax" or m.startswith("jax.")
@@ -150,6 +174,7 @@ class Project:
         self._traced = None
         self._threads = None
         self._locks = None
+        self._shapes = None
 
     @property
     def traced(self):
@@ -170,6 +195,17 @@ class Project:
 
             self._threads = ThreadAnalysis(self)
         return self._threads
+
+    @property
+    def shapes(self):
+        """The interprocedural shape/dtype provenance analysis
+        (analysis.shapes.ShapeAnalysis), computed once per project on
+        top of the traced-call-graph."""
+        if self._shapes is None:
+            from .shapes import ShapeAnalysis
+
+            self._shapes = ShapeAnalysis(self)
+        return self._shapes
 
     @property
     def locks(self):
@@ -253,14 +289,24 @@ def _run(
         for rule in active:
             found.extend(rule.check(module, project))
         for v in found:
+            pragma_line = v.line
             pragma = module.disables.get(v.line, {})
+            if v.rule not in pragma:
+                # Multi-line statements: ast anchors some nodes on
+                # continuation lines; the pragma on the statement's
+                # FIRST physical line still governs the whole statement.
+                start = module.stmt_starts.get(v.line)
+                if start is not None and start < v.line:
+                    candidate = module.disables.get(start, {})
+                    if v.rule in candidate:
+                        pragma_line, pragma = start, candidate
             if v.rule in pragma:
                 if pragma[v.rule]:
                     continue  # justified suppression
                 out.append(
                     Violation(
                         path=v.path,
-                        line=v.line,
+                        line=pragma_line,
                         col=v.col,
                         rule="R0",
                         message=(

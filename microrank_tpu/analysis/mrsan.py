@@ -34,6 +34,16 @@ cross-check, armed by ``RuntimeConfig.sanitizers``:
   a DAG (``kind="lock-order"``, raised as ``LockOrderError``). Checks
   count into ``microrank_mrsan_lockset_checks_total{object}``.
 
+* **Compile witness** (R13-R16's runtime twin) — armed with the
+  statically predicted ``analysis.shapes.CompileKeySpace``, every
+  dispatch seam reports its (kernel, occupancy, leaf-shapes) compile
+  signature via ``observe_compile_key``; first-seen keys count into
+  ``microrank_jit_cache_misses_total{program}`` and journal as
+  ``jit_cache_miss`` events, and a key outside the predicted space is
+  ``microrank_mrsan_violations_total{kind="compile-witness"}`` — the
+  static shape lattice missed a flow, or a live measurement escaped
+  the pad-bucket registry.
+
 The CI contract (mrsan-smoke + race-smoke): the repo lints clean ⇔ a
 sanitized stream run observes zero violations; the injected-bug
 fixtures (a jax call from a webhook-sink thread; a shard-divergent
@@ -225,6 +235,123 @@ def verify_and_reset(log=None) -> List[str]:
     return violations
 
 
+# ------------------------------------------------------- compile witness
+#
+# R13-R16's runtime twin: mrlint's shape analysis claims the compile-key
+# space is finite and warm (static args enumerable, every extent a pad
+# bucket, warmup covering production keys). The witness validates the
+# claim where it actually bites — the jit cache. Each dispatch seam
+# reports its (kernel, occupancy, leaf shapes) signature; a first-seen
+# key is a cache miss (counted + journalled as ``jit_cache_miss``), and
+# a miss outside the statically predicted ``CompileKeySpace`` is a
+# sanitizer violation (kind="compile-witness"): either the static model
+# has a gap or a live measurement escaped the bucket registry at
+# runtime.
+
+_witness_space = None                     # CompileKeySpace | None = armed
+_witness_owner: Optional[str] = None      # "external" (bench/tests) | "config"
+_witness_keys: Dict[str, set] = {}        # program -> observed key set
+_witness_unpredicted: List[dict] = []
+
+
+def arm_witness(space, owner: str = "external") -> None:
+    """Arm the compile witness with a predicted key space
+    (``analysis.shapes.CompileKeySpace``); resets observed state.
+    ``owner`` records who armed it: ``configure_sanitizers`` (run
+    entries, owner="config") must not disarm a witness the bench or a
+    test armed explicitly around the run (owner="external")."""
+    global _witness_space, _witness_owner
+    with _lock:
+        _witness_space = space
+        _witness_owner = owner
+        _witness_keys.clear()
+        _witness_unpredicted.clear()
+
+
+def disarm_witness(owner: Optional[str] = None) -> None:
+    """Disarm; with ``owner`` given, only if that owner armed it."""
+    global _witness_space, _witness_owner
+    with _lock:
+        if owner is not None and _witness_owner != owner:
+            return
+        _witness_space = None
+        _witness_owner = None
+        _witness_keys.clear()
+        _witness_unpredicted.clear()
+
+
+def witness_armed() -> bool:
+    return _witness_space is not None
+
+
+def observe_compile_key(
+    program: str,
+    kernel: Optional[str] = None,
+    graph=None,
+    occupancy: Optional[int] = None,
+) -> None:
+    """One dispatch through a seam: dedupe its compile-key signature,
+    and on first sight count a cache miss + check the prediction.
+
+    The signature deliberately mirrors the jit cache key modulo config
+    (``dispatch.router.bucket_key``): kernel, batch occupancy, and the
+    *set* of leaf shapes — order and multiplicity don't change what
+    XLA compiles for the homogeneous window batches this repo stages.
+    """
+    if _witness_space is None:
+        return
+    shapes: tuple = ()
+    if graph is not None:
+        import jax
+        import numpy as np
+
+        shapes = tuple(sorted(set(
+            tuple(int(d) for d in np.asarray(leaf).shape)
+            for leaf in jax.tree.leaves(graph)
+        )))
+    key = (kernel, occupancy, shapes)
+    with _lock:
+        space = _witness_space
+        if space is None:
+            return
+        seen = _witness_keys.setdefault(program, set())
+        if key in seen:
+            return
+        seen.add(key)
+    reason = space.admits(program, kernel, occupancy, shapes)
+    from ..obs.metrics import record_jit_cache_miss, record_mrsan_violation
+
+    record_jit_cache_miss(
+        program,
+        kernel=kernel,
+        occupancy=occupancy,
+        key=[list(s) for s in shapes],
+        predicted=reason is None,
+    )
+    if reason is not None:
+        with _lock:
+            _witness_unpredicted.append({
+                "program": program,
+                "kernel": kernel,
+                "occupancy": occupancy,
+                "shapes": [list(s) for s in shapes],
+                "reason": reason,
+            })
+        record_mrsan_violation("compile-witness")
+
+
+def witness_report() -> Dict[str, object]:
+    """Observed-key summary: per-program first-seen key counts plus the
+    unpredicted escapes (empty ``unpredicted`` = the static key-space
+    model held for this run — the bench acceptance criterion)."""
+    with _lock:
+        return {
+            "programs": {p: len(k) for p, k in _witness_keys.items()},
+            "keys_total": sum(len(k) for k in _witness_keys.values()),
+            "unpredicted": [dict(u) for u in _witness_unpredicted],
+        }
+
+
 def configure_sanitizers(config) -> None:
     """The one wiring point, called next to ``configure_tracer`` at
     every run entry (TableRCA.run, StreamEngine.run, ServeService.
@@ -239,5 +366,13 @@ def configure_sanitizers(config) -> None:
     reset_schedule()
     if enabled:
         arm_collectives()
+        if _witness_owner != "external":
+            from .shapes import predict_key_space
+
+            arm_witness(predict_key_space(
+                config,
+                cache_dir=getattr(runtime, "compile_cache_dir", None),
+            ), owner="config")
     else:
         disarm_collectives()
+        disarm_witness(owner="config")

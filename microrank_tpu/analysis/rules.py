@@ -1,4 +1,4 @@
-"""The mrlint rule set (R1-R9). See analysis/__init__ for the catalog.
+"""The mrlint rule set (R1-R16). See analysis/__init__ for the catalog.
 
 Each rule is intentionally heuristic — it encodes THIS repo's TPU
 invariants, not general Python semantics — and every finding can be
@@ -665,6 +665,125 @@ class BlockingUnderLockRule(Rule):
     def check(self, module: ModuleInfo, project: Project):
         for ev in project.locks.events:
             if ev.kind == "blocking-under-lock" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class RecompileBombRule(Rule):
+    """R13: no ⊤-provenance value in a static argument of a jit wrapper.
+
+    The interprocedural upgrade of R3(d): the shape/dtype provenance
+    analysis (analysis.shapes) tracks every value on the finite lattice
+    ⊥ < const < bucket < ⊤ through the whole project call graph — a
+    host measurement of live data (``len()``/``int()`` of a span table,
+    a vocab size) that reaches a static argument of a known jit wrapper
+    *through any chain of helper calls* keys the compile cache on the
+    data itself: one recompile per distinct value, the recompile bomb.
+    Routing the measurement through the bucket registry
+    (``graph.structures.pad_to`` or any ``pad*/bucket*/pow2*/round*/
+    align*`` helper) lowers it to BUCKET — a finite key family — and
+    the rule stays silent. Runtime mirror: the mrsan compile witness
+    (analysis.mrsan) observes every dispatched compile key and fails
+    on any key outside the predicted bucket space.
+    """
+
+    name = "R13"
+    slug = "recompile-bomb"
+    summary = (
+        "⊤-provenance (raw live measurement) reaches a static jit "
+        "argument interprocedurally"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.shapes.events:
+            if ev.kind == "recompile-bomb" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class PrecisionLadderRule(Rule):
+    """R14: no mixed precision-ladder dtypes at one fused boundary.
+
+    The device path runs a three-level ladder — f32 / bf16 / scaled
+    int8 (PageRankConfig.kind_precision) — and a fused program fed two
+    different ladder levels without an explicit cast leaves the upcast
+    placement to XLA: it lands where the values meet inside the fusion,
+    not where the kernel contract says, so accumulation precision
+    drifts between call sites that should be bit-identical. The shape/
+    dtype analysis joins dtype sets along the same interprocedural flow
+    as R13; an argument expression that is itself an ``astype(...)`` /
+    ``asarray(dtype=...)`` cast is the sanctioned boundary cast and
+    exempts that argument.
+    """
+
+    name = "R14"
+    slug = "precision-ladder-break"
+    summary = (
+        "mixed dtype-ladder levels flow into one fused program "
+        "boundary without an explicit cast"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.shapes.events:
+            if ev.kind == "ladder-break" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class PadBucketEscapeRule(Rule):
+    """R15: arrays reaching DispatchRouter dispatch are bucket-shaped.
+
+    Every array entering a dispatch seam (``DispatchRouter.rank_batch``,
+    ``stage_rank_window``/``stage_rank_windows_batched``/
+    ``stage_windows_batched``/``stage_sharded``) keys the compile cache
+    with its shape. The window-graph builders (``build_window_graph*``/
+    ``prepare_window_graph``) draw every extent from the pad-bucket
+    registry by construction; an ad-hoc array shaped by a raw host
+    measurement (⊤ shape provenance) escapes the bucket family and
+    compiles one program per distinct window. Runtime mirror: the
+    compile witness checks every OBSERVED extent against
+    ``analysis.shapes.is_bucketed_extent``.
+    """
+
+    name = "R15"
+    slug = "pad-bucket-escape"
+    summary = (
+        "array whose shape is not drawn from the pad-bucket registry "
+        "reaches a dispatch seam"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.shapes.events:
+            if ev.kind == "bucket-escape" and ev.module is module:
+                yield _v(module, ev, self.name, ev.message)
+
+
+@register
+class WarmupCoverageRule(Rule):
+    """R16: production compile keys are warmed before they are served.
+
+    For each jit wrapper whose call sites carry statically enumerable
+    static-argument sets (const provenance with small value sets), the
+    keys dispatched from production sites must be a subset of the keys
+    dispatched from the warmup path (functions reachable from a
+    ``warm*`` root — dispatch/warmup.py's seam): a key served before it
+    is warmed pays the first-request compile the warmup manifest exists
+    to eliminate. Sites whose key sets are unenumerable (⊤ or widened
+    const) are out of static scope by design — the runtime compile
+    witness (analysis.mrsan) owns them, cross-checking every observed
+    key against the static prediction plus the warmup manifest.
+    """
+
+    name = "R16"
+    slug = "warmup-coverage"
+    summary = (
+        "statically enumerated compile keys dispatched in production "
+        "but absent from the warmup path"
+    )
+
+    def check(self, module: ModuleInfo, project: Project):
+        for ev in project.shapes.events:
+            if ev.kind == "warmup-gap" and ev.module is module:
                 yield _v(module, ev, self.name, ev.message)
 
 

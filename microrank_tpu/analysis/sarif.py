@@ -24,12 +24,16 @@ SARIF_SCHEMA = (
 
 
 def _rule_meta(rule) -> dict:
-    return {
+    meta = {
         "id": rule.name,
         "name": rule.slug,
         "shortDescription": {"text": rule.summary},
         "defaultConfiguration": {"level": "error"},
     }
+    doc = " ".join((type(rule).__doc__ or "").split())
+    if doc:
+        meta["fullDescription"] = {"text": doc}
+    return meta
 
 
 def to_sarif(violations: Iterable["Violation"]) -> dict:  # noqa: F821
@@ -69,8 +73,12 @@ def to_sarif(violations: Iterable["Violation"]) -> dict:  # noqa: F821
                             },
                             "region": {
                                 "startLine": max(1, v.line),
-                                # SARIF columns are 1-based; ast's are 0.
-                                "startColumn": v.col + 1,
+                                # SARIF columns are 1-based; ast's are
+                                # 0-based. Clamp: a synthetic violation
+                                # (framework R0, interprocedural events)
+                                # may carry col 0 or -1, and SARIF
+                                # consumers reject startColumn < 1.
+                                "startColumn": max(1, v.col + 1),
                             },
                         }
                     }

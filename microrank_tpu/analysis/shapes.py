@@ -1,0 +1,1020 @@
+"""mrshape — interprocedural shape/dtype/static-arg provenance analysis,
+and the compile-cache key-space model it predicts.
+
+The compile cache is keyed on (static args, argument shapes, dtypes).
+mrlint R3 catches *local* leaks of live measurements into that key; this
+module tracks provenance through the whole project call graph on a
+finite lattice, so the four rules built on it (R13-R16, analysis.rules)
+can make *global* claims:
+
+Provenance lattice (one abstract value per local/parameter/return)::
+
+    BOT  <  CONST  <  BUCKET  <  TOP
+
+* ``BOT`` — nothing known (unanalyzed input); never fires a rule.
+* ``CONST`` — a statically-determined constant. Carries the enumerable
+  value set when small; joining past ``WIDEN_LIMIT`` distinct values
+  widens to "constant, set unenumerable" (values=None) — still bounded,
+  still cache-safe, no longer enumerable for R16.
+* ``BUCKET`` — drawn from the pad-bucket registry
+  (``graph.structures.pad_to`` or a ``pad*/bucket*/pow2*/round*/
+  align*/next_*`` helper): a finite shape family by construction.
+* ``TOP`` — a raw host measurement of live data (``len()``/``int()``/
+  a measured extent): unbounded, one compile-cache entry per distinct
+  value. TOP reaching a static argument of a jit wrapper is R13; an
+  array whose shape is TOP reaching a dispatch seam is R15.
+
+Dtype lattice: the precision ladder is the powerset of
+``{"float32", "bfloat16", "int8"}`` ordered by inclusion (join =
+union). Two distinct ladder levels meeting at one fused program
+boundary without an explicit cast (``astype``/``asarray(dtype=...)``)
+is R14 — inside the program XLA inserts the upcast where it lands, not
+where the kernel contract says (arxiv 2009.10443's mixed-ladder drift).
+
+Propagation mirrors ``analysis.traced.TracedAnalysis``: a monotone
+fixpoint over module-level functions joins argument provenance into
+callee parameters and uses callee return summaries at call sites; it
+terminates because both lattices are finite and joins only go up.
+
+The runtime half (``CompileKeySpace``/``predict_key_space``) is the
+numeric model the mrsan compile-witness checker (analysis.mrsan)
+cross-checks observed compile keys against: every observed array extent
+must be a pad-bucket fixed point (or a batch-occupancy axis), every
+kernel a known kernel — an observed key outside the space is a
+sanitizer failure, the dynamic twin of R13/R15/R16.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+# ------------------------------------------------------------ the lattice
+
+BOT, CONST, BUCKET, TOP = 0, 1, 2, 3
+_LEVEL_NAMES = {BOT: "⊥", CONST: "const", BUCKET: "bucket", TOP: "⊤"}
+
+# Past this many enumerated constants the set widens to "unenumerable"
+# (values=None): still CONST (bounded), no longer usable by R16.
+WIDEN_LIMIT = 8
+
+# The precision ladder (PageRankConfig.kind_precision et al.).
+LADDER_DTYPES = ("float32", "bfloat16", "int8")
+
+
+@dataclass(frozen=True)
+class Prov:
+    """One provenance lattice element; ``values`` only at CONST level."""
+
+    level: int = BOT
+    values: Optional[FrozenSet] = None
+
+    def join(self, other: "Prov") -> "Prov":
+        level = max(self.level, other.level)
+        if level != CONST:
+            return Prov(level)
+        if self.values is None or other.values is None:
+            return Prov(CONST, None)
+        merged = self.values | other.values
+        if len(merged) > WIDEN_LIMIT:
+            return Prov(CONST, None)  # widen: bounded but unenumerable
+        return Prov(CONST, merged)
+
+    @property
+    def enumerable(self) -> bool:
+        return self.level == CONST and self.values is not None
+
+    def describe(self) -> str:
+        if self.enumerable:
+            vals = sorted(map(repr, self.values))
+            return f"const{{{', '.join(vals)}}}"
+        return _LEVEL_NAMES[self.level]
+
+
+P_BOT = Prov(BOT)
+P_TOP = Prov(TOP)
+P_BUCKET = Prov(BUCKET)
+
+
+def p_const(value) -> Prov:
+    try:
+        return Prov(CONST, frozenset([value]))
+    except TypeError:  # unhashable constant — bounded, unenumerable
+        return Prov(CONST, None)
+
+
+@dataclass(frozen=True)
+class AbsVal:
+    """Abstract value: for scalars ``prov`` is the VALUE's provenance;
+    for arrays it is the provenance of the array's SHAPE (what keys the
+    compile cache). ``dtypes`` holds the ladder levels flowing through;
+    ``cast`` marks an explicit boundary cast at this expression."""
+
+    prov: Prov = P_BOT
+    dtypes: FrozenSet[str] = frozenset()
+    is_array: bool = False
+    cast: bool = False
+
+    def join(self, other: "AbsVal") -> "AbsVal":
+        return AbsVal(
+            prov=self.prov.join(other.prov),
+            dtypes=self.dtypes | other.dtypes,
+            is_array=self.is_array or other.is_array,
+            cast=self.cast and other.cast,
+        )
+
+
+V_BOT = AbsVal()
+
+# ----------------------------------------------------- source recognition
+
+_MEASURES = {"len", "int", "float"}
+_BUCKET_HINTS = ("pad", "bucket", "pow2", "round", "align", "next_")
+_ARRAY_CTORS = {"zeros", "ones", "empty", "full", "arange"}
+# Project functions that return pad-bucketed window graphs: everything
+# they build is shaped through graph.structures.pad_to by construction.
+_GRAPH_BUILDERS = (
+    "build_window_graph",
+    "prepare_window_graph",
+    "stack_window_graphs",
+    "collapse_window_graph",
+    "synthetic_prepared",
+)
+# Device dispatch seams whose argument shapes key the compile cache
+# (R15): the router and the blob staging entry points.
+_DISPATCH_SEAMS = {
+    "rank_batch",
+    "stage_rank_window",
+    "stage_rank_windows_batched",
+    "stage_windows_batched",
+    "dispatch_windows_staged",
+    "stage_sharded",
+}
+# Functions whose call subtree is warmup (R16): the statically
+# enumerated keys dispatched from here are "declared warm".
+_WARM_MARKERS = ("warm",)
+
+
+def _is_bucket_name(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return low == "pad_to" or any(h in low for h in _BUCKET_HINTS)
+
+
+def _dtype_of_node(module, node) -> Optional[str]:
+    """A ladder-dtype *designator* expression (``jnp.bfloat16``,
+    ``"int8"``), or None."""
+    if isinstance(node, ast.Constant) and node.value in LADDER_DTYPES:
+        return node.value
+    if isinstance(node, ast.Attribute) and node.attr in LADDER_DTYPES:
+        return node.attr
+    dotted = module.dotted(node)
+    if dotted:
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail in LADDER_DTYPES:
+            return tail
+    return None
+
+
+# -------------------------------------------------------------- the walk
+
+
+@dataclass
+class WrapperSite:
+    """One call of a known jit wrapper, with per-argument analysis."""
+
+    wrapper: object               # traced.JitWrapper
+    call: ast.Call
+    module: object                # core.ModuleInfo
+    enclosing: Optional[object]   # traced.FuncDef of the calling function
+    static_provs: List[Tuple[int, str, Prov]] = field(default_factory=list)
+    arg_vals: List[AbsVal] = field(default_factory=list)
+    # Per-argument: the arg expression ITSELF is an explicit cast at
+    # this boundary (x.astype(d) / asarray(x, dtype=d) / jnp.f32(x)).
+    boundary_casts: List[bool] = field(default_factory=list)
+
+
+@dataclass
+class SeamSite:
+    """One call of a dispatch seam with the graph argument's value."""
+
+    seam: str
+    call: ast.Call
+    module: object
+    graph_val: AbsVal = V_BOT
+
+
+class _ShapeWalker:
+    """Forward abstract interpretation of one function body on the
+    Prov/dtype lattice. Mirrors traced._TaintWalker's statement set."""
+
+    def __init__(self, analysis: "ShapeAnalysis", fd, env: Dict[str, AbsVal]):
+        self.analysis = analysis
+        self.fd = fd
+        self.module = fd.module
+        self.env = dict(env)
+        self.ret: AbsVal = V_BOT
+        self.calls: List[Tuple[object, Dict[str, AbsVal]]] = []
+        self.wrapper_sites: List[WrapperSite] = []
+        self.seam_sites: List[SeamSite] = []
+
+    def run(self) -> None:
+        for stmt in self.fd.node.body:
+            self._stmt(stmt)
+
+    # ------------------------------------------------------------- eval
+
+    def eval(self, node) -> AbsVal:
+        if node is None:
+            return V_BOT
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (int, str, bool, float)):
+                return AbsVal(prov=p_const(node.value))
+            return V_BOT
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, V_BOT)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node)
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node)
+        if isinstance(node, ast.UnaryOp):
+            inner = self.eval(node.operand)
+            if (
+                isinstance(node.op, ast.USub)
+                and inner.prov.enumerable
+            ):
+                vals = frozenset(
+                    -v for v in inner.prov.values
+                    if isinstance(v, (int, float))
+                )
+                if vals:
+                    return AbsVal(prov=Prov(CONST, vals))
+            return inner
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = V_BOT
+            for e in node.elts:
+                out = out.join(self.eval(e))
+            return out
+        if isinstance(node, ast.IfExp):
+            return self.eval(node.body).join(self.eval(node.orelse))
+        if isinstance(node, ast.BoolOp):
+            out = V_BOT
+            for v in node.values:
+                out = out.join(self.eval(v))
+            return out
+        if isinstance(node, ast.Compare):
+            return V_BOT  # booleans don't shape compile keys
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        return V_BOT
+
+    def _eval_attribute(self, node: ast.Attribute) -> AbsVal:
+        if node.attr == "shape":
+            # An array's .shape inherits the array's SHAPE provenance —
+            # a bucketed array's measured extent is still bucketed; an
+            # unknown array's stays unknown (BOT: never fires).
+            base = self.eval(node.value)
+            if base.is_array:
+                return AbsVal(prov=base.prov)
+            return V_BOT
+        base = self.eval(node.value)
+        if base.is_array:
+            # x.T / x.real / config-attr chains off arrays keep shape
+            # provenance; scalar attrs of arrays (.size) stay unknown.
+            if node.attr in ("T", "real", "imag"):
+                return base
+            return V_BOT
+        return V_BOT
+
+    def _eval_subscript(self, node: ast.Subscript) -> AbsVal:
+        base = self.eval(node.value)
+        if base.is_array:
+            return AbsVal(dtypes=base.dtypes, is_array=True)
+        if isinstance(node.value, ast.Attribute) and node.value.attr == "shape":
+            # x.shape[i]: provenance of the shape itself (see above).
+            return self.eval(node.value)
+        return V_BOT
+
+    def _call_name(self, node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Name):
+            return node.func.id
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        return None
+
+    def _eval_call(self, node: ast.Call) -> AbsVal:
+        name = self._call_name(node)
+        arg_vals = [self.eval(a) for a in node.args]
+        kw_vals = {k.arg: self.eval(k.value) for k in node.keywords if k.arg}
+        joined = V_BOT
+        for v in list(arg_vals) + list(kw_vals.values()):
+            joined = joined.join(v)
+
+        # Bucket registry: pad_to / pad* / pow2* helpers — output drawn
+        # from the finite bucket family regardless of the input.
+        if _is_bucket_name(name):
+            return AbsVal(prov=P_BUCKET)
+
+        # Graph builders: every array inside is pad_to-shaped.
+        if name and name.startswith(_GRAPH_BUILDERS):
+            return AbsVal(prov=P_BUCKET, is_array=True)
+
+        # Host measurement of live data: len()/int()/float() over
+        # anything not statically constant is TOP (the R3d semantics,
+        # now interprocedural).
+        if name in _MEASURES and node.args:
+            inner = arg_vals[0]
+            if inner.prov.level in (CONST,):
+                return AbsVal(prov=Prov(CONST, None))
+            if inner.prov.level == BUCKET:
+                return AbsVal(prov=P_BUCKET)  # int(pad_to(..)) stays bucketed
+            return AbsVal(prov=P_TOP)
+
+        # Explicit precision-ladder casts: x.astype(d) / asarray(x, dtype=d)
+        # / jnp.float32(x).
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+        ):
+            d = _dtype_of_node(self.module, node.args[0])
+            recv = self.eval(node.func.value)
+            return AbsVal(
+                prov=recv.prov,
+                dtypes=frozenset([d]) if d else recv.dtypes,
+                is_array=True,
+                cast=True,
+            )
+        dtype_kw = next(
+            (k.value for k in node.keywords if k.arg == "dtype"), None
+        )
+        kw_dtype = _dtype_of_node(self.module, dtype_kw)
+        direct = _dtype_of_node(self.module, node.func)
+        if direct and node.args:
+            return AbsVal(
+                prov=arg_vals[0].prov,
+                dtypes=frozenset([direct]),
+                is_array=arg_vals[0].is_array,
+                cast=True,
+            )
+
+        # Array constructors: shape provenance from the shape argument,
+        # dtype from the dtype kwarg.
+        if name in _ARRAY_CTORS:
+            shape_prov = arg_vals[0].prov if arg_vals else P_BOT
+            return AbsVal(
+                prov=shape_prov,
+                dtypes=frozenset([kw_dtype]) if kw_dtype else frozenset(),
+                is_array=True,
+                cast=bool(kw_dtype),
+            )
+        if name in ("asarray", "array") and node.args:
+            return AbsVal(
+                prov=arg_vals[0].prov,
+                dtypes=(
+                    frozenset([kw_dtype]) if kw_dtype else arg_vals[0].dtypes
+                ),
+                is_array=True,
+                cast=bool(kw_dtype),
+            )
+
+        # Project-internal call: record for the fixpoint, use the
+        # callee's return summary.
+        if isinstance(node.func, ast.Name):
+            target = self.analysis.traced.resolve(self.module, node.func.id)
+            if target is not None:
+                params = target.params
+                bind: Dict[str, AbsVal] = {}
+                for i, v in enumerate(arg_vals):
+                    if i < len(params) and not isinstance(
+                        node.args[i], ast.Starred
+                    ):
+                        bind[params[i]] = v
+                for k, v in kw_vals.items():
+                    if k in params:
+                        bind[k] = v
+                self.calls.append((target, bind))
+                return self.analysis.ret_summary(target)
+
+        # Method on an array keeps its dtype set; unknown call joins
+        # its operands (the monotone default — matches R3's recursion).
+        if isinstance(node.func, ast.Attribute):
+            recv = self.eval(node.func.value)
+            if recv.is_array:
+                return AbsVal(
+                    prov=recv.prov, dtypes=recv.dtypes, is_array=True
+                )
+        return AbsVal(prov=joined.prov, dtypes=joined.dtypes)
+
+    _ARITH = {
+        ast.Add: lambda a, b: a + b,
+        ast.Sub: lambda a, b: a - b,
+        ast.Mult: lambda a, b: a * b,
+        ast.FloorDiv: lambda a, b: a // b if b else None,
+        ast.Mod: lambda a, b: a % b if b else None,
+    }
+
+    def _eval_binop(self, node: ast.BinOp) -> AbsVal:
+        left, right = self.eval(node.left), self.eval(node.right)
+        if left.is_array != right.is_array and isinstance(
+            node.op, (ast.Mult, ast.Add)
+        ):
+            # ``[graph] * occ`` / list concat: replication changes the
+            # batch occupancy, not the element shapes — the array
+            # side's shape provenance carries.
+            return left if left.is_array else right
+        op = self._ARITH.get(type(node.op))
+        if (
+            op is not None
+            and left.prov.enumerable
+            and right.prov.enumerable
+        ):
+            vals = set()
+            for a, b in itertools.product(
+                left.prov.values, right.prov.values
+            ):
+                if isinstance(a, (int, float)) and isinstance(
+                    b, (int, float)
+                ):
+                    try:
+                        r = op(a, b)
+                    except (ZeroDivisionError, OverflowError):
+                        r = None
+                    if r is not None:
+                        vals.add(r)
+            if vals and len(vals) <= WIDEN_LIMIT:
+                return AbsVal(
+                    prov=Prov(CONST, frozenset(vals)),
+                    dtypes=left.dtypes | right.dtypes,
+                )
+        return left.join(right)
+
+    # ------------------------------------------------------- statements
+
+    def _assign(self, target, val: AbsVal) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                # Per-element values are lost in the join; keep it
+                # conservative (BOT never fires).
+                self._assign(e, AbsVal(dtypes=val.dtypes))
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, val)
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs analyzed only via direct calls
+        if isinstance(stmt, ast.Assign):
+            self._scan_calls(stmt.value)
+            val = self.eval(stmt.value)
+            for t in stmt.targets:
+                self._assign(t, val)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_calls(stmt.value)
+                self._assign(stmt.target, self.eval(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_calls(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                cur = self.env.get(stmt.target.id, V_BOT)
+                self.env[stmt.target.id] = cur.join(self.eval(stmt.value))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_calls(stmt.test)
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_calls(stmt.iter)
+            self._assign(stmt.target, self.eval(stmt.iter))
+            for s in stmt.body:
+                self._stmt(s)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_calls(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(
+                        item.optional_vars, self.eval(item.context_expr)
+                    )
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in stmt.orelse + stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_calls(stmt.value)
+                self.ret = self.ret.join(self.eval(stmt.value))
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_calls(stmt.value)
+            return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._scan_calls(node)
+
+    def _scan_calls(self, expr) -> None:
+        """Record project calls, jit-wrapper sites and dispatch-seam
+        sites inside one expression (evaluating args on the lattice)."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            # Side effect: _eval_call records project-call bindings.
+            self.eval(node)
+            self._note_wrapper_site(node)
+            self._note_seam_site(node)
+
+    def _note_wrapper_site(self, call: ast.Call) -> None:
+        if not isinstance(call.func, ast.Name):
+            return
+        w = self.analysis.wrapper_index.get(
+            (id(self.module), call.func.id)
+        )
+        if w is None:
+            return
+        params = w.target.params if w.target is not None else ()
+        site = WrapperSite(
+            wrapper=w, call=call, module=self.module, enclosing=self.fd
+        )
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            v = self.eval(arg)
+            site.arg_vals.append(v)
+            site.boundary_casts.append(self._is_boundary_cast(arg))
+            pname = params[i] if i < len(params) else f"arg{i}"
+            if i in w.static_argnums or (
+                i < len(params) and params[i] in w.static_argnames
+            ):
+                site.static_provs.append((i, pname, v.prov))
+        for k in call.keywords:
+            if k.arg and k.arg in w.static_argnames:
+                site.static_provs.append((-1, k.arg, self.eval(k.value).prov))
+        self.analysis.wrapper_sites.append(site)
+
+    def _is_boundary_cast(self, arg) -> bool:
+        if not isinstance(arg, ast.Call):
+            return False
+        if (
+            isinstance(arg.func, ast.Attribute)
+            and arg.func.attr == "astype"
+        ):
+            return True
+        if any(
+            k.arg == "dtype"
+            and _dtype_of_node(self.module, k.value) is not None
+            for k in arg.keywords
+        ):
+            return True
+        return _dtype_of_node(self.module, arg.func) is not None
+
+    def _note_seam_site(self, call: ast.Call) -> None:
+        name = self._call_name(call)
+        if name not in _DISPATCH_SEAMS or not call.args:
+            return
+        graph_val = self.eval(call.args[0])
+        if isinstance(call.args[0], (ast.List, ast.Tuple)):
+            gv = V_BOT
+            for e in call.args[0].elts:
+                gv = gv.join(self.eval(e))
+            graph_val = gv
+        self.analysis.seam_sites.append(
+            SeamSite(
+                seam=name,
+                call=call,
+                module=self.module,
+                graph_val=graph_val,
+            )
+        )
+
+
+# ----------------------------------------------------------- the analysis
+
+
+@dataclass
+class ShapeEvent:
+    # "recompile-bomb" (R13) | "ladder-break" (R14) |
+    # "bucket-escape" (R15) | "warmup-gap" (R16)
+    kind: str
+    module: object
+    line: int
+    col: int
+    message: str
+
+
+class ShapeAnalysis:
+    """Interprocedural shape/dtype provenance over one lint Project.
+
+    Built lazily via ``Project.shapes``; rules R13-R16 read ``events``.
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self.traced = project.traced
+        self.wrapper_index = {
+            (id(w.module), w.bound_name): w
+            for w in self.traced.wrappers
+            if w.bound_name
+        }
+        # id(FuncDef) -> {param: AbsVal} / return AbsVal summaries.
+        self.param_env: Dict[int, Dict[str, AbsVal]] = {}
+        self.ret_env: Dict[int, AbsVal] = {}
+        self._by_id: Dict[int, object] = {}
+        self.wrapper_sites: List[WrapperSite] = []
+        self.seam_sites: List[SeamSite] = []
+        self.events: List[ShapeEvent] = []
+        self._fixpoint()
+        self._emit_r13()
+        self._emit_r14()
+        self._emit_r15()
+        self._emit_r16()
+
+    # ------------------------------------------------------------ engine
+
+    def ret_summary(self, fd) -> AbsVal:
+        return self.ret_env.get(id(fd), V_BOT)
+
+    def _all_defs(self) -> List[object]:
+        return list(self.traced.defs.values())
+
+    def _fixpoint(self) -> None:
+        for fd in self._all_defs():
+            self._by_id[id(fd)] = fd
+            self.param_env.setdefault(id(fd), {})
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:  # belt over the monotone proof
+            changed = False
+            rounds += 1
+            self.wrapper_sites.clear()
+            self.seam_sites.clear()
+            for fd in self._all_defs():
+                walker = _ShapeWalker(self, fd, self.param_env[id(fd)])
+                walker.run()
+                if self._join_ret(fd, walker.ret):
+                    changed = True
+                for callee, bind in walker.calls:
+                    self._by_id.setdefault(id(callee), callee)
+                    env = self.param_env.setdefault(id(callee), {})
+                    for pname, val in bind.items():
+                        cur = env.get(pname, V_BOT)
+                        new = cur.join(val)
+                        if new != cur:
+                            env[pname] = new
+                            changed = True
+
+    def _join_ret(self, fd, ret: AbsVal) -> bool:
+        cur = self.ret_env.get(id(fd), V_BOT)
+        new = cur.join(ret)
+        if new != cur:
+            self.ret_env[id(fd)] = new
+            return True
+        return False
+
+    # -------------------------------------------------------- R13 events
+
+    def _emit_r13(self) -> None:
+        for site in self.wrapper_sites:
+            for pos, pname, prov in site.static_provs:
+                if prov.level != TOP:
+                    continue
+                wname = site.wrapper.bound_name or "<jit>"
+                self.events.append(
+                    ShapeEvent(
+                        kind="recompile-bomb",
+                        module=site.module,
+                        line=site.call.lineno,
+                        col=site.call.col_offset,
+                        message=(
+                            f"static argument `{pname}` of jit wrapper "
+                            f"`{wname}` has ⊤ provenance — a raw host "
+                            "measurement of live data reaches a compile-"
+                            "cache key interprocedurally, so every "
+                            "distinct value recompiles (the recompile "
+                            "bomb R3 only sees locally); route the "
+                            "measurement through the bucket registry "
+                            "(graph.structures.pad_to) before it "
+                            "becomes static"
+                        ),
+                    )
+                )
+
+    # -------------------------------------------------------- R14 events
+
+    def _emit_r14(self) -> None:
+        for site in self.wrapper_sites:
+            uncast_levels: Dict[str, int] = {}
+            for i, v in enumerate(site.arg_vals):
+                if i < len(site.boundary_casts) and site.boundary_casts[i]:
+                    continue  # explicitly cast at the boundary
+                for d in v.dtypes:
+                    if d in LADDER_DTYPES:
+                        uncast_levels.setdefault(d, i)
+            if len(uncast_levels) < 2:
+                continue
+            wname = site.wrapper.bound_name or "<jit>"
+            levels = ", ".join(sorted(uncast_levels))
+            self.events.append(
+                ShapeEvent(
+                    kind="ladder-break",
+                    module=site.module,
+                    line=site.call.lineno,
+                    col=site.call.col_offset,
+                    message=(
+                        f"mixed precision-ladder dtypes ({levels}) flow "
+                        f"into one fused program boundary `{wname}` "
+                        "without an explicit cast — XLA inserts the "
+                        "upcast where the values meet, not where the "
+                        "kernel contract says, so accumulation "
+                        "precision silently drifts per call site; cast "
+                        "at the boundary (`x.astype(...)` / "
+                        "`jnp.asarray(x, dtype=...)`) to pin one "
+                        "ladder level"
+                    ),
+                )
+            )
+
+    # -------------------------------------------------------- R15 events
+
+    def _emit_r15(self) -> None:
+        for site in self.seam_sites:
+            v = site.graph_val
+            if not (v.is_array and v.prov.level == TOP):
+                continue
+            self.events.append(
+                ShapeEvent(
+                    kind="bucket-escape",
+                    module=site.module,
+                    line=site.call.lineno,
+                    col=site.call.col_offset,
+                    message=(
+                        f"array shaped by a raw host measurement "
+                        f"reaches dispatch seam `{site.seam}` — its "
+                        "shape keys the compile cache outside the pad-"
+                        "bucket registry, so the DispatchRouter "
+                        "compiles one program per distinct window "
+                        "(pad-bucket escape); build the array through "
+                        "graph.structures.pad_to (or a build_window_"
+                        "graph*/prepare_window_graph helper) so the "
+                        "shape is drawn from the bucket family"
+                    ),
+                )
+            )
+
+    # -------------------------------------------------------- R16 events
+
+    def _warm_defs(self) -> Set[int]:
+        """FuncDefs reachable from warm*-named roots (name-level BFS
+        over project-resolved calls)."""
+        edges: Dict[int, Set[int]] = {}
+        for fd in self._all_defs():
+            outs: Set[int] = set()
+            for node in ast.walk(fd.node):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name
+                ):
+                    callee = self.traced.resolve(fd.module, node.func.id)
+                    if callee is not None:
+                        self._by_id.setdefault(id(callee), callee)
+                        outs.add(id(callee))
+            edges[id(fd)] = outs
+        warm = {
+            id(fd)
+            for fd in self._all_defs()
+            if any(m in fd.name.lower() for m in _WARM_MARKERS)
+        }
+        frontier = list(warm)
+        while frontier:
+            cur = frontier.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in warm:
+                    warm.add(nxt)
+                    frontier.append(nxt)
+        return warm
+
+    @staticmethod
+    def _site_keys(site: WrapperSite) -> Optional[Set[Tuple]]:
+        """The statically enumerated compile-key set of one call site
+        (cartesian product of static-arg value sets), or None when any
+        static argument is unenumerable (delegated to the runtime
+        compile witness)."""
+        if not site.static_provs:
+            return None
+        axes = []
+        for _pos, pname, prov in site.static_provs:
+            if not prov.enumerable:
+                return None
+            axes.append([(pname, v) for v in sorted(prov.values, key=repr)])
+        keys = set()
+        for combo in itertools.product(*axes):
+            keys.add(tuple(combo))
+            if len(keys) > 64:
+                return None  # key space too large to enumerate
+        return keys
+
+    def _emit_r16(self) -> None:
+        warm = self._warm_defs()
+        by_wrapper: Dict[int, List[WrapperSite]] = {}
+        for site in self.wrapper_sites:
+            by_wrapper.setdefault(id(site.wrapper), []).append(site)
+        for sites in by_wrapper.values():
+            warm_keys: Set[Tuple] = set()
+            has_warm_site = False
+            for site in sites:
+                if site.enclosing is not None and id(site.enclosing) in warm:
+                    has_warm_site = True
+                    keys = self._site_keys(site)
+                    if keys:
+                        warm_keys |= keys
+            if not has_warm_site:
+                continue  # no warmup declared for this wrapper at all
+            for site in sites:
+                if site.enclosing is not None and id(site.enclosing) in warm:
+                    continue
+                keys = self._site_keys(site)
+                if not keys:
+                    continue  # unenumerable: the runtime witness owns it
+                missing = keys - warm_keys
+                if not missing:
+                    continue
+                wname = site.wrapper.bound_name or "<jit>"
+                sample = sorted(
+                    "(" + ", ".join(f"{k}={v!r}" for k, v in key) + ")"
+                    for key in missing
+                )[:3]
+                self.events.append(
+                    ShapeEvent(
+                        kind="warmup-gap",
+                        module=site.module,
+                        line=site.call.lineno,
+                        col=site.call.col_offset,
+                        message=(
+                            f"compile keys {', '.join(sample)} of jit "
+                            f"wrapper `{wname}` are dispatched here but "
+                            "never by the warmup path — the statically "
+                            "enumerated key set must be a subset of "
+                            "what warmup declares (warmup manifest "
+                            "coverage), or the first production request "
+                            "pays the compile; add the key to the "
+                            "warm* call (dispatch/warmup.py) or make "
+                            "the argument reach this site through it"
+                        ),
+                    )
+                )
+
+
+# ----------------------------------------------- runtime key-space model
+
+
+def is_bucketed_extent(
+    n: int,
+    policy: str = "pow2q",
+    min_pad: int = 8,
+    occupancy: Optional[int] = None,
+) -> bool:
+    """True when one array extent is explainable by the pad-bucket
+    registry: small (≤ the pad floor), a batch-occupancy axis, a
+    ``pad_to`` fixed point under ``policy``, an indptr row (bucket+1),
+    or a packed-bitmap byte column (bucket/8)."""
+    from ..graph.structures import pad_to
+
+    n = int(n)
+    if n <= max(int(min_pad), 8):
+        return True
+    if occupancy is not None and n == int(occupancy):
+        return True
+    if pad_to(n, policy, min_pad) == n:
+        return True
+    if n >= 1 and pad_to(n - 1, policy, min_pad) == n - 1:
+        return True  # indptr arrays carry one extra row
+    if pad_to(n * 8, policy, min_pad) == n * 8:
+        return True  # np.packbits byte columns: bucket / 8
+    return False
+
+
+KNOWN_KERNELS = (
+    "auto",
+    "dense",
+    "dense_bf16",
+    "coo",
+    "csr",
+    "pcsr",
+    "packed",
+    "packed_bf16",
+    "packed_blocked",
+    "kind",
+    "pallas",
+)
+
+
+@dataclass
+class CompileKeySpace:
+    """The statically predicted compile-key space for one run: observed
+    keys (program, kernel, occupancy, leaf shapes) must fall inside it.
+    ``kernels``/``occupancies`` of None mean "any" — the load-bearing
+    claim is always the shape predicate: every extent is drawn from the
+    pad-bucket registry."""
+
+    pad_policy: str = "pow2q"
+    min_pad: int = 8
+    kernels: Optional[FrozenSet[str]] = None
+    occupancies: Optional[FrozenSet[int]] = None
+
+    def admits(
+        self,
+        program: str,
+        kernel: Optional[str],
+        occupancy: Optional[int],
+        shapes,
+    ) -> Optional[str]:
+        """None when the observed key is inside the predicted space,
+        else a human-readable reason it escaped."""
+        if kernel is not None:
+            allowed = (
+                self.kernels if self.kernels is not None
+                else frozenset(KNOWN_KERNELS)
+            )
+            if kernel not in allowed:
+                return (
+                    f"kernel {kernel!r} of program {program!r} is outside "
+                    f"the predicted kernel set {sorted(allowed)}"
+                )
+        if (
+            occupancy is not None
+            and self.occupancies is not None
+            and int(occupancy) not in self.occupancies
+        ):
+            return (
+                f"occupancy {occupancy} of program {program!r} is outside "
+                f"the declared warmup occupancies "
+                f"{sorted(self.occupancies)}"
+            )
+        if self.pad_policy == "exact":
+            return None  # exact padding predicts nothing about extents
+        for shape in shapes or ():
+            for dim in shape:
+                if not is_bucketed_extent(
+                    dim, self.pad_policy, self.min_pad, occupancy
+                ):
+                    return (
+                        f"extent {int(dim)} in shape {tuple(shape)} of "
+                        f"program {program!r} is not a "
+                        f"pad_to(policy={self.pad_policy!r}) bucket — a "
+                        "live measurement escaped the bucket registry"
+                    )
+        return None
+
+
+def predict_key_space(
+    config=None,
+    occupancies=None,
+    cache_dir: Optional[str] = None,
+    pipeline: Optional[str] = None,
+) -> CompileKeySpace:
+    """Build the run's predicted key space from its config (pad policy,
+    forced kernel) plus — when a warmup manifest is available — the
+    declared occupancies. Occupancies stay open (None) unless the
+    caller or the manifest pins them: the shape-bucket predicate is the
+    invariant the witness enforces everywhere."""
+    runtime = getattr(config, "runtime", config)
+    policy = str(getattr(runtime, "pad_policy", "pow2q") or "pow2q")
+    min_pad = int(getattr(runtime, "min_pad", 8) or 8)
+    kernels = None
+    forced = getattr(runtime, "kernel", "auto")
+    if forced and forced != "auto":
+        # A forced kernel still auto-resolves on the sharded route, so
+        # the prediction keeps the full shard-capable set plus it.
+        kernels = frozenset(KNOWN_KERNELS) | frozenset([str(forced)])
+    occs = set(int(o) for o in occupancies) if occupancies else set()
+    if cache_dir and pipeline:
+        from ..dispatch.cache import manifest_occupancies
+
+        occs |= set(manifest_occupancies(cache_dir, pipeline))
+    return CompileKeySpace(
+        pad_policy=policy,
+        min_pad=min_pad,
+        kernels=kernels,
+        occupancies=frozenset(occs) if occs else None,
+    )

@@ -1858,9 +1858,10 @@ def main(argv=None) -> int:
     )
     p_stats.set_defaults(fn=cmd_stats)
 
-    from ..analysis.cli import add_lint_parser
+    from ..analysis.cli import add_lint_parser, add_witness_parser
 
     add_lint_parser(sub)
+    add_witness_parser(sub)
 
     args = parser.parse_args(argv)
     if args.fn in (

@@ -14,6 +14,7 @@ from .cache import (
     WARMUP_MANIFEST_NAME,
     configure_compile_cache,
     load_manifest,
+    manifest_kernels,
     manifest_occupancies,
     record_manifest_entry,
     resolve_cache_dir,
@@ -29,6 +30,7 @@ __all__ = [
     "bucket_key",
     "configure_compile_cache",
     "load_manifest",
+    "manifest_kernels",
     "manifest_occupancies",
     "record_manifest_entry",
     "resolve_cache_dir",

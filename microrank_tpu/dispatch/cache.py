@@ -214,3 +214,17 @@ def manifest_occupancies(
         if e.get("pipeline") == pipeline:
             occs.update(int(o) for o in e.get("occupancies", []))
     return sorted(occs)
+
+
+def manifest_kernels(
+    cache_dir: Optional[str], pipeline: str
+) -> List[str]:
+    """Kernels a previous ``pipeline`` process warmed — the compile
+    witness (analysis.shapes.predict_key_space) and the ``witness``
+    CLI read this to narrow the predicted key space to what the
+    warmup manifest actually declares."""
+    kernels = set()
+    for e in load_manifest(cache_dir):
+        if e.get("pipeline") == pipeline and e.get("kernel"):
+            kernels.add(str(e["kernel"]))
+    return sorted(kernels)

@@ -295,6 +295,18 @@ class DispatchRouter:
                 windows=len(graphs),
             ):
                 staged = self._stage(graphs, kernel)
+        from ..analysis import mrsan
+
+        if mrsan.witness_armed():
+            # Compile witness (R13-R16's runtime twin): report this
+            # batch's compile-key signature before dispatch so an
+            # unpredicted key is journalled even if the compile hangs.
+            mrsan.observe_compile_key(
+                "dispatch." + staged.route,
+                kernel=staged.kernel,
+                graph=graphs[0] if graphs else None,
+                occupancy=len(graphs),
+            )
         profile_cm = (
             self._profiler.session()
             if self._profiler is not None

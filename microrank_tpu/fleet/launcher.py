@@ -134,9 +134,10 @@ def run_local_fleet(config, args) -> int:
 
     sinks.append(JsonlIncidentSink(out_dir / INCIDENT_LOG_NAME))
     if config.runtime.telemetry:
-        from ..obs import JOURNAL_NAME, RunJournal
+        from ..obs import JOURNAL_NAME, RunJournal, set_current_journal
 
         journal = RunJournal(out_dir / JOURNAL_NAME)
+        set_current_journal(journal)
         sinks.append(_JournalIncidentSink(journal))
 
     from .coordinator import FleetCoordinator, FleetServer

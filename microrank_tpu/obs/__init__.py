@@ -13,7 +13,14 @@ a finished run's snapshot offline.
 
 from .flight import FLIGHT_DIR, FlightRecorder
 from .host import ContentionSentinel
-from .journal import JOURNAL_NAME, RunJournal, read_journal
+from .journal import (
+    JOURNAL_NAME,
+    RunJournal,
+    current_journal,
+    emit_current,
+    read_journal,
+    set_current_journal,
+)
 from .registry import (
     Counter,
     Gauge,
@@ -47,11 +54,14 @@ __all__ = [
     "SpanContext",
     "SpanTracer",
     "configure_tracer",
+    "current_journal",
     "diff_registries",
+    "emit_current",
     "get_registry",
     "get_tracer",
     "read_journal",
     "registry_from_json",
+    "set_current_journal",
     "set_registry",
     "set_tracer",
 ]

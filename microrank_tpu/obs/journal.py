@@ -13,6 +13,11 @@ way) is self-flagging. Events:
   sizes, timings dict, rank_iterations / rank_residual (device
   convergence trace), kernel, queue_depth, host sample;
 * ``follow_poll`` — one per follow-mode poll: size, horizon, counters;
+* ``jit_cache_miss`` — one per first-seen compile key at a dispatch
+  seam while the compile witness is armed: entry-point ``program``,
+  ``kernel``, ``occupancy``, the shape ``key``, and whether the static
+  key-space analysis (``analysis.shapes``) ``predicted`` it — an
+  unpredicted key is a model gap the ``witness`` CLI replays;
 * ``run_end`` — totals + a flat telemetry summary (retraces, staged
   bytes).
 
@@ -122,6 +127,37 @@ class RunJournal:
                     os.fsync(f.fileno())
             except OSError:  # pragma: no cover - exotic filesystems
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Current-journal registry.  Deep seams (the compile witness inside the
+# dispatch router) have no journal handle threaded to them; run entries
+# register theirs here so those seams can emit without plumbing the
+# writer through every signature.  One journal per process at a time —
+# the same invariant the metrics registry already relies on.
+
+_current: Optional[RunJournal] = None
+_current_lock = threading.Lock()
+
+
+def set_current_journal(journal: Optional["RunJournal"]) -> None:
+    """Register (or clear, with None) the process-wide journal."""
+    global _current
+    with _current_lock:
+        _current = journal
+
+
+def current_journal() -> Optional["RunJournal"]:
+    with _current_lock:
+        return _current
+
+
+def emit_current(event: str, **fields) -> None:
+    """Emit on the registered journal if one exists; silently a no-op
+    otherwise (bench/test paths that never open a journal)."""
+    j = current_journal()
+    if j is not None:
+        j.emit(event, **fields)
 
 
 def read_journal(path) -> list:

@@ -97,6 +97,17 @@ def jit_retraces() -> Counter:
     )
 
 
+def jit_cache_misses() -> Counter:
+    return get_registry().counter(
+        "microrank_jit_cache_misses_total",
+        "First-seen compile keys observed at dispatch seams by the "
+        "compile witness (analysis.mrsan) — each is one trace+compile "
+        "the warmup manifest did not absorb; cross-checked against the "
+        "static key-space prediction (analysis.shapes R13-R16)",
+        labelnames=("program",),
+    )
+
+
 def pipeline_inflight() -> Gauge:
     return get_registry().gauge(
         "microrank_pipeline_inflight",
@@ -364,9 +375,10 @@ def mrsan_violations() -> Counter:
         "entered off the owner thread — mrlint R8's runtime twin), "
         "collective-divergence (per-shard collective multisets "
         "diverged on the mesh — R9's), shared-state-race (a "
-        "registered object's candidate lockset emptied — R10's), or "
+        "registered object's candidate lockset emptied — R10's), "
         "lock-order (an armed acquire closed a cycle in the observed "
-        "acquisition DAG — R11's)",
+        "acquisition DAG — R11's), or compile-witness (a jit compile "
+        "key outside the statically predicted key space — R13-R16's)",
         labelnames=("kind",),
     )
 
@@ -585,7 +597,8 @@ def ensure_catalog() -> None:
     for ctor in (
         stage_seconds, windows_total, rank_iterations,
         rank_final_residual, staged_bytes, staged_pad_bytes,
-        staging_transfers, jit_retraces, pipeline_inflight,
+        staging_transfers, jit_retraces, jit_cache_misses,
+        pipeline_inflight,
         follow_polls, follow_parse_failures, follow_rotations,
         serve_requests, serve_queue_depth, serve_batch_windows,
         serve_last_batch_gauge, serve_degraded, serve_stage_seconds,
@@ -706,6 +719,32 @@ def record_mrsan_collective(op: str, n: int = 1) -> None:
 
 def record_mrsan_lockset_check(obj: str) -> None:
     mrsan_lockset_checks().inc(object=obj)
+
+
+def record_jit_cache_miss(
+    program: str,
+    kernel: str = None,
+    occupancy: int = None,
+    key=None,
+    predicted: bool = True,
+) -> None:
+    """One first-seen compile key at a dispatch seam (compile witness).
+
+    Increments the per-program miss counter and journals the full key
+    on the registered run journal, so a post-mortem can replay exactly
+    which shapes compiled and whether the static model called them.
+    """
+    jit_cache_misses().inc(program=program)
+    from .journal import emit_current
+
+    emit_current(
+        "jit_cache_miss",
+        program=program,
+        kernel=kernel,
+        occupancy=occupancy,
+        key=key,
+        predicted=bool(predicted),
+    )
 
 
 def record_retry(seam: str) -> None:

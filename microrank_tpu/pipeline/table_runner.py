@@ -458,9 +458,10 @@ class TableRCA:
         )
         journal = None
         if out_dir is not None and cfg.runtime.telemetry:
-            from ..obs import JOURNAL_NAME, RunJournal
+            from ..obs import JOURNAL_NAME, RunJournal, set_current_journal
 
             journal = RunJournal(Path(out_dir) / JOURNAL_NAME)
+            set_current_journal(journal)
             journal.run_start(
                 pipeline="table",
                 kernel=cfg.runtime.kernel,

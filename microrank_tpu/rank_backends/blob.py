@@ -305,6 +305,13 @@ def stage_rank_window(
     from ..utils.guards import assert_device_owner
 
     assert_device_owner("blob.stage_rank_window")
+    from ..analysis import mrsan
+
+    if mrsan.witness_armed():
+        mrsan.observe_compile_key(
+            "blob.stage_rank_window", kernel=kernel, graph=graph,
+            occupancy=1,
+        )
 
     if explain is not None and getattr(explain, "enabled", False):
         from ..explain.extract import (
@@ -518,6 +525,15 @@ def stage_rank_windows_batched(
     ``conv_trace`` appends per-window (residuals [B, 2, I],
     n_iters [B]) to the return tuple."""
     from ..obs.metrics import record_retrace
+    from ..analysis import mrsan
+
+    if mrsan.witness_armed():
+        leaves = jax.tree.leaves(batched)
+        mrsan.observe_compile_key(
+            "blob.stage_rank_windows_batched", kernel=kernel,
+            graph=batched,
+            occupancy=int(leaves[0].shape[0]) if leaves else None,
+        )
 
     if blob:
         blob_arr, layout = pack_graph_blob(batched)

@@ -72,12 +72,10 @@ class PendingWindow:
         if self._finished:
             return
         self._finished = True
-        if error is not None:
-            self.future.set_exception(error)
-        else:
-            self.future.set_result(self.result)
-        if self.on_done is not None:
-            self.on_done(self, error)
+        # Record the root span BEFORE resolving the future: the HTTP
+        # response goes out the moment the future resolves, and a
+        # caller reading the tracer ring right after the response must
+        # find its request's span there.
         if self.ctx is not None and self.t0_us:
             from ..obs.spans import get_tracer
 
@@ -92,6 +90,12 @@ class PendingWindow:
                 degraded=bool(self.result.degraded),
                 error=type(error).__name__ if error else None,
             )
+        if error is not None:
+            self.future.set_exception(error)
+        else:
+            self.future.set_result(self.result)
+        if self.on_done is not None:
+            self.on_done(self, error)
 
 
 def _conv_summary(residuals, n_iters) -> dict:

@@ -70,9 +70,10 @@ class ServeService:
         self.journal = None
         self.out_dir = Path(out_dir) if out_dir is not None else None
         if self.out_dir is not None and config.runtime.telemetry:
-            from ..obs import JOURNAL_NAME, RunJournal
+            from ..obs import JOURNAL_NAME, RunJournal, set_current_journal
 
             self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
+            set_current_journal(self.journal)
         self.build_pool = None
         if self.serve.build_workers > 0:
             from ..stream.pool import BuildWorkerPool

@@ -166,9 +166,10 @@ class StreamEngine:
                 overwrite_csv=config.compat.overwrite_results,
             )
             if config.runtime.telemetry:
-                from ..obs import JOURNAL_NAME, RunJournal
+                from ..obs import JOURNAL_NAME, RunJournal, set_current_journal
 
                 self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
+                set_current_journal(self.journal)
         if tracker is not None:
             # Injected lifecycle (the fleet worker's coordinator proxy):
             # incidents are a GLOBAL concern there, so no local sinks —

@@ -1,0 +1,20 @@
+"""R13 positive: a live measurement reaches a static jit argument
+through a helper call — invisible to R3's local pattern, caught by the
+interprocedural provenance analysis (⊤ flows through n_rows)."""
+import jax
+
+
+def n_rows(table):
+    return len(table)
+
+
+def rank(x, n):
+    return x * n
+
+
+rank_jit = jax.jit(rank, static_argnums=(1,))
+
+
+def serve(table, x):
+    count = n_rows(table)
+    return rank_jit(x, count)

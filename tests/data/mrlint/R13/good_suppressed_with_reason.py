@@ -1,0 +1,20 @@
+"""R13 negative: same flow, suppressed in place with a justified
+pragma (the escape hatch leaves an audit trail; bare disables are R0)."""
+import jax
+
+
+def n_rows(table):
+    return len(table)
+
+
+def rank(x, n):
+    return x * n
+
+
+rank_jit = jax.jit(rank, static_argnums=(1,))
+
+
+def serve(table, x):
+    count = n_rows(table)
+    # mrlint: disable=R13(fixture: table rows bounded by the admission cap upstream)
+    return rank_jit(x, count)

@@ -1,0 +1,19 @@
+"""R14 positive: f32 and bf16 arrays meet at one fused program
+boundary with no explicit cast — XLA places the upcast inside the
+fusion, drifting accumulation precision between call sites."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def combine(a, b):
+    return a + b
+
+
+combine_jit = jax.jit(combine)
+
+
+def run():
+    scores = np.zeros((8,), dtype=np.float32)
+    pattern = np.zeros((8,), dtype=jnp.bfloat16)
+    return combine_jit(scores, pattern)

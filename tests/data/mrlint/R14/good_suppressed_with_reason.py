@@ -1,0 +1,19 @@
+"""R14 negative: the same mixed-ladder boundary, suppressed with a
+justified pragma (e.g. the kernel contract pins the upcast itself)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def combine(a, b):
+    return a + b
+
+
+combine_jit = jax.jit(combine)
+
+
+def run():
+    scores = np.zeros((8,), dtype=np.float32)
+    pattern = np.zeros((8,), dtype=jnp.bfloat16)
+    # mrlint: disable=R14(fixture: kernel promotes bf16 on read, upcast placement is pinned)
+    return combine_jit(scores, pattern)

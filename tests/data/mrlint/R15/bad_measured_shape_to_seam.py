@@ -1,0 +1,12 @@
+"""R15 positive: an array shaped by a raw host measurement reaches a
+dispatch seam — its shape keys the compile cache outside the pad-bucket
+registry (one compiled program per distinct window)."""
+import numpy as np
+
+
+def serve(table, pagerank_cfg, spectrum_cfg):
+    n = len(table)
+    graph = np.zeros((n, n), dtype=np.float32)
+    return stage_rank_window(
+        graph, pagerank_cfg, spectrum_cfg, "kind", True
+    )

@@ -1,0 +1,12 @@
+"""R15 negative: same escape, suppressed with a justified pragma on
+the seam call's first line (covers the continuation lines too)."""
+import numpy as np
+
+
+def serve(table, pagerank_cfg, spectrum_cfg):
+    n = len(table)
+    graph = np.zeros((n, n), dtype=np.float32)
+    # mrlint: disable=R15(fixture: one-shot offline audit path, recompiles are acceptable)
+    return stage_rank_window(
+        graph, pagerank_cfg, spectrum_cfg, "kind", True
+    )

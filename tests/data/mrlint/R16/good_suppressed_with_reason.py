@@ -1,0 +1,19 @@
+"""R16 negative: the same uncovered key, suppressed with a justified
+pragma (e.g. a deliberately cold fallback kernel)."""
+import jax
+
+
+def rank(x, kernel):
+    return x
+
+
+rank_jit = jax.jit(rank, static_argnames=("kernel",))
+
+
+def warm_start(x):
+    rank_jit(x, kernel="kind")
+
+
+def serve(x):
+    # mrlint: disable=R16(fixture: packed is the cold-path fallback, compile on demand is intended)
+    return rank_jit(x, kernel="packed")

@@ -31,7 +31,7 @@ def _rules_fired(path: Path):
 def test_rule_catalog_complete():
     assert set(RULES) == {
         "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
-        "R10", "R11", "R12",
+        "R10", "R11", "R12", "R13", "R14", "R15", "R16",
     }
     for rule in RULES.values():
         assert rule.slug and rule.summary

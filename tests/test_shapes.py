@@ -1,0 +1,426 @@
+"""mrshape suite: the interprocedural shape/dtype provenance lattice
+(analysis.shapes), the compile-key-space model, and the runtime compile
+witness (analysis.mrsan) that mirrors R13-R16.
+
+The rule-level positive/negative behavior lives in the mrlint fixture
+corpus (tests/data/mrlint/R13..R16); this file covers the machinery
+those rules stand on — lattice algebra, interprocedural propagation,
+the bucket-extent predicate, key-space admission, and the witness's
+observe/dedupe/report/journal loop.
+"""
+
+import json
+
+import pytest
+
+from microrank_tpu.analysis.shapes import (
+    BOT,
+    BUCKET,
+    CONST,
+    TOP,
+    WIDEN_LIMIT,
+    AbsVal,
+    CompileKeySpace,
+    Prov,
+    is_bucketed_extent,
+    p_const,
+    predict_key_space,
+)
+
+
+@pytest.fixture
+def registry():
+    """Install a fresh process metrics registry; restore after."""
+    from microrank_tpu.obs import (
+        MetricsRegistry,
+        get_registry,
+        set_registry,
+    )
+
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+# ------------------------------------------------------------- lattice
+
+
+def test_prov_join_is_monotone_on_levels():
+    bot = Prov(BOT)
+    top = Prov(TOP)
+    bucket = Prov(BUCKET)
+    c = p_const(4)
+    assert bot.join(c).level == CONST
+    assert c.join(bucket).level == BUCKET
+    assert bucket.join(top).level == TOP
+    # join is commutative and idempotent
+    assert c.join(bucket) == bucket.join(c)
+    assert top.join(top) == top
+
+
+def test_const_join_unions_value_sets():
+    a = p_const(1)
+    b = p_const(2)
+    j = a.join(b)
+    assert j.level == CONST
+    assert j.values == frozenset({1, 2})
+
+
+def test_const_widening_drops_values_past_limit():
+    acc = p_const(0)
+    for i in range(1, WIDEN_LIMIT + 2):
+        acc = acc.join(p_const(i))
+    assert acc.level == CONST
+    # Past the widening limit the set becomes unenumerable (None), but
+    # stays CONST: bounded, just not finitely listed.
+    assert acc.values is None
+    assert not acc.enumerable
+
+
+def test_absval_join_is_pointwise_and_cast_is_conjunctive():
+    a = AbsVal(
+        prov=p_const(8), dtypes=frozenset({"float32"}), is_array=True,
+        cast=True,
+    )
+    b = AbsVal(
+        prov=Prov(TOP), dtypes=frozenset({"bfloat16"}), is_array=True,
+        cast=False,
+    )
+    j = a.join(b)
+    assert j.prov.level == TOP
+    assert j.dtypes == frozenset({"float32", "bfloat16"})
+    assert j.is_array
+    assert not j.cast  # one uncast branch taints the join
+
+
+# ------------------------------------------- interprocedural propagation
+
+
+def _events(source, tmp_path, kinds=None):
+    from microrank_tpu.analysis.core import Project, parse_module
+
+    f = tmp_path / "mod.py"
+    f.write_text(source)
+    project = Project([parse_module(f)])
+    evs = project.shapes.events
+    if kinds is not None:
+        evs = [e for e in evs if e.kind in kinds]
+    return evs
+
+
+def test_bucket_provenance_survives_helper_chain(tmp_path):
+    """A pad_to-bucketed extent stays BUCKET through two helper calls,
+    so the array built from it does NOT trip the pad-bucket-escape
+    check at a dispatch seam."""
+    src = """
+import numpy as np
+from microrank_tpu.graph.structures import pad_to
+
+def bucketed(table):
+    return pad_to(len(table))
+
+def build(table):
+    n = bucketed(table)
+    return np.zeros((n, n), dtype=np.float32)
+
+def serve(table, pagerank_cfg, spectrum_cfg):
+    graph = build(table)
+    return stage_rank_window(graph, pagerank_cfg, spectrum_cfg, "kind", True)
+"""
+    assert _events(src, tmp_path, kinds={"bucket-escape"}) == []
+
+
+def test_measured_provenance_survives_helper_chain(tmp_path):
+    """The same chain WITHOUT the pad_to stays TOP and fires."""
+    src = """
+import numpy as np
+
+def measured(table):
+    return len(table)
+
+def build(table):
+    n = measured(table)
+    return np.zeros((n, n), dtype=np.float32)
+
+def serve(table, pagerank_cfg, spectrum_cfg):
+    graph = build(table)
+    return stage_rank_window(graph, pagerank_cfg, spectrum_cfg, "kind", True)
+"""
+    evs = _events(src, tmp_path, kinds={"bucket-escape"})
+    assert len(evs) == 1
+
+
+def test_recompile_bomb_through_helper(tmp_path):
+    src = """
+import jax
+
+def n_rows(table):
+    return len(table)
+
+def rank(x, n):
+    return x * n
+
+rank_jit = jax.jit(rank, static_argnums=(1,))
+
+def serve(table, x):
+    return rank_jit(x, n_rows(table))
+"""
+    evs = _events(src, tmp_path, kinds={"recompile-bomb"})
+    assert len(evs) == 1
+    assert "static" in evs[0].message
+
+
+def test_const_static_arg_is_clean(tmp_path):
+    src = """
+import jax
+
+def rank(x, n):
+    return x * n
+
+rank_jit = jax.jit(rank, static_argnums=(1,))
+
+def serve(x):
+    return rank_jit(x, 8)
+"""
+    assert _events(src, tmp_path, kinds={"recompile-bomb"}) == []
+
+
+# ------------------------------------------------- bucket-extent predicate
+
+
+@pytest.mark.parametrize("policy", ["pow2", "pow2q"])
+def test_bucketed_extents_admit_buckets_and_derived_rows(policy):
+    from microrank_tpu.graph.structures import pad_to
+
+    for live in (3, 17, 40, 100, 333):
+        bucket = pad_to(live, policy)
+        assert is_bucketed_extent(bucket, policy)
+        # indptr arrays carry bucket+1 rows
+        assert is_bucketed_extent(bucket + 1, policy)
+        # packbits byte columns carry bucket/8 columns
+        if bucket % 8 == 0:
+            assert is_bucketed_extent(bucket // 8, policy)
+
+
+def test_unbucketed_extent_rejected():
+    # 37 is not a pow2q bucket, not bucket+1 (36 isn't either), and
+    # 37*8=296 isn't a bucket — a live measurement escaped.
+    assert not is_bucketed_extent(37, "pow2q")
+    # but anything at or under the pad floor is always fine
+    assert is_bucketed_extent(7, "pow2q")
+    # and the batch-occupancy axis is admitted when it matches
+    assert is_bucketed_extent(37, "pow2q", occupancy=37)
+
+
+# ------------------------------------------------------ key-space model
+
+
+def test_key_space_admits_bucketed_and_rejects_measured():
+    space = CompileKeySpace(pad_policy="pow2q")
+    assert space.admits("p", "kind", 4, [(64, 64), (65,)]) is None
+    reason = space.admits("p", "kind", 4, [(37, 37)])
+    assert reason is not None and "37" in reason
+
+
+def test_key_space_exact_policy_predicts_nothing_about_extents():
+    space = CompileKeySpace(pad_policy="exact")
+    assert space.admits("p", "kind", 1, [(37, 41)]) is None
+
+
+def test_key_space_rejects_unknown_kernel_and_occupancy():
+    space = CompileKeySpace(
+        pad_policy="pow2q", kernels=frozenset({"kind"}),
+        occupancies=frozenset({1, 4}),
+    )
+    assert space.admits("p", "mystery", 1, []) is not None
+    assert space.admits("p", "kind", 3, []) is not None
+    assert space.admits("p", "kind", 4, []) is None
+
+
+def test_predict_key_space_reads_config_and_manifest(tmp_path):
+    import dataclasses
+
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.dispatch.cache import record_manifest_entry
+
+    cfg = MicroRankConfig()
+    cfg = cfg.replace(
+        runtime=dataclasses.replace(cfg.runtime, pad_policy="pow2")
+    )
+    record_manifest_entry(tmp_path, "table", "kind", [1, 4])
+    space = predict_key_space(
+        cfg, cache_dir=tmp_path, pipeline="table"
+    )
+    assert space.pad_policy == "pow2"
+    assert space.occupancies == frozenset({1, 4})
+
+
+# ------------------------------------------------------ compile witness
+
+
+@pytest.fixture()
+def witness():
+    from microrank_tpu.analysis import mrsan
+
+    mrsan.disarm_witness()
+    yield mrsan
+    mrsan.disarm_witness()
+
+
+def test_witness_observes_dedupes_and_flags_escapes(witness, registry):
+    import numpy as np
+
+    witness.arm_witness(CompileKeySpace(pad_policy="pow2q"))
+    good = {"a": np.zeros((64, 64), dtype=np.float32)}
+    bad = {"a": np.zeros((37, 37), dtype=np.float32)}
+    witness.observe_compile_key("p", kernel="kind", graph=good, occupancy=4)
+    witness.observe_compile_key("p", kernel="kind", graph=good, occupancy=4)
+    witness.observe_compile_key("p", kernel="kind", graph=bad, occupancy=4)
+    rep = witness.witness_report()
+    assert rep["programs"] == {"p": 2}  # dedupe: 3 observations, 2 keys
+    assert rep["keys_total"] == 2
+    assert len(rep["unpredicted"]) == 1
+    assert "37" in rep["unpredicted"][0]["reason"]
+    misses = registry.get("microrank_jit_cache_misses_total")
+    assert sum(s["value"] for s in misses.samples()) == 2
+    viols = registry.get("microrank_mrsan_violations_total")
+    by_kind = {
+        s["labels"]["kind"]: s["value"] for s in viols.samples()
+    }
+    assert by_kind.get("compile-witness") == 1
+
+
+def test_witness_journals_misses(witness, registry, tmp_path):
+    import numpy as np
+
+    from microrank_tpu.obs import (
+        RunJournal,
+        read_journal,
+        set_current_journal,
+    )
+
+    journal = RunJournal(tmp_path / "journal.jsonl")
+    set_current_journal(journal)
+    try:
+        witness.arm_witness(CompileKeySpace(pad_policy="pow2q"))
+        witness.observe_compile_key(
+            "p", kernel="kind",
+            graph={"a": np.zeros((64,), dtype=np.float32)}, occupancy=1,
+        )
+    finally:
+        set_current_journal(None)
+    events = read_journal(tmp_path / "journal.jsonl")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["event"] == "jit_cache_miss"
+    assert ev["program"] == "p"
+    assert ev["kernel"] == "kind"
+    assert ev["predicted"] is True
+    assert ev["key"] == [[64]]
+
+
+def test_configure_sanitizers_does_not_disarm_external_witness(witness):
+    """The bench arms the witness around a TableRCA.run; the run entry's
+    configure_sanitizers (sanitizers off) must leave it armed."""
+    from microrank_tpu.analysis.mrsan import configure_sanitizers
+    from microrank_tpu.config import MicroRankConfig
+
+    witness.arm_witness(CompileKeySpace(pad_policy="pow2q"))
+    configure_sanitizers(MicroRankConfig())  # sanitizers default off
+    assert witness.witness_armed()
+    # but a config-armed witness IS released by the disabled config
+    witness.disarm_witness()
+    witness.arm_witness(CompileKeySpace(pad_policy="pow2q"), owner="config")
+    configure_sanitizers(MicroRankConfig())
+    assert not witness.witness_armed()
+
+
+def test_sanitizers_on_arms_witness_from_config(witness):
+    import dataclasses
+
+    from microrank_tpu.analysis.mrsan import configure_sanitizers
+    from microrank_tpu.config import MicroRankConfig
+    from microrank_tpu.utils.guards import set_sanitizers
+
+    cfg = MicroRankConfig()
+    cfg = cfg.replace(
+        runtime=dataclasses.replace(cfg.runtime, sanitizers=True)
+    )
+    try:
+        configure_sanitizers(cfg)
+        assert witness.witness_armed()
+    finally:
+        configure_sanitizers(MicroRankConfig())
+        set_sanitizers(False)
+
+
+def test_pipeline_run_observes_only_predicted_keys(witness, tmp_path, registry):
+    """End-to-end acceptance: a real TableRCA run over a synthetic
+    faulted timeline observes ≥1 compile key and ZERO keys outside the
+    static prediction — the compile-witness criterion CI enforces on
+    the bench replay."""
+    from microrank_tpu.config import MicroRankConfig, WindowConfig
+    from microrank_tpu.native import load_span_table
+    from microrank_tpu.pipeline.table_runner import TableRCA
+    from microrank_tpu.testing.synthetic import (
+        SyntheticConfig,
+        generate_timeline,
+    )
+
+    tl = generate_timeline(
+        SyntheticConfig(n_operations=30, n_kinds=8, n_traces=100, seed=11),
+        3,
+        [0, 1, 2],
+    )
+    normal_csv = tmp_path / "normal.csv"
+    abn_csv = tmp_path / "abn.csv"
+    tl.normal.to_csv(normal_csv, index=False)
+    tl.timeline.to_csv(abn_csv, index=False)
+    cfg = MicroRankConfig(
+        window=WindowConfig(
+            detect_minutes=tl.window_minutes, skip_minutes=0.0
+        )
+    )
+    witness.arm_witness(predict_key_space(cfg))
+    rca = TableRCA(cfg)
+    rca.fit_baseline(load_span_table(normal_csv))
+    results = rca.run(load_span_table(abn_csv))
+    assert any(r.ranking for r in results)
+    rep = witness.witness_report()
+    assert rep["keys_total"] >= 1
+    assert rep["unpredicted"] == []
+
+
+# ------------------------------------------------------- witness CLI
+
+
+def test_witness_cli_replays_journal(tmp_path, capsys):
+    from microrank_tpu.cli.main import main
+
+    journal = tmp_path / "journal.jsonl"
+    lines = [
+        {"event": "run_start", "pad_policy": "pow2q"},
+        {
+            "event": "jit_cache_miss", "program": "p", "kernel": "kind",
+            "occupancy": 1, "key": [[64, 64]], "predicted": True,
+        },
+    ]
+    journal.write_text(
+        "".join(json.dumps(e) + "\n" for e in lines)
+    )
+    assert main(["witness", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "inside the predicted space" in out
+
+    lines.append({
+        "event": "jit_cache_miss", "program": "p", "kernel": "kind",
+        "occupancy": 1, "key": [[37, 37]], "predicted": False,
+    })
+    journal.write_text(
+        "".join(json.dumps(e) + "\n" for e in lines)
+    )
+    assert main(["witness", str(journal)]) == 1
+    out = capsys.readouterr().out
+    assert "ESCAPE" in out
