@@ -11,6 +11,7 @@ instructs editing the file). Here:
     python -m microrank_tpu.cli eval   --cases 40 [--faults 2] [--detection]
     python -m microrank_tpu.cli stats  out/       (telemetry exposition)
     python -m microrank_tpu.cli stats  --diff before/ after/   (deltas)
+    python -m microrank_tpu.cli stats  --merge host0/ host1/   (fleet view)
     python -m microrank_tpu.cli collect ...       (optional ClickHouse export)
 
 (The benchmark lives at the repo root — ``python bench.py`` — because it
@@ -400,18 +401,74 @@ def _load_snapshot(target: Path):
     return json.loads(snap_path.read_text())
 
 
+def _merge_targets(paths):
+    """Resolve ``--merge`` targets to ONE federated registry. Each
+    target is a run dir / metrics.json path, or a fleet output dir —
+    a dir with ``host*/metrics.json`` children expands to those
+    per-host ledgers (its own top-level metrics.json is already the
+    merged fleet view; re-merging it with its children would double
+    count). The host label on gauges is the snapshot's directory
+    name. Returns None (with a stderr message) on a missing target."""
+    from ..obs import registry_from_json
+    from ..obs.registry import merge_registries
+
+    sources = []
+    for t in paths:
+        tp = Path(t)
+        children = (
+            sorted(tp.glob("host*/metrics.json")) if tp.is_dir() else []
+        )
+        for p in children or [tp]:
+            data = _load_snapshot(Path(p))
+            if data is None:
+                return None
+            p = Path(p)
+            label = (p if p.is_dir() else p.parent).name
+            sources.append((label, registry_from_json(data)))
+    return merge_registries(sources)
+
+
 def cmd_stats(args) -> int:
     """Offline metrics exposition: re-emit a finished run's snapshot
     (``metrics.json`` written at run end) as Prometheus text or JSON,
     and summarize the run journal when present. ``--diff`` takes TWO
     targets and emits after-minus-before deltas (counters/histograms
     subtract; gauges keep the after reading) — compare two runs, or a
-    snapshot taken before and after a traffic window."""
+    snapshot taken before and after a traffic window. ``--merge``
+    federates N per-host snapshots (counters/histogram buckets sum,
+    gauges gain a ``host`` label) — the same law the fleet coordinator
+    applies live — and composes with ``--diff``: two fleet dirs, each
+    merged, then diffed."""
     import os
 
     from ..obs import read_journal, registry_from_json
     from ..obs.journal import JOURNAL_NAME
 
+    if args.merge:
+        if args.diff and len(args.target) != 2:
+            print(
+                "--merge --diff takes exactly two targets (each a "
+                "fleet dir / snapshot list member): "
+                "`cli stats --merge --diff before_fleet/ after_fleet/`",
+                file=sys.stderr,
+            )
+            return 2
+        if args.diff:
+            from ..obs import diff_registries
+
+            regs = [_merge_targets([t]) for t in args.target]
+            if any(r is None for r in regs):
+                return 2
+            out = diff_registries(regs[0], regs[1])
+        else:
+            out = _merge_targets(args.target)
+            if out is None:
+                return 2
+        if args.format == "json":
+            print(json.dumps(out.to_json(), indent=2))
+        else:
+            print(out.to_prometheus(), end="")
+        return 0
     if args.diff:
         if len(args.target) != 2:
             print(
@@ -1847,6 +1904,13 @@ def main(argv=None) -> int:
         help="emit after-minus-before metric deltas between TWO "
         "targets (counters/histograms subtract, gauges keep the "
         "after reading)",
+    )
+    p_stats.add_argument(
+        "--merge", action="store_true",
+        help="federate N per-host snapshots into one fleet view "
+        "(counters and histogram buckets sum, gauges gain a host "
+        "label); a fleet output dir expands to its host*/metrics.json "
+        "children; composes with --diff (two targets, each merged)",
     )
     p_stats.add_argument(
         "--format", choices=["prom", "json"], default="prom",

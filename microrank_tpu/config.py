@@ -643,6 +643,79 @@ class FleetConfig:
     restart_dead_workers: bool = True
     restart_delay_seconds: float = 0.0
     max_restarts: int = 1
+    # Fleet telemetry plane: workers piggyback a versioned metrics
+    # delta (vs the last coordinator-acked baseline) on each heartbeat;
+    # the coordinator folds them into one federated registry served at
+    # GET /fleetz/metrics and snapshotted as the launcher's fleet
+    # metrics.{prom,json}.
+    metrics_in_heartbeat: bool = True
+    # Delta payload byte bound: an oversize delta drops whole metrics
+    # (largest first, counted as status="truncated") until it fits —
+    # the dropped increments ride the NEXT delta because the acked
+    # baseline only advances by what was actually sent.
+    delta_max_bytes: int = 262144
+    # Cardinality cap on host-labeled series in the fleet registry:
+    # deltas from more than expected_hosts + this many distinct hosts
+    # are refused whole and counted
+    # (microrank_fleet_series_dropped_total) instead of growing the
+    # registry without bound — the vocab-budget rationale applied to
+    # our own telemetry.
+    host_series_grace: int = 2
+    # Clamp on the heartbeat-RTT-estimated per-host clock offset used
+    # to order the merged fleet journal / fleet trace (the ingest
+    # skew-repair bound applied to our own telemetry).
+    max_clock_skew_seconds: float = 5.0
+
+
+@dataclass(frozen=True)
+class WatchdogConfig:
+    """SLO self-watchdog knobs (``obs/watchdog.py``).
+
+    The fleet coordinator evaluates the system's OWN golden signals
+    from the federated registry — per-stage latency budgets, error/
+    degraded rate, watermark lag, queue depth — as multi-window burn
+    rates (fast + slow window, both must burn past the threshold), and
+    a breach opens a SELF-incident through the unmodified
+    IncidentTracker machinery: suspect = the breaching stage/host,
+    fingerprint-deduped, resolved after sustained recovery, journaled /
+    webhooked / flight-dumped like any fault. This is the sensor layer
+    ROADMAP item 5's adaptive shedding actuates on.
+    """
+
+    enabled: bool = True
+    # Evaluation cadence (seconds between burn-rate samples; the
+    # coordinator's reaper drives it, extra calls are rate-limited).
+    eval_seconds: float = 1.0
+    # Multi-window burn rates: both the fast and the slow window must
+    # exceed burn_threshold for a breach (fast = reactive, slow =
+    # flap-damping; windows are counts of eval samples).
+    fast_windows: int = 5
+    slow_windows: int = 60
+    burn_threshold: float = 1.0
+    # Per-stage latency SLO: fraction of stage_seconds observations
+    # allowed above the budget (the error budget); burn = observed
+    # over-budget fraction / stage_error_budget. The budget snaps to
+    # the first histogram bucket bound >= the configured value.
+    stage_budget_ms: float = 500.0
+    # Per-stage overrides as (stage, budget_ms) pairs.
+    stage_budgets: Tuple[Tuple[str, float], ...] = ()
+    stage_error_budget: float = 0.1
+    # Error/degraded-rate SLO over windows processed: skipped stream
+    # windows + degraded serves, as a fraction of all windows.
+    error_budget: float = 0.1
+    # Gauge SLOs: burn = reading / budget (averaged over the window).
+    watermark_lag_budget_seconds: float = 600.0
+    queue_depth_budget: float = 8.0
+    # Ratio signals need at least this many new observations across
+    # the fast window before they can breach (cold-start guard).
+    min_samples: int = 3
+    # Self-incident lifecycle (the tracker's own knobs): consecutive
+    # healthy evals that resolve, and the reopen cooldown.
+    resolve_after_evals: int = 3
+    cooldown_evals: int = 5
+    # A single host whose recent per-stage cost exceeds the runner-up
+    # by this factor gets named in the suspect ("stage:<s>@<host>").
+    host_attribution_factor: float = 2.0
 
 
 @dataclass(frozen=True)
@@ -788,6 +861,7 @@ class MicroRankConfig:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -816,6 +890,10 @@ class MicroRankConfig:
                 flt["warmup_occupancies"] = tuple(flt["warmup_occupancies"])
             if typ is ChaosConfig and flt.get("faults") is not None:
                 flt["faults"] = tuple(dict(f) for f in flt["faults"])
+            if typ is WatchdogConfig and flt.get("stage_budgets") is not None:
+                flt["stage_budgets"] = tuple(
+                    (str(s), float(b)) for s, b in flt["stage_budgets"]
+                )
             return typ(**flt)
 
         return cls(
@@ -833,4 +911,5 @@ class MicroRankConfig:
             chaos=_mk(ChaosConfig, d.get("chaos", {})),
             fleet=_mk(FleetConfig, d.get("fleet", {})),
             ingest=_mk(IngestConfig, d.get("ingest", {})),
+            watchdog=_mk(WatchdogConfig, d.get("watchdog", {})),
         )

@@ -367,9 +367,17 @@ class DispatchRouter:
             prestaged=prestaged,
         )
         if record:
-            from ..obs.metrics import record_dispatch_route
+            from ..obs.metrics import record_dispatch_route, stage_seconds
 
             record_dispatch_route(info.route, info.windows, overlap_s)
+            # The device path as a first-class stage observation:
+            # per-host dispatch cost rides the fleet metrics delta
+            # (the coordinator's host/stage gauge) and the SLO
+            # watchdog can budget it like any pipeline stage
+            # (stage_budgets=("dispatch", ...)).
+            stage_seconds().observe(
+                info.dispatch_ms / 1e3, stage="dispatch"
+            )
         return outs, info
 
     def drop_prestaged(self) -> None:

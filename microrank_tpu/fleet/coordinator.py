@@ -42,7 +42,7 @@ import json
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import get_logger
 from .merge import fleet_watermark, merge_rankings
@@ -51,6 +51,23 @@ from .partition import split_partitions
 log = get_logger("microrank_tpu.fleet.coordinator")
 
 FLEET_INCIDENT_LOG = "incidents.jsonl"
+HOST_LEDGER_NAME = "metrics.json"
+
+
+class _JournalSink:
+    """Incident sink -> run journal bridge (the stream engine has its
+    own copy next to its jax-heavy imports; the coordinator re-declares
+    these ten lines rather than paying that import)."""
+
+    def __init__(self, journal):
+        self._journal = journal
+
+    def emit(self, event: dict) -> None:
+        rest = {k: v for k, v in event.items() if k != "event"}
+        try:
+            self._journal.emit(event["event"], **rest)
+        except Exception:  # noqa: BLE001 - telemetry stays best-effort
+            pass
 
 
 @dataclass
@@ -105,6 +122,70 @@ class FleetCoordinator:
             sinks=list(sinks or []),
         )
         self.out_dir = out_dir
+        # ------------------------------------------------ telemetry plane
+        from ..obs.fleetplane import FleetPlane
+
+        self.plane = FleetPlane(
+            expected_hosts=int(expected_workers) or int(fc.partitions),
+            grace=fc.host_series_grace,
+            max_skew_seconds=fc.max_clock_skew_seconds,
+        )
+        # The coordinator's own flight recorder: on incident open,
+        # self-incident, or worker death it dumps the coordinator ring
+        # and asks alive workers for theirs (piggybacked on heartbeat
+        # responses), cross-linked in the dump manifest.
+        self.flight = None
+        if out_dir is not None:
+            from ..obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(
+                out_dir, config.obs, journal=journal
+            )
+        self._flight_pending: Optional[str] = None
+        self._dump_requests: Dict[str, str] = {}
+        self._last_dump_req: Optional[float] = None
+        # The SLO self-watchdog: golden signals from the fleet view,
+        # breaches through an UNMODIFIED IncidentTracker of its own
+        # (self_incidents.jsonl, journal, webhook — like any fault).
+        self.watchdog = None
+        wc = getattr(config, "watchdog", None)
+        if wc is not None and wc.enabled:
+            from ..obs.watchdog import SELF_INCIDENT_LOG, SLOWatchdog
+
+            wd_sinks: List = []
+            if out_dir is not None:
+                from pathlib import Path as _Path
+
+                from ..stream.incidents import JsonlIncidentSink
+
+                wd_sinks.append(
+                    JsonlIncidentSink(_Path(out_dir) / SELF_INCIDENT_LOG)
+                )
+            if journal is not None:
+                wd_sinks.append(_JournalSink(journal))
+            if sc.webhook_url:
+                from ..stream.incidents import WebhookIncidentSink
+
+                wd_sinks.append(
+                    WebhookIncidentSink(
+                        sc.webhook_url,
+                        timeout=sc.webhook_timeout_seconds,
+                        retry_max=sc.webhook_retry_max,
+                        max_queue=sc.webhook_queue,
+                    )
+                )
+            self.watchdog = SLOWatchdog(
+                wc,
+                tracker=IncidentTracker(
+                    top_k=sc.fingerprint_top_k,
+                    resolve_after=wc.resolve_after_evals,
+                    cooldown_windows=wc.cooldown_evals,
+                    jaccard=sc.fingerprint_jaccard,
+                    score_drift=sc.fingerprint_score_drift,
+                    sinks=wd_sinks,
+                ),
+                view=self._fleet_view,
+            )
         from ..utils.guards import TrackedLock, register_shared
 
         self.workers: Dict[str, WorkerState] = {}
@@ -241,6 +322,10 @@ class FleetCoordinator:
         spans: int = 0,
         windows: int = 0,
         uptime_s: float = 0.0,
+        queue_depth: int = 0,
+        wall: Optional[float] = None,
+        rtt: Optional[float] = None,
+        metrics: Optional[dict] = None,
     ) -> dict:
         from ..obs.metrics import (
             record_fleet_heartbeat,
@@ -249,6 +334,7 @@ class FleetCoordinator:
 
         from ..utils.guards import note_shared_access
 
+        recv_wall = time.time()
         with self._lock:
             note_shared_access("fleet_coordinator")
             ws = self.workers.get(host_id)
@@ -267,20 +353,101 @@ class FleetCoordinator:
             ws.uptime_s = float(uptime_s)
             record_fleet_heartbeat(host_id)
             record_fleet_host_rate(host_id, ws.spans_per_second)
+            self._host_telemetry_locked(ws, queue_depth)
             self._reap_locked()
             self._seal_locked()
-            return self._status_locked(ws)
+            resp = self._status_locked(ws)
+            dump = self._dump_requests.pop(host_id, None)
+            if dump:
+                resp["dump"] = dump
+        # Plane work happens OUTSIDE the fleet lock: the plane has its
+        # own registered lock, and the delta fold walks metric samples
+        # — not something to hold the state machine through.
+        if wall is not None and rtt is not None:
+            try:
+                self.plane.note_clock(
+                    host_id, float(wall), float(rtt), recv_wall
+                )
+            except (TypeError, ValueError):
+                pass
+        if metrics is not None:
+            resp["metrics_ack"] = self.plane.ingest(host_id, metrics)
+        return resp
 
-    def report(self, host_id: str, window: dict) -> dict:
+    def _host_telemetry_locked(
+        self, ws: WorkerState, queue_depth: int
+    ) -> None:
+        """Per-host golden-signal gauges from one heartbeat: the
+        reporting host's engine queue depth, and every host's watermark
+        lag behind the fleet's FURTHEST front (event-time seconds — the
+        straggler signal the watchdog's lag budget watches)."""
+        from ..obs.metrics import (
+            record_fleet_host_lag,
+            record_fleet_host_queue,
+        )
+
+        record_fleet_host_queue(ws.host_id, int(queue_depth))
+        fronts = [
+            w.last_start_us
+            for w in self.workers.values()
+            if w.state in ("alive", "pending")
+            and w.last_start_us is not None
+        ]
+        if not fronts:
+            return
+        head = max(fronts)
+        for w in self.workers.values():
+            if w.last_start_us is not None and w.state != "done":
+                record_fleet_host_lag(
+                    w.host_id, (head - w.last_start_us) / 1e6
+                )
+
+    @staticmethod
+    def _window_ctx(window: object):
+        """The worker-side root span context a report carries (its
+        ``trace`` field) -> a SpanContext to parent-link coordinator
+        spans against, or None. Same window => same ``win-<start>``
+        trace id on every host, which is what makes the merged Perfetto
+        dump one causal chain across processes."""
+        from ..obs.spans import SpanContext
+
+        if not isinstance(window, dict):
+            return None
+        tr = window.get("trace")
+        if (
+            isinstance(tr, dict)
+            and tr.get("trace_id")
+            and tr.get("span_id")
+        ):
+            return SpanContext(str(tr["trace_id"]), str(tr["span_id"]))
+        return None
+
+    def report(
+        self,
+        host_id: str,
+        window: dict,
+        traceparent: Optional[Tuple[str, str]] = None,
+    ) -> dict:
         """One finalized window from one host. Idempotent per
         (host, window): re-reports after a resume dedup here, and
         reports for already-sealed windows drop as ``late`` — both
         counted, neither ever reaches the tracker twice."""
         from ..obs.metrics import record_fleet_report
+        from ..obs.spans import get_tracer
 
         from ..utils.guards import note_shared_access
 
-        with self._lock:
+        attrs = {"host": host_id}
+        if traceparent:
+            # The W3C header the worker sent — recorded so the span is
+            # joinable from standards-speaking tooling too.
+            attrs["w3c_trace"] = traceparent[0]
+        with get_tracer().span(
+            "report",
+            service="fleet",
+            ctx=self._window_ctx(window),
+            **attrs,
+        ), self._lock:
             note_shared_access("fleet_coordinator")
             ws = self.workers.get(host_id)
             if ws is None:
@@ -313,10 +480,19 @@ class FleetCoordinator:
             resp["report"] = status
             return resp
 
-    def goodbye(self, host_id: str) -> dict:
+    def goodbye(
+        self, host_id: str, metrics: Optional[dict] = None
+    ) -> dict:
         """Clean worker exit (finite source drained): the host stops
         blocking the fleet watermark without the lease having to age
-        out; when the LAST worker leaves, everything pending seals."""
+        out; when the LAST worker leaves, everything pending seals.
+        A final metrics delta rides the goodbye so the last beat's
+        increments land before the host goes silent (finalize still
+        reconciles against the on-disk ledger — this just narrows the
+        window a crash could lose)."""
+        if metrics is not None:
+            # Outside the fleet lock, like the heartbeat path.
+            self.plane.ingest(host_id, metrics)
         with self._lock:
             ws = self.workers.get(host_id)
             if ws is None:
@@ -373,11 +549,21 @@ class FleetCoordinator:
             ws.partitions = []
         self._rebalance_locked("lease_expired")
         self._workers_gauge_locked()
+        # A host death is a flight-recorder moment: capture the
+        # coordinator ring and ask the SURVIVORS for theirs (the dead
+        # host can't answer; its last on-disk dump still merges into
+        # the fleet trace at finalize). The dump itself happens in
+        # service_flight, outside this lock.
+        if self.flight is not None:
+            self._flight_pending = self._flight_pending or "worker-dead"
+        self._request_dumps_locked("worker-dead")
 
     # ----------------------------------------------------------- sealing
     def _seal_locked(self, flush: bool = False) -> None:
         from ..obs.metrics import record_fleet_sealed
+        from ..obs.spans import get_tracer
 
+        tracer = get_tracer()
         while self._slots:
             start_us = min(self._slots)
             if not flush:
@@ -396,14 +582,54 @@ class FleetCoordinator:
             start = next(iter(reports.values())).get("start") or str(
                 start_us
             )
-            if ranked:
-                merged = merge_rankings(r.get("ranking") for r in ranked)
-                outcome = "ranked"
-                self.tracker.observe_ranked(start, merged)
-            else:
-                merged = []
-                outcome = "healthy"
-                self.tracker.observe_healthy(start)
+            # Seal under the window's OWN trace: any report's carried
+            # worker-root context (ranked first — an incident's chain
+            # should hang off a ranked host) parents the coordinator's
+            # seal -> merge -> incident spans into the same
+            # ``win-<start>`` trace the workers recorded into.
+            ctx = next(
+                filter(None, (self._window_ctx(r) for r in ranked)),
+                None,
+            ) or next(
+                filter(
+                    None,
+                    (self._window_ctx(r) for r in reports.values()),
+                ),
+                None,
+            )
+            opened_before = self.tracker.opened
+            with tracer.span(
+                "seal",
+                service="fleet",
+                ctx=ctx,
+                start=start,
+                hosts=len(reports),
+            ):
+                if ranked:
+                    with tracer.span(
+                        "merge", service="fleet", ranked_hosts=len(ranked)
+                    ):
+                        merged = merge_rankings(
+                            r.get("ranking") for r in ranked
+                        )
+                    outcome = "ranked"
+                    with tracer.span("incident", service="fleet"):
+                        self.tracker.observe_ranked(start, merged)
+                else:
+                    merged = []
+                    outcome = "healthy"
+                    with tracer.span("incident", service="fleet"):
+                        self.tracker.observe_healthy(start)
+            if self.tracker.opened > opened_before:
+                # A fleet incident just opened: dump the coordinator
+                # ring and ask every live worker for its ring — the
+                # cross-linked dumps are what finalize merges into one
+                # cross-host trace of the faulted window.
+                if self.flight is not None:
+                    self._flight_pending = (
+                        self._flight_pending or "incident"
+                    )
+                self._request_dumps_locked("incident")
             record_fleet_sealed(outcome)
             self.sealed.append(
                 {
@@ -424,6 +650,152 @@ class FleetCoordinator:
                 ranked_hosts=len(ranked),
                 top=[[n, float(s)] for n, s in merged[:5]],
             )
+
+    # ------------------------------------------------- telemetry plane
+    def _fleet_view(self):
+        """The federated registry: the coordinator's own process
+        registry (fleet_* counters, per-host breakdown gauges) merged
+        with every host's folded cum."""
+        from ..obs.registry import get_registry
+
+        return self.plane.fleet_view([("coordinator", get_registry())])
+
+    def fleet_metrics_text(self) -> str:
+        """GET /fleetz/metrics: the fleet view in Prometheus text
+        exposition."""
+        return self._fleet_view().to_prometheus()
+
+    def _request_dumps_locked(self, reason: str) -> None:
+        """Flag every live worker for a flight dump on its next
+        heartbeat response. Rate-limited by the flight min-interval so
+        an incident flap cannot stampede N hosts into disk writes (each
+        worker's own recorder rate-limits again on its side)."""
+        now = self.clock()
+        min_gap = max(
+            0.0, float(self.config.obs.flight_min_interval_seconds)
+        )
+        if (
+            self._last_dump_req is not None
+            and now - self._last_dump_req < min_gap
+        ):
+            return
+        self._last_dump_req = now
+        for ws in self.workers.values():
+            if ws.state == "alive":
+                self._dump_requests[ws.host_id] = reason
+
+    def service_flight(self) -> None:
+        """Perform any pending coordinator flight dump OUTSIDE the
+        fleet lock (a dump writes trace/journal/metrics files — never
+        under the state machine's lock). Driven by the server's reaper
+        thread and by finalize; the manifest's ``fleet`` key
+        cross-links the worker rings the coordinator asked for."""
+        if self.flight is None:
+            return
+        with self._lock:
+            reason, self._flight_pending = self._flight_pending, None
+            if not reason:
+                return
+            hosts = {h: ws.state for h, ws in self.workers.items()}
+            requested = dict(self._dump_requests)
+        try:
+            self.flight.dump(
+                f"fleet-{reason}",
+                extra={
+                    "reason": reason,
+                    "hosts": hosts,
+                    "worker_dumps_requested": requested,
+                    "clock_offsets_s": self.plane.offsets(),
+                },
+            )
+        except Exception:  # noqa: BLE001 - telemetry stays best-effort
+            log.exception("fleet flight dump failed")
+
+    def watchdog_tick(self, force: bool = False) -> None:
+        """One SLO self-watchdog evaluation (reaper thread, OUTSIDE the
+        fleet lock — the watchdog reads the plane's merged view under
+        the plane's own lock). A newly opened self-incident is a flight
+        moment exactly like a fleet incident."""
+        if self.watchdog is None:
+            return
+        opened_before = self.watchdog.tracker.opened
+        try:
+            self.watchdog.evaluate(force=force)
+        except Exception:  # noqa: BLE001 - the watchdog must not kill
+            log.exception("SLO watchdog evaluation failed")
+            return
+        if self.watchdog.tracker.opened > opened_before:
+            with self._lock:
+                if self.flight is not None:
+                    self._flight_pending = (
+                        self._flight_pending or "slo-breach"
+                    )
+                self._request_dumps_locked("slo-breach")
+
+    def _reconcile_ledgers(self) -> None:
+        """Durable state wins: replace each host's folded heartbeat
+        deltas with its on-disk ``metrics.json`` ledger, so the fleet
+        totals equal the per-host ledger sums EXACTLY (an in-flight
+        delta that raced the worker's exit cannot leave them apart)."""
+        if self.out_dir is None:
+            return
+        from pathlib import Path
+
+        base = Path(self.out_dir)
+        for host in set(self.plane.host_names()) | set(self.workers):
+            ledger = base / host / HOST_LEDGER_NAME
+            try:
+                doc = json.loads(ledger.read_text())
+            except (OSError, ValueError):
+                continue
+            self.plane.reconcile(host, doc)
+
+    def write_fleet_artifacts(self) -> Dict[str, str]:
+        """End-of-run fleet telemetry: the ledger-reconciled fleet
+        metrics snapshot (``metrics.{prom,json}`` at the fleet root),
+        the clock-offset-corrected merged ``fleet_journal.jsonl``, and
+        the cross-host ``fleet_trace.json``. Returns artifact paths."""
+        if self.out_dir is None:
+            return {}
+        from pathlib import Path
+
+        from ..obs.fleetplane import (
+            write_fleet_journal,
+            write_fleet_trace,
+        )
+        from ..obs.spans import get_tracer
+
+        out = Path(self.out_dir)
+        self._reconcile_ledgers()
+        paths: Dict[str, str] = {}
+        try:
+            self._fleet_view().write_snapshot(out)
+            paths["metrics"] = str(out / "metrics.prom")
+        except OSError:
+            log.exception("fleet metrics snapshot failed")
+        offsets = self.plane.offsets()
+        host_dirs = {
+            h: out / h
+            for h in set(self.plane.host_names()) | set(self.workers)
+            if (out / h).is_dir()
+        }
+        try:
+            p = write_fleet_journal(out, host_dirs, offsets)
+            if p is not None:
+                paths["journal"] = str(p)
+        except OSError:
+            log.exception("fleet journal merge failed")
+        try:
+            p = write_fleet_trace(
+                out, get_tracer().snapshot(), host_dirs, offsets
+            )
+            if p is not None:
+                paths["trace"] = str(p)
+        except OSError:
+            log.exception("fleet trace merge failed")
+        if paths:
+            self._journal("fleet_artifacts", **paths)
+        return paths
 
     # ------------------------------------------------------------ status
     def status(self) -> dict:
@@ -453,7 +825,10 @@ class FleetCoordinator:
 
     def finalize(self) -> dict:
         """End of run: seal everything pending, journal per-host rates
-        and the run summary. Returns the final status dict."""
+        and the run summary, drain any pending flight dump. Returns the
+        final status dict. (The launcher calls write_fleet_artifacts
+        separately, AFTER the worker processes are reaped — their
+        ledgers and last flight dumps must be on disk first.)"""
         with self._lock:
             self._seal_locked(flush=True)
             for ws in self.workers.values():
@@ -465,6 +840,8 @@ class FleetCoordinator:
                     windows=ws.windows,
                     spans_per_second=round(ws.spans_per_second, 2),
                 )
+        self.watchdog_tick(force=True)
+        self.service_flight()
         return self.status()
 
 
@@ -487,8 +864,18 @@ class FleetServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.partition("?")[0] == "/fleetz":
+                path = self.path.partition("?")[0]
+                if path == "/fleetz":
                     self._reply(200, coord.status())
+                elif path == "/fleetz/metrics":
+                    body = coord.fleet_metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 
@@ -511,11 +898,25 @@ class FleetServer:
                         spans=int(doc.get("spans", 0)),
                         windows=int(doc.get("windows", 0)),
                         uptime_s=float(doc.get("uptime_s", 0.0)),
+                        queue_depth=int(doc.get("queue_depth", 0)),
+                        wall=doc.get("wall"),
+                        rtt=doc.get("rtt"),
+                        metrics=doc.get("metrics"),
                     )
                 elif route == "/report":
-                    resp = coord.report(host_id, doc.get("window") or {})
+                    from ..serve.protocol import parse_traceparent
+
+                    resp = coord.report(
+                        host_id,
+                        doc.get("window") or {},
+                        traceparent=parse_traceparent(
+                            self.headers.get("traceparent")
+                        ),
+                    )
                 elif route == "/goodbye":
-                    resp = coord.goodbye(host_id)
+                    resp = coord.goodbye(
+                        host_id, metrics=doc.get("metrics")
+                    )
                 else:
                     self.send_error(404)
                     return
@@ -547,6 +948,11 @@ class FleetServer:
         while not self._stop.wait(tick):
             try:
                 self.coordinator.tick()
+                # Reaper doubles as the telemetry heartbeat: SLO
+                # watchdog evals (rate-limited internally) and any
+                # pending flight dump, both outside the fleet lock.
+                self.coordinator.watchdog_tick()
+                self.coordinator.service_flight()
             except Exception:  # noqa: BLE001 - the reaper must survive
                 log.exception("fleet reaper tick failed")
 

@@ -127,6 +127,12 @@ def run_local_fleet(config, args) -> int:
     out_dir = Path(args.output)
     out_dir.mkdir(parents=True, exist_ok=True)
     ensure_catalog()
+    # The coordinator records its own spans (report/seal/merge/incident,
+    # parent-linked into the workers' window traces) — arm the process
+    # tracer exactly like the worker entry points do.
+    from ..obs.spans import configure_tracer
+
+    configure_tracer(config.obs)
 
     journal = None
     sinks = [StdoutIncidentSink()]
@@ -235,9 +241,13 @@ def run_local_fleet(config, args) -> int:
             )
             journal.sync()
         if config.runtime.telemetry:
-            from ..obs import get_registry
-
-            get_registry().write_snapshot(out_dir)
+            # The fleet view replaces the old coordinator-only
+            # snapshot: every worker's ledger is on disk by now (the
+            # supervision loop only exits once the processes are
+            # reaped), so the merged metrics.{prom,json}, the
+            # offset-corrected fleet journal and the cross-host
+            # Perfetto trace all reconcile against durable state.
+            coordinator.write_fleet_artifacts()
         server.shutdown()
 
     failed = [w for w in workers if w.exit_code != 0]

@@ -98,10 +98,24 @@ class CoordinatorClient:
         if action is not None:
             # Non-raising kinds (e.g. "drop") simulate the same loss.
             raise InjectedFault("coordinator_unreachable", action["kind"])
+        headers = {"Content-Type": "application/json"}
+        # W3C trace propagation: the ambient span context (the window's
+        # ``win-<start>`` trace during a report) rides the wire, so the
+        # coordinator's spans join the SAME trace the worker's stages
+        # recorded under.
+        from ..obs.spans import SpanTracer
+
+        ctx = SpanTracer.current_context()
+        if ctx is not None:
+            from ..serve.protocol import format_traceparent
+
+            headers["traceparent"] = format_traceparent(
+                ctx.trace_id, ctx.span_id
+            )
         req = urllib.request.Request(
             f"{self.url}{route}",
             data=json.dumps({"host": self.host_id, **payload}).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
@@ -127,10 +141,16 @@ class CoordinatorClient:
         )
 
     def heartbeat(
-        self, spans: int, windows: int, uptime_s: float
+        self,
+        spans: int,
+        windows: int,
+        uptime_s: float,
+        extra: Optional[dict] = None,
     ) -> Optional[dict]:
         """Best-effort lease renewal; a failure is counted by the
-        caller, never raised (the next beat retries naturally)."""
+        caller, never raised (the next beat retries naturally).
+        ``extra`` piggybacks the telemetry-plane fields (metrics
+        delta, wall clock, rtt, queue depth)."""
         try:
             return self._post(
                 "/heartbeat",
@@ -138,6 +158,7 @@ class CoordinatorClient:
                     "spans": int(spans),
                     "windows": int(windows),
                     "uptime_s": float(uptime_s),
+                    **(extra or {}),
                 },
             )
         except Exception as e:  # noqa: BLE001 - heartbeats are lossy
@@ -221,10 +242,10 @@ class CoordinatorClient:
             with self._lock:
                 self._draining = False
 
-    def goodbye(self) -> None:
+    def goodbye(self, extra: Optional[dict] = None) -> None:
         try:
             self.flush()
-            self._post("/goodbye", {})
+            self._post("/goodbye", dict(extra or {}))
         except Exception as e:  # noqa: BLE001 - exit is best-effort
             log.warning("goodbye failed: %s", e)
 
@@ -301,6 +322,20 @@ class FleetTracker:
             log.warning("chaos host_kill: exiting hard (os._exit 137)")
             os._exit(137)
         self._window_no += 1
+        # The engine calls us inside its per-window "incident" span, so
+        # the ambient context carries this window's ``win-<start>``
+        # trace — ship it with the report and the coordinator's
+        # seal/merge/incident spans parent-link into the SAME trace.
+        from ..obs.spans import SpanTracer
+
+        ctx = SpanTracer.current_context()
+        if ctx is not None:
+            window = {
+                **window,
+                "trace": {
+                    "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+                },
+            }
         self.apply_status(self.client.report(window))
 
     def observe_ranked(self, window_start: str, ranking, on_open=None):
@@ -351,19 +386,62 @@ class FleetTracker:
 class _HeartbeatLoop(threading.Thread):
     def __init__(self, client: CoordinatorClient, engine,
                  assignment: PartitionSet, tracker: FleetTracker,
-                 interval: float):
+                 interval: float, metrics_sender=None):
         super().__init__(name="mr-fleet-heartbeat", daemon=True)
         self.client = client
         self.engine = engine
         self.assignment = assignment
         self.tracker = tracker
         self.interval = max(0.05, float(interval))
+        # Telemetry-plane piggyback: the delta sender lives on THIS
+        # thread only (build -> send -> ack, single-threaded protocol
+        # state; the registry it reads is itself thread-safe).
+        self.metrics_sender = metrics_sender
         self.beats = 0
         self.drops = 0
+        self.last_rtt = 0.0
         self._t0 = time.monotonic()
         # NB: not ``_stop`` — threading.Thread has a private method of
         # that name and shadowing it breaks join().
         self._halt = threading.Event()
+
+    def _telemetry(self) -> dict:
+        """The heartbeat's telemetry-plane fields: wall clock + the
+        previous beat's RTT (the coordinator's clock-offset estimator),
+        pipeline queue depth, and the metrics delta when armed."""
+        from ..obs import get_registry
+
+        extra = {
+            "wall": time.time(),
+            "rtt": round(self.last_rtt, 6),
+            "queue_depth": int(getattr(self.engine, "queue_depth", 0)),
+        }
+        if self.metrics_sender is not None:
+            try:
+                extra["metrics"] = self.metrics_sender.payload(
+                    get_registry()
+                )
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                log.exception("metrics delta build failed; beat sent bare")
+        return extra
+
+    def _apply(self, resp: dict) -> None:
+        self.tracker.apply_status(resp)
+        self.assignment.set(resp.get("partitions", []))
+        if self.metrics_sender is not None:
+            self.metrics_sender.handle_ack(resp.get("metrics_ack"))
+        reason = resp.get("dump")
+        if reason and getattr(self.engine, "flight", None) is not None:
+            # Coordinator asked for this host's ring (incident open or
+            # a peer died): best-effort, the recorder's own rate limit
+            # caps a storm of requests.
+            safe = "".join(
+                c if c.isalnum() or c in "-_" else "-" for c in str(reason)
+            )[:48]
+            try:
+                self.engine.flight.dump(f"fleet-{safe}")
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                log.exception("requested flight dump failed")
 
     def run(self) -> None:
         from ..chaos.faults import maybe_inject
@@ -373,15 +451,17 @@ class _HeartbeatLoop(threading.Thread):
                 self.drops += 1
                 continue
             summary = self.engine.summary
+            t0 = time.monotonic()
             resp = self.client.heartbeat(
                 spans=getattr(summary, "spans", 0),
                 windows=summary.windows,
                 uptime_s=time.monotonic() - self._t0,
+                extra=self._telemetry(),
             )
             if resp is not None:
+                self.last_rtt = time.monotonic() - t0
                 self.beats += 1
-                self.tracker.apply_status(resp)
-                self.assignment.set(resp.get("partitions", []))
+                self._apply(resp)
 
     def stop(self) -> None:
         self._halt.set()
@@ -436,16 +516,38 @@ def run_fleet_worker(
     )
     if on_engine is not None:
         on_engine(engine)   # e.g. the CLI's SIGTERM drain hook
+    sender = None
+    if fc.metrics_in_heartbeat:
+        from ..obs.fleetplane import MetricsDeltaSender
+
+        sender = MetricsDeltaSender(host_id, max_bytes=fc.delta_max_bytes)
     heartbeat = _HeartbeatLoop(
         client, engine, assignment, tracker,
         interval=float(hello.get("heartbeat_seconds", fc.heartbeat_seconds)),
+        metrics_sender=sender,
     )
     heartbeat.start()
     try:
         summary = engine.run()
     finally:
         heartbeat.stop()
-        client.goodbye()
+        # The sender's protocol state is single-threaded (heartbeat
+        # thread only), so wait for the loop to exit before the final
+        # delta; a beat wedged in a slow send just forfeits it.
+        heartbeat.join(timeout=2.0 * fc.report_timeout_seconds + 2.0)
+        extra = {}
+        if sender is not None and not heartbeat.is_alive():
+            # Final delta rides the goodbye (the engine already wrote
+            # the per-host ledger; this keeps the LIVE view current).
+            from ..obs import get_registry
+
+            try:
+                extra["metrics"] = sender.payload(get_registry())
+                extra["wall"] = time.time()
+                extra["rtt"] = round(heartbeat.last_rtt, 6)
+            except Exception:  # noqa: BLE001 - exit is best-effort
+                pass
+        client.goodbye(extra)
     log.info(
         "fleet worker %s done: %d windows (%d ranked), %d spans, "
         "%d reports sent, %d still buffered",

@@ -8,7 +8,11 @@ see ``registry`` (Counter/Gauge/Histogram + Prometheus/JSON exposition),
 ``spans`` (the self-tracing span ring + trace-context propagation),
 ``flight`` (the incident flight recorder) and ``profiler``
 (sampled jax.profiler sessions + HBM gauges). ``cli stats`` re-exposes
-a finished run's snapshot offline.
+a finished run's snapshot offline. The fleet tier federates all of it:
+``fleetplane`` (heartbeat metrics deltas folded into one fleet
+registry, merged fleet journal + cross-process Perfetto trace) and
+``watchdog`` (multi-window burn-rate SLO evaluation over the fleet
+registry, opening self-incidents through the stream tracker).
 """
 
 from .flight import FLIGHT_DIR, FlightRecorder
@@ -28,6 +32,7 @@ from .registry import (
     MetricsRegistry,
     diff_registries,
     get_registry,
+    merge_registries,
     registry_from_json,
     set_registry,
 )
@@ -59,6 +64,7 @@ __all__ = [
     "emit_current",
     "get_registry",
     "get_tracer",
+    "merge_registries",
     "read_journal",
     "registry_from_json",
     "set_current_journal",

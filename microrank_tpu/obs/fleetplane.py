@@ -1,0 +1,562 @@
+"""The fleet telemetry plane: federated metrics, merged journal, one
+cross-process trace.
+
+PR 11's fleet tier was telemetry-blind: N workers each wrote their own
+``metrics.prom`` / ``journal.jsonl`` / span ring and nothing aggregated
+or correlated them. This module is the aggregation law plus the wire
+protocol, in three pieces:
+
+* **Delta protocol** (worker side: :class:`MetricsDeltaSender`): each
+  heartbeat piggybacks a compact, versioned, CRC'd metrics delta —
+  ``diff_registries`` of the live registry against the last
+  coordinator-ACKED baseline, under a monotonic per-incarnation
+  sequence number. The payload is IMMUTABLE until acked (a lost ack
+  retransmits the same bytes), and the baseline advances by exactly
+  what was sent, so increments that arrive between build and ack — or
+  whole metrics dropped to fit the byte bound — ride the next delta.
+  Exactly-once folding falls out: the coordinator applies seq == next,
+  counts a retransmit of an applied seq as ``stale`` without folding,
+  and answers an out-of-sync sender with a ``resync`` that restarts
+  the exchange from a full snapshot (checkpoint rule: reject whole,
+  never fold a suspect delta).
+
+* **Fold + view** (coordinator side: :class:`FleetPlane`): per-host
+  cumulative registries, folded under the cardinality cap
+  (``expected_hosts + grace`` distinct hosts; overflow refused whole
+  and counted), merged on demand with ``merge_registries`` into the
+  fleet view served at ``GET /fleetz/metrics`` and snapshotted as the
+  launcher's fleet ``metrics.{prom,json}``. At finalize each host's
+  folded state is RECONCILED against its on-disk ``metrics.json``
+  ledger — durable state wins, so the fleet totals equal the per-host
+  ledger sums exactly.
+
+* **Correlation** (:func:`write_fleet_journal`,
+  :func:`write_fleet_trace`): per-host journals and flight-dump traces
+  merge into ONE fleet journal / ONE Perfetto trace, per-host
+  timestamps corrected by the heartbeat-RTT-estimated clock offset
+  (``offset = worker_wall + rtt/2 - coordinator_recv``, EWMA'd and
+  clamped — the ingest skew-repair math pointed at our own telemetry).
+  Workers share the window trace id (``win-<start>``), so the merged
+  trace shows worker build -> report -> coordinator seal -> merge ->
+  incident as one causal chain across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zlib
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    diff_registries,
+    merge_registries,
+    registry_from_json,
+)
+
+log = get_logger("microrank_tpu.obs.fleetplane")
+
+DELTA_VERSION = 1
+FLEET_JOURNAL_NAME = "fleet_journal.jsonl"
+FLEET_TRACE_NAME = "fleet_trace.json"
+
+__all__ = [
+    "DELTA_VERSION",
+    "FLEET_JOURNAL_NAME",
+    "FLEET_TRACE_NAME",
+    "FleetPlane",
+    "MetricsDeltaSender",
+    "fold_into",
+    "histogram_quantile",
+    "write_fleet_journal",
+    "write_fleet_trace",
+]
+
+
+def _canonical(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+
+
+def delta_crc(metrics_doc: dict) -> int:
+    """CRC32 over the canonical serialization of a delta's metrics doc
+    (the torn-payload detector; JSON reordering is not a tear)."""
+    return zlib.crc32(_canonical(metrics_doc)) & 0xFFFFFFFF
+
+
+def fold_into(dst: MetricsRegistry, src: MetricsRegistry) -> None:
+    """Accumulate ``src`` into ``dst`` in place: counters and histogram
+    buckets ADD, gauges take ``src``'s reading per label set (a delta's
+    gauge sample is the newest point-in-time reading). The worker-side
+    baseline advance and the coordinator-side cum fold share this one
+    law, which is what makes base + sent_delta == snapshot-at-build."""
+    for m in src.metrics():
+        try:
+            if isinstance(m, Counter):
+                c = dst.counter(m.name, m.help, m.labelnames)
+                for s in m.samples():
+                    v = float(s["value"])
+                    if v > 0:
+                        c.inc(v, **s["labels"])
+            elif isinstance(m, Histogram):
+                h = dst.histogram(m.name, m.help, m.labelnames, m.buckets)
+                if h.buckets != m.buckets:
+                    continue
+                for s in m.samples():
+                    key = h._key(s["labels"])
+                    with h._lock:
+                        st = h._values.get(key)
+                        if st is None:
+                            st = h._values[key] = {
+                                "counts": [0] * len(s["buckets"]),
+                                "sum": 0.0,
+                                "count": 0,
+                            }
+                        st["counts"] = [
+                            a + b
+                            for a, b in zip(st["counts"], s["buckets"])
+                        ]
+                        st["sum"] += float(s["sum"])
+                        st["count"] += int(s["count"])
+            elif isinstance(m, Gauge):
+                g = dst.gauge(m.name, m.help, m.labelnames)
+                for s in m.samples():
+                    g.set(float(s["value"]), **s["labels"])
+        except (ValueError, TypeError):
+            continue  # shape conflict: skip the metric, not the fold
+
+
+def histogram_quantile(
+    bounds: Sequence[float], counts: Sequence[int], q: float
+) -> float:
+    """Prometheus-style quantile estimate from per-bucket counts
+    (NON-cumulative, overflow bucket last): linear interpolation inside
+    the target bucket; the overflow bucket answers its lower bound (the
+    largest claim the data supports). The merge property test uses this
+    to check that federated histograms answer quantile queries within
+    one bucket of the single-registry run."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = max(0.0, min(1.0, float(q))) * total
+    cum = 0
+    for i, n in enumerate(counts):
+        if n <= 0:
+            continue
+        if cum + n >= target:
+            if i >= len(bounds):  # overflow bucket
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * ((target - cum) / n)
+        cum += n
+    return float(bounds[-1])
+
+
+# ---------------------------------------------------------------------------
+# Worker side: the delta sender
+
+
+def _prune_zero_deltas(doc: dict) -> None:
+    """Drop zero-delta counter/histogram series (and then-empty
+    metrics) from a delta document in place. Folding a zero is a
+    no-op, so pruning changes nothing semantically — but it keeps the
+    steady-state heartbeat small and, crucially, lets a truncated
+    metric actually FIT the retry delta instead of riding alongside a
+    payload-sized echo of unchanged series. Gauges are instantaneous
+    readings and always ship."""
+    metrics = doc.get("metrics", {})
+    for name in list(metrics):
+        m = metrics[name]
+        kind = m.get("type")
+        if kind == "counter":
+            m["samples"] = [
+                s for s in m.get("samples", ()) if float(s["value"]) != 0.0
+            ]
+        elif kind == "histogram":
+            m["samples"] = [
+                s for s in m.get("samples", ()) if int(s["count"]) != 0
+            ]
+        else:
+            continue
+        if not m["samples"]:
+            del metrics[name]
+
+
+class MetricsDeltaSender:
+    """Builds the heartbeat's metrics-delta payload and advances the
+    acked baseline. Single-threaded by design: only the heartbeat loop
+    calls it (the registry it reads IS thread-safe)."""
+
+    def __init__(self, host_id: str, max_bytes: int = 262144):
+        self.host_id = host_id
+        self.max_bytes = max(1024, int(max_bytes))
+        # Per-incarnation epoch: a restarted worker starts a fresh
+        # sequence space; the coordinator folds the new incarnation's
+        # deltas on top of the old cum (counters keep growing across a
+        # rejoin, exactly like the fleet's exactly-once window story).
+        import os
+
+        self.epoch = f"{os.getpid():x}-{int(time.time() * 1e3) & 0xFFFFFF:x}"
+        self._base = MetricsRegistry()
+        self._seq = 0
+        self._pending: Optional[dict] = None
+        self._sent: Optional[MetricsRegistry] = None
+        self.truncated = 0
+
+    def payload(self, registry: MetricsRegistry) -> dict:
+        """The delta to piggyback on this heartbeat. While an earlier
+        delta is unacked the SAME payload retransmits — never a
+        recomputed one, so the coordinator's fold and our baseline
+        advance agree on exactly which increments were delivered."""
+        if self._pending is not None:
+            return self._pending
+        delta = diff_registries(self._base, registry)
+        doc = delta.to_json()
+        doc.pop("ts", None)
+        _prune_zero_deltas(doc)
+        dropped: List[str] = []
+        body = _canonical(doc)
+        while len(body) > self.max_bytes and doc["metrics"]:
+            # Oversize: shed whole metrics, largest serialization
+            # first. Their increments are NOT lost — the baseline only
+            # advances by what this payload carries.
+            name = max(
+                doc["metrics"],
+                key=lambda n: len(_canonical(doc["metrics"][n])),
+            )
+            dropped.append(name)
+            del doc["metrics"][name]
+            body = _canonical(doc)
+        if dropped:
+            self.truncated += len(dropped)
+        self._sent = registry_from_json(doc)
+        self._pending = {
+            "v": DELTA_VERSION,
+            "epoch": self.epoch,
+            "seq": self._seq,
+            "metrics": doc,
+            "crc": delta_crc(doc),
+            "truncated": len(dropped),
+        }
+        return self._pending
+
+    def handle_ack(self, ack: Optional[dict]) -> None:
+        if not isinstance(ack, dict):
+            return
+        if ack.get("resync"):
+            # Coordinator lost our baseline: restart from a full
+            # snapshot (empty base -> next delta carries the whole
+            # cum; the coordinator REPLACES its cum when it lands).
+            self._base = MetricsRegistry()
+            self._seq = int(ack.get("ack", 0))
+            self._pending = None
+            self._sent = None
+            return
+        if self._pending is None:
+            return
+        if int(ack.get("ack", -1)) >= self._seq + 1:
+            if self._sent is not None:
+                fold_into(self._base, self._sent)
+            self._seq += 1
+            self._pending = None
+            self._sent = None
+
+
+# ---------------------------------------------------------------------------
+# Coordinator side: the fold
+
+
+class _HostPlane:
+    __slots__ = (
+        "epoch", "next_seq", "cum", "replace_next",
+        "offset_s", "offset_init",
+    )
+
+    def __init__(self) -> None:
+        self.epoch: Optional[str] = None
+        self.next_seq = 0
+        self.cum = MetricsRegistry()
+        self.replace_next = False
+        self.offset_s = 0.0
+        self.offset_init = False
+
+
+class FleetPlane:
+    """Coordinator-side federated registry + clock-offset estimator."""
+
+    def __init__(
+        self,
+        expected_hosts: int = 0,
+        grace: int = 2,
+        max_skew_seconds: float = 5.0,
+    ):
+        from ..utils.guards import TrackedLock, register_shared
+
+        self.expected_hosts = max(0, int(expected_hosts))
+        self.grace = max(0, int(grace))
+        self.max_skew_seconds = max(0.0, float(max_skew_seconds))
+        # HTTP handler threads (heartbeat/goodbye deltas) and the
+        # finalize path funnel through one lock.
+        self._lock = TrackedLock("fleet_plane")
+        register_shared("fleet_plane", {"fleet_plane"})
+        self._hosts: Dict[str, _HostPlane] = {}
+
+    # ------------------------------------------------------------ deltas
+    def _admit_locked(self, host: str) -> Optional[_HostPlane]:
+        hp = self._hosts.get(host)
+        if hp is None:
+            cap = self.expected_hosts + self.grace
+            if self.expected_hosts and len(self._hosts) >= cap:
+                return None
+            hp = self._hosts[host] = _HostPlane()
+        return hp
+
+    def ingest(self, host: str, payload: object) -> dict:
+        """Fold one heartbeat delta; returns the ``metrics_ack`` dict
+        for the heartbeat response. Rejections are WHOLE (a torn or
+        out-of-order delta never half-poisons the fleet totals) and
+        every disposition is counted."""
+        from .metrics import (
+            record_fleet_delta,
+            record_fleet_host_stage,
+            record_fleet_series_dropped,
+        )
+        from ..utils.guards import note_shared_access
+
+        if not isinstance(payload, dict):
+            record_fleet_delta("rejected")
+            return {"ack": 0}
+        with self._lock:
+            note_shared_access("fleet_plane")
+            hp = self._admit_locked(str(host))
+            if hp is None:
+                record_fleet_series_dropped()
+                return {"ack": 0, "dropped": True}
+            if int(payload.get("v", -1)) != DELTA_VERSION:
+                record_fleet_delta("version")
+                return {"ack": hp.next_seq}
+            epoch = str(payload.get("epoch", ""))
+            if hp.epoch != epoch:
+                # New worker incarnation: fresh sequence space, same
+                # cum (counters accumulate across a rejoin).
+                hp.epoch = epoch
+                hp.next_seq = 0
+                hp.replace_next = False
+            doc = payload.get("metrics")
+            if not isinstance(doc, dict) or (
+                delta_crc(doc) != int(payload.get("crc", -1))
+            ):
+                record_fleet_delta("torn")
+                return {"ack": hp.next_seq}
+            seq = int(payload.get("seq", -1))
+            if seq < hp.next_seq:
+                record_fleet_delta("stale")
+                return {"ack": hp.next_seq}
+            if seq > hp.next_seq:
+                # We never acked what the sender thinks we did —
+                # restart the exchange from a full snapshot.
+                record_fleet_delta("ahead")
+                hp.next_seq = 0
+                hp.replace_next = True
+                return {"ack": 0, "resync": True}
+            delta = registry_from_json(doc)
+            if hp.replace_next:
+                hp.cum = MetricsRegistry()
+                hp.replace_next = False
+            fold_into(hp.cum, delta)
+            hp.next_seq += 1
+            record_fleet_delta("applied")
+            if int(payload.get("truncated", 0)) > 0:
+                record_fleet_delta("truncated")
+            ack = {"ack": hp.next_seq}
+        # Per-host recent stage cost, derived from the DELTA's
+        # stage_seconds histogram (sum/count over just this beat's
+        # observations — the cost signal ROADMAP item 3's placement
+        # needs, not the run-diluted mean). Outside the plane lock:
+        # plain registry writes.
+        st = delta.get("microrank_stage_seconds")
+        if isinstance(st, Histogram):
+            for s in st.samples():
+                if int(s["count"]) > 0:
+                    record_fleet_host_stage(
+                        str(host),
+                        s["labels"].get("stage", ""),
+                        1e3 * float(s["sum"]) / int(s["count"]),
+                    )
+        return ack
+
+    # ------------------------------------------------------------- clocks
+    def note_clock(
+        self, host: str, wall: float, rtt: float, recv_wall: float
+    ) -> None:
+        """EWMA the host-clock offset estimate from one heartbeat:
+        ``offset = worker_wall + rtt/2 - coordinator_recv`` (positive =
+        the host's clock runs ahead of ours)."""
+        from ..utils.guards import note_shared_access
+
+        raw = float(wall) + float(rtt) / 2.0 - float(recv_wall)
+        with self._lock:
+            note_shared_access("fleet_plane")
+            hp = self._admit_locked(str(host))
+            if hp is None:
+                return
+            if not hp.offset_init:
+                hp.offset_s, hp.offset_init = raw, True
+            else:
+                hp.offset_s += 0.3 * (raw - hp.offset_s)
+
+    def offsets(self) -> Dict[str, float]:
+        """Per-host clock offsets, clamped to the skew bound (the
+        ingest skew-repair rule: correct what is plausibly skew, never
+        chase an implausible clock)."""
+        b = self.max_skew_seconds
+        with self._lock:
+            return {
+                h: max(-b, min(b, hp.offset_s))
+                for h, hp in self._hosts.items()
+                if hp.offset_init
+            }
+
+    # -------------------------------------------------------------- views
+    def fleet_view(
+        self, extra: Sequence[Tuple[str, MetricsRegistry]] = ()
+    ) -> MetricsRegistry:
+        """The federated registry: coordinator-side sources first (its
+        own process registry carries the fleet_* counters and per-host
+        breakdown gauges), then each host's folded cum in name order."""
+        with self._lock:
+            hosts = sorted(self._hosts.items())
+            sources = list(extra) + [(h, hp.cum) for h, hp in hosts]
+        return merge_registries(sources)
+
+    def reconcile(self, host: str, ledger: dict) -> None:
+        """Replace a host's folded cum with its on-disk snapshot (the
+        finalize path): the ledger a worker wrote at engine drain is
+        the durable truth, and live deltas that raced the exit must
+        not make the fleet totals disagree with the per-host sums."""
+        from ..utils.guards import note_shared_access
+
+        reg = registry_from_json(ledger)
+        with self._lock:
+            note_shared_access("fleet_plane")
+            hp = self._admit_locked(str(host))
+            if hp is not None:
+                hp.cum = reg
+
+    def host_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._hosts)
+
+
+# ---------------------------------------------------------------------------
+# Fleet journal + fleet trace (the finalize/incident correlation paths)
+
+
+def _read_jsonl(path: Path) -> List[dict]:
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail line: skip, keep the rest
+    except OSError:
+        return []
+    return events
+
+
+def write_fleet_journal(
+    out_dir,
+    host_dirs: Dict[str, Path],
+    offsets: Dict[str, float],
+) -> Optional[Path]:
+    """Merge the coordinator journal and every host journal into one
+    ``fleet_journal.jsonl`` ordered by clock-offset-corrected wall
+    time. Each event gains a ``host`` field; corrected events carry
+    the applied offset so the correction is auditable."""
+    out = Path(out_dir)
+    merged: List[dict] = []
+    for e in _read_jsonl(out / "journal.jsonl"):
+        merged.append({**e, "host": "coordinator"})
+    for host, hdir in sorted(host_dirs.items()):
+        off = float(offsets.get(host, 0.0))
+        for e in _read_jsonl(Path(hdir) / "journal.jsonl"):
+            ev = {**e, "host": host}
+            if off and isinstance(e.get("ts"), (int, float)):
+                ev["ts"] = float(e["ts"]) - off
+                ev["clock_offset_s"] = round(off, 6)
+            merged.append(ev)
+    if not merged:
+        return None
+    merged.sort(key=lambda e: float(e.get("ts", 0.0)))
+    path = out / FLEET_JOURNAL_NAME
+    with open(path, "w") as f:
+        for e in merged:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+def _latest_trace_dump(host_dir: Path) -> Optional[Path]:
+    dumps = sorted((Path(host_dir) / "flight").glob("*/trace.json"))
+    return dumps[-1] if dumps else None
+
+
+def write_fleet_trace(
+    out_dir,
+    coordinator_spans,
+    host_dirs: Dict[str, Path],
+    offsets: Dict[str, float],
+) -> Optional[Path]:
+    """One Perfetto trace across processes: the coordinator's span ring
+    as pid 1 plus each host's LATEST flight-dump trace re-pidded and
+    clock-offset-corrected. Same-window spans share ``win-<start>``
+    trace ids across hosts, so the merged dump shows worker
+    build -> report -> seal -> merge -> incident as one causal chain."""
+    from .flight import chrome_events
+
+    events: List[dict] = chrome_events(
+        list(coordinator_spans), pid=1, process_name="coordinator"
+    )
+    pid = 1
+    for host, hdir in sorted(host_dirs.items()):
+        trace_path = _latest_trace_dump(Path(hdir))
+        if trace_path is None:
+            continue
+        try:
+            doc = json.loads(trace_path.read_text())
+        except (OSError, ValueError):
+            continue
+        pid += 1
+        shift = int(float(offsets.get(host, 0.0)) * 1e6)
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": host},
+            }
+        )
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") == "X" and shift:
+                ev["ts"] = int(ev.get("ts", 0)) - shift
+            events.append(ev)
+    if not events:
+        return None
+    path = Path(out_dir) / FLEET_TRACE_NAME
+    path.write_text(
+        json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+    )
+    return path

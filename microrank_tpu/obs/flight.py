@@ -91,9 +91,13 @@ def write_spans_csv(spans: List[Span], path) -> None:
             w.writerow(row)
 
 
-def write_chrome_trace(spans: List[Span], path) -> None:
-    """Chrome trace-event JSON ("X" complete events; one tid per
-    recording thread, named via "M" metadata events)."""
+def chrome_events(
+    spans: List[Span], pid: int = 1, process_name: Optional[str] = None
+) -> List[dict]:
+    """Chrome trace events for one process's spans ("X" complete
+    events; one tid per recording thread, named via "M" metadata).
+    ``pid``/``process_name`` let the fleet plane merge several
+    processes' rings into ONE Perfetto dump with distinct tracks."""
     tids = {}
     events = []
     for s in spans:
@@ -105,7 +109,7 @@ def write_chrome_trace(spans: List[Span], path) -> None:
                 "ph": "X",
                 "ts": s.start_us,
                 "dur": max(1, s.dur_us),
-                "pid": 1,
+                "pid": pid,
                 "tid": tid,
                 "args": {
                     "trace_id": s.trace_id,
@@ -119,15 +123,31 @@ def write_chrome_trace(spans: List[Span], path) -> None:
         {
             "name": "thread_name",
             "ph": "M",
-            "pid": 1,
+            "pid": pid,
             "tid": tid,
             "args": {"name": thread},
         }
         for thread, tid in tids.items()
     ]
+    if process_name is not None:
+        meta.insert(
+            0,
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": process_name},
+            },
+        )
+    return meta + events
+
+
+def write_chrome_trace(spans: List[Span], path) -> None:
+    """Chrome trace-event JSON for one process (see chrome_events)."""
     Path(path).write_text(
         json.dumps(
-            {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+            {"traceEvents": chrome_events(spans), "displayTimeUnit": "ms"}
         )
     )
 
@@ -161,9 +181,11 @@ class FlightRecorder:
     def tracer(self):
         return self._tracer if self._tracer is not None else get_tracer()
 
-    def dump(self, reason: str) -> Optional[Path]:
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[Path]:
         """Write one flight dump; returns its directory, or None when
-        the recorder is disabled or the rate limit suppressed it."""
+        the recorder is disabled or the rate limit suppressed it.
+        ``extra`` lands under the manifest's ``fleet`` key — the
+        coordinator cross-links the worker rings it asked for there."""
         from .metrics import record_flight_dump
 
         if not self.cfg.flight:
@@ -209,6 +231,7 @@ class FlightRecorder:
                     "t_min_us": t_lo,
                     "t_max_us": t_hi,
                     "journal_events": n_events,
+                    **({"fleet": extra} if extra else {}),
                 },
                 indent=2,
             )

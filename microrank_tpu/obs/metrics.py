@@ -528,6 +528,83 @@ def fleet_host_spans_rate() -> Gauge:
     )
 
 
+def fleet_host_watermark_lag() -> Gauge:
+    return get_registry().gauge(
+        "microrank_fleet_host_watermark_lag_seconds",
+        "Per-host event-time lag behind the fleet's furthest-ahead "
+        "reporter (the host holding the fleet watermark back reads "
+        "largest)",
+        labelnames=("host",),
+    )
+
+
+def fleet_host_queue_depth() -> Gauge:
+    return get_registry().gauge(
+        "microrank_fleet_host_queue_depth",
+        "Per-host pipelined windows in flight (build submitted, rank "
+        "pending) from the last heartbeat",
+        labelnames=("host",),
+    )
+
+
+def fleet_host_stage_ms() -> Gauge:
+    return get_registry().gauge(
+        "microrank_fleet_host_stage_ms",
+        "Per-host mean stage latency (ms) over the last heartbeat's "
+        "metrics delta — the recent cost signal, not the run-cumulative "
+        "mean",
+        labelnames=("host", "stage"),
+    )
+
+
+def fleet_metric_deltas() -> Counter:
+    return get_registry().counter(
+        "microrank_fleet_metric_deltas_total",
+        "Heartbeat metrics deltas by disposition: applied into the "
+        "fleet registry, stale (already-folded seq retransmit), torn "
+        "(CRC mismatch), version (schema mismatch), ahead "
+        "(out-of-sync seq — worker told to resync), truncated "
+        "(worker dropped metrics to fit the byte bound), rejected "
+        "(malformed payload)",
+        labelnames=("status",),
+    )
+
+
+def fleet_series_dropped() -> Counter:
+    return get_registry().counter(
+        "microrank_fleet_series_dropped_total",
+        "Host-labeled series refused by the fleet registry's "
+        "cardinality cap (expected_hosts + grace) instead of growing "
+        "without bound",
+    )
+
+
+def watchdog_evals() -> Counter:
+    return get_registry().counter(
+        "microrank_watchdog_evals_total",
+        "SLO self-watchdog burn-rate evaluations over the fleet "
+        "registry",
+    )
+
+
+def watchdog_breaches() -> Counter:
+    return get_registry().counter(
+        "microrank_watchdog_breaches_total",
+        "Watchdog evals where a golden signal burned past threshold "
+        "in BOTH the fast and the slow window, by signal",
+        labelnames=("signal",),
+    )
+
+
+def watchdog_burn() -> Gauge:
+    return get_registry().gauge(
+        "microrank_watchdog_burn_rate",
+        "Last evaluated burn rate per golden signal (1.0 = consuming "
+        "the error budget exactly at the sustainable rate)",
+        labelnames=("signal", "window"),  # window: fast | slow
+    )
+
+
 def ingest_rejected() -> Counter:
     return get_registry().counter(
         "microrank_ingest_rejected_total",
@@ -616,6 +693,9 @@ def ensure_catalog() -> None:
         policy_events,
         fleet_heartbeats, fleet_reports, fleet_workers_gauge,
         fleet_reassignments, fleet_sealed_windows, fleet_host_spans_rate,
+        fleet_host_watermark_lag, fleet_host_queue_depth,
+        fleet_host_stage_ms, fleet_metric_deltas, fleet_series_dropped,
+        watchdog_evals, watchdog_breaches, watchdog_burn,
         ingest_rejected, ingest_admitted, ingest_clamped,
         ingest_quarantine_dropped, ingest_window_ops,
         host_load_gauge, host_steal_gauge,
@@ -801,6 +881,38 @@ def record_fleet_sealed(outcome: str) -> None:
 
 def record_fleet_host_rate(host: str, spans_per_second: float) -> None:
     fleet_host_spans_rate().set(float(spans_per_second), host=host)
+
+
+def record_fleet_host_lag(host: str, lag_seconds: float) -> None:
+    fleet_host_watermark_lag().set(max(0.0, float(lag_seconds)), host=host)
+
+
+def record_fleet_host_queue(host: str, depth: float) -> None:
+    fleet_host_queue_depth().set(max(0.0, float(depth)), host=host)
+
+
+def record_fleet_host_stage(host: str, stage: str, ms: float) -> None:
+    fleet_host_stage_ms().set(max(0.0, float(ms)), host=host, stage=stage)
+
+
+def record_fleet_delta(status: str) -> None:
+    fleet_metric_deltas().inc(status=status)
+
+
+def record_fleet_series_dropped(n: int = 1) -> None:
+    fleet_series_dropped().inc(float(n))
+
+
+def record_watchdog_eval() -> None:
+    watchdog_evals().inc()
+
+
+def record_watchdog_breach(signal: str) -> None:
+    watchdog_breaches().inc(signal=signal)
+
+
+def record_watchdog_burn(signal: str, window: str, burn: float) -> None:
+    watchdog_burn().set(float(burn), signal=signal, window=window)
 
 
 def record_ingest_rejected(reason: str, n: int = 1) -> None:

@@ -42,6 +42,7 @@ __all__ = [
     "set_registry",
     "registry_from_json",
     "diff_registries",
+    "merge_registries",
 ]
 
 # Latency-shaped default buckets (seconds): 100 us .. ~100 s.
@@ -430,6 +431,86 @@ def diff_registries(
             for s in m.samples():
                 if isinstance(s.get("value"), (int, float)):
                     g.set(float(s["value"]), **s["labels"])
+    return out
+
+
+def merge_registries(
+    sources: Sequence[Tuple[str, MetricsRegistry]],
+) -> MetricsRegistry:
+    """Federate named per-host registries into one fleet registry
+    (``diff_registries``'s sibling; the fleet plane's merge law, also
+    ``cli stats --merge``).
+
+    ``sources`` is an ordered ``(host_name, registry)`` sequence. The
+    laws, chosen so merging K event-stream shards reproduces the
+    single-registry run exactly:
+
+    * **counters** sum per label set — increments are increments no
+      matter which host recorded them;
+    * **histograms** sum bucket-wise per label set (identical bucket
+      bounds required — every host runs the same catalog; a source
+      whose bounds differ is skipped rather than mis-binned);
+    * **gauges** are point-in-time per-host readings that do NOT sum:
+      each source's reading is kept under a prepended ``host`` label
+      (last writer per (host, labels) wins in source order). A gauge
+      already host-labeled (the coordinator's per-host breakdowns)
+      keeps its shape, samples unioned.
+
+    A source whose metric shape conflicts (same name, different kind or
+    labelnames) is skipped for that metric: torn telemetry must never
+    crash the merge.
+    """
+    out = MetricsRegistry()
+    for host, reg in sources:
+        for m in reg.metrics():
+            try:
+                if isinstance(m, Counter):
+                    c = out.counter(m.name, m.help, m.labelnames)
+                    for s in m.samples():
+                        v = float(s["value"])
+                        if v > 0:
+                            c.inc(v, **s["labels"])
+                elif isinstance(m, Histogram):
+                    h = out.histogram(
+                        m.name, m.help, m.labelnames, m.buckets
+                    )
+                    if h.buckets != m.buckets:
+                        continue
+                    for s in m.samples():
+                        key = h._key(s["labels"])
+                        with h._lock:
+                            st = h._values.get(key)
+                            if st is None:
+                                st = h._values[key] = {
+                                    "counts": [0] * len(s["buckets"]),
+                                    "sum": 0.0,
+                                    "count": 0,
+                                }
+                            st["counts"] = [
+                                a + b
+                                for a, b in zip(st["counts"], s["buckets"])
+                            ]
+                            st["sum"] += float(s["sum"])
+                            st["count"] += int(s["count"])
+                elif isinstance(m, Gauge):
+                    if "host" in m.labelnames:
+                        g = out.gauge(m.name, m.help, m.labelnames)
+                        for s in m.samples():
+                            g.set(float(s["value"]), **s["labels"])
+                    else:
+                        g = out.gauge(
+                            m.name, m.help, ("host",) + m.labelnames
+                        )
+                        for s in m.samples():
+                            g.set(
+                                float(s["value"]),
+                                host=host,
+                                **s["labels"],
+                            )
+            except (ValueError, TypeError):
+                # Shape conflict or torn sample: skip this source's
+                # metric, keep merging the rest.
+                continue
     return out
 
 

@@ -160,6 +160,15 @@ class SpanTracer:
         start_us = int(time.time() * 1e6)
         p0 = time.perf_counter()
         try:
+            # FaultPlan seam at span ENTRY: a ``stage:<name>`` latency
+            # spec sleeps here, INSIDE both the span's timed region and
+            # the StageTimings timer wrapping it — so the injected
+            # slowness lands in the span duration AND the stage_seconds
+            # histogram the SLO watchdog reads, exactly like a real
+            # slow stage. Host-scoped specs make per-host stage faults
+            # drivable in a fleet. (The legacy ObsConfig knob keeps its
+            # exit-side hook below.)
+            self._chaos_stage(name)
             yield own
         finally:
             self._maybe_inject(name)
@@ -208,6 +217,15 @@ class SpanTracer:
                 attrs=dict(attrs) if attrs else {},
             )
         )
+
+    def _chaos_stage(self, name: str) -> None:
+        """The unified chaos surface's stage seam. No plan installed:
+        one module-global read and return."""
+        from ..chaos.faults import get_fault_plan, maybe_inject
+
+        if get_fault_plan() is None:
+            return
+        maybe_inject(f"stage:{name}")
 
     def _maybe_inject(self, name: str) -> None:
         """The chaos hook: sleep inside every ``inject_every``-th span

@@ -1,0 +1,268 @@
+"""SLO self-watchdog: the fleet watches itself with its own machinery.
+
+MicroRank's thesis is trace-based RCA of OTHER systems; PR 7's dogfood
+proved the span ring can rank the pipeline's own slowest stage. This
+module closes the loop continuously: the coordinator evaluates the
+system's OWN golden signals from the federated fleet registry —
+
+* per-stage latency budgets (``microrank_stage_seconds`` over-budget
+  fraction vs the stage error budget),
+* error/degraded rate (skipped stream windows + degraded serves over
+  windows processed),
+* fleet watermark lag (max per-host gauge vs budget),
+* pipeline queue depth (max per-host gauge vs budget)
+
+— as MULTI-WINDOW BURN RATES: each eval appends a snapshot to a ring,
+and a signal breaches only when both the fast window (last
+``fast_windows`` evals — reactive) and the slow window (last
+``slow_windows`` — flap-damping) burn past the threshold. Breaches
+open SELF-incidents through the unmodified
+:class:`~microrank_tpu.stream.incidents.IncidentTracker`: the ranked
+"window" is the breaching signals sorted by burn (suspect =
+``stage:<s>@<host>`` when one host dominates the recent cost),
+fingerprint-deduped across evals, resolved after sustained recovery,
+journaled/webhooked/flight-dumped like any fault. This is the sensor
+layer ROADMAP item 5's adaptive shedding actuates on.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+log = get_logger("microrank_tpu.obs.watchdog")
+
+SELF_INCIDENT_LOG = "self_incidents.jsonl"
+
+
+def _ratio(bad: float, total: float) -> float:
+    return bad / total if total > 0 else 0.0
+
+
+class _Snapshot:
+    """One eval's raw signal readings (cumulative pairs for ratio
+    signals, instantaneous values for gauge signals)."""
+
+    __slots__ = ("t", "ratio", "gauge")
+
+    def __init__(self, t: float):
+        self.t = t
+        self.ratio: Dict[str, Tuple[float, float]] = {}  # (bad, total)
+        self.gauge: Dict[str, float] = {}                # burn units
+
+
+class SLOWatchdog:
+    """Burn-rate evaluator over a registry view, reporting into an
+    IncidentTracker the caller owns (UNMODIFIED machinery — the
+    watchdog is just another ranked-window producer)."""
+
+    def __init__(
+        self,
+        config,
+        tracker,
+        view: Callable[[], "object"],
+        clock=time.monotonic,
+        wall=time.time,
+    ):
+        self.cfg = config
+        self.tracker = tracker
+        self.view = view
+        self.clock = clock
+        self.wall = wall
+        self._ring: "deque[_Snapshot]" = deque(
+            maxlen=max(2, int(config.slow_windows) + 1)
+        )
+        self._last_eval: Optional[float] = None
+        self.evals = 0
+        self.breaches = 0
+        # Per-stage budgets in seconds (overrides on top of the
+        # uniform default).
+        self._budgets = {
+            str(s): float(b) / 1e3 for s, b in config.stage_budgets
+        }
+        self._default_budget = float(config.stage_budget_ms) / 1e3
+
+    # ---------------------------------------------------------- snapshot
+    def _stage_budget(self, stage: str) -> float:
+        return self._budgets.get(stage, self._default_budget)
+
+    @staticmethod
+    def _counter_sum(reg, name: str, **labels) -> float:
+        m = reg.get(name)
+        if m is None or m.kind != "counter":
+            return 0.0
+        total = 0.0
+        for s in m.samples():
+            if all(s["labels"].get(k) == v for k, v in labels.items()):
+                total += float(s["value"])
+        return total
+
+    def _snapshot(self, reg) -> _Snapshot:
+        snap = _Snapshot(self.clock())
+        # Per-stage latency: over-budget observation count from the
+        # cumulative histogram (budget snaps to the first bucket bound
+        # >= the configured value — the resolution the data has).
+        hist = reg.get("microrank_stage_seconds")
+        if hist is not None and hist.kind == "histogram":
+            per_stage: Dict[str, Tuple[float, float]] = {}
+            bounds = list(hist.buckets)
+            for s in hist.samples():
+                stage = s["labels"].get("stage", "")
+                budget = self._stage_budget(stage)
+                idx = len(bounds)
+                for j, b in enumerate(bounds):
+                    if b >= budget:
+                        idx = j + 1  # buckets[:idx] are within budget
+                        break
+                ok = sum(s["buckets"][:idx])
+                total = int(s["count"])
+                bad, tot = per_stage.get(stage, (0.0, 0.0))
+                per_stage[stage] = (bad + (total - ok), tot + total)
+            for stage, (bad, tot) in per_stage.items():
+                snap.ratio[f"stage:{stage}"] = (float(bad), float(tot))
+        # Error/degraded rate over windows processed.
+        windows = self._counter_sum(reg, "microrank_stream_windows_total")
+        skipped = self._counter_sum(
+            reg, "microrank_stream_windows_total", outcome="skipped"
+        )
+        degraded = self._counter_sum(reg, "microrank_serve_degraded_total")
+        snap.ratio["error_rate"] = (skipped + degraded, windows)
+        # Gauge signals: worst host, in budget units.
+        for signal, name, budget in (
+            (
+                "watermark_lag",
+                "microrank_fleet_host_watermark_lag_seconds",
+                float(self.cfg.watermark_lag_budget_seconds),
+            ),
+            (
+                "queue_depth",
+                "microrank_fleet_host_queue_depth",
+                float(self.cfg.queue_depth_budget),
+            ),
+        ):
+            g = reg.get(name)
+            if g is None or budget <= 0:
+                continue
+            worst = max(
+                (float(s["value"]) for s in g.samples()), default=0.0
+            )
+            snap.gauge[signal] = worst / budget
+        return snap
+
+    # -------------------------------------------------------------- burn
+    def _burn(self, window: int) -> Dict[str, float]:
+        """Burn rate per signal over the last ``window`` snapshots
+        (fewer early in the run: multi-window alerting degrades to
+        since-start, which only makes the slow window stricter)."""
+        if len(self._ring) < 2:
+            return {}
+        now = self._ring[-1]
+        base = self._ring[max(0, len(self._ring) - 1 - window)]
+        burns: Dict[str, float] = {}
+        for sig, (bad, tot) in now.ratio.items():
+            b0, t0 = base.ratio.get(sig, (0.0, 0.0))
+            dbad, dtot = bad - b0, tot - t0
+            if dtot < float(self.cfg.min_samples):
+                burns[sig] = 0.0
+                continue
+            budget = (
+                float(self.cfg.stage_error_budget)
+                if sig.startswith("stage:")
+                else float(self.cfg.error_budget)
+            )
+            burns[sig] = (
+                _ratio(dbad, dtot) / budget if budget > 0 else math.inf
+            )
+        for sig in now.gauge:
+            vals = [
+                s.gauge[sig]
+                for s in list(self._ring)[-(window + 1):]
+                if sig in s.gauge
+            ]
+            burns[sig] = sum(vals) / len(vals) if vals else 0.0
+        return burns
+
+    def _attribute_host(self, reg, stage: str) -> Optional[str]:
+        """Name the host whose recent per-stage cost dominates (the
+        per-host breakdown gauge the delta fold maintains)."""
+        g = reg.get("microrank_fleet_host_stage_ms")
+        if g is None:
+            return None
+        costs = sorted(
+            (
+                (float(s["value"]), s["labels"].get("host", ""))
+                for s in g.samples()
+                if s["labels"].get("stage") == stage
+            ),
+            reverse=True,
+        )
+        if not costs:
+            return None
+        if len(costs) == 1:
+            return costs[0][1]
+        lead, runner = costs[0], costs[1]
+        factor = float(self.cfg.host_attribution_factor)
+        if runner[0] <= 0 or lead[0] >= factor * runner[0]:
+            return lead[1]
+        return None
+
+    # -------------------------------------------------------------- eval
+    def evaluate(self, force: bool = False) -> List[str]:
+        """One watchdog tick: snapshot the view, compute fast+slow
+        burns, drive the tracker. Returns the breaching signal names
+        (empty = healthy eval). Rate-limited to ``eval_seconds``
+        unless forced; called from the coordinator's reaper thread,
+        OUTSIDE the fleet lock."""
+        from .metrics import (
+            record_watchdog_breach,
+            record_watchdog_burn,
+            record_watchdog_eval,
+        )
+
+        now = self.clock()
+        if (
+            not force
+            and self._last_eval is not None
+            and now - self._last_eval < float(self.cfg.eval_seconds)
+        ):
+            return []
+        self._last_eval = now
+        self.evals += 1
+        record_watchdog_eval()
+        reg = self.view()
+        self._ring.append(self._snapshot(reg))
+        fast = self._burn(int(self.cfg.fast_windows))
+        slow = self._burn(int(self.cfg.slow_windows))
+        threshold = float(self.cfg.burn_threshold)
+        breaching: List[Tuple[str, float]] = []
+        for sig, fb in fast.items():
+            sb = slow.get(sig, 0.0)
+            record_watchdog_burn(sig, "fast", fb)
+            record_watchdog_burn(sig, "slow", sb)
+            if fb >= threshold and sb >= threshold:
+                breaching.append((sig, max(fb, sb)))
+                record_watchdog_breach(sig)
+        label = str(int(self.wall()))
+        if breaching:
+            self.breaches += 1
+            breaching.sort(key=lambda x: (-x[1], x[0]))
+            ranking = []
+            for sig, burn in breaching:
+                name = sig
+                if sig.startswith("stage:"):
+                    host = self._attribute_host(reg, sig.split(":", 1)[1])
+                    if host:
+                        name = f"{sig}@{host}"
+                ranking.append((name, round(burn, 4)))
+            log.warning(
+                "watchdog breach: %s",
+                ", ".join(f"{n} burn={b}" for n, b in ranking),
+            )
+            self.tracker.observe_ranked(f"watchdog-{label}", ranking)
+            return [n for n, _ in ranking]
+        self.tracker.observe_healthy(f"watchdog-{label}")
+        return []

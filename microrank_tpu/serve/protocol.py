@@ -116,6 +116,27 @@ def parse_traceparent(value: Optional[str]) -> Optional[Tuple[str, str]]:
     return trace_id, span_id
 
 
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """Render native tracer ids as a W3C ``traceparent`` header value
+    (the outbound half of parse_traceparent — fleet HTTP carries it on
+    register/heartbeat/report/goodbye). Native ids are human-readable
+    (``win-<start>`` / ``s0000002a``), so non-conforming ids map
+    deterministically into the header's hex fields: trace ids hash
+    (md5 — same string, same 32-hex id on every host, which is what
+    keeps the header shared across processes), span ids keep their hex
+    digits zero-padded. The native ids stay authoritative for span
+    linking; the header is the standards-compliant wire form."""
+    import hashlib
+
+    t = str(trace_id).lower()
+    if not re.fullmatch(r"[0-9a-f]{32}", t):
+        t = hashlib.md5(str(trace_id).encode()).hexdigest()
+    s = re.sub(r"[^0-9a-f]", "", str(span_id).lower())[-16:].rjust(16, "0")
+    if s == "0" * 16:
+        s = "0" * 15 + "1"
+    return f"00-{t}-{s}-01"
+
+
 def parse_rank_request(
     body: bytes, traceparent: Optional[str] = None
 ) -> RankRequest:

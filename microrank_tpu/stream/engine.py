@@ -392,6 +392,14 @@ class StreamEngine:
             self.log.warning("checkpoint write failed: %s", e)
 
     # ------------------------------------------------------------------ run
+    @property
+    def queue_depth(self) -> int:
+        """Pipelined windows in flight (build submitted, rank pending).
+        Read by the fleet heartbeat thread for the per-host telemetry
+        breakdown: a bare ``len`` on a deque only the engine thread
+        mutates — a momentarily stale reading is fine for a gauge."""
+        return len(self._pending)
+
     def run(self) -> StreamSummary:
         from ..analysis.mrsan import configure_sanitizers
         from ..chaos import configure_chaos, set_chaos_journal

@@ -432,7 +432,7 @@ def test_heartbeat_drop_seam_skips_sends(registry):
         def __init__(self):
             self.beats = []
 
-        def heartbeat(self, spans, windows, uptime_s):
+        def heartbeat(self, spans, windows, uptime_s, extra=None):
             self.beats.append(spans)
             return {"partitions": [0], "incident_open": False}
 

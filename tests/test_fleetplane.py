@@ -1,0 +1,756 @@
+"""Fleet telemetry plane (obs/fleetplane.py, obs/watchdog.py) and its
+coordinator/CLI wiring: the merge law (K sharded registries == one
+registry — counters and histogram buckets exactly, quantile estimates
+within bucket resolution), the exactly-once heartbeat delta protocol
+(torn / stale / version-mismatched / out-of-sync deltas rejected WHOLE,
+retransmits idempotent, truncation lossless, worker-restart epochs),
+the host-cardinality cap, clock-offset estimation and the merged fleet
+journal/trace, W3C traceparent round-trips, the ``stage:`` chaos seam,
+the SLO self-watchdog lifecycle (breach opens exactly one self-incident
+naming the stage, resolves on recovery, zero on healthy data), and
+``cli stats --merge``."""
+
+import json
+import random
+
+import pytest
+
+from microrank_tpu.chaos import configure_chaos, reset_breakers, set_chaos_host
+from microrank_tpu.config import ChaosConfig, MicroRankConfig, WatchdogConfig
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.obs.fleetplane import (
+    FLEET_JOURNAL_NAME,
+    FLEET_TRACE_NAME,
+    FleetPlane,
+    MetricsDeltaSender,
+    delta_crc,
+    fold_into,
+    histogram_quantile,
+    write_fleet_journal,
+    write_fleet_trace,
+)
+from microrank_tpu.obs.registry import (
+    DEFAULT_BUCKETS,
+    merge_registries,
+    registry_from_json,
+)
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    configure_chaos(MicroRankConfig())
+    set_chaos_host(None)
+    reset_breakers()
+    yield
+    configure_chaos(MicroRankConfig())
+    set_chaos_host(None)
+    reset_breakers()
+
+
+def _chaos_cfg(*faults):
+    return MicroRankConfig(
+        chaos=ChaosConfig(enabled=True, faults=tuple(faults))
+    )
+
+
+# ------------------------------------------------------- the merge law
+
+
+def _sharded_and_full(n_shards=3, n_events=300, seed=7):
+    rnd = random.Random(seed)
+    full = MetricsRegistry()
+    shards = [MetricsRegistry() for _ in range(n_shards)]
+    values = []
+    for _ in range(n_events):
+        shard = rnd.choice(shards)
+        op = rnd.choice(["build", "rank"])
+        amt = rnd.uniform(0.5, 2.0)
+        for reg in (shard, full):
+            reg.counter("mr_work_total", "w", ("op",)).inc(amt, op=op)
+        v = 10 ** rnd.uniform(-4, 1)
+        values.append(v)
+        for reg in (shard, full):
+            reg.histogram("mr_lat_seconds", "l", ("stage",)).observe(
+                v, stage=op
+            )
+    return shards, full, values
+
+
+def test_merge_matches_single_registry_exactly():
+    shards, full, _ = _sharded_and_full()
+    merged = merge_registries(
+        [(f"host{i}", s) for i, s in enumerate(shards)]
+    )
+    got = {
+        tuple(sorted(s["labels"].items())): s["value"]
+        for s in merged.get("mr_work_total").samples()
+    }
+    want = {
+        tuple(sorted(s["labels"].items())): s["value"]
+        for s in full.get("mr_work_total").samples()
+    }
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == pytest.approx(want[k])
+    mh = {
+        s["labels"]["stage"]: s
+        for s in merged.get("mr_lat_seconds").samples()
+    }
+    fh = {
+        s["labels"]["stage"]: s
+        for s in full.get("mr_lat_seconds").samples()
+    }
+    assert set(mh) == set(fh)
+    for stage in fh:
+        assert mh[stage]["buckets"] == fh[stage]["buckets"]  # exact
+        assert mh[stage]["count"] == fh[stage]["count"]
+        assert mh[stage]["sum"] == pytest.approx(fh[stage]["sum"])
+
+
+def test_merged_quantiles_within_bucket_resolution():
+    shards, full, values = _sharded_and_full()
+    merged = merge_registries(
+        [(f"host{i}", s) for i, s in enumerate(shards)]
+    )
+    for q in (0.5, 0.9, 0.99):
+        per_stage = {}
+        for s in full.get("mr_lat_seconds").samples():
+            per_stage[s["labels"]["stage"]] = s
+        for stage, fs in per_stage.items():
+            ms = next(
+                s
+                for s in merged.get("mr_lat_seconds").samples()
+                if s["labels"]["stage"] == stage
+            )
+            est_m = histogram_quantile(DEFAULT_BUCKETS, ms["buckets"], q)
+            est_f = histogram_quantile(DEFAULT_BUCKETS, fs["buckets"], q)
+            # Identical bucket counts => identical estimates; and the
+            # estimate lands inside the bucket holding the true
+            # empirical quantile (the resolution histograms have).
+            assert est_m == pytest.approx(est_f)
+            svals = sorted(values)
+            true_q = svals[min(len(svals) - 1, int(q * len(svals)))]
+            hi_idx = next(
+                (
+                    i
+                    for i, b in enumerate(DEFAULT_BUCKETS)
+                    if b >= true_q
+                ),
+                len(DEFAULT_BUCKETS) - 1,
+            )
+            # One-bucket slack either way: linear interpolation's rank
+            # convention can differ from the empirical index by one.
+            hi = DEFAULT_BUCKETS[
+                min(hi_idx + 1, len(DEFAULT_BUCKETS) - 1)
+            ]
+            lo = DEFAULT_BUCKETS[hi_idx - 2] if hi_idx >= 2 else 0.0
+            assert lo <= est_m <= hi * (1 + 1e-9)
+
+
+def test_merge_gauges_gain_host_label_and_keep_existing():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.gauge("mr_temp", "t").set(1.0)
+    b.gauge("mr_temp", "t").set(2.0)
+    # Already host-labeled series keep their shape (no double label).
+    a.gauge("mr_lag", "l", ("host",)).set(5.0, host="host0")
+    b.gauge("mr_lag", "l", ("host",)).set(7.0, host="host1")
+    merged = merge_registries([("host0", a), ("host1", b)])
+    temp = merged.get("mr_temp")
+    assert temp.labelnames == ("host",)
+    got = {s["labels"]["host"]: s["value"] for s in temp.samples()}
+    assert got == {"host0": 1.0, "host1": 2.0}
+    lag = merged.get("mr_lag")
+    assert lag.labelnames == ("host",)
+    got = {s["labels"]["host"]: s["value"] for s in lag.samples()}
+    assert got == {"host0": 5.0, "host1": 7.0}
+
+
+# ------------------------------------------- the heartbeat delta wire
+
+
+def _counter_value(reg, name, **labels):
+    m = reg.get(name)
+    if m is None:
+        return 0.0
+    return sum(
+        float(s["value"])
+        for s in m.samples()
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def test_delta_protocol_exactly_once_with_retransmit(registry):
+    work = MetricsRegistry()
+    c = work.counter("mr_jobs_total", "j")
+    c.inc(5)
+    sender = MetricsDeltaSender("host0")
+    plane = FleetPlane()
+    p1 = sender.payload(work)
+    assert plane.ingest("host0", p1) == {"ack": 1}
+    # Ack lost: the retransmit is the SAME payload and folds nowhere.
+    assert sender.payload(work) is p1
+    ack = plane.ingest("host0", p1)
+    assert ack["ack"] == 1
+    sender.handle_ack(ack)
+    c.inc(3)
+    p2 = sender.payload(work)
+    assert p2["seq"] == 1
+    sender.handle_ack(plane.ingest("host0", p2))
+    view = plane.fleet_view()
+    assert _counter_value(view, "mr_jobs_total") == pytest.approx(8.0)
+    assert _counter_value(
+        registry, "microrank_fleet_metric_deltas_total", status="applied"
+    ) == 2
+    assert _counter_value(
+        registry, "microrank_fleet_metric_deltas_total", status="stale"
+    ) == 1
+
+
+def test_delta_increments_between_build_and_ack_ride_next_delta(registry):
+    work = MetricsRegistry()
+    c = work.counter("mr_jobs_total", "j")
+    c.inc(2)
+    sender = MetricsDeltaSender("host0")
+    plane = FleetPlane()
+    p1 = sender.payload(work)
+    c.inc(4)  # lands AFTER the payload snapshot, before the ack
+    sender.handle_ack(plane.ingest("host0", p1))
+    sender.handle_ack(plane.ingest("host0", sender.payload(work)))
+    assert _counter_value(
+        plane.fleet_view(), "mr_jobs_total"
+    ) == pytest.approx(6.0)
+
+
+def test_torn_and_version_mismatched_deltas_rejected_whole(registry):
+    work = MetricsRegistry()
+    work.counter("mr_jobs_total", "j").inc(5)
+    sender = MetricsDeltaSender("host0")
+    plane = FleetPlane()
+    p = sender.payload(work)
+    torn = {**p, "metrics": {"metrics": {}}}  # body/crc disagree
+    ack = plane.ingest("host0", torn)
+    assert ack["ack"] == 0 and "resync" not in ack
+    wrong_v = {**p, "v": 99}
+    assert plane.ingest("host0", wrong_v)["ack"] == 0
+    assert _counter_value(
+        plane.fleet_view(), "mr_jobs_total"
+    ) == 0.0  # nothing folded
+    assert _counter_value(
+        registry, "microrank_fleet_metric_deltas_total", status="torn"
+    ) == 1
+    assert _counter_value(
+        registry, "microrank_fleet_metric_deltas_total", status="version"
+    ) == 1
+    # The intact original still applies: rejection poisoned nothing.
+    sender.handle_ack(plane.ingest("host0", p))
+    assert _counter_value(
+        plane.fleet_view(), "mr_jobs_total"
+    ) == pytest.approx(5.0)
+
+
+def test_out_of_sync_sender_resyncs_via_full_snapshot(registry):
+    work = MetricsRegistry()
+    c = work.counter("mr_jobs_total", "j")
+    c.inc(5)
+    sender = MetricsDeltaSender("host0")
+    plane_a = FleetPlane()
+    sender.handle_ack(plane_a.ingest("host0", sender.payload(work)))
+    c.inc(3)
+    sender.handle_ack(plane_a.ingest("host0", sender.payload(work)))
+    # Coordinator restarts: a fresh plane sees seq=2 but expects 0.
+    plane_b = FleetPlane()
+    ack = plane_b.ingest("host0", sender.payload(work))
+    assert ack.get("resync") is True
+    sender.handle_ack(ack)
+    # The next delta is a FULL snapshot and REPLACES (no double count).
+    resync_payload = sender.payload(work)
+    assert resync_payload["seq"] == 0
+    sender.handle_ack(plane_b.ingest("host0", resync_payload))
+    assert _counter_value(
+        plane_b.fleet_view(), "mr_jobs_total"
+    ) == pytest.approx(8.0)
+    assert _counter_value(
+        registry, "microrank_fleet_metric_deltas_total", status="ahead"
+    ) == 1
+
+
+def test_worker_restart_epoch_accumulates_across_incarnations(registry):
+    plane = FleetPlane()
+    work1 = MetricsRegistry()
+    work1.counter("mr_jobs_total", "j").inc(5)
+    s1 = MetricsDeltaSender("host0")
+    s1.handle_ack(plane.ingest("host0", s1.payload(work1)))
+    # Restarted incarnation: fresh registry, fresh epoch, seq from 0.
+    work2 = MetricsRegistry()
+    work2.counter("mr_jobs_total", "j").inc(2)
+    s2 = MetricsDeltaSender("host0")
+    s2.epoch = s1.epoch + "-reborn"
+    s2.handle_ack(plane.ingest("host0", s2.payload(work2)))
+    assert _counter_value(
+        plane.fleet_view(), "mr_jobs_total"
+    ) == pytest.approx(7.0)
+
+
+def test_oversize_delta_truncates_losslessly(registry):
+    # Each metric fits the 1024-byte floor ALONE but not together:
+    # truncation sheds whole metrics largest-first and the shed one
+    # rides the next delta (a metric larger than max_bytes by itself
+    # can never ship — final totals for that case come from the
+    # on-disk ledger reconciliation instead).
+    work = MetricsRegistry()
+    big = work.counter("mr_big_total", "b", ("k",))
+    for i in range(16):
+        big.inc(1.0, k=f"key-{i:04d}")
+    mid = work.counter("mr_mid_total", "m", ("k",))
+    for i in range(10):
+        mid.inc(1.0, k=f"key-{i:04d}")
+    work.counter("mr_small_total", "s").inc(3)
+    sender = MetricsDeltaSender("host0", max_bytes=1024)
+    plane = FleetPlane()
+    p1 = sender.payload(work)
+    assert p1["truncated"] > 0
+    assert "mr_big_total" not in p1["metrics"]["metrics"]
+    sender.handle_ack(plane.ingest("host0", p1))
+    # The shed metric rides the next delta in full.
+    p2 = sender.payload(work)
+    assert "mr_big_total" in p2["metrics"]["metrics"]
+    sender.handle_ack(plane.ingest("host0", p2))
+    view = plane.fleet_view()
+    assert _counter_value(view, "mr_small_total") == pytest.approx(3.0)
+    assert _counter_value(view, "mr_big_total") == pytest.approx(16.0)
+    assert _counter_value(view, "mr_mid_total") == pytest.approx(10.0)
+    assert _counter_value(
+        registry, "microrank_fleet_metric_deltas_total",
+        status="truncated",
+    ) >= 1
+
+
+def test_host_cardinality_cap_drops_overflow(registry):
+    plane = FleetPlane(expected_hosts=2, grace=1)
+    work = MetricsRegistry()
+    work.counter("mr_jobs_total", "j").inc(1)
+    for i in range(3):
+        s = MetricsDeltaSender(f"host{i}")
+        assert "dropped" not in plane.ingest(f"host{i}", s.payload(work))
+    s = MetricsDeltaSender("host-extra")
+    ack = plane.ingest("host-extra", s.payload(work))
+    assert ack.get("dropped") is True
+    assert "host-extra" not in plane.host_names()
+    assert _counter_value(
+        registry, "microrank_fleet_series_dropped_total"
+    ) == 1
+
+
+# -------------------------------------------------- clocks + artifacts
+
+
+def test_clock_offsets_ewma_and_clamp():
+    plane = FleetPlane(max_skew_seconds=5.0)
+    plane.note_clock("host0", wall=1000.0, rtt=0.2, recv_wall=999.0)
+    assert plane.offsets()["host0"] == pytest.approx(1.1)
+    # An implausible reading moves the EWMA but the OFFSET is clamped.
+    plane.note_clock("host0", wall=1100.0, rtt=0.0, recv_wall=999.0)
+    assert plane.offsets()["host0"] == 5.0
+
+
+def test_fleet_journal_merges_with_offset_correction(tmp_path):
+    (tmp_path / "journal.jsonl").write_text(
+        json.dumps({"event": "a", "ts": 10.0}) + "\n"
+        + json.dumps({"event": "c", "ts": 20.0}) + "\n"
+    )
+    hdir = tmp_path / "host0"
+    hdir.mkdir()
+    (hdir / "journal.jsonl").write_text(
+        json.dumps({"event": "b", "ts": 15.5}) + "\n" + "{torn"
+    )
+    path = write_fleet_journal(
+        tmp_path, {"host0": hdir}, {"host0": 0.5}
+    )
+    assert path == tmp_path / FLEET_JOURNAL_NAME
+    events = [
+        json.loads(line) for line in path.read_text().splitlines()
+    ]
+    assert [e["event"] for e in events] == ["a", "b", "c"]
+    assert events[1]["host"] == "host0"
+    assert events[1]["ts"] == pytest.approx(15.0)  # skew-corrected
+    assert events[1]["clock_offset_s"] == pytest.approx(0.5)
+    assert events[0]["host"] == "coordinator"
+
+
+def test_fleet_trace_merges_processes_sharing_trace_ids(tmp_path):
+    from microrank_tpu.obs.spans import SpanTracer
+
+    tracer = SpanTracer(enabled=True)
+    ctx = tracer.new_trace("win-1000")
+    with tracer.span("seal", service="fleet", ctx=ctx):
+        pass
+    dump_dir = tmp_path / "host0" / "flight" / "0001-incident"
+    dump_dir.mkdir(parents=True)
+    (dump_dir / "trace.json").write_text(
+        json.dumps(
+            {
+                "traceEvents": [
+                    {
+                        "name": "build", "ph": "X", "ts": 2_000_000,
+                        "dur": 10, "pid": 1, "tid": 1,
+                        "args": {"trace_id": "win-1000"},
+                    }
+                ]
+            }
+        )
+    )
+    path = write_fleet_trace(
+        tmp_path,
+        tracer.snapshot(),
+        {"host0": tmp_path / "host0"},
+        {"host0": 0.5},
+    )
+    assert path == tmp_path / FLEET_TRACE_NAME
+    doc = json.loads(path.read_text())
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    pids = {e["pid"] for e in xs}
+    assert len(pids) == 2  # coordinator + host0, distinct tracks
+    by_trace = {
+        e["args"].get("trace_id")
+        for e in xs
+        if e["args"].get("trace_id") == "win-1000"
+    }
+    assert by_trace == {"win-1000"}  # the shared cross-process trace
+    assert {
+        e["pid"] for e in xs if e["args"].get("trace_id") == "win-1000"
+    } == pids
+    host_ev = next(e for e in xs if e["name"] == "build")
+    assert host_ev["ts"] == 2_000_000 - 500_000  # offset-corrected
+    names = [
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    ]
+    assert "coordinator" in names and "host0" in names
+
+
+# ---------------------------------------------------- W3C traceparent
+
+
+def test_format_traceparent_round_trips_and_is_deterministic():
+    from microrank_tpu.serve.protocol import (
+        format_traceparent,
+        parse_traceparent,
+    )
+
+    hex_id = "ab" * 16
+    hdr = format_traceparent(hex_id, "s0000002a")
+    tid, sid = parse_traceparent(hdr)
+    assert tid == hex_id
+    assert sid == "000000000000002a"
+    # Native window ids hash deterministically: same string -> same
+    # header on every host (that sameness IS the cross-process join).
+    h1 = format_traceparent("win-17000000", "s00000001")
+    h2 = format_traceparent("win-17000000", "s00000001")
+    assert h1 == h2
+    assert parse_traceparent(h1) is not None
+
+
+def test_stage_chaos_seam_slows_the_span(registry):
+    from microrank_tpu.obs.spans import SpanTracer
+
+    configure_chaos(
+        _chaos_cfg(
+            {"seam": "stage:detect", "kind": "latency", "value": 60,
+             "count": 1}
+        )
+    )
+    tracer = SpanTracer(enabled=True)
+    with tracer.span("detect"):
+        pass
+    with tracer.span("detect"):  # count=1: second span is clean
+        pass
+    spans = tracer.snapshot()
+    assert spans[0].dur_us >= 50_000
+    assert spans[1].dur_us < 50_000
+
+
+def test_stage_chaos_seam_host_scoped(registry):
+    from microrank_tpu.obs.spans import SpanTracer
+
+    configure_chaos(
+        _chaos_cfg(
+            {"seam": "stage:detect", "kind": "latency", "value": 60,
+             "count": 1, "host": "host1"}
+        )
+    )
+    set_chaos_host("host0")
+    tracer = SpanTracer(enabled=True)
+    with tracer.span("detect"):
+        pass
+    assert tracer.snapshot()[0].dur_us < 50_000  # scoped elsewhere
+
+
+# ------------------------------------------------- SLO self-watchdog
+
+
+def _watchdog(tmp_path, registry_view, **cfg_kwargs):
+    from microrank_tpu.obs.watchdog import SELF_INCIDENT_LOG, SLOWatchdog
+    from microrank_tpu.stream.incidents import (
+        IncidentTracker,
+        JsonlIncidentSink,
+    )
+
+    defaults = dict(
+        eval_seconds=0.0,
+        fast_windows=2,
+        slow_windows=10,
+        min_samples=1,
+        stage_budget_ms=100.0,
+        stage_error_budget=0.1,
+        resolve_after_evals=2,
+        cooldown_evals=1,
+    )
+    defaults.update(cfg_kwargs)
+    cfg = WatchdogConfig(**defaults)
+    log_path = tmp_path / SELF_INCIDENT_LOG
+    tracker = IncidentTracker(
+        resolve_after=cfg.resolve_after_evals,
+        cooldown_windows=cfg.cooldown_evals,
+        sinks=[JsonlIncidentSink(log_path)],
+    )
+    wd = SLOWatchdog(cfg, tracker=tracker, view=lambda: registry_view)
+    return wd, tracker, log_path
+
+
+def test_watchdog_opens_one_attributed_incident_and_resolves(
+    registry, tmp_path
+):
+    view = MetricsRegistry()
+    hist = view.histogram("microrank_stage_seconds", "s", ("stage",))
+    host_ms = view.gauge(
+        "microrank_fleet_host_stage_ms", "ms", ("host", "stage")
+    )
+    wd, tracker, log_path = _watchdog(tmp_path, view)
+    hist.observe(0.005, stage="detect")
+    assert wd.evaluate(force=True) == []  # baseline eval, healthy
+    # The injected fault: host1's detect blows its 100 ms budget.
+    for _ in range(4):
+        hist.observe(0.75, stage="detect")
+    host_ms.set(750.0, host="host1", stage="detect")
+    host_ms.set(5.0, host="host0", stage="detect")
+    breaching = wd.evaluate(force=True)
+    assert breaching == ["stage:detect@host1"]  # stage AND host named
+    assert tracker.opened == 1
+    # Sustained breach dedups into the SAME incident.
+    for _ in range(2):
+        hist.observe(0.75, stage="detect")
+        wd.evaluate(force=True)
+    assert tracker.opened == 1
+    # Recovery: healthy observations only -> burn decays -> resolve.
+    for _ in range(6):
+        hist.observe(0.002, stage="detect")
+        wd.evaluate(force=True)
+        if tracker.resolved:
+            break
+    assert tracker.resolved == 1
+    lines = [
+        json.loads(line) for line in log_path.read_text().splitlines()
+    ]
+    opens = [e for e in lines if e.get("event") == "incident_open"]
+    assert len(opens) == 1
+    assert any(
+        "stage:detect@host1" in json.dumps(e) for e in opens
+    )
+    assert any(e.get("event") == "incident_resolve" for e in lines)
+
+
+def test_watchdog_healthy_run_opens_nothing(registry, tmp_path):
+    view = MetricsRegistry()
+    hist = view.histogram("microrank_stage_seconds", "s", ("stage",))
+    wd, tracker, log_path = _watchdog(tmp_path, view)
+    for _ in range(10):
+        hist.observe(0.003, stage="detect")
+        hist.observe(0.02, stage="build")
+        wd.evaluate(force=True)
+    assert tracker.opened == 0
+    assert not log_path.exists() or not log_path.read_text().strip()
+
+
+def test_watchdog_gauge_signal_needs_fast_and_slow(registry, tmp_path):
+    view = MetricsRegistry()
+    lag = view.gauge(
+        "microrank_fleet_host_watermark_lag_seconds", "l", ("host",)
+    )
+    wd, tracker, _ = _watchdog(
+        tmp_path, view, watermark_lag_budget_seconds=10.0,
+        fast_windows=2, slow_windows=4,
+    )
+    lag.set(5.0, host="host0")  # burn 0.5: under threshold
+    for _ in range(3):
+        assert wd.evaluate(force=True) == []
+    # A transient spike (2.4 burn units) saturates the fast window
+    # ((0.5+0.5+2.4)/3 >= 1) but NOT the slow one ((1.5+2.4)/4 < 1):
+    # no breach — flap damping.
+    lag.set(24.0, host="host0")
+    assert wd.evaluate(force=True) == []
+    assert tracker.opened == 0
+    # Sustained at the same level the slow window fills too: breach.
+    for _ in range(3):
+        out = wd.evaluate(force=True)
+    assert out == ["watermark_lag"]
+    assert tracker.opened == 1
+
+
+# ---------------------------------------------- coordinator round-trip
+
+
+def test_coordinator_fleet_view_and_ledger_reconcile(
+    registry, tmp_path
+):
+    from microrank_tpu.fleet.coordinator import FleetCoordinator
+
+    coord = FleetCoordinator(
+        MicroRankConfig(), out_dir=tmp_path, expected_workers=2
+    )
+    coord.register("host0")
+    coord.register("host1")
+    work = MetricsRegistry()
+    work.counter("mr_jobs_total", "j").inc(5)
+    sender = MetricsDeltaSender("host0")
+    resp = coord.heartbeat(
+        "host0", spans=10, windows=1, uptime_s=1.0, queue_depth=3,
+        wall=1000.0, rtt=0.2, metrics=sender.payload(work),
+    )
+    assert resp["metrics_ack"] == {"ack": 1}
+    prom = coord.fleet_metrics_text()
+    assert "mr_jobs_total 5" in prom
+    assert (
+        'microrank_fleet_host_queue_depth{host="host0"} 3' in prom
+    )
+    # Finalize reconciliation: the on-disk ledger is the durable truth.
+    ledger = MetricsRegistry()
+    ledger.counter("mr_jobs_total", "j").inc(9)
+    (tmp_path / "host0").mkdir()
+    (tmp_path / "host0" / "metrics.json").write_text(
+        json.dumps(ledger.to_json())
+    )
+    coord.goodbye("host0")
+    coord.goodbye("host1")
+    coord.finalize()
+    arts = coord.write_fleet_artifacts()
+    assert "metrics" in arts
+    fleet_prom = (tmp_path / "metrics.prom").read_text()
+    assert "mr_jobs_total 9" in fleet_prom  # ledger replaced the fold
+    fleet_doc = json.loads((tmp_path / "metrics.json").read_text())
+    assert registry_from_json(fleet_doc).get("mr_jobs_total") is not None
+
+
+def test_coordinator_requests_worker_dumps_on_incident(
+    registry, tmp_path
+):
+    from microrank_tpu.fleet.coordinator import FleetCoordinator
+
+    coord = FleetCoordinator(
+        MicroRankConfig(), out_dir=tmp_path, expected_workers=2
+    )
+    coord.register("host0")
+    coord.register("host1")
+    ranked = [["svc-a", 3.0], ["svc-b", 1.0]]
+    for host in ("host0", "host1"):
+        coord.report(
+            host,
+            {
+                "start": "w0", "start_us": 1000, "outcome": "ranked",
+                "ranking": ranked,
+            },
+        )
+    # Advance both hosts so w0 seals at the watermark.
+    for host in ("host0", "host1"):
+        coord.report(
+            host,
+            {
+                "start": "w1", "start_us": 2000, "outcome": "healthy",
+                "ranking": [],
+            },
+        )
+    assert coord.tracker.opened == 1
+    resp = coord.heartbeat("host0", spans=1, windows=2, uptime_s=1.0)
+    assert resp.get("dump") == "incident"
+    # One pop per host: the second heartbeat is clean.
+    assert "dump" not in coord.heartbeat(
+        "host0", spans=1, windows=2, uptime_s=1.0
+    )
+    coord.service_flight()
+    dumps = list((tmp_path / "flight").glob("*-fleet-incident"))
+    assert len(dumps) == 1
+    manifest = json.loads((dumps[0] / "manifest.json").read_text())
+    fleet = manifest["fleet"]
+    assert fleet["reason"] == "incident"
+    assert "host1" in fleet["worker_dumps_requested"]
+
+
+# ----------------------------------------------------- cli stats merge
+
+
+def _write_host_snapshots(tmp_path, values):
+    fleet = tmp_path / "fleet"
+    for i, v in enumerate(values):
+        reg = MetricsRegistry()
+        reg.counter("mr_jobs_total", "j").inc(v)
+        reg.gauge("mr_depth", "d").set(float(i))
+        hdir = fleet / f"host{i}"
+        hdir.mkdir(parents=True)
+        (hdir / "metrics.json").write_text(json.dumps(reg.to_json()))
+    return fleet
+
+
+def test_cli_stats_merge_federates_hosts(tmp_path, capsys):
+    from microrank_tpu.cli.main import main
+
+    fleet = _write_host_snapshots(tmp_path, [5.0, 7.0])
+    rc = main(
+        ["stats", "--merge", str(fleet / "host0"), str(fleet / "host1")]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mr_jobs_total 12" in out
+    assert 'mr_depth{host="host0"} 0' in out
+    assert 'mr_depth{host="host1"} 1' in out
+    # A fleet dir expands to its host*/metrics.json children.
+    rc = main(["stats", "--merge", str(fleet)])
+    assert rc == 0
+    assert "mr_jobs_total 12" in capsys.readouterr().out
+
+
+def test_cli_stats_merge_composes_with_diff(tmp_path, capsys):
+    from microrank_tpu.cli.main import main
+
+    before = _write_host_snapshots(tmp_path / "before", [5.0, 7.0])
+    after = _write_host_snapshots(tmp_path / "after", [6.0, 10.0])
+    rc = main(["stats", "--merge", "--diff", str(before), str(after)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mr_jobs_total 4" in out  # (6+10) - (5+7)
+    rc = main(["stats", "--merge", "--diff", str(before)])
+    assert rc == 2  # exactly two targets
+
+
+def test_fold_into_is_the_shared_accumulation_law():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("mr_jobs_total", "j").inc(2)
+    b.counter("mr_jobs_total", "j").inc(3)
+    b.histogram("mr_lat_seconds", "l").observe(0.01)
+    b.gauge("mr_depth", "d").set(4.0)
+    fold_into(a, b)
+    assert _counter_value(a, "mr_jobs_total") == pytest.approx(5.0)
+    assert a.get("mr_lat_seconds").samples()[0]["count"] == 1
+    assert a.get("mr_depth").samples()[0]["value"] == 4.0
+    # CRC is canonical-serialization stable (reordering is not a tear).
+    doc = {"metrics": {"x": {"type": "counter", "samples": []}}}
+    doc2 = {"metrics": {"x": {"samples": [], "type": "counter"}}}
+    assert delta_crc(doc) == delta_crc(doc2)
