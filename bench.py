@@ -1160,6 +1160,89 @@ def _run_giant(cfg, repeats: int) -> dict:
     return out
 
 
+def _run_warehouse(cfg, spans_per_window, n_ops, fault_ms, n_windows):
+    """Warehouse at-rest economics (ISSUE 18 satellite): the SAME
+    multi-window case the pipelined replay drives, archived as warm
+    columnar segments (kind-dictionary codes + delta ints via
+    savez_compressed), then loaded back. The artifact records at-rest
+    bytes vs the source CSV (acceptance: >=10x smaller) and segment
+    load_ms vs CSV parse_ms (replay = blob load + dispatch, not
+    parse)."""
+    import numpy as np
+    import pandas as pd
+
+    from microrank_tpu.io import load_traces_csv
+    from microrank_tpu.warehouse import load_warehouse_frame, write_segment
+    from microrank_tpu.warehouse.segment import encode_window
+
+    case_dir, _truth = _ensure_batch_data(
+        spans_per_window * n_windows, n_ops, fault_ms, n_windows
+    )
+    csv_path = case_dir / "abnormal.csv"
+    csv_bytes = csv_path.stat().st_size
+
+    t0 = time.perf_counter()
+    df = load_traces_csv(csv_path)
+    parse_s = time.perf_counter() - t0
+
+    # Archive as warm per-window segments, split on the generator's
+    # window boundaries (the exact shape the stream engine seals).
+    start = df["startTime"].min()
+    width = pd.Timedelta(minutes=float(_truth["window_minutes"]))
+    whdir = case_dir / "warehouse_bench"
+    if whdir.exists():
+        for f in whdir.glob("*.npz"):
+            f.unlink()
+    whdir.mkdir(exist_ok=True)
+    at_rest = 0
+    n_segments = 0
+    for i in range(n_windows):
+        w0, w1 = start + i * width, start + (i + 1) * width
+        frame = df[(df["startTime"] >= w0) & (df["startTime"] < w1)]
+        if frame.empty:
+            continue
+        us0 = int(w0.value // 1000)
+        us1 = int(w1.value // 1000)
+        rec = {
+            "meta": {
+                "start": str(w0), "end": str(w1),
+                "start_us": us0, "end_us": us1,
+                "outcome": "clean", "spans": int(len(frame)),
+            },
+            "frame": frame,
+        }
+        at_rest += write_segment(
+            whdir / f"seg-{us0}-{us1}.npz", [encode_window(rec)]
+        )
+        n_segments += 1
+
+    t0 = time.perf_counter()
+    df2 = load_warehouse_frame(whdir)
+    load_s = time.perf_counter() - t0
+    assert len(df2) == int(
+        ((df["startTime"] >= start)
+         & (df["startTime"] < start + n_windows * width)).sum()
+    ), "warehouse round-trip dropped rows"
+
+    out = {
+        "windows": n_segments,
+        "rows": int(len(df2)),
+        "csv_bytes": int(csv_bytes),
+        "at_rest_bytes": int(at_rest),
+        "compression_x": round(csv_bytes / at_rest, 2) if at_rest else None,
+        "parse_ms": round(parse_s * 1e3, 1),
+        "load_ms": round(load_s * 1e3, 1),
+        "load_speedup_x": round(parse_s / load_s, 2) if load_s else None,
+    }
+    log(
+        f"warehouse: {n_segments} warm segments, at-rest "
+        f"{at_rest / 1e6:.2f}MB vs CSV {csv_bytes / 1e6:.2f}MB "
+        f"({out['compression_x']}x smaller); load {out['load_ms']}ms "
+        f"vs parse {out['parse_ms']}ms ({out['load_speedup_x']}x)"
+    )
+    return out
+
+
 def main() -> int:
     config_key = os.environ.get("BENCH_CONFIG", "5")
     preset = CONFIG_PRESETS.get(config_key)
@@ -1624,6 +1707,17 @@ def main() -> int:
                 routed = None
             if routed is not None:
                 result.update(routed)
+
+    # Warehouse at-rest economics (ISSUE 18): archive the replay case
+    # as warm columnar segments and record bytes + load-vs-parse time.
+    # BENCH_WAREHOUSE=0 skips.
+    if os.environ.get("BENCH_WAREHOUSE", "1") != "0":
+        try:
+            result["warehouse"] = _run_warehouse(
+                cfg, spans_target, n_ops, fault_ms, max(replay_n, 4)
+            )
+        except Exception as exc:  # diagnostics must not eat the metric
+            log(f"warehouse case failed ({exc!r}); continuing")
 
     # Giant-window tier (ROADMAP item 2): a 10M-span synthetic window
     # past the DEFAULT bitmap budget — the memory-bounded fallback's

@@ -511,6 +511,8 @@ def cmd_stats(args) -> int:
         )
         events = read_journal(jpath)
         if events:
+            from ..obs import journal_parts
+
             windows = [e for e in events if e["event"] == "window"]
             ranked = [w for w in windows if w.get("outcome") == "ranked"]
             contended = sum(
@@ -518,10 +520,20 @@ def cmd_stats(args) -> int:
                 for w in windows
                 if (w.get("host") or {}).get("contended")
             )
+            # Size spans the rotated parts too (journal_max_bytes):
+            # rotation must not make a run look smaller than it was.
+            parts = journal_parts(jpath)
+            nbytes = sum(
+                os.path.getsize(p) for p in [*parts, jpath]
+                if os.path.exists(p)
+            )
+            rotated = (
+                f" across {len(parts) + 1} parts" if parts else ""
+            )
             print(
                 f"# journal: {len(windows)} windows ({len(ranked)} "
                 f"ranked), {contended} contended samples, "
-                f"{os.path.getsize(jpath)} bytes",
+                f"{nbytes} bytes{rotated}",
                 file=sys.stderr,
             )
     return 0
@@ -866,6 +878,22 @@ def cmd_stream(args) -> int:
         if v is not None
     }
     cfg = cfg.replace(stream=dataclasses.replace(cfg.stream, **overrides))
+    if getattr(args, "warehouse", False) or getattr(
+        args, "warehouse_dir", None
+    ):
+        cfg = cfg.replace(
+            warehouse=dataclasses.replace(
+                cfg.warehouse,
+                enabled=True,
+                dir=getattr(args, "warehouse_dir", None),
+            )
+        )
+    if getattr(args, "journal_max_bytes", None) is not None:
+        cfg = cfg.replace(
+            obs=dataclasses.replace(
+                cfg.obs, journal_max_bytes=args.journal_max_bytes
+            )
+        )
     fleet_overrides = {
         k: v
         for k, v in {
@@ -1135,6 +1163,28 @@ def cmd_scenarios(args) -> int:
 
     log = get_logger("microrank_tpu.cli")
     cfg = _config_from_args(args)
+    if getattr(args, "from_warehouse", None):
+        # Retroactive lane: score a STORED run's incidents (all 13
+        # formulas over the sealed blobs + recorded truth) and feed the
+        # winner back through the same policy engine.
+        from ..warehouse import render_retro_table, run_retro
+
+        result = run_retro(
+            args.from_warehouse,
+            config=cfg,
+            seed=args.seed,
+            persist_policy=not args.no_persist_policy,
+        )
+        print(render_retro_table(result))
+        if args.json:
+            Path(args.json).write_text(json.dumps(result, indent=2))
+        if not result["record"]["formulas"]:
+            log.error(
+                "warehouse %s: no stored ranked windows to score",
+                args.from_warehouse,
+            )
+            return 1
+        return 0
     specs = default_matrix(args.seed, full=args.full)
     if args.families:
         wanted = {f.strip() for f in args.families.split(",") if f.strip()}
@@ -1177,6 +1227,55 @@ def cmd_scenarios(args) -> int:
         )
         return 1
     return 0
+
+
+def cmd_replay(args) -> int:
+    """Time-travel RCA (warehouse/): re-rank stored windows for a time
+    range through the live DispatchRouter (blob load + dispatch, no CSV
+    parse) and verify each fresh ranking against the stored verdict
+    with the tie-aware comparator. Exits nonzero on any mismatch — the
+    warehouse-smoke CI job gates on this."""
+    from ..utils.logging import get_logger
+    from ..warehouse import parse_time_range, replay_range
+
+    log = get_logger("microrank_tpu.cli")
+    cfg = _config_from_args(args)
+    try:
+        t0_us, t1_us = parse_time_range(args.at)
+    except (ValueError, TypeError) as exc:
+        log.error("bad --at range %r: %s", args.at, exc)
+        return 2
+    report = replay_range(
+        args.target, t0_us, t1_us, config=cfg, k=args.top
+    )
+    rng = args.at if args.at not in ("", "*") else "all"
+    print(
+        f"replay --at {rng}: {report['ranked']}/{report['windows']} "
+        f"windows re-ranked, {report['matched']} matched, "
+        f"{len(report['mismatched'])} mismatched "
+        f"({report['spans']} spans in {report['elapsed_s']}s"
+        + (
+            f", {report['spans_per_sec']} spans/s"
+            if report["spans_per_sec"] is not None else ""
+        )
+        + f") -> {report['verdict']}"
+    )
+    for mm in report["mismatched"]:
+        print(
+            f"  MISMATCH {mm['start']}..{mm['end']}: {mm['reason']}"
+        )
+        print(f"    stored:   {mm['stored_top']}")
+        print(f"    replayed: {mm['replayed_top']}")
+    if report["skipped_no_blob"]:
+        log.warning(
+            "%d ranked window(s) stored without rank blobs were "
+            "skipped (run with warehouse.store_blobs=true to make "
+            "history replayable)",
+            report["skipped_no_blob"],
+        )
+    if args.json:
+        Path(args.json).write_text(json.dumps(report, indent=2))
+    return 0 if report["verdict"] == "match" else 1
 
 
 def cmd_synth(args) -> int:
@@ -1737,6 +1836,24 @@ def main(argv=None) -> int:
         "--fleet-no-restart", action="store_true",
         help="--fleet supervision: leave dead workers dead",
     )
+    p_stream.add_argument(
+        "--warehouse", action="store_true",
+        help="archive every sealed window into the tiered span "
+        "warehouse under the output dir (hot -> warm segment blobs at "
+        "seal, cold compaction after warehouse.compact_after windows); "
+        "enables `replay --at` and `scenarios --from-warehouse`",
+    )
+    p_stream.add_argument(
+        "--warehouse-dir", default=None, metavar="DIR",
+        help="warehouse directory (default: <output>/warehouse; "
+        "implies --warehouse)",
+    )
+    p_stream.add_argument(
+        "--journal-max-bytes", type=int, default=None, metavar="N",
+        help="rotate journal.jsonl once it exceeds N bytes (fsync-"
+        "before-rename into journal.jsonl.<n> parts; 0 = never, the "
+        "default)",
+    )
     _add_config_flags(p_stream)
     p_stream.set_defaults(fn=cmd_stream)
 
@@ -1809,8 +1926,47 @@ def main(argv=None) -> int:
         "--json", default=None,
         help="also write the full matrix artifact JSON here",
     )
+    p_scn.add_argument(
+        "--from-warehouse", default=None, metavar="DIR",
+        help="retroactive lane: instead of synthetic scenarios, score "
+        "a STORED run's warehouse incidents across all 13 formulas "
+        "(tie-aware MAP/MRR/top-k vs the recorded ground truth) and "
+        "persist the winning policy — the policy engine tunes on real "
+        "incident outcomes",
+    )
     _add_config_flags(p_scn)
     p_scn.set_defaults(fn=cmd_scenarios)
+
+    p_replay = sub.add_parser(
+        "replay",
+        help="time-travel RCA: re-rank stored warehouse windows for a "
+        "time range through the live dispatch lane (blob load, no CSV "
+        "parse) and verify bit-tie-aware agreement with the stored "
+        "verdicts; exits nonzero on mismatch",
+    )
+    p_replay.add_argument(
+        "target",
+        help="a stream run output dir (reads its warehouse/) or a "
+        "warehouse directory itself",
+    )
+    p_replay.add_argument(
+        "--at", required=True, metavar="RANGE",
+        help="time range to replay: 'all', 'START..END' (each side an "
+        "epoch-microsecond integer or any parsable timestamp, either "
+        "side empty = open), or a single instant selecting the "
+        "window(s) containing it",
+    )
+    p_replay.add_argument(
+        "-k", "--top", type=int, default=5,
+        help="verify agreement over the top-k of each stored verdict "
+        "(default 5)",
+    )
+    p_replay.add_argument(
+        "--json", default=None,
+        help="also write the full replay report JSON to this path",
+    )
+    _add_config_flags(p_replay)
+    p_replay.set_defaults(fn=cmd_replay)
 
     p_synth = sub.add_parser("synth", help="generate a synthetic chaos case")
     p_synth.add_argument("-o", "--output", required=True)
@@ -1930,6 +2086,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.fn in (
         cmd_run, cmd_eval, cmd_serve, cmd_stream, cmd_scenarios,
+        cmd_replay,
     ):  # jax-touching only
         _enable_jit_cache()
     return args.fn(args)

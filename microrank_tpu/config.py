@@ -451,6 +451,13 @@ class ObsConfig:
     # ``GET /profilez?seconds=S`` for on-demand sessions.
     profile_every_n: int = 0
     profile_dir: Optional[str] = None
+    # Size-based journal rotation: when journal.jsonl would exceed this
+    # many bytes, the live file is fsynced, renamed to
+    # ``journal.jsonl.<n>`` and a ``journal_rotated`` event opens the
+    # fresh file (0 = never rotate). ``cli stats``/``cli witness`` read
+    # rotated parts in order, so a long stream run's journal stays
+    # bounded per part without losing history.
+    journal_max_bytes: int = 0
     # Chaos/test knobs: sleep this long inside every ``inject_every``-th
     # span named ``inject_stage`` (the dogfood test slows the build pool
     # and asserts the self-rank blames it; 0 disables).
@@ -846,6 +853,39 @@ class StreamConfig:
 
 
 @dataclass(frozen=True)
+class WarehouseConfig:
+    """Trace warehouse knobs (``warehouse/`` subsystem).
+
+    A tiered columnar span store fed by the stream engine at window-seal
+    time: hot tier = in-memory sealed windows, warm tier = per-window
+    dictionary-compressed ``.npz`` segments (spans + the staged rank
+    blob), cold tier = compacted multi-window segments. Every window
+    record carries its OWN detection context (op vocab + SLO baseline
+    snapshot + admission counters), so any stored range re-ranks with
+    byte-faithful context (``cli replay --at``, ``cli scenarios
+    --from-warehouse``).
+    """
+
+    # Master switch: the stream engine seals segments only when on AND
+    # the run has an output dir.
+    enabled: bool = False
+    # Segment root; None = <out_dir>/warehouse.
+    dir: Optional[str] = None
+    # Store the admitted span frame columns (dictionary-encoded) per
+    # window. Off: only detection context + rank blobs persist (replay
+    # still works; warehouse-source re-streaming does not).
+    store_spans: bool = True
+    # Store the packed rank blob (+ layout + op names) for ranked
+    # windows — replay is a blob load + dispatch, not a parse/build.
+    store_blobs: bool = True
+    # Compact the oldest warm segments into one cold multi-window
+    # segment once this many warm segments exist (0 disables).
+    compact_after: int = 16
+    # Drop the oldest COLD segments beyond this count (0 = unbounded).
+    retention_segments: int = 0
+
+
+@dataclass(frozen=True)
 class MicroRankConfig:
     detector: DetectorConfig = field(default_factory=DetectorConfig)
     pagerank: PageRankConfig = field(default_factory=PageRankConfig)
@@ -862,6 +902,7 @@ class MicroRankConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    warehouse: WarehouseConfig = field(default_factory=WarehouseConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -912,4 +953,5 @@ class MicroRankConfig:
             fleet=_mk(FleetConfig, d.get("fleet", {})),
             ingest=_mk(IngestConfig, d.get("ingest", {})),
             watchdog=_mk(WatchdogConfig, d.get("watchdog", {})),
+            warehouse=_mk(WarehouseConfig, d.get("warehouse", {})),
         )

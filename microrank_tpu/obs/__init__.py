@@ -22,6 +22,7 @@ from .journal import (
     RunJournal,
     current_journal,
     emit_current,
+    journal_parts,
     read_journal,
     set_current_journal,
 )
@@ -65,6 +66,7 @@ __all__ = [
     "get_registry",
     "get_tracer",
     "merge_registries",
+    "journal_parts",
     "read_journal",
     "registry_from_json",
     "set_current_journal",

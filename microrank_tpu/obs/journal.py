@@ -43,10 +43,13 @@ JOURNAL_NAME = "journal.jsonl"
 class RunJournal:
     """Append-only JSONL event writer for one pipeline run."""
 
-    def __init__(self, path, sentinel=None):
+    def __init__(self, path, sentinel=None, max_bytes: int = 0):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
+        # Size-based rotation (ObsConfig.journal_max_bytes): 0 = never.
+        self.max_bytes = int(max_bytes or 0)
+        self._rotations = len(journal_parts(self.path))
         if sentinel is None:
             from .host import ContentionSentinel
 
@@ -58,8 +61,44 @@ class RunJournal:
                "schema": SCHEMA_VERSION, **fields}
         line = json.dumps(rec) + "\n"
         with self._lock:
+            self._maybe_rotate(len(line))
             with open(self.path, "a") as f:
                 f.write(line)
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Rotate the live file to ``journal.jsonl.<n>`` when the next
+        line would push it past ``max_bytes``. fsync BEFORE the rename:
+        the rotated part is immutable history from the moment it gets
+        its final name, so it must be durable under that name — a crash
+        mid-rotation can only lose lines still in the live file's page
+        cache, never a sealed part. Caller holds ``self._lock``."""
+        if not self.max_bytes:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        try:
+            with open(self.path, "a") as f:
+                f.flush()
+                # mrlint: disable=R12(durability contract: fsync-before-rename must serialize with emit() writers under the same lock; bounded by local-disk latency, no network I/O)
+                os.fsync(f.fileno())
+            self._rotations += 1
+            part = self.path.with_name(
+                f"{self.path.name}.{self._rotations}"
+            )
+            os.replace(self.path, part)
+        except OSError:  # pragma: no cover - exotic filesystems
+            return
+        rot = {
+            "event": "journal_rotated", "ts": time.time(),
+            "schema": SCHEMA_VERSION, "part": part.name,
+            "part_bytes": size, "rotation": self._rotations,
+        }
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rot) + "\n")
 
     def run_start(self, **config_fields) -> None:
         self.emit("run_start", host=self.sentinel.sample(), **config_fields)
@@ -160,14 +199,29 @@ def emit_current(event: str, **fields) -> None:
         j.emit(event, **fields)
 
 
+def journal_parts(path) -> list:
+    """Rotated parts of a journal (``journal.jsonl.<n>``) in rotation
+    order — the live file is NOT included."""
+    p = Path(path)
+    parts = []
+    for cand in p.parent.glob(p.name + ".*"):
+        suffix = cand.name[len(p.name) + 1:]
+        if suffix.isdigit():
+            parts.append((int(suffix), cand))
+    return [c for _, c in sorted(parts)]
+
+
 def read_journal(path) -> list:
-    """Parse a journal back into event dicts (tests, ``cli stats``)."""
+    """Parse a journal back into event dicts (tests, ``cli stats``).
+    Rotated parts (``journal.jsonl.<n>``, oldest first) are read before
+    the live file, so consumers see one contiguous event stream."""
     out = []
     p = Path(path)
-    if not p.exists():
-        return out
-    for line in p.read_text().splitlines():
-        line = line.strip()
-        if line:
-            out.append(json.loads(line))
+    for part in [*journal_parts(p), p]:
+        if not part.exists():
+            continue
+        for line in part.read_text().splitlines():
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
     return out

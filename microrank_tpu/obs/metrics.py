@@ -659,6 +659,50 @@ def host_load_gauge() -> Gauge:
     )
 
 
+def warehouse_segments() -> Counter:
+    return get_registry().counter(
+        "microrank_warehouse_segments_total",
+        "Warehouse segments sealed, by tier (warm = one window per "
+        "segment at flush, cold = compacted multi-window)",
+        labelnames=("tier",),
+    )
+
+
+def warehouse_windows() -> Counter:
+    return get_registry().counter(
+        "microrank_warehouse_windows_total",
+        "Window records sealed into warehouse segments, by tier "
+        "(a window counts once per tier it transits)",
+        labelnames=("tier",),
+    )
+
+
+def warehouse_spans() -> Counter:
+    return get_registry().counter(
+        "microrank_warehouse_spans_total",
+        "Span rows sealed into WARM warehouse segments (the at-rest "
+        "copy of every admitted span; compaction does not re-count)",
+    )
+
+
+def warehouse_bytes() -> Counter:
+    return get_registry().counter(
+        "microrank_warehouse_bytes_total",
+        "Compressed segment bytes written, by tier — against "
+        "ingest-side volume this is the at-rest compression observable",
+        labelnames=("tier",),
+    )
+
+
+def warehouse_replays() -> Counter:
+    return get_registry().counter(
+        "microrank_warehouse_replays_total",
+        "Time-travel replay verdicts per stored window: match = the "
+        "re-ranked top-k tie-aware-agrees with the stored verdict",
+        labelnames=("verdict",),  # match | mismatch
+    )
+
+
 def host_steal_gauge() -> Gauge:
     return get_registry().gauge(
         "microrank_host_steal_ratio",
@@ -699,6 +743,8 @@ def ensure_catalog() -> None:
         ingest_rejected, ingest_admitted, ingest_clamped,
         ingest_quarantine_dropped, ingest_window_ops,
         host_load_gauge, host_steal_gauge,
+        warehouse_segments, warehouse_windows, warehouse_spans,
+        warehouse_bytes, warehouse_replays,
     ):
         ctor()
 
@@ -935,6 +981,20 @@ def record_quarantine_dropped(n: int = 1) -> None:
 
 def record_window_ops(n: int) -> None:
     ingest_window_ops().set(float(n))
+
+
+def record_warehouse_seal(
+    tier: str, windows: int, spans: int, nbytes: int
+) -> None:
+    warehouse_segments().inc(tier=tier)
+    warehouse_windows().inc(float(windows), tier=tier)
+    if tier == "warm":
+        warehouse_spans().inc(float(spans))
+    warehouse_bytes().inc(float(nbytes), tier=tier)
+
+
+def record_warehouse_replay(verdict: str, n: int = 1) -> None:
+    warehouse_replays().inc(float(n), verdict=verdict)
 
 
 def record_kernel_ms_per_iter(kernel: str, ms: float) -> None:

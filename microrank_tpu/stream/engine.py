@@ -78,6 +78,7 @@ class _PendingRank:
     result: WindowResult
     future: object              # -> (graph, op_names, kernel)
     trace: object = None        # _WindowTrace (span context + start)
+    frame: object = None        # admitted span frame (warehouse tier)
 
 
 @dataclass
@@ -168,7 +169,10 @@ class StreamEngine:
             if config.runtime.telemetry:
                 from ..obs import JOURNAL_NAME, RunJournal, set_current_journal
 
-                self.journal = RunJournal(self.out_dir / JOURNAL_NAME)
+                self.journal = RunJournal(
+                    self.out_dir / JOURNAL_NAME,
+                    max_bytes=config.obs.journal_max_bytes,
+                )
                 set_current_journal(self.journal)
         if tracker is not None:
             # Injected lifecycle (the fleet worker's coordinator proxy):
@@ -236,6 +240,20 @@ class StreamEngine:
 
             self.flight = FlightRecorder(
                 self.out_dir, config.obs, journal=self.journal
+            )
+        # Trace warehouse (warehouse/): every sealed window lands in the
+        # hot buffer at finalize and is flushed to warm segments at the
+        # same pipeline-drained boundary that writes the checkpoint —
+        # segment data BEFORE the checkpoint commit, so a crash between
+        # them replays (and idempotently re-seals) rather than loses.
+        self.warehouse = None
+        if config.warehouse.enabled and self.out_dir is not None:
+            from ..warehouse import TraceWarehouse
+
+            self.warehouse = TraceWarehouse(
+                self.out_dir,
+                config.warehouse,
+                truth=getattr(source, "fault_pod_ops", None),
             )
         # Crash-only durability (chaos.checkpoint): state.ckpt under the
         # run dir, written at every pipeline-drained window boundary.
@@ -317,6 +335,8 @@ class StreamEngine:
             for k, v in payload.get("summary", {}).items():
                 if hasattr(self.summary, k) and k != "results":
                     setattr(self.summary, k, v)
+            if self.warehouse is not None:
+                self.warehouse.restore_cursor(payload.get("warehouse"))
         except (CheckpointError, KeyError, TypeError, ValueError) as e:
             record_checkpoint("rejected")
             self._cold_reset()
@@ -346,17 +366,43 @@ class StreamEngine:
         reset_cursor = getattr(self.source, "reset_cursor", None)
         if callable(reset_cursor):
             reset_cursor()
+        if self.warehouse is not None:
+            self.warehouse.reset_hot()
         self.summary = StreamSummary()
 
     def _checkpoint(self) -> None:
         """Write state.ckpt — only at a drained boundary (no pending
         ranks: every window the watermark sealed has been finalized, so
         the captured windower/source cursors mark nothing as done that
-        a crash could lose)."""
-        if self._ckpt_path is None or self._pending:
+        a crash could lose). The warehouse flushes FIRST (segment data
+        before the checkpoint commit): if the seal crashes, the
+        checkpoint write is skipped too, so a resume replays the same
+        windows and the deterministic segment names make the re-seal
+        idempotent — exactly-once, never lost."""
+        if self._pending:
             return
         from ..chaos import InjectedFault, save_checkpoint
         from ..obs.metrics import record_checkpoint
+
+        if self.warehouse is not None:
+            try:
+                self.warehouse.flush()
+            except InjectedFault:
+                record_checkpoint("crash_injected")
+                self.log.warning(
+                    "chaos: warehouse seal crashed between segment "
+                    "flush and manifest; checkpoint skipped — the "
+                    "previous checkpoint stands and resume re-seals"
+                )
+                return
+            except OSError as e:
+                self.log.warning(
+                    "warehouse flush failed (%s); checkpoint skipped "
+                    "so the hot windows stay replayable", e
+                )
+                return
+        if self._ckpt_path is None:
+            return
 
         src_state = None
         ckpt_fn = getattr(self.source, "checkpoint_state", None)
@@ -376,6 +422,8 @@ class StreamEngine:
                 )
             },
         }
+        if self.warehouse is not None:
+            payload["warehouse"] = self.warehouse.cursor_state()
         try:
             save_checkpoint(self._ckpt_path, payload)
             record_checkpoint("write")
@@ -673,7 +721,7 @@ class StreamEngine:
             self.baseline.update(frame)
             result.n_traces = int(frame["traceID"].nunique())
             result.skipped_reason = "baseline_warmup"
-            self._finalize(result, "warmup", trace=trace)
+            self._finalize(result, "warmup", frame=frame, trace=trace)
             return
         from ..detect import detect_partition
 
@@ -709,7 +757,9 @@ class StreamEngine:
             fut = self.pool.submit(
                 self._prepare, frame, nrm, abn
             )
-        self._pending.append(_PendingRank(closed, result, fut, trace))
+        self._pending.append(
+            _PendingRank(closed, result, fut, trace, frame=frame)
+        )
         while len(self._pending) >= max(
             1, self.config.stream.pipeline_windows
         ):
@@ -808,7 +858,7 @@ class StreamEngine:
             return
         for p, g, names, ec in group:
             self._finalize(
-                p.result, "ranked", trace=p.trace,
+                p.result, "ranked", frame=p.frame, trace=p.trace,
                 explain_src=(g, names, p.result.kernel or kernel, ec),
             )
 
@@ -1228,6 +1278,11 @@ class StreamEngine:
             # Nothing left to warm-start against: the next incident's
             # first window cold-starts (and re-seeds the state).
             self._warm_state = None
+        # Warehouse observation BEFORE the baseline absorbs this window:
+        # the stored vocab/SLO snapshot must be the exact context the
+        # verdict above was computed under (detect-time fidelity).
+        if self.warehouse is not None:
+            self._warehouse_observe(result, outcome, frame, explain_src)
         if outcome == "clean" and frame is not None:
             self.baseline.update(frame)   # no-op while frozen
         self.summary.results.append(result)
@@ -1252,6 +1307,24 @@ class StreamEngine:
         # state that makes them exactly-once across a restart. No-op
         # while pending ranks exist (the burst's drain boundary writes).
         self._checkpoint()
+
+    def _warehouse_observe(self, result, outcome, frame, explain_src):
+        """Hand one sealed window to the warehouse hot tier (flushed to
+        warm segments at the next drained checkpoint boundary). A
+        storage defect must never kill the stream — log and move on."""
+        try:
+            graph = op_names = kernel = None
+            if explain_src is not None:
+                graph, op_names, kernel, _ec = explain_src
+            snapshot = (
+                self.baseline.snapshot() if self.baseline.ready else None
+            )
+            self.warehouse.observe(
+                result, outcome, frame=frame, graph=graph,
+                op_names=op_names, kernel=kernel, snapshot=snapshot,
+            )
+        except Exception as e:  # noqa: BLE001 - containment rule
+            self.log.warning("warehouse observe failed: %s", e)
 
     def _link_bundle(self, dump_dir) -> None:
         """Cross-link the explain bundle in the flight manifest."""

@@ -94,8 +94,28 @@ def _maybe_corrupt_chunk(chunk: pd.DataFrame) -> pd.DataFrame:
     return corrupt_frame(chunk, action["kind"], seed=seed, **kwargs)
 
 
+def _is_warehouse_dir(path) -> bool:
+    """A directory holding (or containing) sealed warehouse segments —
+    ReplaySource accepts it anywhere a traces CSV is accepted."""
+    try:
+        p = Path(path)
+    except TypeError:
+        return False
+    if not p.is_dir():
+        return False
+    from ..warehouse import MANIFEST_NAME, WAREHOUSE_DIR
+
+    return (
+        (p / MANIFEST_NAME).exists()
+        or (p / WAREHOUSE_DIR / MANIFEST_NAME).exists()
+        or any(p.glob("seg-*.npz"))
+        or any(p.glob("cold-*.npz"))
+    )
+
+
 class ReplaySource:
-    """Replay a staged traces CSV (or an in-memory frame) with pacing.
+    """Replay a staged traces CSV, a warehouse segment directory, or an
+    in-memory frame with pacing.
 
     Resumable: the cursor is the count of rows already yielded (in the
     stable event-time sort order, which is a pure function of the data
@@ -114,6 +134,14 @@ class ReplaySource:
     ):
         if isinstance(path_or_frame, pd.DataFrame):
             self._df = path_or_frame
+        elif _is_warehouse_dir(path_or_frame):
+            # Warehouse-segment mode: reassemble the span stream from a
+            # run's sealed segments — dictionary-compressed columnar
+            # blobs decode straight to the canonical frame, no CSV
+            # parse (the bench artifact's load_ms-vs-parse_ms row).
+            from ..warehouse import load_warehouse_frame
+
+            self._df = load_warehouse_frame(path_or_frame)
         else:
             from ..io import load_traces_csv
 

@@ -1,0 +1,76 @@
+"""Trace warehouse: tiered columnar span store + time-travel RCA.
+
+Everything else in the system is a moving window — once a window seals,
+its spans, vocab and baseline context are gone. The warehouse makes
+history a first-class workload (ROADMAP item 4): the stream engine
+feeds it at window-seal time, and every stored window carries its OWN
+detection context (op-vocab snapshot, SLO-baseline snapshot, admission
+counters), so any time range is re-rankable later with byte-faithful
+context.
+
+Tiers:
+
+* **hot** — in-memory sealed windows, flushed at every pipeline-drained
+  checkpoint boundary;
+* **warm** — one dictionary-compressed ``seg-<start>-<end>.npz`` per
+  window: the admitted span frame (per-column dictionaries + int32
+  codes, delta-encoded timestamps) plus, for ranked windows, the packed
+  rank blob (``rank_backends.blob``) — replay is a blob load + a
+  DispatchRouter dispatch, not a CSV parse + graph build;
+* **cold** — compacted multi-window ``cold-<start>-<end>.npz`` segments
+  (same per-window records, one zip), with optional retention.
+
+A checkpoint-style manifest (version + sha256, atomic seal through
+``utils.atomic``) indexes the segments; corruption is rejected WHOLE
+and the store rebuilds the manifest by cold re-scanning the segment
+files. The seal order is pinned: segment data first, then the
+``warehouse_seal`` chaos seam, then the manifest — a crash between
+segment flush and checkpoint write neither loses nor duplicates spans
+on ``--resume`` (deterministic per-window file names make the re-seal
+idempotent).
+"""
+
+from .manifest import (
+    MANIFEST_NAME,
+    WAREHOUSE_DIR,
+    WAREHOUSE_VERSION,
+    WarehouseError,
+    load_manifest,
+    rescan_segments,
+    seal_manifest,
+)
+from .replay import parse_time_range, replay_range
+from .retro import RETRO_MATRIX_NAME, render_retro_table, run_retro
+from .segment import (
+    StoredWindow,
+    decode_frame,
+    encode_frame,
+    load_segment,
+    unpack_graph_blob_host,
+    write_segment,
+)
+from .store import TraceWarehouse, load_warehouse_frame, resolve_warehouse_dir
+
+__all__ = [
+    "MANIFEST_NAME",
+    "RETRO_MATRIX_NAME",
+    "StoredWindow",
+    "TraceWarehouse",
+    "WAREHOUSE_DIR",
+    "WAREHOUSE_VERSION",
+    "WarehouseError",
+    "decode_frame",
+    "encode_frame",
+    "load_manifest",
+    "load_segment",
+    "load_warehouse_frame",
+    "parse_time_range",
+    "render_retro_table",
+    "replay_range",
+    "rescan_segments",
+    "resolve_warehouse_dir",
+    "run_retro",
+    "seal_manifest",
+    "unpack_graph_blob_host",
+    "write_segment",
+]

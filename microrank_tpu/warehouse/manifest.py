@@ -1,0 +1,131 @@
+"""Warehouse manifest: checkpoint-style atomic seal + rejected-whole load.
+
+The manifest is the warehouse's commit record: a segment EXISTS once it
+is listed here, whatever files sit in the directory. Same envelope as
+``chaos.checkpoint`` (version + sha256 over canonical JSON, written via
+``utils.atomic``): a torn, truncated, version-skewed or bit-flipped
+manifest is rejected WHOLE — no partial trust — and the store rebuilds
+it by cold re-scanning the segment files themselves (each segment's
+meta member carries enough to re-derive its manifest row).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import List, Optional
+
+WAREHOUSE_VERSION = 1
+WAREHOUSE_DIR = "warehouse"
+MANIFEST_NAME = "manifest.json"
+
+
+class WarehouseError(Exception):
+    """A warehouse artifact failed validation (torn/corrupt/skewed)."""
+
+
+def _digest(payload: dict) -> str:
+    canon = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+def seal_manifest(warehouse_dir, payload: dict) -> Path:
+    """Atomically write the manifest envelope. The caller orders this
+    AFTER segment-file writes (write-ahead data, commit record last)."""
+    from ..utils.atomic import atomic_write_json
+
+    path = Path(warehouse_dir) / MANIFEST_NAME
+    doc = {
+        "version": WAREHOUSE_VERSION,
+        "ts": time.time(),
+        "sha256": _digest(payload),
+        "payload": payload,
+    }
+    atomic_write_json(path, doc)
+    return path
+
+
+def load_manifest(warehouse_dir) -> Optional[dict]:
+    """The manifest payload, or None when no manifest exists yet.
+
+    Raises :class:`WarehouseError` on ANY defect — unparsable JSON,
+    wrong envelope shape, version skew, checksum mismatch. Rejected
+    whole: a manifest that cannot be proven intact indexes nothing.
+    """
+    path = Path(warehouse_dir) / MANIFEST_NAME
+    if not path.exists():
+        return None
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        raise WarehouseError(f"manifest unreadable: {exc}") from exc
+    if not isinstance(doc, dict):
+        raise WarehouseError("manifest: not an object")
+    if doc.get("version") != WAREHOUSE_VERSION:
+        raise WarehouseError(
+            f"manifest: version {doc.get('version')!r} != {WAREHOUSE_VERSION}"
+        )
+    payload = doc.get("payload")
+    if not isinstance(payload, dict):
+        raise WarehouseError("manifest: missing payload")
+    if doc.get("sha256") != _digest(payload):
+        raise WarehouseError("manifest: checksum mismatch")
+    return payload
+
+
+def rescan_segments(warehouse_dir) -> List[dict]:
+    """Rebuild manifest segment rows by reading every segment file's
+    meta member (corruption recovery / adoption of orphan seals).
+
+    Unreadable files are skipped (a torn tmp rename never lands under a
+    final name, so anything unreadable here is damage, not a crash
+    artifact). When a cold segment and the warm segments it compacted
+    both survive, the wider cold range wins and the overlapped warm
+    files are ignored — re-listing both would double-count spans.
+    """
+    from .segment import read_segment_meta
+
+    root = Path(warehouse_dir)
+    rows: List[dict] = []
+    for path in sorted(root.glob("*.npz")):
+        if ".tmp." in path.name:
+            continue
+        try:
+            doc = read_segment_meta(path)
+            windows = doc["windows"]
+        except Exception:
+            continue
+        if not windows:
+            continue
+        outcomes: dict = {}
+        spans = 0
+        for w in windows:
+            outcomes[w.get("outcome", "")] = (
+                outcomes.get(w.get("outcome", ""), 0) + 1
+            )
+            spans += int(w.get("spans", 0))
+        rows.append({
+            "file": path.name,
+            "tier": "cold" if path.name.startswith("cold-") else "warm",
+            "start_us": min(int(w["start_us"]) for w in windows),
+            "end_us": max(int(w["end_us"]) for w in windows),
+            "windows": len(windows),
+            "spans": spans,
+            "bytes": path.stat().st_size,
+            "outcomes": outcomes,
+        })
+    # Cold segments absorb the warm files they compacted; drop warm rows
+    # fully covered by a cold row.
+    cold = [r for r in rows if r["tier"] == "cold"]
+    kept = []
+    for r in rows:
+        if r["tier"] == "warm" and any(
+            c["start_us"] <= r["start_us"] and r["end_us"] <= c["end_us"]
+            for c in cold
+        ):
+            continue
+        kept.append(r)
+    kept.sort(key=lambda r: (r["start_us"], r["end_us"], r["file"]))
+    return kept

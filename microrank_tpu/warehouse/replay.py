@@ -1,0 +1,159 @@
+"""Time-travel replay: re-rank stored windows through the live lane.
+
+``cli replay --at START..END`` loads the stored rank blobs for the
+range, rebuilds each window graph on the host (bit-exact inverse of the
+device blob codec), routes them through the SAME DispatchRouter the
+stream engine uses (coalesced into same-bucket batches, at bench speed
+— no CSV parse, no graph build), and verifies every window's fresh
+ranking against the stored verdict with the tie-aware comparator. A
+mismatch means history is not reproducible — the CLI exits nonzero and
+CI fails the warehouse-smoke job.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from ..utils.ranking_compare import tie_aware_topk_agreement
+
+
+def parse_time_range(spec: str) -> Tuple[Optional[int], Optional[int]]:
+    """``"all"`` -> open range; ``"START..END"`` with each side an epoch
+    microsecond integer, any pandas-parsable timestamp, or empty (open
+    bound); a single instant selects the window(s) containing it."""
+    spec = (spec or "").strip()
+    if spec in ("", "all", "*"):
+        return None, None
+
+    def _bound(s: str) -> Optional[int]:
+        s = s.strip()
+        if not s:
+            return None
+        if s.lstrip("+-").isdigit():
+            return int(s)
+        import pandas as pd
+
+        return int(pd.Timestamp(s).value // 1000)
+
+    if ".." in spec:
+        left, right = spec.split("..", 1)
+        return _bound(left), _bound(right)
+    point = _bound(spec)
+    return point, point
+
+
+def replay_range(path, t0_us: Optional[int] = None,
+                 t1_us: Optional[int] = None, config=None,
+                 k: int = 5) -> dict:
+    """Replay stored ranked windows in ``[t0_us, t1_us]``; returns a
+    report dict (``report["verdict"]`` is "match"/"mismatch")."""
+    from ..config import MicroRankConfig
+    from ..dispatch.router import DispatchRouter, bucket_key
+    from ..utils.guards import claim_device_owner
+    from .store import TraceWarehouse
+
+    if config is None:
+        config = MicroRankConfig()
+    claim_device_owner("warehouse-replay")
+    store = TraceWarehouse(path, config.warehouse)
+    windows = store.query(t0_us, t1_us)
+    ranked = []
+    skipped_no_blob = 0
+    for w in windows:
+        if w.outcome != "ranked" or not w.ranking:
+            continue
+        g = w.graph()
+        if g is None:
+            skipped_no_blob += 1
+            continue
+        ranked.append((w, g))
+
+    router = DispatchRouter(config)
+    coalesce = max(1, int(getattr(config.dispatch, "coalesce_windows", 1)))
+    mismatches: List[dict] = []
+    matched = 0
+    spans = sum(w.meta.get("spans", 0) for w, _ in ranked)
+    t_start = time.perf_counter()
+    i = 0
+    while i < len(ranked):
+        w0, g0 = ranked[i]
+        kernel = w0.kernel or "coo"
+        key = bucket_key(g0, kernel)
+        group = [(w0, g0)]
+        j = i + 1
+        while (
+            j < len(ranked)
+            and len(group) < coalesce
+            and (ranked[j][0].kernel or "coo") == kernel
+            and bucket_key(ranked[j][1], kernel) == key
+        ):
+            group.append(ranked[j])
+            j += 1
+        i = j
+        outs, _info = router.rank_batch([g for _, g in group], kernel)
+        top_idx, top_scores, n_valid = outs[:3]
+        for b, (w, _g) in enumerate(group):
+            op_names = w.op_names or []
+            n = int(n_valid[b])
+            new_names = [op_names[int(x)] for x in top_idx[b][:n]]
+            new_scores = [float(s) for s in top_scores[b][:n]]
+            stored = w.ranking
+            kk = min(k, len(stored), len(new_names)) or 1
+            ok, reason = tie_aware_topk_agreement(
+                [n_ for n_, _ in stored], [s for _, s in stored],
+                new_names, new_scores, kk,
+            )
+            _record("match" if ok else "mismatch")
+            if ok:
+                matched += 1
+            else:
+                mismatches.append({
+                    "start": w.meta.get("start"),
+                    "end": w.meta.get("end"),
+                    "reason": reason,
+                    "stored_top": stored[:kk],
+                    "replayed_top": list(
+                        zip(new_names[:kk], new_scores[:kk])
+                    ),
+                })
+    elapsed = time.perf_counter() - t_start
+
+    report = {
+        "range": [t0_us, t1_us],
+        "windows": len(windows),
+        "ranked": len(ranked),
+        "matched": matched,
+        "mismatched": mismatches,
+        "skipped_no_blob": skipped_no_blob,
+        "spans": int(spans),
+        "elapsed_s": round(elapsed, 4),
+        "spans_per_sec": (
+            round(spans / elapsed, 1) if elapsed > 0 else None
+        ),
+        "windows_per_sec": (
+            round(len(ranked) / elapsed, 2) if elapsed > 0 else None
+        ),
+        "k": k,
+        "verdict": "match" if not mismatches else "mismatch",
+    }
+    try:
+        from ..obs.journal import emit_current
+
+        emit_current(
+            "warehouse_replay", windows=len(ranked), matched=matched,
+            mismatched=len(mismatches), spans=int(spans),
+            elapsed_s=report["elapsed_s"], verdict=report["verdict"],
+        )
+    except Exception:  # pragma: no cover
+        pass
+    return report
+
+
+def _record(verdict: str) -> None:
+    try:
+        from ..obs.metrics import record_warehouse_replay
+
+        record_warehouse_replay(verdict)
+    except Exception:  # pragma: no cover
+        pass

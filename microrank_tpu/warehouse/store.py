@@ -1,0 +1,418 @@
+"""TraceWarehouse: hot buffer -> warm segments -> cold compaction.
+
+Seal protocol (the exactly-once contract the crash test pins):
+
+1. every hot window is written to its own ``seg-<start_us>-<end_us>.npz``
+   (atomic tmp+fsync+rename; the name is a pure function of the window
+   bounds, so a re-seal after a crash OVERWRITES the orphan instead of
+   duplicating it);
+2. the ``warehouse_seal`` chaos seam fires — ``kill`` exits the process
+   here, raising kinds propagate ``InjectedFault`` to the engine, which
+   then SKIPS the checkpoint write (the previous checkpoint stands, the
+   source replays the same windows, step 1 makes the re-seal a no-op);
+3. the manifest is sealed (checkpoint-style version+sha256, atomic) —
+   only now do the segments exist as far as readers are concerned;
+4. the hot buffer clears, then compaction folds the oldest warm
+   segments into a cold multi-window segment (warm files are deleted
+   only AFTER the manifest listing the cold segment is sealed) and
+   retention drops the oldest cold segments past the configured cap.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..chaos.faults import maybe_inject
+from .manifest import (
+    MANIFEST_NAME,
+    WAREHOUSE_DIR,
+    WarehouseError,
+    load_manifest,
+    rescan_segments,
+    seal_manifest,
+)
+from .segment import StoredWindow, encode_window, load_segment, write_segment
+
+
+def resolve_warehouse_dir(path, cfg=None) -> Path:
+    """Resolve a warehouse directory from an explicit config, a run
+    output dir, or the warehouse dir itself (CLI accepts either)."""
+    if cfg is not None and getattr(cfg, "dir", None):
+        return Path(cfg.dir)
+    p = Path(path)
+    if (p / MANIFEST_NAME).exists() or p.name == WAREHOUSE_DIR:
+        return p
+    sub = p / WAREHOUSE_DIR
+    if cfg is not None or (sub / MANIFEST_NAME).exists() or sub.is_dir():
+        return sub
+    return p
+
+
+def _to_us(val) -> int:
+    """Window bound -> epoch microseconds (bounds arrive as the strings
+    WindowResult carries, or as timestamps in direct API use)."""
+    if isinstance(val, (int, np.integer)):
+        return int(val)
+    import pandas as pd
+
+    return int(pd.Timestamp(val).value // 1000)
+
+
+def _jsonable_truth(truth):
+    if truth is None:
+        return None
+    if isinstance(truth, (set, frozenset, tuple)):
+        return sorted(str(t) for t in truth)
+    if isinstance(truth, dict):
+        return {str(k): _jsonable_truth(v) for k, v in truth.items()}
+    if isinstance(truth, list):
+        return [str(t) for t in truth]
+    return str(truth)
+
+
+class TraceWarehouse:
+    """One run's tiered segment store rooted at ``<out_dir>/warehouse``
+    (or ``WarehouseConfig.dir``)."""
+
+    def __init__(self, base_dir, cfg, truth=None):
+        self.cfg = cfg
+        self.dir = resolve_warehouse_dir(base_dir, cfg)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.truth = _jsonable_truth(truth)
+        self._hot: List[dict] = []
+        self._segments: List[dict] = []
+        self._counters: Dict[str, int] = {
+            "windows": 0, "spans": 0, "ingest_rejected": 0,
+        }
+        self.sealed_through_us = 0
+        try:
+            payload = load_manifest(self.dir)
+        except WarehouseError as exc:
+            # Rejected whole -> rebuild from the segment files and
+            # re-seal so readers get a provably-intact index again.
+            from ..obs.journal import emit_current
+
+            emit_current("warehouse_manifest_rejected", error=str(exc))
+            self._segments = rescan_segments(self.dir)
+            self._recount()
+            self._seal()
+            return
+        if payload is not None:
+            self._segments = list(payload.get("segments", []))
+            self.sealed_through_us = int(payload.get("sealed_through_us", 0))
+            self._counters.update(payload.get("counters", {}))
+            if self.truth is None:
+                self.truth = payload.get("truth")
+
+    # ------------------------------------------------------------- ingest
+
+    def observe(self, result, outcome: str, frame=None, graph=None,
+                op_names=None, kernel=None, snapshot=None) -> None:
+        """Buffer one sealed window (hot tier). Called by the stream
+        engine at finalize time, BEFORE the baseline absorbs the window,
+        so the stored snapshot is the exact detection context."""
+        spans = 0 if frame is None else int(len(frame))
+        meta = {
+            "start": str(result.start),
+            "end": str(result.end),
+            "start_us": _to_us(result.start),
+            "end_us": _to_us(result.end),
+            "outcome": outcome,
+            "anomaly": bool(result.anomaly),
+            "skipped_reason": result.skipped_reason,
+            "n_traces": int(result.n_traces),
+            "n_abnormal": int(result.n_abnormal),
+            "ranking": (
+                [[str(n), float(s)] for n, s in result.ranking]
+                if result.ranking else None
+            ),
+            "kernel": kernel or result.kernel,
+            "kind_dedup": result.kind_dedup,
+            "ingest_rejected": int(getattr(result, "ingest_rejected", 0)),
+            "degraded_input": bool(getattr(result, "degraded_input", False)),
+            "spans": spans,
+            "baseline_ready": snapshot is not None,
+        }
+        rec: dict = {"meta": meta}
+        if frame is not None and self.cfg.store_spans:
+            rec["frame"] = frame
+        if graph is not None and op_names is not None and self.cfg.store_blobs:
+            from ..rank_backends.blob import pack_graph_blob
+
+            blob, layout = pack_graph_blob(graph)
+            rec["graph_pack"] = (np.asarray(blob), layout, list(op_names))
+        if snapshot is not None:
+            rec["snapshot"] = snapshot
+        self._hot.append(rec)
+
+    # --------------------------------------------------------------- seal
+
+    def flush(self) -> int:
+        """Seal every hot window into warm segments + the manifest.
+
+        Raises ``InjectedFault`` when the ``warehouse_seal`` seam is
+        armed with a raising kind — crucially AFTER the segment files
+        hit disk and BEFORE the manifest/checkpoint, the torn state the
+        crash-consistency test drives through.
+        """
+        if not self._hot:
+            return 0
+        flushed = 0
+        rows: List[dict] = []
+        for rec in self._hot:
+            meta = rec["meta"]
+            name = f"seg-{meta['start_us']}-{meta['end_us']}.npz"
+            path = self.dir / name
+            nbytes = write_segment(path, [encode_window(rec)])
+            rows.append({
+                "file": name,
+                "tier": "warm",
+                "start_us": meta["start_us"],
+                "end_us": meta["end_us"],
+                "windows": 1,
+                "spans": meta["spans"],
+                "bytes": int(nbytes),
+                "outcomes": {meta["outcome"]: 1},
+            })
+            flushed += 1
+        act = maybe_inject("warehouse_seal")
+        if isinstance(act, dict) and act.get("kind") == "kill":
+            # Simulated hard crash between segment flush and manifest/
+            # checkpoint write. 137 = SIGKILL's conventional exit code.
+            os._exit(137)
+        for row in rows:
+            self._adopt_row(row)
+            self._counters["windows"] += 1
+            self._counters["spans"] += row["spans"]
+        self._counters["ingest_rejected"] += sum(
+            r["meta"]["ingest_rejected"] for r in self._hot
+        )
+        self.sealed_through_us = max(
+            [self.sealed_through_us] + [r["end_us"] for r in rows]
+        )
+        self._seal()
+        self._hot = []
+        self._record_seal("warm", flushed, sum(r["spans"] for r in rows),
+                          sum(r["bytes"] for r in rows))
+        self._compact()
+        self._retain()
+        return flushed
+
+    def _adopt_row(self, row: dict) -> None:
+        """Insert/replace by file name — the idempotence point: a
+        re-seal after a crash replaces the manifest row instead of
+        appending a duplicate."""
+        for i, existing in enumerate(self._segments):
+            if existing["file"] == row["file"]:
+                self._counters["windows"] -= existing["windows"]
+                self._counters["spans"] -= existing["spans"]
+                self._segments[i] = row
+                return
+        self._segments.append(row)
+        self._segments.sort(
+            key=lambda r: (r["start_us"], r["end_us"], r["file"])
+        )
+
+    def _seal(self) -> None:
+        seal_manifest(self.dir, self.manifest_payload())
+
+    def manifest_payload(self) -> dict:
+        return {
+            "segments": self._segments,
+            "sealed_through_us": self.sealed_through_us,
+            "counters": dict(self._counters),
+            "truth": self.truth,
+        }
+
+    def _recount(self) -> None:
+        self._counters["windows"] = sum(
+            r["windows"] for r in self._segments
+        )
+        self._counters["spans"] = sum(r["spans"] for r in self._segments)
+        if self._segments:
+            self.sealed_through_us = max(
+                r["end_us"] for r in self._segments
+            )
+
+    # ---------------------------------------------------- compact / retain
+
+    def _compact(self) -> None:
+        """Fold the oldest ``compact_after`` warm segments into one cold
+        multi-window segment. Warm files are deleted only after the
+        manifest naming the cold segment is sealed; the rescan path
+        ignores warm files covered by a cold range, so a crash anywhere
+        in between cannot double-count."""
+        n = int(getattr(self.cfg, "compact_after", 0) or 0)
+        if n <= 0:
+            return
+        while True:
+            warm = [r for r in self._segments if r["tier"] == "warm"]
+            if len(warm) < n:
+                return
+            batch = warm[:n]
+            windows = []
+            for row in batch:
+                for w in load_segment(self.dir / row["file"]):
+                    windows.append((w.arrays, w.meta))
+            start = min(r["start_us"] for r in batch)
+            end = max(r["end_us"] for r in batch)
+            name = f"cold-{start}-{end}.npz"
+            nbytes = write_segment(self.dir / name, windows)
+            cold_row = {
+                "file": name,
+                "tier": "cold",
+                "start_us": start,
+                "end_us": end,
+                "windows": sum(r["windows"] for r in batch),
+                "spans": sum(r["spans"] for r in batch),
+                "bytes": int(nbytes),
+                "outcomes": _merge_outcomes(r["outcomes"] for r in batch),
+            }
+            drop = {r["file"] for r in batch}
+            self._segments = [
+                r for r in self._segments if r["file"] not in drop
+            ]
+            self._segments.append(cold_row)
+            self._segments.sort(
+                key=lambda r: (r["start_us"], r["end_us"], r["file"])
+            )
+            self._seal()
+            for fname in drop:
+                try:
+                    (self.dir / fname).unlink()
+                except OSError:
+                    pass
+            self._record_seal(
+                "cold", cold_row["windows"], cold_row["spans"], nbytes
+            )
+
+    def _retain(self) -> None:
+        cap = int(getattr(self.cfg, "retention_segments", 0) or 0)
+        if cap <= 0 or len(self._segments) <= cap:
+            return
+        dropped = []
+        while len(self._segments) > cap:
+            cold = [r for r in self._segments if r["tier"] == "cold"]
+            if not cold:
+                return
+            victim = cold[0]
+            self._segments.remove(victim)
+            self._counters["windows"] -= victim["windows"]
+            self._counters["spans"] -= victim["spans"]
+            dropped.append(victim["file"])
+        self._seal()
+        for fname in dropped:
+            try:
+                (self.dir / fname).unlink()
+            except OSError:
+                pass
+
+    # --------------------------------------------------- checkpoint seam
+
+    def cursor_state(self) -> dict:
+        """Embedded in the engine checkpoint payload."""
+        return {"sealed_through_us": int(self.sealed_through_us)}
+
+    def restore_cursor(self, state) -> None:
+        if isinstance(state, dict):
+            self.sealed_through_us = max(
+                self.sealed_through_us,
+                int(state.get("sealed_through_us", 0)),
+            )
+
+    def reset_hot(self) -> None:
+        self._hot = []
+
+    # -------------------------------------------------------------- query
+
+    def query(self, t0_us: Optional[int] = None,
+              t1_us: Optional[int] = None) -> List[StoredWindow]:
+        """Stored windows overlapping ``[t0_us, t1_us]`` (either bound
+        None = open), in time order. Reads only manifest-listed
+        segments — the manifest is the commit record."""
+        out: List[StoredWindow] = []
+        for row in self._segments:
+            if t1_us is not None and row["start_us"] > t1_us:
+                continue
+            if t0_us is not None and row["end_us"] < t0_us:
+                continue
+            for w in load_segment(self.dir / row["file"]):
+                if t1_us is not None and w.start_us > t1_us:
+                    continue
+                if t0_us is not None and w.end_us < t0_us:
+                    continue
+                out.append(w)
+        out.sort(key=lambda w: (w.start_us, w.end_us))
+        return out
+
+    def summary(self) -> dict:
+        by_tier: Dict[str, int] = {}
+        for r in self._segments:
+            by_tier[r["tier"]] = by_tier.get(r["tier"], 0) + 1
+        return {
+            "segments": len(self._segments),
+            "by_tier": by_tier,
+            "windows": self._counters["windows"],
+            "spans": self._counters["spans"],
+            "bytes": sum(r["bytes"] for r in self._segments),
+        }
+
+    # ------------------------------------------------------------- obs
+
+    def _record_seal(self, tier, windows, spans, nbytes) -> None:
+        try:
+            from ..obs.journal import emit_current
+            from ..obs.metrics import record_warehouse_seal
+
+            record_warehouse_seal(tier, windows, spans, nbytes)
+            emit_current(
+                "warehouse_seal", tier=tier, windows=int(windows),
+                spans=int(spans), bytes=int(nbytes),
+                segments=len(self._segments),
+            )
+        except Exception:  # pragma: no cover - obs must never fail seal
+            pass
+
+
+def _merge_outcomes(dicts) -> dict:
+    out: Dict[str, int] = {}
+    for d in dicts:
+        for k, v in (d or {}).items():
+            out[k] = out.get(k, 0) + int(v)
+    return out
+
+
+def load_warehouse_frame(path, t0_us=None, t1_us=None):
+    """Reassemble one span DataFrame from a warehouse's stored frames
+    (the ``ReplaySource`` warehouse-segment mode): decode every stored
+    window's columnar frame and concatenate in time order."""
+    import pandas as pd
+
+    whdir = resolve_warehouse_dir(path)
+    payload = load_manifest(whdir)
+    if payload is not None:
+        rows = payload.get("segments", [])
+    else:
+        rows = rescan_segments(whdir)
+    if not rows:
+        raise WarehouseError(f"no warehouse segments under {whdir}")
+    frames = []
+    for row in sorted(rows, key=lambda r: (r["start_us"], r["end_us"])):
+        if t1_us is not None and row["start_us"] > t1_us:
+            continue
+        if t0_us is not None and row["end_us"] < t0_us:
+            continue
+        for w in load_segment(whdir / row["file"]):
+            f = w.frame()
+            if f is not None and len(f):
+                frames.append(f)
+    if not frames:
+        raise WarehouseError(
+            f"warehouse under {whdir} stored no span frames "
+            "(store_spans disabled?)"
+        )
+    return pd.concat(frames, ignore_index=True)

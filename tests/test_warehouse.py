@@ -1,0 +1,483 @@
+"""Trace warehouse (warehouse/): tiered columnar span store + time-travel.
+
+Pins the subsystem's contracts: the frame codec is a value-exact round
+trip (shared span/parent id dictionary, delta ints, datetime bases), the
+host blob unpack is bit-exact against the device pack (so replaying a
+stored blob through the SAME dispatch programs reproduces the live
+scores bit-for-bit), segments restore the full detection context (op
+vocab + SLO baseline snapshot), a corrupted manifest is rejected WHOLE
+and rebuilt from a cold re-scan of the segment files, the journal
+rotates with fsync-before-rename, and the two acceptance paths: an
+in-process stream run whose warehouse replays to a "match" verdict and
+retro-scores all 13 formulas, plus the crash seam — a stream subprocess
+killed at ``warehouse_seal`` (between segment flush and checkpoint) and
+resumed neither loses nor duplicates spans. All on CPU jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from conftest import partition_case
+from microrank_tpu.config import (
+    MicroRankConfig,
+    StreamConfig,
+    WarehouseConfig,
+)
+from microrank_tpu.graph.build import aux_for_kernel, build_window_graph
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.pipeline.results import WindowResult
+from microrank_tpu.rank_backends.blob import pack_graph_blob
+from microrank_tpu.stream import StreamEngine, SyntheticSource
+from microrank_tpu.testing import SyntheticConfig
+from microrank_tpu.warehouse import (
+    MANIFEST_NAME,
+    TraceWarehouse,
+    WarehouseError,
+    decode_frame,
+    encode_frame,
+    load_manifest,
+    load_segment,
+    load_warehouse_frame,
+    parse_time_range,
+    replay_range,
+    run_retro,
+    unpack_graph_blob_host,
+    write_segment,
+)
+from microrank_tpu.warehouse.segment import encode_window
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    old = get_registry()
+    set_registry(MetricsRegistry())
+    yield
+    set_registry(old)
+
+
+# ------------------------------------------------------------ frame codec
+
+
+def _span_frame(n=40, seed=3):
+    rng = np.random.default_rng(seed)
+    ids = [f"s{i:04d}" for i in range(n)]
+    parents = [None if i % 7 == 0 else ids[rng.integers(0, n)]
+               for i in range(n)]
+    t0 = pd.Timestamp("2025-03-01 00:00:00")
+    return pd.DataFrame({
+        "traceID": [f"t{i // 4}" for i in range(n)],
+        "spanID": ids,
+        "ParentSpanId": parents,
+        "operationName": [f"op{i % 5}" for i in range(n)],
+        "serviceName": [f"svc{i % 3}" for i in range(n)],
+        "startTime": [t0 + pd.Timedelta(milliseconds=int(x))
+                      for x in rng.integers(0, 60_000, n)],
+        "duration_ms": rng.random(n).astype(np.float64) * 100,
+        "status_code": rng.integers(0, 3, n).astype(np.int64),
+        "is_error": (rng.random(n) < 0.1),
+    })
+
+
+def test_frame_codec_round_trip_exact():
+    df = _span_frame()
+    arrays, meta = encode_frame(df)
+    # spanID and ParentSpanId share ONE dictionary (parents reference
+    # span ids), and delta-encoded columns store small values.
+    assert "iddict" in arrays and "col_spanID" in arrays
+    assert "dict_spanID" not in arrays and "dict_ParentSpanId" not in arrays
+    assert arrays["col_status_code"].min() == 0
+    out = decode_frame(arrays, meta)
+    assert list(out.columns) == list(df.columns)
+    for col in df.columns:
+        if df[col].dtype == object:
+            a = df[col].where(df[col].notna(), None).tolist()
+            b = out[col].where(out[col].notna(), None).tolist()
+            assert a == b, col
+        else:
+            assert out[col].dtype == df[col].dtype, col
+            pd.testing.assert_series_equal(
+                out[col], df[col], check_names=False
+            )
+
+
+def test_frame_codec_empty_and_all_null_parent():
+    df = _span_frame(6)
+    df["ParentSpanId"] = None
+    out = decode_frame(*encode_frame(df))
+    assert out["ParentSpanId"].isna().all()
+    empty = df.iloc[0:0]
+    out2 = decode_frame(*encode_frame(empty))
+    assert len(out2) == 0 and list(out2.columns) == list(df.columns)
+
+
+# ----------------------------------------------------- blob + rank parity
+
+
+def _graph_for(case, kernel="coo"):
+    nrm, abn = partition_case(case)
+    graph, op_names, _, _ = build_window_graph(
+        case.abnormal, nrm, abn, aux=aux_for_kernel(kernel)
+    )
+    return graph, op_names
+
+
+def test_host_blob_unpack_bit_exact(small_case):
+    graph, _ = _graph_for(small_case)
+    blob, layout = pack_graph_blob(graph)
+    out = unpack_graph_blob_host(np.asarray(blob), layout)
+    for part in ("normal", "abnormal"):
+        src, dst = getattr(graph, part), getattr(out, part)
+        for f, a, b in zip(src._fields, src, dst):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.shape == b.shape and a.dtype == b.dtype, f
+            np.testing.assert_array_equal(
+                np.atleast_1d(a).view(np.uint8),
+                np.atleast_1d(b).view(np.uint8),
+                err_msg=f"{part}.{f}",
+            )
+
+
+def test_segment_blob_round_trip_identical_scores(small_case, tmp_path):
+    """The stored blob ranks bit-identically to the live graph through
+    the same dispatch lane — the invariant `cli replay --at` gates on."""
+    import jax
+
+    from microrank_tpu.rank_backends.jax_tpu import rank_window_device
+
+    cfg = MicroRankConfig()
+    graph, op_names = _graph_for(small_case)
+    blob, layout = pack_graph_blob(graph)
+    rec = {
+        "meta": {
+            "start": "2025-03-01 00:00:00", "end": "2025-03-01 00:01:00",
+            "start_us": 0, "end_us": 60_000_000,
+            "outcome": "ranked", "spans": 0,
+        },
+        "graph_pack": (np.asarray(blob), layout, list(op_names)),
+    }
+    path = tmp_path / "seg-0-60000000.npz"
+    write_segment(path, [encode_window(rec)])
+    (w,) = load_segment(path)
+    assert w.op_names == list(op_names) and w.kernel is None
+    ref = jax.device_get(rank_window_device(
+        jax.device_put(graph), cfg.pagerank, cfg.spectrum, None, "coo"
+    ))
+    got = jax.device_get(rank_window_device(
+        jax.device_put(w.graph()), cfg.pagerank, cfg.spectrum, None, "coo"
+    ))
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_segment_snapshot_restore_bit_faithful(tmp_path):
+    vocab = [f"op{i}" for i in range(9)]
+    mean = np.random.default_rng(0).random(9).astype(np.float32) * 50
+    std = np.random.default_rng(1).random(9).astype(np.float32)
+
+    class _Slo:
+        mean_ms, std_ms = mean, std
+
+    rec = {
+        "meta": {"start": "a", "end": "b", "start_us": 0, "end_us": 1,
+                 "outcome": "clean", "spans": 0},
+        "snapshot": (vocab, _Slo),
+    }
+    path = tmp_path / "seg-0-1.npz"
+    write_segment(path, [encode_window(rec)])
+    (w,) = load_segment(path)
+    assert w.vocab_names == vocab
+    slo = w.slo_baseline()
+    np.testing.assert_array_equal(
+        slo.mean_ms.view(np.uint8), mean.view(np.uint8)
+    )
+    np.testing.assert_array_equal(
+        slo.std_ms.view(np.uint8), std.view(np.uint8)
+    )
+    assert w.frame() is None and w.graph() is None
+
+
+# ------------------------------------------------- manifest + store tiers
+
+
+def _observe_n(store, n, spans_each=12, t0=pd.Timestamp("2025-03-01")):
+    for i in range(n):
+        start = t0 + pd.Timedelta(minutes=i)
+        end = start + pd.Timedelta(minutes=1)
+        res = WindowResult(start=str(start), end=str(end), anomaly=False)
+        store.observe(res, "clean", frame=_span_frame(spans_each, seed=i))
+
+
+def test_store_flush_compact_retention(tmp_path):
+    cfg = WarehouseConfig(
+        enabled=True, compact_after=3, retention_segments=2
+    )
+    store = TraceWarehouse(tmp_path, cfg)
+    assert store.dir == tmp_path / "warehouse"
+    _observe_n(store, 7)
+    assert store.flush() == 7
+    s = store.summary()
+    # 7 warm -> two cold batches of 3, 1 warm leftover; retention keeps
+    # the newest 2 segments by dropping the OLDEST cold segment.
+    tiers = s["by_tier"]
+    assert tiers.get("warm", 0) == 1 and tiers.get("cold", 0) == 1
+    assert s["windows"] == 4 and s["spans"] == 4 * 12
+    # Only manifest-listed files remain on disk.
+    files = {f.name for f in store.dir.glob("*.npz")}
+    assert files == {r["file"] for r in store._segments}
+    # Re-open reads the same state back from the manifest.
+    again = TraceWarehouse(tmp_path, cfg)
+    assert again.summary() == s
+    # query() honors bounds.
+    t0 = pd.Timestamp("2025-03-01").value // 1000
+    one = again.query(t0 + 4 * 60_000_000 + 1, t0 + 4 * 60_000_000 + 2)
+    assert len(one) == 1 and one[0].frame() is not None
+
+
+def test_manifest_corruption_rejected_whole_then_rescan(tmp_path):
+    cfg = WarehouseConfig(enabled=True)
+    store = TraceWarehouse(tmp_path, cfg)
+    _observe_n(store, 2)
+    store.flush()
+    whdir = store.dir
+    man = whdir / MANIFEST_NAME
+    # Bit rot inside the payload: the whole manifest is rejected, not
+    # partially trusted.
+    doc = json.loads(man.read_text())
+    doc["payload"]["counters"]["spans"] += 1
+    man.write_text(json.dumps(doc))
+    with pytest.raises(WarehouseError, match="checksum"):
+        load_manifest(whdir)
+    # Re-opening recovers via cold re-scan of the segment files and
+    # re-seals a provably-intact manifest.
+    recovered = TraceWarehouse(tmp_path, cfg)
+    assert recovered.summary()["windows"] == 2
+    assert recovered.summary()["spans"] == 24
+    assert load_manifest(whdir)["counters"]["windows"] == 2
+    # Torn JSON is equally fatal-then-recoverable.
+    man.write_text('{"version": 1, "payload": {"seg')
+    with pytest.raises(WarehouseError):
+        load_manifest(whdir)
+    assert TraceWarehouse(tmp_path, cfg).summary()["windows"] == 2
+
+
+def test_reseal_same_window_is_idempotent(tmp_path):
+    """The crash-consistency primitive: re-observing + re-flushing the
+    SAME window replaces its segment row instead of double-counting."""
+    cfg = WarehouseConfig(enabled=True)
+    store = TraceWarehouse(tmp_path, cfg)
+    _observe_n(store, 1)
+    store.flush()
+    _observe_n(store, 1)   # same bounds, same filename
+    store.flush()
+    s = store.summary()
+    assert s["windows"] == 1 and s["spans"] == 12 and s["segments"] == 1
+
+
+def test_parse_time_range():
+    assert parse_time_range("all") == (None, None)
+    assert parse_time_range("") == (None, None)
+    assert parse_time_range("12..34") == (12, 34)
+    assert parse_time_range("..34") == (None, 34)
+    t = parse_time_range("2025-03-01 00:00:00..")
+    assert t == (pd.Timestamp("2025-03-01").value // 1000, None)
+    assert parse_time_range("7") == (7, 7)
+
+
+# --------------------------------------------------- journal rotation
+
+
+def test_journal_size_rotation_and_multipart_read(tmp_path):
+    from microrank_tpu.obs.journal import (
+        RunJournal,
+        journal_parts,
+        read_journal,
+    )
+
+    path = tmp_path / "journal.jsonl"
+    j = RunJournal(path, max_bytes=600)
+    for i in range(40):
+        j.emit("tick", i=i, pad="x" * 40)
+    parts = journal_parts(path)
+    assert parts, "no rotation happened; shrink max_bytes"
+    # Rotated parts + live file carry every event exactly once, in order.
+    events = [e for e in read_journal(path) if e["event"] == "tick"]
+    assert [e["i"] for e in events] == list(range(40))
+    assert all(p.stat().st_size <= 600 + 200 for p in parts)
+
+
+# ------------------------------------------- e2e: stream -> replay/retro
+
+
+@pytest.fixture(scope="module")
+def wh_run(tmp_path_factory):
+    """One in-process stream run with the warehouse armed: 8 windows,
+    2 faulted, cold compaction after 4 warm segments."""
+    out_dir = tmp_path_factory.mktemp("wh_run")
+    old = get_registry()
+    set_registry(MetricsRegistry())
+    try:
+        cfg = MicroRankConfig(
+            stream=StreamConfig(allowed_lateness_seconds=5.0),
+            warehouse=WarehouseConfig(enabled=True, compact_after=4),
+        )
+        src = SyntheticSource(
+            n_windows=8, faulted=[4, 5],
+            synth_config=SyntheticConfig(
+                n_operations=12, n_traces=50, seed=11
+            ),
+        )
+        eng = StreamEngine(cfg, src, out_dir=out_dir)
+        summary = eng.run()
+    finally:
+        set_registry(old)
+    return {"out_dir": out_dir, "summary": summary, "source": src,
+            "config": cfg}
+
+
+def test_stream_seals_tiered_segments(wh_run):
+    whdir = wh_run["out_dir"] / "warehouse"
+    payload = load_manifest(whdir)
+    assert payload["counters"]["windows"] == 8
+    tiers = {r["tier"] for r in payload["segments"]}
+    assert "cold" in tiers, "compaction never ran"
+    # Ground truth from the synthetic source rides in the manifest.
+    assert payload["truth"]
+    # Detection context: every post-warmup window carries the snapshot.
+    store = TraceWarehouse(whdir, wh_run["config"].warehouse)
+    ranked = [w for w in store.query() if w.outcome == "ranked"]
+    assert ranked and all(
+        w.vocab_names and w.slo_baseline() is not None for w in ranked
+    )
+    assert all(w.graph() is not None for w in ranked)
+
+
+def test_replay_range_matches_live_verdicts(wh_run):
+    report = replay_range(wh_run["out_dir"], None, None,
+                          config=wh_run["config"])
+    assert report["verdict"] == "match", report["mismatched"]
+    assert report["ranked"] == report["matched"] == 2
+    assert report["skipped_no_blob"] == 0
+    # A bounded range narrows to its window(s).
+    store = TraceWarehouse(
+        wh_run["out_dir"] / "warehouse", wh_run["config"].warehouse
+    )
+    w0 = [w for w in store.query() if w.outcome == "ranked"][0]
+    narrow = replay_range(
+        wh_run["out_dir"], w0.start_us, w0.start_us + 1,
+        config=wh_run["config"],
+    )
+    assert narrow["ranked"] == narrow["matched"] == 1
+    assert narrow["verdict"] == "match"
+
+
+def test_replay_source_warehouse_segment_mode(wh_run):
+    from microrank_tpu.stream.sources import ReplaySource
+
+    df = load_warehouse_frame(wh_run["out_dir"])
+    payload = load_manifest(wh_run["out_dir"] / "warehouse")
+    assert len(df) == payload["counters"]["spans"]
+    src = ReplaySource(wh_run["out_dir"], chunk_spans=100_000)
+    assert sum(len(c) for c in src) == len(df)
+
+
+def test_retro_scoring_feeds_policy_engine(wh_run, tmp_path, monkeypatch):
+    monkeypatch.setenv("MICRORANK_POLICY_DIR", str(tmp_path))
+    result = run_retro(
+        wh_run["out_dir"], config=wh_run["config"], seed=0,
+        persist_policy=True,
+    )
+    rec = result["record"]
+    assert result["outcome_source"] == "manifest"
+    assert rec["formulas"] and len(rec["formulas"]) == 13
+    for row in rec["formulas"].values():
+        assert 0.0 <= row["map"] <= 1.0 and row["windows"] == 2
+    assert rec["profile"] and rec["family"] == "warehouse"
+    assert result["policy"]["profiles"]
+    assert result["policy_path"] and Path(result["policy_path"]).exists()
+    assert (wh_run["out_dir"] / "warehouse" / "retro_matrix.json").exists()
+
+
+# --------------------------------------- crash consistency at the seal
+
+
+def test_warehouse_seal_crash_consistency(tmp_path):
+    """Kill the process AT the ``warehouse_seal`` seam — after segment
+    files hit disk, before manifest + checkpoint — then ``--resume``:
+    the warehouse ends byte-for-byte equivalent to a never-crashed run
+    (no lost windows, no duplicated spans)."""
+    src = SyntheticSource(
+        n_windows=6, faulted=[3],
+        synth_config=SyntheticConfig(
+            n_operations=12, n_traces=50, seed=11
+        ),
+    )
+    input_csv = tmp_path / "timeline.csv"
+    normal_csv = tmp_path / "normal.csv"
+    src.timeline.timeline.to_csv(input_csv, index=False)
+    src.normal.to_csv(normal_csv, index=False)
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({
+        "seed": 0,
+        "faults": [{"seam": "warehouse_seal", "kind": "kill", "count": 1}],
+    }))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).parent.parent),
+    }
+
+    def _run(out, extra):
+        return subprocess.run(
+            [
+                sys.executable, "-m", "microrank_tpu.cli", "stream",
+                "--source", "replay", "--input", str(input_csv),
+                "--lateness-seconds", "5", "--warehouse",
+                "-o", str(out), *extra,
+            ],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+
+    ref = _run(tmp_path / "ref", ["--normal", str(normal_csv)])
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    ref_manifest = load_manifest(tmp_path / "ref" / "warehouse")
+
+    out = tmp_path / "out"
+    crashed = _run(out, ["--normal", str(normal_csv),
+                         "--chaos", str(plan)])
+    assert crashed.returncode == 137, (
+        f"expected the injected kill (137), got {crashed.returncode}:\n"
+        + crashed.stdout + crashed.stderr
+    )
+    # Torn state: segment file(s) exist but the manifest does not list
+    # them yet (or does not exist at all).
+    whdir = out / "warehouse"
+    orphans = list(whdir.glob("seg-*.npz"))
+    assert orphans, "kill fired before any segment flush"
+    try:
+        sealed = load_manifest(whdir) or {"segments": []}
+    except WarehouseError:
+        sealed = {"segments": []}
+    assert len(sealed["segments"]) < len(orphans) or not sealed["segments"]
+
+    # Resume WITHOUT the plan (fault counts are per-process; the crash
+    # already happened) — the re-seal must absorb the orphan segments.
+    resumed = _run(out, ["--resume"])
+    assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+    got = load_manifest(whdir)
+    assert got["counters"]["windows"] == ref_manifest["counters"]["windows"]
+    assert got["counters"]["spans"] == ref_manifest["counters"]["spans"]
+    ref_files = sorted(
+        (r["file"], r["spans"]) for r in ref_manifest["segments"]
+    )
+    got_files = sorted((r["file"], r["spans"]) for r in got["segments"])
+    assert got_files == ref_files
+    # And the recovered history replays clean.
+    report = replay_range(out, None, None)
+    assert report["verdict"] == "match", report["mismatched"]
