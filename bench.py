@@ -1243,6 +1243,197 @@ def _run_warehouse(cfg, spans_per_window, n_ops, fault_ms, n_windows):
     return out
 
 
+def _run_sched(cfg, repeats):
+    """Closed-loop saturation of the unified multi-tenant device
+    scheduler (ISSUE 19): mixed tenants share ONE device through the
+    ParkedWindowStore + DeviceScheduler. Two measurements:
+
+    * fair share — three tenants on the backfill lane with weights
+      1/2/4, each keeping BENCH_SCHED_OUTSTANDING windows in flight
+      (closed loop: resubmit on completion), so the store always holds
+      a backlog and the stride scheduler's dequeue order — not arrival
+      order — decides who runs. Observed share must track weights.
+    * lane latency — an interactive tenant (serve lane) submitting
+      serially against that saturated backfill: its p50/p95/p99 shows
+      what lane priority buys when the device is contended.
+
+    Columns per tenant: windows, throughput (windows/s), p50/p95/p99
+    latency ms, observed vs configured share."""
+    import threading
+
+    import numpy as np
+
+    from microrank_tpu.config import (
+        DetectorConfig,
+        MicroRankConfig,
+        SchedConfig,
+        ServeConfig,
+    )
+    from microrank_tpu.detect import compute_slo, detect_numpy
+    from microrank_tpu.dispatch.router import DispatchRouter
+    from microrank_tpu.graph import build_detect_batch
+    from microrank_tpu.rank_backends.jax_tpu import prepare_window_graph
+    from microrank_tpu.sched import (
+        DeviceScheduler,
+        LANE_BACKFILL,
+        LANE_SERVE,
+        ParkedWindowStore,
+    )
+    from microrank_tpu.testing import SyntheticConfig, generate_case
+
+    duration_s = float(os.environ.get("BENCH_SCHED_SECONDS", 4.0))
+    outstanding = int(os.environ.get("BENCH_SCHED_OUTSTANDING", 8))
+    weights = {"bronze": 1.0, "silver": 2.0, "gold": 4.0}
+
+    case = generate_case(
+        SyntheticConfig(n_operations=48, n_traces=200, seed=3)
+    )
+    vocab, baseline = compute_slo(case.normal)
+    batch, trace_ids = build_detect_batch(case.abnormal, vocab)
+    res = detect_numpy(batch, baseline, DetectorConfig())
+    abn = [t for t, a in zip(trace_ids, res.abnormal) if a]
+    nrm = [
+        t
+        for t, a, v in zip(trace_ids, res.abnormal, res.valid)
+        if v and not a
+    ]
+    run_cfg = MicroRankConfig(
+        sched=SchedConfig(
+            tenant_weights=tuple(weights.items()),
+        )
+    )
+    graph, _names, kernel = prepare_window_graph(
+        case.abnormal, nrm, abn, run_cfg
+    )
+    router = DispatchRouter(run_cfg)
+
+    def rank_once():
+        outs, _ = router.rank_batch([graph], kernel)
+        return outs
+
+    rank_once()  # compile untimed, before the scheduler owns the device
+
+    store = ParkedWindowStore(run_cfg.sched, serve_cfg=ServeConfig())
+    sched = DeviceScheduler(store, name="mr-bench-sched")
+
+    # Fair-share ordering probe: submit a standing backlog (30 windows
+    # per tenant, round-robin arrival) BEFORE the scheduler thread
+    # starts, so the stride scheduler — not arrival order — decides the
+    # drain order. A closed loop can't show shares (work-conserving:
+    # equal offered load completes equally); the dispatch ORDER under
+    # backlog is where configured weights must appear.
+    probe_order = []
+    per_tenant_probe = 30
+
+    def probe(tenant):
+        rank_once()
+        probe_order.append(tenant)  # scheduler thread: dispatch order
+
+    probe_futs = [
+        sched.submit_thunk(
+            LANE_BACKFILL, t, lambda t=t: probe(t)
+        )
+        for _ in range(per_tenant_probe)
+        for t in weights
+    ]
+    sched.start()
+    try:
+        for f in probe_futs:
+            f.result(timeout=300)
+        probe_share = {}
+        n_prefix = len(probe_order) // 3
+        for t, w in weights.items():
+            probe_share[t] = probe_order[:n_prefix].count(t) / n_prefix
+
+        lat = {t: [] for t in weights}
+        lat["interactive"] = []
+        stop_at = time.perf_counter() + duration_s
+        lock = threading.Lock()
+
+        def closed_loop(tenant, lane):
+            while True:
+                t0 = time.perf_counter()
+                if t0 >= stop_at:
+                    return
+                sched.run_on(lane, tenant, rank_once)
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat[tenant].append(dt)
+
+        threads = [
+            threading.Thread(
+                target=closed_loop, args=(t, LANE_BACKFILL),
+                name=f"bench-{t}-{i}", daemon=True,
+            )
+            for t in weights
+            for i in range(outstanding)
+        ]
+        threads.append(
+            threading.Thread(
+                target=closed_loop, args=("interactive", LANE_SERVE),
+                name="bench-interactive", daemon=True,
+            )
+        )
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_start
+    finally:
+        sched.stop(drain=True, timeout=30)
+
+    total_w = sum(weights.values())
+    tenants = {}
+    for tenant in list(weights) + ["interactive"]:
+        ts = sorted(lat[tenant])
+        if not ts:
+            continue
+        arr = np.asarray(ts)
+        tenants[tenant] = {
+            "windows": len(ts),
+            "throughput_wps": round(len(ts) / elapsed, 1),
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 1),
+            "p95_ms": round(float(np.percentile(arr, 95)) * 1e3, 1),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 1),
+            **(
+                {
+                    "weight": weights[tenant],
+                    "share_observed": round(probe_share[tenant], 3),
+                    "share_configured": round(
+                        weights[tenant] / total_w, 3
+                    ),
+                }
+                if tenant in weights
+                else {"lane": "serve"}
+            ),
+        }
+    out = {
+        "duration_s": round(elapsed, 2),
+        "total_windows": int(sum(len(v) for v in lat.values())),
+        "throughput_wps": round(
+            sum(len(v) for v in lat.values()) / elapsed, 1
+        ),
+        "outstanding_per_tenant": outstanding,
+        "kernel": kernel,
+        "expired": store.expired,
+        "tenants": tenants,
+    }
+    for t, row in tenants.items():
+        log(
+            f"sched[{t}]: {row['windows']} windows "
+            f"({row['throughput_wps']}/s), p50 {row['p50_ms']}ms "
+            f"p95 {row['p95_ms']}ms p99 {row['p99_ms']}ms"
+            + (
+                f", share {row['share_observed']:.3f} "
+                f"(configured {row['share_configured']:.3f})"
+                if "share_observed" in row
+                else " [serve lane]"
+            )
+        )
+    return out
+
+
 def main() -> int:
     config_key = os.environ.get("BENCH_CONFIG", "5")
     preset = CONFIG_PRESETS.get(config_key)
@@ -1718,6 +1909,15 @@ def main() -> int:
             )
         except Exception as exc:  # diagnostics must not eat the metric
             log(f"warehouse case failed ({exc!r}); continuing")
+
+    # Unified multi-tenant device scheduler (ISSUE 19): closed-loop
+    # saturation under mixed tenants — fair-share convergence + what
+    # lane priority buys the interactive tenant. BENCH_SCHED=0 skips.
+    if os.environ.get("BENCH_SCHED", "1") != "0":
+        try:
+            result["sched"] = _run_sched(cfg, repeats)
+        except Exception as exc:  # diagnostics must not eat the metric
+            log(f"sched saturation case failed ({exc!r}); continuing")
 
     # Giant-window tier (ROADMAP item 2): a 10M-span synthetic window
     # past the DEFAULT bitmap budget — the memory-bounded fallback's

@@ -281,7 +281,8 @@ def disarm_witness(owner: Optional[str] = None) -> None:
 
 
 def witness_armed() -> bool:
-    return _witness_space is not None
+    with _lock:
+        return _witness_space is not None
 
 
 def observe_compile_key(
@@ -298,8 +299,9 @@ def observe_compile_key(
     *set* of leaf shapes — order and multiplicity don't change what
     XLA compiles for the homogeneous window batches this repo stages.
     """
-    if _witness_space is None:
-        return
+    with _lock:
+        if _witness_space is None:
+            return
     shapes: tuple = ()
     if graph is not None:
         import jax

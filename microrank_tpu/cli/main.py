@@ -786,11 +786,36 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _parse_tenant_floats(specs, flag: str):
+    """Repeatable ``NAME=FLOAT`` flags -> the SchedConfig pair tuple."""
+    out = []
+    for spec in specs or ():
+        name, sep, val = spec.partition("=")
+        if not name or not sep:
+            raise SystemExit(f"{flag} takes NAME=FLOAT, got {spec!r}")
+        try:
+            out.append((name, float(val)))
+        except ValueError:
+            raise SystemExit(
+                f"{flag}: {val!r} is not a number (in {spec!r})"
+            ) from None
+    return tuple(out)
+
+
 def cmd_serve(args) -> int:
     """Online RCA service: accept windows over HTTP, coalesce concurrent
     requests into padded micro-batches, rank on device, degrade to the
-    numpy_ref oracle on dispatch failure (serve/ subsystem)."""
+    numpy_ref oracle on dispatch failure (serve/ subsystem).
+
+    Co-deploy (``--stream-input`` / ``--backfill``): serve, the stream
+    engine, and warehouse replay backfill share ONE device through the
+    unified scheduler (sched/) — every lane parks prepared windows into
+    the shared store; the scheduler thread dequeues by priority lane
+    (open-incident > interactive serve > backfill) under per-tenant
+    weighted fair share (``--tenant-weight``) and soft token-bucket
+    quotas (``--tenant-rate``)."""
     import dataclasses
+    import threading
 
     from ..config import ServeConfig
     from ..io import load_traces_csv
@@ -827,16 +852,110 @@ def cmd_serve(args) -> int:
         if v is not None
     }
     cfg = cfg.replace(serve=dataclasses.replace(cfg.serve, **overrides))
-    service = ServeService(cfg, out_dir=args.output)
-    service.fit_baseline(load_traces_csv(args.normal))
+    sched_overrides = {}
+    if getattr(args, "tenant_weight", None):
+        sched_overrides["tenant_weights"] = _parse_tenant_floats(
+            args.tenant_weight, "--tenant-weight"
+        )
+    if getattr(args, "tenant_rate", None):
+        sched_overrides["tenant_rates"] = _parse_tenant_floats(
+            args.tenant_rate, "--tenant-rate"
+        )
+    if sched_overrides:
+        cfg = cfg.replace(
+            sched=dataclasses.replace(cfg.sched, **sched_overrides)
+        )
+
+    codeploy = bool(
+        getattr(args, "stream_input", None)
+        or getattr(args, "backfill", None)
+    )
+    sched = None
+    if codeploy:
+        from ..sched import DeviceScheduler, ParkedWindowStore
+
+        store = ParkedWindowStore(cfg.sched, serve_cfg=cfg.serve)
+        sched = DeviceScheduler(store)
+        sched.start()
+        log.info(
+            "co-deploy: unified device scheduler up (lanes: "
+            "incident > serve > backfill)"
+        )
+
+    normal_df = load_traces_csv(args.normal)
+    service = ServeService(cfg, out_dir=args.output, sched=sched)
+    service.fit_baseline(normal_df)
     for spec in args.dataset or ():
         name, _, path = spec.partition("=")
         if not name or not path:
             log.error("--dataset takes NAME=CSV_PATH, got %r", spec)
             return 2
         service.add_dataset(name, load_traces_csv(path))
+
+    side_threads = []
+    engine = None
+    if getattr(args, "stream_input", None):
+        from ..stream import FileTailSource, StreamEngine
+
+        stream_out = (
+            str(Path(args.output) / "stream") if args.output else None
+        )
+        engine = StreamEngine(
+            cfg,
+            FileTailSource(
+                args.stream_input,
+                parse_retry_max=cfg.ingest.parse_retry_max,
+            ),
+            out_dir=stream_out,
+            normal_df=normal_df,
+            sched=sched,
+        )
+        t = threading.Thread(
+            target=engine.run, name="co-stream", daemon=True
+        )
+        t.start()
+        side_threads.append(t)
+        log.info(
+            "co-deploy: stream engine tailing %s (incident lane "
+            "preempts serve)", args.stream_input,
+        )
+
+    if getattr(args, "backfill", None):
+        from ..warehouse import parse_time_range, replay_range
+
+        t0_us, t1_us = parse_time_range(
+            getattr(args, "backfill_range", None) or "all"
+        )
+
+        def _backfill():
+            report = replay_range(
+                args.backfill, t0_us, t1_us, config=cfg, sched=sched
+            )
+            log.info(
+                "co-deploy backfill done: verdict=%s ranked=%d "
+                "matched=%d",
+                report["verdict"], report["ranked"], report["matched"],
+            )
+
+        t = threading.Thread(
+            target=_backfill, name="co-backfill", daemon=True
+        )
+        t.start()
+        side_threads.append(t)
+        log.info(
+            "co-deploy: warehouse backfill of %s on the backfill lane",
+            args.backfill,
+        )
+
     service.start()
-    return run_serve(service, cfg.serve.host, cfg.serve.port)
+    rc = run_serve(service, cfg.serve.host, cfg.serve.port)
+    if engine is not None:
+        engine.request_stop()
+    for t in side_threads:
+        t.join(timeout=30)
+    if sched is not None:
+        sched.stop(drain=True, timeout=30)
+    return rc
 
 
 def cmd_stream(args) -> int:
@@ -1625,6 +1744,34 @@ def main(argv=None) -> int:
         "--inject-dispatch-failures", type=int, default=None,
         help="chaos/test knob: fail this many device dispatches with "
         "an injected error (drives the degradation path)",
+    )
+    p_srv.add_argument(
+        "--stream-input", default=None, metavar="TRACES_CSV",
+        help="co-deploy: tail this growing trace file through a stream "
+        "engine sharing the device via the unified scheduler — "
+        "open-incident work preempts interactive serve requests",
+    )
+    p_srv.add_argument(
+        "--backfill", default=None, metavar="WAREHOUSE_DIR",
+        help="co-deploy: replay this trace warehouse on the lowest-"
+        "priority backfill lane of the unified scheduler (never "
+        "jumps ahead of serve or incident work)",
+    )
+    p_srv.add_argument(
+        "--backfill-range", default=None, metavar="START..END",
+        help='time range for --backfill (epoch-us ints or pandas-'
+        'parsable timestamps; default "all")',
+    )
+    p_srv.add_argument(
+        "--tenant-weight", action="append", metavar="NAME=W",
+        help="weighted fair share: tenant NAME gets W times the "
+        "device turns of a weight-1 tenant (repeatable)",
+    )
+    p_srv.add_argument(
+        "--tenant-rate", action="append", metavar="NAME=R",
+        help="soft token-bucket quota: tenant NAME refills R windows/s "
+        "(0 = background class: runs only when in-quota tenants are "
+        "idle; unlisted tenants are unlimited) (repeatable)",
     )
     _add_config_flags(p_srv)
     p_srv.set_defaults(fn=cmd_serve)

@@ -853,6 +853,49 @@ class StreamConfig:
 
 
 @dataclass(frozen=True)
+class SchedConfig:
+    """Unified multi-tenant device scheduler (``sched/`` subsystem).
+
+    Serve's bucket batcher, stream's gated dispatch and warehouse/replay
+    backfill all park prepared window graphs into ONE shared
+    parked-window store (keyed by the dispatch router's (kernel,
+    padded-leaf-shapes) bucket key); a single scheduler thread dequeues
+    by priority lane (open-incident hot path > interactive serve >
+    backfill), weighted fair share across tenants (stride scheduling)
+    and per-tenant token-bucket quotas. Quotas are SOFT: a tenant out
+    of tokens is deprioritized behind in-quota tenants but still served
+    when the device would otherwise idle — the scheduler is
+    work-conserving, so a zero-rate (background) tenant can never
+    starve others and is never starved outright itself.
+    """
+
+    # Weighted fair share: (tenant, weight) pairs; a tenant's long-run
+    # share of dispatched windows under contention converges to
+    # weight / sum(weights of backlogged tenants). Unlisted tenants get
+    # default_weight.
+    tenant_weights: Tuple[Tuple[str, float], ...] = ()
+    default_weight: float = 1.0
+    # Soft token-bucket quotas: (tenant, windows/second) refill rates.
+    # Unlisted tenants are unthrottled; rate 0 marks a pure background
+    # tenant (dispatched only when no in-quota work is ready).
+    tenant_rates: Tuple[Tuple[str, float], ...] = ()
+    # Token bucket capacity (windows) — the burst a quota'd tenant may
+    # spend at once after idling.
+    burst: float = 8.0
+    # Tenant names the non-serve lanes charge their dispatches to.
+    stream_tenant: str = "stream"
+    backfill_tenant: str = "backfill"
+    # Shape-faithful warmup: replay the warmup manifest's recorded
+    # production pad-bucket shapes (kernel, occupancy, leaf shapes) at
+    # startup so the first real window's jit lookup is a cache hit.
+    shape_warmup: bool = True
+    # Manifest cap: at most this many recorded shape signatures per
+    # (pipeline, kernel) — bounds both the manifest file and the
+    # startup replay time.
+    max_shapes: int = 8
+
+
+@dataclass(frozen=True)
 class WarehouseConfig:
     """Trace warehouse knobs (``warehouse/`` subsystem).
 
@@ -903,6 +946,7 @@ class MicroRankConfig:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     warehouse: WarehouseConfig = field(default_factory=WarehouseConfig)
+    sched: SchedConfig = field(default_factory=SchedConfig)
 
     @classmethod
     def reference_compat(cls) -> "MicroRankConfig":
@@ -935,6 +979,12 @@ class MicroRankConfig:
                 flt["stage_budgets"] = tuple(
                     (str(s), float(b)) for s, b in flt["stage_budgets"]
                 )
+            if typ is SchedConfig:
+                for key in ("tenant_weights", "tenant_rates"):
+                    if flt.get(key) is not None:
+                        flt[key] = tuple(
+                            (str(t), float(v)) for t, v in flt[key]
+                        )
             return typ(**flt)
 
         return cls(
@@ -954,4 +1004,5 @@ class MicroRankConfig:
             ingest=_mk(IngestConfig, d.get("ingest", {})),
             watchdog=_mk(WatchdogConfig, d.get("watchdog", {})),
             warehouse=_mk(WarehouseConfig, d.get("warehouse", {})),
+            sched=_mk(SchedConfig, d.get("sched", {})),
         )

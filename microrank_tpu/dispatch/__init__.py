@@ -16,11 +16,17 @@ from .cache import (
     load_manifest,
     manifest_kernels,
     manifest_occupancies,
+    manifest_shapes,
     record_manifest_entry,
     resolve_cache_dir,
 )
 from .router import DispatchRouter, RouteInfo, bucket_key
-from .warmup import synthetic_prepared, warm_occupancies
+from .warmup import (
+    graph_like,
+    synthetic_prepared,
+    warm_manifest_shapes,
+    warm_occupancies,
+)
 
 __all__ = [
     "CompileCacheProbe",
@@ -29,11 +35,14 @@ __all__ = [
     "WARMUP_MANIFEST_NAME",
     "bucket_key",
     "configure_compile_cache",
+    "graph_like",
     "load_manifest",
     "manifest_kernels",
     "manifest_occupancies",
+    "manifest_shapes",
     "record_manifest_entry",
     "resolve_cache_dir",
     "synthetic_prepared",
+    "warm_manifest_shapes",
     "warm_occupancies",
 ]

@@ -163,34 +163,85 @@ def load_manifest(cache_dir: Optional[str]) -> List[dict]:
         return []
 
 
+def _shape_sig(shape: dict) -> tuple:
+    return (
+        int(shape.get("occupancy", 1)),
+        tuple(tuple(int(d) for d in leaf)
+              for leaf in shape.get("leaves", [])),
+    )
+
+
 def record_manifest_entry(
     cache_dir: Optional[str],
     pipeline: str,
     kernel: str,
     occupancies,
+    shapes=None,
+    max_shapes: int = 8,
 ) -> None:
     """Merge one warmed program shape into the manifest (occupancies
-    union per (pipeline, kernel) key); best-effort."""
+    union per (pipeline, kernel) key); best-effort.
+
+    ``shapes`` — optional production pad-bucket records, each
+    ``{"occupancy": n, "leaves": [[dims...], ...]}`` (the graph's
+    padded leaf shapes, i.e. ``bucket_key(graph, kernel)[1:]``). These
+    let a restart replay the EXACT jit-cache keys the previous process
+    served instead of synthetic approximations; kept newest-first,
+    deduped, capped at ``max_shapes`` per (pipeline, kernel).
+    """
     if not cache_dir:
         return
     try:
         entries = load_manifest(cache_dir)
         occs = sorted({int(o) for o in occupancies})
+        new_shapes = [
+            {
+                "occupancy": int(s.get("occupancy", 1)),
+                "leaves": [
+                    [int(d) for d in leaf] for leaf in s.get("leaves", [])
+                ],
+            }
+            for s in (shapes or [])
+        ]
         for e in entries:
             if e.get("pipeline") == pipeline and e.get("kernel") == kernel:
                 merged = sorted(set(e.get("occupancies", [])) | set(occs))
-                if merged == e.get("occupancies"):
+                old_shapes = list(e.get("shapes", []))
+                seen = set()
+                merged_shapes = []
+                for s in new_shapes + old_shapes:
+                    sig = _shape_sig(s)
+                    if sig in seen:
+                        continue
+                    seen.add(sig)
+                    merged_shapes.append(s)
+                merged_shapes = merged_shapes[: max(0, int(max_shapes))]
+                if (
+                    merged == e.get("occupancies")
+                    and merged_shapes == old_shapes
+                ):
                     return  # nothing new — skip the write
                 e["occupancies"] = merged
+                if merged_shapes:
+                    e["shapes"] = merged_shapes
                 break
         else:
-            entries.append(
-                {
-                    "pipeline": pipeline,
-                    "kernel": kernel,
-                    "occupancies": occs,
-                }
-            )
+            entry = {
+                "pipeline": pipeline,
+                "kernel": kernel,
+                "occupancies": occs,
+            }
+            if new_shapes:
+                seen = set()
+                deduped = []
+                for s in new_shapes:
+                    sig = _shape_sig(s)
+                    if sig in seen:
+                        continue
+                    seen.add(sig)
+                    deduped.append(s)
+                entry["shapes"] = deduped[: max(0, int(max_shapes))]
+            entries.append(entry)
         # Atomic + durable (tmp+fsync+rename, utils.atomic): the bare
         # tmp+replace this used to do was atomic against readers but a
         # power cut could still leave an empty rename target.
@@ -214,6 +265,23 @@ def manifest_occupancies(
         if e.get("pipeline") == pipeline:
             occs.update(int(o) for o in e.get("occupancies", []))
     return sorted(occs)
+
+
+def manifest_shapes(
+    cache_dir: Optional[str], pipeline: str
+) -> List[tuple]:
+    """Production pad-bucket shapes a previous ``pipeline`` process
+    recorded: ``(kernel, occupancy, leaves)`` tuples with ``leaves`` a
+    tuple of leaf-shape tuples — the full jit-cache key modulo config.
+    Shape-faithful warmup replays these at startup."""
+    out = []
+    for e in load_manifest(cache_dir):
+        if e.get("pipeline") != pipeline or not e.get("kernel"):
+            continue
+        for s in e.get("shapes", []):
+            occ, leaves = _shape_sig(s)
+            out.append((str(e["kernel"]), occ, leaves))
+    return out
 
 
 def manifest_kernels(

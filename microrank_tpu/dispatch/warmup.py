@@ -40,6 +40,116 @@ def synthetic_prepared(config) -> Optional[Tuple[object, list, str]]:
     return prepare_window_graph(case.abnormal, nrm, abn, config)
 
 
+def graph_like(config, kernel: str, leaves_shapes) -> Optional[object]:
+    """A dispatchable window graph whose padded leaf shapes equal
+    ``leaves_shapes`` (one shape tuple per pytree leaf — a recorded
+    ``bucket_key(graph, kernel)[1:]``), so dispatching it traces the
+    EXACT jit program a production window of that pad bucket hits.
+
+    Built by preparing the synthetic warmup window with the target
+    kernel forced, then resizing each leaf to the recorded shape
+    (zero-fill, overlapping region copied from the synthetic values so
+    the numerics stay tame). Returns None when the recorded signature
+    no longer matches this build's pytree (kernel/config drift) — the
+    caller skips that manifest entry rather than warming a program no
+    request will ever hit.
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    forced = dataclasses.replace(
+        config, runtime=dataclasses.replace(config.runtime, kernel=kernel)
+    )
+    prepared = synthetic_prepared(forced)
+    if prepared is None:
+        return None
+    graph, _, built_kernel = prepared
+    if built_kernel != kernel:  # pragma: no cover - forced above
+        return None
+    leaves, treedef = jax.tree.flatten(graph)
+    targets = [tuple(int(d) for d in s) for s in leaves_shapes]
+    if len(leaves) != len(targets):
+        return None
+    out = []
+    for leaf, target in zip(leaves, targets):
+        src = np.asarray(leaf)
+        if src.shape == target:
+            out.append(leaf)
+            continue
+        if src.ndim != len(target):
+            return None
+        dst = np.zeros(target, dtype=src.dtype)
+        overlap = tuple(
+            slice(0, min(a, b)) for a, b in zip(src.shape, target)
+        )
+        dst[overlap] = src[overlap]
+        out.append(dst)
+    return jax.tree.unflatten(treedef, out)
+
+
+def warm_manifest_shapes(
+    router,
+    config,
+    cache_dir,
+    pipeline: str,
+    probe=None,
+) -> int:
+    """Shape-faithful warmup: replay every production pad-bucket shape
+    the manifest recorded for ``pipeline`` (dispatch.cache
+    ``manifest_shapes``) through the router, so a restarted process
+    compiles — or reloads from the persistent cache — the same jit
+    programs it served before going down, not just synthetic
+    approximations. Returns the number of (kernel, occupancy, shapes)
+    signatures warmed; each failure skips that signature only."""
+    from ..dispatch.cache import manifest_shapes
+    from ..obs.spans import get_tracer
+
+    sigs = manifest_shapes(cache_dir, pipeline)
+    if not sigs:
+        return 0
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.enabled = False
+    warmed = 0
+    try:
+        conv = bool(config.runtime.convergence_trace)
+        for kernel, occ, leaves_shapes in sigs:
+            try:
+                graph = graph_like(config, kernel, leaves_shapes)
+                if graph is None:
+                    _record_warm_shape("skipped")
+                    continue
+                router.rank_batch(
+                    [graph] * max(1, int(occ)), kernel,
+                    conv_trace=conv, record=False,
+                )
+                if probe is not None:
+                    probe.observe()
+                warmed += 1
+                _record_warm_shape("warmed")
+            except Exception as exc:  # noqa: BLE001 - one stale
+                # signature must not abort the rest of the warmup
+                log.warning(
+                    "shape warmup failed for kernel=%s occ=%d (%s)",
+                    kernel, occ, exc,
+                )
+                _record_warm_shape("failed")
+        return warmed
+    finally:
+        tracer.enabled = was_enabled
+
+
+def _record_warm_shape(outcome: str) -> None:
+    try:
+        from ..obs.metrics import record_warm_shape
+
+        record_warm_shape(outcome)
+    except Exception:  # pragma: no cover - metrics best-effort
+        pass
+
+
 def warm_occupancies(
     router,
     config,

@@ -1,5 +1,10 @@
 from .interning import Vocab, factorize_local
-from .loader import load_traces_csv, window_spans
+from .loader import (
+    frame_from_records,
+    load_traces_csv,
+    parse_span_times,
+    window_spans,
+)
 from .naming import operation_names, service_operation_list
 from .schema import (
     CLICKHOUSE_RENAME,
@@ -12,6 +17,8 @@ from .schema import (
 __all__ = [
     "Vocab",
     "factorize_local",
+    "frame_from_records",
+    "parse_span_times",
     "load_traces_csv",
     "window_spans",
     "operation_names",

@@ -710,6 +710,68 @@ def host_steal_gauge() -> Gauge:
     )
 
 
+def sched_dispatches() -> Counter:
+    return get_registry().counter(
+        "microrank_sched_dispatch_windows_total",
+        "Windows dispatched by the unified device scheduler, by "
+        "priority lane and tenant — the fair-share observable: "
+        "per-tenant rates under sustained contention converge to "
+        "SchedConfig.tenant_weights",
+        labelnames=("lane", "tenant"),
+    )
+
+
+def sched_parked() -> Gauge:
+    return get_registry().gauge(
+        "microrank_sched_parked_windows",
+        "Entries currently parked in the shared window store, by lane "
+        "(incident | serve | backfill)",
+        labelnames=("lane",),
+    )
+
+
+def sched_expired() -> Counter:
+    return get_registry().counter(
+        "microrank_sched_expired_total",
+        "Parked entries whose deadline lapsed before dequeue — the "
+        "scheduler answered them (504) instead of burning device time "
+        "on an abandoned request",
+    )
+
+
+def sched_throttled() -> Counter:
+    return get_registry().counter(
+        "microrank_sched_throttled_total",
+        "Batches dispatched while their tenant's token bucket was "
+        "empty (quotas are soft: the batch still ran because nothing "
+        "in-quota was ready — work-conserving by design)",
+        labelnames=("tenant",),
+    )
+
+
+def sched_wait_seconds() -> Histogram:
+    return get_registry().histogram(
+        "microrank_sched_wait_seconds",
+        "Seconds a batch's oldest entry sat parked before dispatch, "
+        "by lane — incident staying at the low buckets while backfill "
+        "absorbs the queueing IS the priority policy working",
+        labelnames=("lane",),
+        buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0),
+    )
+
+
+def warm_shapes() -> Counter:
+    return get_registry().counter(
+        "microrank_warm_shapes_total",
+        "Shape-faithful warmup replays of recorded production pad "
+        "buckets at startup (warmed = program traced/reloaded, "
+        "skipped = recorded signature no longer matches this build, "
+        "failed = dispatch raised)",
+        labelnames=("outcome",),  # warmed | skipped | failed
+    )
+
+
 def ensure_catalog() -> None:
     """Register the whole canonical metric set in the current registry
     (no samples added). Snapshot/exposition paths call this so a scrape
@@ -745,6 +807,8 @@ def ensure_catalog() -> None:
         host_load_gauge, host_steal_gauge,
         warehouse_segments, warehouse_windows, warehouse_spans,
         warehouse_bytes, warehouse_replays,
+        sched_dispatches, sched_parked, sched_expired,
+        sched_throttled, sched_wait_seconds, warm_shapes,
     ):
         ctor()
 
@@ -807,6 +871,31 @@ def record_dispatch_route(
 def record_compile_cache(event: str, n: int = 1) -> None:
     if n > 0:
         compile_cache_events().inc(float(n), event=event)
+
+
+def record_sched_dispatch(lane: str, tenant: str, windows: int) -> None:
+    sched_dispatches().inc(float(windows), lane=lane, tenant=tenant)
+
+
+def record_sched_parked(lane: str, depth: int) -> None:
+    sched_parked().set(float(depth), lane=lane)
+
+
+def record_sched_expired(n: int = 1) -> None:
+    if n > 0:
+        sched_expired().inc(float(n))
+
+
+def record_sched_throttled(tenant: str) -> None:
+    sched_throttled().inc(tenant=tenant)
+
+
+def record_sched_wait(lane: str, seconds: float) -> None:
+    sched_wait_seconds().observe(float(seconds), lane=lane)
+
+
+def record_warm_shape(outcome: str) -> None:
+    warm_shapes().inc(outcome=outcome)
 
 
 def record_build_pool(

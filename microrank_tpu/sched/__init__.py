@@ -1,0 +1,30 @@
+"""Unified multi-tenant device scheduler.
+
+One parked-window store for every lane (serve, stream, warehouse
+backfill) plus the single consumer thread that owns the device when
+lanes are co-deployed. See ``DESIGN.md`` § "Unified scheduler".
+"""
+
+from .scheduler import DeviceScheduler
+from .store import (
+    LANE_BACKFILL,
+    LANE_INCIDENT,
+    LANE_NAMES,
+    LANE_SERVE,
+    ParkedEntry,
+    ParkedWindowStore,
+    TokenBucket,
+    WeightedFairQueue,
+)
+
+__all__ = [
+    "DeviceScheduler",
+    "LANE_BACKFILL",
+    "LANE_INCIDENT",
+    "LANE_NAMES",
+    "LANE_SERVE",
+    "ParkedEntry",
+    "ParkedWindowStore",
+    "TokenBucket",
+    "WeightedFairQueue",
+]

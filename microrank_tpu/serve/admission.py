@@ -25,6 +25,36 @@ class AdmissionController:
         register_shared("serve_admission", {"serve_admission"})
         self._depth = 0
         self._closed = False
+        # EWMA of measured per-window service cost (seconds), fed by
+        # the batcher after each device dispatch. None until the first
+        # window completes — Retry-After then falls back to the
+        # configured constant.
+        self._cost_ewma = None
+
+    def observe_window_cost(self, seconds: float) -> None:
+        """One completed window's measured service cost; smoothed into
+        the EWMA that prices Retry-After."""
+        s = max(0.0, float(seconds))
+        with self._lock:
+            note_shared_access("serve_admission")
+            if self._cost_ewma is None:
+                self._cost_ewma = s
+            else:
+                self._cost_ewma = 0.2 * s + 0.8 * self._cost_ewma
+
+    def retry_after(self) -> float:
+        """Seconds a 429/503 caller should back off: current queue
+        depth × measured per-window cost — the queue's actual drain
+        time — instead of the static configured constant (which remains
+        the floor, and the answer until the first window has been
+        measured)."""
+        with self._lock:
+            note_shared_access("serve_admission")
+            if self._cost_ewma is None:
+                return self.retry_after_seconds
+            return max(
+                self.retry_after_seconds, self._depth * self._cost_ewma
+            )
 
     def try_admit(self) -> bool:
         """One admission slot, or False (429 / 503 at the caller)."""

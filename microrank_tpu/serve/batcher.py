@@ -124,7 +124,7 @@ class MicroBatcher:
 
     def __init__(
         self, config: MicroRankConfig, journal=None, router=None,
-        flight=None,
+        flight=None, store=None,
     ):
         from ..dispatch import DispatchRouter
 
@@ -139,6 +139,13 @@ class MicroBatcher:
         self.router = (
             router if router is not None else DispatchRouter(config)
         )
+        # Co-deploy mode: a sched.ParkedWindowStore shared with the
+        # stream engine and backfill. Built windows then park THERE
+        # (lane=serve, keyed by the same bucket key) and the unified
+        # DeviceScheduler — not the serve scheduler thread — dequeues
+        # and calls ``dispatch`` back. Solo serve (store=None) keeps the
+        # private buckets below, byte-for-byte the old behavior.
+        self.store = store
         from ..utils.guards import TrackedLock, register_shared
 
         # The scheduler thread parks/pops; HTTP threads read stats —
@@ -149,25 +156,82 @@ class MicroBatcher:
         self._buckets: Dict[Tuple, List[PendingWindow]] = {}
         self._inject_failures = int(self.serve.inject_dispatch_failures)
         self.dispatches = 0
+        # Retry-After pricing: set by ServeService to the admission
+        # controller's cost observer; called with measured per-window
+        # seconds after each successful dispatch.
+        self.cost_observer: Optional[Callable[[float], None]] = None
+        # Shape-faithful warmup: set by ServeService to its compile
+        # cache dir; each distinct (kernel, occupancy, leaf shapes)
+        # this batcher dispatches is recorded into the warmup manifest
+        # once, so a restart replays the exact production pad buckets.
+        self.cache_dir: Optional[str] = None
+        self._recorded_shapes: set = set()
 
     # ------------------------------------------------------------ intake
     def submit(self, pw: PendingWindow) -> None:
         from ..utils.guards import note_shared_access
 
         key = bucket_key(pw.graph, pw.kernel)
+        if self.store is not None:
+            self._park_shared(pw, key)
+            return
         with self._lock:
             note_shared_access("serve_buckets")
             self._buckets.setdefault(key, []).append(pw)
 
+    def _park_shared(self, pw: PendingWindow, key) -> None:
+        """Co-deploy intake: park into the shared store's serve lane.
+        The DeviceScheduler dequeues by lane/fair-share/quota policy
+        and calls ``dispatch`` with the coalesced batch; a deadline
+        that lapses while parked expires at dequeue (504, same journal
+        event as the private-bucket path)."""
+        from ..sched import LANE_SERVE, ParkedEntry
+
+        dl = getattr(pw.request, "deadline_ms", None)
+        deadline = pw.enqueued + float(dl) / 1e3 if dl else None
+        self.store.park(ParkedEntry(
+            LANE_SERVE, pw.request.tenant, key, pw,
+            runner=self.dispatch,
+            expire=self._expire_parked,
+            deadline=deadline,
+        ))
+
+    def _expire_parked(self, pw: PendingWindow) -> None:
+        from .protocol import DeadlineExceeded
+
+        waited_ms = (time.monotonic() - pw.enqueued) * 1e3
+        dl = float(getattr(pw.request, "deadline_ms", 0) or 0)
+        pw.result.skipped_reason = "deadline_expired"
+        if self.journal is not None:
+            self.journal.emit(
+                "request_deadline_expired",
+                request_id=pw.request.request_id,
+                tenant=pw.request.tenant,
+                deadline_ms=dl,
+                waited_ms=round(waited_ms, 3),
+                stage="batch",
+            )
+        pw.finish(error=DeadlineExceeded(
+            f"request {pw.request.request_id} expired before dispatch: "
+            f"waited {waited_ms:.0f} ms of a {dl:.0f} ms deadline"
+        ))
+
     def pending(self) -> int:
         from ..utils.guards import note_shared_access
 
+        if self.store is not None:
+            from ..sched import LANE_SERVE
+
+            return self.store.pending(LANE_SERVE)
         with self._lock:
             note_shared_access("serve_buckets")
             return sum(len(v) for v in self._buckets.values())
 
     def next_deadline(self) -> Optional[float]:
-        """Monotonic time the oldest parked request must flush by."""
+        """Monotonic time the oldest parked request must flush by.
+        Co-deployed, flush timing belongs to the DeviceScheduler."""
+        if self.store is not None:
+            return None
         wait_s = max(0.0, float(self.serve.max_wait_ms)) / 1e3
         with self._lock:
             oldest = min(
@@ -179,6 +243,8 @@ class MicroBatcher:
     def take_ready(self, force: bool = False) -> List[List[PendingWindow]]:
         """Pop every bucket that is full, past its max-wait deadline, or
         (``force``, drain mode) non-empty."""
+        if self.store is not None:
+            return []  # the DeviceScheduler drains the shared store
         now = time.monotonic()
         wait_s = max(0.0, float(self.serve.max_wait_ms)) / 1e3
         cap = max(1, int(self.serve.max_batch_windows))
@@ -288,6 +354,11 @@ class MicroBatcher:
             from ..obs.metrics import record_serve_batch
 
             record_serve_batch(len(items))
+            if self.cost_observer is not None:
+                # Measured per-window cost -> admission's Retry-After
+                # EWMA: a 429's back-off then prices actual drain time.
+                self.cost_observer(batch_ms / 1e3 / max(1, len(items)))
+            self._record_shapes(items, route_info)
         self.dispatches += 1
         self._explain_requests(items)
         self._journal_batch(
@@ -296,6 +367,39 @@ class MicroBatcher:
         )
         for pw in items:
             pw.finish()
+
+    def _record_shapes(self, items, route_info) -> None:
+        """Write this batch's (kernel, occupancy, padded leaf shapes)
+        into the warmup manifest, once per distinct signature — a
+        restarted process replays the EXACT production pad buckets
+        (dispatch.warmup.warm_manifest_shapes), so its first real
+        window after warmup is a jit-cache hit."""
+        sched_cfg = getattr(self.config, "sched", None)
+        if (
+            self.cache_dir is None
+            or sched_cfg is None
+            or not sched_cfg.shape_warmup
+            or not self.config.dispatch.warmup_manifest
+            or not items
+            or items[0].graph is None
+        ):
+            return
+        kernel = route_info.kernel if route_info else items[0].kernel
+        leaves = bucket_key(items[0].graph, kernel)[1:]
+        sig = (kernel, len(items), leaves)
+        if sig in self._recorded_shapes:
+            return
+        self._recorded_shapes.add(sig)
+        from ..dispatch import record_manifest_entry
+
+        record_manifest_entry(
+            self.cache_dir, "serve", kernel, [len(items)],
+            shapes=[{
+                "occupancy": len(items),
+                "leaves": [list(s) for s in leaves],
+            }],
+            max_shapes=sched_cfg.max_shapes,
+        )
 
     def _explain_requests(self, items: List[PendingWindow]) -> None:
         """Rank provenance for ``explain: true`` members: ONE extra

@@ -189,26 +189,37 @@ def parse_rank_request(
 
 def spans_to_frame(spans: List[dict]):
     """Inline span records -> the canonical span DataFrame (same rename
-    + column contract as CSV ingest, io.loader)."""
+    + column contract as CSV ingest, io.loader).
+
+    Large POST payloads take the columnar fast path
+    (io.loader.frame_from_records — one pass per column, vectorized
+    ISO8601 timestamp parse); payload shapes the fast path declines
+    (empty, heterogeneous rows) fall back to the legacy row-wise parse.
+    """
     import pandas as pd
 
+    from ..io.loader import frame_from_records
     from ..io.schema import CLICKHOUSE_RENAME, validate_columns
 
-    df = pd.DataFrame(spans).rename(columns=CLICKHOUSE_RENAME)
+    df = frame_from_records(spans)
+    if df is None:
+        df = pd.DataFrame(spans).rename(columns=CLICKHOUSE_RENAME)
+        # Timestamps coerce rather than raise: one malformed row must
+        # not abort the request — the admission ladder (serve.server)
+        # routes NaT rows to the dead-letter store and ranks the clean
+        # subset (422 via AdmissionError only when NOTHING survives).
+        if "startTime" in df.columns:
+            df["startTime"] = pd.to_datetime(
+                df["startTime"], format="mixed", errors="coerce"
+            )
+        if "endTime" in df.columns:
+            df["endTime"] = pd.to_datetime(
+                df["endTime"], format="mixed", errors="coerce"
+            )
     try:
         validate_columns(df.columns)
     except ValueError as e:
         raise ProtocolError(str(e)) from None
-    # Timestamps coerce rather than raise: one malformed row must not
-    # abort the request — the admission ladder (serve.server) routes
-    # NaT rows to the dead-letter store and ranks the clean subset
-    # (422 via AdmissionError only when NOTHING survives).
-    df["startTime"] = pd.to_datetime(
-        df["startTime"], format="mixed", errors="coerce"
-    )
-    df["endTime"] = pd.to_datetime(
-        df["endTime"], format="mixed", errors="coerce"
-    )
     return df
 
 

@@ -24,10 +24,10 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict, deque
 from concurrent.futures import Future
 from typing import Callable, Optional
 
+from ..sched import WeightedFairQueue
 from .batcher import MicroBatcher
 from .protocol import RankRequest
 
@@ -43,17 +43,33 @@ class ShutdownError(RuntimeError):
 class BatchScheduler(threading.Thread):
     def __init__(
         self, service, journal=None, build_pool=None, router=None,
-        flight=None,
+        flight=None, sched=None,
     ):
         super().__init__(name="mr-serve-sched", daemon=True)
         self.service = service
+        # Co-deploy: ``sched`` is the unified DeviceScheduler sharing
+        # the device with stream/backfill. Built windows then park into
+        # ITS store (the batcher dispatches when called back from the
+        # scheduler thread that owns the device); this thread keeps the
+        # host half — fair dequeue and build-pool handoff — and never
+        # touches the device. Solo (sched=None) it owns the device
+        # exactly as before.
+        self.sched = sched
         self.batcher = MicroBatcher(
-            service.config, journal=journal, router=router, flight=flight
+            service.config, journal=journal, router=router, flight=flight,
+            store=sched.store if sched is not None else None,
         )
         self.build_pool = build_pool
         self._cond = threading.Condition()
-        self._tenants: "OrderedDict[str, deque]" = OrderedDict()
-        self._rr = 0                 # round-robin cursor over tenant keys
+        # Weighted fair dequeue across tenant FIFOs (sched.store): with
+        # the default all-equal weights the pop order is exactly the
+        # old round-robin interleave; SchedConfig.tenant_weights skews
+        # turns toward heavier tenants.
+        sched_cfg = getattr(service.config, "sched", None)
+        self._queue = WeightedFairQueue(
+            dict(sched_cfg.tenant_weights) if sched_cfg else {},
+            sched_cfg.default_weight if sched_cfg else 1.0,
+        )
         self._builds = 0             # host builds in flight on the pool
         self._stopping = False
         self._draining = False
@@ -83,33 +99,26 @@ class BatchScheduler(threading.Thread):
             if self._stopping:
                 fut.set_exception(ShutdownError("service shutting down"))
                 return fut
-            self._tenants.setdefault(request.tenant, deque()).append(entry)
+            self._queue.push(request.tenant, entry)
             self._cond.notify()
         return fut
 
     def queued(self) -> int:
         with self._cond:
-            return sum(len(q) for q in self._tenants.values())
+            return len(self._queue)
 
     # ------------------------------------------------------- fair dequeue
     def _pop_fair(self, timeout: float):
-        """Round-robin pop across tenant FIFOs: each turn serves the
-        next tenant that has work, so interleaved arrivals from N
-        tenants dequeue N-fairly regardless of per-tenant burst size."""
+        """Weighted-fair pop across tenant FIFOs (stride scheduling,
+        sched.WeightedFairQueue): each turn serves the backlogged
+        tenant with the least accumulated virtual time, so one chatty
+        tenant cannot starve the rest — and configured tenant weights
+        buy proportionally more turns. Equal weights reproduce the old
+        round-robin interleave exactly."""
         with self._cond:
-            if not any(self._tenants.values()):
+            if not self._queue:
                 self._cond.wait(timeout=max(0.0, timeout))
-            names = list(self._tenants)
-            for i in range(len(names)):
-                name = names[(self._rr + i) % len(names)]
-                q = self._tenants.get(name)
-                if q:
-                    self._rr = (names.index(name) + 1) % max(1, len(names))
-                    entry = q.popleft()
-                    if not q:
-                        del self._tenants[name]
-                    return entry
-        return None
+            return self._queue.pop()
 
     # --------------------------------------------------------------- run
     def run(self) -> None:
@@ -118,8 +127,11 @@ class BatchScheduler(threading.Thread):
         # The scheduler thread IS the device owner on the serve path
         # (mrlint R8 / mrsan): every staging/dispatch/fetch and the
         # degrade fallback happen here; the HTTP threads only enqueue
-        # and the build pool only does host work.
-        claim_device_owner("serve-scheduler")
+        # and the build pool only does host work. Co-deployed, the
+        # unified DeviceScheduler owns the device instead — this thread
+        # then only dequeues/builds and parks into the shared store.
+        if self.sched is None:
+            claim_device_owner("serve-scheduler")
         while True:
             deadline = self.batcher.next_deadline()
             timeout = (
@@ -139,19 +151,24 @@ class BatchScheduler(threading.Thread):
             with self._cond:
                 force = (
                     self._stopping
-                    and not any(self._tenants.values())
+                    and not self._queue
                     and self._builds == 0
                 )
             # All ready batches dispatch through the router pipelined:
             # batch i+1's staging (host pack + H2D) overlaps batch i's
             # device execution (dispatch router double-buffering).
+            # Co-deployed, take_ready is empty (windows parked in the
+            # shared store) and a drain instead force-kicks the
+            # unified scheduler to flush the serve lane.
             self.batcher.dispatch_ready(
                 self.batcher.take_ready(force=force)
             )
+            if self.sched is not None and force:
+                self.sched.kick(force=True)
             with self._cond:
                 if (
                     self._stopping
-                    and not any(self._tenants.values())
+                    and not self._queue
                     and self._builds == 0
                     and self.batcher.pending() == 0
                 ):
@@ -250,13 +267,13 @@ class BatchScheduler(threading.Thread):
             self._stopping = True
             self._draining = drain
             if not drain:
-                for q in self._tenants.values():
-                    for request, fut, _, on_done, _ctx in q:
-                        err = ShutdownError("service shutting down")
-                        fut.set_exception(err)
-                        if on_done is not None:
-                            on_done(None, err)
-                self._tenants.clear()
+                for request, fut, _, on_done, _ctx in (
+                    self._queue.drain_items()
+                ):
+                    err = ShutdownError("service shutting down")
+                    fut.set_exception(err)
+                    if on_done is not None:
+                        on_done(None, err)
             self._cond.notify_all()
         if self.is_alive():
             self.join(timeout=timeout)

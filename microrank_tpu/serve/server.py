@@ -60,9 +60,13 @@ class ServiceDraining(Exception):
 class ServeService:
     """Service facade: baseline + datasets + admission + scheduler."""
 
-    def __init__(self, config: MicroRankConfig, out_dir=None):
+    def __init__(self, config: MicroRankConfig, out_dir=None, sched=None):
         self.config = config
         self.serve = config.serve
+        # Co-deploy: a sched.DeviceScheduler shared with stream/replay
+        # lanes. The batch scheduler then parks built windows into its
+        # store instead of owning the device itself.
+        self.sched = sched
         self.log = get_logger("microrank_tpu.serve")
         self.admission = AdmissionController(
             self.serve.max_queue_depth, self.serve.retry_after_seconds
@@ -109,7 +113,18 @@ class ServeService:
             build_pool=self.build_pool,
             router=self.router,
             flight=self.flight,
+            sched=sched,
         )
+        # Dynamic Retry-After: the batcher feeds measured per-window
+        # dispatch cost into the admission EWMA; 429s then advertise
+        # queue_depth x cost — actual drain time — not a constant.
+        self.scheduler.batcher.cost_observer = (
+            self.admission.observe_window_cost
+        )
+        # Shape-faithful warmup: the batcher records each production
+        # (kernel, occupancy, leaf shapes) it dispatches into the
+        # warmup manifest next to the compile cache.
+        self.scheduler.batcher.cache_dir = self.cache_dir
         self.datasets: Dict[str, object] = {}
         self.slo_vocab = None
         self.baseline = None
@@ -167,7 +182,11 @@ class ServeService:
         )
         # Warmup dispatches run on THIS thread before the scheduler
         # exists; the scheduler thread re-claims when it starts.
-        claim_device_owner("serve-warmup")
+        # Co-deployed, the unified DeviceScheduler already owns the
+        # device — warmup routes through it instead (below), and
+        # claiming here would steal ownership from its thread.
+        if self.sched is None:
+            claim_device_owner("serve-warmup")
         if self.journal is not None:
             self.journal.run_start(
                 pipeline="serve",
@@ -196,7 +215,12 @@ class ServeService:
                     f"entry must be in [1, max_batch_windows="
                     f"{self.serve.max_batch_windows}]"
                 )
-            self.warmup()
+            if self.sched is not None:
+                from ..sched import LANE_SERVE
+
+                self.sched.run_on(LANE_SERVE, "serve", self.warmup)
+            else:
+                self.warmup()
         self.scheduler.start()
 
     def warmup(self) -> None:
@@ -238,10 +262,25 @@ class ServeService:
         if kernel is None:
             return
         record_manifest_entry(self.cache_dir, "serve", kernel, occupancies)
+        # Shape-faithful pass: the manifest also carries the EXACT
+        # (kernel, occupancy, padded leaf shapes) of production pad
+        # buckets a previous process dispatched (batcher._record_shapes)
+        # — replay them so the first real window after a restart hits
+        # an already-traced program, not a same-occupancy-different-
+        # shape approximation. p99 first-window latency ~ steady state.
+        shaped = 0
+        if self.config.sched.shape_warmup:
+            from ..dispatch import warm_manifest_shapes
+
+            shaped = warm_manifest_shapes(
+                self.router, self.config, self.cache_dir, "serve",
+                probe=self.cache_probe,
+            )
         self.log.info(
             "warmup: batched rank program ready (occupancies %s, kernel "
-            "%s, compile cache %d hit / %d miss) in %.1fs",
-            occupancies, kernel, self.cache_probe.hits,
+            "%s, %d production shapes, compile cache %d hit / %d miss) "
+            "in %.1fs",
+            occupancies, kernel, shaped, self.cache_probe.hits,
             self.cache_probe.misses, time.monotonic() - t0,
         )
 
@@ -463,12 +502,21 @@ class ServeService:
             timeout = self.serve.drain_seconds
         if self.scheduler.is_alive() or self.scheduler.queued():
             self.scheduler.stop(drain=drain, timeout=timeout)
+            if self.sched is not None and drain:
+                # Parked serve windows flush on the unified scheduler's
+                # thread; wait for the store to empty and the last
+                # batch to resolve before journaling run_end.
+                self.sched.kick(force=True)
+                self.sched.wait_idle(timeout=timeout or 30.0)
         elif not self.scheduler.is_alive():
             # never started (direct-drive tests): flush parked work
             self.scheduler._stopping = True
             self.scheduler.batcher.dispatch_ready(
                 self.scheduler.batcher.take_ready(force=True)
             )
+            if self.sched is not None:
+                self.sched.kick(force=True)
+                self.sched.wait_idle(timeout=timeout or 30.0)
         if self.build_pool is not None:
             self.build_pool.shutdown()
         if self.journal is not None:
@@ -609,7 +657,7 @@ class HttpFrontend:
 
     async def _rank(self, body, headers):
         svc = self.service
-        retry = {"retry_after": svc.admission.retry_after_seconds}
+        retry = {"retry_after": svc.admission.retry_after()}
         try:
             # W3C trace context: the request's self-tracing spans join
             # the CALLER's distributed trace (serve.protocol).
@@ -690,8 +738,10 @@ class HttpFrontend:
             "Connection: close",
         ]
         if status in (429, 503):
+            # Dynamic backpressure: queue depth x measured per-window
+            # cost (admission EWMA), floored at the configured constant.
             retry = max(
-                1, int(round(self.service.admission.retry_after_seconds))
+                1, int(round(self.service.admission.retry_after()))
             )
             head.append(f"Retry-After: {retry}")
         for name, value in (extra_headers or {}).items():

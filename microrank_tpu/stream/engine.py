@@ -133,8 +133,16 @@ class StreamEngine:
         incident_sinks: Optional[List] = None,
         resume: bool = False,
         tracker=None,
+        sched=None,
     ):
         self.config = config
+        # Co-deploy: ``sched`` is the unified DeviceScheduler sharing
+        # the device with serve/backfill. Every device touch (warmup,
+        # dispatch, fetch) then runs as a thunk on ITS thread — the
+        # engine thread keeps windowing, builds, and incident
+        # lifecycle. Solo (sched=None) the engine owns the device
+        # exactly as before.
+        self.sched = sched
         sc = config.stream
         self.source = source
         self.log = get_logger("microrank_tpu.stream")
@@ -209,6 +217,10 @@ class StreamEngine:
         self.router = DispatchRouter(config)
         self._pending: Deque[_PendingRank] = deque()
         self._warmed: dict = {}     # kernel -> occupancies dispatched
+        # Production pad-bucket shapes dispatched this run, recorded
+        # into the warmup manifest at shutdown (shape-faithful warmup):
+        # (kernel, occupancy, leaf-shape tuple) sigs, deduped.
+        self._shape_sigs: set = set()
         # Warm-start seam (RuntimeConfig.warm_start): the previous
         # ranked window's converged iteration state
         # (rank_backends.warm.WarmState), threaded into the next
@@ -468,7 +480,10 @@ class StreamEngine:
         )
         # The engine thread is the sole jax toucher on the stream path
         # (program-order rule); builds go to the pool, sinks stay host.
-        claim_device_owner("stream-engine")
+        # Co-deployed, the unified DeviceScheduler owns the device and
+        # every dispatch routes through _on_device instead.
+        if self.sched is None:
+            claim_device_owner("stream-engine")
         self._warm_start()
         sc = self.config.stream
         run_t0 = time.monotonic()
@@ -550,6 +565,26 @@ class StreamEngine:
                 get_registry().write_snapshot(self.out_dir)
         return self.summary
 
+    def _on_device(self, fn, lane=None):
+        """Run a device-touching thunk where the device lives: inline
+        when this engine owns it (solo), or on the unified scheduler's
+        thread when co-deployed. The lane defaults by incident state —
+        an open incident rides the hot lane ahead of interactive serve;
+        a healthy stream shares the serve lane; both outrank backfill."""
+        if self.sched is None:
+            return fn()
+        from ..sched import LANE_INCIDENT, LANE_SERVE
+
+        if lane is None:
+            lane = (
+                LANE_INCIDENT
+                if self.tracker.open_incidents()
+                else LANE_SERVE
+            )
+        return self.sched.run_on(
+            lane, self.config.sched.stream_tenant, fn
+        )
+
     def _flush_webhooks(self) -> None:
         """Drain-time best effort for webhook sinks' retry queues: one
         flush pass per sink (entries still failing stay dropped-on-
@@ -577,6 +612,7 @@ class StreamEngine:
             CompileCacheProbe,
             configure_compile_cache,
             manifest_occupancies,
+            warm_manifest_shapes,
             warm_occupancies,
         )
 
@@ -594,13 +630,24 @@ class StreamEngine:
 
         record_compile_cache("warm_start")
         t0 = time.monotonic()
-        warm_occupancies(
+        self._on_device(lambda: warm_occupancies(
             self.router, self.config, occs, probe=self._cache_probe
-        )
+        ))
+        shaped = 0
+        if self.config.sched.shape_warmup:
+            # Shape-faithful warmup: re-trace the exact production pad
+            # buckets (kernel, occupancy, leaf shapes) the previous
+            # process dispatched, so the first real window after a
+            # restart hits an already-traced program — not just the
+            # synthetic default occupancies.
+            shaped = self._on_device(lambda: warm_manifest_shapes(
+                self.router, self.config, self._cache_dir, "stream",
+                probe=self._cache_probe,
+            ))
         self.log.info(
-            "warm restart: re-traced %d manifest occupancies in %.2fs "
-            "(compile cache %d hit / %d miss)",
-            len(occs), time.monotonic() - t0,
+            "warm restart: re-traced %d manifest occupancies + %d "
+            "production shapes in %.2fs (compile cache %d hit / %d miss)",
+            len(occs), shaped, time.monotonic() - t0,
             self._cache_probe.hits, self._cache_probe.misses,
         )
 
@@ -609,9 +656,18 @@ class StreamEngine:
 
         if not self.config.dispatch.warmup_manifest:
             return
+        shapes_by_kernel: dict = {}
+        if self.config.sched.shape_warmup:
+            for kernel, occ, leaves in sorted(self._shape_sigs):
+                shapes_by_kernel.setdefault(kernel, []).append(
+                    {"occupancy": occ,
+                     "leaves": [list(s) for s in leaves]}
+                )
         for kernel, occs in self._warmed.items():
             record_manifest_entry(
-                self._cache_dir, "stream", kernel, sorted(occs)
+                self._cache_dir, "stream", kernel, sorted(occs),
+                shapes=shapes_by_kernel.get(kernel),
+                max_shapes=self.config.sched.max_shapes,
             )
 
     # -------------------------------------------------------- per window
@@ -933,15 +989,32 @@ class StreamEngine:
 
         from ..chaos.retry import STREAM_DISPATCH_POLICY, retry_call
 
-        with get_tracer().attach(
-            head_trace.ctx if head_trace is not None else None
-        ):
-            outs, info = retry_call(
-                "stream_dispatch", _attempt,
-                policy=STREAM_DISPATCH_POLICY,
-            )
+        def _ranked():
+            # The tracer attach rides inside the thunk so the dispatch
+            # spans land on the head window's trace even when the thunk
+            # runs on the unified scheduler's thread (co-deploy).
+            with get_tracer().attach(
+                head_trace.ctx if head_trace is not None else None
+            ):
+                return retry_call(
+                    "stream_dispatch", _attempt,
+                    policy=STREAM_DISPATCH_POLICY,
+                )
+
+        outs, info = self._on_device(_ranked)
         record_stream_dispatch()
         self.summary.dispatches += 1
+        if (
+            self.config.sched.shape_warmup
+            and self.config.dispatch.warmup_manifest
+        ):
+            from ..dispatch import bucket_key
+
+            self._shape_sigs.add((
+                info.kernel,
+                len(group),
+                bucket_key(graphs[0], info.kernel)[1:],
+            ))
         occs = self._warmed.setdefault(info.kernel, set())
         if len(group) not in occs and self._cache_probe is not None:
             # First dispatch at this (kernel, occupancy) — the only kind
@@ -1025,11 +1098,14 @@ class StreamEngine:
 
         from ..chaos.retry import STREAM_DISPATCH_POLICY, retry_call
 
-        with tracer.attach(trace.ctx if trace is not None else None):
-            out = retry_call(
-                "stream_dispatch", _attempt,
-                policy=STREAM_DISPATCH_POLICY,
-            )
+        def _ranked():
+            with tracer.attach(trace.ctx if trace is not None else None):
+                return retry_call(
+                    "stream_dispatch", _attempt,
+                    policy=STREAM_DISPATCH_POLICY,
+                )
+
+        out = self._on_device(_ranked)
         record_stream_dispatch()
         self.summary.dispatches += 1
         top_idx, top_scores, n_valid = out[:3]
@@ -1110,12 +1186,23 @@ class StreamEngine:
             return out
 
         from ..chaos.retry import STREAM_DISPATCH_POLICY, retry_call
+        from ..sched import LANE_INCIDENT
 
-        with tracer.attach(head.trace.ctx if head.trace is not None else None):
-            out = retry_call(
-                "stream_dispatch", _attempt,
-                policy=STREAM_DISPATCH_POLICY,
-            )
+        def _ranked():
+            with tracer.attach(
+                head.trace.ctx if head.trace is not None else None
+            ):
+                return retry_call(
+                    "stream_dispatch", _attempt,
+                    policy=STREAM_DISPATCH_POLICY,
+                )
+
+        # Warm-start only seeds while an incident is open — this IS the
+        # hot path, so pin the incident lane rather than re-deriving it.
+        out = self._on_device(
+            _ranked,
+            lane=LANE_INCIDENT if init is not None else None,
+        )
         record_stream_dispatch()
         self.summary.dispatches += 1
         top_idx, top_scores, n_valid = out[:3]
@@ -1165,19 +1252,26 @@ class StreamEngine:
 
         graph, op_names, kernel, ectx = explain_src
         ex = self.config.explain
-        with get_tracer().span(
-            "explain", service="stream", kernel=kernel
-        ):
-            outs = jax.device_get(
-                stage_rank_window(
-                    graph,
-                    self.config.pagerank,
-                    self.config.spectrum,
-                    kernel,
-                    self.config.runtime.blob_staging,
-                    explain=ex,
+
+        def _explained():
+            with get_tracer().span(
+                "explain", service="stream", kernel=kernel
+            ):
+                return jax.device_get(
+                    stage_rank_window(
+                        graph,
+                        self.config.pagerank,
+                        self.config.spectrum,
+                        kernel,
+                        self.config.runtime.blob_staging,
+                        explain=ex,
+                    )
                 )
-            )
+
+        from ..sched import LANE_INCIDENT
+
+        # An explain dispatch only happens on incident open — hot lane.
+        outs = self._on_device(_explained, lane=LANE_INCIDENT)
         bundle = build_bundle(
             outs,
             op_names,

@@ -45,9 +45,14 @@ def parse_time_range(spec: str) -> Tuple[Optional[int], Optional[int]]:
 
 def replay_range(path, t0_us: Optional[int] = None,
                  t1_us: Optional[int] = None, config=None,
-                 k: int = 5) -> dict:
+                 k: int = 5, sched=None) -> dict:
     """Replay stored ranked windows in ``[t0_us, t1_us]``; returns a
-    report dict (``report["verdict"]`` is "match"/"mismatch")."""
+    report dict (``report["verdict"]`` is "match"/"mismatch").
+
+    ``sched`` (co-deploy): the unified DeviceScheduler — each coalesced
+    group dispatches as a BACKFILL-lane thunk on its thread, so replay
+    backfill shares the device with serve/stream without ever jumping
+    ahead of them."""
     from ..config import MicroRankConfig
     from ..dispatch.router import DispatchRouter, bucket_key
     from ..utils.guards import claim_device_owner
@@ -55,7 +60,8 @@ def replay_range(path, t0_us: Optional[int] = None,
 
     if config is None:
         config = MicroRankConfig()
-    claim_device_owner("warehouse-replay")
+    if sched is None:
+        claim_device_owner("warehouse-replay")
     store = TraceWarehouse(path, config.warehouse)
     windows = store.query(t0_us, t1_us)
     ranked = []
@@ -91,7 +97,17 @@ def replay_range(path, t0_us: Optional[int] = None,
             group.append(ranked[j])
             j += 1
         i = j
-        outs, _info = router.rank_batch([g for _, g in group], kernel)
+        graphs = [g for _, g in group]
+        if sched is None:
+            outs, _info = router.rank_batch(graphs, kernel)
+        else:
+            from ..sched import LANE_BACKFILL
+
+            outs, _info = sched.run_on(
+                LANE_BACKFILL, config.sched.backfill_tenant,
+                lambda: router.rank_batch(graphs, kernel),
+                cost=float(len(graphs)),
+            )
         top_idx, top_scores, n_valid = outs[:3]
         for b, (w, _g) in enumerate(group):
             op_names = w.op_names or []

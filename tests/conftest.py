@@ -21,6 +21,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault(
     "MICRORANK_POLICY_DIR", tempfile.mkdtemp(prefix="mr-policy-test-")
 )
+
+# Hermetic jit cache + warmup manifest: serve/stream dispatches record
+# production pad-bucket shapes into the manifest next to the compile
+# cache (shape-faithful warmup); pointing the suite at its own tmp dir
+# keeps a developer's real ~/.cache manifest out of warmup-count pins
+# and test shapes out of the real manifest.
+os.environ.setdefault(
+    "MICRORANK_JIT_CACHE", tempfile.mkdtemp(prefix="mr-jit-test-")
+)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -300,12 +300,16 @@ def test_stream_burst_coalesces_dispatches(registry, tmp_path):
     )
     import os
 
+    _old_jit_cache = os.environ.get("MICRORANK_JIT_CACHE")
     os.environ["MICRORANK_JIT_CACHE"] = str(tmp_path / "jit")
     try:
         eng = StreamEngine(cfg, src, out_dir=tmp_path)
         s = eng.run()
     finally:
-        os.environ.pop("MICRORANK_JIT_CACHE", None)
+        if _old_jit_cache is None:
+            os.environ.pop("MICRORANK_JIT_CACHE", None)
+        else:
+            os.environ["MICRORANK_JIT_CACHE"] = _old_jit_cache
     assert s.ranked == 3
     assert s.dispatches < s.ranked, (s.dispatches, s.ranked)
     disp_metric = registry.get(
@@ -390,6 +394,7 @@ def test_warmup_probe_classifies_hits(prepared, registry, tmp_path):
 
     cfg, _, _, _ = prepared
     cache = tmp_path / "jit"
+    _old_jit_cache = os.environ.get("MICRORANK_JIT_CACHE")
     os.environ["MICRORANK_JIT_CACHE"] = str(cache)
     try:
         assert configure_compile_cache(None) == str(cache)
@@ -407,7 +412,10 @@ def test_warmup_probe_classifies_hits(prepared, registry, tmp_path):
         assert reg.value(event="hit") >= 2
         assert reg.value(event="miss") == first_misses
     finally:
-        os.environ.pop("MICRORANK_JIT_CACHE", None)
+        if _old_jit_cache is None:
+            os.environ.pop("MICRORANK_JIT_CACHE", None)
+        else:
+            os.environ["MICRORANK_JIT_CACHE"] = _old_jit_cache
         _jax.config.update("jax_compilation_cache_dir", None)
 
 
@@ -434,6 +442,7 @@ def test_stream_warm_restart_replays_manifest(registry, tmp_path):
         )
         return StreamEngine(cfg, src).run()
 
+    _old_jit_cache = os.environ.get("MICRORANK_JIT_CACHE")
     os.environ["MICRORANK_JIT_CACHE"] = str(tmp_path / "jit")
     try:
         s1 = _run()
@@ -447,7 +456,10 @@ def test_stream_warm_restart_replays_manifest(registry, tmp_path):
         assert reg.value(event="warm_start") == 1
         assert reg.value(event="hit") >= 1
     finally:
-        os.environ.pop("MICRORANK_JIT_CACHE", None)
+        if _old_jit_cache is None:
+            os.environ.pop("MICRORANK_JIT_CACHE", None)
+        else:
+            os.environ["MICRORANK_JIT_CACHE"] = _old_jit_cache
 
 
 # ------------------------------------------------------------ bucket key

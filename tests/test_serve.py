@@ -508,7 +508,13 @@ def test_serial_builds_without_pool_still_serve(
         handle.stop()
 
 
-def test_warmup_occupancies_configurable(case, registry):
+def test_warmup_occupancies_configurable(
+    case, registry, tmp_path, monkeypatch
+):
+    # Hermetic cache dir: the shared manifest now also carries
+    # production pad-bucket shapes recorded by every serve dispatch
+    # (shape-faithful warmup), which would add replay dispatches here.
+    monkeypatch.setenv("MICRORANK_JIT_CACHE", str(tmp_path / "jit"))
     svc = _service(
         case,
         warmup=True,
