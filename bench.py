@@ -1160,6 +1160,327 @@ def _run_giant(cfg, repeats: int) -> dict:
     return out
 
 
+def _sliding_timeline(n_traces, n_ops, span_us, rng):
+    """Synthetic span frame for the sliding-window case: traces spread
+    uniformly across ``span_us`` with temporally compact bodies (2 s
+    bands) so a 75% slide changes only the boundary traces, and every
+    op name recurs throughout (the delta lane's frozen-vocab
+    contract). Vectorized — no per-span Python loop."""
+    import numpy as np
+    import pandas as pd
+
+    lens = rng.integers(3, 8, size=n_traces)
+    total = int(lens.sum())
+    starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    tr = np.repeat(np.arange(n_traces), lens)
+    j = np.arange(total) - np.repeat(starts, lens)
+    base = rng.integers(0, max(span_us - 2_000_000, 1), size=n_traces)
+    offs = rng.integers(0, 2_000_000, size=total)
+    # Per-trace time order without a Python sort loop: order by
+    # (trace, offset), then offsets are monotone inside each segment.
+    order = np.lexsort((offs, tr))
+    offs = offs[order]
+    t_us = np.repeat(base, lens) + offs
+    svc = rng.integers(0, 8, size=total)
+    op = rng.integers(0, n_ops, size=total)
+    tid = np.char.add("tr", tr.astype("U12"))
+    sid = np.char.add(
+        np.char.add(tid, "_s"), j.astype("U8")
+    )
+    parent = np.where(j > 0, np.roll(sid, 1), "")
+    svc_names = np.char.add("svc", svc.astype("U4"))
+    return pd.DataFrame(
+        {
+            "traceID": tid,
+            "spanID": sid,
+            "ParentSpanId": parent,
+            "serviceName": svc_names,
+            "operationName": np.char.add("op", op.astype("U4")),
+            "podName": np.char.add(svc_names, "-pod0"),
+            "startTime": pd.to_datetime(t_us, unit="us"),
+            "duration": rng.integers(1, 100, size=total),
+        }
+    )
+
+
+def _run_delta(cfg, spans_per_window, n_windows):
+    """Incremental ranking economics (ISSUE 20 tentpole): the SAME
+    sliding 75%-overlap replay ranked through both lanes — the cold
+    control (full ``build_window_graph`` rebuild + the separate traced
+    program) and the delta lane (O(Δ) ``build_window_graph_delta`` +
+    the fused pair program) — with tie-aware top-5 parity required
+    every window and exactly one fused dispatch per window certified
+    by the dispatch counter + jit cache introspection. The amortized
+    per-window build+device ratio is the acceptance number
+    (``amortized_ratio`` <= 0.40 on the reference platform)."""
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+
+    from microrank_tpu.dispatch import DispatchRouter
+    from microrank_tpu.explain import ExplainContext
+    from microrank_tpu.graph.build import (
+        aux_for_kernel,
+        build_window_graph,
+        build_window_graph_delta,
+    )
+    from microrank_tpu.rank_backends.blob import stage_rank_window
+    from microrank_tpu.rank_backends.jax_tpu import (
+        choose_kernel,
+        device_subset,
+    )
+    from microrank_tpu.rank_backends.warm import (
+        capture_warm_state,
+        map_warm_state,
+    )
+
+    w_us = 60_000_000
+    s_us = 15_000_000               # 75% overlap
+    span_us = w_us + (n_windows - 1) * s_us
+    # Keep every op present in every window (a smoke-scale window that
+    # misses one of the 48 ops would vocab-fallback the whole replay).
+    n_ops = min(48, max(8, spans_per_window // 80))
+    rng = np.random.default_rng(20)
+    # ~5.5 spans/trace; scale the trace count so one window holds about
+    # spans_per_window spans.
+    n_traces = int(spans_per_window / 5.5 * span_us / w_us)
+    df = _sliding_timeline(n_traces, n_ops, span_us, rng)
+    t_all = df["startTime"].to_numpy().view("int64") // 1000
+
+    def window(k):
+        lo = k * s_us
+        frame = df[(t_all >= lo) & (t_all < lo + w_us)]
+        frame = frame.reset_index(drop=True)
+        tids = sorted(frame["traceID"].unique())
+        return frame, tids[::2], tids[1::2], lo, lo + w_us
+
+    # Pin the pad buckets across the replay (the no-recompile guard
+    # would otherwise rebuild cold whenever a padded count crossed a
+    # bucket edge): floor the trace pad above the largest window, and
+    # use full-doubling "pow2" buckets — the counts ABOVE the floor
+    # (edge/incidence pads) fluctuate a few percent slide to slide,
+    # which flaps pow2q's 25%-wide buckets but not pow2's. Both lanes
+    # pay the identical padding, so the comparison stays fair.
+    pad_policy = "pow2"
+    frame0 = window(0)[0]
+    # Per-PARTITION trace count (the windows split their traces in
+    # half), with slack for slide-to-slide fluctuation.
+    min_pad = 1 << int(
+        np.ceil(np.log2(frame0["traceID"].nunique() / 2 * 1.25))
+    )
+
+    kernel = os.environ.get("BENCH_KERNEL", "auto")
+    aux = aux_for_kernel(kernel) if kernel != "auto" else "auto"
+    probe = build_window_graph(
+        frame0, *window(0)[1:3], aux=aux, min_pad=min_pad,
+        pad_policy=pad_policy,
+    )
+    if kernel == "auto":
+        kernel = choose_kernel(probe[0], prefer_bf16=_prefer_bf16())
+        if spans_per_window < 5_000 and kernel.endswith("_bf16"):
+            # Smoke-scale scores are flat enough that the bf16 noise
+            # floor (~1e-3 absolute) exceeds the parity rtol in
+            # RELATIVE terms; the precision ladder is orthogonal to
+            # what this case certifies, so converge in f32.
+            kernel = kernel[: -len("_bf16")]
+        aux = aux_for_kernel(kernel)
+
+    # Both lanes rank TO CONVERGENCE (the reference's fixed 25 trips
+    # are init-sensitive: a warm-started solve stopped at trip 25 sits
+    # at a different point than a cold one, and the parity contract is
+    # "tie-aware identical at convergence"). The tol also lets the
+    # warm-threaded fused lane actually exit early.
+    pr = _dc.replace(
+        cfg.pagerank,
+        tol=float(os.environ.get("BENCH_DELTA_TOL", 1e-4)),
+        iterations=100,
+    )
+    cfg = cfg.replace(pagerank=pr)
+    router = DispatchRouter(cfg)
+
+    # --- cold control lane --------------------------------------------
+    cold_build, cold_rank, cold_rankings = [], [], []
+    for k in range(n_windows):
+        frame, nrm, abn, lo, hi = window(k)
+        t0 = time.perf_counter()
+        g, names, _, _ = build_window_graph(
+            frame, nrm, abn, aux=aux, min_pad=min_pad,
+            pad_policy=pad_policy,
+        )
+        b_s = time.perf_counter() - t0
+        gsub = device_subset(g, kernel)
+        t0 = time.perf_counter()
+        out = jax.device_get(
+            stage_rank_window(gsub, pr, cfg.spectrum, kernel, _use_blob())
+        )
+        r_s = time.perf_counter() - t0
+        if k:  # window 0 pays the compile for both lanes — excluded
+            cold_build.append(b_s)
+            cold_rank.append(r_s)
+        nv = int(out[2])
+        cold_rankings.append(
+            (
+                [names[int(i)] for i in np.asarray(out[0])[:nv]],
+                [float(s) for s in np.asarray(out[1])[:nv]],
+            )
+        )
+
+    # --- delta lane: incremental build + fused pair program -----------
+    delta_build, delta_rank = [], []
+    delta_route_build = []
+    routes, parity = [], []
+    state, warm = None, None
+    cache_after_warmup = None
+    extra_compiles = 0
+    d0 = router.dispatches
+    for k in range(n_windows):
+        frame, nrm, abn, lo, hi = window(k)
+        t0 = time.perf_counter()
+        res = build_window_graph_delta(
+            frame, nrm, abn, state=state, start_us=lo, end_us=hi,
+            aux=aux, min_pad=min_pad, pad_policy=pad_policy,
+        )
+        b_s = time.perf_counter() - t0
+        state = res.state
+        routes.append(res.route if res.route == "delta" else res.reason)
+        ectx = ExplainContext.from_build(
+            res.graph, res.normal_trace_ids, res.abnormal_trace_ids,
+            res.column_map[0], res.column_map[1],
+        )
+        init = (
+            map_warm_state(warm, res.op_names, ectx, res.graph)
+            if warm is not None
+            else None
+        )
+        gsub = device_subset(res.graph, kernel)
+        before = router.dispatches
+        t0 = time.perf_counter()
+        outs, info = router.rank_fused(gsub, kernel, init)
+        r_s = time.perf_counter() - t0
+        assert router.dispatches - before == 1, (
+            "fused pair program must be ONE dispatch per window"
+        )
+        warm = capture_warm_state(res.op_names, ectx, outs[5:9])
+        fused_fn = router_fused_cache_size()
+        if k > 1 and res.route == "delta" and init is not None:
+            # The no-recompile guarantee belongs to the DELTA route: a
+            # delta graph carries the previous window's leaf-shape
+            # signature by construction, so a warm fused dispatch past
+            # the two warmup structures (cold seed init=None at k=0,
+            # warm init=tuple at k=1) must never grow the jit cache. A
+            # cold fallback MAY legitimately compile — its rebuilt pads
+            # are whatever the new window needs.
+            if cache_after_warmup is not None and (
+                fused_fn != cache_after_warmup
+            ):
+                extra_compiles += fused_fn - cache_after_warmup
+        cache_after_warmup = fused_fn
+        if k:
+            delta_build.append(b_s)
+            delta_rank.append(r_s)
+            if res.route == "delta":
+                delta_route_build.append(b_s)
+        nv = int(outs[2])
+        names_d = [
+            res.op_names[int(i)] for i in np.asarray(outs[0])[:nv]
+        ]
+        scores_d = [float(s) for s in np.asarray(outs[1])[:nv]]
+        # rtol sits at the bf16 noise floor: under a *_bf16 kernel the
+        # tol plateaus above BENCH_DELTA_TOL, so warm and cold
+        # trajectories stop ~1e-3 apart in score while agreeing on the
+        # ranking — exactly the tie-aware contract.
+        parity.append(
+            _tie_aware_topk_parity(
+                names_d, scores_d, *cold_rankings[k], k=5, rtol=1e-2
+            )
+        )
+
+    n_delta = sum(1 for r in routes if r == "delta")
+    cold_ms = float(np.mean(cold_build) + np.mean(cold_rank)) * 1e3
+    delta_ms = float(np.mean(delta_build) + np.mean(delta_rank)) * 1e3
+    ratio = delta_ms / cold_ms if cold_ms else None
+    out = {
+        "windows": n_windows,
+        "spans_per_window": int(len(frame0)),
+        "kernel": kernel,
+        "routes": routes,
+        "delta_route_windows": n_delta,
+        "cold_build_ms": round(float(np.mean(cold_build)) * 1e3, 1),
+        "cold_rank_ms": round(float(np.mean(cold_rank)) * 1e3, 1),
+        "delta_build_ms": round(float(np.mean(delta_build)) * 1e3, 1),
+        "delta_route_build_ms": round(
+            float(np.mean(delta_route_build)) * 1e3, 1
+        ),
+        "fused_rank_ms": round(float(np.mean(delta_rank)) * 1e3, 1),
+        "amortized_cold_ms": round(cold_ms, 1),
+        "amortized_delta_ms": round(delta_ms, 1),
+        "amortized_ratio": round(ratio, 3) if ratio else None,
+        "budget_ratio": 0.40,
+        "within_budget": bool(ratio is not None and ratio <= 0.40),
+        "parity_top5_every_window": all(parity),
+        "fused_dispatches_per_window": round(
+            (router.dispatches - d0) / n_windows, 2
+        ),
+        "fused_compiles_after_warmup": extra_compiles,
+    }
+    assert all(parity), (
+        f"delta lane diverged from cold control (per-window: {parity})"
+    )
+    assert n_delta >= n_windows // 2, (
+        f"delta route on {n_delta}/{n_windows} windows — the sliding "
+        f"replay should take it on at least half (routes: {routes})"
+    )
+    assert extra_compiles == 0, (
+        "fused pair program retraced on a delta-route window after warmup"
+    )
+    # The combined build+device ratio is rank-bound on a CPU smoke run
+    # (both lanes pay the same per-iteration device cost and solve to
+    # the same tol); the platform-robust invariant is the host build
+    # itself: an incremental (delta-route) build must beat the full
+    # rebuild. Cold-fallback windows inside the delta lane pay a full
+    # rebuild by design, so they stay in the amortized mean but out of
+    # this apples-to-apples comparison. Below smoke scale the delta
+    # lane's fixed per-window cost (state capture + splice setup)
+    # dominates a few-ms cold rebuild, so the numbers are recorded but
+    # the O(Δ) win is only asserted where Δ-vs-window asymptotics
+    # actually apply.
+    if spans_per_window >= 5_000:
+        assert out["delta_route_build_ms"] < out["cold_build_ms"], (
+            f"delta-route build ({out['delta_route_build_ms']}ms) must "
+            f"beat the cold rebuild ({out['cold_build_ms']}ms)"
+        )
+    log(
+        f"delta replay: {n_delta}/{n_windows} windows on the delta "
+        f"route; amortized build+device {delta_ms:.1f}ms vs cold "
+        f"{cold_ms:.1f}ms ({ratio:.2f}x, budget 0.40); parity every "
+        f"window; {out['fused_dispatches_per_window']} dispatches/window"
+    )
+    return out
+
+
+def router_fused_cache_size():
+    """Compiled-program count of the fused pair entry points (tree +
+    blob twins) — flat after warmup is the no-retrace certificate the
+    delta artifact records."""
+    from microrank_tpu.rank_backends.blob import (
+        rank_window_warm_blob_device,
+    )
+    from microrank_tpu.rank_backends.jax_tpu import (
+        rank_window_warm_device,
+    )
+
+    total = 0
+    for fn in (rank_window_warm_device, rank_window_warm_blob_device):
+        size_fn = getattr(fn, "_cache_size", None)
+        if size_fn is not None:
+            try:
+                total += int(size_fn())
+            except Exception:
+                pass
+    return total
+
+
 def _run_warehouse(cfg, spans_per_window, n_ops, fault_ms, n_windows):
     """Warehouse at-rest economics (ISSUE 18 satellite): the SAME
     multi-window case the pipelined replay drives, archived as warm
@@ -1234,6 +1555,11 @@ def _run_warehouse(cfg, spans_per_window, n_ops, fault_ms, n_windows):
         "load_ms": round(load_s * 1e3, 1),
         "load_speedup_x": round(parse_s / load_s, 2) if load_s else None,
     }
+    assert out["load_speedup_x"] and out["load_speedup_x"] > 1.0, (
+        f"warehouse segment load ({out['load_ms']}ms) must beat the CSV "
+        f"parse ({out['parse_ms']}ms); got {out['load_speedup_x']}x — "
+        "the vectorized dictionary decode regressed"
+    )
     log(
         f"warehouse: {n_segments} warm segments, at-rest "
         f"{at_rest / 1e6:.2f}MB vs CSV {csv_bytes / 1e6:.2f}MB "
@@ -1898,6 +2224,27 @@ def main() -> int:
                 routed = None
             if routed is not None:
                 result.update(routed)
+
+    # Incremental ranking (ISSUE 20): sliding 75%-overlap replay ranked
+    # through the delta lane (O(Δ) build + fused pair program) against
+    # a cold-control rebuild, tie-aware parity every window.
+    # BENCH_DELTA=0 skips.
+    if os.environ.get("BENCH_DELTA", "1") != "0":
+        try:
+            # Capped by the preset so smoke configs (BENCH_CONFIG=1)
+            # pay a proportionally small sliding replay.
+            delta_spans = int(
+                os.environ.get(
+                    "BENCH_DELTA_SPANS", min(20_000, spans_target)
+                )
+            )
+            result["delta"] = _run_delta(
+                cfg,
+                delta_spans,
+                int(os.environ.get("BENCH_DELTA_WINDOWS", 8)),
+            )
+        except Exception as exc:  # diagnostics must not eat the metric
+            log(f"delta replay case failed ({exc!r}); continuing")
 
     # Warehouse at-rest economics (ISSUE 18): archive the replay case
     # as warm columnar segments and record bytes + load-vs-parse time.

@@ -375,6 +375,12 @@ def _config_from_args(args) -> "MicroRankConfig":
                     "kind_dedup_threshold": getattr(
                         args, "kind_dedup_threshold", None
                     ),
+                    "delta_build": (
+                        True if getattr(args, "delta_build", False) else None
+                    ),
+                    "fused_pair": (
+                        True if getattr(args, "fused_pair", False) else None
+                    ),
                 }.items()
                 if v is not None
             },
@@ -1994,6 +2000,21 @@ def main(argv=None) -> int:
         "--warehouse-dir", default=None, metavar="DIR",
         help="warehouse directory (default: <output>/warehouse; "
         "implies --warehouse)",
+    )
+    p_stream.add_argument(
+        "--delta-build", action="store_true",
+        help="incremental sliding-window graph builds: thread each "
+        "window's per-trace build caches into the next overlapping "
+        "window so only boundary traces pay string/factorize work "
+        "(exact — integrity-checked per window with automatic cold "
+        "fallback; see microrank_build_route_total)",
+    )
+    p_stream.add_argument(
+        "--fused-pair", action="store_true",
+        help="fused pair program: both PageRank solves + the spectrum "
+        "epilogue in ONE jitted dispatch per abnormal window, "
+        "exporting converged state to warm-start the next window "
+        "while an incident is open",
     )
     p_stream.add_argument(
         "--journal-max-bytes", type=int, default=None, metavar="N",

@@ -346,6 +346,23 @@ class RuntimeConfig:
     # single-window (no coalescing/sharding), so keep this off for
     # burst-heavy streams where micro-batching wins.
     warm_start: bool = False
+    # Incremental sliding-window build (ROADMAP item 1, closed by the
+    # delta-build lane): thread each window's per-trace build caches
+    # (graph.build.DeltaBuildState) into the next overlapping window so
+    # only the boundary traces pay string/factorize work. Exact by
+    # construction — every delta window passes a row-count + span-time
+    # checksum integrity gate and falls back to the cold build (counted
+    # in microrank_build_route_total{route="cold"}) on churn past
+    # delta_max_changed, unseen op names, or a pad-bucket shift.
+    delta_build: bool = False
+    # Changed-trace fraction past which a delta window rebuilds cold.
+    delta_max_changed: float = 0.5
+    # Fused pair program: rank each abnormal window through the warm
+    # program (both PageRank solves + the spectrum epilogue in ONE
+    # jitted dispatch, exporting converged state for the next window's
+    # warm seed). Implies the warm-start threading; like warm_start,
+    # fused windows dispatch single-window (no coalescing/sharding).
+    fused_pair: bool = False
     # Tuned-policy consultation (scenarios/ subsystem): "auto" (default)
     # resolves spectrum method / kernel / pad_policy from the persisted
     # policy.json (written by `cli scenarios` next to the warmup

@@ -380,6 +380,71 @@ class DispatchRouter:
             )
         return outs, info
 
+    def rank_fused(self, graph, kernel: str, init=None, record: bool = True):
+        """Rank ONE window through the fused pair program — both
+        PageRank solves plus the spectrum epilogue in a single jitted
+        dispatch (blob.stage_rank_window_warm), threading ``init`` (the
+        previous window's mapped converged state, or None for a cold
+        seed that still exports state). Returns ``(outs, RouteInfo)``
+        where ``outs`` is the HOST 9-tuple — ``(top_idx, top_scores,
+        n_valid, residuals, n_iters, score_n, rv_n, score_a, rv_a)``;
+        entries [5:9] are the state export for the next window.
+
+        Always single-window and single-device (warm state is
+        shape-bound to one window's pad bucket; coalescing/sharding
+        stay on rank_batch). The compile witness observes the dispatch
+        as program "dispatch.fused" — one key per (kernel, pad bucket,
+        init structure), so a steady stream proves dispatches-per-window
+        == 1 with at most two cached programs (cold seed + warm)."""
+        import jax
+
+        from ..obs.spans import get_tracer
+        from ..rank_backends.blob import stage_rank_window_warm
+        from ..rank_backends.jax_tpu import graph_device_bytes
+        from ..utils.guards import assert_device_owner
+
+        assert_device_owner("dispatch.rank_fused")
+        tracer = get_tracer()
+        t0 = time.monotonic()
+        from ..analysis import mrsan
+
+        if mrsan.witness_armed():
+            mrsan.observe_compile_key(
+                "dispatch.fused", kernel=kernel, graph=graph, occupancy=1
+            )
+        cfg = self.config
+        with tracer.span(
+            "device_dispatch", service="dispatch", kernel=kernel,
+            route="fused", windows=1,
+        ):
+            dev_outs = stage_rank_window_warm(
+                graph, init, cfg.pagerank, cfg.spectrum, kernel,
+                cfg.runtime.blob_staging,
+            )
+        with tracer.span(
+            "result_fetch", service="dispatch", route="fused"
+        ):
+            outs = jax.device_get(dev_outs)
+        from ..obs.profiler import record_device_memory
+
+        record_device_memory()
+        self.dispatches += 1
+        info = RouteInfo(
+            route="fused",
+            kernel=kernel,
+            windows=1,
+            footprint_bytes=graph_device_bytes(graph),
+            dispatch_ms=round((time.monotonic() - t0) * 1e3, 3),
+        )
+        if record:
+            from ..obs.metrics import record_dispatch_route, stage_seconds
+
+            record_dispatch_route(info.route, info.windows, 0.0)
+            stage_seconds().observe(
+                info.dispatch_ms / 1e3, stage="dispatch"
+            )
+        return outs, info
+
     def drop_prestaged(self) -> None:
         """Discard the cached prestaged batch (caller aborted it)."""
         self._prestaged = None

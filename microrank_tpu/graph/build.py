@@ -26,7 +26,7 @@ Semantics are kept value-identical to the reference matrices:
 
 from __future__ import annotations
 
-from typing import FrozenSet, Iterable, List, Tuple
+from typing import FrozenSet, Iterable, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 import pandas as pd
@@ -36,6 +36,7 @@ from ..io.interning import Vocab
 from ..io.naming import operation_names
 from ..io.schema import DEFAULT_STRIP_LAST_SEGMENT_SERVICES
 from .structures import (
+    DeltaBuildState,
     DetectBatch,
     PartitionGraph,
     SloBaseline,
@@ -621,7 +622,22 @@ def _build_partition(
         if compute_kinds
         else np.zeros(n_traces, dtype=np.int32)
     )
+    graph = _finish_partition(
+        u_op, u_trace, sr_val, rs_val, e_child, e_parent, ss_val,
+        tracelen, kind, cov_unique, op_present, n_ops, n_traces,
+        v_pad, pad_policy, min_pad, aux,
+    )
+    return graph, local_uniques
 
+
+def _finish_partition(
+    u_op, u_trace, sr_val, rs_val, e_child, e_parent, ss_val,
+    tracelen, kind, cov_unique, op_present, n_ops, n_traces,
+    v_pad, pad_policy, min_pad, aux,
+) -> PartitionGraph:
+    """Pad + aux-view tail shared by the cold and delta build lanes:
+    identical unpadded stats in, identical PartitionGraph out — the one
+    place the delta assembly cannot drift from the cold build."""
     e_pad = pad_to(len(u_op), pad_policy, min_pad)
     c_pad = pad_to(len(e_child), pad_policy, min_pad)
     t_pad = pad_to(n_traces, pad_policy, min_pad)
@@ -679,7 +695,47 @@ def _build_partition(
         pc_ell_rs=pc_ell_rs,
         cov_i8=cov_i8,
     )
-    return graph, local_uniques
+    return graph
+
+
+def _window_intern(span_df: pd.DataFrame, strip_services: FrozenSet[str]):
+    """One window's string interning — the dominant cold-build cost,
+    factored out so the delta lane's cold fallback can capture its
+    per-trace caches from the SAME factorize products instead of paying
+    the string work twice.
+
+    Returns ``(op_codes, op_uniques, tr_codes, tr_uniques, parent_row)``
+    where ``parent_row[i]`` is the window row index of span i's parent
+    (-1 when the parent span is absent from the window).
+    """
+    names = operation_names(span_df, "pod", strip_services)
+    # sort=True interns the vocab in name order: vocab index then doubles
+    # as the deterministic tie key of the device ranking (ascending op
+    # name — the same key the numpy oracle uses under tiebreak="name").
+    op_codes, op_uniques = pd.factorize(names, sort=True, use_na_sentinel=False)
+    op_codes = op_codes.astype(np.int64)
+
+    tr_codes, tr_uniques = pd.factorize(
+        span_df["traceID"], use_na_sentinel=False
+    )
+    tr_codes = tr_codes.astype(np.int64)
+
+    # Span linkage, once for the window: factorize spanID and ParentSpanId
+    # through one shared vocabulary, then positional parent lookup.
+    n = len(span_df)
+    combined = np.concatenate(
+        [
+            span_df["spanID"].to_numpy(dtype=object),
+            span_df["ParentSpanId"].to_numpy(dtype=object),
+        ]
+    )
+    link_codes, link_uniques = pd.factorize(combined, use_na_sentinel=False)
+    sid = link_codes[:n].astype(np.int64)
+    pid = link_codes[n:].astype(np.int64)
+    pos = np.full(len(link_uniques), -1, dtype=np.int64)
+    pos[sid] = np.arange(n)
+    parent_row = pos[pid]  # -1 when the parent span is absent
+    return op_codes, op_uniques, tr_codes, tr_uniques, parent_row
 
 
 def build_window_graph(
@@ -717,36 +773,33 @@ def build_window_graph(
     ``trace_ids[map[c]]`` then names the trace a device-side column
     attribution refers to.
     """
-    names = operation_names(span_df, "pod", strip_services)
-    # sort=True interns the vocab in name order: vocab index then doubles
-    # as the deterministic tie key of the device ranking (ascending op
-    # name — the same key the numpy oracle uses under tiebreak="name").
-    op_codes, op_uniques = pd.factorize(names, sort=True, use_na_sentinel=False)
-    op_codes = op_codes.astype(np.int64)
+    intern = _window_intern(span_df, strip_services)
+    graph, op_names, ids0, ids1, column_map = _build_from_intern(
+        intern, normal_ids, abnormal_ids, pad_policy, min_pad, aux,
+        dense_budget_bytes, collapse, kind_dedup_threshold,
+    )
+    if retain_columns:
+        return graph, op_names, ids0, ids1, column_map
+    return graph, op_names, ids0, ids1
+
+
+def _build_from_intern(
+    intern,
+    normal_ids,
+    abnormal_ids,
+    pad_policy,
+    min_pad,
+    aux,
+    dense_budget_bytes,
+    collapse,
+    kind_dedup_threshold,
+):
+    """The cold build's partition construction from interned arrays
+    (everything in build_window_graph after the string work)."""
+    op_codes, op_uniques, tr_codes, tr_uniques, parent_row = intern
     vocab_size = len(op_uniques)
     v_pad = pad_to(vocab_size, pad_policy, min_pad)
-
-    tr_codes, tr_uniques = pd.factorize(
-        span_df["traceID"], use_na_sentinel=False
-    )
-    tr_codes = tr_codes.astype(np.int64)
     tr_index = {t: i for i, t in enumerate(tr_uniques)}
-
-    # Span linkage, once for the window: factorize spanID and ParentSpanId
-    # through one shared vocabulary, then positional parent lookup.
-    n = len(span_df)
-    combined = np.concatenate(
-        [
-            span_df["spanID"].to_numpy(dtype=object),
-            span_df["ParentSpanId"].to_numpy(dtype=object),
-        ]
-    )
-    link_codes, link_uniques = pd.factorize(combined, use_na_sentinel=False)
-    sid = link_codes[:n].astype(np.int64)
-    pid = link_codes[n:].astype(np.int64)
-    pos = np.full(len(link_uniques), -1, dtype=np.int64)
-    pos[sid] = np.arange(n)
-    parent_row = pos[pid]  # -1 when the parent span is absent
 
     # Window-level aux resolution: one decision for both partitions, from
     # their padded trace counts (every id kept below maps to >=1 span, so
@@ -800,11 +853,604 @@ def build_window_graph(
             return_column_map=True,
             kind_dedup_threshold=kind_dedup_threshold,
         )
-    if retain_columns:
-        return (
-            graph, list(op_uniques), id_lists[0], id_lists[1], column_map
+    return graph, list(op_uniques), id_lists[0], id_lists[1], column_map
+
+
+# --------------------------------------------------------------- delta build
+#
+# Sliding-window incremental rebuild (ISSUE 20 tentpole): on a
+# 75%-overlap slide almost every trace is unchanged between consecutive
+# windows, yet the cold build re-pays its dominant cost — pod-level
+# operation naming plus three pd.factorize string passes over EVERY
+# span — for all of them. The delta lane caches the window per trace in
+# interned int form (DeltaBuildState) and rebuilds the next window by
+# splicing only the boundary traces: string work is O(arriving rows),
+# per-trace aggregation is O(changed traces' spans), and the final
+# partition assembly is vectorized int gathers over the caches.
+#
+# Exactness stance: the delta graph must rank tie-aware-identical to
+# the cold build. Everything value-carrying (sr/rs/ss denominators,
+# coverage, call edges, kind grouping) is derived from the same integer
+# statistics through the same _finish_partition / collapse_window_graph
+# tail the cold lane uses. The lane's one modeling assumption — the new
+# frame is exactly the previous frame minus the departing prefix plus
+# the arriving suffix — is CHECKED per window via a row count plus a
+# wrapping uint64 span-time checksum; any mismatch (late spans,
+# eviction drift, replay duplicates) routes the window to the cold
+# build. Parent links crossing traces (out of contract for OTel data;
+# see the module docstring's duplicated-spanID stance) are detected at
+# capture and on every splice and likewise force cold.
+
+#: Fraction of the window's traces (boundary + new) past which the
+#: delta route stops paying for itself and the window rebuilds cold.
+DEFAULT_DELTA_MAX_CHANGED = 0.5
+
+
+class DeltaBuildResult(NamedTuple):
+    """What build_window_graph_delta hands back: the cold build's
+    4-tuple plus the retention map, the carried state and the route
+    actually taken ("delta" | "cold"; ``reason`` says why a cold window
+    went cold — "init" for the first window of a run)."""
+
+    graph: WindowGraph
+    op_names: list
+    normal_trace_ids: list
+    abnormal_trace_ids: list
+    column_map: tuple
+    state: DeltaBuildState
+    route: str
+    reason: str
+
+
+def _graph_shape_sig(graph: WindowGraph) -> tuple:
+    """Leaf-shape signature of both partitions — the delta lane's
+    no-recompile guard (same signature => same jit pad bucket)."""
+    return tuple(
+        tuple(np.shape(leaf) for leaf in part)
+        for part in (graph.normal, graph.abnormal)
+    )
+
+
+def _gather_ranges(indptr: np.ndarray, members: np.ndarray) -> np.ndarray:
+    """Concatenated ``arange(indptr[m], indptr[m+1])`` for every member
+    (vectorized CSR-segment gather index)."""
+    lens = (indptr[members + 1] - indptr[members]).astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    rep_starts = np.repeat(indptr[members].astype(np.int64), lens)
+    cs = np.concatenate(([0], np.cumsum(lens)[:-1]))
+    return rep_starts + (np.arange(total, dtype=np.int64) - np.repeat(cs, lens))
+
+
+def _trace_aggregates(
+    op: np.ndarray,
+    tr: np.ndarray,
+    t_ns: np.ndarray,
+    sid: np.ndarray,
+    pid: np.ndarray,
+    n_traces: int,
+    vocab_size: int,
+    parent_row: Optional[np.ndarray] = None,
+):
+    """Per-trace CSR aggregates over the given span rows.
+
+    ``tr`` must already be the target trace numbering (state-local ids
+    at capture, compact sub ids on a splice). ``parent_row`` may be
+    precomputed (the cold capture reuses the window intern's resolution
+    so the cached edges mirror the cold build exactly); otherwise span
+    linkage is resolved here over the given rows only.
+
+    Returns ``(agg dict, ok, reason)`` — ``ok=False`` marks data the
+    delta lane does not serve (cross-trace parent links, packed-key
+    overflow); the caller then builds cold / marks the state ineligible.
+    """
+    n = len(op)
+    if parent_row is None:
+        combined = np.concatenate([sid, pid])
+        link_codes, link_uniques = pd.factorize(
+            combined, use_na_sentinel=False
         )
-    return graph, list(op_uniques), id_lists[0], id_lists[1]
+        s = link_codes[:n].astype(np.int64)
+        p = link_codes[n:].astype(np.int64)
+        pos = np.full(len(link_uniques), -1, dtype=np.int64)
+        pos[s] = np.arange(n)
+        parent_row = pos[p]
+
+    # Intra-trace guard: every resolved parent must sit in the child's
+    # own trace, else partition edges could span traces the splice
+    # cannot see (the capture-time check covers the cold mirror, this
+    # check covers every splice).
+    valid = parent_row >= 0
+    pr = np.clip(parent_row, 0, None)
+    cross = valid & (tr[pr] != tr)
+    if cross.any():
+        return None, False, "cross_trace"
+    if n_traces and float(n_traces) * vocab_size * vocab_size >= 2.0**62:
+        return None, False, "key_overflow"
+
+    order = np.argsort(tr, kind="stable")
+    tracelen = np.bincount(tr, minlength=n_traces).astype(np.int64)
+    span_indptr = np.zeros(n_traces + 1, dtype=np.int64)
+    np.cumsum(tracelen, out=span_indptr[1:])
+    span_t = t_ns[order]
+    cs = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum(span_t.astype(np.uint64), out=cs[1:])
+    t_checksum = cs[span_indptr[1:]] - cs[span_indptr[:-1]]
+
+    # Unique (trace, op) counts, op ascending within each trace.
+    key = tr * vocab_size + op
+    ukey, ucnt = np.unique(key, return_counts=True)
+    u_tr = ukey // max(vocab_size, 1)
+    uop_indptr = np.zeros(n_traces + 1, dtype=np.int64)
+    np.cumsum(np.bincount(u_tr, minlength=n_traces), out=uop_indptr[1:])
+
+    # Unique intra-trace call edges with instance multiplicities,
+    # (child, parent) ascending within each trace.
+    rows = np.flatnonzero(valid)
+    etr = tr[rows]
+    ekey = (etr * vocab_size + op[rows]) * vocab_size + op[pr[rows]]
+    uek, ecnt = np.unique(ekey, return_counts=True)
+    vv = max(vocab_size * vocab_size, 1)
+    ue_tr = uek // vv
+    rem = uek - ue_tr * vv
+    uedge_indptr = np.zeros(n_traces + 1, dtype=np.int64)
+    np.cumsum(np.bincount(ue_tr, minlength=n_traces), out=uedge_indptr[1:])
+
+    agg = {
+        "span_indptr": span_indptr,
+        "span_op": op[order],
+        "span_t_ns": span_t,
+        "span_sid": sid[order],
+        "span_pid": pid[order],
+        "uop_indptr": uop_indptr,
+        "uop_op": (ukey - u_tr * max(vocab_size, 1)).astype(np.int64),
+        "uop_cnt": ucnt.astype(np.int64),
+        "uedge_indptr": uedge_indptr,
+        "uedge_child": (rem // max(vocab_size, 1)).astype(np.int64),
+        "uedge_parent": (rem % max(vocab_size, 1)).astype(np.int64),
+        "uedge_cnt": ecnt.astype(np.int64),
+        "tracelen": tracelen,
+        "t_checksum": t_checksum,
+    }
+    return agg, True, ""
+
+
+def _capture_delta_state(
+    span_df: pd.DataFrame,
+    intern,
+    params: tuple,
+    start_us: Optional[int],
+    end_us: Optional[int],
+    shape_sig: tuple,
+) -> DeltaBuildState:
+    """Capture the per-trace caches from a cold build's intern products
+    (one extra O(n log n) int pass — no further string work)."""
+    op_codes, op_uniques, tr_codes, tr_uniques, parent_row = intern
+    trace_ids = np.asarray(tr_uniques, dtype=object)
+    empty = np.zeros(0, dtype=np.int64)
+    empty_obj = np.zeros(0, dtype=object)
+    state = DeltaBuildState(
+        start_us=int(start_us) if start_us is not None else 0,
+        end_us=int(end_us) if end_us is not None else 0,
+        params=params,
+        op_uniques=list(op_uniques),
+        op_index=pd.Index(np.asarray(op_uniques, dtype=object)),
+        trace_ids=trace_ids,
+        trace_index=pd.Index(trace_ids),
+        span_indptr=np.zeros(1, dtype=np.int64),
+        span_op=empty,
+        span_t_ns=empty,
+        span_sid=empty_obj,
+        span_pid=empty_obj,
+        uop_indptr=np.zeros(1, dtype=np.int64),
+        uop_op=empty,
+        uop_cnt=empty,
+        uedge_indptr=np.zeros(1, dtype=np.int64),
+        uedge_child=empty,
+        uedge_parent=empty,
+        uedge_cnt=empty,
+        tracelen=empty,
+        t_checksum=np.zeros(0, dtype=np.uint64),
+        shape_sig=shape_sig,
+    )
+    if start_us is None or end_us is None:
+        state.eligible = False
+        state.reason = "bounds"
+        return state
+    st = span_df["startTime"]
+    if not pd.api.types.is_datetime64_any_dtype(st.dtype):
+        state.eligible = False
+        state.reason = "timestamps"
+        return state
+    t_ns = st.to_numpy().view("int64")
+    agg, ok, reason = _trace_aggregates(
+        op_codes,
+        tr_codes,
+        t_ns,
+        span_df["spanID"].to_numpy(dtype=object),
+        span_df["ParentSpanId"].to_numpy(dtype=object),
+        len(tr_uniques),
+        len(op_uniques),
+        parent_row=parent_row,
+    )
+    if not ok:
+        state.eligible = False
+        state.reason = reason
+        return state
+    for k, v in agg.items():
+        setattr(state, k, v)
+    return state
+
+
+def _assemble_partition(
+    state: DeltaBuildState,
+    members: np.ndarray,
+    vocab_size: int,
+    v_pad: int,
+    pad_policy: str,
+    min_pad: int,
+    aux: str,
+    compute_kinds: bool,
+) -> PartitionGraph:
+    """One partition from the state's per-trace caches: the same
+    unpadded statistics _build_partition derives from raw spans,
+    reassembled as vectorized gathers over the per-trace aggregates,
+    finished through the shared _finish_partition tail."""
+    m = members
+    n_traces = len(m)
+    tracelen = state.tracelen[m] if n_traces else np.zeros(0, np.int64)
+
+    u_idx = _gather_ranges(state.uop_indptr, m)
+    u_op = state.uop_op[u_idx]
+    u_cnt = state.uop_cnt[u_idx]
+    u_lens = (state.uop_indptr[m + 1] - state.uop_indptr[m]) if n_traces else np.zeros(0, np.int64)
+    u_trace = np.repeat(np.arange(n_traces, dtype=np.int64), u_lens)
+
+    cov_dup = np.bincount(
+        u_op, weights=u_cnt, minlength=vocab_size
+    ).astype(np.int64)
+    sr_val = (1.0 / tracelen[u_trace]).astype(np.float32)
+    rs_val = (1.0 / cov_dup[u_op]).astype(np.float32)
+    cov_unique = np.bincount(u_op, minlength=vocab_size).astype(np.int32)
+    op_present = cov_unique > 0
+    n_ops = int(op_present.sum())
+
+    e_idx = _gather_ranges(state.uedge_indptr, m)
+    ec = state.uedge_child[e_idx]
+    ep = state.uedge_parent[e_idx]
+    ecnt = state.uedge_cnt[e_idx]
+    outdeg_dup = np.bincount(
+        ep, weights=ecnt, minlength=vocab_size
+    ).astype(np.int64)
+    if len(ec):
+        ekey = np.unique(ec * vocab_size + ep)
+        e_child = (ekey // vocab_size).astype(np.int32)
+        e_parent = (ekey % vocab_size).astype(np.int32)
+        ss_val = (1.0 / outdeg_dup[e_parent]).astype(np.float32)
+    else:
+        e_child = np.zeros(0, dtype=np.int32)
+        e_parent = np.zeros(0, dtype=np.int32)
+        ss_val = np.zeros(0, dtype=np.float32)
+
+    u_trace32 = u_trace.astype(np.int32)
+    u_op32 = u_op.astype(np.int32)
+    kind = (
+        _trace_kinds(u_trace32, u_op32, tracelen, n_traces)
+        if compute_kinds
+        else np.zeros(n_traces, dtype=np.int32)
+    )
+    return _finish_partition(
+        u_op32, u_trace32, sr_val, rs_val, e_child, e_parent, ss_val,
+        tracelen, kind, cov_unique, op_present, n_ops, n_traces,
+        v_pad, pad_policy, min_pad, aux,
+    )
+
+
+def _try_delta(
+    span_df,
+    normal_ids,
+    abnormal_ids,
+    state: DeltaBuildState,
+    start_us: int,
+    end_us: int,
+    strip_services,
+    pad_policy,
+    min_pad,
+    aux,
+    dense_budget_bytes,
+    collapse,
+    kind_dedup_threshold,
+    max_changed_fraction,
+):
+    """One delta attempt. Returns ``(result, None)`` on success or
+    ``(None, reason)`` to route the window to the cold build."""
+    st = span_df["startTime"]
+    if not pd.api.types.is_datetime64_any_dtype(st.dtype):
+        return None, "timestamps"
+    t_ns = st.to_numpy().view("int64")
+    ns0 = start_us * 1000
+    prev_end_ns = state.end_us * 1000
+    vocab_size = len(state.op_uniques)
+    T = len(state.trace_ids)
+
+    span_lens = np.diff(state.span_indptr)
+    span_tr = np.repeat(np.arange(T, dtype=np.int64), span_lens)
+    dep = state.span_t_ns < ns0
+    changed = np.zeros(T, dtype=bool)
+    changed[span_tr[dep]] = True
+
+    arr_idx = np.flatnonzero(t_ns >= prev_end_ns)
+    tids_all = span_df["traceID"].to_numpy(dtype=object)
+    arr_tids = tids_all[arr_idx]
+    loc = state.trace_index.get_indexer(arr_tids).astype(np.int64)
+    existing = loc >= 0
+    changed[loc[existing]] = True
+    new_codes, new_uniques = pd.factorize(
+        arr_tids[~existing], use_na_sentinel=False
+    )
+    n_new = len(new_uniques)
+
+    n_changed = int(changed.sum())
+    if (n_changed + n_new) / max(T + n_new, 1) > max_changed_fraction:
+        return None, "churn"
+
+    # Arriving rows through the FROZEN vocab: any unseen pod-level op
+    # name means the vocab (and with it v_pad) would shift — cold.
+    if len(arr_idx):
+        arr_names = operation_names(
+            span_df.iloc[arr_idx], "pod", strip_services
+        )
+        arr_op = state.op_index.get_indexer(
+            np.asarray(arr_names, dtype=object)
+        ).astype(np.int64)
+        if (arr_op < 0).any():
+            return None, "vocab"
+    else:
+        arr_op = np.zeros(0, dtype=np.int64)
+
+    # Splice the changed traces: surviving cached spans + arriving rows,
+    # renumbered compactly (changed state traces first, new traces after).
+    keep = ~dep
+    ch_span = changed[span_tr] & keep
+    ch_ids = np.flatnonzero(changed)
+    remap = np.full(T, -1, dtype=np.int64)
+    remap[ch_ids] = np.arange(len(ch_ids), dtype=np.int64)
+    arr_sub = np.empty(len(arr_idx), dtype=np.int64)
+    arr_sub[existing] = remap[loc[existing]]
+    arr_sub[~existing] = len(ch_ids) + new_codes.astype(np.int64)
+
+    sub_op = np.concatenate([state.span_op[ch_span], arr_op])
+    sub_tr = np.concatenate([remap[span_tr[ch_span]], arr_sub])
+    sub_t = np.concatenate([state.span_t_ns[ch_span], t_ns[arr_idx]])
+    sub_sid = np.concatenate(
+        [
+            state.span_sid[ch_span],
+            span_df["spanID"].to_numpy(dtype=object)[arr_idx],
+        ]
+    )
+    sub_pid = np.concatenate(
+        [
+            state.span_pid[ch_span],
+            span_df["ParentSpanId"].to_numpy(dtype=object)[arr_idx],
+        ]
+    )
+    n_sub = len(ch_ids) + n_new
+    agg, ok, why = _trace_aggregates(
+        sub_op, sub_tr, sub_t, sub_sid, sub_pid, n_sub, vocab_size
+    )
+    if not ok:
+        return None, why
+
+    # Integrity: the frame must be EXACTLY the cached unchanged spans
+    # plus the splice — row count and wrapping span-time checksum.
+    unchanged = ~changed
+    pred_rows = int(state.tracelen[unchanged].sum()) + len(sub_op)
+    if pred_rows != len(span_df):
+        return None, "integrity"
+    pred_sum = np.concatenate(
+        [state.t_checksum[unchanged], agg["t_checksum"]]
+    ).sum(dtype=np.uint64)
+    frame_sum = t_ns.astype(np.uint64).sum(dtype=np.uint64)
+    if pred_sum != frame_sum:
+        return None, "integrity"
+
+    # Merge: unchanged traces keep their cached segments; changed/new
+    # traces take the recomputed ones (empty splices are dropped — the
+    # trace left the window). O(n) memcpy, no string/hash work.
+    sub_len = agg["tracelen"]
+    alive = sub_len > 0
+    u_lens_old = np.diff(state.uop_indptr)
+    keep_u = unchanged[np.repeat(np.arange(T, dtype=np.int64), u_lens_old)]
+    e_lens_old = np.diff(state.uedge_indptr)
+    keep_e = unchanged[np.repeat(np.arange(T, dtype=np.int64), e_lens_old)]
+    keep_span = unchanged[span_tr]
+
+    sub_ids = np.concatenate(
+        [state.trace_ids[ch_ids], np.asarray(new_uniques, dtype=object)]
+    )
+    new_ids = np.concatenate([state.trace_ids[unchanged], sub_ids[alive]])
+
+    def indptr_of(lens):
+        out = np.zeros(len(lens) + 1, dtype=np.int64)
+        np.cumsum(lens, out=out[1:])
+        return out
+
+    new_span_lens = np.concatenate(
+        [state.tracelen[unchanged], sub_len[alive]]
+    )
+    new_state = DeltaBuildState(
+        start_us=start_us,
+        end_us=end_us,
+        params=state.params,
+        op_uniques=state.op_uniques,
+        op_index=state.op_index,
+        trace_ids=new_ids,
+        trace_index=pd.Index(new_ids),
+        span_indptr=indptr_of(new_span_lens),
+        span_op=np.concatenate([state.span_op[keep_span], agg["span_op"]]),
+        span_t_ns=np.concatenate(
+            [state.span_t_ns[keep_span], agg["span_t_ns"]]
+        ),
+        span_sid=np.concatenate(
+            [state.span_sid[keep_span], agg["span_sid"]]
+        ),
+        span_pid=np.concatenate(
+            [state.span_pid[keep_span], agg["span_pid"]]
+        ),
+        uop_indptr=indptr_of(
+            np.concatenate(
+                [u_lens_old[unchanged], np.diff(agg["uop_indptr"])[alive]]
+            )
+        ),
+        uop_op=np.concatenate([state.uop_op[keep_u], agg["uop_op"]]),
+        uop_cnt=np.concatenate([state.uop_cnt[keep_u], agg["uop_cnt"]]),
+        uedge_indptr=indptr_of(
+            np.concatenate(
+                [e_lens_old[unchanged], np.diff(agg["uedge_indptr"])[alive]]
+            )
+        ),
+        uedge_child=np.concatenate(
+            [state.uedge_child[keep_e], agg["uedge_child"]]
+        ),
+        uedge_parent=np.concatenate(
+            [state.uedge_parent[keep_e], agg["uedge_parent"]]
+        ),
+        uedge_cnt=np.concatenate(
+            [state.uedge_cnt[keep_e], agg["uedge_cnt"]]
+        ),
+        tracelen=new_span_lens,
+        t_checksum=np.concatenate(
+            [state.t_checksum[unchanged], agg["t_checksum"][alive]]
+        ),
+        shape_sig=state.shape_sig,
+    )
+
+    # Partition assembly from the merged caches — same window-level aux
+    # resolution and collapse tail as the cold build.
+    v_pad = pad_to(vocab_size, pad_policy, min_pad)
+    code_sets = []
+    for ids in (normal_ids, abnormal_ids):
+        ids_arr = np.asarray(list(ids), dtype=object)
+        if len(ids_arr):
+            loc2 = new_state.trace_index.get_indexer(ids_arr).astype(
+                np.int64
+            )
+            mem = np.unique(loc2[loc2 >= 0])
+        else:
+            mem = np.zeros(0, dtype=np.int64)
+        code_sets.append(mem)
+    t_pads = [
+        pad_to(max(len(mem), 1), pad_policy, min_pad) for mem in code_sets
+    ]
+    mode = (
+        "none"
+        if collapse != "off"
+        else resolve_aux(aux, v_pad, t_pads, dense_budget_bytes)
+    )
+    parts = []
+    id_lists = []
+    for mem in code_sets:
+        parts.append(
+            _assemble_partition(
+                new_state, mem, vocab_size, v_pad, pad_policy, min_pad,
+                mode, compute_kinds=(collapse == "off"),
+            )
+        )
+        id_lists.append([new_state.trace_ids[i] for i in mem])
+    graph = WindowGraph(normal=parts[0], abnormal=parts[1])
+    column_map = (None, None)
+    if collapse != "off":
+        graph, column_map = collapse_window_graph(
+            graph, aux, pad_policy, min_pad, dense_budget_bytes, collapse,
+            return_column_map=True,
+            kind_dedup_threshold=kind_dedup_threshold,
+        )
+
+    sig = _graph_shape_sig(graph)
+    if state.shape_sig and sig != state.shape_sig:
+        # The pad bucket would shift — rebuild cold so the new bucket is
+        # the cold build's own (no delta-only compile keys, ever).
+        return None, "pad_shift"
+    new_state.shape_sig = sig
+    return (
+        graph, list(state.op_uniques), id_lists[0], id_lists[1],
+        column_map, new_state,
+    ), None
+
+
+def build_window_graph_delta(
+    span_df: pd.DataFrame,
+    normal_ids: Iterable,
+    abnormal_ids: Iterable,
+    *,
+    state: Optional[DeltaBuildState] = None,
+    start_us: Optional[int] = None,
+    end_us: Optional[int] = None,
+    strip_services: FrozenSet[str] = DEFAULT_STRIP_LAST_SEGMENT_SERVICES,
+    pad_policy: str = "pow2q",
+    min_pad: int = 8,
+    aux: str = "auto",
+    dense_budget_bytes: int = DEFAULT_DENSE_BUDGET_BYTES,
+    collapse: str = "off",
+    kind_dedup_threshold: float = DEFAULT_KIND_DEDUP_THRESHOLD,
+    max_changed_fraction: float = DEFAULT_DELTA_MAX_CHANGED,
+) -> DeltaBuildResult:
+    """build_window_graph with a sliding-window incremental mode.
+
+    Pass the previous window's returned ``state`` plus this window's
+    bounds (microseconds, half-open). When the frame is a clean slide of
+    the previous window — same build params, overlapping bounds, no
+    unseen op names, changed-trace fraction under
+    ``max_changed_fraction``, pad signature preserved, integrity
+    checksum matching — the graph is assembled from the per-trace caches
+    (route "delta"). Anything else falls back to the cold build and
+    re-captures (route "cold" with a reason).
+
+    The delta route returns the SAME op vocab as the previous window
+    (superset semantics: departed ops keep zero coverage and are masked
+    by ``op_present``), which is what pins v_pad and the jit pad bucket.
+    """
+    params = (
+        frozenset(strip_services), pad_policy, int(min_pad), aux,
+        int(dense_budget_bytes), collapse, float(kind_dedup_threshold),
+    )
+    reason = None
+    if state is None:
+        reason = "init"
+    elif state.params != params:
+        reason = "params"
+    elif not state.eligible:
+        reason = state.reason or "ineligible"
+    elif start_us is None or end_us is None:
+        reason = "bounds"
+    elif not (state.start_us <= start_us <= state.end_us <= end_us):
+        reason = "bounds"
+    if reason is None:
+        result, reason = _try_delta(
+            span_df, normal_ids, abnormal_ids, state, int(start_us),
+            int(end_us), strip_services, pad_policy, min_pad, aux,
+            dense_budget_bytes, collapse, kind_dedup_threshold,
+            max_changed_fraction,
+        )
+        if result is not None:
+            graph, op_names, ids0, ids1, column_map, new_state = result
+            return DeltaBuildResult(
+                graph, op_names, ids0, ids1, column_map, new_state,
+                "delta", "",
+            )
+
+    intern = _window_intern(span_df, strip_services)
+    graph, op_names, ids0, ids1, column_map = _build_from_intern(
+        intern, normal_ids, abnormal_ids, pad_policy, min_pad, aux,
+        dense_budget_bytes, collapse, kind_dedup_threshold,
+    )
+    new_state = _capture_delta_state(
+        span_df, intern, params, start_us, end_us, _graph_shape_sig(graph)
+    )
+    return DeltaBuildResult(
+        graph, op_names, ids0, ids1, column_map, new_state, "cold", reason
+    )
 
 
 def _collapse_partition(

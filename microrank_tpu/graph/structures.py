@@ -13,6 +13,7 @@ stores the pair list once with two value arrays.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import NamedTuple
 
 import numpy as np
@@ -134,6 +135,68 @@ class WindowGraph(NamedTuple):
 
     normal: PartitionGraph
     abnormal: PartitionGraph
+
+
+@dataclass
+class DeltaBuildState:
+    """Host-side cache that makes a sliding-window rebuild O(Δ) in the
+    expensive work (``graph.build.build_window_graph_delta``).
+
+    The cold build's dominant cost is string-side: pod-level operation
+    naming plus three ``pd.factorize`` passes over every span row. This
+    state caches the window frame per trace in already-interned int
+    form, so the next window pays string work only for the ARRIVING
+    rows and replays everything else as vectorized int gathers:
+
+    * per-trace span CSR (op codes + start times + raw span-id refs,
+      trace-major) — the splice source when a boundary trace loses its
+      departing prefix or gains arriving spans;
+    * per-(trace, unique-op) counts and per-trace unique intra-trace
+      call edges with multiplicities — the partition assembly inputs
+      (coverage, call-graph and kind views all derive from these);
+    * a wrapping uint64 per-trace sum of span start times — the
+      integrity checksum that routes anything the slide model did not
+      predict (late spans, eviction drift, replay duplicates) to the
+      cold build instead of silently diverging.
+
+    The op vocab is FROZEN across delta windows (departed names keep
+    their codes with zero coverage, masked by ``op_present``; any
+    unseen arriving name forces a cold rebuild), so ``v_pad`` — and
+    with it the jit pad bucket — cannot shift on the delta route by
+    construction. ``shape_sig`` pins the full leaf-shape signature of
+    the previous window's graph; a delta assembly whose padded shapes
+    differ is discarded in favor of a cold rebuild ("pad signature
+    preserved or cold").
+
+    All trace-level arrays are indexed by the state-local trace id
+    (``trace_ids[i]`` names trace ``i``); CSR arrays are trace-major
+    over that axis.
+    """
+
+    start_us: int                  # window bounds this state describes
+    end_us: int
+    params: tuple                  # build-parameter signature; mismatch -> cold
+    op_uniques: list               # frozen window vocab, name-sorted
+    op_index: object               # pd.Index over op_uniques (hash join)
+    trace_ids: np.ndarray          # object[T]
+    trace_index: object            # pd.Index over trace_ids
+    span_indptr: np.ndarray        # int64[T+1] per-trace span CSR offsets
+    span_op: np.ndarray            # int64[n]  vocab code per span
+    span_t_ns: np.ndarray          # int64[n]  startTime, ns
+    span_sid: np.ndarray           # object[n] spanID refs
+    span_pid: np.ndarray           # object[n] ParentSpanId refs
+    uop_indptr: np.ndarray         # int64[T+1] per-trace unique-op offsets
+    uop_op: np.ndarray             # int64[sumU] op codes, ascending per trace
+    uop_cnt: np.ndarray            # int64[sumU] span count per (trace, op)
+    uedge_indptr: np.ndarray       # int64[T+1] per-trace unique-edge offsets
+    uedge_child: np.ndarray        # int64[sumC] sorted by (child, parent)
+    uedge_parent: np.ndarray       # int64[sumC]
+    uedge_cnt: np.ndarray          # int64[sumC] instance multiplicity
+    tracelen: np.ndarray           # int64[T] spans per trace (with dups)
+    t_checksum: np.ndarray         # uint64[T] wrapping sum of span_t_ns
+    shape_sig: tuple = ()          # previous graph's leaf-shape signature
+    eligible: bool = True          # False: every next window builds cold
+    reason: str = ""               # why (cross_trace / timestamps / ...)
 
 
 class DetectBatch(NamedTuple):

@@ -123,6 +123,7 @@ class RunJournal:
             rank_iterations=result.rank_iterations,
             rank_residual=result.rank_residual,
             kernel=result.kernel,
+            route=getattr(result, "route", None),
             kind_dedup=result.kind_dedup,
             ingest_rejected=getattr(result, "ingest_rejected", 0),
             degraded_input=bool(

@@ -772,6 +772,18 @@ def warm_shapes() -> Counter:
     )
 
 
+def build_routes() -> Counter:
+    return get_registry().counter(
+        "microrank_build_route_total",
+        "Window graph builds by route: delta = assembled incrementally "
+        "from the previous window's per-trace caches (O(changed "
+        "traces)), cold = full rebuild (first window, churn past the "
+        "threshold, unseen op names, pad-bucket shift, or an integrity "
+        "checksum mismatch)",
+        labelnames=("route",),  # delta | cold
+    )
+
+
 def ensure_catalog() -> None:
     """Register the whole canonical metric set in the current registry
     (no samples added). Snapshot/exposition paths call this so a scrape
@@ -809,6 +821,7 @@ def ensure_catalog() -> None:
         warehouse_bytes, warehouse_replays,
         sched_dispatches, sched_parked, sched_expired,
         sched_throttled, sched_wait_seconds, warm_shapes,
+        build_routes,
     ):
         ctor()
 
@@ -866,6 +879,11 @@ def record_dispatch_route(
     dispatch_windows().observe(float(windows), route=route)
     if overlap_seconds > 0:
         dispatch_overlap_seconds().inc(float(overlap_seconds))
+
+
+def record_build_route(route: str) -> None:
+    """One window graph build: which build lane produced it."""
+    build_routes().inc(route=route)
 
 
 def record_compile_cache(event: str, n: int = 1) -> None:

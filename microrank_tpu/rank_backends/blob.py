@@ -194,6 +194,84 @@ rank_windows_traced_batched_blob_device = jax.jit(
 )
 
 
+@contract(
+    blob="uint32[N]",
+    returns=(
+        "int32[K]", "float32[K]", "int32[]", "float32[2,I]", "int32[]",
+        "float32[V]", "float32[T]", "float32[V]", "float32[U]",
+    ),
+)
+def rank_window_warm_blob_core(
+    blob, layout, init, pagerank_cfg, spectrum_cfg, kernel="coo"
+):
+    """Blob twin of jax_tpu.rank_window_warm_core — the FUSED pair
+    program: one staged buffer, one dispatch running the normal and
+    abnormal PageRank solves plus the spectrum epilogue, exporting the
+    converged state for the next window's warm start. ``init=None``
+    (a pytree-structure change, so its own cached program) is the cold
+    seed that still exports state."""
+    from .jax_tpu import rank_window_warm_core
+
+    graph = unpack_graph_blob(blob, layout)
+    return rank_window_warm_core(
+        graph, init, pagerank_cfg, spectrum_cfg, kernel
+    )
+
+
+rank_window_warm_blob_device = jax.jit(
+    rank_window_warm_blob_core, static_argnums=(1, 3, 4, 5)
+)
+
+
+def stage_rank_window_warm(
+    graph: WindowGraph,
+    init,
+    pagerank_cfg,
+    spectrum_cfg,
+    kernel,
+    blob: bool,
+):
+    """stage_rank_window's warm/fused sibling: stage ONE window and run
+    the pair program (both solves + spectrum epilogue) in ONE dispatch,
+    threading ``init`` — the previous window's mapped (sv_n, rv_n, sv_a,
+    rv_a) state, or None for a cold seed. Returns the 9-tuple of device
+    handles; entries [5:9] are the state export the caller captures for
+    the next window. Same witness/telemetry contract as
+    stage_rank_window (the compile-witness program name is
+    "blob.stage_rank_window_warm")."""
+    from ..analysis import mrsan
+    from ..obs.metrics import record_retrace
+    from ..utils.guards import assert_device_owner
+
+    assert_device_owner("blob.stage_rank_window_warm")
+    if mrsan.witness_armed():
+        mrsan.observe_compile_key(
+            "blob.stage_rank_window_warm", kernel=kernel, graph=graph,
+            occupancy=1,
+        )
+    if init is not None:
+        init = tuple(jax.device_put(x) for x in init)
+    if blob:
+        blob_arr, layout = pack_graph_blob(graph)
+        _account_staging(graph, "blob", 1)
+        out = rank_window_warm_blob_device(
+            jax.device_put(blob_arr), layout, init, pagerank_cfg,
+            spectrum_cfg, kernel,
+        )
+        record_retrace(
+            "rank_window_warm_blob", rank_window_warm_blob_device
+        )
+        return out
+    from .jax_tpu import rank_window_warm_device
+
+    _account_staging(graph, "tree", len(jax.tree.leaves(graph)))
+    out = rank_window_warm_device(
+        jax.device_put(graph), init, pagerank_cfg, spectrum_cfg, kernel
+    )
+    record_retrace("rank_window_warm", rank_window_warm_device)
+    return out
+
+
 def _rank_window_blob_checked_core(
     blob, layout, pagerank_cfg, spectrum_cfg, kernel="coo"
 ):

@@ -1811,6 +1811,84 @@ def prepare_window_graph_explained(span_df, normal_ids, abnormal_ids, config):
     return graph, op_names, kernel, ectx
 
 
+def prepare_window_graph_delta(
+    span_df,
+    normal_ids,
+    abnormal_ids,
+    config,
+    state=None,
+    start_us=None,
+    end_us=None,
+):
+    """prepare_window_graph_explained's incremental sibling
+    (RuntimeConfig.delta_build): build through
+    graph.build_window_graph_delta, threading the previous window's
+    ``DeltaBuildState``. Returns ``(graph, op_names, kernel, ectx,
+    state, route, reason)`` — the leading 4 match the explained
+    prepare's contract (the stream engine's rank path never branches),
+    ``state`` is what the NEXT window passes back in, and
+    ``route``/``reason`` are the build-route telemetry ("delta" or
+    "cold" + why), also recorded into microrank_build_route_total and
+    the run journal here so every caller pays the same observability.
+    """
+    from ..explain.bundle import ExplainContext
+    from ..graph.build import (
+        aux_for_kernel,
+        build_window_graph_delta,
+        kind_dedup_ratio,
+    )
+    from ..obs.journal import emit_current
+    from ..obs.metrics import record_build_route, record_kind_dedup
+    from ..obs.spans import get_tracer
+    from .base import validate_partitions
+
+    import time as _time
+
+    normal_ids = list(normal_ids)
+    abnormal_ids = list(abnormal_ids)
+    validate_partitions(normal_ids, abnormal_ids)
+    validate_tiebreak(config.spectrum)
+    rt = config.runtime
+    t0 = _time.perf_counter()
+    with get_tracer().span("build", service="pipeline"):
+        res = build_window_graph_delta(
+            span_df,
+            normal_ids,
+            abnormal_ids,
+            state=state,
+            start_us=start_us,
+            end_us=end_us,
+            pad_policy=rt.pad_policy,
+            min_pad=rt.min_pad,
+            aux=aux_for_kernel(rt.kernel),
+            dense_budget_bytes=rt.dense_budget_bytes,
+            collapse=rt.collapse_kinds,
+            kind_dedup_threshold=rt.kind_dedup_threshold,
+            max_changed_fraction=rt.delta_max_changed,
+        )
+        kernel = rt.kernel
+        if kernel == "auto":
+            kernel = choose_kernel(
+                res.graph, rt.dense_budget_bytes, rt.prefer_bf16
+            )
+        record_kind_dedup(kind_dedup_ratio(res.graph))
+        record_build_route(res.route)
+        emit_current(
+            "build_route",
+            route=res.route,
+            reason=res.reason,
+            build_ms=round((_time.perf_counter() - t0) * 1e3, 3),
+        )
+    ectx = ExplainContext.from_build(
+        res.graph, res.normal_trace_ids, res.abnormal_trace_ids,
+        res.column_map[0], res.column_map[1],
+    )
+    return (
+        device_subset(res.graph, kernel), res.op_names, kernel, ectx,
+        res.state, res.route, res.reason,
+    )
+
+
 def _prepare_window_graph(
     span_df, normal_ids, abnormal_ids, config, retain_columns: bool
 ):

@@ -229,6 +229,18 @@ class StreamEngine:
         # warm) and never checkpointed — a restart simply cold-starts
         # its first window, which is exactly crash-only semantics.
         self._warm_state = None
+        # Delta-build seam (RuntimeConfig.delta_build): the previous
+        # built window's per-trace build caches
+        # (graph.build.DeltaBuildState), threaded through the build
+        # pool so each overlapping window's graph assembles in O(changed
+        # traces). Builds chain on the previous build's future (the
+        # state handoff is strictly window-ordered even with a deep
+        # pool); the state itself is INDEPENDENT of the incident
+        # lifecycle — the guard chain inside build_window_graph_delta
+        # (bounds/params/churn/integrity) is what invalidates it, and a
+        # restart cold-builds its first window. Never checkpointed.
+        self._delta_state = None
+        self._build_chain = None
         # Trace-relative clock-skew registry (ingest.TraceClock),
         # lazily built on the first pre-admitted batch. Never
         # checkpointed: a restart re-learns first-seen times from the
@@ -811,8 +823,14 @@ class StreamEngine:
         # the off-thread build parent-links to THIS window's trace.
         with tracer.attach(trace.ctx):
             fut = self.pool.submit(
-                self._prepare, frame, nrm, abn
+                self._prepare, frame, nrm, abn,
+                closed.start_us, closed.end_us, self._build_chain,
             )
+        if self.config.runtime.delta_build:
+            # Chain: the NEXT delta build waits on this one's future (a
+            # pure barrier — the state handoff rides self._delta_state,
+            # written on the worker before the future resolves).
+            self._build_chain = fut
         self._pending.append(
             _PendingRank(closed, result, fut, trace, frame=frame)
         )
@@ -822,20 +840,33 @@ class StreamEngine:
             self._rank_head()
 
     # ---------------------------------------------------------- ranking
-    def _prepare(self, frame, nrm, abn):
+    def _prepare(
+        self, frame, nrm, abn, start_us=None, end_us=None, prev_build=None
+    ):
         """The build-pool unit, under the unified retry policy: a
         build-pool exception (incl. the ``build`` chaos seam) retries
         with backoff ON the worker before it can surface as a skipped
         window — a transient build fault costs latency, not a window."""
         from ..chaos import BUILD_POLICY, retry_call
 
+        if prev_build is not None:
+            # Delta chain barrier: the previous window's build must have
+            # published its DeltaBuildState before this one reads it.
+            # Its FAILURE is not ours — the stale state is still a valid
+            # delta base (the bounds/integrity guards absorb a larger
+            # slide), and the failed window surfaces on its own turn.
+            try:
+                prev_build.result()
+            except Exception:  # noqa: BLE001 - see above
+                pass
+
         return retry_call(
             "build",
-            lambda: self._prepare_impl(frame, nrm, abn),
+            lambda: self._prepare_impl(frame, nrm, abn, start_us, end_us),
             policy=BUILD_POLICY,
         )
 
-    def _prepare_impl(self, frame, nrm, abn):
+    def _prepare_impl(self, frame, nrm, abn, start_us=None, end_us=None):
         """Prepared graph plus (when the explain subsystem is armed)
         the coverage-column retention context the incident bundle joins
         device attributions against. Uniform 4-tuple so the rank path
@@ -843,11 +874,32 @@ class StreamEngine:
         from ..chaos import maybe_inject
         from ..rank_backends.jax_tpu import (
             prepare_window_graph,
+            prepare_window_graph_delta,
             prepare_window_graph_explained,
         )
 
         maybe_inject("build")
-        if self.config.explain.enabled or self.config.runtime.warm_start:
+        rt = self.config.runtime
+        if rt.delta_build:
+            # Incremental lane: thread the previous window's build
+            # caches; the returned state is published BEFORE the future
+            # resolves (the submit site chains the next build on it).
+            graph, op_names, kernel, ectx, state, route, _reason = (
+                prepare_window_graph_delta(
+                    frame, nrm, abn, self.config,
+                    state=self._delta_state,
+                    start_us=start_us, end_us=end_us,
+                )
+            )
+            self._delta_state = state
+            if not (
+                self.config.explain.enabled
+                or rt.warm_start
+                or rt.fused_pair
+            ):
+                ectx = None
+            return graph, op_names, kernel, ectx
+        if self.config.explain.enabled or rt.warm_start or rt.fused_pair:
             # The retention context doubles as the warm-start seam's
             # column identity map (rank_backends.warm maps rv across
             # the window delta by representative trace id).
@@ -877,7 +929,10 @@ class StreamEngine:
             self._finalize(head.result, "skipped", trace=head.trace)
             return
         warm = bool(
-            self.config.runtime.warm_start
+            (
+                self.config.runtime.warm_start
+                or self.config.runtime.fused_pair
+            )
             and not self.config.runtime.device_checks
             and ectx is not None
         )
@@ -1162,11 +1217,23 @@ class StreamEngine:
         if self._warm_state is not None and self.tracker.open_incidents():
             init = map_warm_state(self._warm_state, op_names, ectx, graph)
         t0 = time.monotonic()
+        fused = bool(rt.fused_pair)
 
         def _attempt():
             from ..chaos import InjectedFault, maybe_inject
 
             maybe_inject("dispatch")
+            if fused:
+                # Fused pair program through the router: blob staging +
+                # both solves + epilogue in ONE dispatch; the router
+                # owns the witness/route telemetry ("dispatch.fused").
+                with contract_checks(rt.validate_numerics):
+                    out, _info = self.router.rank_fused(
+                        graph, kernel, init
+                    )
+                if maybe_inject("fetch") is not None:
+                    raise InjectedFault("fetch", "nan")
+                return out
             with tracer.span(
                 "device_dispatch", service="stream", kernel=kernel,
                 warm=init is not None,
@@ -1215,7 +1282,10 @@ class StreamEngine:
             assert_finite_scores(scores, "stream window (warm)")
         result.ranking = list(zip(names, scores))
         result.kernel = kernel
-        result.route = "warm" if init is not None else "warm_cold"
+        if fused:
+            result.route = "fused" if init is not None else "fused_cold"
+        else:
+            result.route = "warm" if init is not None else "warm_cold"
         result.batch_windows = 1
         from ..graph.build import kind_dedup_ratio
 

@@ -68,20 +68,15 @@ def encode_frame(frame) -> Tuple[Dict[str, np.ndarray], dict]:
         and not pd.api.types.is_numeric_dtype(frame[c])
     ]
     if len(shared) == 2:
-        vals = [
-            frame[c].astype(object).where(frame[c].notna(), None)
-            for c in shared
-        ]
+        parts = []
+        for c in shared:
+            ser = frame[c]
+            mask = ser.notna().to_numpy()
+            if mask.any():
+                parts.append(ser[mask].astype(str).to_numpy(dtype=str))
         uniq = np.unique(
-            np.concatenate(
-                [
-                    np.asarray(
-                        [str(v) for v in col if v is not None], dtype=str
-                    )
-                    for col in vals
-                ]
-            )
-            if any(len(col) for col in vals)
+            np.concatenate(parts)
+            if parts
             else np.asarray([], dtype=str)
         )
         arrays[_IDDICT_KEY] = uniq
@@ -135,25 +130,37 @@ def _dict_codes(ser, uniq: np.ndarray) -> np.ndarray:
     return codes
 
 
+def _object_lut(uniq: np.ndarray) -> np.ndarray:
+    """Dictionary -> object lookup table with a trailing NaN slot.
+
+    Boxing the ``<U`` dictionary into Python strings happens ONCE here
+    (len(dict) allocations); row decode is then a pure pointer gather,
+    and code -1 (null) indexes the last slot — no per-row Python. The
+    shared id dictionary reuses one LUT for both span-id columns, so
+    the two columns also share their string objects."""
+    lut = np.empty(len(uniq) + 1, dtype=object)
+    if len(uniq):
+        lut[:-1] = uniq
+    lut[-1] = np.nan
+    return lut
+
+
 def decode_frame(arrays: Dict[str, np.ndarray], frame_meta: dict):
     """Inverse of :func:`encode_frame`."""
     import pandas as pd
 
     data = {}
+    luts: Dict[str, np.ndarray] = {}
     for meta in frame_meta["columns"]:
         col = meta["name"]
         enc = meta["enc"]
         raw = arrays[f"col_{col}"]
         if enc in ("dict", "dict_shared"):
-            uniq = arrays[
-                _IDDICT_KEY if enc == "dict_shared" else f"dict_{col}"
-            ]
-            vals = np.empty(len(raw), dtype=object)
-            ok = raw >= 0
-            if ok.any() and len(uniq):
-                vals[ok] = uniq[raw[ok]]
-            vals[~ok] = np.nan
-            data[col] = vals
+            dict_key = _IDDICT_KEY if enc == "dict_shared" else f"dict_{col}"
+            lut = luts.get(dict_key)
+            if lut is None:
+                lut = luts[dict_key] = _object_lut(arrays[dict_key])
+            data[col] = lut[raw]
         elif enc == "datetime":
             ns = raw.astype(np.int64) + int(meta.get("base", 0))
             data[col] = ns.view(meta["dtype"])

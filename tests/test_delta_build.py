@@ -1,0 +1,530 @@
+"""Incremental ranking (ISSUE 20): delta-build + fused pair program.
+
+Covers the tentpole's exactness contract and its guards: delta-vs-cold
+parity across kernels x collapse x blob staging (tie-aware identical
+ranking at convergence), the cold-fallback guard chain (churn,
+integrity, vocab, params, bounds) counted in
+microrank_build_route_total, warm-start invalidation across a
+kind-collapse column-map change (a stale-state dispatch can never flip
+a tie-aware top-k verdict), the fused pair program's single-dispatch
+parity, and the stream engine wiring end to end.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from microrank_tpu.config import MicroRankConfig, PageRankConfig
+from microrank_tpu.graph.build import (
+    build_window_graph,
+    build_window_graph_delta,
+)
+from microrank_tpu.obs import MetricsRegistry, get_registry, set_registry
+from microrank_tpu.utils.ranking_compare import tie_aware_topk_agreement
+
+CFG = MicroRankConfig()
+W_US = 100_000_000        # 100 s window
+S_US = 25_000_000         # 25 s slide -> 75% overlap
+
+
+@pytest.fixture
+def registry():
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+def _timeline(n_traces=160, seed=1, n_ops=6, span_us=None):
+    """Synthetic span timeline with temporally compact traces (each
+    trace's spans sit in a 2 s band) so a 75% slide keeps most traces
+    intact. Every op name appears throughout the timeline, keeping the
+    window vocab stable (the delta lane's frozen-vocab contract)."""
+    rng = np.random.default_rng(seed)
+    span = span_us if span_us is not None else W_US + 4 * S_US
+    rows = []
+    base = np.sort(rng.integers(0, span - 2_000_000, size=n_traces))
+    for i in range(n_traces):
+        tid = f"tr{seed}_{i}"
+        n = int(rng.integers(3, 8))
+        t_us = base[i] + np.sort(rng.integers(0, 2_000_000, size=n))
+        sids = [f"{tid}_s{j}" for j in range(n)]
+        for j in range(n):
+            svc = f"svc{rng.integers(0, 5)}"
+            rows.append(
+                {
+                    "traceID": tid,
+                    "spanID": sids[j],
+                    "ParentSpanId": sids[j - 1] if j else "",
+                    "serviceName": svc,
+                    "operationName": f"op{rng.integers(0, n_ops)}",
+                    "podName": svc + "-pod0",
+                    "startTime": pd.Timestamp(
+                        int(t_us[j]) * 1000, unit="ns"
+                    ),
+                    "duration": int(rng.integers(1, 100)),
+                }
+            )
+    return pd.DataFrame(rows)
+
+
+def _window(frames, lo_us, hi_us):
+    t = frames["startTime"].to_numpy().view("int64") // 1000
+    return frames[(t >= lo_us) & (t < hi_us)].reset_index(drop=True)
+
+
+def _partition(frame):
+    tids = sorted(frame["traceID"].unique())
+    return tids[: len(tids) // 2], tids[len(tids) // 2 :]
+
+
+def _slide(frames, k):
+    lo = k * S_US
+    wf = _window(frames, lo, lo + W_US)
+    nrm, abn = _partition(wf)
+    return wf, nrm, abn, lo, lo + W_US
+
+
+def _names_scores(out, names):
+    n = int(out[2])
+    return (
+        [names[int(i)] for i in np.asarray(out[0])[:n]],
+        [float(s) for s in np.asarray(out[1])[:n]],
+    )
+
+
+# ------------------------------------------------- delta-vs-cold parity
+
+
+PARITY_MATRIX = [
+    # (kernel, collapse, blob) — every kernel, both collapse modes,
+    # blob staging alternated so both staging paths rank delta graphs.
+    ("kind", "on", True),
+    ("kind", "off", False),
+    ("packed", "off", True),
+    ("packed", "on", False),
+    ("pcsr", "off", False),
+    ("pcsr", "on", True),
+    ("coo", "off", True),
+    ("coo", "on", False),
+    ("csr", "off", False),
+    ("csr", "on", True),
+]
+
+
+@pytest.mark.parametrize("kernel,collapse,blob", PARITY_MATRIX)
+def test_delta_vs_cold_ranking_parity(kernel, collapse, blob):
+    """A delta-route window must rank tie-aware-identical to the cold
+    build of the same frame, through the actual device program for
+    every kernel family, collapsed and uncollapsed, both staging
+    paths."""
+    from microrank_tpu.graph.build import aux_for_kernel
+    from microrank_tpu.rank_backends.blob import stage_rank_window
+    from microrank_tpu.rank_backends.jax_tpu import device_subset
+
+    frames = _timeline(seed=3)
+    aux = aux_for_kernel(kernel)
+    state = None
+    saw_delta = False
+    pr = dataclasses.replace(CFG.pagerank, iterations=15)
+    for k in range(3):
+        wf, nrm, abn, lo, hi = _slide(frames, k)
+        res = build_window_graph_delta(
+            wf, nrm, abn, state=state, start_us=lo, end_us=hi,
+            aux=aux, collapse=collapse,
+        )
+        state = res.state
+        if res.route != "delta":
+            continue
+        saw_delta = True
+        cold = build_window_graph(
+            wf, nrm, abn, aux=aux, collapse=collapse
+        )
+        out_d = jax.device_get(
+            stage_rank_window(
+                device_subset(res.graph, kernel), pr, CFG.spectrum,
+                kernel, blob,
+            )
+        )
+        out_c = jax.device_get(
+            stage_rank_window(
+                device_subset(cold[0], kernel), pr, CFG.spectrum,
+                kernel, blob,
+            )
+        )
+        names_d, scores_d = _names_scores(out_d, res.op_names)
+        names_c, scores_c = _names_scores(out_c, cold[1])
+        ok, why = tie_aware_topk_agreement(
+            names_d, scores_d, names_c, scores_c,
+            k=min(5, len(names_c)), rtol=1e-6,
+        )
+        assert ok, f"window {k}: {why}"
+    assert saw_delta, "no window took the delta route"
+
+
+def test_delta_graph_statistics_match_cold_exactly():
+    """Value-level parity of the assembled partitions: the delta graph's
+    incidence/edge statistics (the sr/rs/ss weights the kernels consume)
+    must be exactly the cold build's, as sets — numbering may differ
+    only through the frozen superset vocab."""
+    frames = _timeline(seed=4)
+    state = None
+    checked = 0
+    # min_pad=512 pins every pad bucket (counts stay below it), so the
+    # no-recompile pad-signature guard never forces a cold rebuild and
+    # each slide past the first exercises the delta assembly.
+    for k in range(4):
+        wf, nrm, abn, lo, hi = _slide(frames, k)
+        res = build_window_graph_delta(
+            wf, nrm, abn, state=state, start_us=lo, end_us=hi,
+            min_pad=512,
+        )
+        state = res.state
+        if res.route != "delta":
+            continue
+        checked += 1
+        g_cold, ops_cold, i0, i1 = build_window_graph(
+            wf, nrm, abn, min_pad=512
+        )
+        for part_d, part_c in (
+            (res.graph.normal, g_cold.normal),
+            (res.graph.abnormal, g_cold.abnormal),
+        ):
+            ops_d = res.op_names
+            nnz = int(np.count_nonzero(np.asarray(part_d.sr_val)))
+            assert nnz == int(np.count_nonzero(np.asarray(part_c.sr_val)))
+            inc_d = sorted(
+                (ops_d[int(o)], float(s), float(r))
+                for o, s, r in zip(
+                    part_d.inc_op[:nnz], part_d.sr_val[:nnz],
+                    part_d.rs_val[:nnz],
+                )
+            )
+            inc_c = sorted(
+                (ops_cold[int(o)], float(s), float(r))
+                for o, s, r in zip(
+                    part_c.inc_op[:nnz], part_c.sr_val[:nnz],
+                    part_c.rs_val[:nnz],
+                )
+            )
+            assert inc_d == inc_c
+            assert int(part_d.n_ops) == int(part_c.n_ops)
+            assert int(part_d.n_traces) == int(part_c.n_traces)
+        assert sorted(map(str, res.normal_trace_ids)) == sorted(
+            map(str, i0)
+        )
+        assert sorted(map(str, res.abnormal_trace_ids)) == sorted(
+            map(str, i1)
+        )
+    assert checked >= 2
+
+
+# -------------------------------------------------- fallback guard chain
+
+
+def test_full_turnover_forces_cold_fallback(registry):
+    """Adversarial churn: a window sharing ZERO traces with the previous
+    one must route cold (reason 'churn'), and both routes land in
+    microrank_build_route_total."""
+    from microrank_tpu.obs.metrics import record_build_route
+
+    frames = _timeline(seed=5, span_us=3 * W_US)
+    w0 = _window(frames, 0, W_US)
+    # 100% turnover: same bounds overlap contract, disjoint span set.
+    w1 = _window(frames, W_US, 2 * W_US)
+    n0, a0 = _partition(w0)
+    n1, a1 = _partition(w1)
+    r0 = build_window_graph_delta(w0, n0, a0, start_us=0, end_us=W_US)
+    record_build_route(r0.route)
+    r1 = build_window_graph_delta(
+        w1, n1, a1, state=r0.state, start_us=W_US, end_us=2 * W_US
+    )
+    record_build_route(r1.route)
+    assert r0.route == "cold" and r0.reason == "init"
+    assert r1.route == "cold" and r1.reason == "churn"
+    ctr = registry.get("microrank_build_route_total")
+    assert ctr.value(route="cold") == 2
+    # And a clean slide of the same stream takes the delta route.
+    frames2 = _timeline(seed=6)
+    state = None
+    for k in range(2):
+        wf, nrm, abn, lo, hi = _slide(frames2, k)
+        res = build_window_graph_delta(
+            wf, nrm, abn, state=state, start_us=lo, end_us=hi,
+            min_pad=512,
+        )
+        record_build_route(res.route)
+        state = res.state
+    assert ctr.value(route="delta") == 1
+
+
+def test_guard_chain_reasons():
+    """Each eligibility guard names its fallback: params mismatch,
+    non-overlapping bounds, an unseen op name (frozen vocab), and an
+    integrity-checksum mismatch (a late span smuggled into the cached
+    region) all rebuild cold — never a wrong delta graph."""
+    frames = _timeline(seed=7)
+    w0, n0, a0, lo0, hi0 = _slide(frames, 0)
+    r0 = build_window_graph_delta(w0, n0, a0, start_us=lo0, end_us=hi0)
+    w1, n1, a1, lo1, hi1 = _slide(frames, 1)
+
+    r = build_window_graph_delta(
+        w1, n1, a1, state=r0.state, start_us=lo1, end_us=hi1, min_pad=16
+    )
+    assert (r.route, r.reason) == ("cold", "params")
+
+    r = build_window_graph_delta(
+        w1, n1, a1, state=r0.state, start_us=hi0 + S_US,
+        end_us=hi0 + S_US + W_US,
+    )
+    assert (r.route, r.reason) == ("cold", "bounds")
+
+    unseen = w1.copy()
+    unseen.loc[unseen.index[-1], "operationName"] = "brand_new_op"
+    r = build_window_graph_delta(
+        unseen, n1, a1, state=r0.state, start_us=lo1, end_us=hi1
+    )
+    assert (r.route, r.reason) == ("cold", "vocab")
+
+    # Late span: a row inside the previous window's time range that the
+    # previous frame never contained — only the checksum can see it.
+    late = w1.copy()
+    extra = late.iloc[[0]].copy()
+    tid = extra.iloc[0]["traceID"]
+    extra["spanID"] = "late_span_xyz"
+    extra["ParentSpanId"] = ""
+    extra["startTime"] = extra["startTime"] - pd.Timedelta(seconds=1)
+    late = pd.concat([late, extra], ignore_index=True)
+    r = build_window_graph_delta(
+        late, n1, a1, state=r0.state, start_us=lo1, end_us=hi1
+    )
+    assert r.route == "cold" and r.reason == "integrity", (
+        r.route, r.reason, tid,
+    )
+
+
+def test_delta_state_ineligible_on_bad_timestamps():
+    frames = _timeline(seed=8)
+    w0, n0, a0, lo, hi = _slide(frames, 0)
+    w0 = w0.copy()
+    w0["startTime"] = np.arange(len(w0))  # not datetime64
+    r = build_window_graph_delta(w0, n0, a0, start_us=lo, end_us=hi)
+    assert r.route == "cold"
+    assert not r.state.eligible and r.state.reason == "timestamps"
+
+
+# --------------------------------- warm-start invalidation (satellite 2)
+
+
+def test_warm_state_survives_column_map_change():
+    """Regression pin: when the delta build changes the kind-collapse
+    column map between windows (trace membership shifts, groups merge or
+    split), the threaded warm state must be REMAPPED through the new
+    retention map or dropped — a stale-state dispatch can never flip the
+    tie-aware top-k verdict vs a cold solve of the same window."""
+    from microrank_tpu.explain.bundle import ExplainContext
+    from microrank_tpu.rank_backends.jax_tpu import (
+        device_subset,
+        rank_window_warm_device,
+    )
+    from microrank_tpu.rank_backends.warm import (
+        capture_warm_state,
+        map_warm_state,
+    )
+
+    frames = _timeline(seed=9, n_traces=200)
+    pr = dataclasses.replace(CFG.pagerank, tol=1e-4, iterations=50)
+
+    def run(graph, init):
+        return jax.device_get(
+            rank_window_warm_device(
+                device_subset(graph, "kind"), init, pr, CFG.spectrum,
+                "kind",
+            )
+        )
+
+    state = None
+    warm = None
+    cmaps = []
+    checked = 0
+    for k in range(4):
+        wf, nrm, abn, lo, hi = _slide(frames, k)
+        res = build_window_graph_delta(
+            wf, nrm, abn, state=state, start_us=lo, end_us=hi,
+            aux="kind", collapse="on",
+        )
+        state = res.state
+        ectx = ExplainContext.from_build(
+            res.graph, res.normal_trace_ids, res.abnormal_trace_ids,
+            res.column_map[0], res.column_map[1],
+        )
+        cmaps.append(
+            tuple(
+                None if m is None else tuple(np.asarray(m).tolist())
+                for m in res.column_map
+            )
+        )
+        init = (
+            map_warm_state(warm, res.op_names, ectx, res.graph)
+            if warm is not None
+            else None
+        )
+        out_w = run(res.graph, init)
+        out_c = run(res.graph, None)
+        if init is not None:
+            checked += 1
+            ok, why = tie_aware_topk_agreement(
+                *_names_scores(out_w, res.op_names),
+                *_names_scores(out_c, res.op_names),
+                k=5, rtol=1e-3, exempt_last=True,
+            )
+            assert ok, f"window {k} (stale-state flip): {why}"
+        warm = capture_warm_state(res.op_names, ectx, out_w[5:9])
+    assert checked >= 2
+    # The pin is only meaningful if the column map actually changed
+    # between consecutive windows at least once.
+    assert any(a != b for a, b in zip(cmaps, cmaps[1:])), (
+        "column map never changed — the invalidation path went untested"
+    )
+
+
+# ------------------------------------------------------ fused pair (blob)
+
+
+def test_fused_pair_program_matches_separate_dispatch():
+    """The fused pair program (one dispatch: both solves + epilogue)
+    must reproduce the separate traced program's ranking and iteration
+    telemetry, blob-staged and tree-staged."""
+    from microrank_tpu.rank_backends.blob import (
+        stage_rank_window,
+        stage_rank_window_warm,
+    )
+    from microrank_tpu.rank_backends.jax_tpu import device_subset
+
+    from microrank_tpu.graph.build import aux_for_kernel
+
+    frames = _timeline(seed=10)
+    wf, nrm, abn, _, _ = _slide(frames, 0)
+    graph, names, _, _ = build_window_graph(
+        wf, nrm, abn, aux=aux_for_kernel("coo")
+    )
+    g = device_subset(graph, "coo")
+    pr = dataclasses.replace(CFG.pagerank, iterations=15)
+    for blob in (True, False):
+        fused = jax.device_get(
+            stage_rank_window_warm(g, None, pr, CFG.spectrum, "coo", blob)
+        )
+        sep = jax.device_get(
+            stage_rank_window(
+                g, pr, CFG.spectrum, "coo", blob, conv_trace=True
+            )
+        )
+        assert len(fused) == 9  # 5 ranked outputs + 4 state exports
+        ok, why = tie_aware_topk_agreement(
+            *_names_scores(fused, names), *_names_scores(sep, names),
+            k=5, rtol=1e-6,
+        )
+        assert ok, why
+        assert int(fused[4]) == int(sep[4])  # same iteration count
+        # State exports carry the partition shapes for the next window.
+        assert fused[5].shape == fused[7].shape  # score vectors [V]
+
+
+def test_router_rank_fused_route_metrics(registry):
+    """DispatchRouter.rank_fused: one dispatch, host outputs, route
+    'fused' recorded in the dispatch metrics."""
+    from microrank_tpu.rank_backends.jax_tpu import prepare_window_graph
+
+    frames = _timeline(seed=11)
+    wf, nrm, abn, _, _ = _slide(frames, 0)
+    cfg = CFG.replace(
+        pagerank=PageRankConfig(iterations=15),
+    )
+    graph, names, kernel = prepare_window_graph(wf, nrm, abn, cfg)
+    from microrank_tpu.dispatch import DispatchRouter
+
+    router = DispatchRouter(cfg)
+    outs, info = router.rank_fused(graph, kernel, None)
+    assert info.route == "fused" and info.windows == 1
+    assert router.dispatches == 1
+    names_f, scores_f = _names_scores(outs, names)
+    assert names_f and all(np.isfinite(scores_f))
+    assert registry.get(
+        "microrank_dispatch_route_total"
+    ).value(route="fused") == 1
+
+
+# --------------------------------------------------- stream engine wiring
+
+
+@pytest.mark.slow
+def test_stream_engine_delta_fused_end_to_end(tmp_path):
+    """Engine wiring: a sliding synthetic replay under
+    delta_build+fused_pair takes the delta route on at least half the
+    built windows, every ranked window dispatches through the fused
+    program, and verdicts match a cold-only control engine tie-aware."""
+    import json
+
+    from microrank_tpu.config import StreamConfig, WindowConfig
+    from microrank_tpu.stream import StreamEngine, SyntheticSource
+    from microrank_tpu.testing import SyntheticConfig
+
+    def source():
+        return SyntheticSource(
+            n_windows=6,
+            faulted=[2, 3, 4],
+            synth_config=SyntheticConfig(
+                n_operations=24, n_traces=200, n_kinds=16, seed=5
+            ),
+            pace_seconds=0.01,
+            sleep=lambda s: None,
+        )
+
+    def run(delta, out):
+        cfg = MicroRankConfig(
+            stream=StreamConfig(
+                allowed_lateness_seconds=5.0, slide_minutes=1.25,
+                max_windows=20,
+            ),
+            window=WindowConfig(detect_minutes=5.0),
+        )
+        cfg = cfg.replace(
+            runtime=dataclasses.replace(
+                cfg.runtime, delta_build=delta, fused_pair=delta
+            ),
+        )
+        eng = StreamEngine(cfg, source(), out_dir=str(out))
+        s = eng.run()
+        evts = [
+            json.loads(line)
+            for line in (out / "journal.jsonl").read_text().splitlines()
+        ]
+        return s, evts
+
+    s_delta, ev_delta = run(True, tmp_path / "delta")
+    s_cold, ev_cold = run(False, tmp_path / "cold")
+    routes = [
+        (e["route"], e["reason"])
+        for e in ev_delta
+        if e["event"] == "build_route"
+    ]
+    n_delta = sum(1 for r, _ in routes if r == "delta")
+    assert routes and n_delta >= len(routes) / 2, routes
+
+    def ranked(evts):
+        return [
+            e
+            for e in evts
+            if e["event"] == "window" and e.get("outcome") == "ranked"
+        ]
+
+    rd, rc = ranked(ev_delta), ranked(ev_cold)
+    assert len(rd) == len(rc) > 0
+    assert all(e["route"] in ("fused", "fused_cold") for e in rd)
+    for d, c in zip(rd, rc):
+        assert d["start"] == c["start"]
+        assert d["top1"] == c["top1"]

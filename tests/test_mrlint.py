@@ -67,10 +67,15 @@ def test_repo_tree_is_clean():
     assert violations == [], "\n".join(v.format() for v in violations)
 
 
-def test_cli_lint_exits_zero_on_tree(capsys):
+def test_cli_lint_exits_zero_on_clean(capsys):
+    # The CLI's zero-exit/"clean" contract on a clean subtree. The
+    # full-tree cleanliness invariant is test_repo_tree_is_clean above
+    # (same lint_paths engine) — re-linting the whole package through
+    # the CLI doubled the most expensive call in the suite for no
+    # added coverage.
     from microrank_tpu.cli.main import main
 
-    assert main(["lint", str(REPO_PKG)]) == 0
+    assert main(["lint", str(REPO_PKG / "utils")]) == 0
     assert "clean" in capsys.readouterr().out
 
 
